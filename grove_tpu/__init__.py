@@ -1,0 +1,28 @@
+"""grove-tpu: a TPU-native orchestration framework + JAX serving stack.
+
+This package provides the capabilities of ai-dynamo/grove (a Kubernetes
+operator for gang-scheduled AI inference: PodCliqueSet / PodClique /
+PodCliqueScalingGroup / PodGang / ClusterTopology — see
+/root/reference/README.md:9-41) re-designed TPU-first as a standalone
+control plane plus the JAX workload stack that runs inside the pods it
+orchestrates:
+
+- ``grove_tpu.api``        — the typed resource API (Grove's CRDs, A1-A7)
+- ``grove_tpu.store``      — versioned object store with watch semantics
+                             (the etcd/apiserver analog)
+- ``grove_tpu.runtime``    — controller runtime: workqueues, reconcile flow,
+                             expectations, concurrency (R1-R10)
+- ``grove_tpu.controllers``— domain controllers (C1-C6)
+- ``grove_tpu.scheduler``  — pluggable gang-scheduler backends, slice-atomic
+                             TPU placement (S1-S5)
+- ``grove_tpu.topology``   — TPU fleet model: slices, hosts, ICI/DCN levels
+- ``grove_tpu.admission``  — defaulting / validation / authorization (W1-W6)
+- ``grove_tpu.agent``      — node agents (real subprocess pods + fake nodes)
+                             and the in-pod startup barrier (I1)
+- ``grove_tpu.models``     — flagship JAX models (Llama family)
+- ``grove_tpu.ops``        — attention, KV cache, norms, rope
+- ``grove_tpu.parallel``   — meshes, sharding rules, collectives
+- ``grove_tpu.serving``    — disaggregated prefill/decode engine
+"""
+
+from grove_tpu.version import __version__  # noqa: F401

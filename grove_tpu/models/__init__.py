@@ -1,0 +1,17 @@
+from grove_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    forward,
+    prefill,
+    decode_step,
+    CONFIGS,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "prefill",
+    "decode_step",
+    "CONFIGS",
+]

@@ -1,0 +1,253 @@
+"""Llama-family decoder in pure functional JAX — the flagship model.
+
+Design notes (TPU-first):
+- Params are a plain pytree with all layers stacked on a leading axis; the
+  forward pass is one `lax.scan` over layers → one compiled layer body,
+  fast compile, and XLA pipelines HBM prefetch of layer weights.
+- bf16 params/activations, f32 for softmax/norm accumulation
+  (`preferred_element_type`) — keeps the MXU fed at its native precision.
+- No Python control flow on traced values; decode uses static max lengths
+  with per-lane `lengths` masking (see grove_tpu/ops/kvcache.py).
+- Sharding is applied externally via grove_tpu.parallel.sharding rules;
+  model code is mesh-agnostic.
+
+This is the serving workload Grove-the-reference orchestrates but never
+implements (the reference runs vLLM/SGLang inside pods — README.md:35-41);
+here it is part of the framework so a PodCliqueSet can deploy a complete
+disaggregated prefill/decode Llama service with no external engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grove_tpu.ops import kvcache
+from grove_tpu.ops.attention import causal_attention, decode_attention
+from grove_tpu.ops.kvcache import KVCache
+from grove_tpu.ops.norms import rms_norm
+from grove_tpu.ops.rope import apply_rope, rope_table
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 5632
+    head_dim: int = 128
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def params_bytes(self) -> int:
+        c = self
+        per_layer = (2 * c.d_model
+                     + c.d_model * c.n_heads * c.head_dim
+                     + 2 * c.d_model * c.n_kv_heads * c.head_dim
+                     + c.n_heads * c.head_dim * c.d_model
+                     + 3 * c.d_model * c.d_ff)
+        total = (2 * c.vocab_size * c.d_model + c.d_model
+                 + c.n_layers * per_layer)
+        return total * jnp.dtype(c.dtype).itemsize
+
+
+CONFIGS: dict[str, LlamaConfig] = {
+    # Tiny config for unit tests and multichip dry-runs (divisible by 8 for
+    # tp=4/sp=2 virtual meshes).
+    "test-tiny": LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                             n_heads=8, n_kv_heads=4, d_ff=128, head_dim=8,
+                             max_seq_len=128),
+    # ~1.1B — fits a single v5e chip in bf16 with room for KV cache; the
+    # single-chip bench model.
+    "llama-1b": LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                            n_heads=16, n_kv_heads=8, d_ff=5632, head_dim=128,
+                            max_seq_len=2048),
+    # Llama-3-8B-shaped (docs/perf projections; needs >1 chip for headroom).
+    "llama-8b": LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                            n_heads=32, n_kv_heads=8, d_ff=14336, head_dim=128,
+                            max_seq_len=8192),
+    # Llama-70B-shaped — the north-star disaggregated serving target
+    # (BASELINE.md: v5e-256, tp over ICI).
+    "llama-70b": LlamaConfig(vocab_size=128256, d_model=8192, n_layers=80,
+                             n_heads=64, n_kv_heads=8, d_ff=28672, head_dim=128,
+                             max_seq_len=8192),
+}
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Initialise a parameter pytree (layers stacked on axis 0)."""
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+    d, h, kv, hd, ff, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                           cfg.head_dim, cfg.d_ff, cfg.n_layers)
+
+    def norm_init(shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": norm_init((L, d)),
+        "mlp_norm": norm_init((L, d)),
+        "wq": dense_init(ks[0], (L, d, h, hd), d),
+        "wk": dense_init(ks[1], (L, d, kv, hd), d),
+        "wv": dense_init(ks[2], (L, d, kv, hd), d),
+        "wo": dense_init(ks[3], (L, h, hd, d), h * hd),
+        "w_gate": dense_init(ks[4], (L, d, ff), d),
+        "w_up": dense_init(ks[5], (L, d, ff), d),
+        "w_down": dense_init(ks[6], (L, ff, d), ff),
+    }
+    return {
+        "tok_embed": dense_init(k_embed, (cfg.vocab_size, d), d),
+        "lm_head": dense_init(k_head, (d, cfg.vocab_size), d),
+        "final_norm": norm_init((d,)),
+        "layers": layers,
+    }
+
+
+def _qkv(cfg: LlamaConfig, x, lp, cos, sin, positions):
+    """Pre-norm + QKV projections + rope. Shared by prefill and decode."""
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    return q, k, v
+
+
+def _attn_out(x, attn, lp):
+    return x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]).astype(x.dtype)
+
+
+def _mlp_block(cfg: LlamaConfig, x, lp):
+    """Pre-norm SwiGLU MLP with residual. Shared by prefill and decode."""
+    hm = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", hm, lp["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", hm, lp["w_up"])
+    return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          lp["w_down"]).astype(x.dtype)
+
+
+def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset):
+    """One decoder layer over a full sequence. x: [b, s, d_model]."""
+    q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+    attn = causal_attention(q, k, v, q_offset=q_offset)
+    x = _attn_out(x, attn, lp)
+    x = _mlp_block(cfg, x, lp)
+    return x, (k, v)
+
+
+def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Full forward pass → logits [b, s, vocab]. Training / compile-check path."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+
+    def body(x, lp):
+        x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+        return x, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
+def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            cache: KVCache,
+            lengths: jnp.ndarray | None = None) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill: run the prompt, fill the cache, return last-token logits.
+
+    tokens: [b, s], right-padded to a static s; ``lengths`` [b] gives the
+    true prompt length per lane (defaults to s for all lanes). Cache lanes
+    are overwritten from position 0. Pad positions ≥ length are causally
+    invisible to valid tokens and marked invalid in the returned cache, and
+    the returned logits are taken at each lane's last *valid* token.
+
+    Returns (logits [b, vocab], cache with lengths set per lane).
+    """
+    b, s = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        x, (k, v) = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+        kc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(kc, k, 0)
+        vc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(vc, v, 0)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    # Last valid token per lane (ragged batches: pad rows carry garbage).
+    x_last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = jnp.einsum("bd,dv->bv", x_last, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    new_cache = KVCache(k=k_all, v=v_all, lengths=lengths.astype(jnp.int32))
+    return logits, new_cache
+
+
+def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                cache: KVCache) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step. tokens: [b] (last sampled token per lane).
+
+    Returns (logits [b, vocab], cache advanced by one).
+
+    Capacity: callers must not decode a lane past ``cache.max_len`` — the
+    cache write clamps silently (see kvcache.write_row); check
+    ``cache.has_room()`` before stepping (the serving engine evicts or
+    stops lanes that are full).
+    """
+    b = tokens.shape[0]
+    positions = cache.lengths[:, None]  # [b, 1]
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)  # [b, 1, d]
+    new_lengths = cache.lengths + 1
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        kc = jax.vmap(kvcache.write_row)(kc, k, cache.lengths)
+        vc = jax.vmap(kvcache.write_row)(vc, v, cache.lengths)
+        attn = decode_attention(q, kc, vc, new_lengths)
+        x = _attn_out(x, attn, lp)
+        x = _mlp_block(cfg, x, lp)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, KVCache(k=k_all, v=v_all, lengths=new_lengths)
+
+
+def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy (training path for the multichip dry-run)."""
+    logits = forward(cfg, params, tokens)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,13 @@
+from grove_tpu.ops.norms import rms_norm
+from grove_tpu.ops.rope import apply_rope, rope_table
+from grove_tpu.ops.attention import causal_attention, decode_attention
+from grove_tpu.ops.kvcache import KVCache
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_table",
+    "causal_attention",
+    "decode_attention",
+    "KVCache",
+]

@@ -1,0 +1,69 @@
+"""Attention ops: prefill (full causal) and decode (single-token vs cache).
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout; GQA
+is handled by repeating KV heads up to Q heads with a reshape-free einsum
+grouping (no materialised repeat).
+
+The prefill path is a plain jnp formulation — XLA fuses the softmax chain
+and tiles the two matmuls onto the MXU; a pallas flash kernel can be slotted
+in behind the same signature (see grove_tpu/ops/pallas/).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _group_heads(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
+    """[b, s, h, d] -> [b, s, n_kv, group, d] view for GQA."""
+    b, s, h, d = q.shape
+    return q.reshape(b, s, n_kv, h // n_kv, d)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     *, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Causal GQA attention for prefill.
+
+    q: [b, sq, h, d]; k, v: [b, skv, n_kv, d]. ``q_offset`` is the absolute
+    position of q[0] (for chunked prefill against a longer KV prefix).
+    """
+    b, sq, h, d = q.shape
+    n_kv = k.shape[2]
+    qg = _group_heads(q, n_kv)  # [b, sq, n_kv, g, d]
+    scale = d ** -0.5
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg * scale, k,
+                        preferred_element_type=jnp.float32)
+    q_pos = jnp.arange(sq)[:, None] + q_offset
+    kv_pos = jnp.arange(k.shape[1])[None, :]
+    mask = q_pos >= kv_pos  # causal
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jnp.exp(logits - lax.stop_gradient(
+        jnp.max(logits, axis=-1, keepdims=True)))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, h, d)
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     lengths: jnp.ndarray) -> jnp.ndarray:
+    """Single-step attention against a (padded) KV cache.
+
+    q: [b, 1, h, d]; caches: [b, max_len, n_kv, d]; lengths: [b] — number of
+    valid cache entries per sequence (the new token's K/V already written).
+    """
+    b, _, h, d = q.shape
+    n_kv = k_cache.shape[2]
+    qg = _group_heads(q, n_kv)[:, 0]  # [b, n_kv, g, d]
+    scale = d ** -0.5
+    logits = jnp.einsum("bkgd,bskd->bkgs", qg * scale, k_cache,
+                        preferred_element_type=jnp.float32)
+    valid = jnp.arange(k_cache.shape[1])[None, :] < lengths[:, None]  # [b, s]
+    logits = jnp.where(valid[:, None, None], logits, NEG_INF)
+    logits = logits - jnp.max(logits, axis=-1, keepdims=True)
+    probs = jnp.exp(logits)
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, 1, h, d)

@@ -1,0 +1,49 @@
+"""KV cache for incremental decoding.
+
+A dense cache [layers, batch, max_len, n_kv, head_dim] with a per-lane
+length vector. Static shapes throughout (jit-friendly); insertion is a
+`dynamic_update_slice` along the sequence axis. The serving engine
+allocates one cache per decode batch lane and recycles lanes (continuous
+batching) — see grove_tpu/serving/engine.py.
+
+Layer-level writes happen inside the model's `lax.scan` over layers (the
+cache rows ride the scan as xs/ys), so the write helpers here operate on
+single-lane rows and are shared by prefill and decode paths.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def write_row(row: jnp.ndarray, kv: jnp.ndarray, pos: jnp.ndarray | int) -> jnp.ndarray:
+    """Write ``kv`` [s, n_kv, d] into one lane's cache row [max_len, n_kv, d]
+    starting at ``pos``. NOTE: lax dynamic-update semantics clamp ``pos`` so
+    the write never errors past max_len — callers must enforce capacity
+    (see KVCache.has_room)."""
+    return lax.dynamic_update_slice_in_dim(row, kv.astype(row.dtype), pos, axis=0)
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray        # [layers, b, max_len, n_kv, d]
+    v: jnp.ndarray        # [layers, b, max_len, n_kv, d]
+    lengths: jnp.ndarray  # [b] int32 — valid entries per lane
+
+    @classmethod
+    def create(cls, n_layers: int, batch: int, max_len: int, n_kv: int,
+               head_dim: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (n_layers, batch, max_len, n_kv, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((batch,), jnp.int32))
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    def has_room(self, n_tokens: int = 1) -> jnp.ndarray:
+        """[b] bool — lanes that can accept ``n_tokens`` more without the
+        silent clamp in write_row corrupting the tail of the cache."""
+        return self.lengths + n_tokens <= self.max_len

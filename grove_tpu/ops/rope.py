@@ -1,0 +1,37 @@
+"""Rotary position embeddings.
+
+Precompute the cos/sin table once (host-side, outside jit when possible)
+and gather rows by position — avoids recomputing sin/cos per step in the
+decode loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0,
+               dtype=jnp.float32) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin), each [max_len, head_dim//2]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    pos = np.arange(max_len, dtype=np.float32)
+    ang = np.outer(pos, freqs)
+    return jnp.asarray(np.cos(ang), dtype=dtype), jnp.asarray(np.sin(ang), dtype=dtype)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., seq, heads, head_dim] by per-token ``positions`` [..., seq].
+
+    Uses the split-halves ("rotate-half" / GPT-NeoX) convention: dimension
+    ``i`` pairs with ``i + head_dim//2``. Meta-Llama checkpoints use the
+    interleaved (2i, 2i+1) pairing — a checkpoint importer must permute
+    wq/wk columns to this layout (the standard HF conversion).
+    """
+    c = cos[positions][..., None, :]  # [..., seq, 1, half]
+    s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

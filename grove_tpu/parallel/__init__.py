@@ -1,0 +1,17 @@
+from grove_tpu.parallel.mesh import MeshPlan, build_mesh, mesh_axes_for
+from grove_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    logical_sharding,
+    param_pspec,
+    shard_params,
+)
+
+__all__ = [
+    "MeshPlan",
+    "build_mesh",
+    "mesh_axes_for",
+    "LOGICAL_RULES",
+    "logical_sharding",
+    "param_pspec",
+    "shard_params",
+]

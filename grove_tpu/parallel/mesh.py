@@ -1,0 +1,125 @@
+"""Device-mesh construction for TPU slices and multislice deployments.
+
+Grove's orchestration layer places a PodCliqueScalingGroup replica onto one
+ICI-connected TPU slice and spreads PodCliqueSet replicas over DCN (see
+SURVEY.md §2.7/§2.8 and the reference's topology packing at
+operator/api/core/v1alpha1/podcliqueset.go:296-309). Inside the pods, the
+JAX side of that contract is a `jax.sharding.Mesh` whose axes mirror the
+physical fabric:
+
+- ``dp`` — data parallelism. Across slices (DCN) in multislice, or across
+  hosts within a slice.
+- ``sp`` — sequence/context parallelism (ring attention / all-to-all over
+  ICI neighbors).
+- ``tp`` — tensor parallelism over the fastest ICI dimension.
+
+Axis order is outermost-to-innermost = slowest-to-fastest interconnect, so
+collectives over ``tp`` ride the torus's nearest-neighbor links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+
+# Canonical axis order: outermost (slowest fabric) ... innermost (fastest).
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A concrete (dp, sp, tp) factorisation of a device count."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {AXIS_DP: self.dp, AXIS_SP: self.sp, AXIS_TP: self.tp}
+
+
+def _largest_pow2_divisor(n: int, cap: int) -> int:
+    d = 1
+    while d * 2 <= cap and n % (d * 2) == 0:
+        d *= 2
+    return d
+
+
+def mesh_axes_for(n_devices: int, *, want_sp: bool = True,
+                  max_tp: int = 8) -> MeshPlan:
+    """Pick a sensible (dp, sp, tp) factorisation for ``n_devices``.
+
+    Heuristic: give ``tp`` the largest power-of-two divisor up to ``max_tp``
+    (tensor parallelism wants the fastest links and benefits most from being
+    wide), then one factor of 2 to ``sp`` when available (ring attention needs
+    ≥2 to exercise the ring), and the remainder to ``dp``.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    tp = _largest_pow2_divisor(n_devices, min(max_tp, n_devices))
+    rest = n_devices // tp
+    sp = 1
+    if want_sp and rest % 2 == 0 and rest >= 2:
+        sp = 2
+    dp = rest // sp
+    plan = MeshPlan(dp=dp, sp=sp, tp=tp)
+    assert plan.size == n_devices, (plan, n_devices)
+    return plan
+
+
+def build_mesh(plan: MeshPlan | None = None,
+               devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a `Mesh` with axes (dp, sp, tp) over ``devices``.
+
+    When ``plan`` is None, a plan is derived from the device count. Devices
+    default to all visible devices. The device array is laid out so that
+    adjacent devices (fastest ICI neighbours under the default enumeration)
+    land on the innermost (tp) axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if plan is None:
+        plan = mesh_axes_for(len(devices))
+    if plan.size != len(devices):
+        raise ValueError(
+            f"mesh plan {plan} needs {plan.size} devices, have {len(devices)}")
+    arr = np.array(devices).reshape(plan.dp, plan.sp, plan.tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A trivial 1x1x1 mesh (single-chip serving / bench path)."""
+    if device is None:
+        device = jax.devices()[0]
+    return build_mesh(MeshPlan(), [device])
+
+
+def validate_plan_fits_slice(plan: MeshPlan, slice_chips: int) -> None:
+    """Gang contract: tp*sp must fit inside one ICI slice.
+
+    dp may cross slices (DCN); tp and sp traffic must stay on ICI. The
+    orchestrator enforces the pod-placement half of this (slice-atomic
+    PodGangs); this checks the in-pod mesh half.
+    """
+    ici = plan.tp * plan.sp
+    if ici > slice_chips:
+        raise ValueError(
+            f"tp*sp={ici} exceeds slice size {slice_chips}; "
+            "sequence/tensor parallel groups must be ICI-resident")
+    if slice_chips % ici != 0:
+        raise ValueError(
+            f"slice size {slice_chips} not divisible by tp*sp={ici}")

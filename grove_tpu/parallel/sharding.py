@@ -1,0 +1,87 @@
+"""Logical→physical sharding rules for the model stack.
+
+Parameters and activations are annotated with *logical* axis names; the
+rules below map them onto mesh axes (dp, sp, tp). This keeps model code
+free of mesh knowledge — the same model runs single-chip (all rules
+collapse to replication) or on a v5e-256 mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+# logical axis -> mesh axis (None = replicate)
+LOGICAL_RULES: dict[str, str | None] = {
+    "batch": AXIS_DP,
+    "seq": AXIS_SP,          # sequence parallelism for long context
+    "vocab": AXIS_TP,
+    "embed": None,           # d_model replicated (activations row-sharded by batch)
+    "heads": AXIS_TP,        # attention heads over tp
+    "kv_heads": AXIS_TP,
+    "head_dim": None,
+    "mlp": AXIS_TP,          # ffn hidden over tp
+    "layers": None,          # scan-stacked layer axis
+    "expert": AXIS_TP,       # MoE experts over tp (EP == TP group here)
+}
+
+
+def logical_pspec(*logical_axes: str | None) -> P:
+    """Translate a tuple of logical axis names to a PartitionSpec.
+
+    Unknown names raise (a typo'd axis silently replicating would cost
+    N× memory and collectives while still computing correct numbers).
+    """
+    return P(*[LOGICAL_RULES[a] if a is not None else None
+               for a in logical_axes])
+
+
+def logical_sharding(mesh: Mesh, *logical_axes: str | None) -> NamedSharding:
+    return NamedSharding(mesh, logical_pspec(*logical_axes))
+
+
+# PartitionSpecs per parameter leaf name. Keys match the param pytree
+# produced by grove_tpu.models.llama.init_params.
+_PARAM_RULES: dict[str, tuple[str | None, ...]] = {
+    "tok_embed": ("vocab", "embed"),
+    "lm_head": ("embed", "vocab"),
+    "final_norm": ("embed",),
+    # per-layer (leading stacked "layers" axis added automatically)
+    "attn_norm": ("embed",),
+    "mlp_norm": ("embed",),
+    "wq": ("embed", "heads", "head_dim"),
+    "wk": ("embed", "kv_heads", "head_dim"),
+    "wv": ("embed", "kv_heads", "head_dim"),
+    "wo": ("heads", "head_dim", "embed"),
+    "w_gate": ("embed", "mlp"),
+    "w_up": ("embed", "mlp"),
+    "w_down": ("mlp", "embed"),
+}
+
+_STACKED = {"attn_norm", "mlp_norm", "wq", "wk", "wv", "wo",
+            "w_gate", "w_up", "w_down"}
+
+
+def param_pspec(name: str) -> P:
+    """PartitionSpec for a named parameter leaf."""
+    logical = _PARAM_RULES[name]
+    if name in _STACKED:
+        logical = ("layers",) + logical
+    return logical_pspec(*logical)
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    """A pytree of NamedShardings matching ``params`` (dict-of-dict layout)."""
+    def leaf(path, _):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return NamedSharding(mesh, param_pspec(name))
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def shard_params(mesh: Mesh, params: Any) -> Any:
+    """Device-put params with their canonical shardings."""
+    return jax.device_put(params, param_shardings(mesh, params))

@@ -1,0 +1,9 @@
+"""Build/version info (reference: operator/internal/version/version.go)."""
+
+__version__ = "0.1.0"
+
+GIT_COMMIT = "dev"
+
+
+def version_info() -> dict:
+    return {"version": __version__, "commit": GIT_COMMIT}

@@ -1,0 +1,31 @@
+"""Test environment: force a virtual 8-device CPU platform BEFORE jax import.
+
+Mirrors the reference's ladder of cluster-free testing (SURVEY.md §4: envtest
+/ fake clients / KWOK) — multi-chip sharding is validated on a virtual CPU
+mesh; only bench.py touches the real TPU.
+"""
+
+import os
+
+# The image presets JAX_PLATFORMS=axon (the tunnelled real TPU) and its
+# sitecustomize partially imports jax, which latches the platform choice —
+# the env var alone is not enough; jax.config.update below overrides it.
+# Tests always run on the virtual CPU mesh; only bench.py touches the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
