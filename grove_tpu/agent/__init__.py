@@ -1,0 +1,4 @@
+from grove_tpu.agent.node import FakeKubeletPool
+from grove_tpu.agent.barrier import barrier_satisfied
+
+__all__ = ["FakeKubeletPool", "barrier_satisfied"]

@@ -1,0 +1,34 @@
+"""Startup-order barrier — the grove-initc analog (I1).
+
+The reference injects an init container that watches sibling pods and
+blocks until every parent PodClique has >= minAvailable Ready pods
+(initc/internal/wait.go:109-274). Here the same predicate is evaluated
+by the node agent before it starts (fake: marks Running) the workload
+process; the real agent also re-checks before exec'ing the payload.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import PodClique
+from grove_tpu.api.core import StartupBarrier
+from grove_tpu.runtime.errors import NotFoundError
+from grove_tpu.store.client import Client
+
+
+def barrier_satisfied(client: Client, barrier: StartupBarrier | None,
+                      namespace: str = "default") -> bool:
+    if barrier is None or not barrier.parent_cliques:
+        return True
+    for fqn in barrier.parent_cliques:
+        try:
+            parent = client.get(PodClique, fqn, namespace)
+        except NotFoundError:
+            return False
+        # Pinned threshold if the pod builder recorded one; otherwise the
+        # parent's live min_available (the parent PCLQ may not have existed
+        # at pod-build time — a stale default of 1 would let children jump
+        # the barrier).
+        need = barrier.min_available.get(fqn, parent.spec.min_available)
+        if parent.status.ready_replicas < need:
+            return False
+    return True

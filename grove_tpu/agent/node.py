@@ -1,0 +1,93 @@
+"""Node agents: run (or synthesise) the pods bound to TPU hosts.
+
+FakeKubeletPool is the KWOK analog (SURVEY.md §4): one thread services
+every fake node, transitioning bound pods Pending → Running (+Ready)
+once their startup barrier clears — no processes run, so the control
+plane can be exercised at 1000-pod scale on one machine. The real
+subprocess-running agent lives in grove_tpu.agent.process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from grove_tpu.api import Node, Pod, constants as c
+from grove_tpu.api.core import PodPhase
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.agent.barrier import barrier_satisfied
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+
+class FakeKubeletPool:
+    """Synthetic readiness for all fake nodes (KWOK analog)."""
+
+    def __init__(self, client: Client, namespace: str = "default",
+                 tick: float = 0.05, startup_latency: float = 0.0):
+        self.client = client
+        self.namespace = namespace
+        self.tick = tick
+        self.startup_latency = startup_latency
+        self.log = get_logger("agent.fake")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="fake-kubelet",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._pass()
+            except Exception:  # noqa: BLE001 - agent survival barrier
+                self.log.exception("fake kubelet pass panicked")
+            time.sleep(self.tick)
+
+    def _fake_nodes(self) -> set[str]:
+        return {n.meta.name for n in self.client.list(Node, self.namespace)
+                if n.spec.fake}
+
+    def _pass(self) -> None:
+        fake_nodes = self._fake_nodes()
+        if not fake_nodes:
+            return
+        for pod in self.client.list(Pod, self.namespace):
+            if (pod.status.node_name in fake_nodes
+                    and pod.status.phase == PodPhase.PENDING
+                    and pod.meta.deletion_timestamp is None):
+                if not barrier_satisfied(self.client, pod.spec.startup_barrier,
+                                         self.namespace):
+                    continue
+                if self.startup_latency:
+                    time.sleep(self.startup_latency)
+                pod.status.phase = PodPhase.RUNNING
+                pod.status.start_time = time.time()
+                pod.status.pod_ip = f"10.0.{hash(pod.meta.name) % 250}.{hash(pod.meta.uid) % 250}"
+                pod.status.conditions = set_condition(
+                    pod.status.conditions,
+                    Condition(type=c.COND_READY, status="True",
+                              reason="FakeNodeReady"))
+                try:
+                    self.client.update_status(pod)
+                except GroveError:
+                    pass  # retried next pass
+
+
+def fail_pod(client: Client, name: str, namespace: str = "default",
+             message: str = "injected failure") -> None:
+    """Test/chaos helper: mark a pod failed (node crash analog)."""
+    pod = client.get(Pod, name, namespace)
+    pod.status.phase = PodPhase.FAILED
+    pod.status.message = message
+    pod.status.conditions = set_condition(
+        pod.status.conditions,
+        Condition(type=c.COND_READY, status="False", reason="Failed",
+                  message=message))
+    client.update_status(pod)

@@ -1,0 +1,56 @@
+"""Typed resource API — the framework's equivalent of Grove's CRDs.
+
+Mirrors the reference's API surface (SURVEY.md §2.1, A1-A7):
+PodCliqueSet / PodClique / PodCliqueScalingGroup (operator API),
+PodGang (scheduler API), ClusterTopology, plus — because this framework
+is its own control plane, not a Kubernetes add-on — the core data-plane
+types Pod and Node.
+"""
+
+from grove_tpu.api.meta import (
+    Condition,
+    ObjectMeta,
+    OwnerReference,
+    new_meta,
+)
+from grove_tpu.api.core import (
+    Node,
+    NodeStatus,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    ContainerSpec,
+)
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    HeadlessServiceConfig,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetStatus,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    StartupType,
+    TopologyConstraint,
+    UpdateStrategy,
+)
+from grove_tpu.api.podclique import PodClique, PodCliqueSpec, PodCliqueStatus
+from grove_tpu.api.scalinggroup import (
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupSpec,
+    PodCliqueScalingGroupStatus,
+)
+from grove_tpu.api.podgang import (
+    PodGang,
+    PodGangPhase,
+    PodGangSpec,
+    PodGangStatus,
+    PodGroup,
+)
+from grove_tpu.api.clustertopology import (
+    ClusterTopology,
+    TopologyLevel,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

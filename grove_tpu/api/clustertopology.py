@@ -1,0 +1,60 @@
+"""ClusterTopology — the ordered hierarchy of placement domains.
+
+Parity with reference operator/api/core/v1alpha1/clustertopologybinding.go:
+32-155, with TPU-native levels. Default hierarchy (outer → inner):
+
+  pool        — node pool / datacenter block (DCN between pools)
+  superblock  — optically-switched group of slices (v4/v5p) or pool subnet
+  slice       — one ICI mesh (the gang-atomic domain)
+  host        — one TPU VM (4 or 8 chips)
+
+Each level names the node label that carries its domain value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from grove_tpu.api import constants
+from grove_tpu.api.meta import Condition, ObjectMeta
+
+
+@dataclasses.dataclass
+class TopologyLevel:
+    domain: str = ""      # level name, e.g. "slice"
+    node_label: str = ""  # node label key carrying the domain value
+
+
+DEFAULT_TPU_LEVELS = [
+    TopologyLevel("pool", constants.NODE_LABEL_POOL),
+    TopologyLevel("superblock", constants.NODE_LABEL_SUPERBLOCK),
+    TopologyLevel("slice", constants.NODE_LABEL_SLICE),
+    TopologyLevel("host", constants.NODE_LABEL_HOST),
+]
+
+
+@dataclasses.dataclass
+class ClusterTopologySpec:
+    levels: list[TopologyLevel] = dataclasses.field(
+        default_factory=lambda: list(DEFAULT_TPU_LEVELS))
+    # Scheduler backends that auto-manage their own topology view get it
+    # synced from this resource; externally-managed ones are drift-checked.
+    externally_managed: bool = False
+
+
+@dataclasses.dataclass
+class ClusterTopologyStatus:
+    synced_backends: list[str] = dataclasses.field(default_factory=list)
+    drift_detected: bool = False
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClusterTopology:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: ClusterTopologySpec = dataclasses.field(
+        default_factory=ClusterTopologySpec)
+    status: ClusterTopologyStatus = dataclasses.field(
+        default_factory=ClusterTopologyStatus)
+
+    KIND = "ClusterTopology"

@@ -1,0 +1,94 @@
+"""OperatorConfiguration — component-config for the control plane.
+
+Parity with reference operator/api/config/v1alpha1/types.go:120-313:
+per-controller concurrency, scheduler profiles with a default, topology-
+aware-scheduling toggle, authorizer toggle, log settings. Loaded from a
+YAML file by the CLI (`grove_tpu.cli`), defaulted and validated before use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from grove_tpu.api import constants
+
+
+@dataclasses.dataclass
+class ControllerConcurrency:
+    podcliqueset: int = 2
+    podclique: int = 4
+    podcliquescalinggroup: int = 2
+    podgang: int = 2
+    clustertopology: int = 1
+
+
+@dataclasses.dataclass
+class SchedulerProfile:
+    name: str = ""          # profile name referenced by PCS spec
+    backend: str = ""       # registered backend: "gang" | "simple" | "external"
+    options: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TopologyAwareSchedulingConfig:
+    enabled: bool = True
+
+
+@dataclasses.dataclass
+class AuthorizerConfig:
+    enabled: bool = False
+    exempt_actors: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class LogConfig:
+    level: str = "info"
+    format: str = "text"    # "text" | "json"
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    enabled: bool = True
+    sync_period_seconds: float = 5.0
+
+
+@dataclasses.dataclass
+class OperatorConfiguration:
+    concurrency: ControllerConcurrency = dataclasses.field(
+        default_factory=ControllerConcurrency)
+    scheduler_profiles: list[SchedulerProfile] = dataclasses.field(
+        default_factory=lambda: [
+            SchedulerProfile(name="default", backend=constants.DEFAULT_SCHEDULER),
+            SchedulerProfile(name="simple", backend="simple"),
+        ])
+    default_scheduler_profile: str = "default"
+    topology_aware_scheduling: TopologyAwareSchedulingConfig = dataclasses.field(
+        default_factory=TopologyAwareSchedulingConfig)
+    authorizer: AuthorizerConfig = dataclasses.field(
+        default_factory=AuthorizerConfig)
+    autoscaler: AutoscalerConfig = dataclasses.field(
+        default_factory=AutoscalerConfig)
+    log: LogConfig = dataclasses.field(default_factory=LogConfig)
+    # reconcile loop tuning
+    requeue_base_seconds: float = 0.05
+    requeue_max_seconds: float = 5.0
+
+
+def validate_config(cfg: OperatorConfiguration) -> list[str]:
+    """Return a list of problems (empty == valid)."""
+    errs: list[str] = []
+    for field, v in dataclasses.asdict(cfg.concurrency).items():
+        if v < 1:
+            errs.append(f"concurrency.{field} must be >= 1, got {v}")
+    names = [p.name for p in cfg.scheduler_profiles]
+    if len(set(names)) != len(names):
+        errs.append(f"duplicate scheduler profile names: {names}")
+    if cfg.default_scheduler_profile not in names:
+        errs.append(
+            f"default_scheduler_profile {cfg.default_scheduler_profile!r} "
+            f"not among profiles {names}")
+    if cfg.log.level not in ("debug", "info", "warning", "error"):
+        errs.append(f"unknown log level {cfg.log.level!r}")
+    if cfg.log.format not in ("text", "json"):
+        errs.append(f"unknown log format {cfg.log.format!r}")
+    return errs

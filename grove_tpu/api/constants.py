@@ -1,0 +1,72 @@
+"""Shared label keys, env-var names, scheduling gates, finalizers.
+
+Parity with the reference's api/common/constants/constants.go:56-71 label
+and env contract, re-targeted at TPU: workload pods receive both the
+framework rank identity (GROVE_*) and the JAX/TPU bootstrap contract
+(TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / slice metadata) so a multi-host
+JAX process group initialises with zero extra wiring.
+"""
+
+DOMAIN = "grove.tpu"
+
+# ---- labels ----
+LABEL_MANAGED_BY = f"{DOMAIN}/managed-by"
+LABEL_MANAGED_BY_VALUE = "grove-tpu-operator"
+LABEL_PCS_NAME = f"{DOMAIN}/podcliqueset"
+LABEL_PCS_REPLICA = f"{DOMAIN}/podcliqueset-replica-index"
+LABEL_PCLQ_NAME = f"{DOMAIN}/podclique"
+LABEL_PCLQ_ROLE = f"{DOMAIN}/podclique-role"
+LABEL_PCSG_NAME = f"{DOMAIN}/podcliquescalinggroup"
+LABEL_PCSG_REPLICA = f"{DOMAIN}/podcliquescalinggroup-replica-index"
+LABEL_PODGANG_NAME = f"{DOMAIN}/podgang"
+LABEL_POD_INDEX = f"{DOMAIN}/pod-index"
+LABEL_POD_TEMPLATE_HASH = f"{DOMAIN}/pod-template-hash"
+LABEL_SCHEDULER_NAME = f"{DOMAIN}/scheduler-name"
+LABEL_COMPONENT = f"{DOMAIN}/component"
+
+# ---- node labels (TPU topology; GKE-compatible names kept alongside) ----
+NODE_LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+NODE_LABEL_SLICE = f"{DOMAIN}/tpu-slice"
+NODE_LABEL_SLICE_WORKER = f"{DOMAIN}/tpu-slice-worker"
+NODE_LABEL_POOL = f"{DOMAIN}/node-pool"
+NODE_LABEL_SUPERBLOCK = f"{DOMAIN}/superblock"
+NODE_LABEL_HOST = "kubernetes.io/hostname"
+
+# ---- env vars injected into workload pods ----
+ENV_PCS_NAME = "GROVE_PCS_NAME"
+ENV_PCS_INDEX = "GROVE_PCS_INDEX"
+ENV_PCLQ_NAME = "GROVE_PCLQ_NAME"
+ENV_PCLQ_POD_INDEX = "GROVE_PCLQ_POD_INDEX"
+ENV_PCSG_NAME = "GROVE_PCSG_NAME"
+ENV_PCSG_INDEX = "GROVE_PCSG_INDEX"
+ENV_PCSG_TEMPLATE_NUM_PODS = "GROVE_PCSG_TEMPLATE_NUM_PODS"
+ENV_HEADLESS_SERVICE = "GROVE_HEADLESS_SERVICE"
+# TPU/JAX bootstrap contract (multi-host process group on a slice)
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_SLICE_NAME = "GROVE_TPU_SLICE"
+ENV_TPU_SLICE_TOPOLOGY = "GROVE_TPU_SLICE_TOPOLOGY"
+ENV_MEGASLICE_INDEX = "GROVE_MULTISLICE_INDEX"  # DCN slice index (PCS replica)
+ENV_MEGASLICE_COUNT = "GROVE_MULTISLICE_COUNT"
+
+# ---- scheduling gates ----
+GATE_PODGANG_PENDING = f"{DOMAIN}/podgang-pending-creation"
+
+# ---- finalizers ----
+FINALIZER_PCS = f"{DOMAIN}/podcliqueset"
+FINALIZER_PCLQ = f"{DOMAIN}/podclique"
+FINALIZER_PCSG = f"{DOMAIN}/podcliquescalinggroup"
+
+# ---- condition types ----
+COND_SCHEDULED = "Scheduled"
+COND_READY = "Ready"
+COND_INITIALIZED = "Initialized"
+COND_UNHEALTHY = "Unhealthy"
+COND_DISRUPTION_TARGET = "DisruptionTarget"
+COND_MIN_AVAILABLE_BREACHED = "MinAvailableBreached"
+COND_PCLQ_SCHEDULED = "PodCliqueScheduled"
+
+# ---- defaults ----
+DEFAULT_TERMINATION_DELAY_SECONDS = 4 * 3600.0  # reference default 4h
+DEFAULT_SCHEDULER = "gang"

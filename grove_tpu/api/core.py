@@ -1,0 +1,116 @@
+"""Core data-plane types: Pod and Node.
+
+The reference delegates these to Kubernetes; this framework is its own
+control plane, so it defines them natively — shaped for TPU workloads:
+a Node is one TPU host (VM) belonging to an ICI slice, a Pod is one
+workload process (typically one JAX multi-host worker) with chip
+requests, scheduling gates, and a startup barrier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from grove_tpu.api.meta import Condition, ObjectMeta
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class ContainerSpec:
+    """The workload process. ``argv`` is executed by the node agent; fake
+    nodes (KWOK analog) skip execution and synthesise readiness."""
+
+    name: str = "main"
+    argv: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    workdir: str = ""
+
+
+@dataclasses.dataclass
+class StartupBarrier:
+    """In-pod startup ordering (the grove-initc analog, SURVEY.md §2.6 I1):
+    the node agent blocks the main process until every listed parent
+    PodClique has >= min_available Ready pods."""
+
+    parent_cliques: list[str] = dataclasses.field(default_factory=list)  # fqn
+    min_available: dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PodSpec:
+    container: ContainerSpec = dataclasses.field(default_factory=ContainerSpec)
+    tpu_chips: int = 0                  # chips requested on the host
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    scheduler_name: str = ""
+    scheduling_gates: list[str] = dataclasses.field(default_factory=list)
+    hostname: str = ""
+    subdomain: str = ""                 # headless-service DNS wiring
+    startup_barrier: Optional[StartupBarrier] = None
+    priority_class: str = ""
+    termination_grace_seconds: float = 5.0
+
+
+@dataclasses.dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+    node_name: str = ""
+    pod_ip: str = ""
+    start_time: float = 0.0
+    restart_count: int = 0
+    message: str = ""
+
+
+@dataclasses.dataclass
+class Pod:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodSpec = dataclasses.field(default_factory=PodSpec)
+    status: PodStatus = dataclasses.field(default_factory=PodStatus)
+
+    KIND = "Pod"
+
+
+@dataclasses.dataclass
+class NodeStatus:
+    ready: bool = True
+    allocatable_chips: int = 0
+    heartbeat_time: float = 0.0
+    message: str = ""
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    tpu_chips: int = 4                  # chips on this host (v5e host = 4)
+    fake: bool = True                   # KWOK-analog synthetic node
+    unschedulable: bool = False
+
+
+@dataclasses.dataclass
+class Node:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: NodeSpec = dataclasses.field(default_factory=NodeSpec)
+    status: NodeStatus = dataclasses.field(default_factory=NodeStatus)
+
+    KIND = "Node"
+
+
+@dataclasses.dataclass
+class Service:
+    """Headless service: DNS-style discovery record for a PCS replica's
+    pods (reference: podcliqueset/components/service/). In this control
+    plane it materialises as an endpoints map the agent env-injects."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    publish_not_ready: bool = True
+    endpoints: list[str] = dataclasses.field(default_factory=list)
+
+    KIND = "Service"

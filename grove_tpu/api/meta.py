@@ -1,0 +1,91 @@
+"""Object metadata, conditions, owner references.
+
+The framework's analog of k8s ObjectMeta as used by the reference's CRDs.
+Optimistic concurrency (resource_version), finalizers, and owner-based
+garbage collection are implemented by grove_tpu.store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Optional
+
+
+@dataclasses.dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+
+@dataclasses.dataclass
+class Condition:
+    """Status condition (type/status/reason/message), k8s-convention."""
+
+    type: str = ""
+    status: str = "Unknown"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    finalizers: list[str] = dataclasses.field(default_factory=list)
+    owner_references: list[OwnerReference] = dataclasses.field(default_factory=list)
+
+
+def new_meta(name: str, namespace: str = "default",
+             labels: dict[str, str] | None = None,
+             annotations: dict[str, str] | None = None) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace,
+                      uid=str(uuid.uuid4()),
+                      labels=dict(labels or {}),
+                      annotations=dict(annotations or {}),
+                      creation_timestamp=time.time())
+
+
+def set_condition(conditions: list[Condition], cond: Condition) -> list[Condition]:
+    """Upsert a condition by type, bumping last_transition_time on change."""
+    out = []
+    found = False
+    for c in conditions:
+        if c.type == cond.type:
+            found = True
+            if c.status != cond.status:
+                cond.last_transition_time = time.time()
+            else:
+                cond.last_transition_time = c.last_transition_time
+                cond = dataclasses.replace(
+                    cond, last_transition_time=c.last_transition_time)
+            out.append(cond)
+        else:
+            out.append(c)
+    if not found:
+        cond.last_transition_time = time.time()
+        out.append(cond)
+    return out
+
+
+def get_condition(conditions: list[Condition], ctype: str) -> Condition | None:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def is_condition_true(conditions: list[Condition], ctype: str) -> bool:
+    c = get_condition(conditions, ctype)
+    return c is not None and c.status == "True"

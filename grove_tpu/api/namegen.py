@@ -1,0 +1,58 @@
+"""Deterministic child-resource naming.
+
+Role parity with the reference's api/common/namegen.go:32-112: every child
+resource name is a pure function of its parents, so reconcilers can compute
+expected state without reads and informer events can be mapped back to
+owners by parsing names.
+
+Scheme:
+  PCLQ (standalone):      <pcs>-<pcsReplica>-<clique>
+  PCSG:                   <pcs>-<pcsReplica>-<pcsg>
+  PCLQ (in PCSG):         <pcs>-<pcsReplica>-<pcsg>-<pcsgReplica>-<clique>
+  Pod:                    <pclq>-<podIndex>
+  Base PodGang:           <pcs>-<pcsReplica>
+  Scaled PodGang:         <pcs>-<pcsReplica>-<pcsg>-<pcsgReplica>
+  Headless service:       <pcs>-<pcsReplica>-svc
+"""
+
+from __future__ import annotations
+
+
+def pclq_name(pcs: str, pcs_replica: int, clique: str) -> str:
+    return f"{pcs}-{pcs_replica}-{clique}"
+
+
+def pcsg_name(pcs: str, pcs_replica: int, group: str) -> str:
+    return f"{pcs}-{pcs_replica}-{group}"
+
+
+def pcsg_pclq_name(pcs: str, pcs_replica: int, group: str,
+                   pcsg_replica: int, clique: str) -> str:
+    return f"{pcs}-{pcs_replica}-{group}-{pcsg_replica}-{clique}"
+
+
+def pod_name(pclq: str, pod_index: int) -> str:
+    return f"{pclq}-{pod_index}"
+
+
+def pod_index_from_name(pod: str) -> int:
+    """Extract the stable pod index from a pod name (hostname-derived, the
+    index-reuse mechanism of the reference's internal/index/tracker.go:35)."""
+    return int(pod.rsplit("-", 1)[1])
+
+
+def base_podgang_name(pcs: str, pcs_replica: int) -> str:
+    return f"{pcs}-{pcs_replica}"
+
+
+def scaled_podgang_name(pcs: str, pcs_replica: int, group: str,
+                        pcsg_replica: int) -> str:
+    return f"{pcs}-{pcs_replica}-{group}-{pcsg_replica}"
+
+
+def headless_service_name(pcs: str, pcs_replica: int) -> str:
+    return f"{pcs}-{pcs_replica}-svc"
+
+
+def hpa_name(target_kind: str, target: str) -> str:
+    return f"{target_kind.lower()}-{target}-hpa"

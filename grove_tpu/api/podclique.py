@@ -1,0 +1,52 @@
+"""PodClique — a group of identical pods fulfilling one role.
+
+Parity with reference operator/api/core/v1alpha1/podclique.go:38-109.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from grove_tpu.api.meta import Condition, ObjectMeta
+from grove_tpu.api.podcliqueset import AutoScalingConfig, PodCliqueTemplate
+
+
+@dataclasses.dataclass
+class PodCliqueSpec:
+    role_name: str = ""
+    replicas: int = 1
+    min_available: int = 1
+    template: PodCliqueTemplate = dataclasses.field(
+        default_factory=PodCliqueTemplate)
+    starts_after: list[str] = dataclasses.field(default_factory=list)  # fqns
+    auto_scaling: Optional[AutoScalingConfig] = None
+    # Owning context (deterministic naming inputs)
+    pcs_name: str = ""
+    pcs_replica: int = 0
+    pcsg_name: str = ""                # "" when standalone
+    pcsg_replica: int = 0
+    pod_template_hash: str = ""
+    scheduler_name: str = ""
+    priority_class: str = ""
+    subdomain: str = ""
+
+
+@dataclasses.dataclass
+class PodCliqueStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    ready_replicas: int = 0
+    scheduled_replicas: int = 0
+    gated_replicas: int = 0
+    updated_replicas: int = 0
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PodClique:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodCliqueSpec = dataclasses.field(default_factory=PodCliqueSpec)
+    status: PodCliqueStatus = dataclasses.field(default_factory=PodCliqueStatus)
+
+    KIND = "PodClique"

@@ -1,0 +1,163 @@
+"""PodCliqueSet — the top-level user-facing resource.
+
+Capability parity with the reference's operator/api/core/v1alpha1/
+podcliqueset.go:41-227 (replicas, update strategy, clique templates,
+startup ordering type, headless service, topology constraint, termination
+delay, scaling-group configs) re-designed TPU-first:
+
+- ``TopologyConstraint`` speaks TPU levels (superblock / slice / host)
+  instead of rack/NVLink; ``pack_level: "slice"`` means slice-atomic
+  placement (all gang pods on one ICI mesh).
+- A ``ScalingGroupConfig`` replica is one multi-host JAX process group;
+  its pods get TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injected.
+- PCS replicas are multislice data-parallel copies spread over DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import Condition, ObjectMeta
+
+
+class StartupType(str, enum.Enum):
+    ANY_ORDER = "AnyOrder"
+    IN_ORDER = "CliqueStartupTypeInOrder"        # DAG from clique order
+    EXPLICIT = "CliqueStartupTypeExplicit"       # StartsAfter edges
+
+
+class UpdateStrategyType(str, enum.Enum):
+    ROLLING_RECREATE = "RollingRecreate"
+    ON_DELETE = "OnDelete"
+
+
+@dataclasses.dataclass
+class UpdateStrategy:
+    type: UpdateStrategyType = UpdateStrategyType.ROLLING_RECREATE
+
+
+@dataclasses.dataclass
+class TopologyConstraint:
+    """Placement constraint against ClusterTopology levels.
+
+    pack_level: all pods of the scope land within one domain at this level
+    (e.g. "slice" → one ICI mesh). required=False means best-effort
+    (preferred) packing. spread_level: sibling replicas spread across
+    domains at this level (e.g. PCS replicas across slices/pools for DCN
+    multislice).
+    """
+
+    pack_level: str = ""
+    required: bool = True
+    spread_level: str = ""
+
+
+@dataclasses.dataclass
+class AutoScalingConfig:
+    """HPA-analog config (reference podclique.go:89-109): the autoscaler
+    controller scales replicas between bounds on a target metric."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    metric: str = "queue_depth"
+    target_value: float = 0.0
+
+
+@dataclasses.dataclass
+class HeadlessServiceConfig:
+    publish_not_ready_addresses: bool = True
+
+
+@dataclasses.dataclass
+class PodCliqueTemplate:
+    """One role (leader / worker / prefill / decode...) within the set.
+
+    ``tpu_workers`` pods are created per replica of the owning scope; each
+    pod asks for ``chips_per_worker`` chips, so one clique replica maps to
+    a (tpu_workers × chips_per_worker)-chip process group.
+    """
+
+    name: str = ""
+    replicas: int = 1                 # pods per clique instance
+    min_available: Optional[int] = None
+    container: ContainerSpec = dataclasses.field(default_factory=ContainerSpec)
+    tpu_chips_per_pod: int = 0
+    starts_after: list[str] = dataclasses.field(default_factory=list)
+    auto_scaling: Optional[AutoScalingConfig] = None
+    topology: Optional[TopologyConstraint] = None
+    priority_class: str = ""
+
+
+@dataclasses.dataclass
+class ScalingGroupConfig:
+    """Cliques that scale together as one unit — one replica of the group
+    is one complete multi-node model instance (reference
+    scalinggroup.go:37-77)."""
+
+    name: str = ""
+    clique_names: list[str] = dataclasses.field(default_factory=list)
+    replicas: int = 1
+    min_available: Optional[int] = None
+    auto_scaling: Optional[AutoScalingConfig] = None
+    topology: Optional[TopologyConstraint] = None
+
+
+@dataclasses.dataclass
+class PodCliqueSetTemplate:
+    cliques: list[PodCliqueTemplate] = dataclasses.field(default_factory=list)
+    scaling_groups: list[ScalingGroupConfig] = dataclasses.field(default_factory=list)
+    startup_type: StartupType = StartupType.ANY_ORDER
+    priority_class: str = ""
+    scheduler_name: str = ""
+    termination_delay_seconds: Optional[float] = None
+    headless_service: Optional[HeadlessServiceConfig] = None
+    topology: Optional[TopologyConstraint] = None
+
+
+@dataclasses.dataclass
+class PodCliqueSetSpec:
+    replicas: int = 1
+    template: PodCliqueSetTemplate = dataclasses.field(
+        default_factory=PodCliqueSetTemplate)
+    update_strategy: UpdateStrategy = dataclasses.field(
+        default_factory=UpdateStrategy)
+
+
+@dataclasses.dataclass
+class UpdateProgress:
+    updated_replicas: list[int] = dataclasses.field(default_factory=list)
+    current_replica: Optional[int] = None
+    target_hash: str = ""
+
+
+@dataclasses.dataclass
+class LastError:
+    code: str = ""
+    operation: str = ""
+    message: str = ""
+    observed_at: float = 0.0
+
+
+@dataclasses.dataclass
+class PodCliqueSetStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    available_replicas: int = 0
+    updated_replicas: int = 0
+    generation_hash: str = ""
+    rolling_update: Optional[UpdateProgress] = None
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+    last_errors: list[LastError] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PodCliqueSet:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodCliqueSetSpec = dataclasses.field(default_factory=PodCliqueSetSpec)
+    status: PodCliqueSetStatus = dataclasses.field(
+        default_factory=PodCliqueSetStatus)
+
+    KIND = "PodCliqueSet"

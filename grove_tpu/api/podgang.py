@@ -1,0 +1,68 @@
+"""PodGang — the gang-scheduling contract between the operator and
+pluggable schedulers.
+
+Parity with the reference's scheduler/api/core/v1alpha1/podgang.go:30-190:
+a list of PodGroups with min-replica guarantees, gang- and group-level
+topology constraints, a placement-reuse hint for updates, and a status
+carrying phase + Scheduled/Ready/Initialized/Unhealthy conditions.
+
+TPU-first difference: ``TopologyConstraint.pack_level == "slice"`` is an
+*atomicity* constraint (the gang must land inside exactly one ICI slice),
+stronger than the reference's NVLink-domain pack preference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from grove_tpu.api.meta import Condition, ObjectMeta
+from grove_tpu.api.podcliqueset import TopologyConstraint
+
+
+class PodGangPhase(str, enum.Enum):
+    PENDING = "Pending"
+    STARTING = "Starting"
+    RUNNING = "Running"
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """A set of same-shaped pods inside the gang."""
+
+    name: str = ""
+    pod_names: list[str] = dataclasses.field(default_factory=list)
+    min_replicas: int = 1
+    topology: TopologyConstraint | None = None
+
+
+@dataclasses.dataclass
+class PodGangSpec:
+    groups: list[PodGroup] = dataclasses.field(default_factory=list)
+    topology: TopologyConstraint | None = None
+    priority_class: str = ""
+    scheduler_name: str = ""
+    # Placement-reuse hint: on rolling update the replacement gang prefers
+    # the slice/hosts of the gang it replaces (reference podgang.go:65-71).
+    reuse_reservation_of: str = ""
+    # Base gang this scaled gang depends on ("" for base gangs): scaled
+    # gangs are only schedulable after their base gang is placed.
+    base_gang: str = ""
+
+
+@dataclasses.dataclass
+class PodGangStatus:
+    phase: PodGangPhase = PodGangPhase.PENDING
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+    placement_score: float = 0.0
+    # chosen placement: slice name per group pod, filled by the scheduler
+    assigned_slice: str = ""
+
+
+@dataclasses.dataclass
+class PodGang:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodGangSpec = dataclasses.field(default_factory=PodGangSpec)
+    status: PodGangStatus = dataclasses.field(default_factory=PodGangStatus)
+
+    KIND = "PodGang"

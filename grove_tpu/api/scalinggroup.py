@@ -1,0 +1,46 @@
+"""PodCliqueScalingGroup — cliques that scale together as one unit.
+
+Parity with reference operator/api/core/v1alpha1/scalinggroup.go:37-77;
+one PCSG replica == one multi-host JAX process group on one TPU slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from grove_tpu.api.meta import Condition, ObjectMeta
+from grove_tpu.api.podcliqueset import AutoScalingConfig, TopologyConstraint
+
+
+@dataclasses.dataclass
+class PodCliqueScalingGroupSpec:
+    clique_names: list[str] = dataclasses.field(default_factory=list)
+    replicas: int = 1
+    min_available: int = 1
+    auto_scaling: Optional[AutoScalingConfig] = None
+    topology: Optional[TopologyConstraint] = None
+    pcs_name: str = ""
+    pcs_replica: int = 0
+    pod_template_hash: str = ""
+
+
+@dataclasses.dataclass
+class PodCliqueScalingGroupStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    ready_replicas: int = 0
+    scheduled_replicas: int = 0
+    updated_replicas: int = 0
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PodCliqueScalingGroup:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: PodCliqueScalingGroupSpec = dataclasses.field(
+        default_factory=PodCliqueScalingGroupSpec)
+    status: PodCliqueScalingGroupStatus = dataclasses.field(
+        default_factory=PodCliqueScalingGroupStatus)
+
+    KIND = "PodCliqueScalingGroup"

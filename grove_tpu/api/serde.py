@@ -1,0 +1,83 @@
+"""Dataclass <-> plain-dict serialisation for API objects.
+
+Equivalent in role to the reference's generated deepcopy/clientset codecs
+(operator/api/core/v1alpha1/zz_generated.deepcopy.go and scheduler/client):
+every API type round-trips through JSON/YAML-safe dicts so resources can be
+stored, diffed, hashed, and written to disk as manifests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import types
+import typing
+from typing import Any, TypeVar, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert dataclasses/enums/containers to plain data.
+
+    None-valued and default-empty fields are kept (cheap, explicit, and
+    hashing cares about values anyway).
+    """
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: to_dict(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def _strip_optional(tp: Any) -> Any:
+    origin = get_origin(tp)
+    if origin is typing.Union or origin is types.UnionType:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: type[T], data: Any) -> T:
+    """Reconstruct ``cls`` from plain data produced by :func:`to_dict`."""
+    return _from(cls, data)
+
+
+def _from(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    tp = _strip_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        seq = [_from(elem, v) for v in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: _from(vt, v) for k, v in data.items()}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        if tp not in _HINTS_CACHE:
+            _HINTS_CACHE[tp] = get_type_hints(tp)
+        hints = _HINTS_CACHE[tp]
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            if f.name in data:
+                kwargs[f.name] = _from(hints[f.name], data[f.name])
+        return tp(**kwargs)
+    return data
+
+
+def clone(obj: T) -> T:
+    """Deep copy an API object via its dict form (the deepcopy analog)."""
+    return from_dict(type(obj), to_dict(obj))

@@ -1,0 +1,144 @@
+"""grovectl — run a control plane, apply manifests, watch status.
+
+Usage examples (see samples/):
+
+  # bring up an in-process cluster with a fake v5e fleet, deploy a
+  # PodCliqueSet, wait for it to become available, print the timeline:
+  python -m grove_tpu.cli run --fleet v5e:4x4:2 --apply samples/simple1.yaml
+
+  # inspect resources after the run (printed automatically):
+  python -m grove_tpu.cli run --fleet v5e:4x4:2 --apply f.yaml --show pods
+
+The reference reserves a kubectl-plugin module for this role
+(cli-plugin/, empty stub); here the CLI is functional and doubles as the
+demo/e2e driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+)
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.cluster import new_cluster
+from grove_tpu.manifest import load_manifest
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+
+def parse_fleet(spec: str) -> FleetSpec:
+    """'v5e:4x4:2[,v5p:2x2x2:1]' -> FleetSpec."""
+    slices = []
+    for part in spec.split(","):
+        gen, topo, count = part.split(":")
+        slices.append(SliceSpec(generation=gen, topology=topo,
+                                count=int(count)))
+    return FleetSpec(slices=slices)
+
+
+def print_pods(client, namespace="default") -> None:
+    rows = [("POD", "PHASE", "READY", "NODE", "GATES")]
+    for p in client.list(Pod, namespace):
+        ready = "1/1" if is_condition_true(p.status.conditions,
+                                           c.COND_READY) else "0/1"
+        rows.append((p.meta.name, p.status.phase.value, ready,
+                     p.status.node_name or "<none>",
+                     ",".join(p.spec.scheduling_gates) or "-"))
+    _table(rows)
+
+
+def print_gangs(client, namespace="default") -> None:
+    rows = [("PODGANG", "PHASE", "SCHEDULED", "SLICE", "SCORE")]
+    for g in client.list(PodGang, namespace):
+        rows.append((g.meta.name, g.status.phase.value,
+                     str(is_condition_true(g.status.conditions,
+                                           c.COND_SCHEDULED)),
+                     g.status.assigned_slice or "-",
+                     f"{g.status.placement_score:.2f}"))
+    _table(rows)
+
+
+def print_sets(client, namespace="default") -> None:
+    rows = [("PODCLIQUESET", "REPLICAS", "AVAILABLE", "HASH")]
+    for s in client.list(PodCliqueSet, namespace):
+        rows.append((s.meta.name, str(s.spec.replicas),
+                     str(s.status.available_replicas),
+                     s.status.generation_hash))
+    _table(rows)
+
+
+def _table(rows) -> None:
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    cluster = new_cluster(fleet=parse_fleet(args.fleet))
+    with cluster:
+        client = cluster.client
+        t0 = time.time()
+        objs = []
+        if args.apply:
+            with open(args.apply) as f:
+                objs = load_manifest(f)
+            for obj in objs:
+                client.create(obj)
+                print(f"created {obj.KIND}/{obj.meta.name}")
+        sets = [o for o in objs if isinstance(o, PodCliqueSet)]
+        deadline = time.time() + args.timeout
+        for pcs in sets:
+            while time.time() < deadline:
+                live = client.get(PodCliqueSet, pcs.meta.name,
+                                  pcs.meta.namespace)
+                if live.status.available_replicas >= live.spec.replicas:
+                    print(f"PodCliqueSet/{pcs.meta.name} available "
+                          f"({live.status.available_replicas}/"
+                          f"{live.spec.replicas}) after "
+                          f"{time.time() - t0:.2f}s")
+                    break
+                time.sleep(0.05)
+            else:
+                print(f"TIMEOUT waiting for PodCliqueSet/{pcs.meta.name}",
+                      file=sys.stderr)
+                print_pods(client)
+                print_gangs(client)
+                return 1
+        print()
+        print_sets(client)
+        print()
+        print_gangs(client)
+        print()
+        print_pods(client)
+        if args.hold:
+            print(f"\nholding cluster for {args.hold}s (ctrl-c to stop)...")
+            time.sleep(args.hold)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="grovectl")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    run = sub.add_parser("run", help="run a cluster, apply manifests, report")
+    run.add_argument("--fleet", default="v5e:4x4:2",
+                     help="fleet spec gen:topology:count[,...]")
+    run.add_argument("--apply", help="YAML manifest to apply")
+    run.add_argument("--timeout", type=float, default=30.0)
+    run.add_argument("--hold", type=float, default=0.0,
+                     help="keep the cluster up after reporting")
+    run.set_defaults(fn=cmd_run)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

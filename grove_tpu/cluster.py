@@ -1,0 +1,56 @@
+"""Cluster assembly: one call brings up a full in-process control plane.
+
+The `cmd/main.go` analog (R1): config → manager → scheduler registry →
+controllers → agents. Used by the CLI, the e2e tests, and the scale
+harness; a real deployment runs exactly this plus process-running node
+agents instead of (or alongside) the fake kubelet pool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from grove_tpu.agent.node import FakeKubeletPool
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.controllers.register import register_controllers
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.scheduler.framework import Registry
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+from grove_tpu.topology.fleet import FleetSpec, create_fleet
+
+
+@dataclasses.dataclass
+class Cluster:
+    manager: Manager
+    scheduler_registry: Registry
+
+    @property
+    def client(self) -> Client:
+        return self.manager.client
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def __enter__(self) -> "Cluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def new_cluster(config: OperatorConfiguration | None = None,
+                fleet: FleetSpec | None = None,
+                store: Store | None = None,
+                fake_kubelet: bool = True) -> Cluster:
+    mgr = Manager(config=config, store=store)
+    registry = register_controllers(mgr)
+    if fake_kubelet:
+        mgr.add_runnable(FakeKubeletPool(mgr.client))
+    if fleet is not None:
+        create_fleet(mgr.client, fleet)
+    return Cluster(manager=mgr, scheduler_registry=registry)

@@ -1,0 +1,3 @@
+from grove_tpu.controllers.register import register_controllers
+
+__all__ = ["register_controllers"]

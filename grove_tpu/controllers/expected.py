@@ -1,0 +1,259 @@
+"""Expected-state computation for a PodCliqueSet.
+
+Pure functions mapping a PCS spec to the full set of child resources
+(PCLQs, PCSGs, Services, PodGangs) — the declarative core the reconcilers
+diff against live state. Role parity with the reference's per-component
+buildResource functions plus computeExpectedPodGangs
+(podcliqueset/components/podgang/syncflow.go:147-212), with one TPU-first
+simplification: because child naming is fully deterministic (namegen),
+expected PodGang pod references are computed directly from the spec
+instead of being re-read from live pods.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import constants as c
+from grove_tpu.api import namegen
+from grove_tpu.api.core import Service
+from grove_tpu.api.meta import ObjectMeta, OwnerReference, new_meta
+from grove_tpu.api.podclique import PodClique, PodCliqueSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSet,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+)
+from grove_tpu.api.podgang import PodGang, PodGangSpec, PodGroup
+from grove_tpu.api.scalinggroup import (
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupSpec,
+)
+from grove_tpu.runtime.hashutil import compute_hash
+
+
+def owner_ref(obj) -> OwnerReference:
+    return OwnerReference(kind=obj.KIND, name=obj.meta.name, uid=obj.meta.uid)
+
+
+def generation_hash(pcs: PodCliqueSet) -> str:
+    """Hash of the pod-shaping template (rolling-update trigger; reference
+    reconcilespec.go:110-123)."""
+    return compute_hash(pcs.spec.template)
+
+
+def standalone_cliques(pcs: PodCliqueSet) -> list[PodCliqueTemplate]:
+    grouped = {name for sg in pcs.spec.template.scaling_groups
+               for name in sg.clique_names}
+    return [t for t in pcs.spec.template.cliques if t.name not in grouped]
+
+
+def grouped_cliques(pcs: PodCliqueSet,
+                    sg: ScalingGroupConfig) -> list[PodCliqueTemplate]:
+    by_name = {t.name: t for t in pcs.spec.template.cliques}
+    return [by_name[n] for n in sg.clique_names]
+
+
+def min_available(t: PodCliqueTemplate) -> int:
+    return t.min_available if t.min_available is not None else t.replicas
+
+
+def sg_min_available(sg: ScalingGroupConfig) -> int:
+    return sg.min_available if sg.min_available is not None else sg.replicas
+
+
+def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
+                       parents: list[str]) -> list[str]:
+    """Map parent clique names to PCLQ FQNs within the same PCS replica.
+
+    A parent inside a scaling group resolves to its replica-0..minAvailable
+    instances (the gang-guaranteed ones)."""
+    sg_of = {name: sg for sg in pcs.spec.template.scaling_groups
+             for name in sg.clique_names}
+    fqns: list[str] = []
+    for parent in parents:
+        sg = sg_of.get(parent)
+        if sg is None:
+            fqns.append(namegen.pclq_name(pcs.meta.name, replica, parent))
+        else:
+            for j in range(sg_min_available(sg)):
+                fqns.append(namegen.pcsg_pclq_name(
+                    pcs.meta.name, replica, sg.name, j, parent))
+    return fqns
+
+
+def _clique_to_spec(pcs: PodCliqueSet, replica: int, t: PodCliqueTemplate,
+                    name: str, pcsg: str = "", pcsg_replica: int = 0,
+                    template_hash: str = "") -> PodCliqueSpec:
+    return PodCliqueSpec(
+        role_name=t.name,
+        replicas=t.replicas,
+        min_available=min_available(t),
+        template=t,
+        starts_after=_starts_after_fqns(pcs, replica, t.starts_after),
+        auto_scaling=t.auto_scaling,
+        pcs_name=pcs.meta.name,
+        pcs_replica=replica,
+        pcsg_name=pcsg,
+        pcsg_replica=pcsg_replica,
+        pod_template_hash=template_hash,
+        scheduler_name=pcs.spec.template.scheduler_name,
+        priority_class=t.priority_class or pcs.spec.template.priority_class,
+        subdomain=namegen.headless_service_name(pcs.meta.name, replica),
+    )
+
+
+def _labels(pcs: PodCliqueSet, replica: int, extra: dict[str, str]
+            ) -> dict[str, str]:
+    labels = {
+        c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+        c.LABEL_PCS_NAME: pcs.meta.name,
+        c.LABEL_PCS_REPLICA: str(replica),
+    }
+    labels.update(extra)
+    return labels
+
+
+# Component ownership labels: the PCS controller prunes only children it
+# created itself; PCSG-member PCLQs belong to the PCSG controller (without
+# this partition the two reconcilers would fight over membership).
+COMPONENT_STANDALONE_PCLQ = "pclq"
+COMPONENT_PCSG_PCLQ = "pcsg-pclq"
+
+
+def expected_services(pcs: PodCliqueSet) -> list[Service]:
+    if pcs.spec.template.headless_service is None:
+        return []
+    out = []
+    for r in range(pcs.spec.replicas):
+        name = namegen.headless_service_name(pcs.meta.name, r)
+        out.append(Service(
+            meta=_meta(pcs, name, _labels(pcs, r, {})),
+            selector={c.LABEL_PCS_NAME: pcs.meta.name,
+                      c.LABEL_PCS_REPLICA: str(r)},
+            publish_not_ready=pcs.spec.template.headless_service
+            .publish_not_ready_addresses,
+        ))
+    return out
+
+
+def _meta(pcs: PodCliqueSet, name: str, labels: dict[str, str]) -> ObjectMeta:
+    meta = new_meta(name, namespace=pcs.meta.namespace, labels=labels)
+    meta.owner_references = [owner_ref(pcs)]
+    return meta
+
+
+def expected_standalone_pclqs(pcs: PodCliqueSet,
+                              template_hash: str) -> list[PodClique]:
+    out = []
+    for r in range(pcs.spec.replicas):
+        for t in standalone_cliques(pcs):
+            name = namegen.pclq_name(pcs.meta.name, r, t.name)
+            out.append(PodClique(
+                meta=_meta(pcs, name, _labels(pcs, r, {
+                    c.LABEL_PCLQ_ROLE: t.name,
+                    c.LABEL_COMPONENT: COMPONENT_STANDALONE_PCLQ})),
+                spec=_clique_to_spec(pcs, r, t, name,
+                                     template_hash=template_hash),
+            ))
+    return out
+
+
+def expected_pcsgs(pcs: PodCliqueSet,
+                   template_hash: str) -> list[PodCliqueScalingGroup]:
+    out = []
+    for r in range(pcs.spec.replicas):
+        for sg in pcs.spec.template.scaling_groups:
+            name = namegen.pcsg_name(pcs.meta.name, r, sg.name)
+            out.append(PodCliqueScalingGroup(
+                meta=_meta(pcs, name, _labels(pcs, r, {
+                    c.LABEL_PCSG_NAME: name})),
+                spec=PodCliqueScalingGroupSpec(
+                    clique_names=list(sg.clique_names),
+                    replicas=sg.replicas,
+                    min_available=sg_min_available(sg),
+                    auto_scaling=sg.auto_scaling,
+                    topology=sg.topology,
+                    pcs_name=pcs.meta.name,
+                    pcs_replica=r,
+                    pod_template_hash=template_hash,
+                ),
+            ))
+    return out
+
+
+def _pod_group(pclq_fqn: str, replicas: int, min_avail: int) -> PodGroup:
+    return PodGroup(
+        name=pclq_fqn,
+        pod_names=[namegen.pod_name(pclq_fqn, i) for i in range(replicas)],
+        min_replicas=min_avail,
+    )
+
+
+def expected_podgangs(pcs: PodCliqueSet) -> list[PodGang]:
+    """Base gang per PCS replica + scaled gang per PCSG replica beyond
+    min_available (reference syncflow.go:147-212)."""
+    out = []
+    tmpl = pcs.spec.template
+    for r in range(pcs.spec.replicas):
+        base_name = namegen.base_podgang_name(pcs.meta.name, r)
+        groups: list[PodGroup] = []
+        for t in standalone_cliques(pcs):
+            fqn = namegen.pclq_name(pcs.meta.name, r, t.name)
+            groups.append(_pod_group(fqn, t.replicas, min_available(t)))
+        for sg in tmpl.scaling_groups:
+            for j in range(sg_min_available(sg)):
+                for t in grouped_cliques(pcs, sg):
+                    fqn = namegen.pcsg_pclq_name(
+                        pcs.meta.name, r, sg.name, j, t.name)
+                    groups.append(_pod_group(fqn, t.replicas, min_available(t)))
+        out.append(PodGang(
+            meta=_meta(pcs, base_name, _labels(pcs, r, {})),
+            spec=PodGangSpec(
+                groups=groups,
+                topology=tmpl.topology,
+                priority_class=tmpl.priority_class,
+                scheduler_name=tmpl.scheduler_name,
+            ),
+        ))
+        # Scaled gangs: one per PCSG replica >= minAvailable.
+        for sg in tmpl.scaling_groups:
+            for j in range(sg_min_available(sg), sg.replicas):
+                name = namegen.scaled_podgang_name(pcs.meta.name, r,
+                                                   sg.name, j)
+                groups = [
+                    _pod_group(
+                        namegen.pcsg_pclq_name(pcs.meta.name, r, sg.name, j,
+                                               t.name),
+                        t.replicas, min_available(t))
+                    for t in grouped_cliques(pcs, sg)
+                ]
+                out.append(PodGang(
+                    meta=_meta(pcs, name, _labels(pcs, r, {
+                        c.LABEL_PCSG_NAME: namegen.pcsg_name(
+                            pcs.meta.name, r, sg.name)})),
+                    spec=PodGangSpec(
+                        groups=groups,
+                        topology=sg.topology or tmpl.topology,
+                        priority_class=tmpl.priority_class,
+                        scheduler_name=tmpl.scheduler_name,
+                        base_gang=base_name,
+                    ),
+                ))
+    return out
+
+
+def podgang_name_for_pclq(spec: PodCliqueSpec,
+                          pcsg_min_available: int | None = None) -> str:
+    """Which gang a PCLQ's pods belong to (deterministic).
+
+    Standalone cliques and PCSG replicas below min_available ride the
+    base gang; PCSG replicas at/after min_available get scaled gangs
+    (reference syncflow.go:161-212).
+    """
+    if not spec.pcsg_name:
+        return namegen.base_podgang_name(spec.pcs_name, spec.pcs_replica)
+    assert pcsg_min_available is not None, "PCSG-owned PCLQ needs min_available"
+    if spec.pcsg_replica < pcsg_min_available:
+        return namegen.base_podgang_name(spec.pcs_name, spec.pcs_replica)
+    sg_short = spec.pcsg_name[len(f"{spec.pcs_name}-{spec.pcs_replica}-"):]
+    return namegen.scaled_podgang_name(spec.pcs_name, spec.pcs_replica,
+                                       sg_short, spec.pcsg_replica)

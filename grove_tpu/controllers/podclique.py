@@ -1,0 +1,295 @@
+"""PodClique controller — reconciles a PCLQ to its Pods (C2).
+
+Parity with reference internal/controller/podclique + components/pod:
+expectation-gated diff sync, stable index assignment (hole reuse),
+scheduling gates removed only once the pod's PodGang exists (and, for
+scaled gangs, the base gang is Scheduled — syncflow.go:254-427), env-var
+injection, deletion-sorted scale-in, and status with
+MinAvailableBreached / PodCliqueScheduled conditions.
+
+TPU-first: env injection includes the JAX multi-host bootstrap contract —
+TPU_WORKER_ID is the stable pod index (survives pod replacement via index
+reuse), TPU_WORKER_HOSTNAMES is the deterministic list of clique pod
+hostnames.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import (
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodGang,
+    constants as c,
+    namegen,
+)
+from grove_tpu.api.core import PodPhase, PodSpec, StartupBarrier
+from grove_tpu.api.meta import (
+    Condition,
+    OwnerReference,
+    is_condition_true,
+    new_meta,
+    set_condition,
+)
+from grove_tpu.api.serde import clone
+from grove_tpu.controllers.expected import podgang_name_for_pclq
+from grove_tpu.runtime.concurrent import run_with_slow_start
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.expectations import ExpectationsStore
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.indextracker import available_indices
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.scheduler.framework import Registry
+from grove_tpu.store.client import Client
+
+
+class PodCliqueReconciler:
+    def __init__(self, client: Client, scheduler_registry: Registry):
+        self.client = client
+        self.schedulers = scheduler_registry
+        self.expectations = ExpectationsStore()
+        self.log = get_logger("podclique")
+
+    def reconcile(self, req: Request) -> StepResult:
+        try:
+            pclq = self.client.get(PodClique, req.name, req.namespace)
+        except NotFoundError:
+            self.expectations.forget(req.key)
+            return StepResult.finished()
+        if pclq.meta.deletion_timestamp is not None:
+            return StepResult.finished()  # cascade removes pods
+
+        pods = self.client.list(Pod, req.namespace,
+                                selector={c.LABEL_PCLQ_NAME: pclq.meta.name})
+        pods = [p for p in pods if p.meta.deletion_timestamp is None]
+
+        if not self.expectations.satisfied(req.key):
+            # Writes from the previous sync are not all visible yet; only
+            # status may be refreshed (reference syncflow.go:170).
+            self._update_status(pclq, pods)
+            return StepResult.requeue(0.05)
+
+        gang_name = self._gang_name(pclq)
+        result = self._sync_pods(pclq, pods, gang_name, req)
+        if result is not None:
+            return result
+        self._remove_gates_if_unblocked(pclq, pods, gang_name)
+        self._update_status(pclq, pods)
+        return StepResult.finished()
+
+    # ---- pod diff sync ----
+
+    def _sync_pods(self, pclq: PodClique, pods: list[Pod], gang_name: str,
+                   req: Request) -> StepResult | None:
+        want = pclq.spec.replicas
+        if len(pods) < want:
+            used = []
+            for p in pods:
+                try:
+                    used.append(namegen.pod_index_from_name(p.meta.name))
+                except ValueError:
+                    pass
+            indices = available_indices(used, want - len(pods))
+            new_pods = [self._build_pod(pclq, i, gang_name) for i in indices]
+            self.expectations.expect_creates(
+                req.key, [p.meta.uid for p in new_pods])
+            created, errors = run_with_slow_start(
+                [lambda p=p: self._create_observed(req.key, p)
+                 for p in new_pods])
+            if errors:
+                # Unrealised expectations for failed creates must be
+                # forgotten or the next syncs would stall until TTL.
+                self.expectations.forget(req.key)
+                return StepResult.fail(errors[0])
+        elif len(pods) > want:
+            doomed = sorted(pods, key=_deletion_order)[:len(pods) - want]
+            self.expectations.expect_deletes(
+                req.key, [p.meta.uid for p in doomed])
+            for p in doomed:
+                try:
+                    self.client.delete(Pod, p.meta.name, p.meta.namespace)
+                    self.expectations.observe_delete(req.key, p.meta.uid)
+                except NotFoundError:
+                    self.expectations.observe_delete(req.key, p.meta.uid)
+                except GroveError as e:
+                    self.expectations.forget(req.key)
+                    return StepResult.fail(e)
+        return None
+
+    def _create_observed(self, key: str, pod: Pod) -> None:
+        try:
+            self.client.create(pod)
+        except GroveError:
+            self.expectations.observe_create(key, pod.meta.uid)
+            raise
+        self.expectations.observe_create(key, pod.meta.uid)
+
+    def _gang_name(self, pclq: PodClique) -> str:
+        if not pclq.spec.pcsg_name:
+            return podgang_name_for_pclq(pclq.spec)
+        try:
+            pcsg = self.client.get(PodCliqueScalingGroup, pclq.spec.pcsg_name,
+                                   pclq.meta.namespace)
+            return podgang_name_for_pclq(pclq.spec, pcsg.spec.min_available)
+        except NotFoundError:
+            # PCSG not visible yet; assume base gang (re-synced on event).
+            return namegen.base_podgang_name(pclq.spec.pcs_name,
+                                             pclq.spec.pcs_replica)
+
+    # ---- pod construction (reference components/pod/pod.go:138-201) ----
+
+    def _build_pod(self, pclq: PodClique, index: int, gang_name: str) -> Pod:
+        spec = pclq.spec
+        name = namegen.pod_name(pclq.meta.name, index)
+        container = clone(spec.template.container)
+        pod = Pod(
+            meta=new_meta(name, namespace=pclq.meta.namespace, labels={
+                c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                c.LABEL_PCS_NAME: spec.pcs_name,
+                c.LABEL_PCS_REPLICA: str(spec.pcs_replica),
+                c.LABEL_PCLQ_NAME: pclq.meta.name,
+                c.LABEL_PCLQ_ROLE: spec.role_name,
+                c.LABEL_POD_INDEX: str(index),
+                c.LABEL_POD_TEMPLATE_HASH: spec.pod_template_hash,
+                **({c.LABEL_PCSG_NAME: spec.pcsg_name,
+                    c.LABEL_PCSG_REPLICA: str(spec.pcsg_replica)}
+                   if spec.pcsg_name else {}),
+            }),
+            spec=PodSpec(
+                container=container,
+                tpu_chips=spec.template.tpu_chips_per_pod,
+                scheduling_gates=[c.GATE_PODGANG_PENDING],
+                hostname=name,
+                subdomain=spec.subdomain,
+                priority_class=spec.priority_class,
+            ),
+        )
+        pod.meta.owner_references = [OwnerReference(
+            kind=PodClique.KIND, name=pclq.meta.name, uid=pclq.meta.uid)]
+        self._add_env(pod, pclq, index)
+        if spec.starts_after:
+            pod.spec.startup_barrier = StartupBarrier(
+                parent_cliques=list(spec.starts_after),
+                min_available=self._parent_min_available(pclq),
+            )
+        backend = self.schedulers.get(spec.scheduler_name or None)
+        backend.prepare_pod(pod, gang_name)
+        return pod
+
+    def _parent_min_available(self, pclq: PodClique) -> dict[str, int]:
+        """Pin thresholds for parents that already exist; parents not yet
+        visible are resolved live by the barrier (agent/barrier.py)."""
+        out = {}
+        for fqn in pclq.spec.starts_after:
+            try:
+                parent = self.client.get(PodClique, fqn, pclq.meta.namespace)
+                out[fqn] = parent.spec.min_available
+            except NotFoundError:
+                pass
+        return out
+
+    def _add_env(self, pod: Pod, pclq: PodClique, index: int) -> None:
+        """Reference components/pod/pod.go:330-375 env contract + the TPU
+        bootstrap set (the MNNVL/ComputeDomain analog is: nothing — ICI
+        comes free with slice membership; SURVEY.md §2.8)."""
+        spec = pclq.spec
+        env = pod.spec.container.env
+        env[c.ENV_PCS_NAME] = spec.pcs_name
+        env[c.ENV_PCS_INDEX] = str(spec.pcs_replica)
+        env[c.ENV_PCLQ_NAME] = pclq.meta.name
+        env[c.ENV_PCLQ_POD_INDEX] = str(index)
+        env[c.ENV_HEADLESS_SERVICE] = spec.subdomain
+        if spec.pcsg_name:
+            env[c.ENV_PCSG_NAME] = spec.pcsg_name
+            env[c.ENV_PCSG_INDEX] = str(spec.pcsg_replica)
+            env[c.ENV_PCSG_TEMPLATE_NUM_PODS] = str(
+                spec.template.replicas)
+        # TPU multi-host process-group contract
+        hostnames = ",".join(
+            namegen.pod_name(pclq.meta.name, i)
+            for i in range(spec.replicas))
+        env[c.ENV_TPU_WORKER_ID] = str(index)
+        env[c.ENV_TPU_WORKER_HOSTNAMES] = hostnames
+        env[c.ENV_MEGASLICE_INDEX] = str(spec.pcs_replica)
+
+    # ---- gate removal (reference syncflow.go:254-427) ----
+
+    def _remove_gates_if_unblocked(self, pclq: PodClique, pods: list[Pod],
+                                   gang_name: str) -> None:
+        gated = [p for p in pods if c.GATE_PODGANG_PENDING in
+                 p.spec.scheduling_gates]
+        if not gated:
+            return
+        try:
+            gang = self.client.get(PodGang, gang_name, pclq.meta.namespace)
+        except NotFoundError:
+            return  # gang not created yet: stay gated
+        if not is_condition_true(gang.status.conditions, c.COND_INITIALIZED):
+            return  # not all gang pods exist yet
+        if gang.spec.base_gang:
+            # scaled gang: wait for the base gang to be placed first so
+            # scaled capacity can never starve the base gang
+            try:
+                base = self.client.get(PodGang, gang.spec.base_gang,
+                                       pclq.meta.namespace)
+            except NotFoundError:
+                return
+            if not is_condition_true(base.status.conditions, c.COND_SCHEDULED):
+                return
+        for pod in gated:
+            pod.spec.scheduling_gates = [
+                g for g in pod.spec.scheduling_gates
+                if g != c.GATE_PODGANG_PENDING]
+            try:
+                self.client.update(pod)
+            except GroveError:
+                pass  # retried on next event
+
+    # ---- status (reference reconcilestatus.go:210-282) ----
+
+    def _update_status(self, pclq: PodClique, pods: list[Pod]) -> None:
+        ready = sum(1 for p in pods
+                    if is_condition_true(p.status.conditions, c.COND_READY))
+        scheduled = sum(1 for p in pods if p.status.node_name)
+        gated = sum(1 for p in pods if p.spec.scheduling_gates)
+        updated = sum(1 for p in pods
+                      if p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH)
+                      == pclq.spec.pod_template_hash)
+        pclq.status.replicas = len(pods)
+        pclq.status.ready_replicas = ready
+        pclq.status.scheduled_replicas = scheduled
+        pclq.status.gated_replicas = gated
+        pclq.status.updated_replicas = updated
+        pclq.status.observed_generation = pclq.meta.generation
+        breached = ready < pclq.spec.min_available
+        pclq.status.conditions = set_condition(
+            pclq.status.conditions, Condition(
+                type=c.COND_MIN_AVAILABLE_BREACHED,
+                status="True" if breached else "False",
+                reason=f"ready={ready} minAvailable={pclq.spec.min_available}"))
+        pclq.status.conditions = set_condition(
+            pclq.status.conditions, Condition(
+                type=c.COND_PCLQ_SCHEDULED,
+                status="True" if scheduled >= pclq.spec.min_available else "False",
+                reason=f"scheduled={scheduled}"))
+        try:
+            self.client.update_status(pclq)
+        except GroveError:
+            pass
+
+
+def _deletion_order(pod: Pod) -> tuple:
+    """Scale-in preference: gated first, then unscheduled, then not-ready,
+    then highest index (reference deletion-sort)."""
+    ready = is_condition_true(pod.status.conditions, c.COND_READY)
+    try:
+        idx = namegen.pod_index_from_name(pod.meta.name)
+    except ValueError:
+        idx = 0
+    return (
+        0 if pod.spec.scheduling_gates else 1,
+        0 if not pod.status.node_name else 1,
+        0 if not ready else 1,
+        -idx,
+    )

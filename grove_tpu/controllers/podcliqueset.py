@@ -1,0 +1,188 @@
+"""PodCliqueSet controller — the top-level reconciler (C1).
+
+Parity with reference internal/controller/podcliqueset: finalizer flow,
+generation-hash change detection, dependency-grouped component sync
+(G1 services → G2 podcliques → G3 scalinggroups ∥ podgangs; reference
+reconcilespec.go:274-300), and status aggregation (AvailableReplicas =
+replicas with no MinAvailableBreached).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from grove_tpu.api import PodClique, PodCliqueScalingGroup, PodCliqueSet, PodGang
+from grove_tpu.api import constants as c
+from grove_tpu.api.core import Service
+from grove_tpu.api.meta import Condition, is_condition_true, set_condition
+from grove_tpu.api.serde import to_dict
+from grove_tpu.controllers import expected as exp
+from grove_tpu.runtime.concurrent import run_concurrently
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+
+class PodCliqueSetReconciler:
+    def __init__(self, client: Client):
+        self.client = client
+        self.log = get_logger("podcliqueset")
+
+    def reconcile(self, req: Request) -> StepResult:
+        try:
+            pcs = self.client.get(PodCliqueSet, req.name, req.namespace)
+        except NotFoundError:
+            return StepResult.finished()
+
+        if pcs.meta.deletion_timestamp is not None:
+            return self._reconcile_delete(pcs)
+
+        if c.FINALIZER_PCS not in pcs.meta.finalizers:
+            pcs.meta.finalizers.append(c.FINALIZER_PCS)
+            pcs = self.client.update(pcs)
+
+        template_hash = exp.generation_hash(pcs)
+        if not pcs.status.generation_hash:
+            pcs.status.generation_hash = template_hash
+            pcs = self.client.update_status(pcs)
+        elif pcs.status.generation_hash != template_hash:
+            # Template changed -> rolling update (orchestrated by the
+            # rollout module; milestone later in SURVEY §7 order).
+            pcs = self._init_rolling_update(pcs, template_hash)
+
+        errors = self._sync_components(pcs, template_hash)
+        self._update_status(pcs)
+        if errors:
+            return StepResult.fail(errors[0])
+        return StepResult.finished()
+
+    # ---- deletion (finalizer path) ----
+
+    def _reconcile_delete(self, pcs: PodCliqueSet) -> StepResult:
+        # Children are removed by owner-reference cascade on final removal;
+        # the finalizer exists so asynchronous cleanup could be ordered
+        # here (and so tests can observe the marked state).
+        if c.FINALIZER_PCS in pcs.meta.finalizers:
+            pcs.meta.finalizers.remove(c.FINALIZER_PCS)
+            self.client.update(pcs)
+        return StepResult.finished()
+
+    # ---- rolling update bookkeeping (full orchestration in rollout.py) ----
+
+    def _init_rolling_update(self, pcs: PodCliqueSet,
+                             target_hash: str) -> PodCliqueSet:
+        from grove_tpu.api.podcliqueset import UpdateProgress
+        pcs.status.generation_hash = target_hash
+        pcs.status.rolling_update = UpdateProgress(target_hash=target_hash)
+        return self.client.update_status(pcs)
+
+    # ---- component sync ----
+
+    def _sync_components(self, pcs: PodCliqueSet,
+                         template_hash: str) -> list[Exception]:
+        # G1: services
+        errors = self._sync_children(Service, exp.expected_services(pcs), pcs)
+        if errors:
+            return errors
+        # G2: standalone PCLQs (must exist before podgangs reference pods).
+        # The component label keeps PCSG-member PCLQs (owned by the PCSG
+        # controller) out of this diff's prune set.
+        errors = self._sync_children(
+            PodClique, exp.expected_standalone_pclqs(pcs, template_hash), pcs,
+            update_spec=True,
+            extra_selector={c.LABEL_COMPONENT: exp.COMPONENT_STANDALONE_PCLQ})
+        if errors:
+            return errors
+        # G3: scaling groups ∥ podgangs
+        errors = run_concurrently([
+            lambda: self._raise_all(self._sync_children(
+                PodCliqueScalingGroup, exp.expected_pcsgs(pcs, template_hash),
+                pcs, update_spec=True)),
+            lambda: self._raise_all(self._sync_children(
+                PodGang, exp.expected_podgangs(pcs), pcs, update_spec=True)),
+        ])
+        return errors
+
+    @staticmethod
+    def _raise_all(errors: list[Exception]) -> None:
+        if errors:
+            raise errors[0]
+
+    def _sync_children(self, kind_cls, expected_objs, pcs,
+                       update_spec: bool = False,
+                       extra_selector: dict[str, str] | None = None
+                       ) -> list[Exception]:
+        """Create missing / update drifted / prune orphaned children."""
+        errors: list[Exception] = []
+        selector = {c.LABEL_PCS_NAME: pcs.meta.name}
+        if extra_selector:
+            selector.update(extra_selector)
+        live = {o.meta.name: o for o in self.client.list(
+            kind_cls, pcs.meta.namespace, selector)}
+        expected_names = set()
+        for obj in expected_objs:
+            expected_names.add(obj.meta.name)
+            cur = live.get(obj.meta.name)
+            try:
+                if cur is None:
+                    self.client.create(obj)
+                elif update_spec and to_dict(cur.spec) != to_dict(obj.spec):
+                    cur.spec = obj.spec
+                    self.client.update(cur)
+            except GroveError as e:
+                errors.append(e)
+        # prune: children no longer in the expected set (scale-in, template
+        # restructure) — reference syncflow.go orphan pruning
+        for name, cur in live.items():
+            if name not in expected_names and cur.meta.deletion_timestamp is None:
+                try:
+                    self.client.delete(kind_cls, name, pcs.meta.namespace)
+                except GroveError as e:
+                    errors.append(e)
+        return errors
+
+    # ---- status ----
+
+    def _update_status(self, pcs: PodCliqueSet) -> None:
+        try:
+            pcs = self.client.get(PodCliqueSet, pcs.meta.name, pcs.meta.namespace)
+        except NotFoundError:
+            return
+        selector = {c.LABEL_PCS_NAME: pcs.meta.name}
+        pclqs = self.client.list(PodClique, pcs.meta.namespace, selector)
+        pcsgs = self.client.list(PodCliqueScalingGroup, pcs.meta.namespace,
+                                 selector)
+        available = 0
+        for r in range(pcs.spec.replicas):
+            replica_pclqs = [q for q in pclqs
+                             if q.meta.labels.get(c.LABEL_PCS_REPLICA) == str(r)
+                             and not q.spec.pcsg_name]
+            replica_pcsgs = [g for g in pcsgs
+                             if g.meta.labels.get(c.LABEL_PCS_REPLICA) == str(r)]
+            breached = any(
+                is_condition_true(q.status.conditions,
+                                  c.COND_MIN_AVAILABLE_BREACHED)
+                for q in replica_pclqs) or any(
+                is_condition_true(g.status.conditions,
+                                  c.COND_MIN_AVAILABLE_BREACHED)
+                for g in replica_pcsgs)
+            ready = (replica_pclqs or replica_pcsgs) and all(
+                q.status.ready_replicas >= q.spec.min_available
+                for q in replica_pclqs) and all(
+                g.status.ready_replicas >= g.spec.min_available
+                for g in replica_pcsgs)
+            if ready and not breached:
+                available += 1
+        pcs.status.replicas = pcs.spec.replicas
+        pcs.status.available_replicas = available
+        pcs.status.observed_generation = pcs.meta.generation
+        pcs.status.conditions = set_condition(pcs.status.conditions, Condition(
+            type="Available",
+            status="True" if available >= pcs.spec.replicas else "False",
+            reason=f"{available}/{pcs.spec.replicas} replicas available"))
+        try:
+            self.client.update_status(pcs)
+        except GroveError:
+            pass  # next event recomputes

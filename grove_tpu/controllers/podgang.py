@@ -1,0 +1,39 @@
+"""PodGang controller (C4) — thin backend delegation.
+
+Parity with reference internal/controller/podgang/reconciler.go:59-86:
+resolve the backend from the gang's scheduler name (or default) and hand
+the gang to Backend.sync_podgang. Native backends place gangs in their own
+loop; this controller is the seam where a translating backend (e.g. one
+emitting an external scheduler's CRD) would do its work.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import PodGang
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import NotFoundError
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.scheduler.framework import Registry
+from grove_tpu.store.client import Client
+
+
+class PodGangReconciler:
+    def __init__(self, client: Client, scheduler_registry: Registry):
+        self.client = client
+        self.schedulers = scheduler_registry
+        self.log = get_logger("podgang")
+
+    def reconcile(self, req: Request) -> StepResult:
+        try:
+            gang = self.client.get(PodGang, req.name, req.namespace)
+        except NotFoundError:
+            return StepResult.finished()
+        if gang.meta.deletion_timestamp is not None:
+            return StepResult.finished()
+        try:
+            backend = self.schedulers.get(gang.spec.scheduler_name or None)
+        except KeyError as e:
+            return StepResult.fail(e)
+        backend.sync_podgang(gang)
+        return StepResult.finished()

@@ -1,0 +1,93 @@
+"""Controller registration — wire reconcilers, watches, and workers.
+
+Parity with reference internal/controller/register.go:34-67 (five
+controllers) + cmd/main.go bootstrap order: scheduler registry first,
+then controllers with their watch mappings, then backend placement loops
+and agents as manager runnables.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import constants as c
+from grove_tpu.controllers.podclique import PodCliqueReconciler
+from grove_tpu.controllers.podcliqueset import PodCliqueSetReconciler
+from grove_tpu.controllers.podgang import PodGangReconciler
+from grove_tpu.controllers.scalinggroup import ScalingGroupReconciler
+from grove_tpu.runtime.controller import (
+    Controller,
+    Request,
+    owner_requests,
+    self_requests,
+)
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.scheduler.framework import Registry
+from grove_tpu.scheduler.registry import build_registry
+from grove_tpu.store.store import Event
+
+
+def _label_requests(label: str):
+    """Map an event to the object named by one of its labels."""
+    def mapper(event: Event) -> list[Request]:
+        name = event.obj.meta.labels.get(label)
+        return [Request(event.obj.meta.namespace, name)] if name else []
+    return mapper
+
+
+def register_controllers(mgr: Manager) -> Registry:
+    cfg = mgr.config
+    registry = build_registry(cfg, mgr.client)
+
+    pcs = PodCliqueSetReconciler(mgr.client)
+    pcs_ctrl = Controller("podcliqueset", mgr.client, pcs.reconcile,
+                          workers=cfg.concurrency.podcliqueset,
+                          backoff_base=cfg.requeue_base_seconds,
+                          backoff_max=cfg.requeue_max_seconds)
+    pcs_ctrl.watches(["PodCliqueSet"], self_requests)
+    pcs_ctrl.watches(["PodClique", "PodCliqueScalingGroup", "PodGang",
+                      "Service"], _label_requests(c.LABEL_PCS_NAME))
+    mgr.add_controller(pcs_ctrl)
+
+    pclq = PodCliqueReconciler(mgr.client, registry)
+    pclq_ctrl = Controller("podclique", mgr.client, pclq.reconcile,
+                           workers=cfg.concurrency.podclique,
+                           backoff_base=cfg.requeue_base_seconds,
+                           backoff_max=cfg.requeue_max_seconds)
+    pclq_ctrl.watches(["PodClique"], self_requests)
+    pclq_ctrl.watches(["Pod"], _label_requests(c.LABEL_PCLQ_NAME))
+
+    def gang_to_pclqs(event: Event) -> list[Request]:
+        """PodGang status flips (Initialized/base Scheduled) unblock gate
+        removal in its PCS's cliques."""
+        ns = event.obj.meta.namespace
+        pcs_name = event.obj.meta.labels.get(c.LABEL_PCS_NAME)
+        if not pcs_name:
+            return []
+        from grove_tpu.api import PodClique
+        return [Request(ns, q.meta.name) for q in mgr.client.list(
+            PodClique, ns, selector={c.LABEL_PCS_NAME: pcs_name})]
+
+    pclq_ctrl.watches(["PodGang"], gang_to_pclqs)
+    mgr.add_controller(pclq_ctrl)
+
+    pcsg = ScalingGroupReconciler(mgr.client)
+    pcsg_ctrl = Controller("podcliquescalinggroup", mgr.client, pcsg.reconcile,
+                           workers=cfg.concurrency.podcliquescalinggroup,
+                           backoff_base=cfg.requeue_base_seconds,
+                           backoff_max=cfg.requeue_max_seconds)
+    pcsg_ctrl.watches(["PodCliqueScalingGroup"], self_requests)
+    pcsg_ctrl.watches(["PodClique"], _label_requests(c.LABEL_PCSG_NAME))
+    mgr.add_controller(pcsg_ctrl)
+
+    gang = PodGangReconciler(mgr.client, registry)
+    gang_ctrl = Controller("podgang", mgr.client, gang.reconcile,
+                           workers=cfg.concurrency.podgang,
+                           backoff_base=cfg.requeue_base_seconds,
+                           backoff_max=cfg.requeue_max_seconds)
+    gang_ctrl.watches(["PodGang"], self_requests)
+    mgr.add_controller(gang_ctrl)
+
+    for backend in registry.backends():
+        runnable = backend.runnable()
+        if runnable is not None:
+            mgr.add_runnable(runnable)
+    return registry

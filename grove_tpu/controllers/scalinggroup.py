@@ -1,0 +1,146 @@
+"""PodCliqueScalingGroup controller (C3).
+
+Parity with reference internal/controller/podcliquescalinggroup: fans a
+PCSG out to member PCLQs per PCSG replica (names
+<pcs>-<i>-<pcsg>-<j>-<clique>), injects GROVE_PCSG_* context, supports
+scale-in by pruning replica PCLQs, and rolls member readiness up to
+ScheduledReplicas / ReadyReplicas / MinAvailableBreached.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import (
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    constants as c,
+    namegen,
+)
+from grove_tpu.api.meta import Condition, OwnerReference, set_condition
+from grove_tpu.api.serde import to_dict
+from grove_tpu.controllers import expected as exp
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+
+class ScalingGroupReconciler:
+    def __init__(self, client: Client):
+        self.client = client
+        self.log = get_logger("podcliquescalinggroup")
+
+    def reconcile(self, req: Request) -> StepResult:
+        try:
+            pcsg = self.client.get(PodCliqueScalingGroup, req.name,
+                                   req.namespace)
+        except NotFoundError:
+            return StepResult.finished()
+        if pcsg.meta.deletion_timestamp is not None:
+            return StepResult.finished()
+        try:
+            pcs = self.client.get(PodCliqueSet, pcsg.spec.pcs_name,
+                                  req.namespace)
+        except NotFoundError:
+            return StepResult.requeue(0.2)  # parent not visible yet
+
+        errors = self._sync_member_pclqs(pcsg, pcs)
+        self._update_status(pcsg)
+        if errors:
+            return StepResult.fail(errors[0])
+        return StepResult.finished()
+
+    def _member_name(self, pcsg: PodCliqueScalingGroup, replica: int,
+                     clique: str) -> str:
+        sg_short = pcsg.meta.name[
+            len(f"{pcsg.spec.pcs_name}-{pcsg.spec.pcs_replica}-"):]
+        return namegen.pcsg_pclq_name(pcsg.spec.pcs_name,
+                                      pcsg.spec.pcs_replica, sg_short,
+                                      replica, clique)
+
+    def _sync_member_pclqs(self, pcsg: PodCliqueScalingGroup,
+                           pcs: PodCliqueSet) -> list[Exception]:
+        errors: list[Exception] = []
+        by_name = {t.name: t for t in pcs.spec.template.cliques}
+        live = {q.meta.name: q for q in self.client.list(
+            PodClique, pcsg.meta.namespace,
+            selector={c.LABEL_PCSG_NAME: pcsg.meta.name})}
+        expected_names = set()
+        for j in range(pcsg.spec.replicas):
+            for clique in pcsg.spec.clique_names:
+                t = by_name.get(clique)
+                if t is None:
+                    errors.append(GroveError(
+                        f"clique {clique!r} referenced by {pcsg.meta.name} "
+                        "not in PCS template", operation="SyncPCLQ"))
+                    continue
+                name = self._member_name(pcsg, j, clique)
+                expected_names.add(name)
+                spec = exp._clique_to_spec(
+                    pcs, pcsg.spec.pcs_replica, t, name,
+                    pcsg=pcsg.meta.name, pcsg_replica=j,
+                    template_hash=pcsg.spec.pod_template_hash)
+                cur = live.get(name)
+                try:
+                    if cur is None:
+                        pclq = PodClique(
+                            meta=exp._meta(pcs, name, exp._labels(
+                                pcs, pcsg.spec.pcs_replica, {
+                                    c.LABEL_PCLQ_ROLE: clique,
+                                    c.LABEL_PCSG_NAME: pcsg.meta.name,
+                                    c.LABEL_PCSG_REPLICA: str(j),
+                                    c.LABEL_COMPONENT: exp.COMPONENT_PCSG_PCLQ,
+                                })),
+                            spec=spec)
+                        # owned by the PCSG (cascade + watch mapping)
+                        pclq.meta.owner_references = [OwnerReference(
+                            kind=PodCliqueScalingGroup.KIND,
+                            name=pcsg.meta.name, uid=pcsg.meta.uid)]
+                        self.client.create(pclq)
+                    elif to_dict(cur.spec) != to_dict(spec):
+                        cur.spec = spec
+                        self.client.update(cur)
+                except GroveError as e:
+                    errors.append(e)
+        # prune scale-in leftovers
+        for name, cur in live.items():
+            if name not in expected_names and cur.meta.deletion_timestamp is None:
+                try:
+                    self.client.delete(PodClique, name, pcsg.meta.namespace)
+                except GroveError as e:
+                    errors.append(e)
+        return errors
+
+    def _update_status(self, pcsg: PodCliqueScalingGroup) -> None:
+        members = self.client.list(
+            PodClique, pcsg.meta.namespace,
+            selector={c.LABEL_PCSG_NAME: pcsg.meta.name})
+        ready_replicas = 0
+        scheduled_replicas = 0
+        for j in range(pcsg.spec.replicas):
+            mine = [q for q in members
+                    if q.meta.labels.get(c.LABEL_PCSG_REPLICA) == str(j)]
+            if len(mine) == len(pcsg.spec.clique_names) and all(
+                    q.status.ready_replicas >= q.spec.min_available
+                    for q in mine):
+                ready_replicas += 1
+            if len(mine) == len(pcsg.spec.clique_names) and all(
+                    q.status.scheduled_replicas >= q.spec.min_available
+                    for q in mine):
+                scheduled_replicas += 1
+        pcsg.status.replicas = pcsg.spec.replicas
+        pcsg.status.ready_replicas = ready_replicas
+        pcsg.status.scheduled_replicas = scheduled_replicas
+        pcsg.status.observed_generation = pcsg.meta.generation
+        breached = ready_replicas < pcsg.spec.min_available
+        pcsg.status.conditions = set_condition(
+            pcsg.status.conditions, Condition(
+                type=c.COND_MIN_AVAILABLE_BREACHED,
+                status="True" if breached else "False",
+                reason=(f"readyReplicas={ready_replicas} "
+                        f"minAvailable={pcsg.spec.min_available}")))
+        try:
+            self.client.update_status(pcsg)
+        except GroveError:
+            pass

@@ -1,0 +1,60 @@
+"""YAML manifest loading — the kubectl-apply surface.
+
+Maps YAML documents (kind + metadata + spec, snake_case fields mirroring
+the dataclass API) onto typed resources. The reference relies on kubectl
++ CRD schemas; here the manifest codec is part of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, TextIO
+
+import yaml
+
+from grove_tpu.api import (
+    ClusterTopology,
+    Node,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+)
+from grove_tpu.api.core import Service
+from grove_tpu.api.meta import ObjectMeta, new_meta
+from grove_tpu.api.serde import from_dict
+from grove_tpu.runtime.errors import ValidationError
+
+KIND_REGISTRY: dict[str, type] = {
+    cls.KIND: cls
+    for cls in (PodCliqueSet, PodClique, PodCliqueScalingGroup, PodGang,
+                ClusterTopology, Pod, Node, Service)
+}
+
+
+def load_object(doc: dict[str, Any]) -> Any:
+    kind = doc.get("kind")
+    cls = KIND_REGISTRY.get(kind or "")
+    if cls is None:
+        raise ValidationError(
+            f"unknown kind {kind!r}; supported: {sorted(KIND_REGISTRY)}")
+    metadata = doc.get("metadata") or {}
+    if not metadata.get("name"):
+        raise ValidationError(f"{kind}: metadata.name is required")
+    obj = cls()
+    obj.meta = new_meta(metadata["name"],
+                        namespace=metadata.get("namespace", "default"),
+                        labels=metadata.get("labels"),
+                        annotations=metadata.get("annotations"))
+    if "spec" in doc:
+        spec_cls = type(obj.spec) if hasattr(obj, "spec") else None
+        if spec_cls is None:
+            raise ValidationError(f"{kind} does not take a spec")
+        obj.spec = from_dict(spec_cls, doc["spec"])
+    return obj
+
+
+def load_manifest(stream: str | TextIO) -> list[Any]:
+    """Parse a (multi-document) YAML manifest into typed objects."""
+    docs = yaml.safe_load_all(stream)
+    return [load_object(d) for d in docs if d]

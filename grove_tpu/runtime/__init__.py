@@ -1,0 +1,20 @@
+from grove_tpu.runtime.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    GroveError,
+    NotFoundError,
+)
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.controller import Controller, Request
+from grove_tpu.runtime.manager import Manager
+
+__all__ = [
+    "AlreadyExistsError",
+    "ConflictError",
+    "GroveError",
+    "NotFoundError",
+    "StepResult",
+    "Controller",
+    "Request",
+    "Manager",
+]

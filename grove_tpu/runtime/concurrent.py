@@ -1,0 +1,49 @@
+"""Bounded / slow-start concurrent task running.
+
+Role parity with reference internal/utils/concurrent.go:70-104
+(RunConcurrently[WithSlowStart|WithBounds]): component sync fans out many
+store mutations; batches double in size (1, 2, 4, ...) so one systemic
+failure surfaces after O(log n) attempts instead of n.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+
+def run_concurrently(tasks: Sequence[Callable[[], None]],
+                     max_workers: int = 8) -> list[Exception]:
+    """Run all tasks; return the list of raised exceptions (empty == ok)."""
+    errors: list[Exception] = []
+    if not tasks:
+        return errors
+    with ThreadPoolExecutor(max_workers=min(max_workers, len(tasks))) as ex:
+        futures = [ex.submit(t) for t in tasks]
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 - collected, not swallowed
+                errors.append(e)
+    return errors
+
+
+def run_with_slow_start(tasks: Sequence[Callable[[], None]],
+                        initial_batch: int = 1,
+                        max_workers: int = 8) -> tuple[int, list[Exception]]:
+    """Run in doubling batches; stop at the first batch with any failure.
+
+    Returns (successes, errors). Mirrors the kube slow-start pattern used
+    for pod creation bursts.
+    """
+    done = 0
+    batch = max(1, initial_batch)
+    remaining = list(tasks)
+    while remaining:
+        current, remaining = remaining[:batch], remaining[batch:]
+        errors = run_concurrently(current, max_workers=max_workers)
+        done += len(current) - len(errors)
+        if errors:
+            return done, errors
+        batch *= 2
+    return done, []

@@ -1,0 +1,215 @@
+"""Controller runtime: rate-limited workqueue + watch-driven reconcilers.
+
+Role parity with controller-runtime as used by the reference (SURVEY.md
+§1 L2): each controller owns a dedup-ing delay queue fed by store watch
+events through mapper functions; N worker threads pop requests and call
+the reconcile function; failures requeue with exponential backoff; a
+StepResult can ask for a delayed requeue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Any, Callable, NamedTuple
+
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.store import Event
+from grove_tpu.store.client import Client
+
+
+class Request(NamedTuple):
+    namespace: str
+    name: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def owner_requests(event: Event, kind: str) -> list[Request]:
+    """Map an event to requests for its controller owner of ``kind``."""
+    obj = event.obj
+    return [Request(obj.meta.namespace, ref.name)
+            for ref in obj.meta.owner_references
+            if ref.kind == kind and ref.controller]
+
+
+def self_requests(event: Event) -> list[Request]:
+    return [Request(event.obj.meta.namespace, event.obj.meta.name)]
+
+
+class _DelayQueue:
+    """Dedup-ing delay queue: an item re-added while pending is not
+    duplicated; an item re-added while being processed is re-queued after
+    processing (the k8s workqueue 'dirty' semantics)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._heap: list[tuple[float, int, Request]] = []
+        self._seq = itertools.count()
+        self._pending: set[Request] = set()
+        self._processing: set[Request] = set()
+        self._dirty: set[Request] = set()
+        self._shutdown = False
+
+    def add(self, req: Request, delay: float = 0.0) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if req in self._processing:
+                self._dirty.add(req)
+                return
+            # Always push: a watch event (delay=0) must be able to
+            # accelerate a request sitting out a backoff window. The
+            # _pending set makes delivery once-only — after the earliest
+            # entry pops, stale heap entries are skipped by get().
+            self._pending.add(req)
+            heapq.heappush(self._heap, (time.time() + delay, next(self._seq), req))
+            self._lock.notify()
+
+    def get(self, timeout: float = 0.2) -> Request | None:
+        with self._lock:
+            deadline = time.time() + timeout
+            while True:
+                if self._shutdown:
+                    return None
+                now = time.time()
+                while self._heap and self._heap[0][2] not in self._pending:
+                    heapq.heappop(self._heap)  # stale entry (already popped)
+                if self._heap and self._heap[0][0] <= now:
+                    _, _, req = heapq.heappop(self._heap)
+                    self._pending.discard(req)
+                    self._processing.add(req)
+                    return req
+                wait = min(
+                    self._heap[0][0] - now if self._heap else timeout,
+                    deadline - now)
+                if wait <= 0:
+                    return None
+                self._lock.wait(wait)
+
+    def done(self, req: Request) -> None:
+        with self._lock:
+            self._processing.discard(req)
+            if req in self._dirty:
+                self._dirty.discard(req)
+                self._pending.add(req)
+                heapq.heappush(self._heap, (time.time(), next(self._seq), req))
+                self._lock.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending) + len(self._processing)
+
+
+class Controller:
+    """A named reconciler with its own queue, workers, and watches."""
+
+    def __init__(self, name: str, client: Client,
+                 reconcile: Callable[[Request], StepResult | None],
+                 workers: int = 2,
+                 backoff_base: float = 0.05,
+                 backoff_max: float = 5.0):
+        self.name = name
+        self.client = client
+        self.reconcile = reconcile
+        self.workers = workers
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.queue = _DelayQueue()
+        self.log = get_logger(f"controller.{name}")
+        self._failures: dict[Request, int] = {}
+        self._watch_specs: list[tuple[list[str] | None,
+                                      Callable[[Event], list[Request]],
+                                      dict[str, str] | None]] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self.reconcile_count = 0
+        self.error_count = 0
+
+    # ---- wiring ----
+
+    def watches(self, kinds: list[str] | None,
+                mapper: Callable[[Event], list[Request]],
+                selector: dict[str, str] | None = None) -> "Controller":
+        self._watch_specs.append((kinds, mapper, selector))
+        return self
+
+    def enqueue(self, req: Request, delay: float = 0.0) -> None:
+        self.queue.add(req, delay)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        for kinds, mapper, selector in self._watch_specs:
+            watcher = self.client.watch(kinds, selector)
+            t = threading.Thread(target=self._dispatch, args=(watcher, mapper),
+                                 name=f"{self.name}-watch", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"{self.name}-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+
+    def _dispatch(self, watcher, mapper) -> None:
+        while not self._stop.is_set():
+            event = watcher.poll(timeout=0.2)
+            if event is None:
+                continue
+            try:
+                for req in mapper(event):
+                    self.queue.add(req)
+            except Exception:  # noqa: BLE001
+                self.log.exception("watch mapper panic (event dropped)")
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            req = self.queue.get(timeout=0.2)
+            if req is None:
+                continue
+            try:
+                self._process(req)
+            finally:
+                self.queue.done(req)
+
+    def _process(self, req: Request) -> None:
+        self.reconcile_count += 1
+        try:
+            result = self.reconcile(req) or StepResult.finished()
+        except Exception as e:  # noqa: BLE001 - reconcile panic barrier
+            self.error_count += 1
+            self.log.warning("reconcile %s panicked: %s", req.key, e,
+                             exc_info=True)
+            self._requeue_with_backoff(req)
+            return
+        if result.error is not None:
+            self.error_count += 1
+            self.log.debug("reconcile %s error: %s", req.key, result.error)
+            self._requeue_with_backoff(req, result.requeue_after)
+            return
+        self._failures.pop(req, None)
+        if result.requeue_after is not None:
+            self.queue.add(req, result.requeue_after)
+
+    def _requeue_with_backoff(self, req: Request,
+                              override: float | None = None) -> None:
+        n = self._failures.get(req, 0) + 1
+        self._failures[req] = n
+        delay = override if override is not None else min(
+            self.backoff_base * (2 ** (n - 1)), self.backoff_max)
+        self.queue.add(req, delay)

@@ -1,0 +1,54 @@
+"""Structured errors with codes, mapped into status.last_errors.
+
+Role parity with reference internal/errors/errors.go:36-92 (GroveError
+{code, operation, message}) plus the apiserver error taxonomy the store
+needs (NotFound / Conflict / AlreadyExists), which the reference gets from
+k8s.io/apimachinery.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class GroveError(Exception):
+    code = "ERR_UNKNOWN"
+
+    def __init__(self, message: str, operation: str = "", code: str | None = None):
+        super().__init__(message)
+        self.message = message
+        self.operation = operation
+        if code is not None:
+            self.code = code
+        self.observed_at = time.time()
+
+    def __str__(self) -> str:  # pragma: no cover - repr plumbing
+        op = f" op={self.operation}" if self.operation else ""
+        return f"[{self.code}{op}] {self.message}"
+
+
+class NotFoundError(GroveError):
+    code = "ERR_NOT_FOUND"
+
+
+class AlreadyExistsError(GroveError):
+    code = "ERR_ALREADY_EXISTS"
+
+
+class ConflictError(GroveError):
+    """Optimistic-concurrency conflict (stale resource_version)."""
+
+    code = "ERR_CONFLICT"
+
+
+class ValidationError(GroveError):
+    code = "ERR_VALIDATION"
+
+
+class ForbiddenError(GroveError):
+    code = "ERR_FORBIDDEN"
+
+
+def is_retriable(err: Exception) -> bool:
+    """Conflicts and transient store errors are retried by requeueing."""
+    return isinstance(err, ConflictError)

@@ -1,0 +1,73 @@
+"""Create/delete expectations — the informer-staleness barrier.
+
+Role parity with reference internal/expect/expectations.go:18-92: after a
+reconciler issues creates/deletes, the watch cache may not reflect them on
+the next sync; acting on the stale view would double-create or over-delete.
+The reconciler records expected UIDs here and skips mutating sync passes
+until observed events have cleared them (or they time out).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _Expectation:
+    __slots__ = ("creates", "deletes", "stamp")
+
+    def __init__(self) -> None:
+        self.creates: set[str] = set()
+        self.deletes: set[str] = set()
+        self.stamp = time.time()
+
+
+class ExpectationsStore:
+    def __init__(self, ttl_seconds: float = 30.0):
+        self._lock = threading.Lock()
+        self._by_key: dict[str, _Expectation] = {}
+        self._ttl = ttl_seconds
+
+    def expect_creates(self, key: str, uids: list[str]) -> None:
+        with self._lock:
+            exp = self._by_key.setdefault(key, _Expectation())
+            exp.creates.update(uids)
+            exp.stamp = time.time()
+
+    def expect_deletes(self, key: str, uids: list[str]) -> None:
+        with self._lock:
+            exp = self._by_key.setdefault(key, _Expectation())
+            exp.deletes.update(uids)
+            exp.stamp = time.time()
+
+    def observe_create(self, key: str, uid: str) -> None:
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp:
+                exp.creates.discard(uid)
+
+    def observe_delete(self, key: str, uid: str) -> None:
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp:
+                exp.deletes.discard(uid)
+
+    def satisfied(self, key: str) -> bool:
+        """True when all expected events have been observed (or expired —
+        expired expectations are dropped so a lost event can't wedge the
+        controller forever; the next sync recomputes from live state)."""
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is None:
+                return True
+            if not exp.creates and not exp.deletes:
+                del self._by_key[key]
+                return True
+            if time.time() - exp.stamp > self._ttl:
+                del self._by_key[key]
+                return True
+            return False
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._by_key.pop(key, None)

@@ -1,0 +1,53 @@
+"""Reconcile-flow plumbing: step results and short-circuiting.
+
+Role parity with reference internal/controller/common/flow.go
+(ReconcileStepResult / ShortCircuitReconcileFlow): reconcilers are a
+sequence of steps; each step either continues, completes the flow, or
+requeues (with or without an error).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class StepResult:
+    done: bool = False                  # stop the flow (success)
+    requeue_after: Optional[float] = None
+    error: Optional[Exception] = None
+
+    CONTINUE: "StepResult" = None  # type: ignore[assignment]
+
+    @staticmethod
+    def ok() -> "StepResult":
+        return StepResult()
+
+    @staticmethod
+    def finished() -> "StepResult":
+        return StepResult(done=True)
+
+    @staticmethod
+    def requeue(after: float) -> "StepResult":
+        return StepResult(done=True, requeue_after=after)
+
+    @staticmethod
+    def fail(err: Exception, requeue_after: float | None = None) -> "StepResult":
+        return StepResult(done=True, error=err, requeue_after=requeue_after)
+
+    @property
+    def short_circuits(self) -> bool:
+        return self.done or self.error is not None
+
+
+StepResult.CONTINUE = StepResult()
+
+
+def run_steps(*steps) -> StepResult:
+    """Run callables returning StepResult until one short-circuits."""
+    for step in steps:
+        result = step()
+        if result is not None and result.short_circuits:
+            return result
+    return StepResult.finished()

@@ -1,0 +1,20 @@
+"""Template hashing for change detection.
+
+Role parity with reference internal/utils/kubernetes ComputeHash + the
+generation-hash machinery (podcliqueset/reconcilespec.go:110-123): a
+stable short hash of the pod-shaping parts of a spec, used to detect
+rolling-update triggers and to label pods with their template version.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+from grove_tpu.api.serde import to_dict
+
+
+def compute_hash(obj: Any) -> str:
+    data = json.dumps(to_dict(obj), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(data.encode()).hexdigest()[:10]

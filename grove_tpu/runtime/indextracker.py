@@ -1,0 +1,21 @@
+"""Stable pod-index assignment with hole reuse.
+
+Role parity with reference internal/index/tracker.go:35-90: pods carry a
+stable integer index (their TPU_WORKER_ID within the clique); when a pod
+dies, its index is a hole that the replacement pod must reuse so worker
+identity survives pod replacement.
+"""
+
+from __future__ import annotations
+
+
+def available_indices(used: list[int], want: int) -> list[int]:
+    """Return ``want`` smallest non-negative integers not in ``used``."""
+    taken = set(used)
+    out: list[int] = []
+    i = 0
+    while len(out) < want:
+        if i not in taken:
+            out.append(i)
+        i += 1
+    return out

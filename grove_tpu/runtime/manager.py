@@ -1,0 +1,86 @@
+"""Manager: owns the store, clients, controllers, and agents.
+
+Role parity with reference internal/controller/manager.go:55-147 +
+cmd/main.go:44-143 — minus leader election (single-process control plane;
+the seam is Manager.start) and webhook TLS (admission runs in-process at
+the client boundary, see grove_tpu.admission).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from grove_tpu.api.config import OperatorConfiguration, validate_config
+from grove_tpu.runtime.controller import Controller
+from grove_tpu.runtime.logger import get_logger, setup_logging
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+
+
+class Manager:
+    def __init__(self, config: OperatorConfiguration | None = None,
+                 store: Store | None = None, client: Client | None = None):
+        self.config = config or OperatorConfiguration()
+        problems = validate_config(self.config)
+        if problems:
+            raise ValueError(f"invalid operator configuration: {problems}")
+        setup_logging(self.config.log.level, self.config.log.format)
+        self.store = store or Store()
+        self.client = client or Client(self.store)
+        self.log = get_logger("manager")
+        self.controllers: list[Controller] = []
+        self.runnables: list[Any] = []   # agents etc. with start()/stop()
+        self._started = False
+
+    def add_controller(self, controller: Controller) -> None:
+        self.controllers.append(controller)
+
+    def add_runnable(self, runnable: Any) -> None:
+        self.runnables.append(runnable)
+
+    def start(self) -> None:
+        for c in self.controllers:
+            c.start()
+        for r in self.runnables:
+            r.start()
+        self._started = True
+        self.log.info("manager started: %d controllers, %d runnables",
+                      len(self.controllers), len(self.runnables))
+
+    def stop(self) -> None:
+        for c in self.controllers:
+            c.stop()
+        for r in self.runnables:
+            r.stop()
+        self._started = False
+
+    # ---- health/readiness (reference manager.go:73-89) ----
+
+    def healthz(self) -> dict:
+        return {
+            "started": self._started,
+            "controllers": {
+                c.name: {"queue": len(c.queue),
+                         "reconciles": c.reconcile_count,
+                         "errors": c.error_count}
+                for c in self.controllers
+            },
+        }
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.2) -> bool:
+        """Block until all controller queues stay empty for ``settle``
+        seconds (test convenience; the e2e 'waiter' analog)."""
+        deadline = time.time() + timeout
+        quiet_since = None
+        while time.time() < deadline:
+            if all(len(c.queue) == 0 for c in self.controllers):
+                if quiet_since is None:
+                    quiet_since = time.time()
+                elif time.time() - quiet_since >= settle:
+                    return True
+            else:
+                quiet_since = None
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,4 @@
+from grove_tpu.scheduler.framework import Backend, Registry, TopologyAware
+from grove_tpu.scheduler.registry import build_registry
+
+__all__ = ["Backend", "Registry", "TopologyAware", "build_registry"]

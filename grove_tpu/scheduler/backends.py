@@ -1,0 +1,396 @@
+"""Scheduler backends: gang (native, slice-atomic), simple, external.
+
+Backend set parity with reference internal/scheduler/{kai,volcano,kube,lpx}
+re-based on TPU-native placement:
+
+- ``gang``    — the KAI/Volcano role: consumes PodGangs natively and
+                gang-places onto TPU slices (atomic ICI placement, reuse
+                hints, DCN spread). Ships the placement loop.
+- ``simple``  — the kube role: no gang semantics, first-fit single pods
+                (gating still guarantees all-pods-exist before placement).
+- ``external``— the lpx role: stamps scheduler_name and delegates
+                placement to an out-of-process scheduler; rejects Grove
+                topology constraints it cannot honor.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+from grove_tpu.api import Node, Pod, PodGang, constants as c
+from grove_tpu.api.meta import Condition, is_condition_true, set_condition
+from grove_tpu.api.podcliqueset import PodCliqueSet
+from grove_tpu.api.podgang import PodGangPhase
+from grove_tpu.runtime.errors import ConflictError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.scheduler.placement import (
+    HostView,
+    PodRequest,
+    plan_gang,
+    plan_single,
+)
+from grove_tpu.store.client import Client
+
+
+def build_host_views(client: Client, namespace: str = "default") -> list[HostView]:
+    """Snapshot free capacity per ready TPU host."""
+    used: dict[str, int] = defaultdict(int)
+    for pod in client.list(Pod, namespace):
+        if pod.status.node_name and pod.status.phase.value in ("Pending", "Running"):
+            used[pod.status.node_name] += pod.spec.tpu_chips
+    views = []
+    for node in client.list(Node, namespace):
+        if not node.status.ready or node.spec.unschedulable:
+            continue
+        labels = node.meta.labels
+        views.append(HostView(
+            name=node.meta.name,
+            slice_name=labels.get(c.NODE_LABEL_SLICE, ""),
+            pool=labels.get(c.NODE_LABEL_POOL, ""),
+            superblock=labels.get(c.NODE_LABEL_SUPERBLOCK, ""),
+            free_chips=node.status.allocatable_chips - used[node.meta.name],
+            labels=dict(labels),
+        ))
+    return views
+
+
+def _schedulable(pod: Pod) -> bool:
+    return (not pod.spec.scheduling_gates
+            and not pod.status.node_name
+            and pod.meta.deletion_timestamp is None
+            and pod.status.phase.value == "Pending")
+
+
+class _PlacementLoop:
+    """Shared scheduling loop thread driving one backend's place() pass."""
+
+    def __init__(self, name: str, client: Client, tick: float, place) -> None:
+        self.name = name
+        self.client = client
+        self.tick = tick
+        self.place = place
+        self.log = get_logger(f"scheduler.{name}")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+    def start(self) -> None:
+        watcher = self.client.watch(["Pod", "PodGang", "Node"])
+
+        def pump():
+            while not self._stop.is_set():
+                if watcher.poll(0.2) is not None:
+                    self._wake.set()
+
+        threading.Thread(target=pump, name=f"sched-{self.name}-watch",
+                         daemon=True).start()
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"sched-{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.tick)
+            self._wake.clear()
+            try:
+                self.place()
+            except ConflictError:
+                self._wake.set()   # stale write; retry promptly
+            except Exception:      # noqa: BLE001 - loop survival barrier
+                self.log.exception("placement pass panicked")
+
+
+class GangBackend:
+    """Native TPU gang scheduler."""
+
+    name = "gang"
+
+    def __init__(self) -> None:
+        self.client: Client | None = None
+        self.namespace = "default"
+        self.log = get_logger("scheduler.gang")
+        self._loop: _PlacementLoop | None = None
+
+    # ---- Backend interface ----
+
+    def init(self, client: Client, options: dict[str, str]) -> None:
+        self.client = client
+        tick = float(options.get("tick_seconds", "0.2"))
+        self._loop = _PlacementLoop("gang", client, tick, self._place_pass)
+
+    def prepare_pod(self, pod: Pod, gang_name: str) -> None:
+        pod.spec.scheduler_name = self.name
+        pod.meta.labels[c.LABEL_PODGANG_NAME] = gang_name
+
+    def sync_podgang(self, gang: PodGang) -> None:
+        # Native backend: the placement loop consumes PodGangs directly;
+        # nothing to translate (the reference KAI backend's posture,
+        # kai/backend.go:33).
+        return
+
+    def validate_pcs(self, pcs: PodCliqueSet) -> list[str]:
+        return []
+
+    def runnable(self) -> Optional[_PlacementLoop]:
+        return self._loop
+
+    # ---- placement ----
+
+    def _place_pass(self) -> None:
+        client = self.client
+        assert client is not None
+        hosts = build_host_views(client, self.namespace)
+        gangs = client.list(PodGang, self.namespace)
+        scheduled_by_name = {
+            g.meta.name: is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+            for g in gangs}
+        # Base gangs first, then scaled; stable by creation time.
+        gangs.sort(key=lambda g: (bool(g.spec.base_gang),
+                                  g.meta.creation_timestamp))
+        for gang in gangs:
+            if gang.spec.scheduler_name not in ("", self.name):
+                continue
+            if gang.spec.base_gang and not scheduled_by_name.get(
+                    gang.spec.base_gang, False):
+                continue  # scaled capacity never blocks/preempts base gangs
+            placed = self._sync_gang(gang, hosts)
+            if placed:
+                hosts = build_host_views(client, self.namespace)
+
+    def _gang_pods(self, gang: PodGang) -> tuple[list[Pod], int, int]:
+        """(existing pods of the gang, total expected, min required)."""
+        client = self.client
+        pods = client.list(Pod, self.namespace,
+                           selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+        by_name = {p.meta.name: p for p in pods}
+        existing: list[Pod] = []
+        expected = 0
+        min_required = 0
+        for group in gang.spec.groups:
+            expected += len(group.pod_names)
+            min_required += group.min_replicas
+            for pn in group.pod_names:
+                if pn in by_name:
+                    existing.append(by_name[pn])
+        return existing, expected, min_required
+
+    def _sync_gang(self, gang: PodGang, hosts: list[HostView]) -> bool:
+        client = self.client
+        existing, expected, min_required = self._gang_pods(gang)
+        initialized = expected > 0 and len(existing) == expected
+
+        bindable = [p for p in existing if _schedulable(p)]
+        already_bound = [p for p in existing if p.status.node_name]
+        gated = [p for p in existing if p.spec.scheduling_gates]
+
+        # Group-level min check on *bindable* pods — and never start the
+        # gang while some of its pods are still gated (gate removal is
+        # per-pod; placing the early-ungated subset would split the gang).
+        bindable_names = {p.meta.name for p in bindable}
+        group_ok = (expected > 0 and not gated and all(
+            sum(1 for pn in grp.pod_names if pn in bindable_names)
+            >= grp.min_replicas
+            for grp in gang.spec.groups))
+
+        placed_any = False
+
+        if not already_bound and group_ok and bindable:
+            # First placement: gang-atomic plan over all present pods.
+            requests = [PodRequest(p.meta.name, p.spec.tpu_chips,
+                                   dict(p.spec.node_selector))
+                        for p in bindable]
+            topo = gang.spec.topology
+            pack_level = topo.pack_level if topo else "slice"
+            required = topo.required if topo else True
+            spread = self._spread_penalties(gang)
+            plan = plan_gang(requests, hosts, pack_level=pack_level,
+                             required=required,
+                             prefer_slice=self._reuse_slice(gang),
+                             spread_penalty=spread)
+            if plan is not None:
+                self._bind(bindable, plan.assignments)
+                gang.status.assigned_slice = plan.slice_name
+                gang.status.placement_score = plan.score
+                placed_any = True
+        elif already_bound and bindable:
+            # Stragglers (scale-up within the gang, or pods re-created
+            # after a partial bind): co-locate on the slice, decrementing
+            # the capacity view after each bind. A required slice pack is
+            # a hard constraint — better an unschedulable pod than a gang
+            # whose ICI collectives can never form.
+            topo = gang.spec.topology
+            slice_required = (topo is None or
+                              (topo.pack_level in ("", "slice") and topo.required))
+            pool = hosts
+            if slice_required and gang.status.assigned_slice:
+                pool = [h for h in hosts
+                        if h.slice_name == gang.status.assigned_slice]
+            by_name = {h.name: h for h in pool}
+            for p in bindable:
+                host = plan_single(
+                    PodRequest(p.meta.name, p.spec.tpu_chips,
+                               dict(p.spec.node_selector)),
+                    pool, prefer_slice=gang.status.assigned_slice)
+                if host is not None:
+                    self._bind([p], {p.meta.name: host})
+                    by_name[host].free_chips -= p.spec.tpu_chips
+                    placed_any = True
+
+        self._update_status(gang, initialized, placed_any)
+        return placed_any
+
+    def _reuse_slice(self, gang: PodGang) -> str:
+        """Resolve the ReuseReservationRef hint to a slice name."""
+        if not gang.spec.reuse_reservation_of:
+            return ""
+        try:
+            old = self.client.get(PodGang, gang.spec.reuse_reservation_of,
+                                  self.namespace)
+            return old.status.assigned_slice
+        except NotFoundError:
+            return ""
+
+    def _spread_penalties(self, gang: PodGang) -> dict[str, float]:
+        """Penalise slices already hosting sibling gangs of the same PCS
+        (DCN multislice spread of PCS replicas)."""
+        pcs = gang.meta.labels.get(c.LABEL_PCS_NAME, "")
+        if not pcs:
+            return {}
+        penalties: dict[str, float] = defaultdict(float)
+        for other in self.client.list(PodGang, self.namespace,
+                                      selector={c.LABEL_PCS_NAME: pcs}):
+            if other.meta.name != gang.meta.name and other.status.assigned_slice:
+                # Must dominate bin-pack tightness (<= 1.0) so multislice
+                # replicas spread before they pack.
+                penalties[other.status.assigned_slice] += 2.0
+        return dict(penalties)
+
+    def _bind(self, pods: list[Pod], assignment: dict[str, str]) -> None:
+        for pod in pods:
+            host = assignment.get(pod.meta.name)
+            if host is None:
+                continue
+            pod.status.node_name = host
+            try:
+                self.client.update_status(pod)
+            except (NotFoundError, ConflictError) as e:
+                # Pod vanished or changed under us (scale-in race): skip;
+                # the next pass replans from live state. Aborting here
+                # would strand the rest of the gang mid-bind.
+                self.log.debug("bind %s -> %s skipped: %s",
+                               pod.meta.name, host, e)
+
+    def _update_status(self, gang: PodGang, initialized: bool,
+                       placed_now: bool) -> None:
+        client = self.client
+        existing, expected, _ = self._gang_pods(gang)
+        bound = sum(1 for p in existing if p.status.node_name)
+        ready = sum(1 for p in existing
+                    if is_condition_true(p.status.conditions, c.COND_READY))
+        scheduled = expected > 0 and bound >= sum(
+            g.min_replicas for g in gang.spec.groups)
+        conds = gang.status.conditions
+        conds = set_condition(conds, Condition(
+            type=c.COND_INITIALIZED, status="True" if initialized else "False",
+            reason="AllPodsCreated" if initialized else "AwaitingPods"))
+        conds = set_condition(conds, Condition(
+            type=c.COND_SCHEDULED, status="True" if scheduled else "False",
+            reason="GangPlaced" if scheduled else "AwaitingPlacement"))
+        conds = set_condition(conds, Condition(
+            type=c.COND_READY,
+            status="True" if (expected and ready == expected) else "False",
+            reason=f"{ready}/{expected} ready"))
+        gang.status.conditions = conds
+        if expected and ready == expected:
+            gang.status.phase = PodGangPhase.RUNNING
+        elif scheduled:
+            gang.status.phase = PodGangPhase.STARTING
+        else:
+            gang.status.phase = PodGangPhase.PENDING
+        try:
+            client.update_status(gang)  # store suppresses no-op writes
+        except (ConflictError, NotFoundError):
+            pass  # next pass recomputes from live state
+
+
+class SimpleBackend:
+    """Non-gang first-fit placement (the kube-scheduler role)."""
+
+    name = "simple"
+
+    def __init__(self) -> None:
+        self.client: Client | None = None
+        self.namespace = "default"
+        self._loop: _PlacementLoop | None = None
+
+    def init(self, client: Client, options: dict[str, str]) -> None:
+        self.client = client
+        tick = float(options.get("tick_seconds", "0.2"))
+        self._loop = _PlacementLoop("simple", client, tick, self._place_pass)
+
+    def prepare_pod(self, pod: Pod, gang_name: str) -> None:
+        pod.spec.scheduler_name = self.name
+        pod.meta.labels[c.LABEL_PODGANG_NAME] = gang_name
+
+    def sync_podgang(self, gang: PodGang) -> None:
+        return
+
+    def validate_pcs(self, pcs: PodCliqueSet) -> list[str]:
+        return []
+
+    def runnable(self) -> Optional[_PlacementLoop]:
+        return self._loop
+
+    def _place_pass(self) -> None:
+        client = self.client
+        hosts = build_host_views(client, self.namespace)
+        for pod in client.list(Pod, self.namespace):
+            if pod.spec.scheduler_name not in ("", self.name):
+                continue
+            if not _schedulable(pod):
+                continue
+            host = plan_single(
+                PodRequest(pod.meta.name, pod.spec.tpu_chips,
+                           dict(pod.spec.node_selector)), hosts)
+            if host is not None:
+                pod.status.node_name = host
+                client.update_status(pod)
+                hosts = build_host_views(client, self.namespace)
+
+
+class ExternalBackend:
+    """Delegate placement to an out-of-process scheduler (lpx role)."""
+
+    name = "external"
+
+    def __init__(self, scheduler_name: str = "external"):
+        self.scheduler_name = scheduler_name
+
+    def init(self, client: Client, options: dict[str, str]) -> None:
+        self.scheduler_name = options.get("scheduler_name", self.scheduler_name)
+
+    def prepare_pod(self, pod: Pod, gang_name: str) -> None:
+        pod.spec.scheduler_name = self.scheduler_name
+        pod.meta.labels[c.LABEL_PODGANG_NAME] = gang_name
+
+    def sync_podgang(self, gang: PodGang) -> None:
+        return
+
+    def validate_pcs(self, pcs: PodCliqueSet) -> list[str]:
+        problems = []
+        t = pcs.spec.template
+        if t.topology is not None:
+            problems.append(
+                "external scheduler profile does not support grove topology "
+                "constraints (set them in the external scheduler instead)")
+        return problems
+
+    def runnable(self) -> None:
+        return None

@@ -1,0 +1,86 @@
+"""Pluggable scheduler-backend framework.
+
+Role parity with reference internal/scheduler/types.go:35-115 (Backend /
+TopologyAwareBackend / Registry): the operator talks to gang schedulers
+only through this seam. Differences, TPU-first:
+
+- Native backends (``gang``, ``simple``) ship their own placement loop as
+  a runnable, because this framework is its own control plane — there is
+  no external kube-scheduler to delegate to. The ``external`` backend
+  preserves the delegate-out path (reference ``lpx``).
+- Placement binds pods to TPU hosts honoring slice atomicity rather than
+  emitting a foreign CRD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Protocol, runtime_checkable
+
+from grove_tpu.api.podcliqueset import PodCliqueSet
+from grove_tpu.api.podgang import PodGang
+from grove_tpu.api.core import Pod
+from grove_tpu.store.client import Client
+
+
+@runtime_checkable
+class Backend(Protocol):
+    """A scheduler integration."""
+
+    name: str
+
+    def init(self, client: Client, options: dict[str, str]) -> None:
+        """Wire the backend to the control plane (called once at startup)."""
+        ...
+
+    def prepare_pod(self, pod: Pod, gang_name: str) -> None:
+        """Stamp backend-specific fields onto a pod at build time
+        (reference Backend.PreparePod)."""
+        ...
+
+    def sync_podgang(self, gang: PodGang) -> None:
+        """Accept/translate a PodGang (reference Backend.SyncPodGang)."""
+        ...
+
+    def validate_pcs(self, pcs: PodCliqueSet) -> list[str]:
+        """Backend-specific admission checks (reference
+        Backend.ValidatePodCliqueSet). Returns problems; empty == ok."""
+        ...
+
+    def runnable(self) -> Optional[Any]:
+        """The backend's placement loop (start()/stop()), if native."""
+        ...
+
+
+@runtime_checkable
+class TopologyAware(Protocol):
+    """Backends that consume ClusterTopology (reference types.go:59-93)."""
+
+    def sync_topology(self, topology: Any) -> None: ...
+    def check_topology_drift(self, topology: Any) -> bool: ...
+
+
+class Registry:
+    """Profile-name -> backend (reference types.go:96-115)."""
+
+    def __init__(self, default: str):
+        self._backends: dict[str, Backend] = {}
+        self._default = default
+
+    def register(self, profile: str, backend: Backend) -> None:
+        self._backends[profile] = backend
+
+    def get(self, profile: str | None = None) -> Backend:
+        name = profile or self._default
+        if name not in self._backends:
+            raise KeyError(
+                f"no scheduler profile {name!r}; have {sorted(self._backends)}")
+        return self._backends[name]
+
+    def profiles(self) -> list[str]:
+        return sorted(self._backends)
+
+    def backends(self) -> list[Backend]:
+        seen: dict[int, Backend] = {}
+        for b in self._backends.values():
+            seen[id(b)] = b
+        return list(seen.values())

@@ -1,0 +1,135 @@
+"""Pure placement planning — the scheduler's computational core.
+
+Separated from the control loop so it is unit-testable and portable (the
+hot path is plain data in/out; a C++ drop-in can replace plan_* without
+touching the loop). Implements TPU slice-atomic gang placement:
+
+- ``pack_level == "slice"`` + required: every pod of the gang lands inside
+  ONE ICI slice (the reference's NVLink-domain pack made atomic).
+- preferred packing: try slice, then pool, then anywhere.
+- Reuse: a gang replacing another (rolling update) prefers its old slice
+  (reference ReuseReservationRef, podgang.go:65-71).
+- Spread: sibling gangs of one PodCliqueSet prefer distinct domains at the
+  spread level (multislice DP over DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+
+
+@dataclasses.dataclass
+class HostView:
+    """Free capacity on one TPU host, with its topology domains."""
+
+    name: str
+    slice_name: str
+    pool: str
+    superblock: str
+    free_chips: int
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _selector_matches(pod: "PodRequest", host: HostView) -> bool:
+    return all(host.labels.get(k) == v for k, v in pod.node_selector.items())
+
+
+@dataclasses.dataclass
+class PodRequest:
+    name: str
+    chips: int
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PlacementPlan:
+    assignments: dict[str, str]      # pod name -> host name
+    slice_name: str                  # "" when the plan spans slices
+    score: float                     # higher is better (bin-pack tightness)
+
+
+def _domain_of(host: HostView, level: str) -> str:
+    return {"slice": host.slice_name, "pool": host.pool,
+            "superblock": host.superblock, "host": host.name,
+            "": ""}.get(level, "")
+
+
+def _fit_in_hosts(pods: list[PodRequest], hosts: list[HostView]
+                  ) -> dict[str, str] | None:
+    """First-fit-decreasing of pods onto hosts. Returns assignment or None."""
+    free = {h.name: h.free_chips for h in hosts}
+    order = sorted(hosts, key=lambda h: -h.free_chips)
+    assignment: dict[str, str] = {}
+    for pod in sorted(pods, key=lambda p: -p.chips):
+        placed = False
+        for h in order:
+            if free[h.name] >= pod.chips and _selector_matches(pod, h):
+                assignment[pod.name] = h.name
+                free[h.name] -= pod.chips
+                placed = True
+                break
+        if not placed:
+            return None
+    return assignment
+
+
+def plan_gang(pods: list[PodRequest], hosts: list[HostView],
+              pack_level: str = "slice", required: bool = True,
+              prefer_slice: str = "",
+              spread_penalty: dict[str, float] | None = None
+              ) -> PlacementPlan | None:
+    """Plan placement for all ``pods`` together (gang semantics).
+
+    ``spread_penalty`` maps domain value (at the caller's spread level,
+    pre-resolved to slice names) -> penalty subtracted from the score.
+    """
+    if not pods:
+        return PlacementPlan({}, "", 0.0)
+    spread_penalty = spread_penalty or {}
+
+    by_domain: dict[str, list[HostView]] = defaultdict(list)
+    level = pack_level or "slice"
+    for h in hosts:
+        by_domain[_domain_of(h, level)].append(h)
+
+    candidates: list[PlacementPlan] = []
+    for domain, domain_hosts in by_domain.items():
+        assignment = _fit_in_hosts(pods, domain_hosts)
+        if assignment is None:
+            continue
+        total_free = sum(h.free_chips for h in domain_hosts)
+        used = sum(p.chips for p in pods)
+        tightness = used / total_free if total_free else 1.0
+        score = tightness - spread_penalty.get(domain, 0.0)
+        if prefer_slice and domain == prefer_slice:
+            score += 10.0   # reuse dominates
+        slice_name = domain if level == "slice" else ""
+        candidates.append(PlacementPlan(assignment, slice_name, score))
+
+    if candidates:
+        return max(candidates, key=lambda p: p.score)
+    if required:
+        return None
+    # Preferred packing failed -> relax across all hosts.
+    assignment = _fit_in_hosts(pods, hosts)
+    if assignment is None:
+        return None
+    return PlacementPlan(assignment, "", -1.0)
+
+
+def plan_single(pod: PodRequest, hosts: list[HostView],
+                prefer_slice: str = "") -> str | None:
+    """Place one pod (simple backend / gang stragglers). Returns host name.
+
+    Prefers the given slice (late pods of a gang co-locate), then tightest
+    fit.
+    """
+    best: tuple[float, str] | None = None
+    for h in hosts:
+        if h.free_chips < pod.chips or not _selector_matches(pod, h):
+            continue
+        score = -h.free_chips + (1000.0 if h.slice_name == prefer_slice else 0.0)
+        if best is None or score > best[0]:
+            best = (score, h.name)
+    return best[1] if best else None

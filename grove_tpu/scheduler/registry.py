@@ -1,0 +1,38 @@
+"""Build the backend registry from operator config profiles.
+
+Parity with reference internal/scheduler/registry/registry.go: profiles
+from OperatorConfiguration become named backends; each backend's Init is
+called once with its options; the default profile resolves lookups with
+no explicit scheduler name.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.scheduler.backends import (
+    ExternalBackend,
+    GangBackend,
+    SimpleBackend,
+)
+from grove_tpu.scheduler.framework import Registry
+from grove_tpu.store.client import Client
+
+_FACTORIES = {
+    "gang": GangBackend,
+    "simple": SimpleBackend,
+    "external": ExternalBackend,
+}
+
+
+def build_registry(config: OperatorConfiguration, client: Client) -> Registry:
+    registry = Registry(default=config.default_scheduler_profile)
+    for profile in config.scheduler_profiles:
+        factory = _FACTORIES.get(profile.backend)
+        if factory is None:
+            raise ValueError(
+                f"scheduler profile {profile.name!r}: unknown backend "
+                f"{profile.backend!r}; have {sorted(_FACTORIES)}")
+        backend = factory()
+        backend.init(client, dict(profile.options))
+        registry.register(profile.name, backend)
+    return registry

@@ -1,0 +1,4 @@
+from grove_tpu.store.store import Event, EventType, Store, Watcher
+from grove_tpu.store.client import Client, FakeClient
+
+__all__ = ["Event", "EventType", "Store", "Watcher", "Client", "FakeClient"]

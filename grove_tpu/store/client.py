@@ -1,0 +1,117 @@
+"""Typed client + error-injecting fake.
+
+The real client is a thin veneer over the Store (one process, no wire
+format). ``FakeClient`` mirrors the reference's TestClientBuilder
+(operator/test/utils/client.go:36-58): record errors per (method, kind,
+name) and they are replayed to the caller, so reconcilers are exercised
+against apiserver failure modes without special hooks in production code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Iterable
+
+from grove_tpu.store.store import Store, Watcher
+
+
+class Client:
+    def __init__(self, store: Store):
+        self._store = store
+
+    def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
+        return self._store.get(kind_cls, name, namespace)
+
+    def list(self, kind_cls: type, namespace: str | None = "default",
+             selector: dict[str, str] | None = None) -> list[Any]:
+        return self._store.list(kind_cls, namespace, selector)
+
+    def create(self, obj: Any) -> Any:
+        return self._store.create(obj)
+
+    def update(self, obj: Any) -> Any:
+        return self._store.update(obj)
+
+    def update_status(self, obj: Any) -> Any:
+        return self._store.update_status(obj)
+
+    def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
+        return self._store.delete(kind_cls, name, namespace)
+
+    def watch(self, kinds: Iterable[str] | None = None,
+              selector: dict[str, str] | None = None) -> Watcher:
+        return self._store.watch(kinds, selector)
+
+
+@dataclasses.dataclass
+class _InjectedError:
+    method: str                 # get/list/create/update/update_status/delete
+    error: Exception
+    kind: str | None = None     # None = any kind
+    name: str | None = None     # None = any object
+    times: int = 1              # how many calls it poisons (-1 = forever)
+
+
+class FakeClient(Client):
+    """Client with scripted error injection and call recording."""
+
+    def __init__(self, store: Store | None = None):
+        super().__init__(store or Store())
+        self._errors: list[_InjectedError] = []
+        self._calls: list[tuple[str, str, str]] = []  # (method, kind, name)
+        self._lock = threading.Lock()
+
+    @property
+    def store(self) -> Store:
+        return self._store
+
+    def inject_error(self, method: str, error: Exception, kind: str | None = None,
+                     name: str | None = None, times: int = 1) -> None:
+        with self._lock:
+            self._errors.append(_InjectedError(method, error, kind, name, times))
+
+    def calls(self, method: str | None = None) -> list[tuple[str, str, str]]:
+        with self._lock:
+            return [c for c in self._calls if method is None or c[0] == method]
+
+    def _intercept(self, method: str, kind: str, name: str) -> None:
+        with self._lock:
+            self._calls.append((method, kind, name))
+            for inj in self._errors:
+                if inj.method != method:
+                    continue
+                if inj.kind is not None and inj.kind != kind:
+                    continue
+                if inj.name is not None and inj.name != name:
+                    continue
+                if inj.times == 0:
+                    continue
+                if inj.times > 0:
+                    inj.times -= 1
+                raise inj.error
+
+    def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
+        self._intercept("get", kind_cls.KIND, name)
+        return super().get(kind_cls, name, namespace)
+
+    def list(self, kind_cls: type, namespace: str | None = "default",
+             selector: dict[str, str] | None = None) -> list[Any]:
+        self._intercept("list", kind_cls.KIND, "")
+        return super().list(kind_cls, namespace, selector)
+
+    def create(self, obj: Any) -> Any:
+        self._intercept("create", obj.KIND, obj.meta.name)
+        return super().create(obj)
+
+    def update(self, obj: Any) -> Any:
+        self._intercept("update", obj.KIND, obj.meta.name)
+        return super().update(obj)
+
+    def update_status(self, obj: Any) -> Any:
+        self._intercept("update_status", obj.KIND, obj.meta.name)
+        return super().update_status(obj)
+
+    def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
+        self._intercept("delete", kind_cls.KIND, name)
+        return super().delete(kind_cls, name, namespace)

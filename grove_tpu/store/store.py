@@ -1,0 +1,233 @@
+"""Versioned, watchable object store — the control plane's state core.
+
+The reference runs against kube-apiserver + etcd; this framework carries
+its own equivalent: optimistic concurrency via resource_version, spec
+generation bumping, finalizer-aware deletion, owner-reference cascade
+deletion (the k8s GC analog), label-selector lists, and watch streams
+with per-watcher queues (the informer feed).
+
+Thread-safe; controllers run in threads and see a consistent snapshot per
+call (objects are deep-cloned across the boundary, so callers can never
+mutate store state in place — the informer-cache-corruption class of bug
+is structurally impossible).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Iterable, NamedTuple
+
+from grove_tpu.api.serde import clone, to_dict
+from grove_tpu.runtime.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+
+
+class EventType(str, enum.Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+class Event(NamedTuple):
+    type: EventType
+    obj: Any
+
+
+def _key(obj: Any) -> tuple[str, str]:
+    return (obj.meta.namespace, obj.meta.name)
+
+
+def matches_labels(obj: Any, selector: dict[str, str] | None) -> bool:
+    if not selector:
+        return True
+    labels = obj.meta.labels
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Watcher:
+    """A subscription to store events; iterate or poll with timeout."""
+
+    def __init__(self, kinds: set[str] | None, selector: dict[str, str] | None):
+        self.kinds = kinds
+        self.selector = selector
+        self.queue: "queue.Queue[Event]" = queue.Queue()
+        self.closed = False
+
+    def _offer(self, event: Event) -> None:
+        if self.closed:
+            return
+        if self.kinds is not None and event.obj.KIND not in self.kinds:
+            return
+        if not matches_labels(event.obj, self.selector):
+            return
+        self.queue.put(event)
+
+    def poll(self, timeout: float | None = 0.5) -> Event | None:
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[tuple[str, str], Any]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: list[Watcher] = []
+
+    # ---- watch ----
+
+    def watch(self, kinds: Iterable[str] | None = None,
+              selector: dict[str, str] | None = None) -> Watcher:
+        w = Watcher(set(kinds) if kinds is not None else None, selector)
+        with self._lock:
+            self._watchers.append(w)
+        return w
+
+    def _emit(self, etype: EventType, obj: Any) -> None:
+        for w in self._watchers:
+            w._offer(Event(etype, clone(obj)))
+
+    # ---- reads ----
+
+    def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
+        with self._lock:
+            objs = self._objects.get(kind_cls.KIND, {})
+            obj = objs.get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind_cls.KIND} {namespace}/{name} not found")
+            return clone(obj)
+
+    def list(self, kind_cls: type, namespace: str | None = "default",
+             selector: dict[str, str] | None = None) -> list[Any]:
+        with self._lock:
+            objs = self._objects.get(kind_cls.KIND, {})
+            out = []
+            for (ns, _), obj in objs.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if matches_labels(obj, selector):
+                    out.append(clone(obj))
+            out.sort(key=lambda o: o.meta.name)
+            return out
+
+    # ---- writes ----
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            kind = obj.KIND
+            objs = self._objects.setdefault(kind, {})
+            key = _key(obj)
+            if key in objs:
+                raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} exists")
+            stored = clone(obj)
+            if not stored.meta.uid:
+                stored.meta.uid = str(uuid.uuid4())
+            if not stored.meta.creation_timestamp:
+                stored.meta.creation_timestamp = time.time()
+            stored.meta.resource_version = next(self._rv)
+            stored.meta.generation = 1
+            objs[key] = stored
+            self._emit(EventType.ADDED, stored)
+            return clone(stored)
+
+    def _get_live(self, obj: Any) -> Any:
+        objs = self._objects.get(obj.KIND, {})
+        live = objs.get(_key(obj))
+        if live is None:
+            ns, name = _key(obj)
+            raise NotFoundError(f"{obj.KIND} {ns}/{name} not found")
+        return live
+
+    def update(self, obj: Any) -> Any:
+        """Full update (spec+meta). Bumps generation when spec changed."""
+        with self._lock:
+            live = self._get_live(obj)
+            if obj.meta.resource_version != live.meta.resource_version:
+                raise ConflictError(
+                    f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
+                    f"resource_version {obj.meta.resource_version} != "
+                    f"{live.meta.resource_version}")
+            stored = clone(obj)
+            stored.meta.uid = live.meta.uid
+            stored.meta.creation_timestamp = live.meta.creation_timestamp
+            stored.meta.generation = live.meta.generation
+            if hasattr(live, "spec") and to_dict(live.spec) != to_dict(stored.spec):
+                stored.meta.generation += 1
+            stored.meta.resource_version = next(self._rv)
+            self._objects[obj.KIND][_key(obj)] = stored
+            self._emit(EventType.MODIFIED, stored)
+            if stored.meta.deletion_timestamp and not stored.meta.finalizers:
+                self._remove(stored)
+            return clone(stored)
+
+    def update_status(self, obj: Any) -> Any:
+        """Status-only update: ignores spec/meta edits in ``obj``.
+
+        No-op writes (byte-identical status) are suppressed: reconcilers
+        watch their own kinds and recompute status on every event, so
+        un-suppressed no-op writes would self-trigger a reconcile hot loop
+        at steady state.
+        """
+        with self._lock:
+            live = self._get_live(obj)
+            if obj.meta.resource_version != live.meta.resource_version:
+                raise ConflictError(
+                    f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
+                    f"resource_version (status)")
+            if to_dict(obj.status) == to_dict(live.status):
+                return clone(live)
+            stored = clone(live)
+            stored.status = clone(obj.status)
+            stored.meta.resource_version = next(self._rv)
+            self._objects[obj.KIND][_key(obj)] = stored
+            self._emit(EventType.MODIFIED, stored)
+            return clone(stored)
+
+    def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
+        """Finalizer-aware delete: marks for deletion if finalizers remain,
+        removes (and cascades to owned objects) otherwise."""
+        with self._lock:
+            objs = self._objects.get(kind_cls.KIND, {})
+            obj = objs.get((namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind_cls.KIND} {namespace}/{name} not found")
+            if obj.meta.finalizers:
+                if obj.meta.deletion_timestamp is None:
+                    obj.meta.deletion_timestamp = time.time()
+                    obj.meta.resource_version = next(self._rv)
+                    self._emit(EventType.MODIFIED, obj)
+                return
+            self._remove(obj)
+
+    def _remove(self, obj: Any) -> None:
+        """Unconditional removal + owner-reference cascade (GC analog)."""
+        self._objects[obj.KIND].pop(_key(obj), None)
+        self._emit(EventType.DELETED, obj)
+        # Cascade: anything owned (controller ref) by this uid gets deleted.
+        uid = obj.meta.uid
+        dependents = [
+            o for kind_objs in self._objects.values()
+            for o in list(kind_objs.values())
+            if any(ref.uid == uid for ref in o.meta.owner_references)
+        ]
+        for dep in dependents:
+            if dep.meta.finalizers:
+                if dep.meta.deletion_timestamp is None:
+                    dep.meta.deletion_timestamp = time.time()
+                    dep.meta.resource_version = next(self._rv)
+                    self._emit(EventType.MODIFIED, dep)
+            else:
+                self._remove(dep)

@@ -1,0 +1,11 @@
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec, create_fleet
+from grove_tpu.topology.tpu import TPU_GENERATIONS, TpuGeneration, slice_hosts
+
+__all__ = [
+    "FleetSpec",
+    "SliceSpec",
+    "create_fleet",
+    "TPU_GENERATIONS",
+    "TpuGeneration",
+    "slice_hosts",
+]

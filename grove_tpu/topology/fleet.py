@@ -1,0 +1,68 @@
+"""Fleet provisioning: materialise Node objects for TPU slices.
+
+The KWOK-analog capacity source (SURVEY.md §4: fake nodes for control-
+plane testing at scale): a FleetSpec describes pools of slices; create_fleet
+writes the Node objects with the full TPU label schema so schedulers see
+exactly what a GKE TPU node pool would expose. Real (subprocess-running)
+nodes use the same labels with spec.fake=False.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from grove_tpu.api import Node, new_meta
+from grove_tpu.api import constants as c
+from grove_tpu.api.core import NodeSpec, NodeStatus
+from grove_tpu.store.client import Client
+from grove_tpu.topology.tpu import TPU_GENERATIONS, slice_hosts
+
+
+@dataclasses.dataclass
+class SliceSpec:
+    generation: str = "v5e"
+    topology: str = "4x4"        # ICI mesh shape, e.g. "4x8" = 32 chips
+    count: int = 1               # how many such slices
+    pool: str = "pool-0"
+    superblock: str = ""         # defaults to pool
+
+
+@dataclasses.dataclass
+class FleetSpec:
+    slices: list[SliceSpec] = dataclasses.field(default_factory=list)
+    fake: bool = True
+
+
+def node_name(slice_name: str, worker: int) -> str:
+    return f"{slice_name}-w{worker}"
+
+
+def create_fleet(client: Client, fleet: FleetSpec,
+                 namespace: str = "default") -> list[Node]:
+    """Create Node objects for every host of every slice in the fleet."""
+    nodes: list[Node] = []
+    slice_seq = 0
+    for spec in fleet.slices:
+        gen = TPU_GENERATIONS[spec.generation]
+        hosts = slice_hosts(spec.generation, spec.topology)
+        for _ in range(spec.count):
+            slice_name = f"{spec.pool}-slice-{slice_seq}"
+            slice_seq += 1
+            for w in range(hosts):
+                name = node_name(slice_name, w)
+                node = Node(
+                    meta=new_meta(name, namespace=namespace, labels={
+                        c.NODE_LABEL_TPU_ACCELERATOR: f"tpu-{spec.generation}",
+                        c.NODE_LABEL_TPU_TOPOLOGY: spec.topology,
+                        c.NODE_LABEL_SLICE: slice_name,
+                        c.NODE_LABEL_SLICE_WORKER: str(w),
+                        c.NODE_LABEL_POOL: spec.pool,
+                        c.NODE_LABEL_SUPERBLOCK: spec.superblock or spec.pool,
+                        c.NODE_LABEL_HOST: name,
+                    }),
+                    spec=NodeSpec(tpu_chips=gen.chips_per_host, fake=fleet.fake),
+                    status=NodeStatus(ready=True,
+                                      allocatable_chips=gen.chips_per_host),
+                )
+                nodes.append(client.create(node))
+    return nodes

@@ -1,0 +1,56 @@
+"""TPU hardware model: generations, slice shapes, host counts.
+
+The placement-relevant facts about TPU fleets (public GKE/Cloud TPU
+topology semantics): a slice is one ICI-connected mesh described by a
+topology string like "4x8" (v5e, 2D) or "4x4x8" (v5p, 3D torus); hosts
+own 4 chips (v5e/v6e) or 4 chips across 2 trays (v5p: 4 chips/host); DCN
+connects slices. Gang placement must treat the slice as atomic for ICI
+collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    name: str
+    chips_per_host: int
+    dims: int                  # topology dimensionality (2 or 3)
+    hbm_gb_per_chip: int
+    max_slice_chips: int
+
+
+TPU_GENERATIONS: dict[str, TpuGeneration] = {
+    "v5e": TpuGeneration("v5e", 4, 2, 16, 256),
+    "v6e": TpuGeneration("v6e", 4, 2, 32, 256),
+    "v5p": TpuGeneration("v5p", 4, 3, 95, 8960),
+}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """'4x8' -> (4, 8); '4x4x8' -> (4, 4, 8)."""
+    try:
+        dims = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError as e:
+        raise ValueError(f"bad topology string {topology!r}") from e
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"bad topology string {topology!r}")
+    return dims
+
+
+def topology_chips(topology: str) -> int:
+    return math.prod(parse_topology(topology))
+
+
+def slice_hosts(generation: str, topology: str) -> int:
+    """Number of hosts (TPU VMs / workers) in a slice."""
+    gen = TPU_GENERATIONS[generation]
+    chips = topology_chips(topology)
+    if chips % gen.chips_per_host and chips >= gen.chips_per_host:
+        raise ValueError(
+            f"{generation} slice {topology}: {chips} chips not divisible by "
+            f"{gen.chips_per_host} chips/host")
+    return max(1, chips // gen.chips_per_host)

@@ -1,0 +1,137 @@
+"""E2E: scaling-group (disaggregated) workloads + steady-state quiescence.
+
+Covers the two systemic failure modes found in review: the PCS controller
+fighting the PCSG controller over member PCLQs, and no-op status writes
+self-triggering reconciles forever (the reference's steady-state-reconcile
+scale-test phase, scale_test.go:216-240, exists to catch exactly this).
+"""
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Pod,
+    PodClique,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    HeadlessServiceConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    TopologyConstraint,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+def disagg_pcs(name="disagg", sg_replicas=2, sg_min=1):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(
+            replicas=1,
+            template=PodCliqueSetTemplate(
+                cliques=[
+                    PodCliqueTemplate(
+                        name="frontend", replicas=1, min_available=1,
+                        tpu_chips_per_pod=0,
+                        starts_after=["prefill", "decode"],
+                        container=ContainerSpec(argv=["sleep", "inf"])),
+                    PodCliqueTemplate(
+                        name="prefill", replicas=2, min_available=2,
+                        tpu_chips_per_pod=4,
+                        container=ContainerSpec(argv=["sleep", "inf"])),
+                    PodCliqueTemplate(
+                        name="decode", replicas=2, min_available=2,
+                        tpu_chips_per_pod=4,
+                        container=ContainerSpec(argv=["sleep", "inf"])),
+                ],
+                scaling_groups=[ScalingGroupConfig(
+                    name="model", clique_names=["prefill", "decode"],
+                    replicas=sg_replicas, min_available=sg_min)],
+                headless_service=HeadlessServiceConfig(),
+                topology=TopologyConstraint(pack_level="slice", required=True),
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=4)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def test_disagg_converges_and_stays_stable(cluster):
+    client = cluster.client
+    client.create(disagg_pcs())
+
+    def available():
+        return client.get(PodCliqueSet, "disagg").status.available_replicas == 1
+
+    wait_for(available, timeout=15.0, desc="disagg available")
+
+    # Convergence must be *stable*: the same PCLQ objects persist (no
+    # controller fight recreating them) once the system settles.
+    assert cluster.manager.wait_idle(timeout=15.0, settle=0.5), \
+        "controllers never went idle"
+    pclqs_before = {q.meta.name: q.meta.uid for q in client.list(PodClique)}
+    pods_before = {p.meta.name: p.meta.uid for p in client.list(Pod)}
+    time.sleep(1.0)
+    pclqs_after = {q.meta.name: q.meta.uid for q in client.list(PodClique)}
+    pods_after = {p.meta.name: p.meta.uid for p in client.list(Pod)}
+    assert pclqs_before == pclqs_after, "PCLQ churn at steady state"
+    assert pods_before == pods_after, "pod churn at steady state"
+    assert available()
+
+    # 1 frontend + 2 model replicas x (2 prefill + 2 decode) = 9 pods
+    assert len(pods_after) == 9
+
+    # startup order: the frontend waits for the gang-guaranteed model
+    # replica (PCSG replica 0); scaled replicas (>= min_available) may
+    # start later and must not hold it up.
+    frontend = client.get(Pod, "disagg-0-frontend-0")
+    assert frontend.spec.startup_barrier is not None
+    base_workers = [p for p in client.list(Pod)
+                    if p.meta.labels.get(c.LABEL_PCSG_REPLICA) == "0"]
+    assert len(base_workers) == 4
+    assert frontend.status.start_time >= max(
+        w.status.start_time for w in base_workers) - 1e-3
+
+    # scaled gang landed on a different slice than the base gang
+    base = client.get(PodGang, "disagg-0")
+    scaled = client.get(PodGang, "disagg-0-model-1")
+    assert base.status.assigned_slice
+    assert scaled.status.assigned_slice
+    assert base.status.assigned_slice != scaled.status.assigned_slice
+
+
+def test_steady_state_reconcile_cost_bounded(cluster):
+    """After convergence the control plane must go quiet (the reference
+    profiles exactly this window; a hot loop here burns a CPU forever)."""
+    client = cluster.client
+    client.create(simple_pcs(name="quiet"))
+    wait_for(lambda: client.get(
+        PodCliqueSet, "quiet").status.available_replicas == 1,
+        desc="available")
+    assert cluster.manager.wait_idle(timeout=10.0, settle=0.5)
+    before = {name: v["reconciles"] for name, v in
+              cluster.manager.healthz()["controllers"].items()}
+    time.sleep(2.0)
+    after = {name: v["reconciles"] for name, v in
+             cluster.manager.healthz()["controllers"].items()}
+    drift = {k: after[k] - before[k] for k in after}
+    assert all(v <= 5 for v in drift.values()), \
+        f"steady-state reconcile churn: {drift}"

@@ -1,0 +1,151 @@
+"""End-to-end: PodCliqueSet → gated pods → gang placement → Ready.
+
+The driver-config-1 equivalent of the reference's samples/simple/
+simple1.yaml on a kind cluster (SURVEY.md §7 stage 3), running against
+the in-process control plane with a fake (KWOK-analog) TPU fleet.
+"""
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Pod,
+    PodClique,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    HeadlessServiceConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+
+def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def simple_pcs(name="simple1", replicas=1, pods=3, chips=4):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="workers",
+                    replicas=pods,
+                    min_available=pods,
+                    container=ContainerSpec(argv=["sleep", "inf"]),
+                    tpu_chips_per_pod=chips,
+                )],
+                headless_service=HeadlessServiceConfig(),
+                topology=TopologyConstraint(pack_level="slice", required=True),
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])  # 2 slices x 4 hosts
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def test_simple_pcs_reaches_ready(cluster):
+    client = cluster.client
+    client.create(simple_pcs())
+
+    def all_ready():
+        pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "simple1"})
+        return len(pods) == 3 and all(
+            is_condition_true(p.status.conditions, c.COND_READY) for p in pods)
+
+    wait_for(all_ready, desc="3 ready pods")
+
+    # Gang landed slice-atomically: all pods on hosts of one slice.
+    pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "simple1"})
+    slices = {p.status.node_name.rsplit("-w", 1)[0] for p in pods}
+    assert len(slices) == 1, f"gang split across slices: {slices}"
+
+    # Gates were removed (not bypassed).
+    assert all(not p.spec.scheduling_gates for p in pods)
+
+    # Env contract on every pod.
+    env = pods[0].spec.container.env
+    assert env[c.ENV_PCS_NAME] == "simple1"
+    assert env[c.ENV_TPU_WORKER_HOSTNAMES].count(",") == 2
+    assert {p.spec.container.env[c.ENV_TPU_WORKER_ID] for p in pods} == \
+        {"0", "1", "2"}
+
+    # PodGang went Running; PCLQ and PCS statuses aggregated.
+    wait_for(lambda: client.get(PodGang, "simple1-0").status.phase.value
+             == "Running", desc="gang Running")
+    wait_for(lambda: client.get(
+        PodClique, "simple1-0-workers").status.ready_replicas == 3,
+        desc="pclq status")
+    wait_for(lambda: client.get(
+        PodCliqueSet, "simple1").status.available_replicas == 1,
+        desc="pcs Available")
+
+
+def test_gang_does_not_fit_stays_pending(cluster):
+    """A gang needing more chips than any slice holds must never be
+    partially placed (slice atomicity)."""
+    client = cluster.client
+    client.create(simple_pcs(name="toobig", pods=5, chips=4))  # 20 chips > 16
+
+    time.sleep(1.0)
+    pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "toobig"})
+    assert len(pods) == 5
+    assert all(not p.status.node_name for p in pods), "partial placement!"
+    gang = client.get(PodGang, "toobig-0")
+    assert not is_condition_true(gang.status.conditions, c.COND_SCHEDULED)
+
+
+def test_two_replicas_spread_over_slices(cluster):
+    """PCS replicas (multislice DP) spread across slices over DCN."""
+    client = cluster.client
+    client.create(simple_pcs(name="spread", replicas=2, pods=2, chips=4))
+
+    def both_placed():
+        pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "spread"})
+        return len(pods) == 4 and all(p.status.node_name for p in pods)
+
+    wait_for(both_placed, desc="all pods placed")
+    pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "spread"})
+    by_replica = {}
+    for p in pods:
+        r = p.meta.labels[c.LABEL_PCS_REPLICA]
+        by_replica.setdefault(r, set()).add(
+            p.status.node_name.rsplit("-w", 1)[0])
+    assert all(len(s) == 1 for s in by_replica.values())
+    assert by_replica["0"] != by_replica["1"], "replicas packed onto one slice"
+
+
+def test_pcs_delete_cascades(cluster):
+    client = cluster.client
+    client.create(simple_pcs(name="gone"))
+    wait_for(lambda: len(client.list(Pod, selector={
+        c.LABEL_PCS_NAME: "gone"})) == 3, desc="pods created")
+    client.delete(PodCliqueSet, "gone")
+    wait_for(lambda: not client.list(Pod, selector={
+        c.LABEL_PCS_NAME: "gone"}), desc="pods cascaded away")
+    wait_for(lambda: not client.list(PodGang, selector={
+        c.LABEL_PCS_NAME: "gone"}), desc="gangs cascaded away")

@@ -1,0 +1,168 @@
+"""Controller runtime: workqueue semantics, backoff, watch-driven
+reconciles, expectations, slow-start, hashing, index reuse."""
+
+import threading
+import time
+
+import pytest
+
+from grove_tpu.api import Pod, new_meta
+from grove_tpu.runtime.concurrent import run_with_slow_start
+from grove_tpu.runtime.controller import Controller, Request, self_requests
+from grove_tpu.runtime.expectations import ExpectationsStore
+from grove_tpu.runtime.flow import StepResult, run_steps
+from grove_tpu.runtime.hashutil import compute_hash
+from grove_tpu.runtime.indextracker import available_indices
+from grove_tpu.runtime.manager import Manager
+from grove_tpu.store import FakeClient
+
+
+def test_flow_short_circuit():
+    calls = []
+    result = run_steps(
+        lambda: calls.append("a") or StepResult.ok(),
+        lambda: StepResult.requeue(1.5),
+        lambda: calls.append("never"),
+    )
+    assert calls == ["a"]
+    assert result.requeue_after == 1.5
+
+
+def test_expectations():
+    e = ExpectationsStore(ttl_seconds=0.2)
+    e.expect_creates("k", ["u1", "u2"])
+    assert not e.satisfied("k")
+    e.observe_create("k", "u1")
+    assert not e.satisfied("k")
+    e.observe_create("k", "u2")
+    assert e.satisfied("k")
+    # ttl expiry path
+    e.expect_deletes("k2", ["u3"])
+    assert not e.satisfied("k2")
+    time.sleep(0.25)
+    assert e.satisfied("k2")
+
+
+def test_slow_start_stops_on_failure():
+    attempts = []
+
+    def ok():
+        attempts.append("ok")
+
+    def bad():
+        attempts.append("bad")
+        raise RuntimeError("x")
+
+    done, errors = run_with_slow_start([ok, bad, ok, ok, ok])
+    # batch1=[ok] batch2=[bad, ok] -> stop; batches 3+ never run
+    assert done == 2 and len(errors) == 1
+    assert len(attempts) == 3
+
+
+def test_hash_stability():
+    pod = Pod(meta=new_meta("a"))
+    h1 = compute_hash(pod.spec)
+    pod2 = Pod(meta=new_meta("a"))
+    assert compute_hash(pod2.spec) == h1
+    pod2.spec.tpu_chips = 4
+    assert compute_hash(pod2.spec) != h1
+
+
+def test_available_indices_reuses_holes():
+    assert available_indices([0, 2, 5], 3) == [1, 3, 4]
+    assert available_indices([], 2) == [0, 1]
+
+
+def test_controller_reconciles_on_watch_event():
+    client = FakeClient()
+    seen = []
+    done = threading.Event()
+
+    def reconcile(req: Request):
+        seen.append(req)
+        done.set()
+        return StepResult.finished()
+
+    c = Controller("test", client, reconcile, workers=1)
+    c.watches(["Pod"], self_requests)
+    mgr = Manager(client=client, store=client.store)
+    mgr.add_controller(c)
+    mgr.start()
+    try:
+        client.create(Pod(meta=new_meta("p1")))
+        assert done.wait(5.0), "reconcile never ran"
+        assert seen[0] == Request("default", "p1")
+        assert mgr.wait_idle(5.0)
+        health = mgr.healthz()
+        assert health["controllers"]["test"]["reconciles"] >= 1
+    finally:
+        mgr.stop()
+
+
+def test_controller_backoff_retries_failures():
+    client = FakeClient()
+    counts = {"n": 0}
+    succeeded = threading.Event()
+
+    def reconcile(req: Request):
+        counts["n"] += 1
+        if counts["n"] < 3:
+            return StepResult.fail(RuntimeError("transient"))
+        succeeded.set()
+        return StepResult.finished()
+
+    c = Controller("retry", client, reconcile, workers=1,
+                   backoff_base=0.01, backoff_max=0.05)
+    c.start()
+    try:
+        c.enqueue(Request("default", "x"))
+        assert succeeded.wait(5.0), f"only {counts['n']} attempts"
+        assert counts["n"] == 3
+    finally:
+        c.stop()
+
+
+def test_watch_event_accelerates_backoff():
+    """An immediate add must override a pending delayed entry (a watch
+    event cuts short a backoff window, k8s workqueue semantics)."""
+    client = FakeClient()
+    processed = threading.Event()
+
+    c = Controller("accel", client, lambda req: (processed.set(),
+                                                 StepResult.finished())[1],
+                   workers=1)
+    c.start()
+    try:
+        c.enqueue(Request("default", "x"), delay=5.0)
+        time.sleep(0.05)
+        c.enqueue(Request("default", "x"), delay=0.0)
+        t0 = time.time()
+        assert processed.wait(2.0), "request stuck behind backoff entry"
+        assert time.time() - t0 < 1.0
+    finally:
+        c.stop()
+
+
+def test_queue_dedupes_pending():
+    client = FakeClient()
+    block = threading.Event()
+    processed = []
+
+    def reconcile(req: Request):
+        processed.append(req)
+        block.wait(2.0)
+        return StepResult.finished()
+
+    c = Controller("dedupe", client, reconcile, workers=1)
+    c.start()
+    try:
+        # first request occupies the worker; the rest dedupe to one pending
+        c.enqueue(Request("default", "busy"))
+        time.sleep(0.1)
+        for _ in range(5):
+            c.enqueue(Request("default", "later"))
+        block.set()
+        time.sleep(0.5)
+        assert processed.count(Request("default", "later")) == 1
+    finally:
+        c.stop()

@@ -1,0 +1,109 @@
+"""Store semantics: optimistic concurrency, finalizers, cascade GC, watch."""
+
+import pytest
+
+from grove_tpu.api import Pod, PodClique, new_meta
+from grove_tpu.api.meta import OwnerReference
+from grove_tpu.runtime.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from grove_tpu.store import EventType, FakeClient, Store
+
+
+def make_pod(name, labels=None):
+    return Pod(meta=new_meta(name, labels=labels))
+
+
+def test_create_get_list_delete():
+    s = Store()
+    s.create(make_pod("a", {"role": "x"}))
+    s.create(make_pod("b", {"role": "y"}))
+    assert s.get(Pod, "a").meta.name == "a"
+    assert [p.meta.name for p in s.list(Pod)] == ["a", "b"]
+    assert [p.meta.name for p in s.list(Pod, selector={"role": "y"})] == ["b"]
+    with pytest.raises(AlreadyExistsError):
+        s.create(make_pod("a"))
+    s.delete(Pod, "a")
+    with pytest.raises(NotFoundError):
+        s.get(Pod, "a")
+
+
+def test_update_conflict_and_generation():
+    s = Store()
+    pod = s.create(make_pod("a"))
+    assert pod.meta.generation == 1
+    stale = s.get(Pod, "a")
+    pod.spec.tpu_chips = 4
+    updated = s.update(pod)
+    assert updated.meta.generation == 2
+    stale.spec.tpu_chips = 8
+    with pytest.raises(ConflictError):
+        s.update(stale)
+    # status update does not bump generation
+    updated.status.message = "hi"
+    after = s.update_status(updated)
+    assert after.meta.generation == 2
+    assert after.status.message == "hi"
+
+
+def test_store_isolation():
+    """Mutating a returned object must not affect stored state."""
+    s = Store()
+    s.create(make_pod("a"))
+    got = s.get(Pod, "a")
+    got.meta.labels["hacked"] = "yes"
+    assert "hacked" not in s.get(Pod, "a").meta.labels
+
+
+def test_finalizer_delete_flow():
+    s = Store()
+    pod = make_pod("a")
+    pod.meta.finalizers = ["grove.tpu/test"]
+    pod = s.create(pod)
+    s.delete(Pod, "a")
+    live = s.get(Pod, "a")  # still present, marked
+    assert live.meta.deletion_timestamp is not None
+    live.meta.finalizers = []
+    s.update(live)          # clearing finalizers completes deletion
+    with pytest.raises(NotFoundError):
+        s.get(Pod, "a")
+
+
+def test_cascade_delete_owned():
+    s = Store()
+    pclq = s.create(PodClique(meta=new_meta("clq")))
+    child = make_pod("clq-0")
+    child.meta.owner_references = [OwnerReference(
+        kind="PodClique", name="clq", uid=pclq.meta.uid)]
+    s.create(child)
+    s.delete(PodClique, "clq")
+    with pytest.raises(NotFoundError):
+        s.get(Pod, "clq-0")
+
+
+def test_watch_events():
+    s = Store()
+    w = s.watch(kinds=["Pod"])
+    pod = s.create(make_pod("a"))
+    pod.spec.tpu_chips = 1
+    s.update(pod)
+    s.delete(Pod, "a")
+    events = [w.poll(0.1) for _ in range(3)]
+    assert [e.type for e in events] == [
+        EventType.ADDED, EventType.MODIFIED, EventType.DELETED]
+    # selector-filtered watcher sees nothing for non-matching pods
+    w2 = s.watch(kinds=["Pod"], selector={"role": "x"})
+    s.create(make_pod("b"))
+    assert w2.poll(0.05) is None
+
+
+def test_fake_client_error_injection():
+    c = FakeClient()
+    c.create(make_pod("a"))
+    c.inject_error("get", ConflictError("boom"), kind="Pod", times=1)
+    with pytest.raises(ConflictError):
+        c.get(Pod, "a")
+    assert c.get(Pod, "a").meta.name == "a"   # injected error consumed
+    assert ("create", "Pod", "a") in c.calls()
