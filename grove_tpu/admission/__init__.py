@@ -1,0 +1,10 @@
+from grove_tpu.admission.chain import AdmissionChain, install_admission
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import validate_podcliqueset
+
+__all__ = [
+    "AdmissionChain",
+    "install_admission",
+    "default_podcliqueset",
+    "validate_podcliqueset",
+]

@@ -1,0 +1,38 @@
+"""Authorization admission — who may mutate grove-managed resources.
+
+Role parity with reference admission/pcs/authorization/handler.go:40: when
+enabled, only the operator service account (and configured exempt actors)
+may mutate resources the operator manages (children carrying the
+managed-by label); users manage the world through the PodCliqueSet spec,
+never by poking its children.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import constants as c
+from grove_tpu.api.config import AuthorizerConfig
+
+OPERATOR_ACTOR = "system:grove-operator"
+NODE_ACTOR = "system:node-agent"
+SCHEDULER_ACTOR = "system:scheduler"
+
+_SYSTEM_ACTORS = {OPERATOR_ACTOR, NODE_ACTOR, SCHEDULER_ACTOR}
+
+# Kinds users declare themselves (never operator-managed at the top level)
+_USER_KINDS = {"PodCliqueSet", "ClusterTopology", "Node"}
+
+
+def authorize(config: AuthorizerConfig, actor: str, verb: str,
+              obj) -> str | None:
+    """Return a denial message, or None to admit."""
+    if not config.enabled:
+        return None
+    if actor in _SYSTEM_ACTORS or actor in config.exempt_actors:
+        return None
+    if obj.KIND in _USER_KINDS:
+        return None
+    if obj.meta.labels.get(c.LABEL_MANAGED_BY) == c.LABEL_MANAGED_BY_VALUE:
+        return (f"actor {actor!r} may not {verb} grove-managed "
+                f"{obj.KIND} {obj.meta.name!r}; edit the owning "
+                "PodCliqueSet instead")
+    return None

@@ -1,0 +1,60 @@
+"""Admission chain — the in-process webhook pipeline.
+
+The reference receives admission over HTTPS from the apiserver (L5);
+this control plane owns its store, so admission installs as a write hook:
+every create/update passes defaulting → validation → authorization before
+commit. Same guarantees, no TLS plumbing (the cert-manager component C6
+becomes moot by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from grove_tpu.admission.authorization import authorize
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import (
+    validate_clustertopology,
+    validate_podcliqueset,
+)
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.runtime.errors import ForbiddenError, ValidationError
+from grove_tpu.scheduler.framework import Registry
+
+
+class AdmissionChain:
+    def __init__(self, config: OperatorConfiguration,
+                 registry: Registry | None = None):
+        self.config = config
+        self.registry = registry
+
+    def admit(self, verb: str, obj: Any, old: Any, actor: str) -> Any:
+        """Mutate (defaulting) and validate; raise on rejection."""
+        denial = authorize(self.config.authorizer, actor, verb, obj)
+        if denial:
+            raise ForbiddenError(denial, operation=f"admission/{verb}")
+        if verb not in ("create", "update"):
+            return obj
+        if obj.KIND == "PodCliqueSet":
+            obj = default_podcliqueset(obj)
+            problems = validate_podcliqueset(obj, self.registry, old)
+            if problems:
+                raise ValidationError(
+                    f"PodCliqueSet {obj.meta.name!r} rejected: "
+                    + "; ".join(problems),
+                    operation=f"admission/{verb}")
+        elif obj.KIND == "ClusterTopology":
+            problems = validate_clustertopology(obj)
+            if problems:
+                raise ValidationError(
+                    f"ClusterTopology {obj.meta.name!r} rejected: "
+                    + "; ".join(problems),
+                    operation=f"admission/{verb}")
+        return obj
+
+
+def install_admission(store, config: OperatorConfiguration,
+                      registry: Registry | None = None) -> AdmissionChain:
+    chain = AdmissionChain(config, registry)
+    store.set_admission(chain)
+    return chain

@@ -1,0 +1,65 @@
+"""Defaulting admission — fills omitted PodCliqueSet fields.
+
+Role parity with reference admission/pcs/defaulting/podcliqueset.go
+(912 LoC): replicas, min_available, startup type, termination delay (4h),
+scheduler profile. TPU-first default: a template whose cliques request
+chips gets required slice packing unless the user says otherwise — on
+TPU, a gang that straddles slices cannot form ICI collectives, so
+"packed" is the only sane default.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import constants as c
+from grove_tpu.api.podcliqueset import (
+    HeadlessServiceConfig,
+    PodCliqueSet,
+    StartupType,
+    TopologyConstraint,
+)
+
+
+def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
+    spec = pcs.spec
+    if spec.replicas < 1:
+        spec.replicas = 1
+    tmpl = spec.template
+    if tmpl.startup_type is None:
+        tmpl.startup_type = StartupType.ANY_ORDER
+    if tmpl.termination_delay_seconds is None:
+        tmpl.termination_delay_seconds = c.DEFAULT_TERMINATION_DELAY_SECONDS
+    if tmpl.headless_service is None:
+        tmpl.headless_service = HeadlessServiceConfig()
+    uses_tpu = any(t.tpu_chips_per_pod > 0 for t in tmpl.cliques)
+    if tmpl.topology is None and uses_tpu:
+        tmpl.topology = TopologyConstraint(pack_level="slice", required=True)
+    for t in tmpl.cliques:
+        if t.replicas < 1:
+            t.replicas = 1
+        if t.auto_scaling is not None:
+            a = t.auto_scaling
+            if a.min_replicas < 1:
+                a.min_replicas = 1
+            if a.max_replicas < a.min_replicas:
+                a.max_replicas = a.min_replicas
+        if t.min_available is None:
+            # Autoscaled cliques default their gang floor to the scaling
+            # floor (so scale-in below the initial replica count works);
+            # fixed cliques default to all-replicas-required.
+            if t.auto_scaling is not None:
+                t.min_available = max(1, min(t.auto_scaling.min_replicas,
+                                             t.replicas))
+            else:
+                t.min_available = t.replicas
+    for sg in tmpl.scaling_groups:
+        if sg.replicas < 1:
+            sg.replicas = 1
+        if sg.min_available is None:
+            sg.min_available = 1  # one gang-guaranteed instance; rest elastic
+        if sg.auto_scaling is not None:
+            a = sg.auto_scaling
+            if a.min_replicas < 1:
+                a.min_replicas = 1
+            if a.max_replicas < a.min_replicas:
+                a.max_replicas = a.min_replicas
+    return pcs

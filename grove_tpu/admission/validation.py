@@ -1,0 +1,239 @@
+"""Validation admission — the PodCliqueSet rule set.
+
+Role parity with reference admission/pcs/validation/ (6,289 LoC across 13
+files), the rules that shape every downstream object:
+
+- structural: names, replica/min_available bounds, uniqueness
+- startup DAG: StartsAfter references exist and form a DAG (cycle
+  detection via Tarjan SCC, reference podcliquedeps.go:53)
+- topology: levels must exist in the hierarchy; child constraints must be
+  at least as strict as the parent's (reference topologyconstraints.go)
+- scaling groups: member cliques exist, belong to exactly one group
+- update immutability: startup type, clique set, scaling-group membership
+- scheduler-specific checks via Backend.validate_pcs
+"""
+
+from __future__ import annotations
+
+import re
+
+from grove_tpu.api.clustertopology import ClusterTopology, DEFAULT_TPU_LEVELS
+from grove_tpu.api.podcliqueset import PodCliqueSet, TopologyConstraint
+from grove_tpu.scheduler.framework import Registry
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?$")
+
+_LEVELS = [lvl.domain for lvl in DEFAULT_TPU_LEVELS]  # outer -> inner
+
+
+def _level_index(level: str) -> int:
+    return _LEVELS.index(level)
+
+
+def tarjan_sccs(graph: dict[str, list[str]]) -> list[list[str]]:
+    """Tarjan strongly-connected components (iterative)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(graph.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in graph:
+                    continue
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+    for node in graph:
+        if node not in index:
+            strongconnect(node)
+    return sccs
+
+
+def _validate_topology(field: str, topo: TopologyConstraint | None,
+                       parent: TopologyConstraint | None,
+                       errs: list[str]) -> None:
+    if topo is None:
+        return
+    if topo.pack_level and topo.pack_level not in _LEVELS:
+        errs.append(f"{field}.pack_level: unknown level {topo.pack_level!r}; "
+                    f"levels: {_LEVELS}")
+    if topo.spread_level and topo.spread_level not in _LEVELS:
+        errs.append(f"{field}.spread_level: unknown level "
+                    f"{topo.spread_level!r}; levels: {_LEVELS}")
+    if (parent is not None and parent.pack_level and topo.pack_level
+            and _level_index(topo.pack_level) < _level_index(parent.pack_level)):
+        # child packs at an outer (looser) level than the parent demands
+        errs.append(
+            f"{field}.pack_level {topo.pack_level!r} is looser than the "
+            f"template constraint {parent.pack_level!r} (child must be at "
+            "least as strict)")
+
+
+def validate_podcliqueset(pcs: PodCliqueSet,
+                          registry: Registry | None = None,
+                          old: PodCliqueSet | None = None) -> list[str]:
+    """Return all problems (empty == admitted)."""
+    errs: list[str] = []
+    if not _NAME_RE.match(pcs.meta.name):
+        errs.append(f"metadata.name {pcs.meta.name!r} must be DNS-label-like "
+                    "(lowercase alphanumerics and '-', <= 52 chars)")
+    spec = pcs.spec
+    tmpl = spec.template
+    if spec.replicas < 1:
+        errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
+    if not tmpl.cliques:
+        errs.append("spec.template.cliques must not be empty")
+
+    names = [t.name for t in tmpl.cliques]
+    if len(set(names)) != len(names):
+        errs.append(f"clique names must be unique: {names}")
+    for t in tmpl.cliques:
+        f = f"clique {t.name!r}"
+        if not _NAME_RE.match(t.name or ""):
+            errs.append(f"{f}: invalid name")
+        if t.replicas < 1:
+            errs.append(f"{f}: replicas must be >= 1")
+        if t.min_available is not None and not (
+                1 <= t.min_available <= t.replicas):
+            errs.append(f"{f}: min_available {t.min_available} outside "
+                        f"[1, {t.replicas}]")
+        if t.tpu_chips_per_pod < 0:
+            errs.append(f"{f}: tpu_chips_per_pod must be >= 0")
+        if t.auto_scaling is not None:
+            a = t.auto_scaling
+            if a.min_replicas > a.max_replicas:
+                errs.append(f"{f}: auto_scaling min {a.min_replicas} > max "
+                            f"{a.max_replicas}")
+            if t.min_available is not None and a.min_replicas < t.min_available:
+                errs.append(f"{f}: auto_scaling.min_replicas must be >= "
+                            f"min_available (the gang floor)")
+        _validate_topology(f + ".topology", t.topology, tmpl.topology, errs)
+
+    # startup DAG (reference podcliquedeps.go:53: Tarjan SCC)
+    known = set(names)
+    graph = {t.name: [] for t in tmpl.cliques}
+    for t in tmpl.cliques:
+        for dep in t.starts_after:
+            if dep == t.name:
+                errs.append(f"clique {t.name!r}: starts_after itself")
+            elif dep not in known:
+                errs.append(f"clique {t.name!r}: starts_after unknown clique "
+                            f"{dep!r}")
+            else:
+                graph[t.name].append(dep)
+    for scc in tarjan_sccs(graph):
+        if len(scc) > 1:
+            errs.append(f"starts_after cycle detected: {sorted(scc)}")
+
+    # scaling groups
+    sg_names = [sg.name for sg in tmpl.scaling_groups]
+    if len(set(sg_names)) != len(sg_names):
+        errs.append(f"scaling group names must be unique: {sg_names}")
+    seen_members: dict[str, str] = {}
+    for sg in tmpl.scaling_groups:
+        f = f"scaling group {sg.name!r}"
+        if not _NAME_RE.match(sg.name or ""):
+            errs.append(f"{f}: invalid name")
+        if not sg.clique_names:
+            errs.append(f"{f}: clique_names must not be empty")
+        if sg.replicas < 1:
+            errs.append(f"{f}: replicas must be >= 1")
+        if sg.min_available is not None and not (
+                1 <= sg.min_available <= sg.replicas):
+            errs.append(f"{f}: min_available {sg.min_available} outside "
+                        f"[1, {sg.replicas}]")
+        for m in sg.clique_names:
+            if m not in known:
+                errs.append(f"{f}: references unknown clique {m!r}")
+            elif m in seen_members:
+                errs.append(f"{f}: clique {m!r} already in scaling group "
+                            f"{seen_members[m]!r}")
+            else:
+                seen_members[m] = sg.name
+        if sg.auto_scaling is not None and sg.min_available is not None \
+                and sg.auto_scaling.min_replicas < sg.min_available:
+            errs.append(f"{f}: auto_scaling.min_replicas must be >= "
+                        "min_available (the gang floor)")
+        _validate_topology(f + ".topology", sg.topology, tmpl.topology, errs)
+
+    _validate_topology("spec.template.topology", tmpl.topology, None, errs)
+    if tmpl.termination_delay_seconds is not None \
+            and tmpl.termination_delay_seconds < 0:
+        errs.append("termination_delay_seconds must be >= 0")
+
+    # update immutability (reference validation: structure is immutable,
+    # content rolls)
+    if old is not None:
+        old_tmpl = old.spec.template
+        if [t.name for t in old_tmpl.cliques] != names:
+            errs.append("clique set is immutable (got a different clique "
+                        "name list); create a new PodCliqueSet instead")
+        if old_tmpl.startup_type != tmpl.startup_type:
+            errs.append("startup_type is immutable")
+        old_sg = {sg.name: list(sg.clique_names)
+                  for sg in old_tmpl.scaling_groups}
+        new_sg = {sg.name: list(sg.clique_names)
+                  for sg in tmpl.scaling_groups}
+        if old_sg != new_sg:
+            errs.append("scaling group membership is immutable")
+
+    # scheduler-specific validation (reference backend.ValidatePodCliqueSet)
+    if registry is not None:
+        try:
+            backend = registry.get(tmpl.scheduler_name or None)
+            errs.extend(backend.validate_pcs(pcs))
+        except KeyError:
+            errs.append(f"unknown scheduler profile "
+                        f"{tmpl.scheduler_name!r}; have {registry.profiles()}")
+    return errs
+
+
+def validate_clustertopology(ct: ClusterTopology) -> list[str]:
+    """W5: level uniqueness + label rules."""
+    errs: list[str] = []
+    domains = [lvl.domain for lvl in ct.spec.levels]
+    labels = [lvl.node_label for lvl in ct.spec.levels]
+    if not domains:
+        errs.append("spec.levels must not be empty")
+    if len(set(domains)) != len(domains):
+        errs.append(f"duplicate level domains: {domains}")
+    if len(set(labels)) != len(labels):
+        errs.append(f"duplicate level node_labels: {labels}")
+    for lvl in ct.spec.levels:
+        if not lvl.domain or not lvl.node_label:
+            errs.append(f"level {lvl}: domain and node_label are required")
+    return errs

@@ -1,0 +1,102 @@
+"""Multi-level autoscaling — the HPA analog (C1e).
+
+The reference creates one HPA per auto-scaled PCLQ/PCSG targeting its
+scale subresource (podcliqueset/components/hpa/). This control plane owns
+the loop: a MetricsRegistry holds current metric values (pushed by serving
+engines — e.g. queue depth per clique — or by tests), and the Autoscaler
+runnable applies the standard HPA formula
+
+    desired = clamp(ceil(value / target), min_replicas, max_replicas)
+
+to the live replicas of every auto-scaled PodClique and
+PodCliqueScalingGroup. The gang floor: min_replicas is validated to be
+>= min_available, so scaling never undercuts the gang guarantee.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from grove_tpu.api import PodClique, PodCliqueScalingGroup
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+
+class MetricsRegistry:
+    """Named metric values per (kind, namespace, name): the metrics-server
+    analog."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, str, str, str], float] = {}
+
+    def set(self, kind: str, name: str, metric: str, value: float,
+            namespace: str = "default") -> None:
+        with self._lock:
+            self._values[(kind, namespace, name, metric)] = value
+
+    def get(self, kind: str, name: str, metric: str,
+            namespace: str = "default") -> float | None:
+        with self._lock:
+            return self._values.get((kind, namespace, name, metric))
+
+
+def desired_replicas(value: float, target: float, lo: int, hi: int) -> int:
+    if target <= 0:
+        return lo
+    return max(lo, min(hi, math.ceil(value / target)))
+
+
+class Autoscaler:
+    def __init__(self, client: Client, metrics: MetricsRegistry,
+                 namespace: str | None = None, sync_period: float = 1.0):
+        """``namespace=None`` scans every namespace (the default: the rest
+        of the control plane is namespace-agnostic too)."""
+        self.client = client
+        self.metrics = metrics
+        self.namespace = namespace
+        self.sync_period = sync_period
+        self.log = get_logger("autoscaler")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._pass()
+            except Exception:  # noqa: BLE001 - loop survival
+                self.log.exception("autoscale pass panicked")
+            self._stop.wait(self.sync_period)
+
+    def _pass(self) -> None:
+        for kind_cls in (PodClique, PodCliqueScalingGroup):
+            for obj in self.client.list(kind_cls, self.namespace):
+                a = obj.spec.auto_scaling
+                if a is None or obj.meta.deletion_timestamp is not None:
+                    continue
+                value = self.metrics.get(obj.KIND, obj.meta.name, a.metric,
+                                         namespace=obj.meta.namespace)
+                if value is None:
+                    continue
+                want = desired_replicas(value, a.target_value,
+                                        a.min_replicas, a.max_replicas)
+                if want != obj.spec.replicas:
+                    self.log.info("scaling %s/%s %d -> %d (%s=%.2f)",
+                                  obj.KIND, obj.meta.name, obj.spec.replicas,
+                                  want, a.metric, value)
+                    obj.spec.replicas = want
+                    try:
+                        self.client.update(obj)
+                    except GroveError:
+                        pass  # conflict: next pass retries on fresh state

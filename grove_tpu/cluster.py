@@ -24,6 +24,7 @@ from grove_tpu.topology.fleet import FleetSpec, create_fleet
 class Cluster:
     manager: Manager
     scheduler_registry: Registry
+    metrics: "MetricsRegistry | None" = None
 
     @property
     def client(self) -> Client:
@@ -46,11 +47,22 @@ class Cluster:
 def new_cluster(config: OperatorConfiguration | None = None,
                 fleet: FleetSpec | None = None,
                 store: Store | None = None,
-                fake_kubelet: bool = True) -> Cluster:
+                fake_kubelet: bool = True,
+                admission: bool = True) -> Cluster:
     mgr = Manager(config=config, store=store)
     registry = register_controllers(mgr)
+    if admission:
+        from grove_tpu.admission import install_admission
+        install_admission(mgr.store, mgr.config, registry)
     if fake_kubelet:
         mgr.add_runnable(FakeKubeletPool(mgr.client))
+    metrics = None
+    if mgr.config.autoscaler.enabled:
+        from grove_tpu.autoscale import Autoscaler, MetricsRegistry
+        metrics = MetricsRegistry()
+        mgr.add_runnable(Autoscaler(
+            mgr.client, metrics,
+            sync_period=mgr.config.autoscaler.sync_period_seconds))
     if fleet is not None:
         create_fleet(mgr.client, fleet)
-    return Cluster(manager=mgr, scheduler_registry=registry)
+    return Cluster(manager=mgr, scheduler_registry=registry, metrics=metrics)

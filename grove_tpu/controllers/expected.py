@@ -57,7 +57,9 @@ def min_available(t: PodCliqueTemplate) -> int:
 
 
 def sg_min_available(sg: ScalingGroupConfig) -> int:
-    return sg.min_available if sg.min_available is not None else sg.replicas
+    # Default matches admission defaulting: one gang-guaranteed instance,
+    # remaining replicas are elastic scaled gangs.
+    return sg.min_available if sg.min_available is not None else 1
 
 
 def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
@@ -188,23 +190,37 @@ def _pod_group(pclq_fqn: str, replicas: int, min_avail: int) -> PodGroup:
     )
 
 
-def expected_podgangs(pcs: PodCliqueSet) -> list[PodGang]:
+def expected_podgangs(pcs: PodCliqueSet,
+                      live_replicas: dict[str, int] | None = None
+                      ) -> list[PodGang]:
     """Base gang per PCS replica + scaled gang per PCSG replica beyond
-    min_available (reference syncflow.go:147-212)."""
+    min_available (reference syncflow.go:147-212).
+
+    ``live_replicas`` maps child names (PCLQ FQN or PCSG name) to their
+    live replica counts — auto-scaled children own their replica field, so
+    gang pod references must follow the live value, not the template.
+    """
+    live_replicas = live_replicas or {}
     out = []
     tmpl = pcs.spec.template
+
+    def pclq_replicas(fqn: str, t: PodCliqueTemplate) -> int:
+        return live_replicas.get(fqn, t.replicas)
+
     for r in range(pcs.spec.replicas):
         base_name = namegen.base_podgang_name(pcs.meta.name, r)
         groups: list[PodGroup] = []
         for t in standalone_cliques(pcs):
             fqn = namegen.pclq_name(pcs.meta.name, r, t.name)
-            groups.append(_pod_group(fqn, t.replicas, min_available(t)))
+            groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
+                                     min_available(t)))
         for sg in tmpl.scaling_groups:
             for j in range(sg_min_available(sg)):
                 for t in grouped_cliques(pcs, sg):
                     fqn = namegen.pcsg_pclq_name(
                         pcs.meta.name, r, sg.name, j, t.name)
-                    groups.append(_pod_group(fqn, t.replicas, min_available(t)))
+                    groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
+                                             min_available(t)))
         out.append(PodGang(
             meta=_meta(pcs, base_name, _labels(pcs, r, {})),
             spec=PodGangSpec(
@@ -214,18 +230,19 @@ def expected_podgangs(pcs: PodCliqueSet) -> list[PodGang]:
                 scheduler_name=tmpl.scheduler_name,
             ),
         ))
-        # Scaled gangs: one per PCSG replica >= minAvailable.
+        # Scaled gangs: one per live PCSG replica >= minAvailable.
         for sg in tmpl.scaling_groups:
-            for j in range(sg_min_available(sg), sg.replicas):
+            sg_live = live_replicas.get(
+                namegen.pcsg_name(pcs.meta.name, r, sg.name), sg.replicas)
+            for j in range(sg_min_available(sg), sg_live):
                 name = namegen.scaled_podgang_name(pcs.meta.name, r,
                                                    sg.name, j)
-                groups = [
-                    _pod_group(
-                        namegen.pcsg_pclq_name(pcs.meta.name, r, sg.name, j,
-                                               t.name),
-                        t.replicas, min_available(t))
-                    for t in grouped_cliques(pcs, sg)
-                ]
+                groups = []
+                for t in grouped_cliques(pcs, sg):
+                    fqn = namegen.pcsg_pclq_name(pcs.meta.name, r, sg.name,
+                                                 j, t.name)
+                    groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
+                                             min_available(t)))
                 out.append(PodGang(
                     meta=_meta(pcs, name, _labels(pcs, r, {
                         c.LABEL_PCSG_NAME: namegen.pcsg_name(

@@ -82,6 +82,23 @@ class PodCliqueReconciler:
 
     def _sync_pods(self, pclq: PodClique, pods: list[Pod], gang_name: str,
                    req: Request) -> StepResult | None:
+        # Pod-level self-healing: Failed pods are deleted so their index
+        # is recreated (the kubelet-restart analog). Gang termination only
+        # fires when this self-heal cannot keep MinAvailable satisfied.
+        failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
+        if failed:
+            self.expectations.expect_deletes(
+                req.key, [p.meta.uid for p in failed])
+            for p in failed:
+                try:
+                    self.client.delete(Pod, p.meta.name, p.meta.namespace)
+                    self.expectations.observe_delete(req.key, p.meta.uid)
+                except NotFoundError:
+                    self.expectations.observe_delete(req.key, p.meta.uid)
+                except GroveError as e:
+                    self.expectations.forget(req.key)
+                    return StepResult.fail(e)
+            return StepResult.requeue(0.05)
         want = pclq.spec.replicas
         if len(pods) < want:
             used = []
@@ -262,7 +279,14 @@ class PodCliqueReconciler:
         pclq.status.gated_replicas = gated
         pclq.status.updated_replicas = updated
         pclq.status.observed_generation = pclq.meta.generation
-        breached = ready < pclq.spec.min_available
+        # A breach only counts once the clique has been scheduled: during
+        # initial placement "not ready yet" is startup, not failure
+        # (reference reconcilestatus.go:210-272 gates on PodCliqueScheduled).
+        # PodCliqueScheduled is sticky — losing pods after placement is a
+        # breach, not a return to "awaiting placement".
+        was_scheduled = scheduled >= pclq.spec.min_available or \
+            is_condition_true(pclq.status.conditions, c.COND_PCLQ_SCHEDULED)
+        breached = was_scheduled and ready < pclq.spec.min_available
         pclq.status.conditions = set_condition(
             pclq.status.conditions, Condition(
                 type=c.COND_MIN_AVAILABLE_BREACHED,
@@ -271,7 +295,7 @@ class PodCliqueReconciler:
         pclq.status.conditions = set_condition(
             pclq.status.conditions, Condition(
                 type=c.COND_PCLQ_SCHEDULED,
-                status="True" if scheduled >= pclq.spec.min_available else "False",
+                status="True" if was_scheduled else "False",
                 reason=f"scheduled={scheduled}"))
         try:
             self.client.update_status(pclq)

@@ -17,6 +17,7 @@ from grove_tpu.api.core import Service
 from grove_tpu.api.meta import Condition, is_condition_true, set_condition
 from grove_tpu.api.serde import to_dict
 from grove_tpu.controllers import expected as exp
+from grove_tpu.controllers import replica_lifecycle as lifecycle
 from grove_tpu.runtime.concurrent import run_concurrently
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.errors import GroveError, NotFoundError
@@ -48,14 +49,22 @@ class PodCliqueSetReconciler:
             pcs.status.generation_hash = template_hash
             pcs = self.client.update_status(pcs)
         elif pcs.status.generation_hash != template_hash:
-            # Template changed -> rolling update (orchestrated by the
-            # rollout module; milestone later in SURVEY §7 order).
             pcs = self._init_rolling_update(pcs, template_hash)
+
+        # Availability loops first (reference sync group G1): gang
+        # termination and rolling-update orchestration may delete replica
+        # children that the component sync below then recreates.
+        requeue = lifecycle.gang_termination_pass(self.client, pcs)
+        ru_requeue = lifecycle.rolling_update_pass(self.client, pcs)
+        if ru_requeue is not None:
+            requeue = ru_requeue if requeue is None else min(requeue, ru_requeue)
 
         errors = self._sync_components(pcs, template_hash)
         self._update_status(pcs)
         if errors:
             return StepResult.fail(errors[0])
+        if requeue is not None:
+            return StepResult.requeue(requeue)
         return StepResult.finished()
 
     # ---- deletion (finalizer path) ----
@@ -95,15 +104,46 @@ class PodCliqueSetReconciler:
             extra_selector={c.LABEL_COMPONENT: exp.COMPONENT_STANDALONE_PCLQ})
         if errors:
             return errors
-        # G3: scaling groups ∥ podgangs
+        # G3: scaling groups ∥ podgangs. Gangs reference live (possibly
+        # autoscaled) replica counts and carry placement-reuse hints for
+        # replicas being recreated by a rolling update.
+        live = self._live_replicas(pcs)
+        gangs = exp.expected_podgangs(pcs, live)
+        for gang in gangs:
+            r = gang.meta.labels.get(c.LABEL_PCS_REPLICA, "")
+            raw = pcs.meta.annotations.get(
+                lifecycle.ANNOTATION_PREFERRED_SLICE + f"-{r}")
+            if raw:
+                import json
+                try:
+                    hint = json.loads(raw).get(gang.meta.name, "")
+                except (ValueError, AttributeError):
+                    hint = ""
+                if hint:
+                    gang.meta.annotations[
+                        lifecycle.ANNOTATION_PREFERRED_SLICE] = hint
         errors = run_concurrently([
             lambda: self._raise_all(self._sync_children(
                 PodCliqueScalingGroup, exp.expected_pcsgs(pcs, template_hash),
                 pcs, update_spec=True)),
             lambda: self._raise_all(self._sync_children(
-                PodGang, exp.expected_podgangs(pcs), pcs, update_spec=True)),
+                PodGang, gangs, pcs, update_spec=True)),
         ])
         return errors
+
+    def _live_replicas(self, pcs: PodCliqueSet) -> dict[str, int]:
+        """Live replica counts for auto-scaled children (they own their
+        replicas field; template values are only the initial state)."""
+        live: dict[str, int] = {}
+        sel = {c.LABEL_PCS_NAME: pcs.meta.name}
+        for q in self.client.list(PodClique, pcs.meta.namespace, sel):
+            if q.spec.auto_scaling is not None:
+                live[q.meta.name] = q.spec.replicas
+        for g in self.client.list(PodCliqueScalingGroup, pcs.meta.namespace,
+                                  sel):
+            if g.spec.auto_scaling is not None:
+                live[g.meta.name] = g.spec.replicas
+        return live
 
     @staticmethod
     def _raise_all(errors: list[Exception]) -> None:
@@ -128,9 +168,14 @@ class PodCliqueSetReconciler:
             try:
                 if cur is None:
                     self.client.create(obj)
-                elif update_spec and to_dict(cur.spec) != to_dict(obj.spec):
-                    cur.spec = obj.spec
-                    self.client.update(cur)
+                elif update_spec:
+                    if getattr(obj.spec, "auto_scaling", None) is not None:
+                        # replicas are owned by the autoscaler once the
+                        # child exists; never stomp them from the template
+                        obj.spec.replicas = cur.spec.replicas
+                    if to_dict(cur.spec) != to_dict(obj.spec):
+                        cur.spec = obj.spec
+                        self.client.update(cur)
             except GroveError as e:
                 errors.append(e)
         # prune: children no longer in the expected set (scale-in, template

@@ -1,0 +1,234 @@
+"""PCS-replica lifecycle: gang termination and rolling updates (C1d).
+
+Parity with reference podcliqueset/components/podcliquesetreplica:
+
+- Gang termination (gangterminate.go:69-230): a PCS replica whose
+  standalone PCLQ or PCSG has MinAvailableBreached persisting beyond
+  TerminationDelay is deleted wholesale (all children), then recreated by
+  the next component sync — gang restart semantics. The delay gives the
+  scheduler/agents time to self-heal before the hammer falls.
+
+- Rolling update (rollingupdate.go:37-296): on template-hash change,
+  replicas are recreated one at a time, ordered breached-first → already
+  -in-progress → by index; the replacement gang carries a placement-reuse
+  hint (the slice of the gang it replaces; reference ReuseReservationRef,
+  scheduler api podgang.go:65-71).
+"""
+
+from __future__ import annotations
+
+import time
+
+from grove_tpu.api import (
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+)
+from grove_tpu.api.meta import get_condition
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+ANNOTATION_PREFERRED_SLICE = f"{c.DOMAIN}/preferred-slice"
+
+log = get_logger("replica-lifecycle")
+
+
+def _replica_children(client: Client, pcs: PodCliqueSet, replica: int):
+    sel = {c.LABEL_PCS_NAME: pcs.meta.name,
+           c.LABEL_PCS_REPLICA: str(replica)}
+    ns = pcs.meta.namespace
+    return (client.list(PodClique, ns, sel),
+            client.list(PodCliqueScalingGroup, ns, sel),
+            client.list(PodGang, ns, sel))
+
+
+def record_replica_slices(client: Client, pcs: PodCliqueSet,
+                          replica: int) -> dict[str, str]:
+    """Snapshot gang → slice for a replica about to be recreated."""
+    _, _, gangs = _replica_children(client, pcs, replica)
+    return {g.meta.name: g.status.assigned_slice
+            for g in gangs if g.status.assigned_slice}
+
+
+def delete_replica_children(client: Client, pcs: PodCliqueSet,
+                            replica: int) -> None:
+    """Delete every child of one PCS replica (pods go via cascade)."""
+    pclqs, pcsgs, gangs = _replica_children(client, pcs, replica)
+    ns = pcs.meta.namespace
+    for kind_cls, objs in ((PodClique, pclqs),
+                           (PodCliqueScalingGroup, pcsgs),
+                           (PodGang, gangs)):
+        for obj in objs:
+            if obj.meta.deletion_timestamp is not None:
+                continue
+            try:
+                client.delete(kind_cls, obj.meta.name, ns)
+            except NotFoundError:
+                pass
+
+
+def breach_started_at(client: Client, pcs: PodCliqueSet,
+                      replica: int) -> float | None:
+    """Earliest MinAvailableBreached=True transition among the replica's
+    standalone PCLQs and PCSGs; None when nothing is breached."""
+    pclqs, pcsgs, _ = _replica_children(client, pcs, replica)
+    starts = []
+    for q in pclqs:
+        if q.spec.pcsg_name:
+            continue  # rolled up through its PCSG
+        cond = get_condition(q.status.conditions, c.COND_MIN_AVAILABLE_BREACHED)
+        if cond is not None and cond.status == "True":
+            starts.append(cond.last_transition_time)
+    for g in pcsgs:
+        cond = get_condition(g.status.conditions, c.COND_MIN_AVAILABLE_BREACHED)
+        if cond is not None and cond.status == "True":
+            starts.append(cond.last_transition_time)
+    return min(starts) if starts else None
+
+
+def gang_termination_pass(client: Client, pcs: PodCliqueSet) -> float | None:
+    """Terminate replicas whose breach outlived TerminationDelay.
+
+    Returns a requeue delay when a breach clock is running, else None.
+    """
+    delay = pcs.spec.template.termination_delay_seconds
+    if delay is None:
+        delay = c.DEFAULT_TERMINATION_DELAY_SECONDS
+    soonest: float | None = None
+    now = time.time()
+    for r in range(pcs.spec.replicas):
+        started = breach_started_at(client, pcs, r)
+        if started is None:
+            continue
+        elapsed = now - started
+        if elapsed >= delay:
+            log.info("gang-terminating %s replica %d (breached %.1fs > %.1fs)",
+                     pcs.meta.name, r, elapsed, delay)
+            delete_replica_children(client, pcs, r)
+        else:
+            remaining = delay - elapsed
+            soonest = remaining if soonest is None else min(soonest, remaining)
+    return soonest
+
+
+# ---- rolling update ----
+
+def replica_pods_at_hash(client: Client, pcs: PodCliqueSet, replica: int,
+                         target_hash: str) -> bool:
+    from grove_tpu.api import Pod
+    pods = client.list(Pod, pcs.meta.namespace,
+                       selector={c.LABEL_PCS_NAME: pcs.meta.name,
+                                 c.LABEL_PCS_REPLICA: str(replica)})
+    return bool(pods) and all(
+        p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) == target_hash
+        for p in pods)
+
+
+def _replica_available(client: Client, pcs: PodCliqueSet, replica: int) -> bool:
+    pclqs, pcsgs, _ = _replica_children(client, pcs, replica)
+    standalone = [q for q in pclqs if not q.spec.pcsg_name]
+    if not standalone and not pcsgs:
+        return False
+    ok = all(q.status.ready_replicas >= q.spec.min_available
+             for q in standalone)
+    ok = ok and all(g.status.ready_replicas >= g.spec.min_available
+                    for g in pcsgs)
+    return ok
+
+
+def rolling_update_pass(client: Client, pcs: PodCliqueSet) -> float | None:
+    """Advance the rolling update by at most one replica recreation.
+
+    Returns a requeue delay while the update is in flight, None when done.
+    OnDelete strategy only does bookkeeping (reference podcliqueset.go:
+    488-504): PCLQ templates are already updated; the user deletes pods.
+    """
+    progress = pcs.status.rolling_update
+    if progress is None:
+        return None
+    target = progress.target_hash
+
+    from grove_tpu.api.podcliqueset import UpdateStrategyType
+    on_delete = (pcs.spec.update_strategy.type == UpdateStrategyType.ON_DELETE)
+
+    pending = [r for r in range(pcs.spec.replicas)
+               if not replica_pods_at_hash(client, pcs, r, target)]
+    if not pending:
+        pcs.status.rolling_update = None
+        pcs.status.updated_replicas = pcs.spec.replicas
+        # Drop the per-update placement hints: they describe fleet state at
+        # the moment of this update and must not bias future recreations.
+        stale = [k for k in pcs.meta.annotations
+                 if k.startswith(ANNOTATION_PREFERRED_SLICE)]
+        try:
+            client.update_status(pcs)
+            if stale:
+                fresh = client.get(PodCliqueSet, pcs.meta.name,
+                                   pcs.meta.namespace)
+                for k in stale:
+                    fresh.meta.annotations.pop(k, None)
+                client.update(fresh)
+        except GroveError:
+            pass
+        return None
+    # Persist rollout progress so watchers see per-replica advancement
+    # (also the only bookkeeping OnDelete gets).
+    updated_count = pcs.spec.replicas - len(pending)
+    if pcs.status.updated_replicas != updated_count:
+        pcs.status.updated_replicas = updated_count
+        try:
+            pcs = client.update_status(pcs)
+            progress = pcs.status.rolling_update
+            if progress is None:
+                return 0.2
+        except GroveError:
+            pass
+    if on_delete:
+        return None  # user-driven; no orchestration
+
+    # Order: breached first, then the one already being updated, then index
+    # (reference rollingupdate.go:182-235).
+    def order(r: int):
+        breached = breach_started_at(client, pcs, r) is not None
+        in_progress = progress.current_replica == r
+        return (0 if breached else 1, 0 if in_progress else 1, r)
+
+    pending.sort(key=order)
+    victim = pending[0]
+
+    if progress.current_replica == victim:
+        # Already recreated; wait for it to come back at the target hash.
+        return 0.2
+    # Availability floor: never take a second replica down while the
+    # previous one is still recovering (unless it is itself breached).
+    if progress.current_replica is not None and \
+            progress.current_replica != victim and \
+            not _replica_available(client, pcs, progress.current_replica):
+        return 0.2
+
+    slices = record_replica_slices(client, pcs, victim)
+    if slices:
+        # Full per-gang map: gang names are deterministic across the
+        # recreation, so each gang gets exactly its old slice back.
+        import json
+        pcs.meta.annotations[ANNOTATION_PREFERRED_SLICE + f"-{victim}"] = \
+            json.dumps(slices)
+        try:
+            pcs = client.update(pcs)
+            progress = pcs.status.rolling_update
+            if progress is None:
+                return 0.2
+        except GroveError:
+            return 0.1
+    log.info("rolling update %s: recreating replica %d -> %s",
+             pcs.meta.name, victim, target)
+    delete_replica_children(client, pcs, victim)
+    progress.current_replica = victim
+    try:
+        client.update_status(pcs)
+    except GroveError:
+        pass
+    return 0.2

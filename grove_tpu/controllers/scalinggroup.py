@@ -82,6 +82,8 @@ class ScalingGroupReconciler:
                     pcsg=pcsg.meta.name, pcsg_replica=j,
                     template_hash=pcsg.spec.pod_template_hash)
                 cur = live.get(name)
+                if cur is not None and spec.auto_scaling is not None:
+                    spec.replicas = cur.spec.replicas  # autoscaler-owned
                 try:
                     if cur is None:
                         pclq = PodClique(

@@ -1,3 +1,11 @@
+"""Controller runtime package.
+
+Import submodules directly (grove_tpu.runtime.controller, .manager, ...);
+this __init__ re-exports only leaf helpers to avoid import cycles with
+the store (store raises runtime.errors; controller/manager consume the
+store).
+"""
+
 from grove_tpu.runtime.errors import (
     AlreadyExistsError,
     ConflictError,
@@ -5,8 +13,6 @@ from grove_tpu.runtime.errors import (
     NotFoundError,
 )
 from grove_tpu.runtime.flow import StepResult
-from grove_tpu.runtime.controller import Controller, Request
-from grove_tpu.runtime.manager import Manager
 
 __all__ = [
     "AlreadyExistsError",
@@ -14,7 +20,4 @@ __all__ = [
     "GroveError",
     "NotFoundError",
     "StepResult",
-    "Controller",
-    "Request",
-    "Manager",
 ]

@@ -247,7 +247,12 @@ class GangBackend:
         return placed_any
 
     def _reuse_slice(self, gang: PodGang) -> str:
-        """Resolve the ReuseReservationRef hint to a slice name."""
+        """Resolve the placement-reuse hint to a slice name: an explicit
+        preferred-slice annotation (rolling updates stamp the replaced
+        gang's slice there) or a live gang named by reuse_reservation_of."""
+        hint = gang.meta.annotations.get(f"{c.DOMAIN}/preferred-slice", "")
+        if hint:
+            return hint
         if not gang.spec.reuse_reservation_of:
             return ""
         try:

@@ -17,8 +17,14 @@ from grove_tpu.store.store import Store, Watcher
 
 
 class Client:
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, actor: str = "system:grove-operator"):
         self._store = store
+        self.actor = actor
+
+    def impersonate(self, actor: str) -> "Client":
+        """A client acting as a different principal (authorization tests,
+        user-facing surfaces)."""
+        return Client(self._store, actor)
 
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
         return self._store.get(kind_cls, name, namespace)
@@ -28,16 +34,16 @@ class Client:
         return self._store.list(kind_cls, namespace, selector)
 
     def create(self, obj: Any) -> Any:
-        return self._store.create(obj)
+        return self._store.create(obj, actor=self.actor)
 
     def update(self, obj: Any) -> Any:
-        return self._store.update(obj)
+        return self._store.update(obj, actor=self.actor)
 
     def update_status(self, obj: Any) -> Any:
-        return self._store.update_status(obj)
+        return self._store.update_status(obj, actor=self.actor)
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
-        return self._store.delete(kind_cls, name, namespace)
+        return self._store.delete(kind_cls, name, namespace, actor=self.actor)
 
     def watch(self, kinds: Iterable[str] | None = None,
               selector: dict[str, str] | None = None) -> Watcher:

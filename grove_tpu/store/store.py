@@ -86,6 +86,15 @@ class Store:
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = itertools.count(1)
         self._watchers: list[Watcher] = []
+        self._admission = None   # AdmissionChain (see grove_tpu.admission)
+
+    def set_admission(self, chain) -> None:
+        self._admission = chain
+
+    def _admit(self, verb: str, obj: Any, old: Any, actor: str) -> Any:
+        if self._admission is None:
+            return obj
+        return self._admission.admit(verb, obj, old, actor)
 
     # ---- watch ----
 
@@ -125,14 +134,14 @@ class Store:
 
     # ---- writes ----
 
-    def create(self, obj: Any) -> Any:
+    def create(self, obj: Any, actor: str = "system:grove-operator") -> Any:
         with self._lock:
             kind = obj.KIND
             objs = self._objects.setdefault(kind, {})
             key = _key(obj)
             if key in objs:
                 raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} exists")
-            stored = clone(obj)
+            stored = self._admit("create", clone(obj), None, actor)
             if not stored.meta.uid:
                 stored.meta.uid = str(uuid.uuid4())
             if not stored.meta.creation_timestamp:
@@ -151,7 +160,7 @@ class Store:
             raise NotFoundError(f"{obj.KIND} {ns}/{name} not found")
         return live
 
-    def update(self, obj: Any) -> Any:
+    def update(self, obj: Any, actor: str = "system:grove-operator") -> Any:
         """Full update (spec+meta). Bumps generation when spec changed."""
         with self._lock:
             live = self._get_live(obj)
@@ -160,7 +169,7 @@ class Store:
                     f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
                     f"resource_version {obj.meta.resource_version} != "
                     f"{live.meta.resource_version}")
-            stored = clone(obj)
+            stored = self._admit("update", clone(obj), clone(live), actor)
             stored.meta.uid = live.meta.uid
             stored.meta.creation_timestamp = live.meta.creation_timestamp
             stored.meta.generation = live.meta.generation
@@ -173,7 +182,8 @@ class Store:
                 self._remove(stored)
             return clone(stored)
 
-    def update_status(self, obj: Any) -> Any:
+    def update_status(self, obj: Any,
+                      actor: str = "system:grove-operator") -> Any:
         """Status-only update: ignores spec/meta edits in ``obj``.
 
         No-op writes (byte-identical status) are suppressed: reconcilers
@@ -183,6 +193,9 @@ class Store:
         """
         with self._lock:
             live = self._get_live(obj)
+            # Status is a privileged surface (node binding, breach
+            # conditions, gang placement) — same authorization as spec.
+            self._admit("update_status", clone(obj), clone(live), actor)
             if obj.meta.resource_version != live.meta.resource_version:
                 raise ConflictError(
                     f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
@@ -196,7 +209,8 @@ class Store:
             self._emit(EventType.MODIFIED, stored)
             return clone(stored)
 
-    def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
+    def delete(self, kind_cls: type, name: str, namespace: str = "default",
+               actor: str = "system:grove-operator") -> None:
         """Finalizer-aware delete: marks for deletion if finalizers remain,
         removes (and cascades to owned objects) otherwise."""
         with self._lock:
@@ -204,6 +218,7 @@ class Store:
             obj = objs.get((namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind_cls.KIND} {namespace}/{name} not found")
+            self._admit("delete", clone(obj), None, actor)
             if obj.meta.finalizers:
                 if obj.meta.deletion_timestamp is None:
                     obj.meta.deletion_timestamp = time.time()

@@ -1,0 +1,166 @@
+"""Admission chain: defaulting, validation rules (incl. Tarjan DAG cycle
+detection), immutability, authorization."""
+
+import dataclasses
+
+import pytest
+
+from grove_tpu.admission.chain import AdmissionChain, install_admission
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import (
+    tarjan_sccs,
+    validate_clustertopology,
+    validate_podcliqueset,
+)
+from grove_tpu.api import ClusterTopology, PodCliqueSet, new_meta
+from grove_tpu.api.clustertopology import ClusterTopologySpec, TopologyLevel
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    TopologyConstraint,
+)
+from grove_tpu.runtime.errors import ForbiddenError, ValidationError
+from grove_tpu.store import Client, Store
+
+
+def pcs_with(cliques, sgs=(), name="t", topology=None):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(
+            replicas=1,
+            template=PodCliqueSetTemplate(
+                cliques=list(cliques), scaling_groups=list(sgs),
+                topology=topology)))
+
+
+def test_defaulting_fills_gaps():
+    pcs = pcs_with([PodCliqueTemplate(name="a", replicas=3,
+                                      tpu_chips_per_pod=4)])
+    pcs = default_podcliqueset(pcs)
+    t = pcs.spec.template
+    assert t.cliques[0].min_available == 3
+    assert t.termination_delay_seconds == 4 * 3600
+    assert t.headless_service is not None
+    assert t.topology is not None and t.topology.pack_level == "slice"
+
+
+def test_validate_accepts_good_spec():
+    pcs = default_podcliqueset(pcs_with(
+        [PodCliqueTemplate(name="a", replicas=2),
+         PodCliqueTemplate(name="b", replicas=1, starts_after=["a"])]))
+    assert validate_podcliqueset(pcs) == []
+
+
+@pytest.mark.parametrize("cliques,fragment", [
+    ([], "must not be empty"),
+    ([PodCliqueTemplate(name="a"), PodCliqueTemplate(name="a")], "unique"),
+    ([PodCliqueTemplate(name="UPPER")], "invalid name"),
+    ([PodCliqueTemplate(name="a", replicas=2, min_available=3)],
+     "outside [1, 2]"),
+    ([PodCliqueTemplate(name="a", starts_after=["a"])], "itself"),
+    ([PodCliqueTemplate(name="a", starts_after=["ghost"])], "unknown clique"),
+    ([PodCliqueTemplate(name="a", starts_after=["b"]),
+      PodCliqueTemplate(name="b", starts_after=["a"])], "cycle"),
+    ([PodCliqueTemplate(name="a", replicas=2,
+                        auto_scaling=AutoScalingConfig(min_replicas=3,
+                                                       max_replicas=1))],
+     "min 3 > max"),
+])
+def test_validate_rejections(cliques, fragment):
+    pcs = pcs_with(cliques)
+    problems = validate_podcliqueset(pcs)
+    assert any(fragment in p for p in problems), problems
+
+
+def test_validate_three_node_cycle():
+    pcs = pcs_with([
+        PodCliqueTemplate(name="a", starts_after=["c"]),
+        PodCliqueTemplate(name="b", starts_after=["a"]),
+        PodCliqueTemplate(name="c", starts_after=["b"]),
+    ])
+    problems = validate_podcliqueset(pcs)
+    assert any("cycle" in p and "'a', 'b', 'c'" in p for p in problems), problems
+
+
+def test_tarjan_finds_nested_scc():
+    graph = {"a": ["b"], "b": ["c"], "c": ["a"], "d": ["a"], "e": []}
+    sccs = [sorted(s) for s in tarjan_sccs(graph)]
+    assert ["a", "b", "c"] in sccs
+
+
+def test_validate_topology_strictness():
+    pcs = pcs_with(
+        [PodCliqueTemplate(name="a",
+                           topology=TopologyConstraint(pack_level="pool"))],
+        topology=TopologyConstraint(pack_level="slice"))
+    problems = validate_podcliqueset(pcs)
+    assert any("looser" in p for p in problems), problems
+    # equal or stricter is fine
+    pcs2 = pcs_with(
+        [PodCliqueTemplate(name="a",
+                           topology=TopologyConstraint(pack_level="host"))],
+        topology=TopologyConstraint(pack_level="slice"))
+    assert validate_podcliqueset(pcs2) == []
+
+
+def test_validate_scaling_groups():
+    sg = ScalingGroupConfig(name="g", clique_names=["a", "ghost"])
+    pcs = pcs_with([PodCliqueTemplate(name="a")], [sg])
+    problems = validate_podcliqueset(pcs)
+    assert any("unknown clique 'ghost'" in p for p in problems), problems
+    # one clique in two groups
+    pcs2 = pcs_with([PodCliqueTemplate(name="a")],
+                    [ScalingGroupConfig(name="g1", clique_names=["a"]),
+                     ScalingGroupConfig(name="g2", clique_names=["a"])])
+    problems = validate_podcliqueset(pcs2)
+    assert any("already in scaling group" in p for p in problems), problems
+
+
+def test_update_immutability():
+    old = default_podcliqueset(pcs_with([PodCliqueTemplate(name="a")]))
+    new = default_podcliqueset(pcs_with([PodCliqueTemplate(name="b")]))
+    problems = validate_podcliqueset(new, old=old)
+    assert any("immutable" in p for p in problems), problems
+
+
+def test_clustertopology_validation():
+    ct = ClusterTopology(meta=new_meta("ct"), spec=ClusterTopologySpec(
+        levels=[TopologyLevel("slice", "l1"), TopologyLevel("slice", "l2")]))
+    assert any("duplicate" in p for p in validate_clustertopology(ct))
+
+
+def test_admission_installed_on_store():
+    store = Store()
+    cfg = OperatorConfiguration()
+    install_admission(store, cfg, registry=None)
+    client = Client(store)
+    with pytest.raises(ValidationError):
+        client.create(pcs_with([], name="bad"))
+    ok = client.create(pcs_with([PodCliqueTemplate(name="a", replicas=2)]))
+    assert ok.spec.template.cliques[0].min_available == 2  # defaulted in store
+
+
+def test_authorization_blocks_child_mutation():
+    store = Store()
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    install_admission(store, cfg, registry=None)
+    operator = Client(store)  # default operator actor
+    from grove_tpu.api import Pod, constants as c
+    pod = Pod(meta=new_meta("p", labels={
+        c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE}))
+    operator.create(pod)
+    user = operator.impersonate("alice")
+    with pytest.raises(ForbiddenError):
+        user.delete(Pod, "p")
+    # status is a privileged surface too (node binding, breach conditions)
+    live = operator.get(Pod, "p")
+    live.status.node_name = "stolen-node"
+    with pytest.raises(ForbiddenError):
+        user.update_status(live)
+    # users may still manage their own top-level resources
+    user.create(pcs_with([PodCliqueTemplate(name="a")], name="users-own"))

@@ -1,0 +1,218 @@
+"""Availability loops: pod self-healing, gang termination after
+TerminationDelay, multi-level autoscaling, rolling updates.
+
+Covers reference behaviors from gangterminate.go, hpa/, rollingupdate.go
+(SURVEY.md §3.3-3.5) against the in-process control plane.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from grove_tpu.agent.node import fail_pod
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=3)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def _ready_pods(client, pcs_name):
+    return [p for p in client.list(Pod, selector={c.LABEL_PCS_NAME: pcs_name})
+            if is_condition_true(p.status.conditions, c.COND_READY)]
+
+
+def test_failed_pod_self_heals(cluster):
+    client = cluster.client
+    client.create(simple_pcs(name="heal", pods=3))
+    wait_for(lambda: len(_ready_pods(client, "heal")) == 3, desc="ready")
+    victim = client.get(Pod, "heal-0-workers-1")
+    fail_pod(client, victim.meta.name)
+    # Replacement reuses the stable index (new uid, same name).
+    wait_for(lambda: (lambda p: p is not None and p.meta.uid != victim.meta.uid
+                      and is_condition_true(p.status.conditions, c.COND_READY))(
+        next(iter(client.list(Pod, selector={
+            c.LABEL_PCLQ_NAME: "heal-0-workers",
+            c.LABEL_POD_INDEX: "1"})), None)),
+        desc="replacement pod ready")
+    assert len(_ready_pods(client, "heal")) == 3
+    env = client.get(Pod, "heal-0-workers-1").spec.container.env
+    assert env[c.ENV_TPU_WORKER_ID] == "1"
+
+
+def test_gang_termination_after_delay(cluster):
+    client = cluster.client
+    pcs = simple_pcs(name="doomed", pods=2, chips=4)
+    pcs.spec.template.termination_delay_seconds = 0.6
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "doomed")) == 2, desc="ready")
+    gang_before = client.get(PodGang, "doomed-0")
+
+    # Make self-heal impossible: cordon every node, then fail a pod.
+    for node in client.list(Node):
+        node.spec.unschedulable = True
+        client.update(node)
+    fail_pod(client, "doomed-0-workers-0")
+
+    # Breach persists past TerminationDelay -> replica children recreated.
+    wait_for(lambda: (lambda g: g is not None
+                      and g.meta.uid != gang_before.meta.uid)(
+        next(iter(client.list(PodGang, selector={
+            c.LABEL_PCS_NAME: "doomed"})), None)),
+        timeout=15.0, desc="gang recreated after termination delay")
+
+    # Uncordon -> the recreated replica converges back to Ready.
+    for node in client.list(Node):
+        node.spec.unschedulable = False
+        client.update(node)
+    wait_for(lambda: len(_ready_pods(client, "doomed")) == 2,
+             timeout=15.0, desc="recovered")
+
+
+def test_breach_shorter_than_delay_does_not_terminate(cluster):
+    client = cluster.client
+    pcs = simple_pcs(name="patient", pods=2, chips=4)
+    pcs.spec.template.termination_delay_seconds = 30.0
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "patient")) == 2, desc="ready")
+    gang_before = client.get(PodGang, "patient-0")
+    fail_pod(client, "patient-0-workers-0")          # self-heals quickly
+    wait_for(lambda: len(_ready_pods(client, "patient")) == 2,
+             desc="self-healed")
+    assert client.get(PodGang, "patient-0").meta.uid == gang_before.meta.uid
+
+
+def test_pcsg_autoscaling(cluster):
+    client = cluster.client
+    pcs = PodCliqueSet(
+        meta=new_meta("elastic"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="decode", replicas=2, min_available=2,
+                tpu_chips_per_pod=4,
+                container=ContainerSpec(argv=["sleep", "inf"]))],
+            scaling_groups=[ScalingGroupConfig(
+                name="model", clique_names=["decode"], replicas=1,
+                min_available=1,
+                auto_scaling=AutoScalingConfig(
+                    min_replicas=1, max_replicas=3,
+                    metric="queue_depth", target_value=10.0))],
+        )))
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "elastic")) == 2, desc="base up")
+
+    cluster.metrics.set("PodCliqueScalingGroup", "elastic-0-model",
+                        "queue_depth", 25.0)   # ceil(25/10)=3 replicas
+    wait_for(lambda: len(_ready_pods(client, "elastic")) == 6,
+             timeout=15.0, desc="scaled out to 3 model instances")
+    # scaled gangs exist for replicas 1 and 2
+    gangs = {g.meta.name for g in client.list(
+        PodGang, selector={c.LABEL_PCS_NAME: "elastic"})}
+    assert {"elastic-0", "elastic-0-model-1", "elastic-0-model-2"} <= gangs
+
+    cluster.metrics.set("PodCliqueScalingGroup", "elastic-0-model",
+                        "queue_depth", 1.0)    # back to 1
+    wait_for(lambda: len(_ready_pods(client, "elastic")) == 2,
+             timeout=15.0, desc="scaled back in")
+    wait_for(lambda: {g.meta.name for g in client.list(
+        PodGang, selector={c.LABEL_PCS_NAME: "elastic"})} == {"elastic-0"},
+        desc="scaled gangs pruned")
+
+
+def test_rolling_update(cluster):
+    client = cluster.client
+    client.create(simple_pcs(name="roll", pods=2, chips=4))
+    wait_for(lambda: len(_ready_pods(client, "roll")) == 2, desc="ready")
+    old_hash = client.get(PodCliqueSet, "roll").status.generation_hash
+    old_slice = client.get(PodGang, "roll-0").status.assigned_slice
+
+    live = client.get(PodCliqueSet, "roll")
+    live.spec.template.cliques[0].container.env["VERSION"] = "v2"
+    client.update(live)
+
+    def updated():
+        s = client.get(PodCliqueSet, "roll")
+        pods = _ready_pods(client, "roll")
+        return (s.status.rolling_update is None
+                and s.status.generation_hash != old_hash
+                and len(pods) == 2
+                and all(p.meta.labels[c.LABEL_POD_TEMPLATE_HASH]
+                        != old_hash for p in pods)
+                and all(p.spec.container.env.get("VERSION") == "v2"
+                        for p in pods))
+
+    wait_for(updated, timeout=20.0, desc="rolling update complete")
+    # Placement reuse: the recreated gang prefers the replaced gang's slice.
+    assert client.get(PodGang, "roll-0").status.assigned_slice == old_slice
+    # Per-update placement hints are cleaned up once the rollout is done.
+    annotations = client.get(PodCliqueSet, "roll").meta.annotations
+    assert not any("preferred-slice" in k for k in annotations)
+
+
+def test_rolling_update_one_replica_at_a_time(cluster):
+    """The availability floor: with 2 replicas, at least one must keep its
+    pods ready at every instant of the rollout."""
+    import threading
+    client = cluster.client
+    client.create(simple_pcs(name="grad", replicas=2, pods=2, chips=4))
+    wait_for(lambda: len(_ready_pods(client, "grad")) == 4, desc="ready")
+    old_hash = client.get(PodCliqueSet, "grad").status.generation_hash
+
+    violations = []
+    stop = threading.Event()
+
+    def monitor():
+        while not stop.is_set():
+            by_replica = {"0": 0, "1": 0}
+            for p in _ready_pods(client, "grad"):
+                by_replica[p.meta.labels[c.LABEL_PCS_REPLICA]] += 1
+            if all(v < 2 for v in by_replica.values()):
+                violations.append(dict(by_replica))
+            time.sleep(0.01)
+
+    t = threading.Thread(target=monitor, daemon=True)
+    t.start()
+    live = client.get(PodCliqueSet, "grad")
+    live.spec.template.cliques[0].container.env["VERSION"] = "v2"
+    client.update(live)
+
+    def updated():
+        s = client.get(PodCliqueSet, "grad")
+        pods = _ready_pods(client, "grad")
+        return (s.status.rolling_update is None
+                and s.status.generation_hash != old_hash and len(pods) == 4
+                and all(p.meta.labels[c.LABEL_POD_TEMPLATE_HASH] != old_hash
+                        for p in pods))
+
+    wait_for(updated, timeout=30.0, desc="both replicas updated")
+    stop.set()
+    t.join(1.0)
+    assert not violations, f"both replicas down simultaneously: {violations[:3]}"
