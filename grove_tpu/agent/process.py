@@ -1,0 +1,197 @@
+"""Process-running node agent: pods become real OS processes.
+
+The kubelet analog for real deployments (and the richest e2e tier): pods
+bound to non-fake nodes are exec'd with the full injected environment
+(GROVE_* identity, TPU_WORKER_ID/TPU_WORKER_HOSTNAMES, slice metadata from
+the node's labels). The startup barrier (grove-initc analog, I1) is
+enforced before exec — the process only starts once every parent
+PodClique has >= min_available Ready pods. Exit code 0 → Succeeded,
+non-zero → Failed (which the PodClique controller self-heals by
+recreating the pod at the same index).
+
+One ProcessKubelet serves every real node in the cluster — in a true
+multi-host deployment each host runs one with ``node_name`` pinned.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+
+from grove_tpu.agent.barrier import barrier_satisfied
+from grove_tpu.api import Node, Pod, constants as c
+from grove_tpu.api.core import PodPhase
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+
+class ProcessKubelet:
+    def __init__(self, client: Client, namespace: str = "default",
+                 node_name: str | None = None, tick: float = 0.05,
+                 workdir: str | None = None):
+        self.client = client
+        self.namespace = namespace
+        self.node_name = node_name
+        self.tick = tick
+        self.workdir = workdir
+        self.log = get_logger("agent.process")
+        # pod name -> (pod uid, proc): the uid detects delete+recreate under
+        # the same name within one tick (rolling updates), so a stale
+        # process is never adopted by the replacement pod.
+        self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="process-kubelet", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+        for name, (_, proc) in list(self._procs.items()):
+            self._terminate(name, proc)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._pass()
+            except Exception:  # noqa: BLE001 - agent survival barrier
+                self.log.exception("process kubelet pass panicked")
+            time.sleep(self.tick)
+
+    def _my_nodes(self) -> dict[str, Node]:
+        nodes = {}
+        for n in self.client.list(Node, self.namespace):
+            if n.spec.fake:
+                continue
+            if self.node_name is not None and n.meta.name != self.node_name:
+                continue
+            nodes[n.meta.name] = n
+        return nodes
+
+    def _pass(self) -> None:
+        nodes = self._my_nodes()
+        if not nodes:
+            return
+        live_pods = {p.meta.name: p for p in self.client.list(
+            Pod, self.namespace) if p.status.node_name in nodes}
+
+        # Reap: processes whose pod vanished or was replaced (same name,
+        # new uid); exited processes.
+        for name, (uid, proc) in list(self._procs.items()):
+            pod = live_pods.get(name)
+            if pod is None or pod.meta.deletion_timestamp is not None \
+                    or pod.meta.uid != uid:
+                self._terminate(name, proc)
+                continue
+            code = proc.poll()
+            if code is not None:
+                del self._procs[name]
+                self._set_exit_status(pod, code)
+
+        # Launch: bound pending pods whose barrier cleared.
+        for name, pod in live_pods.items():
+            if (pod.status.phase != PodPhase.PENDING
+                    or name in self._procs
+                    or pod.meta.deletion_timestamp is not None):
+                continue
+            if not barrier_satisfied(self.client, pod.spec.startup_barrier,
+                                     self.namespace):
+                continue
+            self._launch(pod, nodes[pod.status.node_name])
+
+    def _launch(self, pod: Pod, node: Node) -> None:
+        argv = pod.spec.container.argv
+        if not argv:
+            self._set_exit_status(pod, 0)
+            return
+        env = dict(os.environ)
+        env.update(pod.spec.container.env)
+        env["GROVE_POD_NAME"] = pod.meta.name
+        env["GROVE_NODE_NAME"] = node.meta.name
+        env[c.ENV_TPU_SLICE_NAME] = node.meta.labels.get(c.NODE_LABEL_SLICE, "")
+        env[c.ENV_TPU_SLICE_TOPOLOGY] = node.meta.labels.get(
+            c.NODE_LABEL_TPU_TOPOLOGY, "")
+        try:
+            proc = subprocess.Popen(
+                argv, env=env,
+                cwd=pod.spec.container.workdir or self.workdir or None,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True)
+        except OSError as e:
+            self.log.warning("pod %s: exec failed: %s", pod.meta.name, e)
+
+            def exec_failed(p: Pod) -> None:
+                p.status.phase = PodPhase.FAILED
+                p.status.message = f"exec failed: {e}"
+
+            self._write_status(pod, exec_failed)
+            return
+        self._procs[pod.meta.name] = (pod.meta.uid, proc)
+
+        def running(p: Pod) -> None:
+            p.status.phase = PodPhase.RUNNING
+            p.status.start_time = time.time()
+            p.status.conditions = set_condition(
+                p.status.conditions,
+                Condition(type=c.COND_READY, status="True",
+                          reason="ProcessRunning"))
+
+        self._write_status(pod, running)
+        self.log.info("pod %s: started pid %d on %s", pod.meta.name,
+                      proc.pid, node.meta.name)
+
+    def _set_exit_status(self, pod: Pod, code: int) -> None:
+        def exited(p: Pod) -> None:
+            p.status.phase = (PodPhase.SUCCEEDED if code == 0
+                              else PodPhase.FAILED)
+            p.status.message = f"exit code {code}"
+            p.status.conditions = set_condition(
+                p.status.conditions,
+                Condition(type=c.COND_READY, status="False",
+                          reason="ProcessExited", message=f"code {code}"))
+        self._write_status(pod, exited)
+
+    def _write_status(self, pod: Pod, mutate) -> None:
+        """Apply ``mutate`` to a fresh read and write, retrying conflicts —
+        a swallowed conflict here would permanently lose an exit status
+        (the proc entry is already reaped, so no later pass retries)."""
+        for _ in range(5):
+            try:
+                live = self.client.get(Pod, pod.meta.name, pod.meta.namespace)
+                if live.meta.uid != pod.meta.uid:
+                    return  # replaced under the same name; not our pod
+                mutate(live)
+                self.client.update_status(live)
+                return
+            except NotFoundError:
+                return
+            except GroveError:
+                time.sleep(0.01)
+        self.log.warning("pod %s: status write kept conflicting; dropped",
+                         pod.meta.name)
+
+    def _terminate(self, name: str, proc: subprocess.Popen) -> None:
+        self._procs.pop(name, None)
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+                proc.wait(timeout=2.0)
+            except (ProcessLookupError, subprocess.TimeoutExpired, PermissionError):
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=1.0)  # reap — no zombies
+                except subprocess.TimeoutExpired:
+                    pass
+        self.log.info("pod %s: process terminated", name)

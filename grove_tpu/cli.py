@@ -83,7 +83,14 @@ def _table(rows) -> None:
 
 
 def cmd_run(args: argparse.Namespace) -> int:
-    cluster = new_cluster(fleet=parse_fleet(args.fleet))
+    fleet = parse_fleet(args.fleet)
+    if args.real:
+        fleet.fake = False
+        cluster = new_cluster(fleet=fleet, fake_kubelet=False)
+        from grove_tpu.agent.process import ProcessKubelet
+        cluster.manager.add_runnable(ProcessKubelet(cluster.client))
+    else:
+        cluster = new_cluster(fleet=fleet)
     with cluster:
         client = cluster.client
         t0 = time.time()
@@ -135,6 +142,9 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--timeout", type=float, default=30.0)
     run.add_argument("--hold", type=float, default=0.0,
                      help="keep the cluster up after reporting")
+    run.add_argument("--real", action="store_true",
+                     help="run pods as real OS processes (process kubelet) "
+                          "instead of synthetic fake-node readiness")
     run.set_defaults(fn=cmd_run)
     args = parser.parse_args(argv)
     return args.fn(args)

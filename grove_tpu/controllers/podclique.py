@@ -45,11 +45,19 @@ from grove_tpu.store.client import Client
 
 
 class PodCliqueReconciler:
+    CRASH_BACKOFF_BASE = 0.2
+    CRASH_BACKOFF_MAX = 30.0
+    CRASH_RESET_AFTER = 60.0
+
     def __init__(self, client: Client, scheduler_registry: Registry):
         self.client = client
         self.schedulers = scheduler_registry
         self.expectations = ExpectationsStore()
         self.log = get_logger("podclique")
+        # pod name -> (consecutive failures, not-before timestamp): the
+        # CrashLoopBackOff analog — an instantly-failing workload must not
+        # respawn at full agent tick rate.
+        self._crash_backoff: dict[str, tuple[int, float]] = {}
 
     def reconcile(self, req: Request) -> StepResult:
         try:
@@ -82,14 +90,20 @@ class PodCliqueReconciler:
 
     def _sync_pods(self, pclq: PodClique, pods: list[Pod], gang_name: str,
                    req: Request) -> StepResult | None:
+        import time as _time
         # Pod-level self-healing: Failed pods are deleted so their index
         # is recreated (the kubelet-restart analog). Gang termination only
         # fires when this self-heal cannot keep MinAvailable satisfied.
         failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
         if failed:
+            now = _time.time()
             self.expectations.expect_deletes(
                 req.key, [p.meta.uid for p in failed])
             for p in failed:
+                n, _ = self._crash_backoff.get(p.meta.name, (0, 0.0))
+                delay = min(self.CRASH_BACKOFF_BASE * (2 ** n),
+                            self.CRASH_BACKOFF_MAX)
+                self._crash_backoff[p.meta.name] = (n + 1, now + delay)
                 try:
                     self.client.delete(Pod, p.meta.name, p.meta.namespace)
                     self.expectations.observe_delete(req.key, p.meta.uid)
@@ -101,6 +115,7 @@ class PodCliqueReconciler:
             return StepResult.requeue(0.05)
         want = pclq.spec.replicas
         if len(pods) < want:
+            now = _time.time()
             used = []
             for p in pods:
                 try:
@@ -108,6 +123,25 @@ class PodCliqueReconciler:
                 except ValueError:
                     pass
             indices = available_indices(used, want - len(pods))
+            # CrashLoopBackOff: hold back indices whose pod keeps failing.
+            ready_names = {p.meta.name for p in pods if is_condition_true(
+                p.status.conditions, c.COND_READY)}
+            for name in list(self._crash_backoff):
+                n, not_before = self._crash_backoff[name]
+                if name in ready_names or now - not_before > self.CRASH_RESET_AFTER:
+                    del self._crash_backoff[name]
+            held = []
+            allowed = []
+            for i in indices:
+                name = namegen.pod_name(pclq.meta.name, i)
+                entry = self._crash_backoff.get(name)
+                if entry is not None and entry[1] > now:
+                    held.append(entry[1] - now)
+                else:
+                    allowed.append(i)
+            indices = allowed
+            if not indices and held:
+                return StepResult.requeue(min(held))
             new_pods = [self._build_pod(pclq, i, gang_name) for i in indices]
             self.expectations.expect_creates(
                 req.key, [p.meta.uid for p in new_pods])
@@ -119,6 +153,10 @@ class PodCliqueReconciler:
                 # forgotten or the next syncs would stall until TTL.
                 self.expectations.forget(req.key)
                 return StepResult.fail(errors[0])
+            if held:
+                # Some indices are in crash backoff: revisit when the
+                # soonest backoff expires (no store event will fire).
+                return StepResult.requeue(min(held))
         elif len(pods) > want:
             doomed = sorted(pods, key=_deletion_order)[:len(pods) - want]
             self.expectations.expect_deletes(

@@ -1,0 +1,4 @@
+from grove_tpu.scale.measurement import TimelineTracker
+from grove_tpu.scale.runner import ScaleConfig, run_scale_test
+
+__all__ = ["TimelineTracker", "ScaleConfig", "run_scale_test"]

@@ -1,0 +1,3 @@
+from grove_tpu.scale.runner import main
+
+raise SystemExit(main())

@@ -1,0 +1,49 @@
+"""Timeline measurement for scale tests.
+
+Role parity with reference operator/e2e/measurement/measurement.go:167-320
+(TimelineTracker): phases with named milestones, durations derived from
+first/last event, JSON export for dashboards / the driver's bench record.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class TimelineTracker:
+    def __init__(self) -> None:
+        self._events: list[tuple[str, str, float]] = []  # (phase, name, ts)
+        self.t0 = time.time()
+
+    def record(self, phase: str, name: str) -> float:
+        ts = time.time()
+        self._events.append((phase, name, ts))
+        return ts - self.t0
+
+    def duration(self, phase: str, start: str, end: str) -> float | None:
+        ts = {name: t for p, name, t in self._events if p == phase}
+        if start in ts and end in ts:
+            return ts[end] - ts[start]
+        return None
+
+    def phase_events(self, phase: str) -> list[tuple[str, float]]:
+        return [(name, t - self.t0) for p, name, t in self._events
+                if p == phase]
+
+    def export(self) -> dict:
+        return {
+            "t0": self.t0,
+            "events": [{"phase": p, "name": n, "offset_s": round(t - self.t0, 4)}
+                       for p, n, t in self._events],
+        }
+
+    def dump_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.export(), f, indent=2)
+
+    def summary(self) -> str:
+        lines = []
+        for p, n, t in self._events:
+            lines.append(f"{t - self.t0:9.3f}s  {p:24s} {n}")
+        return "\n".join(lines)

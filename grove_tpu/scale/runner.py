@@ -1,0 +1,164 @@
+"""Scale test runner: N-pod PodCliqueSet deploy / steady-state / delete.
+
+Role parity with reference e2e/tests/scale/scale_test.go:166-258
+(Test_ScaleTest_1000): deploy a large PCS onto a fake fleet, measure
+  deploy:    pcs-created → pods-created → pods-scheduled → pods-ready →
+             pcs-available
+  steady:    reconcile count over a quiet window (no-op cost)
+  delete:    delete request latency + children-gone latency
+and export the timeline as JSON.
+
+Run directly:  python -m grove_tpu.scale --pods 1000
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from grove_tpu.api import (
+    Pod,
+    PodClique,
+    PodCliqueSet,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.scale.measurement import TimelineTracker
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+
+@dataclasses.dataclass
+class ScaleConfig:
+    pods: int = 1000
+    cliques: int = 4              # pods spread over this many cliques
+    pcs_name: str = "scale-pcs"
+    deploy_timeout: float = 600.0  # reference budget: 10 min
+    steady_window: float = 2.0
+    poll: float = 0.05
+
+
+def _fleet_for(pods: int) -> FleetSpec:
+    # CPU-style pods (chips=0) at scale — capacity is node count, matching
+    # the reference's KWOK nginx pods. ~64 pods/host keeps the node list
+    # small relative to the pod list.
+    hosts = max(4, pods // 64)
+    # v5e 4x4 slice = 4 hosts; count = hosts/4
+    return FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                       count=max(1, hosts // 4))])
+
+
+def run_scale_test(cfg: ScaleConfig) -> dict:
+    tracker = TimelineTracker()
+    cluster = new_cluster(fleet=_fleet_for(cfg.pods))
+    per_clique = cfg.pods // cfg.cliques
+    assert per_clique * cfg.cliques == cfg.pods, "pods must divide by cliques"
+    with cluster:
+        client = cluster.client
+        pcs = PodCliqueSet(
+            meta=new_meta(cfg.pcs_name),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name=f"role{i}", replicas=per_clique,
+                    min_available=per_clique, tpu_chips_per_pod=0,
+                    container=ContainerSpec(argv=["sleep", "inf"]))
+                    for i in range(cfg.cliques)],
+            )))
+        client.create(pcs)
+        tracker.record("deploy", "pcs-created")
+
+        sel = {c.LABEL_PCS_NAME: cfg.pcs_name}
+        deadline = time.time() + cfg.deploy_timeout
+        milestones = {"pods-created": False, "pods-scheduled": False,
+                      "pods-ready": False, "pcs-available": False}
+        while time.time() < deadline and not all(milestones.values()):
+            pods = client.list(Pod, selector=sel)
+            if not milestones["pods-created"] and len(pods) >= cfg.pods:
+                tracker.record("deploy", "pods-created")
+                milestones["pods-created"] = True
+            if not milestones["pods-scheduled"] and len(pods) >= cfg.pods \
+                    and all(p.status.node_name for p in pods):
+                tracker.record("deploy", "pods-scheduled")
+                milestones["pods-scheduled"] = True
+            if not milestones["pods-ready"] and len(pods) >= cfg.pods and all(
+                    is_condition_true(p.status.conditions, c.COND_READY)
+                    for p in pods):
+                tracker.record("deploy", "pods-ready")
+                milestones["pods-ready"] = True
+            if not milestones["pcs-available"]:
+                live = client.get(PodCliqueSet, cfg.pcs_name)
+                if live.status.available_replicas >= 1:
+                    tracker.record("deploy", "pcs-available")
+                    milestones["pcs-available"] = True
+            time.sleep(cfg.poll)
+        if not all(milestones.values()):
+            missing = [k for k, v in milestones.items() if not v]
+            raise TimeoutError(f"deploy milestones not reached: {missing}")
+
+        # Steady-state no-op reconcile cost (reference scale_test.go:216-240)
+        cluster.manager.wait_idle(timeout=30.0, settle=0.3)
+        before = {name: v["reconciles"] for name, v in
+                  cluster.manager.healthz()["controllers"].items()}
+        tracker.record("steady-state", "window-start")
+        time.sleep(cfg.steady_window)
+        tracker.record("steady-state", "window-end")
+        after = {name: v["reconciles"] for name, v in
+                 cluster.manager.healthz()["controllers"].items()}
+        steady_reconciles = sum(after[k] - before[k] for k in after)
+
+        # Delete: request latency + full cascade
+        t_del = time.time()
+        client.delete(PodCliqueSet, cfg.pcs_name)
+        delete_request_s = time.time() - t_del
+        tracker.record("delete", "request-returned")
+        while client.list(Pod, selector=sel) or client.list(
+                PodClique, selector=sel):
+            time.sleep(cfg.poll)
+        tracker.record("delete", "children-gone")
+
+    result = {
+        "pods": cfg.pods,
+        "deploy_pods_created_s": tracker.duration(
+            "deploy", "pcs-created", "pods-created"),
+        "deploy_pods_scheduled_s": tracker.duration(
+            "deploy", "pcs-created", "pods-scheduled"),
+        "deploy_pods_ready_s": tracker.duration(
+            "deploy", "pcs-created", "pods-ready"),
+        "deploy_available_s": tracker.duration(
+            "deploy", "pcs-created", "pcs-available"),
+        "steady_reconciles_per_s": steady_reconciles / cfg.steady_window,
+        "delete_request_s": delete_request_s,
+        "delete_cascade_s": tracker.duration(
+            "delete", "request-returned", "children-gone"),
+        "timeline": tracker.export(),
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json as _json
+    import sys
+    parser = argparse.ArgumentParser(prog="grove-scale")
+    parser.add_argument("--pods", type=int, default=1000)
+    parser.add_argument("--cliques", type=int, default=4)
+    parser.add_argument("--json", help="write full timeline JSON here")
+    args = parser.parse_args(argv)
+    result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques))
+    timeline = result.pop("timeline")
+    if args.json:
+        with open(args.json, "w") as f:
+            _json.dump({**result, "timeline": timeline}, f, indent=2)
+    print(_json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

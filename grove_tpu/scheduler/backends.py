@@ -82,8 +82,14 @@ class _PlacementLoop:
 
         def pump():
             while not self._stop.is_set():
-                if watcher.poll(0.2) is not None:
-                    self._wake.set()
+                if watcher.poll(0.2) is None:
+                    continue
+                # Drain the backlog: N queued events are one wake, not N
+                # passes (each pass is O(pods) — per-event passes would be
+                # quadratic during large binds).
+                while watcher.poll(0) is not None:
+                    pass
+                self._wake.set()
 
         threading.Thread(target=pump, name=f"sched-{self.name}-watch",
                          daemon=True).start()

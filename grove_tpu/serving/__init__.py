@@ -1,0 +1,3 @@
+from grove_tpu.serving.engine import DecodeEngine, PrefillResult, PrefillWorker
+
+__all__ = ["DecodeEngine", "PrefillResult", "PrefillWorker"]

@@ -1,0 +1,227 @@
+"""Serving engines: continuous-batching decode + disaggregated prefill.
+
+The workload half of the framework: what runs inside the pods that the
+control plane gang-schedules. The reference operator runs third-party
+engines (vLLM/SGLang — README.md:35-41); here the engine is first-party
+and TPU-shaped:
+
+- fixed decode batch lanes (static shapes; one compiled decode step),
+- prefill and decode as separate jitted programs so they can live in
+  separate pods (disaggregated serving): ``PrefillWorker`` returns the
+  per-sequence KV slab; ``DecodeEngine.insert`` splices it into a free
+  lane (the KV-transfer seam — over ICI/DCN in multi-host deployments),
+- donated cache buffers (no per-step reallocation),
+- a queue-depth metric hook feeding the control plane's autoscaler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.models import llama
+from grove_tpu.models.llama import LlamaConfig
+from grove_tpu.ops.kvcache import KVCache
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray              # [s] int32
+    max_new_tokens: int = 32
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+
+@dataclasses.dataclass
+class PrefillResult:
+    """Everything decode needs to continue a sequence: the KV slab and the
+    first sampled token (the disaggregation transfer payload)."""
+
+    k: jnp.ndarray        # [layers, s_pad, n_kv, d]
+    v: jnp.ndarray        # [layers, s_pad, n_kv, d]
+    length: int
+    next_token: int
+
+
+class PrefillWorker:
+    """The prefill side of disaggregated serving (chips optimised for
+    throughput over long prompts)."""
+
+    def __init__(self, cfg: LlamaConfig, params, batch: int = 1,
+                 max_prompt: int | None = None):
+        self.cfg = cfg
+        self.params = params
+        self.batch = batch
+        self.max_prompt = max_prompt or cfg.max_seq_len
+
+        def run(params, tokens, lengths, cache):
+            return llama.prefill(cfg, params, tokens, cache, lengths)
+
+        self._prefill = jax.jit(run, donate_argnums=(3,))
+        self._cache = KVCache.create(cfg.n_layers, batch, self.max_prompt,
+                                     cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+
+    def prefill(self, prompts: list[np.ndarray]) -> list[PrefillResult]:
+        """Prefill up to ``batch`` prompts (right-padded to one length)."""
+        assert 0 < len(prompts) <= self.batch
+        s_pad = self.max_prompt
+        toks = np.zeros((self.batch, s_pad), np.int32)
+        lengths = np.zeros((self.batch,), np.int32)
+        for i, p in enumerate(prompts):
+            toks[i, :len(p)] = p
+            lengths[i] = len(p)
+        logits, cache = self._prefill(self.params, jnp.asarray(toks),
+                                      jnp.asarray(lengths), self._cache)
+        self._cache = cache
+        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        out = []
+        for i in range(len(prompts)):
+            out.append(PrefillResult(
+                k=cache.k[:, i], v=cache.v[:, i],
+                length=int(lengths[i]), next_token=int(next_tokens[i])))
+        return out
+
+
+class DecodeEngine:
+    """Continuous-batching decode over fixed lanes.
+
+    Two operating modes:
+    - standalone: ``admit_prompts`` prefills in-engine (single-pod serving,
+      also the bench path);
+    - disaggregated: ``insert`` splices a PrefillResult produced elsewhere.
+    """
+
+    def __init__(self, cfg: LlamaConfig, key_or_params, batch: int = 8,
+                 max_len: int | None = None,
+                 metric_hook: Callable[[int], None] | None = None):
+        self.cfg = cfg
+        if isinstance(key_or_params, jax.Array) and key_or_params.dtype == jnp.uint32:
+            self.params = llama.init_params(cfg, key_or_params)
+        else:
+            self.params = key_or_params
+        self.batch = batch
+        self.max_len = max_len or cfg.max_seq_len
+        self.metric_hook = metric_hook
+        self.cache = KVCache.create(cfg.n_layers, batch, self.max_len,
+                                    cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+        self._tokens = jnp.zeros((batch,), jnp.int32)
+        self._active = np.zeros((batch,), bool)
+        self._requests: list[Request | None] = [None] * batch
+        self._queue: deque[Request] = deque()
+        self._next_rid = 0
+        self.completed: list[Request] = []
+        self.steps = 0
+
+        def step_fn(params, tokens, cache):
+            logits, cache = llama.decode_step(cfg, params, tokens, cache)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._step = jax.jit(step_fn, donate_argnums=(2,))
+
+        def pf(params, tokens, lengths, cache):
+            return llama.prefill(cfg, params, tokens, cache, lengths)
+
+        self._prefill = jax.jit(pf, donate_argnums=(3,))
+
+    # ---- request intake ----
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int = 32) -> int:
+        req = Request(rid=self._next_rid, prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=max_new_tokens)
+        self._next_rid += 1
+        self._queue.append(req)
+        self._report_metric()
+        return req.rid
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def _report_metric(self) -> None:
+        if self.metric_hook is not None:
+            self.metric_hook(len(self._queue))
+
+    # ---- standalone mode (bench path) ----
+
+    def admit_prompts(self, prompts: jnp.ndarray) -> None:
+        """Prefill a full batch [batch, s] into the lanes (all same len)."""
+        b, s = prompts.shape
+        assert b == self.batch
+        lengths = jnp.full((b,), s, jnp.int32)
+        logits, self.cache = self._prefill(self.params, prompts, lengths,
+                                           self.cache)
+        self._tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        self._active[:] = True
+
+    # ---- disaggregated mode ----
+
+    def free_lanes(self) -> list[int]:
+        return [i for i in range(self.batch) if not self._active[i]]
+
+    def insert(self, lane: int, result: PrefillResult,
+               request: Request | None = None) -> None:
+        """Splice a prefilled sequence into a free lane (KV handoff)."""
+        assert not self._active[lane], f"lane {lane} busy"
+        s = result.k.shape[1]
+        k = self.cache.k.at[:, lane, :s].set(result.k.astype(self.cache.k.dtype))
+        v = self.cache.v.at[:, lane, :s].set(result.v.astype(self.cache.v.dtype))
+        lengths = self.cache.lengths.at[lane].set(result.length)
+        self.cache = KVCache(k=k, v=v, lengths=lengths)
+        self._tokens = self._tokens.at[lane].set(result.next_token)
+        self._active[lane] = True
+        self._requests[lane] = request
+        if request is not None:
+            request.generated.append(result.next_token)
+
+    def admit_from_queue(self, prefiller: PrefillWorker) -> int:
+        """Move queued requests through the prefiller into free lanes."""
+        admitted = 0
+        lanes = self.free_lanes()
+        while lanes and self._queue:
+            take = min(len(lanes), prefiller.batch, len(self._queue))
+            reqs = [self._queue.popleft() for _ in range(take)]
+            results = prefiller.prefill([r.prompt for r in reqs])
+            for req, res in zip(reqs, results):
+                self.insert(lanes.pop(0), res, req)
+                admitted += 1
+        self._report_metric()
+        return admitted
+
+    # ---- decode ----
+
+    def step(self) -> None:
+        """One decode step across all lanes (inactive lanes compute too —
+        static shapes beat per-lane control flow on TPU)."""
+        self._tokens, self.cache = self._step(self.params, self._tokens,
+                                              self.cache)
+        self.steps += 1
+        # Lane bookkeeping on host (cheap; token fetch is one tiny array).
+        if any(r is not None for r in self._requests):
+            toks = np.asarray(self._tokens)
+            room = np.asarray(self.cache.has_room())
+            for i, req in enumerate(self._requests):
+                if req is None or not self._active[i]:
+                    continue
+                req.generated.append(int(toks[i]))
+                if len(req.generated) >= req.max_new_tokens or not room[i]:
+                    req.done = True
+                    self.completed.append(req)
+                    self._requests[i] = None
+                    self._active[i] = False
+                    lengths = self.cache.lengths.at[i].set(0)
+                    self.cache = self.cache._replace(lengths=lengths)
+
+    def sync(self) -> None:
+        self._tokens.block_until_ready()
+
+    def run(self, steps: int) -> None:
+        for _ in range(steps):
+            self.step()
+        self.sync()

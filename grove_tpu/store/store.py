@@ -106,10 +106,22 @@ class Store:
         return w
 
     def _emit(self, etype: EventType, obj: Any) -> None:
+        if not self._watchers:
+            return
+        # One clone shared by all watchers: event payloads are read-only
+        # by convention (mappers extract names/labels; reconcilers re-read
+        # through the client, never mutate event objects).
+        shared = Event(etype, clone(obj))
         for w in self._watchers:
-            w._offer(Event(etype, clone(obj)))
+            w._offer(shared)
 
     # ---- reads ----
+
+    # Stored objects are never mutated in place after insertion (writes
+    # replace the dict entry with a fresh clone) — so reads may snapshot
+    # references under the lock and clone OUTSIDE it. Cloning N objects
+    # inside the global lock would serialise every controller thread
+    # behind each large list.
 
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
         with self._lock:
@@ -117,20 +129,18 @@ class Store:
             obj = objs.get((namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind_cls.KIND} {namespace}/{name} not found")
-            return clone(obj)
+        return clone(obj)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
              selector: dict[str, str] | None = None) -> list[Any]:
         with self._lock:
             objs = self._objects.get(kind_cls.KIND, {})
-            out = []
-            for (ns, _), obj in objs.items():
-                if namespace is not None and ns != namespace:
-                    continue
-                if matches_labels(obj, selector):
-                    out.append(clone(obj))
-            out.sort(key=lambda o: o.meta.name)
-            return out
+            refs = [obj for (ns, _), obj in objs.items()
+                    if (namespace is None or ns == namespace)
+                    and matches_labels(obj, selector)]
+        out = [clone(o) for o in refs]
+        out.sort(key=lambda o: o.meta.name)
+        return out
 
     # ---- writes ----
 
@@ -221,9 +231,12 @@ class Store:
             self._admit("delete", clone(obj), None, actor)
             if obj.meta.finalizers:
                 if obj.meta.deletion_timestamp is None:
-                    obj.meta.deletion_timestamp = time.time()
-                    obj.meta.resource_version = next(self._rv)
-                    self._emit(EventType.MODIFIED, obj)
+                    # Replace, never mutate in place (readers hold refs).
+                    marked = clone(obj)
+                    marked.meta.deletion_timestamp = time.time()
+                    marked.meta.resource_version = next(self._rv)
+                    self._objects[kind_cls.KIND][(namespace, name)] = marked
+                    self._emit(EventType.MODIFIED, marked)
                 return
             self._remove(obj)
 
@@ -241,8 +254,10 @@ class Store:
         for dep in dependents:
             if dep.meta.finalizers:
                 if dep.meta.deletion_timestamp is None:
-                    dep.meta.deletion_timestamp = time.time()
-                    dep.meta.resource_version = next(self._rv)
-                    self._emit(EventType.MODIFIED, dep)
+                    marked = clone(dep)
+                    marked.meta.deletion_timestamp = time.time()
+                    marked.meta.resource_version = next(self._rv)
+                    self._objects[dep.KIND][_key(dep)] = marked
+                    self._emit(EventType.MODIFIED, marked)
             else:
                 self._remove(dep)

@@ -1,0 +1,135 @@
+"""E2E with REAL processes: pods exec, env contract lands in the process,
+startup order holds across OS processes, deletion kills them, crashes
+self-heal. The richest tier of the test ladder (SURVEY.md §4: this is
+what the reference cannot do without a k8s cluster; here it needs only
+fork/exec)."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from grove_tpu.agent.process import ProcessKubelet
+from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=2)], fake=False)
+    cl = new_cluster(fleet=fleet, fake_kubelet=False)
+    kubelet = ProcessKubelet(cl.client, workdir=str(tmp_path))
+    cl.manager.add_runnable(kubelet)
+    with cl:
+        yield cl, tmp_path
+
+
+def _env_dump_argv(out_dir, marker):
+    code = (
+        "import json, os, time, sys\n"
+        f"path = os.path.join({str(out_dir)!r}, "
+        "os.environ['GROVE_POD_NAME'] + '.json')\n"
+        "json.dump({k: v for k, v in os.environ.items()}, open(path, 'w'))\n"
+        f"time.sleep(120)\n"
+    )
+    return [sys.executable, "-c", code]
+
+
+def test_pods_run_as_processes_with_env(cluster):
+    cl, tmp = cluster
+    client = cl.client
+    client.create(PodCliqueSet(
+        meta=new_meta("realpcs"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, tpu_chips_per_pod=4,
+                container=ContainerSpec(argv=_env_dump_argv(tmp, "w")))],
+        ))))
+    wait_for(lambda: all(
+        p.status.phase == PodPhase.RUNNING
+        for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "realpcs"}))
+        and len(client.list(Pod, selector={c.LABEL_PCS_NAME: "realpcs"})) == 2,
+        timeout=15.0, desc="processes running")
+
+    # The process observed the full injected contract.
+    def dumped():
+        return all((tmp / f"realpcs-0-w-{i}.json").exists() for i in (0, 1))
+    wait_for(dumped, timeout=10.0, desc="env dumps written")
+    env0 = json.loads((tmp / "realpcs-0-w-0.json").read_text())
+    assert env0[c.ENV_TPU_WORKER_ID] == "0"
+    assert env0[c.ENV_TPU_WORKER_HOSTNAMES] == "realpcs-0-w-0,realpcs-0-w-1"
+    assert env0[c.ENV_PCS_NAME] == "realpcs"
+    assert env0[c.ENV_TPU_SLICE_NAME]  # node's slice label propagated
+    assert env0[c.ENV_TPU_SLICE_TOPOLOGY] == "2x4"
+
+
+def test_delete_terminates_processes(cluster):
+    cl, tmp = cluster
+    client = cl.client
+    client.create(PodCliqueSet(
+        meta=new_meta("killme"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=1, tpu_chips_per_pod=4,
+                container=ContainerSpec(
+                    argv=[sys.executable, "-c",
+                          f"open({str(tmp)!r} + '/alive.pid', 'w')"
+                          ".write(str(__import__('os').getpid()));"
+                          "__import__('time').sleep(120)"]))],
+        ))))
+    wait_for(lambda: (tmp / "alive.pid").exists(), timeout=15.0,
+             desc="process started")
+    pid = int((tmp / "alive.pid").read_text())
+    os.kill(pid, 0)  # alive
+    client.delete(PodCliqueSet, "killme")
+
+    def dead():
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+    wait_for(dead, timeout=10.0, desc="process terminated on delete")
+
+
+def test_crash_self_heals_with_new_process(cluster):
+    cl, tmp = cluster
+    client = cl.client
+    counter = tmp / "starts"
+    counter.mkdir()
+    # Each run appends a file; first run crashes, later runs stay up.
+    code = (
+        "import os, time, uuid\n"
+        f"d = {str(counter)!r}\n"
+        "n = len(os.listdir(d))\n"
+        "open(os.path.join(d, str(uuid.uuid4())), 'w').close()\n"
+        "if n == 0:\n"
+        "    raise SystemExit(3)\n"
+        "time.sleep(120)\n"
+    )
+    client.create(PodCliqueSet(
+        meta=new_meta("crashy"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=1, tpu_chips_per_pod=4,
+                container=ContainerSpec(
+                    argv=[sys.executable, "-c", code]))],
+        ))))
+    wait_for(lambda: len(list(counter.iterdir())) >= 2, timeout=20.0,
+             desc="crashed pod recreated and relaunched")
+    wait_for(lambda: all(
+        p.status.phase == PodPhase.RUNNING
+        for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "crashy"})),
+        timeout=15.0, desc="eventually running")

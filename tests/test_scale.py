@@ -1,0 +1,70 @@
+"""Scale + soak: the reference's scale-test ladder shrunk to CI size
+(Test_ScaleTest_1000 → 300 pods here; the full 1000 runs via
+`python -m grove_tpu.scale --pods 1000`)."""
+
+import time
+
+import numpy as np
+
+from grove_tpu.api import Pod, PodGang, constants as c
+from grove_tpu.scale.runner import ScaleConfig, run_scale_test
+
+
+def test_scale_300_pods_within_budget():
+    res = run_scale_test(ScaleConfig(pods=300, cliques=3,
+                                     deploy_timeout=120.0,
+                                     steady_window=1.0))
+    assert res["deploy_pods_created_s"] < 30
+    assert res["deploy_pods_ready_s"] < 90
+    assert res["deploy_available_s"] < 90
+    # Steady state must be quiet (no-op reconcile storm would show here).
+    assert res["steady_reconciles_per_s"] < 20
+    # Delete request returns fast; cascade completes.
+    assert res["delete_request_s"] < 1.0
+    assert res["delete_cascade_s"] < 30
+
+
+def test_soak_scale_cycles():
+    """Repeated scale out/in (reference soak_test.go): the system must
+    converge every cycle without leaking pods or gangs."""
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+    from test_e2e_simple import wait_for
+    from test_availability import _ready_pods
+    from grove_tpu.api import PodCliqueSet, new_meta
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.podcliqueset import (
+        AutoScalingConfig, PodCliqueSetSpec, PodCliqueSetTemplate,
+        PodCliqueTemplate, ScalingGroupConfig)
+
+    fleet = FleetSpec(slices=[SliceSpec(topology="4x4", count=4)])
+    with new_cluster(fleet=fleet) as cl:
+        client = cl.client
+        client.create(PodCliqueSet(
+            meta=new_meta("soak"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, tpu_chips_per_pod=4,
+                    container=ContainerSpec(argv=["sleep", "inf"]))],
+                scaling_groups=[ScalingGroupConfig(
+                    name="m", clique_names=["w"], replicas=1, min_available=1,
+                    auto_scaling=AutoScalingConfig(
+                        min_replicas=1, max_replicas=4,
+                        metric="queue_depth", target_value=10.0))],
+            ))))
+        wait_for(lambda: len(_ready_pods(client, "soak")) == 2, desc="base")
+        for cycle in range(3):
+            cl.metrics.set("PodCliqueScalingGroup", "soak-0-m",
+                           "queue_depth", 40.0)   # -> 4 replicas
+            wait_for(lambda: len(_ready_pods(client, "soak")) == 8,
+                     timeout=20.0, desc=f"cycle {cycle} out")
+            cl.metrics.set("PodCliqueScalingGroup", "soak-0-m",
+                           "queue_depth", 0.1)    # -> 1 replica
+            wait_for(lambda: len(_ready_pods(client, "soak")) == 2,
+                     timeout=20.0, desc=f"cycle {cycle} in")
+        # No leaked gangs after the churn.
+        wait_for(lambda: {g.meta.name for g in client.list(
+            PodGang, selector={c.LABEL_PCS_NAME: "soak"})} == {"soak-0"},
+            desc="gangs pruned")
+        # No leaked pods.
+        assert len(client.list(Pod, selector={c.LABEL_PCS_NAME: "soak"})) == 2
