@@ -1,0 +1,72 @@
+"""ClusterTopology controller (C5).
+
+Parity with reference internal/controller/clustertopology + internal/
+clustertopology: for every topology-aware scheduler backend, either sync
+the CT's level hierarchy into the backend (auto-managed) or drift-check
+an externally-managed view; status records synced backends and drift.
+``ensure_default_topology`` is the startup pre-sync
+(clustertopology.go:31) — controllers start with a valid hierarchy even
+before any CT is applied.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import ClusterTopology, new_meta
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import AlreadyExistsError, GroveError, NotFoundError
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.scheduler.framework import Registry, TopologyAware
+from grove_tpu.store.client import Client
+
+DEFAULT_CT_NAME = "default"
+
+
+def ensure_default_topology(client: Client) -> ClusterTopology:
+    """Create the default TPU topology CT if none exists (startup pre-sync)."""
+    try:
+        return client.get(ClusterTopology, DEFAULT_CT_NAME)
+    except NotFoundError:
+        pass
+    ct = ClusterTopology(meta=new_meta(DEFAULT_CT_NAME))
+    try:
+        return client.create(ct)
+    except AlreadyExistsError:
+        return client.get(ClusterTopology, DEFAULT_CT_NAME)
+
+
+class ClusterTopologyReconciler:
+    def __init__(self, client: Client, scheduler_registry: Registry):
+        self.client = client
+        self.schedulers = scheduler_registry
+        self.log = get_logger("clustertopology")
+
+    def reconcile(self, req: Request) -> StepResult:
+        try:
+            ct = self.client.get(ClusterTopology, req.name, req.namespace)
+        except NotFoundError:
+            return StepResult.finished()
+        if ct.meta.deletion_timestamp is not None:
+            return StepResult.finished()
+
+        synced: list[str] = []
+        drift = False
+        for backend in self.schedulers.backends():
+            if not isinstance(backend, TopologyAware):
+                continue
+            if ct.spec.externally_managed:
+                if backend.check_topology_drift(ct):
+                    drift = True
+                    self.log.warning(
+                        "topology drift: backend %s disagrees with CT %s",
+                        backend.name, ct.meta.name)
+            else:
+                backend.sync_topology(ct)
+                synced.append(backend.name)
+        ct.status.synced_backends = synced
+        ct.status.drift_detected = drift
+        try:
+            self.client.update_status(ct)
+        except GroveError:
+            pass
+        return StepResult.finished()

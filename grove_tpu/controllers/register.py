@@ -86,6 +86,20 @@ def register_controllers(mgr: Manager) -> Registry:
     gang_ctrl.watches(["PodGang"], self_requests)
     mgr.add_controller(gang_ctrl)
 
+    if cfg.topology_aware_scheduling.enabled:
+        from grove_tpu.controllers.clustertopology import (
+            ClusterTopologyReconciler,
+            ensure_default_topology,
+        )
+        ensure_default_topology(mgr.client)  # startup pre-sync
+        ct = ClusterTopologyReconciler(mgr.client, registry)
+        ct_ctrl = Controller("clustertopology", mgr.client, ct.reconcile,
+                             workers=cfg.concurrency.clustertopology,
+                             backoff_base=cfg.requeue_base_seconds,
+                             backoff_max=cfg.requeue_max_seconds)
+        ct_ctrl.watches(["ClusterTopology"], self_requests)
+        mgr.add_controller(ct_ctrl)
+
     for backend in registry.backends():
         runnable = backend.runnable()
         if runnable is not None:

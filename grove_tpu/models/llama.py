@@ -143,26 +143,46 @@ def _mlp_block(cfg: LlamaConfig, x, lp):
                           lp["w_down"]).astype(x.dtype)
 
 
-def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset):
-    """One decoder layer over a full sequence. x: [b, s, d_model]."""
+def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset,
+                   attn_fn=None):
+    """One decoder layer over a full sequence. x: [b, s, d_model].
+
+    ``attn_fn(q, k, v)`` overrides the attention implementation (ring
+    attention for sequence-parallel long context; pallas flash kernels).
+    """
     q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
-    attn = causal_attention(q, k, v, q_offset=q_offset)
+    if attn_fn is None:
+        attn = causal_attention(q, k, v, q_offset=q_offset)
+    else:
+        attn = attn_fn(q, k, v)
     x = _attn_out(x, attn, lp)
     x = _mlp_block(cfg, x, lp)
     return x, (k, v)
 
 
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
-            positions: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Full forward pass → logits [b, s, vocab]. Training / compile-check path."""
+            positions: jnp.ndarray | None = None,
+            mesh=None, ring: bool = False) -> jnp.ndarray:
+    """Full forward pass → logits [b, s, vocab]. Training / compile-check path.
+
+    ``ring=True`` (requires ``mesh``) computes attention with ring
+    sequence parallelism over the sp axis — the long-context path.
+    """
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = params["tok_embed"][tokens].astype(cfg.dtype)
 
+    attn_fn = None
+    if ring:
+        from grove_tpu.ops.ringattention import ring_attention
+        assert mesh is not None, "ring attention needs the mesh"
+        attn_fn = lambda q, k, v: ring_attention(mesh, q, k, v)  # noqa: E731
+
     def body(x, lp):
-        x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+        x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0,
+                              attn_fn=attn_fn)
         return x, None
 
     x, _ = lax.scan(body, x, params["layers"])
@@ -243,9 +263,10 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return logits, KVCache(k=k_all, v=v_all, lengths=new_lengths)
 
 
-def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+            mesh=None, ring: bool = False) -> jnp.ndarray:
     """Next-token cross-entropy (training path for the multichip dry-run)."""
-    logits = forward(cfg, params, tokens)
+    logits = forward(cfg, params, tokens, mesh=mesh, ring=ring)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -1,0 +1,3 @@
+from grove_tpu.native.loader import native_available, native_plan_gang
+
+__all__ = ["native_available", "native_plan_gang"]

@@ -1,0 +1,154 @@
+"""ctypes loader for the native placement core.
+
+Compiles grove_tpu/native/placement.cpp with the system toolchain on
+first use (cached next to the source); every entry point degrades to the
+pure-Python implementation when no compiler is available, so the control
+plane never hard-depends on the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from grove_tpu.runtime.logger import get_logger
+
+log = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "placement.cpp")
+_LIB = os.path.join(_HERE, "libplacement.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.info("native placement build unavailable (%s); using python "
+                 "fallback", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        have_lib = os.path.exists(_LIB)
+        have_src = os.path.exists(_SRC)
+        stale = (have_lib and have_src
+                 and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if (not have_lib or stale):
+            # No source (pruned install with a prebuilt .so is fine; with
+            # neither, fall back to Python) -> don't try to compile.
+            if not have_src or not _build():
+                if not have_lib:
+                    return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.info("native placement load failed (%s)", e)
+            return None
+        lib.grove_plan_gang.restype = ctypes.c_int
+        lib.grove_plan_gang.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def prewarm(background: bool = True) -> None:
+    """Trigger the (possibly compiling) load off the hot path — the gang
+    backend calls this at init so the first placement pass never stalls
+    behind a g++ invocation."""
+    if background:
+        threading.Thread(target=_load, name="native-prewarm",
+                         daemon=True).start()
+    else:
+        _load()
+
+
+def native_plan_gang(pods, hosts, pack_level: str, required: bool,
+                     prefer_slice: str, spread_penalty: dict[str, float]):
+    """Native-backed equivalent of placement.plan_gang. Returns a
+    PlacementPlan or None (infeasible), or NotImplemented when the native
+    library is unavailable (caller falls back to Python)."""
+    lib = _load()
+    if lib is None:
+        return NotImplemented
+
+    from grove_tpu.scheduler.placement import PlacementPlan, _domain_of
+
+    n_pods = len(pods)
+    n_hosts = len(hosts)
+    if n_pods == 0:
+        return PlacementPlan({}, "", 0.0)
+    if n_hosts == 0:
+        return None
+
+    level = pack_level or "slice"
+    domain_names: list[str] = []
+    domain_ids: dict[str, int] = {}
+    host_domain = (ctypes.c_int32 * n_hosts)()
+    host_free = (ctypes.c_int64 * n_hosts)()
+    for h_i, h in enumerate(hosts):
+        dom = _domain_of(h, level)
+        if dom not in domain_ids:
+            domain_ids[dom] = len(domain_names)
+            domain_names.append(dom)
+        host_domain[h_i] = domain_ids[dom]
+        host_free[h_i] = h.free_chips
+
+    pod_chips = (ctypes.c_int64 * n_pods)()
+    eligible = (ctypes.c_uint8 * (n_pods * n_hosts))()
+    for p_i, p in enumerate(pods):
+        pod_chips[p_i] = p.chips
+        for h_i, h in enumerate(hosts):
+            ok = all(h.labels.get(k) == v for k, v in p.node_selector.items())
+            eligible[p_i * n_hosts + h_i] = 1 if ok else 0
+
+    n_domains = len(domain_names)
+    penalty = (ctypes.c_double * n_domains)()
+    for name, p in (spread_penalty or {}).items():
+        if name in domain_ids:
+            penalty[domain_ids[name]] = p
+    prefer = domain_ids.get(prefer_slice, -1) if prefer_slice else -1
+
+    out_score = ctypes.c_double()
+    out_domain = ctypes.c_int32()
+    out_assign = (ctypes.c_int32 * n_pods)()
+    rc = lib.grove_plan_gang(
+        n_pods, pod_chips, n_hosts, host_free, host_domain, eligible,
+        n_domains, penalty, prefer, 1 if required else 0,
+        ctypes.byref(out_score), ctypes.byref(out_domain), out_assign)
+    if rc < 0:
+        return None
+    assignment = {pods[i].name: hosts[out_assign[i]].name
+                  for i in range(n_pods)}
+    if rc == 1:
+        dom = domain_names[out_domain.value]
+        slice_name = dom if level == "slice" else ""
+    else:
+        slice_name = ""
+    return PlacementPlan(assignment, slice_name, out_score.value)

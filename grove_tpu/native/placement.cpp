@@ -1,0 +1,117 @@
+// Native placement core — gang bin-packing over topology domains.
+//
+// The computational kernel of the gang scheduler (grove_tpu/scheduler/
+// placement.py documents the semantics; this is a drop-in for plan_gang's
+// inner search). The reference implements its scheduler role in Go inside
+// the operator; here the control plane is Python and the hot placement
+// path is C++ behind a C ABI consumed via ctypes.
+//
+// Semantics mirror placement.plan_gang exactly (property-tested against
+// the Python implementation in tests/test_native_placement.py):
+//   - candidate domains = distinct host_domain values
+//   - first-fit-decreasing of pods onto a domain's hosts (hosts ordered
+//     by descending free chips; ties broken by input order)
+//   - eligibility mask gates pod->host placements (node selectors)
+//   - score = used/total_free - penalty[domain] (+10 for prefer_domain)
+//   - required=false falls back to FFD over all hosts (score -1)
+//
+// Build: g++ -O2 -shared -fPIC placement.cpp -o libplacement.so
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Returns: 1 = planned within a domain (*out_domain set), 0 = planned
+// across domains (relaxed), -1 = infeasible. out_assignment[i] = host
+// index for pod i.
+int grove_plan_gang(
+    int32_t n_pods, const int64_t* pod_chips,
+    int32_t n_hosts, const int64_t* host_free, const int32_t* host_domain,
+    const uint8_t* eligible,          // [n_pods * n_hosts] 0/1
+    int32_t n_domains, const double* domain_penalty,
+    int32_t prefer_domain,            // -1 = none
+    int32_t required,
+    double* out_score, int32_t* out_domain, int32_t* out_assignment) {
+
+  // Pods sorted by descending chip request (stable).
+  std::vector<int32_t> pod_order(n_pods);
+  for (int32_t i = 0; i < n_pods; ++i) pod_order[i] = i;
+  std::stable_sort(pod_order.begin(), pod_order.end(),
+                   [&](int32_t a, int32_t b) {
+                     return pod_chips[a] > pod_chips[b];
+                   });
+
+  // Hosts by descending free chips (stable), reused per candidate.
+  std::vector<int32_t> host_order(n_hosts);
+  for (int32_t i = 0; i < n_hosts; ++i) host_order[i] = i;
+  std::stable_sort(host_order.begin(), host_order.end(),
+                   [&](int32_t a, int32_t b) {
+                     return host_free[a] > host_free[b];
+                   });
+
+  std::vector<int64_t> free_work(n_hosts);
+  std::vector<int32_t> assign_work(n_pods);
+
+  // FFD over an allowed host set; returns true when every pod placed.
+  auto ffd = [&](int32_t domain /* -1 = any */) -> bool {
+    for (int32_t h = 0; h < n_hosts; ++h) free_work[h] = host_free[h];
+    for (int32_t p = 0; p < n_pods; ++p) assign_work[p] = -1;
+    for (int32_t pi : pod_order) {
+      bool placed = false;
+      for (int32_t h : host_order) {
+        if (domain >= 0 && host_domain[h] != domain) continue;
+        if (free_work[h] < pod_chips[pi]) continue;
+        if (!eligible[(size_t)pi * n_hosts + h]) continue;
+        assign_work[pi] = h;
+        free_work[h] -= pod_chips[pi];
+        placed = true;
+        break;
+      }
+      if (!placed) return false;
+    }
+    return true;
+  };
+
+  int64_t used = 0;
+  for (int32_t p = 0; p < n_pods; ++p) used += pod_chips[p];
+
+  double best_score = -1e300;
+  int32_t best_domain = -1;
+  std::vector<int32_t> best_assign;
+
+  for (int32_t d = 0; d < n_domains; ++d) {
+    // Skip domains with no hosts.
+    int64_t total_free = 0;
+    bool has_host = false;
+    for (int32_t h = 0; h < n_hosts; ++h) {
+      if (host_domain[h] == d) { total_free += host_free[h]; has_host = true; }
+    }
+    if (!has_host) continue;
+    if (!ffd(d)) continue;
+    double tightness = total_free > 0 ? (double)used / (double)total_free : 1.0;
+    double score = tightness - domain_penalty[d];
+    if (d == prefer_domain) score += 10.0;
+    if (score > best_score) {
+      best_score = score;
+      best_domain = d;
+      best_assign = assign_work;
+    }
+  }
+
+  if (best_domain >= 0) {
+    *out_score = best_score;
+    *out_domain = best_domain;
+    for (int32_t p = 0; p < n_pods; ++p) out_assignment[p] = best_assign[p];
+    return 1;
+  }
+  if (required) return -1;
+  if (!ffd(-1)) return -1;
+  *out_score = -1.0;
+  *out_domain = -1;
+  for (int32_t p = 0; p < n_pods; ++p) out_assignment[p] = assign_work[p];
+  return 0;
+}
+
+}  // extern "C"

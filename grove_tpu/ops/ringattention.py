@@ -1,0 +1,132 @@
+"""Ring attention: causal attention with sequence parallelism over ICI.
+
+Long-context first-class support: the sequence axis is sharded over the
+``sp`` mesh axis; Q stays resident while K/V blocks rotate around the
+ring via ``lax.ppermute`` (nearest-neighbour ICI hops — exactly the
+traffic pattern the orchestrator's slice-atomic gang placement
+guarantees can form). Per-block results merge with the online-softmax
+(log-sum-exp) rule, so memory stays O(seq_local) regardless of total
+sequence length.
+
+The reference operator never touches sequence length (SURVEY.md §5) —
+its role is packing the participants onto one fabric; this module is the
+in-pod half of that contract.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_offset, kv_offset, scale):
+    """Attention of local Q against one K/V block, returning the
+    un-normalised accumulator pieces (max, exp-sum, weighted values).
+
+    q: [b, sq, h, d]; k/v: [b, sk, n_kv, d] (GQA: h = n_kv * group).
+    """
+    b, sq, h, d = q.shape
+    n_kv = k.shape[2]
+    qg = q.reshape(b, sq, n_kv, h // n_kv, d)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg * scale, k,
+                        preferred_element_type=jnp.float32)
+    q_pos = jnp.arange(sq)[:, None] + q_offset
+    kv_pos = jnp.arange(k.shape[1])[None, :] + kv_offset
+    mask = q_pos >= kv_pos
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    block_max = jnp.max(logits, axis=-1)                    # [b,k,g,q]
+    probs = jnp.exp(logits - block_max[..., None])
+    # Fully-masked rows: block_max == NEG_INF -> make their contribution 0.
+    probs = jnp.where((block_max == NEG_INF)[..., None], 0.0, probs)
+    block_sum = jnp.sum(probs, axis=-1)                     # [b,k,g,q]
+    block_out = jnp.einsum("bkgqs,bskd->bkgqd", probs, v.astype(jnp.float32))
+    return block_max, block_sum, block_out
+
+
+def _ring_attention_local(q, k, v, axis_name: str):
+    """Per-shard body (run under shard_map): rotate K/V around the ring."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    n_kv = k.shape[2]
+    scale = d ** -0.5
+    q_offset = idx * sq
+
+    # Mark the fresh accumulators as device-varying so the loop carry
+    # types match after they mix with per-shard data.
+    all_axes = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+    def _varying(x):
+        return lax.pcast(x, all_axes, to="varying")
+
+    acc_max = _varying(jnp.full((b, n_kv, h // n_kv, sq), NEG_INF, jnp.float32))
+    acc_sum = _varying(jnp.zeros((b, n_kv, h // n_kv, sq), jnp.float32))
+    acc_out = _varying(jnp.zeros((b, n_kv, h // n_kv, sq, d), jnp.float32))
+
+    def body(step, carry):
+        acc_max, acc_sum, acc_out, k, v = carry
+        # Blocks rotate i -> i+1 each step, so at step s this shard holds
+        # the block that started (s shards) behind it — progressively
+        # older blocks, which is exactly the causal-friendly order.
+        src = (idx - step) % n
+        kv_offset = src * k.shape[1]
+
+        # Blocks entirely in the causal future contribute nothing; skip
+        # their attention FLOPs (~(n-1)/2n of all blocks). The ppermute
+        # below still runs every step, so the collective stays uniform
+        # across shards.
+        def compute(_):
+            return _block_attention(q, k, v, q_offset, kv_offset, scale)
+
+        def skip(_):
+            g = h // n_kv
+            return (_varying(jnp.full((b, n_kv, g, sq), NEG_INF, jnp.float32)),
+                    _varying(jnp.zeros((b, n_kv, g, sq), jnp.float32)),
+                    _varying(jnp.zeros((b, n_kv, g, sq, d), jnp.float32)))
+
+        block_in_past = src * k.shape[1] <= q_offset + sq - 1
+        bmax, bsum, bout = lax.cond(block_in_past, compute, skip, None)
+        new_max = jnp.maximum(acc_max, bmax)
+        # Guard against (-inf) - (-inf) when a row has seen nothing yet.
+        corr_old = jnp.exp(jnp.where(acc_max == NEG_INF, NEG_INF,
+                                     acc_max - new_max))
+        corr_new = jnp.exp(jnp.where(bmax == NEG_INF, NEG_INF,
+                                     bmax - new_max))
+        acc_sum = acc_sum * corr_old + bsum * corr_new
+        acc_out = acc_out * corr_old[..., None] + bout * corr_new[..., None]
+        # Rotate K/V to the next shard (nearest-neighbour ICI hop).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return new_max, acc_sum, acc_out, k, v
+
+    acc_max, acc_sum, acc_out, _, _ = lax.fori_loop(
+        0, n, body, (acc_max, acc_sum, acc_out, k, v))
+    out = acc_out / jnp.maximum(acc_sum[..., None], 1e-30)
+    # [b, k, g, q, d] -> [b, q, h, d]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention(mesh: Mesh, q, k, v, *, axis_name: str = AXIS_SP):
+    """Causal GQA ring attention over the ``sp`` mesh axis.
+
+    q: [b, s, h, d], k/v: [b, s, n_kv, d] — global shapes; s is sharded
+    over ``sp``, h/n_kv over ``tp``, b over ``dp``.
+    """
+    qspec = P(AXIS_DP, axis_name, AXIS_TP, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec),
+        out_specs=qspec,
+    )
+    return fn(q, k, v)

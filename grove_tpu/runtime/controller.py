@@ -152,6 +152,9 @@ class Controller:
     def start(self) -> None:
         for kinds, mapper, selector in self._watch_specs:
             watcher = self.client.watch(kinds, selector)
+            # Initial resync (the informer initial-LIST): objects created
+            # before start would otherwise never be reconciled.
+            self._resync(kinds, mapper, selector)
             t = threading.Thread(target=self._dispatch, args=(watcher, mapper),
                                  name=f"{self.name}-watch", daemon=True)
             t.start()
@@ -165,6 +168,25 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self.queue.shutdown()
+
+    def _resync(self, kinds, mapper, selector) -> None:
+        from grove_tpu.manifest import KIND_REGISTRY
+        from grove_tpu.store.store import Event, EventType
+        for kind in kinds or []:
+            kind_cls = KIND_REGISTRY.get(kind)
+            if kind_cls is None:
+                continue
+            try:
+                objs = self.client.list(kind_cls, namespace=None,
+                                        selector=selector)
+            except Exception:  # noqa: BLE001 - best-effort warm-up
+                continue
+            for obj in objs:
+                try:
+                    for req in mapper(Event(EventType.ADDED, obj)):
+                        self.queue.add(req)
+                except Exception:  # noqa: BLE001
+                    self.log.exception("resync mapper panic")
 
     def _dispatch(self, watcher, mapper) -> None:
         while not self._stop.is_set():

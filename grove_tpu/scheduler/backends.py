@@ -35,8 +35,18 @@ from grove_tpu.scheduler.placement import (
 from grove_tpu.store.client import Client
 
 
-def build_host_views(client: Client, namespace: str = "default") -> list[HostView]:
-    """Snapshot free capacity per ready TPU host."""
+from grove_tpu.api.clustertopology import DEFAULT_TPU_LEVELS
+
+DEFAULT_LEVEL_LABELS: dict[str, str] = {
+    lvl.domain: lvl.node_label for lvl in DEFAULT_TPU_LEVELS}
+
+
+def build_host_views(client: Client, namespace: str = "default",
+                     level_labels: dict[str, str] | None = None
+                     ) -> list[HostView]:
+    """Snapshot free capacity per ready TPU host, resolving topology
+    domains from node labels via the (possibly CT-synced) level map."""
+    level_labels = level_labels or DEFAULT_LEVEL_LABELS
     used: dict[str, int] = defaultdict(int)
     for pod in client.list(Pod, namespace):
         if pod.status.node_name and pod.status.phase.value in ("Pending", "Running"):
@@ -48,10 +58,9 @@ def build_host_views(client: Client, namespace: str = "default") -> list[HostVie
         labels = node.meta.labels
         views.append(HostView(
             name=node.meta.name,
-            slice_name=labels.get(c.NODE_LABEL_SLICE, ""),
-            pool=labels.get(c.NODE_LABEL_POOL, ""),
-            superblock=labels.get(c.NODE_LABEL_SUPERBLOCK, ""),
             free_chips=node.status.allocatable_chips - used[node.meta.name],
+            domains={domain: labels.get(label, "")
+                     for domain, label in level_labels.items()},
             labels=dict(labels),
         ))
     return views
@@ -123,6 +132,21 @@ class GangBackend:
         self.namespace = "default"
         self.log = get_logger("scheduler.gang")
         self._loop: _PlacementLoop | None = None
+        self._level_labels = dict(DEFAULT_LEVEL_LABELS)
+
+    # ---- TopologyAware interface (reference types.go:59-93) ----
+
+    def sync_topology(self, topology) -> None:
+        """Adopt a ClusterTopology's level hierarchy (auto-managed mode)."""
+        self._level_labels = {lvl.domain: lvl.node_label
+                              for lvl in topology.spec.levels}
+        self.log.info("topology synced: %s", list(self._level_labels))
+
+    def check_topology_drift(self, topology) -> bool:
+        """True when the backend's live view differs from the CT
+        (externally-managed mode: report, don't overwrite)."""
+        return self._level_labels != {lvl.domain: lvl.node_label
+                                      for lvl in topology.spec.levels}
 
     # ---- Backend interface ----
 
@@ -130,6 +154,8 @@ class GangBackend:
         self.client = client
         tick = float(options.get("tick_seconds", "0.2"))
         self._loop = _PlacementLoop("gang", client, tick, self._place_pass)
+        from grove_tpu.native.loader import prewarm
+        prewarm()  # compile the native core off the placement hot path
 
     def prepare_pod(self, pod: Pod, gang_name: str) -> None:
         pod.spec.scheduler_name = self.name
@@ -152,7 +178,7 @@ class GangBackend:
     def _place_pass(self) -> None:
         client = self.client
         assert client is not None
-        hosts = build_host_views(client, self.namespace)
+        hosts = build_host_views(client, self.namespace, self._level_labels)
         gangs = client.list(PodGang, self.namespace)
         scheduled_by_name = {
             g.meta.name: is_condition_true(g.status.conditions, c.COND_SCHEDULED)
@@ -168,7 +194,8 @@ class GangBackend:
                 continue  # scaled capacity never blocks/preempts base gangs
             placed = self._sync_gang(gang, hosts)
             if placed:
-                hosts = build_host_views(client, self.namespace)
+                hosts = build_host_views(client, self.namespace,
+                                         self._level_labels)
 
     def _gang_pods(self, gang: PodGang) -> tuple[list[Pod], int, int]:
         """(existing pods of the gang, total expected, min required)."""

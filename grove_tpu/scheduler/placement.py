@@ -21,14 +21,21 @@ from collections import defaultdict
 
 @dataclasses.dataclass
 class HostView:
-    """Free capacity on one TPU host, with its topology domains."""
+    """Free capacity on one TPU host, with its topology domains.
+
+    ``domains`` maps ClusterTopology level names (pool / superblock /
+    slice / host, or custom hierarchies) to this host's domain value —
+    resolved from node labels by the backend using its synced topology.
+    """
 
     name: str
-    slice_name: str
-    pool: str
-    superblock: str
     free_chips: int
+    domains: dict[str, str] = dataclasses.field(default_factory=dict)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def slice_name(self) -> str:
+        return self.domains.get("slice", "")
 
 
 def _selector_matches(pod: "PodRequest", host: HostView) -> bool:
@@ -50,9 +57,9 @@ class PlacementPlan:
 
 
 def _domain_of(host: HostView, level: str) -> str:
-    return {"slice": host.slice_name, "pool": host.pool,
-            "superblock": host.superblock, "host": host.name,
-            "": ""}.get(level, "")
+    if level == "host":
+        return host.name
+    return host.domains.get(level, "")
 
 
 def _fit_in_hosts(pods: list[PodRequest], hosts: list[HostView]
@@ -83,9 +90,20 @@ def plan_gang(pods: list[PodRequest], hosts: list[HostView],
 
     ``spread_penalty`` maps domain value (at the caller's spread level,
     pre-resolved to slice names) -> penalty subtracted from the score.
+
+    Dispatches to the native C++ core (grove_tpu/native/placement.cpp)
+    when available; this Python body is the reference semantics and the
+    fallback. Disable native with GROVE_NATIVE_PLACEMENT=0.
     """
     if not pods:
         return PlacementPlan({}, "", 0.0)
+    import os
+    if os.environ.get("GROVE_NATIVE_PLACEMENT", "1") != "0":
+        from grove_tpu.native.loader import native_plan_gang
+        result = native_plan_gang(pods, hosts, pack_level, required,
+                                  prefer_slice, spread_penalty or {})
+        if result is not NotImplemented:
+            return result
     spread_penalty = spread_penalty or {}
 
     by_domain: dict[str, list[HostView]] = defaultdict(list)

@@ -1,0 +1,82 @@
+"""ClusterTopology controller: startup pre-sync, backend sync, custom
+hierarchies driving placement, drift detection."""
+
+import time
+
+import pytest
+
+from grove_tpu.api import ClusterTopology, Pod, constants as c
+from grove_tpu.api.clustertopology import TopologyLevel
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def test_default_ct_created_and_synced(cluster):
+    client = cluster.client
+
+    def synced():
+        ct = client.get(ClusterTopology, "default")
+        return "gang" in ct.status.synced_backends
+    wait_for(synced, desc="default CT synced to gang backend")
+    ct = client.get(ClusterTopology, "default")
+    assert [lvl.domain for lvl in ct.spec.levels] == [
+        "pool", "superblock", "slice", "host"]
+    assert not ct.status.drift_detected
+
+
+def test_custom_level_labels_drive_placement(cluster):
+    """Re-point the 'slice' level at a custom node label: gangs must pack
+    by the new domain."""
+    client = cluster.client
+    # Tag both slices' nodes with one custom zone so a 5-host gang (which
+    # cannot fit a single 4-host slice) becomes packable under the custom
+    # hierarchy.
+    from grove_tpu.api import Node
+    for node in client.list(Node):
+        node.meta.labels["example.com/zone"] = "z1"
+        client.update(node)
+    ct = client.get(ClusterTopology, "default")
+    ct.spec.levels = [TopologyLevel("pool", c.NODE_LABEL_POOL),
+                      TopologyLevel("slice", "example.com/zone"),
+                      TopologyLevel("host", c.NODE_LABEL_HOST)]
+    client.update(ct)
+
+    def resynced():
+        return client.get(ClusterTopology,
+                          "default").status.synced_backends == ["gang"]
+    wait_for(resynced, desc="CT resynced")
+    time.sleep(0.3)  # let the backend pick up the new hierarchy
+
+    client.create(simple_pcs(name="wide", pods=5, chips=4))  # 20 chips
+    wait_for(lambda: all(
+        p.status.node_name for p in client.list(
+            Pod, selector={c.LABEL_PCS_NAME: "wide"})) and len(client.list(
+            Pod, selector={c.LABEL_PCS_NAME: "wide"})) == 5,
+        timeout=10.0, desc="gang placed across the custom domain")
+
+
+def test_externally_managed_drift_detection(cluster):
+    client = cluster.client
+    wait_for(lambda: client.get(ClusterTopology,
+                                "default").status.synced_backends,
+             desc="initial sync")
+    ct = client.get(ClusterTopology, "default")
+    ct.spec.externally_managed = True
+    ct.spec.levels = [TopologyLevel("slice", "some.other/label")]
+    client.update(ct)
+
+    def drifted():
+        live = client.get(ClusterTopology, "default")
+        return live.status.drift_detected
+    wait_for(drifted, desc="drift detected (backend view not overwritten)")
