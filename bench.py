@@ -58,11 +58,14 @@ def bare_decode_loop(cfg):
     decode = jax.jit(lambda p, t, c: llama.decode_step(cfg, p, t, c),
                      donate_argnums=(2,))
 
+    import numpy as np
+
     logits, cache = prefill(params, prompt, cache)
     tokens = jnp.argmax(logits, axis=-1)
-    # Warmup / compile.
+    # Warmup / compile; device->host fetch forces real completion (the
+    # tunnelled PJRT backend's block_until_ready can return early).
     tokens_w, cache = decode(params, tokens, cache)
-    tokens_w.block_until_ready()
+    np.asarray(tokens_w)
 
     best = float("inf")
     for _ in range(TIMED_ITERS):
@@ -71,7 +74,7 @@ def bare_decode_loop(cfg):
         for _ in range(DECODE_STEPS):
             logits, cache = decode(params, tok, cache)
             tok = jnp.argmax(logits, axis=-1)
-        tok.block_until_ready()
+        np.asarray(tok)  # host fetch == hard sync of the whole chain
         best = min(best, time.perf_counter() - t0)
     return BATCH * DECODE_STEPS / best
 

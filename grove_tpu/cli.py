@@ -76,6 +76,18 @@ def print_sets(client, namespace="default") -> None:
     _table(rows)
 
 
+def print_events(client, namespace="default") -> None:
+    from grove_tpu.runtime.events import Event
+    events = client.list(Event, namespace)
+    if not events:
+        return
+    rows = [("EVENT", "TYPE", "REASON", "COUNT", "MESSAGE")]
+    for e in sorted(events, key=lambda e: e.last_seen):
+        rows.append((f"{e.involved_kind}/{e.involved_name}", e.type,
+                     e.reason, str(e.count), e.message[:60]))
+    _table(rows)
+
+
 def _table(rows) -> None:
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
@@ -119,6 +131,7 @@ def cmd_run(args: argparse.Namespace) -> int:
                       file=sys.stderr)
                 print_pods(client)
                 print_gangs(client)
+                print_events(client)
                 return 1
         print()
         print_sets(client)
@@ -126,6 +139,8 @@ def cmd_run(args: argparse.Namespace) -> int:
         print_gangs(client)
         print()
         print_pods(client)
+        print()
+        print_events(client)
         if args.hold:
             print(f"\nholding cluster for {args.hold}s (ctrl-c to stop)...")
             time.sleep(args.hold)

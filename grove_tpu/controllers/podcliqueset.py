@@ -60,6 +60,7 @@ class PodCliqueSetReconciler:
             requeue = ru_requeue if requeue is None else min(requeue, ru_requeue)
 
         errors = self._sync_components(pcs, template_hash)
+        self._sync_service_endpoints(pcs)
         self._update_status(pcs)
         if errors:
             return StepResult.fail(errors[0])
@@ -187,6 +188,31 @@ class PodCliqueSetReconciler:
                 except GroveError as e:
                     errors.append(e)
         return errors
+
+    def _sync_service_endpoints(self, pcs: PodCliqueSet) -> None:
+        """Publish pod endpoints into each replica's headless Service —
+        the DNS record analog workloads discover peers through (reference
+        components/service/; publishNotReadyAddresses defaults true)."""
+        from grove_tpu.api import Pod
+        from grove_tpu.api.meta import is_condition_true as _ready
+        hs = pcs.spec.template.headless_service
+        if hs is None:
+            return
+        for svc in self.client.list(Service, pcs.meta.namespace,
+                                    {c.LABEL_PCS_NAME: pcs.meta.name}):
+            pods = self.client.list(Pod, pcs.meta.namespace, svc.selector)
+            eps = sorted(
+                p.spec.hostname for p in pods
+                if hs.publish_not_ready_addresses
+                or _ready(p.status.conditions, c.COND_READY))
+            publish = hs.publish_not_ready_addresses
+            if eps != svc.endpoints or svc.publish_not_ready != publish:
+                svc.endpoints = eps
+                svc.publish_not_ready = publish  # follow template edits
+                try:
+                    self.client.update(svc)
+                except GroveError:
+                    pass
 
     # ---- status ----
 

@@ -107,6 +107,11 @@ def gang_termination_pass(client: Client, pcs: PodCliqueSet) -> float | None:
         if elapsed >= delay:
             log.info("gang-terminating %s replica %d (breached %.1fs > %.1fs)",
                      pcs.meta.name, r, elapsed, delay)
+            from grove_tpu.runtime.events import EventRecorder
+            EventRecorder(client, "replica-lifecycle").event(
+                pcs, "Warning", "GangTerminated",
+                f"replica {r}: MinAvailable breached for {elapsed:.0f}s "
+                f"(> {delay:.0f}s); deleting and recreating the gang")
             delete_replica_children(client, pcs, r)
         else:
             remaining = delay - elapsed
@@ -225,6 +230,10 @@ def rolling_update_pass(client: Client, pcs: PodCliqueSet) -> float | None:
             return 0.1
     log.info("rolling update %s: recreating replica %d -> %s",
              pcs.meta.name, victim, target)
+    from grove_tpu.runtime.events import EventRecorder
+    EventRecorder(client, "replica-lifecycle").event(
+        pcs, "Normal", "RollingUpdateReplica",
+        f"recreating replica {victim} at template hash {target}")
     delete_replica_children(client, pcs, victim)
     progress.current_replica = victim
     try:

@@ -24,11 +24,12 @@ from grove_tpu.api.core import Service
 from grove_tpu.api.meta import ObjectMeta, new_meta
 from grove_tpu.api.serde import from_dict
 from grove_tpu.runtime.errors import ValidationError
+from grove_tpu.runtime.events import Event
 
 KIND_REGISTRY: dict[str, type] = {
     cls.KIND: cls
     for cls in (PodCliqueSet, PodClique, PodCliqueScalingGroup, PodGang,
-                ClusterTopology, Pod, Node, Service)
+                ClusterTopology, Pod, Node, Service, Event)
 }
 
 

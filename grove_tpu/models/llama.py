@@ -169,6 +169,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     sequence parallelism over the sp axis — the long-context path.
     """
     b, s = tokens.shape
+    _pos_arg = positions
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
@@ -178,6 +179,11 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     if ring:
         from grove_tpu.ops.ringattention import ring_attention
         assert mesh is not None, "ring attention needs the mesh"
+        # The ring path derives its causal mask from shard offsets and
+        # assumes default contiguous positions; custom positions would
+        # silently disagree with the mask.
+        assert _pos_arg is None, \
+            "ring=True does not support custom positions"
         attn_fn = lambda q, k, v: ring_attention(mesh, q, k, v)  # noqa: E731
 
     def body(x, lp):

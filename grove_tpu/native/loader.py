@@ -38,40 +38,65 @@ def _build() -> bool:
         return False
 
 
-def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        have_lib = os.path.exists(_LIB)
-        have_src = os.path.exists(_SRC)
-        stale = (have_lib and have_src
-                 and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
-        if (not have_lib or stale):
-            # No source (pruned install with a prebuilt .so is fine; with
-            # neither, fall back to Python) -> don't try to compile.
-            if not have_src or not _build():
-                if not have_lib:
-                    return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError as e:
-            log.info("native placement load failed (%s)", e)
-            return None
-        lib.grove_plan_gang.restype = ctypes.c_int
-        lib.grove_plan_gang.argtypes = [
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_uint8),
-            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
-            ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        _lib = lib
+def _load_nowait() -> Optional[ctypes.CDLL]:
+    """Non-blocking view for the placement hot path: while a build holds
+    the lock (prewarm compiling), callers fall back to Python instead of
+    stalling behind g++."""
+    if _lib is not None:
         return _lib
+    if not _lock.acquire(blocking=False):
+        return None
+    try:
+        return _load_locked()
+    finally:
+        _lock.release()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    with _lock:
+        return _load_locked()
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    have_lib = os.path.exists(_LIB)
+    have_src = os.path.exists(_SRC)
+    stale = (have_lib and have_src
+             and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+    if not have_lib or stale:
+        # No source (pruned install with a prebuilt .so is fine; with
+        # neither, fall back to Python) -> don't try to compile.
+        if not have_src or not _build():
+            if not have_lib:
+                return None
+            if stale:
+                # A stale binary would silently diverge from the Python
+                # reference semantics — never load it.
+                log.warning(
+                    "libplacement.so is older than placement.cpp and "
+                    "rebuild failed; using the python implementation")
+                return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as e:
+        log.info("native placement load failed (%s)", e)
+        return None
+    lib.grove_plan_gang.restype = ctypes.c_int
+    lib.grove_plan_gang.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    _lib = lib
+    return _lib
 
 
 def native_available() -> bool:
@@ -94,7 +119,7 @@ def native_plan_gang(pods, hosts, pack_level: str, required: bool,
     """Native-backed equivalent of placement.plan_gang. Returns a
     PlacementPlan or None (infeasible), or NotImplemented when the native
     library is unavailable (caller falls back to Python)."""
-    lib = _load()
+    lib = _load_nowait()
     if lib is None:
         return NotImplemented
 

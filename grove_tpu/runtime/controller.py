@@ -210,7 +210,9 @@ class Controller:
                 self.queue.done(req)
 
     def _process(self, req: Request) -> None:
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
         self.reconcile_count += 1
+        GLOBAL_METRICS.inc("grove_reconcile_total", controller=self.name)
         try:
             result = self.reconcile(req) or StepResult.finished()
         except Exception as e:  # noqa: BLE001 - reconcile panic barrier
@@ -221,6 +223,9 @@ class Controller:
             return
         if result.error is not None:
             self.error_count += 1
+            from grove_tpu.runtime.metrics import GLOBAL_METRICS
+            GLOBAL_METRICS.inc("grove_reconcile_errors_total",
+                               controller=self.name)
             self.log.debug("reconcile %s error: %s", req.key, result.error)
             self._requeue_with_backoff(req, result.requeue_after)
             return

@@ -1,0 +1,71 @@
+"""Event recording — the Kubernetes Events analog.
+
+The reference records events through controller-runtime recorders (e.g.
+scheduler capability events, volcano/backend.go:125). Here events are
+first-class store objects (kind Event) with count-deduplication, so
+`grovectl` and tests can surface why something is stuck.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from grove_tpu.api.meta import ObjectMeta, new_meta
+from grove_tpu.runtime.errors import ConflictError, GroveError, NotFoundError
+
+
+@dataclasses.dataclass
+class Event:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = "Normal"          # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+
+    KIND = "Event"
+
+
+class EventRecorder:
+    def __init__(self, client, component: str, min_interval: float = 5.0):
+        self.client = client
+        self.component = component
+        # Repeat-suppression window: a hot loop re-reporting the same
+        # condition must not turn into a store write storm.
+        self.min_interval = min_interval
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        """Record (or bump) an event for ``obj``. Never raises."""
+        name = f"{obj.meta.name}.{reason.lower()}"
+        ns = obj.meta.namespace
+        now = time.time()
+        try:
+            try:
+                cur = self.client.get(Event, name, ns)
+                if (cur.message == message
+                        and now - cur.last_seen < self.min_interval):
+                    return
+                cur.count += 1
+                cur.last_seen = now
+                cur.message = message
+                self.client.update(cur)
+            except NotFoundError:
+                ev = Event(
+                    meta=new_meta(name, namespace=ns,
+                                  labels={"component": self.component}),
+                    involved_kind=obj.KIND, involved_name=obj.meta.name,
+                    type=etype, reason=reason, message=message,
+                    first_seen=now, last_seen=now)
+                self.client.create(ev)
+        except (ConflictError, GroveError):
+            pass  # events are best-effort
+
+
+def events_for(client, kind: str, name: str,
+               namespace: str = "default") -> list[Event]:
+    return [e for e in client.list(Event, namespace)
+            if e.involved_kind == kind and e.involved_name == name]

@@ -58,6 +58,22 @@ class Manager:
 
     # ---- health/readiness (reference manager.go:73-89) ----
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (the metrics-server analog)."""
+        from grove_tpu.manifest import KIND_REGISTRY
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        for c in self.controllers:
+            GLOBAL_METRICS.set("grove_workqueue_depth", len(c.queue),
+                               controller=c.name)
+        for kind, cls in KIND_REGISTRY.items():
+            try:
+                GLOBAL_METRICS.set("grove_store_objects",
+                                   len(self.client.list(cls, namespace=None)),
+                                   kind=kind)
+            except Exception:  # noqa: BLE001 - best-effort gauge
+                pass
+        return GLOBAL_METRICS.render()
+
     def healthz(self) -> dict:
         return {
             "started": self._started,

@@ -1,0 +1,68 @@
+"""Prometheus-style metrics for the control plane.
+
+Role parity with the reference's controller-runtime metrics server
+(config types.go:202-212): counters/gauges with labels, rendered in the
+Prometheus text exposition format by ``render``. The manager exposes
+``Manager.metrics_text()``; a real deployment serves it over HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class MetricsHub:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        self._help: dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        self._help[name] = help_text
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] += value
+
+    def set(self, name: str, value: float, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+
+    @staticmethod
+    def _fmt(name: str, labels: tuple, value: float) -> str:
+        if labels:
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            return f"{name}{{{lbl}}} {value}"
+        return f"{name} {value}"
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            by_name: dict[str, list[str]] = defaultdict(list)
+            for (name, labels), v in sorted(self._counters.items()):
+                by_name[name].append(self._fmt(name, labels, v))
+            for (name, labels), v in sorted(self._gauges.items()):
+                by_name[name].append(self._fmt(name, labels, v))
+        for name, samples in sorted(by_name.items()):
+            if name in self._help:
+                lines.append(f"# HELP {name} {self._help[name]}")
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+GLOBAL_METRICS = MetricsHub()
+GLOBAL_METRICS.describe("grove_reconcile_total",
+                        "Reconcile invocations per controller")
+GLOBAL_METRICS.describe("grove_reconcile_errors_total",
+                        "Reconcile errors per controller")
+GLOBAL_METRICS.describe("grove_workqueue_depth",
+                        "Current workqueue depth per controller")
+GLOBAL_METRICS.describe("grove_gang_placements_total",
+                        "Gangs placed by the scheduler")
+GLOBAL_METRICS.describe("grove_store_objects",
+                        "Objects in the store per kind")

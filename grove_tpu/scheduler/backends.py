@@ -155,7 +155,9 @@ class GangBackend:
         tick = float(options.get("tick_seconds", "0.2"))
         self._loop = _PlacementLoop("gang", client, tick, self._place_pass)
         from grove_tpu.native.loader import prewarm
+        from grove_tpu.runtime.events import EventRecorder
         prewarm()  # compile the native core off the placement hot path
+        self.recorder = EventRecorder(client, "gang-scheduler")
 
     def prepare_pod(self, pod: Pod, gang_name: str) -> None:
         pod.spec.scheduler_name = self.name
@@ -252,6 +254,19 @@ class GangBackend:
                 gang.status.assigned_slice = plan.slice_name
                 gang.status.placement_score = plan.score
                 placed_any = True
+                from grove_tpu.runtime.metrics import GLOBAL_METRICS
+                GLOBAL_METRICS.inc("grove_gang_placements_total")
+                self.recorder.event(
+                    gang, "Normal", "GangPlaced",
+                    f"{len(bindable)} pods onto "
+                    f"{plan.slice_name or 'multiple domains'} "
+                    f"(score {plan.score:.2f})")
+            else:
+                self.recorder.event(
+                    gang, "Warning", "GangUnschedulable",
+                    f"no {pack_level or 'slice'} domain fits "
+                    f"{len(requests)} pods "
+                    f"({sum(r.chips for r in requests)} chips)")
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate on the slice, decrementing

@@ -219,7 +219,10 @@ class DecodeEngine:
                     self.cache = self.cache._replace(lengths=lengths)
 
     def sync(self) -> None:
-        self._tokens.block_until_ready()
+        # Host fetch rather than block_until_ready: a tiny [batch] int32
+        # transfer that hard-syncs the full dispatch chain (some remote
+        # PJRT transports complete block_until_ready early).
+        np.asarray(self._tokens)
 
     def run(self, steps: int) -> None:
         for _ in range(steps):
