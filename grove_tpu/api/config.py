@@ -74,6 +74,30 @@ class OperatorConfiguration:
     requeue_max_seconds: float = 5.0
 
 
+def load_config(path: str) -> OperatorConfiguration:
+    """Load + validate an OperatorConfiguration from a YAML file
+    (component-config style; reference decode.go + validation.go)."""
+    import yaml
+
+    from grove_tpu.api.serde import from_dict, unknown_keys
+    from grove_tpu.runtime.errors import ValidationError
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    # Strict decode: a typo'd key silently becoming a default is the worst
+    # failure mode a config system can have.
+    unknown = unknown_keys(OperatorConfiguration, data)
+    if unknown:
+        raise ValidationError(
+            f"operator configuration {path!r}: unknown keys {unknown}")
+    cfg = from_dict(OperatorConfiguration, data)
+    problems = validate_config(cfg)
+    if problems:
+        raise ValidationError(
+            f"operator configuration {path!r} invalid: " + "; ".join(problems))
+    return cfg
+
+
 def validate_config(cfg: OperatorConfiguration) -> list[str]:
     """Return a list of problems (empty == valid)."""
     errs: list[str] = []

@@ -78,6 +78,33 @@ def _from(tp: Any, data: Any) -> Any:
     return data
 
 
+def unknown_keys(cls: type, data: Any, prefix: str = "") -> list[str]:
+    """Recursively find dict keys that no dataclass field accepts —
+    strict-decoding support (a typo'd config key must not silently
+    become a default)."""
+    problems: list[str] = []
+    if not (dataclasses.is_dataclass(cls) and isinstance(data, dict)):
+        return problems
+    if cls not in _HINTS_CACHE:
+        _HINTS_CACHE[cls] = get_type_hints(cls)
+    hints = _HINTS_CACHE[cls]
+    fields = {f.name for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if key not in fields:
+            problems.append(path)
+            continue
+        tp = _strip_optional(hints[key])
+        origin = get_origin(tp)
+        if origin is list and isinstance(value, list):
+            (elem,) = get_args(tp) or (Any,)
+            for i, item in enumerate(value):
+                problems.extend(unknown_keys(elem, item, f"{path}[{i}]"))
+        elif dataclasses.is_dataclass(tp):
+            problems.extend(unknown_keys(tp, value, path))
+    return problems
+
+
 def clone(obj: T) -> T:
     """Deep copy an API object (the zz_generated deepcopy analog).
 

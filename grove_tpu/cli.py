@@ -95,14 +95,18 @@ def _table(rows) -> None:
 
 
 def cmd_run(args: argparse.Namespace) -> int:
+    config = None
+    if args.config:
+        from grove_tpu.api.config import load_config
+        config = load_config(args.config)
     fleet = parse_fleet(args.fleet)
     if args.real:
         fleet.fake = False
-        cluster = new_cluster(fleet=fleet, fake_kubelet=False)
+        cluster = new_cluster(config=config, fleet=fleet, fake_kubelet=False)
         from grove_tpu.agent.process import ProcessKubelet
         cluster.manager.add_runnable(ProcessKubelet(cluster.client))
     else:
-        cluster = new_cluster(fleet=fleet)
+        cluster = new_cluster(config=config, fleet=fleet)
     with cluster:
         client = cluster.client
         t0 = time.time()
@@ -160,6 +164,8 @@ def main(argv: list[str] | None = None) -> int:
     run.add_argument("--real", action="store_true",
                      help="run pods as real OS processes (process kubelet) "
                           "instead of synthetic fake-node readiness")
+    run.add_argument("--config",
+                     help="OperatorConfiguration YAML (component-config)")
     run.set_defaults(fn=cmd_run)
     args = parser.parse_args(argv)
     return args.fn(args)

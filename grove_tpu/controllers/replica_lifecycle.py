@@ -28,6 +28,7 @@ from grove_tpu.api import (
 )
 from grove_tpu.api.meta import get_condition
 from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.events import EventRecorder
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.store.client import Client
 
@@ -107,11 +108,11 @@ def gang_termination_pass(client: Client, pcs: PodCliqueSet) -> float | None:
         if elapsed >= delay:
             log.info("gang-terminating %s replica %d (breached %.1fs > %.1fs)",
                      pcs.meta.name, r, elapsed, delay)
-            from grove_tpu.runtime.events import EventRecorder
             EventRecorder(client, "replica-lifecycle").event(
                 pcs, "Warning", "GangTerminated",
                 f"replica {r}: MinAvailable breached for {elapsed:.0f}s "
-                f"(> {delay:.0f}s); deleting and recreating the gang")
+                f"(> {delay:.0f}s); deleting and recreating the gang",
+                key=f"replica-{r}")
             delete_replica_children(client, pcs, r)
         else:
             remaining = delay - elapsed
@@ -230,10 +231,10 @@ def rolling_update_pass(client: Client, pcs: PodCliqueSet) -> float | None:
             return 0.1
     log.info("rolling update %s: recreating replica %d -> %s",
              pcs.meta.name, victim, target)
-    from grove_tpu.runtime.events import EventRecorder
     EventRecorder(client, "replica-lifecycle").event(
         pcs, "Normal", "RollingUpdateReplica",
-        f"recreating replica {victim} at template hash {target}")
+        f"recreating replica {victim} at template hash {target}",
+        key=f"replica-{victim}")
     delete_replica_children(client, pcs, victim)
     progress.current_replica = victim
     try:

@@ -86,35 +86,46 @@ CONFIGS: dict[str, LlamaConfig] = {
 }
 
 
-def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
-    """Initialise a parameter pytree (layers stacked on axis 0)."""
+def norm_init(cfg: LlamaConfig, shape) -> jnp.ndarray:
+    return jnp.ones(shape, cfg.dtype)
+
+
+def dense_init(cfg: LlamaConfig, key, shape, fan_in) -> jnp.ndarray:
+    return (jax.random.normal(key, shape, jnp.float32)
+            * (fan_in ** -0.5)).astype(cfg.dtype)
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                include_mlp: bool = True) -> Params:
+    """Initialise a parameter pytree (layers stacked on axis 0).
+
+    ``include_mlp=False`` skips the dense MLP leaves (model families that
+    replace the MLP — e.g. MoE — must not transiently allocate it; for
+    real configs that is a multi-GB throwaway).
+    """
     k_embed, k_head, k_layers = jax.random.split(key, 3)
     d, h, kv, hd, ff, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
                            cfg.head_dim, cfg.d_ff, cfg.n_layers)
 
-    def norm_init(shape):
-        return jnp.ones(shape, cfg.dtype)
-
-    def dense_init(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32)
-                * (fan_in ** -0.5)).astype(cfg.dtype)
-
     ks = jax.random.split(k_layers, 7)
     layers = {
-        "attn_norm": norm_init((L, d)),
-        "mlp_norm": norm_init((L, d)),
-        "wq": dense_init(ks[0], (L, d, h, hd), d),
-        "wk": dense_init(ks[1], (L, d, kv, hd), d),
-        "wv": dense_init(ks[2], (L, d, kv, hd), d),
-        "wo": dense_init(ks[3], (L, h, hd, d), h * hd),
-        "w_gate": dense_init(ks[4], (L, d, ff), d),
-        "w_up": dense_init(ks[5], (L, d, ff), d),
-        "w_down": dense_init(ks[6], (L, ff, d), ff),
+        "attn_norm": norm_init(cfg, (L, d)),
+        "mlp_norm": norm_init(cfg, (L, d)),
+        "wq": dense_init(cfg, ks[0], (L, d, h, hd), d),
+        "wk": dense_init(cfg, ks[1], (L, d, kv, hd), d),
+        "wv": dense_init(cfg, ks[2], (L, d, kv, hd), d),
+        "wo": dense_init(cfg, ks[3], (L, h, hd, d), h * hd),
     }
+    if include_mlp:
+        layers.update({
+            "w_gate": dense_init(cfg, ks[4], (L, d, ff), d),
+            "w_up": dense_init(cfg, ks[5], (L, d, ff), d),
+            "w_down": dense_init(cfg, ks[6], (L, ff, d), ff),
+        })
     return {
-        "tok_embed": dense_init(k_embed, (cfg.vocab_size, d), d),
-        "lm_head": dense_init(k_head, (d, cfg.vocab_size), d),
-        "final_norm": norm_init((d,)),
+        "tok_embed": dense_init(cfg, k_embed, (cfg.vocab_size, d), d),
+        "lm_head": dense_init(cfg, k_head, (d, cfg.vocab_size), d),
+        "final_norm": norm_init(cfg, (d,)),
         "layers": layers,
     }
 
@@ -269,12 +280,16 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return logits, KVCache(k=k_all, v=v_all, lengths=new_lengths)
 
 
+def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy (shared by all model families)."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             mesh=None, ring: bool = False) -> jnp.ndarray:
     """Next-token cross-entropy (training path for the multichip dry-run)."""
-    logits = forward(cfg, params, tokens, mesh=mesh, ring=ring)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return next_token_loss(forward(cfg, params, tokens, mesh=mesh, ring=ring),
+                           tokens)

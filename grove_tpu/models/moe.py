@@ -1,0 +1,109 @@
+"""Mixture-of-Experts decoder (Mixtral-shaped) — the second model family.
+
+Reuses the Llama attention stack; the MLP becomes a top-k token-choice
+router over E experts. TPU-first choices:
+
+- Experts are evaluated densely per token then combined by router weight
+  (einsum over the expert axis) — static shapes, no gather/scatter of
+  token groups, so XLA tiles everything onto the MXU. This is the right
+  trade below ~16 experts; a capacity-based dispatch kernel is the
+  pallas upgrade path for larger E.
+- Expert parallelism: the ``expert`` logical axis maps to the tp mesh
+  axis (grove_tpu/parallel/sharding.py), so experts shard over the same
+  fast ICI group as tensor parallelism (EP == TP group).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from grove_tpu.models import llama
+from grove_tpu.models.llama import LlamaConfig, _attn_out, _qkv
+from grove_tpu.ops.attention import causal_attention
+from grove_tpu.ops.norms import rms_norm
+from grove_tpu.ops.rope import rope_table
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig(LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+
+
+MOE_CONFIGS: dict[str, MoeConfig] = {
+    "moe-test-tiny": MoeConfig(vocab_size=256, d_model=64, n_layers=2,
+                               n_heads=8, n_kv_heads=4, d_ff=96, head_dim=8,
+                               max_seq_len=128, n_experts=4,
+                               experts_per_token=2),
+    # Mixtral-8x7B-shaped (docs/perf projections)
+    "mixtral-8x7b": MoeConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, d_ff=14336,
+                              head_dim=128, max_seq_len=8192, n_experts=8,
+                              experts_per_token=2),
+}
+
+
+def init_params(cfg: MoeConfig, key: jax.Array) -> Params:
+    """Llama attention/embed params plus router + stacked experts (the
+    dense MLP is never allocated — for real configs it would be a
+    multi-GB throwaway)."""
+    base = llama.init_params(cfg, key, include_mlp=False)
+    L, d, ff, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
+    ks = jax.random.split(jax.random.fold_in(key, 17), 4)
+    layers = base["layers"]
+    layers["router"] = llama.dense_init(cfg, ks[0], (L, d, E), d)
+    layers["we_gate"] = llama.dense_init(cfg, ks[1], (L, E, d, ff), d)
+    layers["we_up"] = llama.dense_init(cfg, ks[2], (L, E, d, ff), d)
+    layers["we_down"] = llama.dense_init(cfg, ks[3], (L, E, ff, d), ff)
+    return base
+
+
+def _moe_block(cfg: MoeConfig, x, lp):
+    """Top-k routed expert MLP with residual. x: [b, s, d]."""
+    hm = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,de->bse", hm, lp["router"],
+                        preferred_element_type=jnp.float32)
+    k = cfg.experts_per_token
+    top_vals, top_idx = lax.top_k(logits, k)                  # [b, s, k]
+    gate_w = jax.nn.softmax(top_vals, axis=-1)                # [b, s, k]
+    # Dense weight mask over experts: [b, s, E]
+    one_hot = jax.nn.one_hot(top_idx, cfg.n_experts, dtype=gate_w.dtype)
+    weights = jnp.einsum("bsk,bske->bse", gate_w, one_hot)
+    # Evaluate all experts densely, combine by weight (static shapes).
+    gate = jnp.einsum("bsd,edf->besf", hm, lp["we_gate"])
+    up = jnp.einsum("bsd,edf->besf", hm, lp["we_up"])
+    expert_out = jnp.einsum("besf,efd->besd", jax.nn.silu(gate) * up,
+                            lp["we_down"])
+    out = jnp.einsum("bse,besd->bsd", weights.astype(expert_out.dtype),
+                     expert_out)
+    return x + out.astype(x.dtype)
+
+
+def forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Full forward → logits [b, s, vocab]."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+
+    def body(x, lp):
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        x = _attn_out(x, causal_attention(q, k, v), lp)
+        x = _moe_block(cfg, x, lp)
+        return x, None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(cfg: MoeConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    return llama.next_token_loss(forward(cfg, params, tokens), tokens)

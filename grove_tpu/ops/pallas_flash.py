@@ -1,0 +1,106 @@
+"""Pallas flash attention (TPU): causal GQA prefill attention.
+
+The MXU/VMEM-shaped hot op: the grid walks (batch, head, q-block,
+k-block); only one Q block and one K/V block are VMEM-resident at a time
+(VMEM use is O(block·d), independent of sequence length), with the
+online-softmax state carried across k-steps in VMEM scratch. Causally
+future k-blocks skip their compute entirely (`pl.when`). f32
+accumulation, bf16 matmuls on the MXU.
+
+Same signature/semantics as ops.attention.causal_attention (which
+remains the XLA fallback); `interpret=True` runs the kernel on CPU for
+tests. See /opt/skills/guides/pallas_guide.md for the programming model.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, scale: float, n_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip k-blocks entirely in the future of this q-block.
+    @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+    def _compute():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # [bq, d]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)              # [bk, d]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        kv_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m_prev = m_ref[:]
+        block_m = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, block_m)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where((block_m == NEG_INF)[:, None], 0.0, p)
+        corr = jnp.exp(jnp.where(m_prev == NEG_INF, NEG_INF, m_prev - m_new))
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        out = acc_ref[:] / jnp.maximum(l_ref[:][:, None], 1e-30)
+        o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+
+
+def flash_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           *, block_q: int = 128, block_k: int = 128,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Causal GQA flash attention. q: [b, s, h, d]; k/v: [b, s, n_kv, d]."""
+    b, s, h, d = q.shape
+    n_kv = k.shape[2]
+    group = h // n_kv
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, \
+        f"seq {s} must divide block sizes ({block_q}, {block_k})"
+    n_k = s // block_k
+
+    grid = (b, h, s // block_q, n_k)
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, scale=d ** -0.5, n_k=n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, 1, d),
+                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, ki, hi // g, 0)),
+            pl.BlockSpec((1, block_k, 1, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, ki, hi // g, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, 1, d),
+                               lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)

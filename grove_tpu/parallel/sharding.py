@@ -60,10 +60,16 @@ _PARAM_RULES: dict[str, tuple[str | None, ...]] = {
     "w_gate": ("embed", "mlp"),
     "w_up": ("embed", "mlp"),
     "w_down": ("mlp", "embed"),
+    # MoE (EP == TP group: experts shard over tp, ff replicated per expert)
+    "router": ("embed", None),
+    "we_gate": ("expert", "embed", None),
+    "we_up": ("expert", "embed", None),
+    "we_down": ("expert", None, "embed"),
 }
 
 _STACKED = {"attn_norm", "mlp_norm", "wq", "wk", "wv", "wo",
-            "w_gate", "w_up", "w_down"}
+            "w_gate", "w_up", "w_down",
+            "router", "we_gate", "we_up", "we_down"}
 
 
 def param_pspec(name: str) -> P:

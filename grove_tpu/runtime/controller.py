@@ -17,6 +17,7 @@ from typing import Any, Callable, NamedTuple
 
 from grove_tpu.runtime.flow import StepResult
 from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
 from grove_tpu.store.store import Event
 from grove_tpu.store.client import Client
 
@@ -210,7 +211,6 @@ class Controller:
                 self.queue.done(req)
 
     def _process(self, req: Request) -> None:
-        from grove_tpu.runtime.metrics import GLOBAL_METRICS
         self.reconcile_count += 1
         GLOBAL_METRICS.inc("grove_reconcile_total", controller=self.name)
         try:
@@ -223,7 +223,6 @@ class Controller:
             return
         if result.error is not None:
             self.error_count += 1
-            from grove_tpu.runtime.metrics import GLOBAL_METRICS
             GLOBAL_METRICS.inc("grove_reconcile_errors_total",
                                controller=self.name)
             self.log.debug("reconcile %s error: %s", req.key, result.error)

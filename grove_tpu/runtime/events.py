@@ -38,16 +38,24 @@ class EventRecorder:
         # condition must not turn into a store write storm.
         self.min_interval = min_interval
 
-    def event(self, obj, etype: str, reason: str, message: str) -> None:
-        """Record (or bump) an event for ``obj``. Never raises."""
+    def event(self, obj, etype: str, reason: str, message: str,
+              key: str = "") -> None:
+        """Record (or bump) an event for ``obj``. Never raises.
+
+        ``key`` disambiguates parallel subjects under one reason (e.g.
+        per-replica gang terminations) so their histories don't overwrite
+        each other. Rate limiting applies regardless of message content —
+        varying messages must not bypass write-storm suppression.
+        """
         name = f"{obj.meta.name}.{reason.lower()}"
+        if key:
+            name += f".{key}"
         ns = obj.meta.namespace
         now = time.time()
         try:
             try:
                 cur = self.client.get(Event, name, ns)
-                if (cur.message == message
-                        and now - cur.last_seen < self.min_interval):
+                if now - cur.last_seen < self.min_interval:
                     return
                 cur.count += 1
                 cur.last_seen = now

@@ -1,0 +1,49 @@
+"""Checkpoint/resume for the workload stack (orbax-backed).
+
+The reference has no workload checkpointing (SURVEY.md §5 — the engine's
+job); since grove-tpu ships the engine, it ships the checkpointing too:
+param save/restore with sharding-aware loading (restored leaves land
+directly on the serving mesh), plus serving-engine warm restart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def save_params(path: str, params: Any, step: int = 0) -> str:
+    """Save a param pytree; returns the checkpoint directory."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckpt:
+        target = os.path.join(path, str(step))
+        ckpt.save(target, params)
+    return target
+
+
+def load_params(path: str, step: int = 0,
+                like: Any | None = None) -> Any:
+    """Restore a param pytree. ``like`` (a pytree of arrays or
+    ShapeDtypeStructs with shardings) makes restoration land shards
+    directly on the target mesh — no host round-trip."""
+    path = os.path.abspath(os.path.join(path, str(step)))
+    with ocp.StandardCheckpointer() as ckpt:
+        if like is None:
+            return ckpt.restore(path)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding", None)),
+            like)
+        return ckpt.restore(path, abstract)
+
+
+def latest_step(path: str) -> int | None:
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = [int(d) for d in os.listdir(path) if d.isdigit()]
+    return max(steps) if steps else None

@@ -1,0 +1,84 @@
+"""MoE model family + orbax checkpointing."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import moe
+from grove_tpu.parallel import build_mesh, shard_params
+from grove_tpu.parallel.mesh import MeshPlan
+from grove_tpu.serving import checkpoint
+
+CFG = dataclasses.replace(moe.MOE_CONFIGS["moe-test-tiny"],
+                          dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return moe.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_moe_forward_shape_and_finite(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                CFG.vocab_size)
+    logits = moe.forward(CFG, params, tokens)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    loss = moe.loss_fn(CFG, params, tokens)
+    assert jnp.isfinite(loss)
+
+
+def test_moe_routing_actually_selects():
+    """Different tokens route to different experts: perturbing one
+    expert's weights must change only the outputs of tokens routed to it.
+    One layer — with more, attention propagates the perturbation to every
+    later token and the locality check is meaningless."""
+    cfg = dataclasses.replace(CFG, n_layers=1)
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 32), 0,
+                                cfg.vocab_size)
+    base = moe.forward(cfg, params, tokens)
+    mutated = dict(params)
+    mutated["layers"] = dict(params["layers"])
+    mutated["layers"]["we_down"] = (
+        params["layers"]["we_down"].at[:, 0].mul(2.0))  # expert 0 only
+    out = moe.forward(cfg, mutated, tokens)
+    changed = np.any(np.asarray(base) != np.asarray(out), axis=-1)[0]
+    assert changed.any(), "no token used expert 0 at all (degenerate)"
+    assert not changed.all(), "every token hit expert 0 (routing broken)"
+
+
+def test_moe_sharded_matches_single(params, cpu_devices):
+    mesh = build_mesh(MeshPlan(dp=1, sp=2, tp=4), cpu_devices[:8])
+    sharded = shard_params(mesh, params)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                CFG.vocab_size)
+    ref = moe.forward(CFG, params, tokens)
+    out = jax.jit(lambda p, t: moe.forward(CFG, p, t))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_checkpoint_roundtrip(params, tmp_path):
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_params(path, params, step=3)
+    assert checkpoint.latest_step(path) == 3
+    restored = checkpoint.load_params(path, step=3, like=params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_restores_onto_mesh(params, cpu_devices, tmp_path):
+    """Sharding-aware restore: leaves land with the target sharding."""
+    mesh = build_mesh(MeshPlan(dp=1, sp=2, tp=4), cpu_devices[:8])
+    sharded = shard_params(mesh, params)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_params(path, params, step=0)
+    restored = checkpoint.load_params(path, step=0, like=sharded)
+    leaf = restored["layers"]["we_gate"]
+    assert leaf.sharding == sharded["layers"]["we_gate"].sharding
+    np.testing.assert_array_equal(np.asarray(leaf),
+                                  np.asarray(params["layers"]["we_gate"]))
