@@ -33,12 +33,16 @@ from grove_tpu.store.client import Client
 class ProcessKubelet:
     def __init__(self, client: Client, namespace: str = "default",
                  node_name: str | None = None, tick: float = 0.05,
-                 workdir: str | None = None):
+                 workdir: str | None = None, log_dir: str | None = None):
         self.client = client
         self.namespace = namespace
         self.node_name = node_name
         self.tick = tick
         self.workdir = workdir
+        # Pod logs (kubectl-logs analog): one file per pod incarnation
+        # (name + uid — a self-healed replacement gets its own file).
+        self.log_dir = log_dir or os.path.join(
+            workdir or os.getcwd(), "pod-logs")
         self.log = get_logger("agent.process")
         # pod name -> (pod uid, proc): the uid detects delete+recreate under
         # the same name within one tick (rolling updates), so a stale
@@ -121,11 +125,15 @@ class ProcessKubelet:
         env[c.ENV_TPU_SLICE_TOPOLOGY] = node.meta.labels.get(
             c.NODE_LABEL_TPU_TOPOLOGY, "")
         try:
-            proc = subprocess.Popen(
-                argv, env=env,
-                cwd=pod.spec.container.workdir or self.workdir or None,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                start_new_session=True)
+            os.makedirs(self.log_dir, exist_ok=True)
+            log_path = os.path.join(
+                self.log_dir, f"{pod.meta.name}.{pod.meta.uid[:8]}.log")
+            with open(log_path, "ab") as log_file:
+                proc = subprocess.Popen(
+                    argv, env=env,
+                    cwd=pod.spec.container.workdir or self.workdir or None,
+                    stdout=log_file, stderr=subprocess.STDOUT,
+                    start_new_session=True)
         except OSError as e:
             self.log.warning("pod %s: exec failed: %s", pod.meta.name, e)
 

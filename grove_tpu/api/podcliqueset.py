@@ -111,6 +111,10 @@ class PodCliqueSetTemplate:
     scaling_groups: list[ScalingGroupConfig] = dataclasses.field(default_factory=list)
     startup_type: StartupType = StartupType.ANY_ORDER
     priority_class: str = ""
+    # Scheduling priority: higher-priority gangs are considered first
+    # when capacity is contended (reference PriorityClassName; numeric
+    # here — this control plane has no PriorityClass registry).
+    priority: int = 0
     scheduler_name: str = ""
     termination_delay_seconds: Optional[float] = None
     headless_service: Optional[HeadlessServiceConfig] = None

@@ -41,6 +41,7 @@ class PodGangSpec:
     groups: list[PodGroup] = dataclasses.field(default_factory=list)
     topology: TopologyConstraint | None = None
     priority_class: str = ""
+    priority: int = 0
     scheduler_name: str = ""
     # Placement-reuse hint: on rolling update the replacement gang prefers
     # the slice/hosts of the gang it replaces (reference podgang.go:65-71).

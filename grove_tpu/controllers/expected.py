@@ -36,8 +36,15 @@ def owner_ref(obj) -> OwnerReference:
 
 def generation_hash(pcs: PodCliqueSet) -> str:
     """Hash of the pod-shaping template (rolling-update trigger; reference
-    reconcilespec.go:110-123)."""
-    return compute_hash(pcs.spec.template)
+    reconcilespec.go:110-123).
+
+    Fields that never reach a Pod spec are excluded — bumping scheduling
+    priority must not restart the workload.
+    """
+    from grove_tpu.api.serde import clone
+    tmpl = clone(pcs.spec.template)
+    tmpl.priority = 0
+    return compute_hash(tmpl)
 
 
 def standalone_cliques(pcs: PodCliqueSet) -> list[PodCliqueTemplate]:
@@ -227,6 +234,7 @@ def expected_podgangs(pcs: PodCliqueSet,
                 groups=groups,
                 topology=tmpl.topology,
                 priority_class=tmpl.priority_class,
+                priority=tmpl.priority,
                 scheduler_name=tmpl.scheduler_name,
             ),
         ))
@@ -251,6 +259,7 @@ def expected_podgangs(pcs: PodCliqueSet,
                         groups=groups,
                         topology=sg.topology or tmpl.topology,
                         priority_class=tmpl.priority_class,
+                        priority=tmpl.priority,
                         scheduler_name=tmpl.scheduler_name,
                         base_gang=base_name,
                     ),

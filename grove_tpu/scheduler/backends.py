@@ -185,8 +185,9 @@ class GangBackend:
         scheduled_by_name = {
             g.meta.name: is_condition_true(g.status.conditions, c.COND_SCHEDULED)
             for g in gangs}
-        # Base gangs first, then scaled; stable by creation time.
-        gangs.sort(key=lambda g: (bool(g.spec.base_gang),
+        # Priority first, then base gangs before scaled, then creation
+        # time (stable).
+        gangs.sort(key=lambda g: (-g.spec.priority, bool(g.spec.base_gang),
                                   g.meta.creation_timestamp))
         for gang in gangs:
             if gang.spec.scheduler_name not in ("", self.name):

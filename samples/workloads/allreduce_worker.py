@@ -1,0 +1,51 @@
+"""Multi-host JAX worker: bootstraps jax.distributed purely from the
+grove env contract and runs a global psum.
+
+This is the template for what real TPU workloads do on a slice: worker
+identity from TPU_WORKER_ID, world membership from TPU_WORKER_HOSTNAMES,
+coordinator = worker 0. On real TPU hosts the hostnames resolve over the
+headless service; single-machine deployments (tests, --real demos) use
+loopback via GROVE_COORD_HOST.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# One local device per worker process (a real TPU worker would have its
+# host's chips; the CPU demo models one chip per process). Also shields
+# against inherited XLA_FLAGS from the launching environment.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+
+def main() -> None:
+    wid = int(os.environ["TPU_WORKER_ID"])
+    hosts = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+    n = len(hosts)
+    coord_host = os.environ.get("GROVE_COORD_HOST", hosts[0])
+    coord_port = os.environ.get("GROVE_COORD_PORT", "12355")
+    jax.distributed.initialize(
+        coordinator_address=f"{coord_host}:{coord_port}",
+        num_processes=n, process_id=wid)
+
+    # Each worker contributes (wid + 1); the ring must agree on the sum.
+    x = jnp.full((1, 4), float(wid + 1))
+    total = jax.pmap(lambda v: jax.lax.psum(v, "w"), axis_name="w")(x)
+    result = float(total[0, 0])
+
+    out_dir = os.environ.get("GROVE_OUT_DIR")
+    if out_dir:
+        with open(os.path.join(out_dir, f"result-{wid}.txt"), "w") as f:
+            f.write(f"{result}\n")
+    print(f"worker {wid}/{n}: psum = {result}", flush=True)
+
+    import time
+    time.sleep(float(os.environ.get("GROVE_HOLD_SECONDS", "120")))
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,70 @@ def test_pcsg_autoscaling(cluster):
         desc="scaled gangs pruned")
 
 
+def test_pclq_level_autoscaling(cluster):
+    """Standalone clique autoscaling: replicas follow the metric between
+    the HPA bounds; gang pod references follow the live count."""
+    client = cluster.client
+    pcs = PodCliqueSet(
+        meta=new_meta("pclqscale"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, min_available=1, tpu_chips_per_pod=0,
+                auto_scaling=AutoScalingConfig(
+                    min_replicas=1, max_replicas=4,
+                    metric="queue_depth", target_value=10.0),
+                container=ContainerSpec(argv=["sleep", "inf"]))],
+        )))
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "pclqscale")) == 2, desc="base")
+    cluster.metrics.set("PodClique", "pclqscale-0-w", "queue_depth", 35.0)
+    wait_for(lambda: len(_ready_pods(client, "pclqscale")) == 4,
+             timeout=15.0, desc="scaled to 4 pods")
+    cluster.metrics.set("PodClique", "pclqscale-0-w", "queue_depth", 2.0)
+    wait_for(lambda: len(_ready_pods(client, "pclqscale")) == 1,
+             timeout=15.0, desc="scaled back to the floor")
+
+
+def test_priority_orders_gang_placement(cluster):
+    """When capacity fits only one gang, the higher-priority one wins
+    even if created later."""
+    client = cluster.client
+    # Fill all but one slice so only one 2x4-chip gang fits.
+    filler = simple_pcs(name="filler", replicas=2, pods=4, chips=4)
+    client.create(filler)
+    wait_for(lambda: len(_ready_pods(client, "filler")) == 8, desc="filler")
+
+    # Cordon everything so both gangs are pending at one decision point.
+    for node in client.list(Node):
+        node.spec.unschedulable = True
+        client.update(node)
+
+    # 12 of the free slice's 16 chips each: only one of the two fits.
+    low = simple_pcs(name="low", pods=3, chips=4)
+    low.spec.template.priority = 0
+    high = simple_pcs(name="high", pods=3, chips=4)
+    high.spec.template.priority = 100
+    client.create(low)
+    client.create(high)
+
+    def both_ungated():
+        pods = [p for name in ("low", "high") for p in client.list(
+            Pod, selector={c.LABEL_PCS_NAME: name})]
+        return len(pods) == 6 and all(
+            not p.spec.scheduling_gates for p in pods)
+
+    wait_for(both_ungated, desc="both gangs exist with gates removed")
+
+    for node in client.list(Node):
+        node.spec.unschedulable = False
+        client.update(node)
+
+    wait_for(lambda: len(_ready_pods(client, "high")) == 3,
+             timeout=10.0, desc="high-priority gang placed")
+    assert not any(p.status.node_name for p in client.list(
+        Pod, selector={c.LABEL_PCS_NAME: "low"}))
+
+
 def test_rolling_update(cluster):
     client = cluster.client
     client.create(simple_pcs(name="roll", pods=2, chips=4))
