@@ -1,0 +1,33 @@
+# grove-tpu build/dev targets (reference operator/Makefile analog)
+
+PY ?= python
+
+.PHONY: test test-fast scale soak bench docs native lint clean
+
+test:            ## full suite on the virtual CPU mesh
+	$(PY) -m pytest tests/ -q
+
+test-fast:       ## control-plane tests only (skip model numerics)
+	$(PY) -m pytest tests/ -q -k "not model and not ring and not moe and not pallas and not serving"
+
+scale:           ## 1000-pod deploy/steady/delete timeline
+	$(PY) -m grove_tpu.scale --pods 1000
+
+soak:            ## repeated scale out/in cycles
+	$(PY) -m pytest tests/test_scale.py::test_soak_scale_cycles -q
+
+bench:           ## single-chip serving benchmark (real TPU)
+	$(PY) bench.py
+
+docs:            ## regenerate the API reference from the dataclasses
+	PYTHONPATH=. $(PY) tools/gen_api_docs.py > docs/api-reference.md
+
+native:          ## (re)build the C++ placement core
+	g++ -O2 -shared -fPIC grove_tpu/native/placement.cpp -o grove_tpu/native/libplacement.so
+
+serve:           ## run the control plane as a daemon with the HTTP API
+	$(PY) -m grove_tpu.cli serve --fleet v5e:4x4:2
+
+clean:
+	rm -rf pod-logs .pytest_cache grove_tpu/native/libplacement.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

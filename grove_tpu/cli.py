@@ -94,7 +94,8 @@ def _table(rows) -> None:
         print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
 
 
-def cmd_run(args: argparse.Namespace) -> int:
+def _build_cluster(args: argparse.Namespace):
+    """Shared bring-up for run/serve: config, fleet, --real agent."""
     config = None
     if args.config:
         from grove_tpu.api.config import load_config
@@ -107,6 +108,11 @@ def cmd_run(args: argparse.Namespace) -> int:
         cluster.manager.add_runnable(ProcessKubelet(cluster.client))
     else:
         cluster = new_cluster(config=config, fleet=fleet)
+    return cluster
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    cluster = _build_cluster(args)
     with cluster:
         client = cluster.client
         t0 = time.time()
@@ -151,9 +157,44 @@ def cmd_run(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Long-running daemon: control plane + HTTP API."""
+    from grove_tpu.server import ApiServer
+    cluster = _build_cluster(args)
+    try:
+        with cluster:
+            server = ApiServer(cluster, host=args.host, port=args.port)
+            try:
+                server.start()
+            except OSError as e:
+                print(f"error: cannot bind {args.host}:{args.port}: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"grove-tpu control plane serving on "
+                  f"http://{args.host}:{server.port}  (ctrl-c to stop)")
+            try:
+                while True:
+                    time.sleep(1.0)
+            finally:
+                server.stop()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="grovectl")
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    serve = sub.add_parser("serve", help="run the control plane as a "
+                                         "daemon with an HTTP API")
+    serve.add_argument("--fleet", default="v5e:4x4:2")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=8087)
+    serve.add_argument("--real", action="store_true")
+    serve.add_argument("--config")
+    serve.set_defaults(fn=cmd_serve)
+
     run = sub.add_parser("run", help="run a cluster, apply manifests, report")
     run.add_argument("--fleet", default="v5e:4x4:2",
                      help="fleet spec gen:topology:count[,...]")

@@ -1,0 +1,155 @@
+"""HTTP API server — the apiserver-facing surface of the control plane.
+
+The reference is driven through kube-apiserver; grove-tpu's standalone
+control plane exposes its own minimal HTTP API so out-of-process clients
+(dashboards, CI, other hosts' agents) can operate it:
+
+  GET  /healthz                       manager health (JSON)
+  GET  /metrics                       Prometheus text
+  GET  /api/<kind>                    list (JSON; ?namespace=, label
+                                      selectors via ?l.<key>=<value>)
+  GET  /api/<kind>/<name>             get one
+  POST /apply                         YAML/JSON manifest (create-or-update)
+  DELETE /api/<kind>/<name>           delete
+
+Single-threaded-per-request stdlib server (ThreadingHTTPServer): the
+store is already thread-safe, and control-plane traffic is low-rate.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from grove_tpu.api.serde import to_dict
+from grove_tpu.manifest import KIND_REGISTRY, load_manifest, load_object
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+
+
+class ApiServer:
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 8087):
+        self.cluster = cluster
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> None:
+        cluster = self.cluster
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload, content_type="application/json"):
+                body = (json.dumps(payload, indent=2).encode()
+                        if content_type == "application/json"
+                        else payload.encode())
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _kind(self, token: str):
+                cls = KIND_REGISTRY.get(token)
+                if cls is None:
+                    self._send(404, {"error": f"unknown kind {token!r}",
+                                     "kinds": sorted(KIND_REGISTRY)})
+                return cls
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if url.path == "/healthz":
+                        self._send(200, cluster.manager.healthz())
+                    elif url.path == "/metrics":
+                        self._send(200, cluster.manager.metrics_text(),
+                                   content_type="text/plain; version=0.0.4")
+                    elif len(parts) == 2 and parts[0] == "api":
+                        cls = self._kind(parts[1])
+                        if cls is None:
+                            return
+                        q = parse_qs(url.query)
+                        ns = q.get("namespace", ["default"])[0]
+                        selector = {k[2:]: v[0] for k, v in q.items()
+                                    if k.startswith("l.")}
+                        objs = cluster.client.list(cls, ns, selector or None)
+                        self._send(200, [to_dict(o) for o in objs])
+                    elif len(parts) == 3 and parts[0] == "api":
+                        cls = self._kind(parts[1])
+                        if cls is None:
+                            return
+                        q = parse_qs(url.query)
+                        ns = q.get("namespace", ["default"])[0]
+                        self._send(200, to_dict(
+                            cluster.client.get(cls, parts[2], ns)))
+                    else:
+                        self._send(404, {"error": "not found"})
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except GroveError as e:
+                    self._send(400, {"error": str(e)})
+
+            def do_POST(self):
+                if urlparse(self.path).path != "/apply":
+                    self._send(404, {"error": "POST /apply only"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(length).decode()
+                try:
+                    if self.headers.get("Content-Type", "").startswith(
+                            "application/json"):
+                        objs = [load_object(json.loads(raw))]
+                    else:
+                        objs = load_manifest(raw)
+                    results = []
+                    for obj in objs:
+                        try:
+                            created = cluster.client.create(obj)
+                            results.append({"kind": created.KIND,
+                                            "name": created.meta.name,
+                                            "action": "created"})
+                        except GroveError as e:
+                            if "exists" not in str(e):
+                                raise
+                            live = cluster.client.get(
+                                type(obj), obj.meta.name, obj.meta.namespace)
+                            live.spec = obj.spec
+                            cluster.client.update(live)
+                            results.append({"kind": obj.KIND,
+                                            "name": obj.meta.name,
+                                            "action": "updated"})
+                    self._send(200, results)
+                except GroveError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 - malformed input
+                    self._send(400, {"error": f"bad manifest: {e}"})
+
+            def do_DELETE(self):
+                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                if len(parts) != 3 or parts[0] != "api":
+                    self._send(404, {"error": "DELETE /api/<kind>/<name>"})
+                    return
+                cls = self._kind(parts[1])
+                if cls is None:
+                    return
+                try:
+                    cluster.client.delete(cls, parts[2])
+                    self._send(200, {"deleted": parts[2]})
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except GroveError as e:
+                    self._send(403, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="api-server", daemon=True).start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

@@ -1,0 +1,88 @@
+"""HTTP API server: apply/list/get/delete, health, metrics."""
+
+import json
+import urllib.request
+
+import pytest
+
+from grove_tpu.cluster import new_cluster
+from grove_tpu.server import ApiServer
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+MANIFEST = """
+kind: PodCliqueSet
+metadata: {name: websvc}
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - {name: w, replicas: 2, tpu_chips_per_pod: 4}
+"""
+
+
+@pytest.fixture
+def server():
+    cl = new_cluster(fleet=FleetSpec(
+        slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}", cl
+        srv.stop()
+
+
+def _req(url, method="GET", body=None, content_type="application/yaml"):
+    req = urllib.request.Request(url, method=method,
+                                 data=body.encode() if body else None,
+                                 headers={"Content-Type": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read() or b"null") \
+                if "json" in resp.headers.get("Content-Type", "") \
+                else resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_apply_watch_delete_over_http(server):
+    base, cl = server
+    status, out = _req(f"{base}/apply", "POST", MANIFEST)
+    assert status == 200 and out[0]["action"] == "created"
+
+    def available():
+        s, body = _req(f"{base}/api/PodCliqueSet/websvc")
+        return s == 200 and body["status"]["available_replicas"] == 1
+    wait_for(available, desc="available over http")
+
+    status, pods = _req(f"{base}/api/Pod?l.grove.tpu/podcliqueset=websvc")
+    assert status == 200 and len(pods) == 2
+    assert pods[0]["status"]["node_name"]
+
+    # idempotent re-apply = update
+    status, out = _req(f"{base}/apply", "POST", MANIFEST)
+    assert status == 200 and out[0]["action"] == "updated"
+
+    status, _ = _req(f"{base}/api/PodCliqueSet/websvc", "DELETE")
+    assert status == 200
+    wait_for(lambda: _req(f"{base}/api/Pod")[1] == [], desc="pods gone")
+
+
+def test_health_metrics_and_errors(server):
+    base, _ = server
+    status, health = _req(f"{base}/healthz")
+    assert status == 200 and health["started"]
+    status, text = _req(f"{base}/metrics")
+    assert status == 200 and "grove_reconcile_total" in text
+    status, err = _req(f"{base}/api/NopeKind")
+    assert status == 404 and "kinds" in err
+    status, err = _req(f"{base}/api/Pod/ghost")
+    assert status == 404
+    status, err = _req(f"{base}/apply", "POST", "kind: Bad\nmetadata: {name: x}")
+    assert status == 400
+    # admission rejection surfaces as 400 with the reason
+    bad = MANIFEST.replace("replicas: 2", "replicas: 2\n        min_available: 9")
+    status, err = _req(f"{base}/apply", "POST",
+                       bad.replace("websvc", "broken"))
+    assert status == 400 and "min_available" in err["error"]
