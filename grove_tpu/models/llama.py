@@ -171,6 +171,18 @@ def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset,
     return x, (k, v)
 
 
+def embed(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding (shared by dense/ring/pipeline forwards)."""
+    return params["tok_embed"][tokens].astype(cfg.dtype)
+
+
+def head(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + LM head (shared by dense/ring/pipeline forwards)."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             positions: jnp.ndarray | None = None,
             mesh=None, ring: bool = False) -> jnp.ndarray:
@@ -184,7 +196,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    x = embed(cfg, params, tokens)
 
     attn_fn = None
     if ring:
@@ -203,9 +215,7 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         return x, None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                      preferred_element_type=jnp.float32)
+    return head(cfg, params, x)
 
 
 def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,

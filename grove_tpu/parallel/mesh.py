@@ -28,27 +28,32 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
 
 # Canonical axis order: outermost (slowest fabric) ... innermost (fastest).
-MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+# pp sits between dp and sp: stage hops are point-to-point activations —
+# cheaper than sp/tp collectives, tolerant of slower links than either.
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
-    """A concrete (dp, sp, tp) factorisation of a device count."""
+    """A concrete (dp, pp, sp, tp) factorisation of a device count."""
 
     dp: int = 1
+    pp: int = 1
     sp: int = 1
     tp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {AXIS_DP: self.dp, AXIS_SP: self.sp, AXIS_TP: self.tp}
+        return {AXIS_DP: self.dp, AXIS_PP: self.pp, AXIS_SP: self.sp,
+                AXIS_TP: self.tp}
 
 
 def _largest_pow2_divisor(n: int, cap: int) -> int:
@@ -97,7 +102,7 @@ def build_mesh(plan: MeshPlan | None = None,
     if plan.size != len(devices):
         raise ValueError(
             f"mesh plan {plan} needs {plan.size} devices, have {len(devices)}")
-    arr = np.array(devices).reshape(plan.dp, plan.sp, plan.tp)
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.sp, plan.tp)
     return Mesh(arr, MESH_AXES)
 
 
@@ -111,7 +116,9 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
 def validate_plan_fits_slice(plan: MeshPlan, slice_chips: int) -> None:
     """Gang contract: tp*sp must fit inside one ICI slice.
 
-    dp may cross slices (DCN); tp and sp traffic must stay on ICI. The
+    dp may cross slices (DCN); tp and sp traffic must stay on ICI; pp
+    stage hops are point-to-point activation transfers and may cross
+    slices (each stage's tp*sp group must still be slice-resident). The
     orchestrator enforces the pod-placement half of this (slice-atomic
     PodGangs); this checks the in-pod mesh half.
     """

@@ -1,0 +1,115 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a pp axis.
+
+Layers are sharded across stages (the leading stacked-layer axis split
+over ``pp``); activations flow stage-to-stage via ``lax.ppermute``
+(nearest-neighbour ICI hops, like the ring). The schedule runs
+M + S - 1 ticks: at tick t, stage s works on microbatch t - s — every
+stage executes the same SPMD program with inactivity masked by zeros, so
+the bubble costs compute but never diverges control flow (XLA-friendly).
+
+Embedding/head/final-norm weights are replicated across stages; stage 0
+embeds, the last stage projects to logits, and the result is summed
+across stages (only the last contributes non-zeros).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grove_tpu.models.llama import LlamaConfig, _layer_prefill, head
+from grove_tpu.ops.rope import rope_table
+from grove_tpu.parallel.mesh import AXIS_PP
+
+
+def _stage_body(cfg: LlamaConfig, n_micro: int, tok_embed, lm_head,
+                final_norm, layers, tokens):
+    """Per-stage SPMD body (under shard_map over pp).
+
+    layers: this stage's layer shard (leading axis L/S).
+    tokens: full [B, s] (replicated); microbatches split on B.
+    """
+    s_count = lax.axis_size(AXIS_PP)
+    stage = lax.axis_index(AXIS_PP)
+    B, seq = tokens.shape
+    mb = B // n_micro
+    d = tok_embed.shape[1]
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(seq), (mb, seq))
+
+    def run_stage(x):
+        def body(x, lp):
+            x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+            return x, None
+        x, _ = lax.scan(body, x, layers)
+        return x
+
+    fwd_perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+    # pvary: fresh buffers must carry the device-varying type to match
+    # the loop carry once mixed with per-stage data.
+    carry_in = lax.pcast(jnp.zeros((mb, seq, d), cfg.dtype), (AXIS_PP,),
+                         to="varying")
+    outputs = lax.pcast(jnp.zeros((n_micro, mb, seq, d), cfg.dtype),
+                        (AXIS_PP,), to="varying")
+
+    def tick(t, state):
+        carry_in, outputs = state
+        my_mb = t - stage
+        active = jnp.logical_and(my_mb >= 0, my_mb < n_micro)
+
+        # Stage 0 sources its input by embedding microbatch t.
+        emb_idx = jnp.clip(t, 0, n_micro - 1)
+        mb_tokens = lax.dynamic_slice_in_dim(tokens, emb_idx * mb, mb, axis=0)
+        embedded = tok_embed[mb_tokens].astype(cfg.dtype)
+        x_in = jnp.where(stage == 0, embedded, carry_in)
+
+        x_out = jnp.where(active, run_stage(x_in), jnp.zeros_like(x_in))
+
+        # Last stage records its finished microbatch.
+        slot = jnp.clip(my_mb, 0, n_micro - 1)
+        record = jnp.logical_and(active, stage == s_count - 1)
+        outputs = lax.dynamic_update_slice_in_dim(
+            outputs,
+            jnp.where(record, x_out, lax.dynamic_slice_in_dim(
+                outputs, slot, 1, axis=0)[0])[None],
+            slot, axis=0)
+
+        carry_next = lax.ppermute(x_out, AXIS_PP, fwd_perm)
+        return carry_next, outputs
+
+    _, outputs = lax.fori_loop(0, n_micro + s_count - 1, tick,
+                               (carry_in, outputs))
+
+    # Only the last stage holds real outputs; psum broadcasts them, then
+    # every stage runs the shared final-norm + head (llama.head).
+    x = outputs.reshape(B, seq, d)
+    x = jnp.where(stage == s_count - 1, x, jnp.zeros_like(x))
+    x = lax.psum(x, AXIS_PP)
+    return head(cfg, {"final_norm": final_norm, "lm_head": lm_head}, x)
+
+
+def pipeline_forward(cfg: LlamaConfig, params, tokens: jnp.ndarray,
+                     mesh: Mesh, n_microbatches: int = 2) -> jnp.ndarray:
+    """Forward pass with layers pipelined over the mesh's ``pp`` axis.
+
+    Requires n_layers % pp == 0 and batch % n_microbatches == 0. The
+    dense-MLP Llama param layout is expected (layer-stacked leaves).
+    """
+    (pp_size,) = (mesh.shape[AXIS_PP],)
+    assert cfg.n_layers % pp_size == 0, \
+        f"{cfg.n_layers} layers not divisible into {pp_size} stages"
+    assert tokens.shape[0] % n_microbatches == 0
+
+    layer_spec = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
+    fn = jax.shard_map(
+        partial(_stage_body, cfg, n_microbatches),
+        mesh=mesh,
+        in_specs=(P(), P(), P(), layer_spec, P()),
+        out_specs=P(),
+    )
+    return fn(params["tok_embed"], params["lm_head"], params["final_norm"],
+              params["layers"], tokens)
