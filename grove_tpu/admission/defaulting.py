@@ -33,15 +33,11 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
     uses_tpu = any(t.tpu_chips_per_pod > 0 for t in tmpl.cliques)
     if tmpl.topology is None and uses_tpu:
         tmpl.topology = TopologyConstraint(pack_level="slice", required=True)
+    # Contradictory auto_scaling bounds are NOT silently repaired here —
+    # validation rejects them uniformly at every level (clique/SG/PCS).
     for t in tmpl.cliques:
         if t.replicas < 1:
             t.replicas = 1
-        if t.auto_scaling is not None:
-            a = t.auto_scaling
-            if a.min_replicas < 1:
-                a.min_replicas = 1
-            if a.max_replicas < a.min_replicas:
-                a.max_replicas = a.min_replicas
         if t.min_available is None:
             # Autoscaled cliques default their gang floor to the scaling
             # floor (so scale-in below the initial replica count works);
@@ -56,10 +52,4 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
             sg.replicas = 1
         if sg.min_available is None:
             sg.min_available = 1  # one gang-guaranteed instance; rest elastic
-        if sg.auto_scaling is not None:
-            a = sg.auto_scaling
-            if a.min_replicas < 1:
-                a.min_replicas = 1
-            if a.max_replicas < a.min_replicas:
-                a.max_replicas = a.min_replicas
     return pcs

@@ -115,6 +115,13 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     tmpl = spec.template
     if spec.replicas < 1:
         errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
+    if spec.auto_scaling is not None:
+        a = spec.auto_scaling
+        if a.min_replicas > a.max_replicas:
+            errs.append(f"spec.auto_scaling min {a.min_replicas} > max "
+                        f"{a.max_replicas}")
+        if a.min_replicas < 1:
+            errs.append("spec.auto_scaling.min_replicas must be >= 1")
     if not tmpl.cliques:
         errs.append("spec.template.cliques must not be empty")
 
@@ -135,6 +142,8 @@ def validate_podcliqueset(pcs: PodCliqueSet,
             errs.append(f"{f}: tpu_chips_per_pod must be >= 0")
         if t.auto_scaling is not None:
             a = t.auto_scaling
+            if a.min_replicas < 1:
+                errs.append(f"{f}: auto_scaling.min_replicas must be >= 1")
             if a.min_replicas > a.max_replicas:
                 errs.append(f"{f}: auto_scaling min {a.min_replicas} > max "
                             f"{a.max_replicas}")
@@ -184,10 +193,17 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                             f"{seen_members[m]!r}")
             else:
                 seen_members[m] = sg.name
-        if sg.auto_scaling is not None and sg.min_available is not None \
-                and sg.auto_scaling.min_replicas < sg.min_available:
-            errs.append(f"{f}: auto_scaling.min_replicas must be >= "
-                        "min_available (the gang floor)")
+        if sg.auto_scaling is not None:
+            a = sg.auto_scaling
+            if a.min_replicas < 1:
+                errs.append(f"{f}: auto_scaling.min_replicas must be >= 1")
+            if a.min_replicas > a.max_replicas:
+                errs.append(f"{f}: auto_scaling min {a.min_replicas} > max "
+                            f"{a.max_replicas}")
+            if sg.min_available is not None \
+                    and a.min_replicas < sg.min_available:
+                errs.append(f"{f}: auto_scaling.min_replicas must be >= "
+                            "min_available (the gang floor)")
         _validate_topology(f + ".topology", sg.topology, tmpl.topology, errs)
 
     _validate_topology("spec.template.topology", tmpl.topology, None, errs)

@@ -128,6 +128,10 @@ class PodCliqueSetSpec:
         default_factory=PodCliqueSetTemplate)
     update_strategy: UpdateStrategy = dataclasses.field(
         default_factory=UpdateStrategy)
+    # Third autoscaling level (reference README "Multi-Level Auto-Scaling"):
+    # whole-service replicas — each new replica is a multislice DP copy
+    # spread over DCN.
+    auto_scaling: Optional[AutoScalingConfig] = None
 
 
 @dataclasses.dataclass

@@ -1,16 +1,22 @@
 """Multi-level autoscaling — the HPA analog (C1e).
 
-The reference creates one HPA per auto-scaled PCLQ/PCSG targeting its
-scale subresource (podcliqueset/components/hpa/). This control plane owns
-the loop: a MetricsRegistry holds current metric values (pushed by serving
-engines — e.g. queue depth per clique — or by tests), and the Autoscaler
-runnable applies the standard HPA formula
+The reference creates one HPA per auto-scaled target's scale subresource
+(podcliqueset/components/hpa/). This control plane owns the loop: a
+MetricsRegistry holds current metric values (pushed by serving engines —
+e.g. queue depth per clique — or by tests), and the Autoscaler runnable
+applies the standard HPA formula
 
     desired = clamp(ceil(value / target), min_replicas, max_replicas)
 
-to the live replicas of every auto-scaled PodClique and
-PodCliqueScalingGroup. The gang floor: min_replicas is validated to be
->= min_available, so scaling never undercuts the gang guarantee.
+at all three levels:
+
+- PodClique — pods within a role,
+- PodCliqueScalingGroup — whole model instances (each a gang on a slice),
+- PodCliqueSet — whole-service replicas (multislice DP over DCN).
+
+The gang floor: for PCLQ/PCSG, min_replicas is validated to be >=
+min_available, so scaling never undercuts the gang guarantee (a PCS has
+no floor beyond min_replicas >= 1).
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ import math
 import threading
 import time
 
-from grove_tpu.api import PodClique, PodCliqueScalingGroup
+from grove_tpu.api import PodClique, PodCliqueScalingGroup, PodCliqueSet
 from grove_tpu.runtime.errors import GroveError
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.store.client import Client
@@ -80,7 +86,7 @@ class Autoscaler:
             self._stop.wait(self.sync_period)
 
     def _pass(self) -> None:
-        for kind_cls in (PodClique, PodCliqueScalingGroup):
+        for kind_cls in (PodClique, PodCliqueScalingGroup, PodCliqueSet):
             for obj in self.client.list(kind_cls, self.namespace):
                 a = obj.spec.auto_scaling
                 if a is None or obj.meta.deletion_timestamp is not None:

@@ -171,6 +171,30 @@ def test_pclq_level_autoscaling(cluster):
              timeout=15.0, desc="scaled back to the floor")
 
 
+def test_pcs_level_autoscaling(cluster):
+    """Third autoscaling level: whole-service replicas (multislice DP) —
+    scale-out adds a spread replica, scale-in prunes its children."""
+    client = cluster.client
+    pcs = simple_pcs(name="svcscale", pods=2, chips=4)
+    pcs.spec.auto_scaling = AutoScalingConfig(
+        min_replicas=1, max_replicas=3, metric="rps", target_value=100.0)
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "svcscale")) == 2, desc="base")
+
+    cluster.metrics.set("PodCliqueSet", "svcscale", "rps", 250.0)  # -> 3
+    wait_for(lambda: len(_ready_pods(client, "svcscale")) == 6,
+             timeout=15.0, desc="3 service replicas")
+    slices = {p.status.node_name.rsplit("-w", 1)[0]
+              for p in _ready_pods(client, "svcscale")}
+    assert len(slices) == 3, f"replicas not spread over slices: {slices}"
+
+    cluster.metrics.set("PodCliqueSet", "svcscale", "rps", 10.0)   # -> 1
+    wait_for(lambda: len(_ready_pods(client, "svcscale")) == 2,
+             timeout=15.0, desc="scaled back to one replica")
+    wait_for(lambda: len(client.list(PodGang, selector={
+        c.LABEL_PCS_NAME: "svcscale"})) == 1, desc="replica gangs pruned")
+
+
 def test_priority_orders_gang_placement(cluster):
     """When capacity fits only one gang, the higher-priority one wins
     even if created later."""
