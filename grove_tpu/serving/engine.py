@@ -130,6 +130,19 @@ class DecodeEngine:
 
         self._prefill = jax.jit(pf, donate_argnums=(3,))
 
+    # ---- compiled-callable access (benchmarks, custom loops) ----
+
+    def compiled_prefill(self):
+        """The jitted prefill: (params, tokens[b,s], lengths[b], cache) ->
+        (last-token logits [b, vocab], cache). Stable public surface for
+        callers that drive the compiled programs without lane bookkeeping."""
+        return self._prefill
+
+    def compiled_step(self):
+        """The jitted decode step: (params, tokens[b], cache) ->
+        (next tokens [b], cache). Cache argument is donated."""
+        return self._step
+
     # ---- request intake ----
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32) -> int:
