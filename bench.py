@@ -94,9 +94,11 @@ def main() -> None:
     bare = time_loop(bare_steps)
     log(f"bare-metal decode: {bare:.1f} tok/s/chip")
 
-    # ---- framework path: the serving engine's step loop (bookkeeping,
-    # lane management, metric hooks) over the same compiled functions.
-    eng.admit_prompts(prompt)
+    # ---- framework path: the serving engine's step loop over the same
+    # compiled functions, with tracked requests so the REAL serving-layer
+    # costs run — completion bookkeeping with windowed host drains.
+    eng.admit_prompts(prompt,
+                      max_new_tokens=(TIMED_ITERS + 2) * DECODE_STEPS)
     eng.step()
     eng.sync()  # warmup
 

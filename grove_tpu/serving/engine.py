@@ -100,7 +100,8 @@ class DecodeEngine:
 
     def __init__(self, cfg: LlamaConfig, key_or_params, batch: int = 8,
                  max_len: int | None = None,
-                 metric_hook: Callable[[int], None] | None = None):
+                 metric_hook: Callable[[int], None] | None = None,
+                 host_sync_interval: int = 8):
         self.cfg = cfg
         if isinstance(key_or_params, jax.Array) and key_or_params.dtype == jnp.uint32:
             self.params = llama.init_params(cfg, key_or_params)
@@ -109,12 +110,18 @@ class DecodeEngine:
         self.batch = batch
         self.max_len = max_len or cfg.max_seq_len
         self.metric_hook = metric_hook
+        # Completion bookkeeping needs sampled tokens on the host; fetching
+        # every step would serialise dispatch behind a device→host sync.
+        # Tokens accumulate on device and drain every ``host_sync_interval``
+        # steps (a finished lane decodes at most interval-1 wasted steps).
+        self.host_sync_interval = max(1, host_sync_interval)
         self.cache = KVCache.create(cfg.n_layers, batch, self.max_len,
                                     cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
         self._tokens = jnp.zeros((batch,), jnp.int32)
         self._active = np.zeros((batch,), bool)
         self._requests: list[Request | None] = [None] * batch
         self._queue: deque[Request] = deque()
+        self._pending_tokens: list[jnp.ndarray] = []
         self._next_rid = 0
         self.completed: list[Request] = []
         self.steps = 0
@@ -163,8 +170,14 @@ class DecodeEngine:
 
     # ---- standalone mode (bench path) ----
 
-    def admit_prompts(self, prompts: jnp.ndarray) -> None:
-        """Prefill a full batch [batch, s] into the lanes (all same len)."""
+    def admit_prompts(self, prompts: jnp.ndarray,
+                      max_new_tokens: int | None = None) -> None:
+        """Prefill a full batch [batch, s] into the lanes (all same len).
+
+        With ``max_new_tokens`` each lane gets a tracked Request, so the
+        full completion bookkeeping runs (the real serving path); without
+        it, lanes decode untracked (raw-throughput loops).
+        """
         b, s = prompts.shape
         assert b == self.batch
         lengths = jnp.full((b,), s, jnp.int32)
@@ -172,6 +185,13 @@ class DecodeEngine:
                                            self.cache)
         self._tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         self._active[:] = True
+        if max_new_tokens is not None:
+            prompts_np = np.asarray(prompts)
+            for i in range(b):
+                req = Request(rid=self._next_rid, prompt=prompts_np[i],
+                              max_new_tokens=max_new_tokens)
+                self._next_rid += 1
+                self._requests[i] = req
 
     # ---- disaggregated mode ----
 
@@ -215,26 +235,42 @@ class DecodeEngine:
         self._tokens, self.cache = self._step(self.params, self._tokens,
                                               self.cache)
         self.steps += 1
-        # Lane bookkeeping on host (cheap; token fetch is one tiny array).
         if any(r is not None for r in self._requests):
-            toks = np.asarray(self._tokens)
-            room = np.asarray(self.cache.has_room())
-            for i, req in enumerate(self._requests):
-                if req is None or not self._active[i]:
-                    continue
-                req.generated.append(int(toks[i]))
-                if len(req.generated) >= req.max_new_tokens or not room[i]:
-                    req.done = True
-                    self.completed.append(req)
-                    self._requests[i] = None
-                    self._active[i] = False
-                    lengths = self.cache.lengths.at[i].set(0)
-                    self.cache = self.cache._replace(lengths=lengths)
+            self._pending_tokens.append(self._tokens)
+            if len(self._pending_tokens) >= self.host_sync_interval:
+                self._drain()
+
+    def _drain(self) -> None:
+        """Process accumulated tokens: one host fetch per window."""
+        if not self._pending_tokens:
+            return
+        toks = np.asarray(jnp.stack(self._pending_tokens))  # [w, batch]
+        self._pending_tokens.clear()
+        room = np.asarray(self.cache.has_room())
+        freed = False
+        for i, req in enumerate(self._requests):
+            if req is None or not self._active[i]:
+                continue
+            for t in toks[:, i]:
+                req.generated.append(int(t))
+                if len(req.generated) >= req.max_new_tokens:
+                    break
+            if len(req.generated) >= req.max_new_tokens or not room[i]:
+                req.done = True
+                self.completed.append(req)
+                self._requests[i] = None
+                self._active[i] = False
+                freed = True
+                lengths = self.cache.lengths.at[i].set(0)
+                self.cache = self.cache._replace(lengths=lengths)
+        if freed:
+            self._report_metric()
 
     def sync(self) -> None:
-        # Host fetch rather than block_until_ready: a tiny [batch] int32
-        # transfer that hard-syncs the full dispatch chain (some remote
-        # PJRT transports complete block_until_ready early).
+        # Drain outstanding bookkeeping, then a tiny host fetch that
+        # hard-syncs the dispatch chain (some remote PJRT transports
+        # complete block_until_ready early).
+        self._drain()
         np.asarray(self._tokens)
 
     def run(self, steps: int) -> None:

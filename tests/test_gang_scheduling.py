@@ -1,0 +1,94 @@
+"""Gang-scheduling matrix (the reference's GS1-GS10 analog,
+e2e/tests/gang_scheduling_test.go): capacity-pressure behaviors beyond
+the basic flows covered in test_e2e_simple/test_e2e_disagg."""
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Pod,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+)
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_availability import _ready_pods
+from test_e2e_disagg import disagg_pcs
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def small_cluster():
+    # Exactly 2 slices of 16 chips.
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def test_scaled_gang_pending_never_degrades_base(small_cluster):
+    """PCSG replicas beyond capacity: the base gang (and affordable scaled
+    gangs) run; the unaffordable scaled gang stays fully pending."""
+    client = small_cluster.client
+    # Each model replica needs 16 chips (one slice); 2 slices; ask for 3
+    # replicas with min_available=1 -> base + 1 scaled run, 1 scaled waits.
+    pcs = disagg_pcs(name="over", sg_replicas=3, sg_min=1)
+    client.create(pcs)
+
+    wait_for(lambda: client.get(
+        PodCliqueSet, "over").status.available_replicas == 1,
+        timeout=15.0, desc="base available despite scaled pressure")
+
+    def states():
+        gangs = {g.meta.name: g for g in client.list(
+            PodGang, selector={c.LABEL_PCS_NAME: "over"})}
+        return gangs
+
+    wait_for(lambda: is_condition_true(
+        states()["over-0-model-1"].status.conditions, c.COND_SCHEDULED),
+        timeout=10.0, desc="first scaled gang placed")
+    time.sleep(0.5)
+    gangs = states()
+    assert not is_condition_true(
+        gangs["over-0-model-2"].status.conditions, c.COND_SCHEDULED)
+    # And none of the unaffordable gang's pods is partially bound.
+    pods = client.list(Pod, selector={
+        c.LABEL_PODGANG_NAME: "over-0-model-2"})
+    assert pods and all(not p.status.node_name for p in pods)
+
+
+def test_waiting_gang_places_when_capacity_frees(small_cluster):
+    """A gang pending on capacity is placed as soon as another workload
+    releases its slice (no manual nudge)."""
+    client = small_cluster.client
+    client.create(simple_pcs(name="a", replicas=2, pods=4, chips=4))
+    wait_for(lambda: len(_ready_pods(client, "a")) == 8, desc="a up (both slices)")
+
+    client.create(simple_pcs(name="b", pods=4, chips=4))
+    time.sleep(0.6)
+    assert not any(p.status.node_name for p in client.list(
+        Pod, selector={c.LABEL_PCS_NAME: "b"})), "b should be waiting"
+
+    client.delete(PodCliqueSet, "a")
+    wait_for(lambda: len(_ready_pods(client, "b")) == 4,
+             timeout=10.0, desc="b placed after capacity freed")
+
+
+def test_min_available_subset_schedules(small_cluster):
+    """min_available < replicas: the gang places when the minimum subset
+    exists even while extra pods are still materialising — and extras
+    co-locate on the gang's slice afterwards."""
+    client = small_cluster.client
+    pcs = simple_pcs(name="minset", pods=4, chips=4)
+    pcs.spec.template.cliques[0].min_available = 2
+    client.create(pcs)
+    wait_for(lambda: len(_ready_pods(client, "minset")) == 4,
+             timeout=10.0, desc="all pods eventually ready")
+    slices = {p.status.node_name.rsplit("-w", 1)[0]
+              for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "minset"})}
+    assert len(slices) == 1, f"gang split: {slices}"

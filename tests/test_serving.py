@@ -94,6 +94,22 @@ def test_continuous_batching_recycles_lanes():
     assert all(len(r.generated) == 4 for r in eng.completed)
 
 
+def test_admit_prompts_tracked_requests_complete():
+    """admit_prompts(max_new_tokens=...) runs real bookkeeping: windowed
+    drains record tokens and complete lanes at the budget."""
+    params = _params()
+    eng = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    prompts = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0,
+                                 CFG.vocab_size)
+    eng.admit_prompts(prompts, max_new_tokens=10)
+    for _ in range(16):
+        eng.step()
+    eng.sync()
+    assert len(eng.completed) == 2
+    assert all(len(r.generated) == 10 for r in eng.completed)
+    assert eng.free_lanes() == [0, 1]
+
+
 def test_metric_hook_reports_queue_depth():
     params = _params()
     seen = []
