@@ -189,11 +189,13 @@ def expected_pcsgs(pcs: PodCliqueSet,
     return out
 
 
-def _pod_group(pclq_fqn: str, replicas: int, min_avail: int) -> PodGroup:
+def _pod_group(pclq_fqn: str, replicas: int, min_avail: int,
+               topology=None) -> PodGroup:
     return PodGroup(
         name=pclq_fqn,
         pod_names=[namegen.pod_name(pclq_fqn, i) for i in range(replicas)],
         min_replicas=min_avail,
+        topology=topology,
     )
 
 
@@ -220,14 +222,14 @@ def expected_podgangs(pcs: PodCliqueSet,
         for t in standalone_cliques(pcs):
             fqn = namegen.pclq_name(pcs.meta.name, r, t.name)
             groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
-                                     min_available(t)))
+                                     min_available(t), t.topology))
         for sg in tmpl.scaling_groups:
             for j in range(sg_min_available(sg)):
                 for t in grouped_cliques(pcs, sg):
                     fqn = namegen.pcsg_pclq_name(
                         pcs.meta.name, r, sg.name, j, t.name)
                     groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
-                                             min_available(t)))
+                                             min_available(t), t.topology))
         out.append(PodGang(
             meta=_meta(pcs, base_name, _labels(pcs, r, {})),
             spec=PodGangSpec(
@@ -250,7 +252,7 @@ def expected_podgangs(pcs: PodCliqueSet,
                     fqn = namegen.pcsg_pclq_name(pcs.meta.name, r, sg.name,
                                                  j, t.name)
                     groups.append(_pod_group(fqn, pclq_replicas(fqn, t),
-                                             min_available(t)))
+                                             min_available(t), t.topology))
                 out.append(PodGang(
                     meta=_meta(pcs, name, _labels(pcs, r, {
                         c.LABEL_PCSG_NAME: namegen.pcsg_name(

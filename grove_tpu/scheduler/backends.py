@@ -27,9 +27,11 @@ from grove_tpu.api.podgang import PodGangPhase
 from grove_tpu.runtime.errors import ConflictError, NotFoundError
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.scheduler.placement import (
+    GroupRequest,
     HostView,
     PodRequest,
     plan_gang,
+    plan_gang_grouped,
     plan_single,
 )
 from grove_tpu.store.client import Client
@@ -239,17 +241,43 @@ class GangBackend:
 
         if not already_bound and group_ok and bindable:
             # First placement: gang-atomic plan over all present pods.
-            requests = [PodRequest(p.meta.name, p.spec.tpu_chips,
-                                   dict(p.spec.node_selector))
-                        for p in bindable]
             topo = gang.spec.topology
             pack_level = topo.pack_level if topo else "slice"
             required = topo.required if topo else True
             spread = self._spread_penalties(gang)
-            plan = plan_gang(requests, hosts, pack_level=pack_level,
-                             required=required,
-                             prefer_slice=self._reuse_slice(gang),
-                             spread_penalty=spread)
+
+            def req(p: Pod) -> PodRequest:
+                return PodRequest(p.meta.name, p.spec.tpu_chips,
+                                  dict(p.spec.node_selector))
+
+            if any(grp.topology is not None and grp.topology.pack_level
+                   for grp in gang.spec.groups):
+                # Per-group constraints: hierarchical planning (each
+                # constrained group packed into its own sub-domain).
+                by_pod = {p.meta.name: p for p in bindable}
+                greqs = []
+                grouped_names: set[str] = set()
+                for grp in gang.spec.groups:
+                    pods_in = [by_pod[n] for n in grp.pod_names
+                               if n in by_pod]
+                    grouped_names.update(p.meta.name for p in pods_in)
+                    greqs.append(GroupRequest(
+                        [req(p) for p in pods_in],
+                        grp.topology.pack_level if grp.topology else "",
+                        grp.topology.required if grp.topology else True))
+                stray = [req(p) for p in bindable
+                         if p.meta.name not in grouped_names]
+                if stray:
+                    greqs.append(GroupRequest(stray))
+                plan = plan_gang_grouped(
+                    greqs, hosts, pack_level=pack_level, required=required,
+                    prefer_slice=self._reuse_slice(gang),
+                    spread_penalty=spread)
+            else:
+                plan = plan_gang([req(p) for p in bindable], hosts,
+                                 pack_level=pack_level, required=required,
+                                 prefer_slice=self._reuse_slice(gang),
+                                 spread_penalty=spread)
             if plan is not None:
                 self._bind(bindable, plan.assignments)
                 gang.status.assigned_slice = plan.slice_name
@@ -266,23 +294,19 @@ class GangBackend:
                 self.recorder.event(
                     gang, "Warning", "GangUnschedulable",
                     f"no {pack_level or 'slice'} domain fits "
-                    f"{len(requests)} pods "
-                    f"({sum(r.chips for r in requests)} chips)")
+                    f"{len(bindable)} pods "
+                    f"({sum(p.spec.tpu_chips for p in bindable)} chips)")
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
-            # after a partial bind): co-locate on the slice, decrementing
-            # the capacity view after each bind. A required slice pack is
-            # a hard constraint — better an unschedulable pod than a gang
-            # whose ICI collectives can never form.
-            topo = gang.spec.topology
-            slice_required = (topo is None or
-                              (topo.pack_level in ("", "slice") and topo.required))
-            pool = hosts
-            if slice_required and gang.status.assigned_slice:
-                pool = [h for h in hosts
-                        if h.slice_name == gang.status.assigned_slice]
-            by_name = {h.name: h for h in pool}
+            # after a partial bind): co-locate with their siblings,
+            # decrementing the capacity view after each bind. Required
+            # packs (gang-level AND group-level) are hard constraints —
+            # better an unschedulable pod than a gang whose ICI
+            # collectives can never re-form.
+            bound_domains = self._bound_domains(gang, existing, hosts)
+            by_name = {h.name: h for h in hosts}
             for p in bindable:
+                pool = self._straggler_pool(gang, p, hosts, bound_domains)
                 host = plan_single(
                     PodRequest(p.meta.name, p.spec.tpu_chips,
                                dict(p.spec.node_selector)),
@@ -294,6 +318,56 @@ class GangBackend:
 
         self._update_status(gang, initialized, placed_any)
         return placed_any
+
+    def _bound_domains(self, gang: PodGang, existing: list[Pod],
+                       hosts: list[HostView]) -> dict[str, dict[str, str]]:
+        """Per group: the domain (at every level) of its bound pods —
+        the anchor stragglers must rejoin. {group_name: {level: domain}}."""
+        host_by_name = {h.name: h for h in hosts}
+        out: dict[str, dict[str, str]] = {}
+        pod_by_name = {p.meta.name: p for p in existing}
+        for grp in gang.spec.groups:
+            for pn in grp.pod_names:
+                p = pod_by_name.get(pn)
+                if p is None or not p.status.node_name:
+                    continue
+                h = host_by_name.get(p.status.node_name)
+                if h is not None:
+                    out[grp.name] = dict(h.domains)
+                    break
+        return out
+
+    def _straggler_pool(self, gang: PodGang, pod: Pod,
+                        hosts: list[HostView],
+                        bound_domains: dict[str, dict[str, str]]
+                        ) -> list[HostView]:
+        """Hosts a late pod may bind to: every *required* pack constraint
+        (gang-level and its group's) restricts to the domain its bound
+        siblings occupy."""
+        constraints: list[tuple[str, str]] = []  # (level, domain value)
+        gang_topo = gang.spec.topology
+        gang_level = gang_topo.pack_level if gang_topo else "slice"
+        gang_required = gang_topo.required if gang_topo else True
+        my_group = next((g for g in gang.spec.groups
+                         if pod.meta.name in g.pod_names), None)
+        anchor = bound_domains.get(my_group.name) if my_group else None
+        if anchor is None and bound_domains:
+            anchor = next(iter(bound_domains.values()))
+        if anchor:
+            if gang_required and gang_level:
+                constraints.append((gang_level, anchor.get(gang_level, "")))
+            if (my_group is not None and my_group.topology is not None
+                    and my_group.topology.pack_level
+                    and my_group.topology.required
+                    and my_group.name in bound_domains):
+                lvl = my_group.topology.pack_level
+                constraints.append(
+                    (lvl, bound_domains[my_group.name].get(lvl, "")))
+        pool = hosts
+        for level, value in constraints:
+            if value:
+                pool = [h for h in pool if h.domains.get(level) == value]
+        return pool
 
     def _reuse_slice(self, gang: PodGang) -> str:
         """Resolve the placement-reuse hint to a slice name: an explicit

@@ -111,14 +111,28 @@ def plan_gang(pods: list[PodRequest], hosts: list[HostView],
     for h in hosts:
         by_domain[_domain_of(h, level)].append(h)
 
+    return _best_domain_plan(by_domain, hosts, _fit_in_hosts_of(pods),
+                             sum(p.chips for p in pods), level, required,
+                             prefer_slice, spread_penalty)
+
+
+def _fit_in_hosts_of(pods: list[PodRequest]):
+    return lambda domain_hosts: _fit_in_hosts(pods, domain_hosts)
+
+
+def _best_domain_plan(by_domain, all_hosts, fit_fn, used_chips, level,
+                      required, prefer_slice, spread_penalty
+                      ) -> PlacementPlan | None:
+    """Score every candidate domain with ``fit_fn`` and pick the best;
+    relax across all hosts when the pack is only preferred. Shared by the
+    flat and per-group planners so scoring semantics cannot diverge."""
     candidates: list[PlacementPlan] = []
     for domain, domain_hosts in by_domain.items():
-        assignment = _fit_in_hosts(pods, domain_hosts)
+        assignment = fit_fn(domain_hosts)
         if assignment is None:
             continue
         total_free = sum(h.free_chips for h in domain_hosts)
-        used = sum(p.chips for p in pods)
-        tightness = used / total_free if total_free else 1.0
+        tightness = used_chips / total_free if total_free else 1.0
         score = tightness - spread_penalty.get(domain, 0.0)
         if prefer_slice and domain == prefer_slice:
             score += 10.0   # reuse dominates
@@ -130,10 +144,80 @@ def plan_gang(pods: list[PodRequest], hosts: list[HostView],
     if required:
         return None
     # Preferred packing failed -> relax across all hosts.
-    assignment = _fit_in_hosts(pods, hosts)
+    assignment = fit_fn(all_hosts)
     if assignment is None:
         return None
     return PlacementPlan(assignment, "", -1.0)
+
+
+@dataclasses.dataclass
+class GroupRequest:
+    """A PodGroup with its own (stricter) pack constraint."""
+
+    pods: list[PodRequest]
+    pack_level: str = ""          # "" = no group-level constraint
+    required: bool = True         # False = preferred (relaxes on failure)
+
+
+def plan_gang_grouped(groups: list[GroupRequest], hosts: list[HostView],
+                      pack_level: str = "slice", required: bool = True,
+                      prefer_slice: str = "",
+                      spread_penalty: dict[str, float] | None = None
+                      ) -> PlacementPlan | None:
+    """Gang planning with per-group pack constraints (reference
+    PodGroup.TopologyConstraint, scheduler api podgang.go:99-117).
+
+    The gang-level constraint picks the enclosing domain as in plan_gang;
+    inside it, each group with its own stricter level is packed into ONE
+    sub-domain of that level (e.g. a gang packed per pool with each
+    group slice-resident). Groups without constraints fill remaining
+    capacity anywhere in the gang domain.
+    """
+    all_pods = [p for g in groups for p in g.pods]
+    if not any(g.pack_level for g in groups):
+        return plan_gang(all_pods, hosts, pack_level=pack_level,
+                         required=required, prefer_slice=prefer_slice,
+                         spread_penalty=spread_penalty)
+    spread_penalty = spread_penalty or {}
+    level = pack_level or "slice"
+    by_domain: dict[str, list[HostView]] = defaultdict(list)
+    for h in hosts:
+        by_domain[_domain_of(h, level)].append(h)
+
+    def plan_in_domain(domain_hosts: list[HostView]) -> dict[str, str] | None:
+        free = {h.name: h.free_chips for h in domain_hosts}
+        assignment: dict[str, str] = {}
+
+        def commit(sub: dict[str, str], pods: list[PodRequest]) -> None:
+            chips = {p.name: p.chips for p in pods}
+            for pn, hn in sub.items():
+                assignment[pn] = hn
+                free[hn] -= chips[pn]
+
+        def views() -> list[HostView]:
+            return [dataclasses.replace(h, free_chips=free[h.name])
+                    for h in domain_hosts]
+
+        # Constrained groups first (hardest), largest demand first.
+        constrained = sorted((g for g in groups if g.pack_level),
+                             key=lambda g: -sum(p.chips for p in g.pods))
+        for g in constrained:
+            sub_plan = plan_gang(g.pods, views(), pack_level=g.pack_level,
+                                 required=g.required)
+            if sub_plan is None:
+                return None
+            commit(sub_plan.assignments, g.pods)
+        rest = [p for g in groups if not g.pack_level for p in g.pods]
+        if rest:
+            sub = _fit_in_hosts(rest, views())
+            if sub is None:
+                return None
+            commit(sub, rest)
+        return assignment
+
+    return _best_domain_plan(by_domain, hosts, plan_in_domain,
+                             sum(p.chips for p in all_pods), level,
+                             required, prefer_slice, spread_penalty)
 
 
 def plan_single(pod: PodRequest, hosts: list[HostView],

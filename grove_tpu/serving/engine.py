@@ -122,6 +122,10 @@ class DecodeEngine:
         self._requests: list[Request | None] = [None] * batch
         self._queue: deque[Request] = deque()
         self._pending_tokens: list[jnp.ndarray] = []
+        # Steps already pending when a lane was (re)admitted: tokens from
+        # before the admission belong to the previous occupant, not the
+        # new request.
+        self._lane_window_start = np.zeros((batch,), np.int32)
         self._next_rid = 0
         self.completed: list[Request] = []
         self.steps = 0
@@ -187,11 +191,16 @@ class DecodeEngine:
         self._active[:] = True
         if max_new_tokens is not None:
             prompts_np = np.asarray(prompts)
+            first = np.asarray(self._tokens)
+            self._lane_window_start[:] = len(self._pending_tokens)
             for i in range(b):
                 req = Request(rid=self._next_rid, prompt=prompts_np[i],
                               max_new_tokens=max_new_tokens)
                 self._next_rid += 1
                 self._requests[i] = req
+                # Count the prefill-sampled token like insert() does —
+                # both admission paths account tokens identically.
+                req.generated.append(int(first[i]))
 
     # ---- disaggregated mode ----
 
@@ -210,6 +219,7 @@ class DecodeEngine:
         self._tokens = self._tokens.at[lane].set(result.next_token)
         self._active[lane] = True
         self._requests[lane] = request
+        self._lane_window_start[lane] = len(self._pending_tokens)
         if request is not None:
             request.generated.append(result.next_token)
 
@@ -246,12 +256,16 @@ class DecodeEngine:
             return
         toks = np.asarray(jnp.stack(self._pending_tokens))  # [w, batch]
         self._pending_tokens.clear()
-        room = np.asarray(self.cache.has_room())
+        # A lane must keep a full window of cache room: drains happen every
+        # host_sync_interval steps, and write_row clamps silently past
+        # max_len — completing the lane a window early prevents that.
+        room = np.asarray(self.cache.has_room(self.host_sync_interval))
         freed = False
         for i, req in enumerate(self._requests):
             if req is None or not self._active[i]:
                 continue
-            for t in toks[:, i]:
+            start = int(self._lane_window_start[i])
+            for t in toks[start:, i]:
                 req.generated.append(int(t))
                 if len(req.generated) >= req.max_new_tokens:
                     break
@@ -263,6 +277,7 @@ class DecodeEngine:
                 freed = True
                 lengths = self.cache.lengths.at[i].set(0)
                 self.cache = self.cache._replace(lengths=lengths)
+        self._lane_window_start[:] = 0
         if freed:
             self._report_metric()
 

@@ -79,6 +79,41 @@ def test_waiting_gang_places_when_capacity_frees(small_cluster):
              timeout=10.0, desc="b placed after capacity freed")
 
 
+def test_per_group_topology_constraints(small_cluster):
+    """Gang packed at pool level with each clique slice-constrained: the
+    two cliques land slice-resident individually even though together
+    they exceed any single slice (reference PodGroup.TopologyConstraint,
+    podgang.go:99-117)."""
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec, PodCliqueSetTemplate, PodCliqueTemplate,
+        TopologyConstraint)
+    from grove_tpu.api import new_meta
+    client = small_cluster.client
+    slice_pack = TopologyConstraint(pack_level="slice", required=True)
+    client.create(PodCliqueSet(
+        meta=new_meta("grouped"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            topology=TopologyConstraint(pack_level="pool", required=True),
+            cliques=[
+                PodCliqueTemplate(name="left", replicas=3,
+                                  tpu_chips_per_pod=4, topology=slice_pack,
+                                  container=ContainerSpec(argv=["x"])),
+                PodCliqueTemplate(name="right", replicas=3,
+                                  tpu_chips_per_pod=4, topology=slice_pack,
+                                  container=ContainerSpec(argv=["x"])),
+            ]))))
+    # 24 chips total > 16/slice: only satisfiable with per-group packing.
+    wait_for(lambda: len(_ready_pods(client, "grouped")) == 6,
+             timeout=10.0, desc="grouped gang placed")
+    by_clique = {}
+    for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "grouped"}):
+        role = p.meta.labels[c.LABEL_PCLQ_ROLE]
+        by_clique.setdefault(role, set()).add(
+            p.status.node_name.rsplit("-w", 1)[0])
+    assert all(len(s) == 1 for s in by_clique.values()), by_clique
+
+
 def test_min_available_subset_schedules(small_cluster):
     """min_available < replicas: the gang places when the minimum subset
     exists even while extra pods are still materialising — and extras
