@@ -197,7 +197,12 @@ class GangBackend:
             if gang.spec.base_gang and not scheduled_by_name.get(
                     gang.spec.base_gang, False):
                 continue  # scaled capacity never blocks/preempts base gangs
-            placed = self._sync_gang(gang, hosts)
+            placed, preempted = self._sync_gang(gang, hosts)
+            if preempted:
+                # Stop the pass: freed capacity must go to the preemptor
+                # on the next pass (which re-sorts by priority), not to a
+                # lower-priority gang later in THIS pass.
+                break
             if placed:
                 hosts = build_host_views(client, self.namespace,
                                          self._level_labels)
@@ -238,6 +243,7 @@ class GangBackend:
             for grp in gang.spec.groups))
 
         placed_any = False
+        preempted = False
 
         if not already_bound and group_ok and bindable:
             # First placement: gang-atomic plan over all present pods.
@@ -250,6 +256,7 @@ class GangBackend:
                 return PodRequest(p.meta.name, p.spec.tpu_chips,
                                   dict(p.spec.node_selector))
 
+            plan_fn = None
             if any(grp.topology is not None and grp.topology.pack_level
                    for grp in gang.spec.groups):
                 # Per-group constraints: hierarchical planning (each
@@ -269,15 +276,17 @@ class GangBackend:
                          if p.meta.name not in grouped_names]
                 if stray:
                     greqs.append(GroupRequest(stray))
-                plan = plan_gang_grouped(
-                    greqs, hosts, pack_level=pack_level, required=required,
+                plan_fn = lambda hv: plan_gang_grouped(
+                    greqs, hv, pack_level=pack_level, required=required,
                     prefer_slice=self._reuse_slice(gang),
                     spread_penalty=spread)
             else:
-                plan = plan_gang([req(p) for p in bindable], hosts,
-                                 pack_level=pack_level, required=required,
-                                 prefer_slice=self._reuse_slice(gang),
-                                 spread_penalty=spread)
+                requests = [req(p) for p in bindable]
+                plan_fn = lambda hv: plan_gang(
+                    requests, hv, pack_level=pack_level, required=required,
+                    prefer_slice=self._reuse_slice(gang),
+                    spread_penalty=spread)
+            plan = plan_fn(hosts)
             if plan is not None:
                 self._bind(bindable, plan.assignments)
                 gang.status.assigned_slice = plan.slice_name
@@ -296,6 +305,8 @@ class GangBackend:
                     f"no {pack_level or 'slice'} domain fits "
                     f"{len(bindable)} pods "
                     f"({sum(p.spec.tpu_chips for p in bindable)} chips)")
+                if self._try_preempt_for(gang, plan_fn, hosts):
+                    preempted = True
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate with their siblings,
@@ -317,7 +328,92 @@ class GangBackend:
                     placed_any = True
 
         self._update_status(gang, initialized, placed_any)
-        return placed_any
+        return placed_any, preempted
+
+    def _try_preempt_for(self, gang: PodGang, plan_fn,
+                         hosts: list[HostView]) -> bool:
+        """Free capacity for a starved BASE gang by evicting one scaled
+        (elastic) gang of equal-or-lower priority.
+
+        Elastic capacity is best-effort by definition — the base-gang
+        guarantee ('scaled capacity never starves the base', reference
+        syncflow.go:387 gating) extends across PodCliqueSets here.
+        ``plan_fn`` is the exact planner the gang failed with (flat or
+        per-group): eviction happens only when some victim's reclaimed
+        capacity makes that very plan feasible — the cheapest such victim
+        by (priority, chips). The victim's pods are deleted; its
+        PodClique recreates them gated and the gang re-queues behind the
+        preemptor. One victim per pass keeps eviction minimal.
+        """
+        if gang.spec.base_gang:
+            return False  # only base gangs preempt
+        client = self.client
+        victims = []
+        for other in client.list(PodGang, self.namespace):
+            if not other.spec.base_gang:
+                continue  # never evict another base gang
+            if other.spec.priority > gang.spec.priority:
+                continue
+            # Only capacity the victim actually holds (matches the
+            # used-chips predicate of build_host_views).
+            pods = [p for p in client.list(
+                Pod, self.namespace,
+                selector={c.LABEL_PODGANG_NAME: other.meta.name})
+                if p.status.node_name
+                and p.meta.deletion_timestamp is None
+                and p.status.phase.value in ("Pending", "Running")]
+            if not pods:
+                continue
+            victims.append((sum(p.spec.tpu_chips for p in pods), other, pods))
+        if not victims:
+            return False
+
+        def feasible_with(victim_pods) -> bool:
+            reclaim: dict[str, int] = defaultdict(int)
+            for p in victim_pods:
+                reclaim[p.status.node_name] += p.spec.tpu_chips
+            potential = [
+                HostView(h.name, h.free_chips + reclaim.get(h.name, 0),
+                         dict(h.domains), dict(h.labels)) for h in hosts]
+            return plan_fn(potential) is not None
+
+        # Cheapest single victim whose eviction alone makes the plan work.
+        viable = [(chips, v, pods) for chips, v, pods in victims
+                  if feasible_with(pods)]
+        if not viable:
+            # Multi-victim scenarios: evict only when everything together
+            # would work, and then only a victim intersecting the plan's
+            # chosen hosts (never an irrelevant one).
+            all_pods = [p for _, _, pods in victims for p in pods]
+            if not feasible_with(all_pods):
+                return False
+            reclaim_all: dict[str, int] = defaultdict(int)
+            for p in all_pods:
+                reclaim_all[p.status.node_name] += p.spec.tpu_chips
+            potential = [
+                HostView(h.name, h.free_chips + reclaim_all.get(h.name, 0),
+                         dict(h.domains), dict(h.labels)) for h in hosts]
+            plan = plan_fn(potential)
+            used_hosts = set(plan.assignments.values())
+            viable = [(chips, v, pods) for chips, v, pods in victims
+                      if any(p.status.node_name in used_hosts for p in pods)]
+            if not viable:
+                return False
+        _, victim, pods = min(viable, key=lambda v: (v[1].spec.priority, v[0]))
+        self.log.info("preempting scaled gang %s (priority %d) for base "
+                      "gang %s (priority %d)", victim.meta.name,
+                      victim.spec.priority, gang.meta.name,
+                      gang.spec.priority)
+        self.recorder.event(
+            victim, "Warning", "GangPreempted",
+            f"evicted for starved base gang {gang.meta.name} "
+            f"(priority {gang.spec.priority} >= {victim.spec.priority})")
+        for p in pods:
+            try:
+                client.delete(Pod, p.meta.name, p.meta.namespace)
+            except (NotFoundError, ConflictError):
+                pass
+        return True
 
     def _bound_domains(self, gang: PodGang, existing: list[Pod],
                        hosts: list[HostView]) -> dict[str, dict[str, str]]:

@@ -114,6 +114,47 @@ def test_per_group_topology_constraints(small_cluster):
     assert all(len(s) == 1 for s in by_clique.values()), by_clique
 
 
+def test_base_gang_preempts_scaled_capacity(small_cluster):
+    """A starved base gang evicts another PCS's elastic (scaled) gang —
+    the base-gang guarantee extends across PodCliqueSets; the evicted
+    gang re-queues and recovers when capacity frees."""
+    client = small_cluster.client
+    # PCS-A: base (1 slice) + 1 scaled replica (2nd slice) -> fleet full.
+    client.create(disagg_pcs(name="a", sg_replicas=2, sg_min=1))
+    wait_for(lambda: len(_ready_pods(client, "a")) == 9,
+             timeout=15.0, desc="a fully up (both slices)")
+
+    # PCS-B: a base gang that needs one slice -> must preempt a's scaled.
+    client.create(simple_pcs(name="b", pods=4, chips=4))
+    wait_for(lambda: len(_ready_pods(client, "b")) == 4,
+             timeout=15.0, desc="b placed via preemption")
+
+    from grove_tpu.runtime.events import events_for
+    evs = events_for(client, "PodGang", "a-0-model-1")
+    assert any(e.reason == "GangPreempted" for e in evs), evs
+    # a's base replica is untouched; its scaled replica waits for capacity.
+    assert client.get(PodCliqueSet, "a").status.available_replicas == 1
+
+    # b released -> a's scaled gang recovers on its own.
+    client.delete(PodCliqueSet, "b")
+    wait_for(lambda: len(_ready_pods(client, "a")) == 9,
+             timeout=15.0, desc="a's elastic capacity recovered")
+
+
+def test_no_pointless_preemption(small_cluster):
+    """A gang too big to ever fit must not shed innocent elastic capacity."""
+    client = small_cluster.client
+    client.create(disagg_pcs(name="a", sg_replicas=2, sg_min=1))
+    wait_for(lambda: len(_ready_pods(client, "a")) == 9, timeout=15.0,
+             desc="a up")
+    client.create(simple_pcs(name="huge", pods=5, chips=4))  # 20 > 16/slice
+    time.sleep(1.0)
+    assert len(_ready_pods(client, "a")) == 9, "innocent capacity evicted"
+    from grove_tpu.runtime.events import events_for
+    assert not any(e.reason == "GangPreempted"
+                   for e in events_for(client, "PodGang", "a-0-model-1"))
+
+
 def test_min_available_subset_schedules(small_cluster):
     """min_available < replicas: the gang places when the minimum subset
     exists even while extra pods are still materialising — and extras
