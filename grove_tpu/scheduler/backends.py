@@ -497,19 +497,22 @@ class GangBackend:
         return dict(penalties)
 
     def _bind(self, pods: list[Pod], assignment: dict[str, str]) -> None:
+        to_write = []
         for pod in pods:
             host = assignment.get(pod.meta.name)
             if host is None:
                 continue
             pod.status.node_name = host
-            try:
-                self.client.update_status(pod)
-            except (NotFoundError, ConflictError) as e:
-                # Pod vanished or changed under us (scale-in race): skip;
-                # the next pass replans from live state. Aborting here
-                # would strand the rest of the gang mid-bind.
-                self.log.debug("bind %s -> %s skipped: %s",
-                               pod.meta.name, host, e)
+            to_write.append(pod)
+        # One batched store transaction: per-pod locking would serialise a
+        # large gang bind against every reader. Individual failures (pod
+        # vanished / changed under us in a scale-in race) are skipped; the
+        # next pass replans from live state — aborting would strand the
+        # rest of the gang mid-bind.
+        for pod, err in zip(to_write,
+                            self.client.update_status_many(to_write)):
+            if err is not None:
+                self.log.debug("bind %s skipped: %s", pod.meta.name, err)
 
     def _update_status(self, gang: PodGang, initialized: bool,
                        placed_now: bool) -> None:

@@ -42,6 +42,9 @@ class Client:
     def update_status(self, obj: Any) -> Any:
         return self._store.update_status(obj, actor=self.actor)
 
+    def update_status_many(self, objs: list[Any]) -> list[Exception | None]:
+        return self._store.update_status_many(objs, actor=self.actor)
+
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         return self._store.delete(kind_cls, name, namespace, actor=self.actor)
 
@@ -117,6 +120,20 @@ class FakeClient(Client):
     def update_status(self, obj: Any) -> Any:
         self._intercept("update_status", obj.KIND, obj.meta.name)
         return super().update_status(obj)
+
+    def update_status_many(self, objs: list[Any]) -> list[Exception | None]:
+        # Batches decompose to singular writes so injected update_status
+        # errors replay and every call is recorded (the whole point of
+        # this fake); production batching is a store-level optimisation.
+        from grove_tpu.runtime.errors import ConflictError, NotFoundError
+        results: list[Exception | None] = []
+        for obj in objs:
+            try:
+                self.update_status(obj)
+                results.append(None)
+            except (NotFoundError, ConflictError) as e:
+                results.append(e)
+        return results
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         self._intercept("delete", kind_cls.KIND, name)

@@ -202,22 +202,50 @@ class Store:
         at steady state.
         """
         with self._lock:
-            live = self._get_live(obj)
-            # Status is a privileged surface (node binding, breach
-            # conditions, gang placement) — same authorization as spec.
-            self._admit("update_status", clone(obj), clone(live), actor)
-            if obj.meta.resource_version != live.meta.resource_version:
-                raise ConflictError(
-                    f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
-                    f"resource_version (status)")
-            if to_dict(obj.status) == to_dict(live.status):
-                return clone(live)
-            stored = clone(live)
-            stored.status = clone(obj.status)
-            stored.meta.resource_version = next(self._rv)
-            self._objects[obj.KIND][_key(obj)] = stored
-            self._emit(EventType.MODIFIED, stored)
-            return clone(stored)
+            return clone(self._update_status_locked(obj, actor))
+
+    def _update_status_locked(self, obj: Any, actor: str) -> Any:
+        """Single source of truth for status-write semantics (shared by the
+        singular and batched paths). Caller holds the lock."""
+        live = self._get_live(obj)
+        # Status is a privileged surface (node binding, breach conditions,
+        # gang placement) — same authorization as spec.
+        self._admit("update_status", clone(obj), clone(live), actor)
+        if obj.meta.resource_version != live.meta.resource_version:
+            raise ConflictError(
+                f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
+                f"resource_version (status)")
+        if to_dict(obj.status) == to_dict(live.status):
+            return live
+        stored = clone(live)
+        stored.status = clone(obj.status)
+        stored.meta.resource_version = next(self._rv)
+        self._objects[obj.KIND][_key(obj)] = stored
+        self._emit(EventType.MODIFIED, stored)
+        return stored
+
+    def update_status_many(self, objs: list[Any],
+                           actor: str = "system:grove-operator"
+                           ) -> list[Exception | None]:
+        """Batched status updates under one lock acquisition (the gang
+        scheduler binds hundreds of pods at once; per-call locking and
+        admission would serialise the bind against every reader).
+
+        Returns one entry per input: None on success, NotFound/Conflict
+        (the expected races) otherwise — callers decide per-object what a
+        failure means. Any other exception (admission denial, codec bug)
+        propagates loudly: swallowing it into the result list would turn
+        a systemic failure into a silent forever-pending gang.
+        """
+        results: list[Exception | None] = []
+        with self._lock:
+            for obj in objs:
+                try:
+                    self._update_status_locked(obj, actor)
+                    results.append(None)
+                except (NotFoundError, ConflictError) as e:
+                    results.append(e)
+        return results
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default",
                actor: str = "system:grove-operator") -> None:

@@ -99,6 +99,29 @@ def test_watch_events():
     assert w2.poll(0.05) is None
 
 
+def test_update_status_many_partial_failure():
+    """Batched status writes: per-object results, one stale entry fails
+    alone, no-op writes suppressed."""
+    s = Store()
+    a = s.create(make_pod("a"))
+    b = s.create(make_pod("b"))
+    stale_b = s.get(Pod, "b")
+    b.status.message = "bump"      # make stale_b actually stale
+    s.update_status(b)
+    a.status.node_name = "h1"
+    stale_b.status.node_name = "h2"
+    results = s.update_status_many([a, stale_b])
+    assert results[0] is None
+    assert isinstance(results[1], ConflictError)
+    assert s.get(Pod, "a").status.node_name == "h1"
+    assert s.get(Pod, "b").status.node_name == ""
+    # byte-identical second write: success, but no version bump
+    rv = s.get(Pod, "a").meta.resource_version
+    fresh = s.get(Pod, "a")
+    assert s.update_status_many([fresh]) == [None]
+    assert s.get(Pod, "a").meta.resource_version == rv
+
+
 def test_fake_client_error_injection():
     c = FakeClient()
     c.create(make_pod("a"))
