@@ -182,9 +182,98 @@ def cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+def _http(server: str, path: str, method: str = "GET",
+          body: bytes | None = None,
+          content_type: str = "application/yaml"):
+    """Request against a serve daemon. Returns (status, decoded-body);
+    status 0 = could not reach the server. Shared by the client verbs and
+    the server tests."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    def decode(raw: bytes, ctype: str):
+        if "json" in ctype:
+            try:
+                return _json.loads(raw or b"null")
+            except ValueError:
+                pass
+        return raw.decode(errors="replace")
+
+    req = urllib.request.Request(f"{server}{path}", method=method, data=body,
+                                 headers={"Content-Type": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, decode(resp.read(),
+                                       resp.headers.get("Content-Type", ""))
+    except urllib.error.HTTPError as e:
+        # Error bodies may be non-JSON (proxy, wrong service on the port).
+        return e.code, decode(e.read(),
+                              e.headers.get("Content-Type", "") or "json")
+    except urllib.error.URLError as e:
+        return 0, {"error": f"cannot reach {server}: {e.reason}"}
+
+
+def _err_text(body) -> str:
+    return body.get("error", body) if isinstance(body, dict) else str(body)
+
+
+def cmd_get(args: argparse.Namespace) -> int:
+    """Read resources from a running serve daemon."""
+    import json as _json
+    path = f"/api/{args.kind}" + (f"/{args.name}" if args.name else "")
+    status, body = _http(args.server, path)
+    if status != 200:
+        print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
+        return 1
+    print(_json.dumps(body, indent=2))
+    return 0
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    """Apply a manifest against a running serve daemon."""
+    with open(args.file, "rb") as f:
+        body = f.read()
+    status, out = _http(args.server, "/apply", "POST", body)
+    if status != 200:
+        print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
+        return 1
+    for r in out:
+        print(f"{r['kind']}/{r['name']} {r['action']}")
+    return 0
+
+
+def cmd_delete(args: argparse.Namespace) -> int:
+    """Delete a resource on a running serve daemon."""
+    status, out = _http(args.server, f"/api/{args.kind}/{args.name}", "DELETE")
+    if status != 200:
+        print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
+        return 1
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="grovectl")
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    default_server = "http://127.0.0.1:8087"
+    get = sub.add_parser("get", help="read resources from a serve daemon")
+    get.add_argument("kind")
+    get.add_argument("name", nargs="?")
+    get.add_argument("--server", default=default_server)
+    get.set_defaults(fn=cmd_get)
+
+    apply_p = sub.add_parser("apply", help="apply a manifest to a serve daemon")
+    apply_p.add_argument("-f", "--file", required=True)
+    apply_p.add_argument("--server", default=default_server)
+    apply_p.set_defaults(fn=cmd_apply)
+
+    delete = sub.add_parser("delete", help="delete a resource on a serve daemon")
+    delete.add_argument("kind")
+    delete.add_argument("name")
+    delete.add_argument("--server", default=default_server)
+    delete.set_defaults(fn=cmd_delete)
 
     serve = sub.add_parser("serve", help="run the control plane as a "
                                          "daemon with an HTTP API")

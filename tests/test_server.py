@@ -34,16 +34,13 @@ def server():
 
 
 def _req(url, method="GET", body=None, content_type="application/yaml"):
-    req = urllib.request.Request(url, method=method,
-                                 data=body.encode() if body else None,
-                                 headers={"Content-Type": content_type})
-    try:
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return resp.status, json.loads(resp.read() or b"null") \
-                if "json" in resp.headers.get("Content-Type", "") \
-                else resp.read().decode()
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read() or b"{}")
+    """Thin shim over the CLI's shared _http helper (one copy of the
+    request/decode logic for client verbs and tests alike)."""
+    from grove_tpu.cli import _http
+    scheme_host, _, rest = url.removeprefix("http://").partition("/")
+    return _http(f"http://{scheme_host}", f"/{rest}", method=method,
+                 body=body.encode() if body else None,
+                 content_type=content_type)
 
 
 def test_apply_watch_delete_over_http(server):
@@ -67,6 +64,27 @@ def test_apply_watch_delete_over_http(server):
     status, _ = _req(f"{base}/api/PodCliqueSet/websvc", "DELETE")
     assert status == 200
     wait_for(lambda: _req(f"{base}/api/Pod")[1] == [], desc="pods gone")
+
+
+def test_grovectl_client_verbs(server, tmp_path, capsys):
+    """grovectl apply/get/delete drive a remote serve daemon."""
+    from grove_tpu.cli import main
+    base, _ = server
+    manifest = tmp_path / "svc.yaml"
+    manifest.write_text(MANIFEST)
+
+    assert main(["apply", "-f", str(manifest), "--server", base]) == 0
+    assert "PodCliqueSet/websvc created" in capsys.readouterr().out
+
+    wait_for(lambda: (main(["get", "PodCliqueSet", "websvc",
+                            "--server", base]) == 0
+                      and '"available_replicas": 1'
+                      in capsys.readouterr().out),
+             desc="available via grovectl get")
+
+    assert main(["delete", "PodCliqueSet", "websvc", "--server", base]) == 0
+    assert "deleted" in capsys.readouterr().out
+    assert main(["get", "PodCliqueSet", "websvc", "--server", base]) == 1
 
 
 def test_health_metrics_and_errors(server):
