@@ -232,8 +232,12 @@ def cmd_get(args: argparse.Namespace) -> int:
 
 def cmd_apply(args: argparse.Namespace) -> int:
     """Apply a manifest against a running serve daemon."""
-    with open(args.file, "rb") as f:
-        body = f.read()
+    try:
+        with open(args.file, "rb") as f:
+            body = f.read()
+    except OSError as e:
+        print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
+        return 1
     status, out = _http(args.server, "/apply", "POST", body)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)

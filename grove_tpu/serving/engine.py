@@ -30,6 +30,29 @@ from grove_tpu.models.llama import LlamaConfig
 from grove_tpu.ops.kvcache import KVCache
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    """Token sampling: temperature 0 = greedy argmax; otherwise
+    temperature-scaled categorical over the top_k logits (0 = full
+    vocab). Compiled into the decode step (static branch)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+
+
+def sample_tokens(logits: jnp.ndarray, key: jax.Array,
+                  cfg: SamplerConfig) -> jnp.ndarray:
+    """logits [b, vocab] -> tokens [b] per the sampler config."""
+    if cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / cfg.temperature
+    if cfg.top_k > 0 and cfg.top_k < scaled.shape[-1]:
+        kth = jax.lax.top_k(scaled, cfg.top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 @dataclasses.dataclass
 class Request:
     rid: int
@@ -101,8 +124,12 @@ class DecodeEngine:
     def __init__(self, cfg: LlamaConfig, key_or_params, batch: int = 8,
                  max_len: int | None = None,
                  metric_hook: Callable[[int], None] | None = None,
-                 host_sync_interval: int = 8):
+                 host_sync_interval: int = 8,
+                 sampler: SamplerConfig | None = None):
         self.cfg = cfg
+        # Init-only: the sampled step closes over this config at compile
+        # time, so later mutation cannot take effect (and is rejected).
+        self._sampler = sampler or SamplerConfig()
         if isinstance(key_or_params, jax.Array) and key_or_params.dtype == jnp.uint32:
             self.params = llama.init_params(cfg, key_or_params)
         else:
@@ -130,16 +157,33 @@ class DecodeEngine:
         self.completed: list[Request] = []
         self.steps = 0
 
-        def step_fn(params, tokens, cache):
+        sampler_cfg = self._sampler
+        self._sampling = sampler_cfg.temperature > 0.0
+        self._rng = jax.random.PRNGKey(sampler_cfg.seed)
+
+        def step_greedy(params, tokens, cache):
             logits, cache = llama.decode_step(cfg, params, tokens, cache)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        self._step = jax.jit(step_fn, donate_argnums=(2,))
+        def step_sampled(params, tokens, cache, key):
+            logits, cache = llama.decode_step(cfg, params, tokens, cache)
+            key, sub = jax.random.split(key)
+            return sample_tokens(logits, sub, sampler_cfg), cache, key
+
+        # The greedy 3-ary step stays the public compiled surface
+        # (benchmarks, raw loops); sampling engines use the key-threaded
+        # variant internally and only compile it when actually sampling.
+        self._step = jax.jit(step_greedy, donate_argnums=(2,))
+        self._step_sampled = jax.jit(step_sampled, donate_argnums=(2,))
 
         def pf(params, tokens, lengths, cache):
             return llama.prefill(cfg, params, tokens, cache, lengths)
 
         self._prefill = jax.jit(pf, donate_argnums=(3,))
+
+    @property
+    def sampler(self) -> SamplerConfig:
+        return self._sampler
 
     # ---- compiled-callable access (benchmarks, custom loops) ----
 
@@ -187,7 +231,11 @@ class DecodeEngine:
         lengths = jnp.full((b,), s, jnp.int32)
         logits, self.cache = self._prefill(self.params, prompts, lengths,
                                            self.cache)
-        self._tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if self._sampling:
+            self._rng, sub = jax.random.split(self._rng)
+            self._tokens = sample_tokens(logits, sub, self._sampler)
+        else:
+            self._tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         self._active[:] = True
         if max_new_tokens is not None:
             prompts_np = np.asarray(prompts)
@@ -242,8 +290,12 @@ class DecodeEngine:
     def step(self) -> None:
         """One decode step across all lanes (inactive lanes compute too —
         static shapes beat per-lane control flow on TPU)."""
-        self._tokens, self.cache = self._step(self.params, self._tokens,
-                                              self.cache)
+        if self._sampling:
+            self._tokens, self.cache, self._rng = self._step_sampled(
+                self.params, self._tokens, self.cache, self._rng)
+        else:
+            self._tokens, self.cache = self._step(self.params, self._tokens,
+                                                  self.cache)
         self.steps += 1
         if any(r is not None for r in self._requests):
             self._pending_tokens.append(self._tokens)

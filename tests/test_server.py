@@ -1,8 +1,5 @@
 """HTTP API server: apply/list/get/delete, health, metrics."""
 
-import json
-import urllib.request
-
 import pytest
 
 from grove_tpu.cluster import new_cluster

@@ -110,6 +110,38 @@ def test_admit_prompts_tracked_requests_complete():
     assert eng.free_lanes() == [0, 1]
 
 
+def test_sampling_temperature_and_topk():
+    """Sampled decoding: deterministic per seed, varies across seeds,
+    respects top-k support; temperature 0 == greedy."""
+    from grove_tpu.serving.engine import SamplerConfig, sample_tokens
+    params = _params()
+    prompts = jax.random.randint(jax.random.PRNGKey(9), (2, 6), 0,
+                                 CFG.vocab_size)
+
+    def run(seed, temp):
+        eng = DecodeEngine(CFG, params, batch=2,
+                           sampler=SamplerConfig(temperature=temp,
+                                                 top_k=8, seed=seed))
+        eng.admit_prompts(prompts)
+        out = []
+        for _ in range(6):
+            eng.step()
+            out.append(np.asarray(eng._tokens).tolist())
+        eng.sync()
+        return out
+
+    assert run(0, 1.2) == run(0, 1.2)          # deterministic per seed
+    assert run(0, 1.2) != run(1, 1.2)          # seed changes trajectory
+    assert run(0, 0.0) == run(5, 0.0)          # greedy ignores the seed
+
+    # top-k at the op level: only the k best logits are ever sampled.
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 4.0]])
+    cfgk = SamplerConfig(temperature=0.5, top_k=2, seed=0)
+    picks = {int(sample_tokens(logits, jax.random.PRNGKey(i), cfgk)[0])
+             for i in range(30)}
+    assert picks <= {3, 4}, picks
+
+
 def test_metric_hook_reports_queue_depth():
     params = _params()
     seen = []
