@@ -78,11 +78,14 @@ class PrefillWorker:
     throughput over long prompts)."""
 
     def __init__(self, cfg: LlamaConfig, params, batch: int = 1,
-                 max_prompt: int | None = None):
+                 max_prompt: int | None = None,
+                 sampler: SamplerConfig | None = None):
         self.cfg = cfg
         self.params = params
         self.batch = batch
         self.max_prompt = max_prompt or cfg.max_seq_len
+        self.sampler = sampler or SamplerConfig()
+        self._rng = jax.random.PRNGKey(self.sampler.seed)
 
         def run(params, tokens, lengths, cache):
             return llama.prefill(cfg, params, tokens, cache, lengths)
@@ -103,7 +106,11 @@ class PrefillWorker:
         logits, cache = self._prefill(self.params, jnp.asarray(toks),
                                       jnp.asarray(lengths), self._cache)
         self._cache = cache
-        next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
+        if self.sampler.temperature > 0.0:
+            self._rng, sub = jax.random.split(self._rng)
+            next_tokens = np.asarray(sample_tokens(logits, sub, self.sampler))
+        else:
+            next_tokens = np.asarray(jnp.argmax(logits, axis=-1))
         out = []
         for i in range(len(prompts)):
             out.append(PrefillResult(
