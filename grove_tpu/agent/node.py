@@ -24,7 +24,7 @@ from grove_tpu.store.client import Client
 class FakeKubeletPool:
     """Synthetic readiness for all fake nodes (KWOK analog)."""
 
-    def __init__(self, client: Client, namespace: str = "default",
+    def __init__(self, client: Client, namespace: str | None = None,
                  tick: float = 0.05, startup_latency: float = 0.0):
         self.client = client
         self.namespace = namespace
@@ -63,7 +63,7 @@ class FakeKubeletPool:
                     and pod.status.phase == PodPhase.PENDING
                     and pod.meta.deletion_timestamp is None):
                 if not barrier_satisfied(self.client, pod.spec.startup_barrier,
-                                         self.namespace):
+                                         pod.meta.namespace):
                     continue
                 if self.startup_latency:
                     time.sleep(self.startup_latency)

@@ -31,7 +31,7 @@ from grove_tpu.store.client import Client
 
 
 class ProcessKubelet:
-    def __init__(self, client: Client, namespace: str = "default",
+    def __init__(self, client: Client, namespace: str | None = None,
                  node_name: str | None = None, tick: float = 0.05,
                  workdir: str | None = None, log_dir: str | None = None):
         self.client = client
@@ -44,10 +44,11 @@ class ProcessKubelet:
         self.log_dir = log_dir or os.path.join(
             workdir or os.getcwd(), "pod-logs")
         self.log = get_logger("agent.process")
-        # pod name -> (pod uid, proc): the uid detects delete+recreate under
-        # the same name within one tick (rolling updates), so a stale
-        # process is never adopted by the replacement pod.
-        self._procs: dict[str, tuple[str, subprocess.Popen]] = {}
+        # (namespace, pod name) -> (pod uid, proc): the uid detects
+        # delete+recreate under the same name within one tick (rolling
+        # updates) so a stale process is never adopted; the namespace in
+        # the key keeps same-named pods in different namespaces apart.
+        self._procs: dict[tuple[str, str], tuple[str, subprocess.Popen]] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -60,8 +61,8 @@ class ProcessKubelet:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(2.0)
-        for name, (_, proc) in list(self._procs.items()):
-            self._terminate(name, proc)
+        for key, (_, proc) in list(self._procs.items()):
+            self._terminate(key, proc)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -85,30 +86,31 @@ class ProcessKubelet:
         nodes = self._my_nodes()
         if not nodes:
             return
-        live_pods = {p.meta.name: p for p in self.client.list(
-            Pod, self.namespace) if p.status.node_name in nodes}
+        live_pods = {(p.meta.namespace, p.meta.name): p
+                     for p in self.client.list(Pod, self.namespace)
+                     if p.status.node_name in nodes}
 
         # Reap: processes whose pod vanished or was replaced (same name,
         # new uid); exited processes.
-        for name, (uid, proc) in list(self._procs.items()):
-            pod = live_pods.get(name)
+        for key, (uid, proc) in list(self._procs.items()):
+            pod = live_pods.get(key)
             if pod is None or pod.meta.deletion_timestamp is not None \
                     or pod.meta.uid != uid:
-                self._terminate(name, proc)
+                self._terminate(key, proc)
                 continue
             code = proc.poll()
             if code is not None:
-                del self._procs[name]
+                del self._procs[key]
                 self._set_exit_status(pod, code)
 
         # Launch: bound pending pods whose barrier cleared.
-        for name, pod in live_pods.items():
+        for key, pod in live_pods.items():
             if (pod.status.phase != PodPhase.PENDING
-                    or name in self._procs
+                    or key in self._procs
                     or pod.meta.deletion_timestamp is not None):
                 continue
             if not barrier_satisfied(self.client, pod.spec.startup_barrier,
-                                     self.namespace):
+                                     pod.meta.namespace):
                 continue
             self._launch(pod, nodes[pod.status.node_name])
 
@@ -143,7 +145,8 @@ class ProcessKubelet:
 
             self._write_status(pod, exec_failed)
             return
-        self._procs[pod.meta.name] = (pod.meta.uid, proc)
+        self._procs[(pod.meta.namespace, pod.meta.name)] = \
+            (pod.meta.uid, proc)
 
         def running(p: Pod) -> None:
             p.status.phase = PodPhase.RUNNING
@@ -187,8 +190,8 @@ class ProcessKubelet:
         self.log.warning("pod %s: status write kept conflicting; dropped",
                          pod.meta.name)
 
-    def _terminate(self, name: str, proc: subprocess.Popen) -> None:
-        self._procs.pop(name, None)
+    def _terminate(self, key, proc: subprocess.Popen) -> None:
+        self._procs.pop(key, None)
         if proc.poll() is None:
             try:
                 os.killpg(proc.pid, signal.SIGTERM)
@@ -202,4 +205,4 @@ class ProcessKubelet:
                     proc.wait(timeout=1.0)  # reap — no zombies
                 except subprocess.TimeoutExpired:
                     pass
-        self.log.info("pod %s: process terminated", name)
+        self.log.info("pod %s: process terminated", key)

@@ -54,10 +54,10 @@ class PodCliqueReconciler:
         self.schedulers = scheduler_registry
         self.expectations = ExpectationsStore()
         self.log = get_logger("podclique")
-        # pod name -> (consecutive failures, not-before timestamp): the
-        # CrashLoopBackOff analog — an instantly-failing workload must not
-        # respawn at full agent tick rate.
-        self._crash_backoff: dict[str, tuple[int, float]] = {}
+        # (namespace, pod name) -> (consecutive failures, not-before
+        # timestamp): the CrashLoopBackOff analog — an instantly-failing
+        # workload must not respawn at full agent tick rate.
+        self._crash_backoff: dict[tuple[str, str], tuple[int, float]] = {}
 
     def reconcile(self, req: Request) -> StepResult:
         try:
@@ -100,10 +100,11 @@ class PodCliqueReconciler:
             self.expectations.expect_deletes(
                 req.key, [p.meta.uid for p in failed])
             for p in failed:
-                n, _ = self._crash_backoff.get(p.meta.name, (0, 0.0))
+                bk = (p.meta.namespace, p.meta.name)
+                n, _ = self._crash_backoff.get(bk, (0, 0.0))
                 delay = min(self.CRASH_BACKOFF_BASE * (2 ** n),
                             self.CRASH_BACKOFF_MAX)
-                self._crash_backoff[p.meta.name] = (n + 1, now + delay)
+                self._crash_backoff[bk] = (n + 1, now + delay)
                 try:
                     self.client.delete(Pod, p.meta.name, p.meta.namespace)
                     self.expectations.observe_delete(req.key, p.meta.uid)
@@ -124,17 +125,18 @@ class PodCliqueReconciler:
                     pass
             indices = available_indices(used, want - len(pods))
             # CrashLoopBackOff: hold back indices whose pod keeps failing.
-            ready_names = {p.meta.name for p in pods if is_condition_true(
-                p.status.conditions, c.COND_READY)}
-            for name in list(self._crash_backoff):
-                n, not_before = self._crash_backoff[name]
-                if name in ready_names or now - not_before > self.CRASH_RESET_AFTER:
-                    del self._crash_backoff[name]
+            ready_keys = {(p.meta.namespace, p.meta.name) for p in pods
+                          if is_condition_true(p.status.conditions,
+                                               c.COND_READY)}
+            for bk in list(self._crash_backoff):
+                n, not_before = self._crash_backoff[bk]
+                if bk in ready_keys or now - not_before > self.CRASH_RESET_AFTER:
+                    del self._crash_backoff[bk]
             held = []
             allowed = []
             for i in indices:
-                name = namegen.pod_name(pclq.meta.name, i)
-                entry = self._crash_backoff.get(name)
+                bk = (pclq.meta.namespace, namegen.pod_name(pclq.meta.name, i))
+                entry = self._crash_backoff.get(bk)
                 if entry is not None and entry[1] > now:
                     held.append(entry[1] - now)
                 else:

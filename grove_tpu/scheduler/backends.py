@@ -43,7 +43,7 @@ DEFAULT_LEVEL_LABELS: dict[str, str] = {
     lvl.domain: lvl.node_label for lvl in DEFAULT_TPU_LEVELS}
 
 
-def build_host_views(client: Client, namespace: str = "default",
+def build_host_views(client: Client, namespace: str | None = None,
                      level_labels: dict[str, str] | None = None
                      ) -> list[HostView]:
     """Snapshot free capacity per ready TPU host, resolving topology
@@ -131,7 +131,7 @@ class GangBackend:
 
     def __init__(self) -> None:
         self.client: Client | None = None
-        self.namespace = "default"
+        self.namespace = None  # None = every namespace
         self.log = get_logger("scheduler.gang")
         self._loop: _PlacementLoop | None = None
         self._level_labels = dict(DEFAULT_LEVEL_LABELS)
@@ -185,7 +185,8 @@ class GangBackend:
         hosts = build_host_views(client, self.namespace, self._level_labels)
         gangs = client.list(PodGang, self.namespace)
         scheduled_by_name = {
-            g.meta.name: is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+            (g.meta.namespace, g.meta.name):
+                is_condition_true(g.status.conditions, c.COND_SCHEDULED)
             for g in gangs}
         # Priority first, then base gangs before scaled, then creation
         # time (stable).
@@ -195,7 +196,7 @@ class GangBackend:
             if gang.spec.scheduler_name not in ("", self.name):
                 continue
             if gang.spec.base_gang and not scheduled_by_name.get(
-                    gang.spec.base_gang, False):
+                    (gang.meta.namespace, gang.spec.base_gang), False):
                 continue  # scaled capacity never blocks/preempts base gangs
             placed, preempted = self._sync_gang(gang, hosts)
             if preempted:
@@ -210,7 +211,7 @@ class GangBackend:
     def _gang_pods(self, gang: PodGang) -> tuple[list[Pod], int, int]:
         """(existing pods of the gang, total expected, min required)."""
         client = self.client
-        pods = client.list(Pod, self.namespace,
+        pods = client.list(Pod, gang.meta.namespace,
                            selector={c.LABEL_PODGANG_NAME: gang.meta.name})
         by_name = {p.meta.name: p for p in pods}
         existing: list[Pod] = []
@@ -349,7 +350,9 @@ class GangBackend:
             return False  # only base gangs preempt
         client = self.client
         victims = []
-        for other in client.list(PodGang, self.namespace):
+        # Victims cluster-wide: capacity is one pool, so preemption must
+        # see elastic gangs in every namespace.
+        for other in client.list(PodGang, None):
             if not other.spec.base_gang:
                 continue  # never evict another base gang
             if other.spec.priority > gang.spec.priority:
@@ -357,7 +360,7 @@ class GangBackend:
             # Only capacity the victim actually holds (matches the
             # used-chips predicate of build_host_views).
             pods = [p for p in client.list(
-                Pod, self.namespace,
+                Pod, other.meta.namespace,
                 selector={c.LABEL_PODGANG_NAME: other.meta.name})
                 if p.status.node_name
                 and p.meta.deletion_timestamp is None
@@ -476,7 +479,7 @@ class GangBackend:
             return ""
         try:
             old = self.client.get(PodGang, gang.spec.reuse_reservation_of,
-                                  self.namespace)
+                                  gang.meta.namespace)
             return old.status.assigned_slice
         except NotFoundError:
             return ""
@@ -488,7 +491,7 @@ class GangBackend:
         if not pcs:
             return {}
         penalties: dict[str, float] = defaultdict(float)
-        for other in self.client.list(PodGang, self.namespace,
+        for other in self.client.list(PodGang, gang.meta.namespace,
                                       selector={c.LABEL_PCS_NAME: pcs}):
             if other.meta.name != gang.meta.name and other.status.assigned_slice:
                 # Must dominate bin-pack tightness (<= 1.0) so multislice
@@ -554,7 +557,7 @@ class SimpleBackend:
 
     def __init__(self) -> None:
         self.client: Client | None = None
-        self.namespace = "default"
+        self.namespace = None  # None = every namespace
         self._loop: _PlacementLoop | None = None
 
     def init(self, client: Client, options: dict[str, str]) -> None:

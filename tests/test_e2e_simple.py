@@ -139,6 +139,38 @@ def test_two_replicas_spread_over_slices(cluster):
     assert by_replica["0"] != by_replica["1"], "replicas packed onto one slice"
 
 
+def test_non_default_namespace(cluster):
+    """The whole pipeline (controllers, scheduler, agents, autoscaler) is
+    namespace-agnostic: a PCS in 'prod' reaches Ready and stays isolated
+    from 'default'."""
+    client = cluster.client
+    pcs = simple_pcs(name="nsapp")
+    pcs.meta.namespace = "prod"
+    client.create(pcs)
+
+    def ready():
+        pods = client.list(Pod, "prod", selector={c.LABEL_PCS_NAME: "nsapp"})
+        return len(pods) == 3 and all(
+            is_condition_true(p.status.conditions, c.COND_READY) for p in pods)
+
+    wait_for(ready, desc="prod-namespace pods ready")
+    wait_for(lambda: client.get(
+        PodCliqueSet, "nsapp", "prod").status.available_replicas == 1,
+        desc="prod PCS available")
+    assert client.list(Pod, "default",
+                       selector={c.LABEL_PCS_NAME: "nsapp"}) == []
+
+    # Same-named PCS in another namespace: identical child names must not
+    # collide anywhere (gang gating, scheduler maps, agents).
+    twin = simple_pcs(name="nsapp", pods=2, chips=4)
+    client.create(twin)
+    wait_for(lambda: client.get(
+        PodCliqueSet, "nsapp", "default").status.available_replicas == 1,
+        desc="default twin available")
+    assert client.get(PodCliqueSet, "nsapp",
+                      "prod").status.available_replicas == 1
+
+
 def test_pcs_delete_cascades(cluster):
     client = cluster.client
     client.create(simple_pcs(name="gone"))
