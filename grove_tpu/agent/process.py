@@ -33,12 +33,17 @@ from grove_tpu.store.client import Client
 class ProcessKubelet:
     def __init__(self, client: Client, namespace: str | None = None,
                  node_name: str | None = None, tick: float = 0.05,
-                 workdir: str | None = None, log_dir: str | None = None):
+                 workdir: str | None = None, log_dir: str | None = None,
+                 extra_env: dict[str, str] | None = None):
         self.client = client
         self.namespace = namespace
         self.node_name = node_name
         self.tick = tick
         self.workdir = workdir
+        # Agent-level env for every pod (e.g. GROVE_CONTROL_PLANE in serve
+        # mode). Read at launch time, so the dict may be filled after
+        # construction (the API server's port resolves late).
+        self.extra_env = extra_env if extra_env is not None else {}
         # Pod logs (kubectl-logs analog): one file per pod incarnation
         # (name + uid — a self-healed replacement gets its own file).
         self.log_dir = log_dir or os.path.join(
@@ -120,8 +125,10 @@ class ProcessKubelet:
             self._set_exit_status(pod, 0)
             return
         env = dict(os.environ)
+        env.update(self.extra_env)
         env.update(pod.spec.container.env)
         env["GROVE_POD_NAME"] = pod.meta.name
+        env["GROVE_NAMESPACE"] = pod.meta.namespace
         env["GROVE_NODE_NAME"] = node.meta.name
         env[c.ENV_TPU_SLICE_NAME] = node.meta.labels.get(c.NODE_LABEL_SLICE, "")
         env[c.ENV_TPU_SLICE_TOPOLOGY] = node.meta.labels.get(
@@ -205,4 +212,4 @@ class ProcessKubelet:
                     proc.wait(timeout=1.0)  # reap — no zombies
                 except subprocess.TimeoutExpired:
                     pass
-        self.log.info("pod %s: process terminated", key)
+        self.log.info("pod %s/%s: process terminated", *key)

@@ -33,21 +33,44 @@ from grove_tpu.store.client import Client
 
 class MetricsRegistry:
     """Named metric values per (kind, namespace, name): the metrics-server
-    analog."""
+    analog.
 
-    def __init__(self) -> None:
+    Multi-reporter aware: each reporting pod/engine contributes its own
+    sample and ``get`` returns the SUM of fresh samples (queue-depth-style
+    metrics represent per-reporter load; the total drives scaling).
+    Last-write-wins across reporters would flap the autoscaler whenever
+    load is heterogeneous. Samples expire after ``sample_ttl`` so dead
+    reporters stop counting.
+    """
+
+    def __init__(self, sample_ttl: float = 10.0) -> None:
         self._lock = threading.Lock()
-        self._values: dict[tuple[str, str, str, str], float] = {}
+        self.sample_ttl = sample_ttl
+        self._samples: dict[tuple[str, str, str, str],
+                            dict[str, tuple[float, float]]] = {}
 
     def set(self, kind: str, name: str, metric: str, value: float,
-            namespace: str = "default") -> None:
+            namespace: str = "default", reporter: str = "_default") -> None:
+        import time as _time
+        key = (kind, namespace, name, metric)
         with self._lock:
-            self._values[(kind, namespace, name, metric)] = value
+            self._samples.setdefault(key, {})[reporter] = (value, _time.time())
 
     def get(self, kind: str, name: str, metric: str,
             namespace: str = "default") -> float | None:
+        import time as _time
+        key = (kind, namespace, name, metric)
+        cutoff = _time.time() - self.sample_ttl
         with self._lock:
-            return self._values.get((kind, namespace, name, metric))
+            samples = self._samples.get(key)
+            if not samples:
+                return None
+            for reporter in [r for r, (_, ts) in samples.items()
+                             if ts < cutoff]:
+                del samples[reporter]
+            if not samples:
+                return None
+            return sum(v for v, _ in samples.values())
 
 
 def desired_replicas(value: float, target: float, lo: int, hi: int) -> int:

@@ -170,8 +170,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 print(f"error: cannot bind {args.host}:{args.port}: {e}",
                       file=sys.stderr)
                 return 1
+            # Pods learn the control-plane URL so in-pod engines can push
+            # autoscaling metrics (serving/metrics_push.py). Wildcard
+            # binds map to loopback — pods launched by the in-process
+            # kubelet are local, and 0.0.0.0 is not a routable target.
+            push_host = "127.0.0.1" if args.host in ("0.0.0.0", "::") \
+                else args.host
+            url = f"http://{push_host}:{server.port}"
+            from grove_tpu.agent.process import ProcessKubelet
+            for r in cluster.manager.runnables:
+                if isinstance(r, ProcessKubelet):
+                    r.extra_env["GROVE_CONTROL_PLANE"] = url
             print(f"grove-tpu control plane serving on "
-                  f"http://{args.host}:{server.port}  (ctrl-c to stop)")
+                  f"{url}  (ctrl-c to stop)")
             try:
                 while True:
                     time.sleep(1.0)

@@ -94,8 +94,12 @@ class ApiServer:
                     self._send(400, {"error": str(e)})
 
             def do_POST(self):
-                if urlparse(self.path).path != "/apply":
-                    self._send(404, {"error": "POST /apply only"})
+                path = urlparse(self.path).path
+                if path == "/metrics/push":
+                    self._metrics_push()
+                    return
+                if path != "/apply":
+                    self._send(404, {"error": "POST /apply or /metrics/push"})
                     return
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length).decode()
@@ -127,6 +131,26 @@ class ApiServer:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - malformed input
                     self._send(400, {"error": f"bad manifest: {e}"})
+
+            def _metrics_push(self):
+                """Workload→control-plane metric ingestion: engines inside
+                pods report autoscaling signals (queue depth, rps) here;
+                the Autoscaler consumes them from the MetricsRegistry."""
+                if cluster.metrics is None:
+                    self._send(503, {"error": "autoscaler disabled"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    cluster.metrics.set(
+                        payload["kind"], payload["name"], payload["metric"],
+                        float(payload["value"]),
+                        namespace=payload.get("namespace", "default"),
+                        reporter=payload.get("reporter", "_default"))
+                    self._send(200, {"ok": True})
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send(400, {"error": f"bad metric payload: {e}; "
+                                     "need kind/name/metric/value"})
 
             def do_DELETE(self):
                 parts = [p for p in urlparse(self.path).path.split("/") if p]

@@ -1,0 +1,101 @@
+"""Workload-side metric reporting — the autoscaling feedback loop.
+
+Engines running inside pods push their scaling signal (queue depth, rps)
+to the control plane's HTTP API using only the injected environment:
+
+- ``GROVE_CONTROL_PLANE`` — the serve daemon URL (injected by the node
+  agent when the cluster runs in serve mode),
+- ``GROVE_PCSG_NAME`` / ``GROVE_PCLQ_NAME`` — which object the metric
+  scales.
+
+Zero dependencies beyond urllib; failures are swallowed (metrics are
+advisory — a serving engine must never crash because the control plane
+blinked).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+ENV_CONTROL_PLANE = "GROVE_CONTROL_PLANE"
+
+
+def push_metric(metric: str, value: float, *, kind: str | None = None,
+                name: str | None = None, namespace: str | None = None,
+                server: str | None = None) -> bool:
+    """Report a metric for this pod's scaling scope.
+
+    Defaults from the injected env: scaling group if the pod belongs to
+    one (scaling whole model instances), else its clique. Returns True
+    when the control plane accepted the sample.
+    """
+    server = server or os.environ.get(ENV_CONTROL_PLANE, "")
+    if not server:
+        return False
+    if kind is None or name is None:
+        pcsg = os.environ.get("GROVE_PCSG_NAME", "")
+        if pcsg:
+            kind, name = "PodCliqueScalingGroup", pcsg
+        else:
+            kind, name = "PodClique", os.environ.get("GROVE_PCLQ_NAME", "")
+    if not name:
+        return False
+    payload = json.dumps({
+        "kind": kind, "name": name, "metric": metric, "value": value,
+        "namespace": namespace or os.environ.get("GROVE_NAMESPACE", "default"),
+        # Per-reporter samples: the registry sums fresh samples across
+        # reporters instead of last-write-wins.
+        "reporter": os.environ.get("GROVE_POD_NAME", "_default"),
+    }).encode()
+    req = urllib.request.Request(
+        f"{server}/metrics/push", data=payload, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            return resp.status == 200
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+def queue_depth_hook(**kwargs):
+    """A DecodeEngine ``metric_hook``: reports the engine's queue depth.
+
+    Pushes happen on a background thread (latest value wins) — the hook
+    itself never blocks the decode loop, even when the control plane is
+    slow or down.
+    """
+    import queue
+    import threading
+
+    latest: "queue.Queue[float]" = queue.Queue(maxsize=1)
+
+    def pump() -> None:
+        while True:
+            depth = latest.get()
+            # Coalesce to the most recent value.
+            try:
+                while True:
+                    depth = latest.get_nowait()
+            except queue.Empty:
+                pass
+            push_metric("queue_depth", depth, **kwargs)
+
+    threading.Thread(target=pump, name="metrics-push", daemon=True).start()
+
+    def hook(depth: float) -> None:
+        try:
+            latest.put_nowait(depth)
+        except queue.Full:
+            try:
+                latest.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                latest.put_nowait(depth)
+            except queue.Full:
+                pass
+
+    return hook

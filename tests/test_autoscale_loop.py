@@ -1,0 +1,96 @@
+"""The full autoscaling feedback loop across process boundaries:
+
+  worker process (real) --HTTP push--> control plane MetricsRegistry
+      --> Autoscaler scales the PodCliqueScalingGroup
+      --> more gangs placed --> more worker processes
+
+This is the reference's HPA story (metrics-server → HPA → scale
+subresource) realised end-to-end with nothing mocked.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from grove_tpu.agent.process import ProcessKubelet
+from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.server import ApiServer
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+WORKER = textwrap.dedent("""
+    import os, time
+    from grove_tpu.serving.metrics_push import push_metric
+    # A busy serving engine: report a deep queue for a while, then idle.
+    deadline = time.time() + 6.0
+    while time.time() < deadline:
+        push_metric("queue_depth", 25.0)
+        time.sleep(0.3)
+    while True:
+        push_metric("queue_depth", 1.0)
+        time.sleep(0.3)
+""")
+
+
+@pytest.mark.timeout(90)
+def test_closed_autoscaling_loop_over_http(tmp_path):
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=3)], fake=False)
+    cfg = OperatorConfiguration()
+    cfg.autoscaler.sync_period_seconds = 0.5
+    cl = new_cluster(config=cfg, fleet=fleet, fake_kubelet=False)
+    kubelet = ProcessKubelet(cl.client, workdir="/root/repo",
+                             log_dir=str(tmp_path / "logs"))
+    cl.manager.add_runnable(kubelet)
+    with cl:
+        server = ApiServer(cl, port=0)
+        server.start()
+        kubelet.extra_env["GROVE_CONTROL_PLANE"] = \
+            f"http://127.0.0.1:{server.port}"
+        try:
+            worker = tmp_path / "worker.py"
+            worker.write_text(WORKER)
+            cl.client.create(PodCliqueSet(
+                meta=new_meta("loop"),
+                spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                    cliques=[PodCliqueTemplate(
+                        name="decode", replicas=1, min_available=1,
+                        tpu_chips_per_pod=4,
+                        container=ContainerSpec(
+                            argv=[sys.executable, str(worker)],
+                            env={"PYTHONPATH": "/root/repo"}))],
+                    scaling_groups=[ScalingGroupConfig(
+                        name="m", clique_names=["decode"], replicas=1,
+                        min_available=1,
+                        auto_scaling=AutoScalingConfig(
+                            min_replicas=1, max_replicas=3,
+                            metric="queue_depth", target_value=10.0))],
+                ))))
+
+            def running_pods():
+                return [p for p in cl.client.list(
+                    Pod, selector={c.LABEL_PCS_NAME: "loop"})
+                    if p.status.phase == PodPhase.RUNNING]
+
+            wait_for(lambda: len(running_pods()) == 1, timeout=20.0,
+                     desc="first engine running")
+            # The engine reports queue_depth=25 -> ceil(25/10)=3 replicas.
+            wait_for(lambda: len(running_pods()) == 3, timeout=30.0,
+                     desc="autoscaler fanned out to 3 model instances")
+            # Engines go idle -> scale back to the floor.
+            wait_for(lambda: len(running_pods()) == 1, timeout=40.0,
+                     desc="scaled back in")
+        finally:
+            server.stop()
