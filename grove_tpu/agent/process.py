@@ -136,7 +136,8 @@ class ProcessKubelet:
         try:
             os.makedirs(self.log_dir, exist_ok=True)
             log_path = os.path.join(
-                self.log_dir, f"{pod.meta.name}.{pod.meta.uid[:8]}.log")
+                self.log_dir,
+                f"{pod.meta.namespace}.{pod.meta.name}.{pod.meta.uid[:8]}.log")
             with open(log_path, "ab") as log_file:
                 proc = subprocess.Popen(
                     argv, env=env,

@@ -9,7 +9,9 @@ control plane exposes its own minimal HTTP API so out-of-process clients
   GET  /api/<kind>                    list (JSON; ?namespace=, label
                                       selectors via ?l.<key>=<value>)
   GET  /api/<kind>/<name>             get one
+  GET  /logs/<ns>/<pod>               pod logs (?tail=N; kubectl-logs analog)
   POST /apply                         YAML/JSON manifest (create-or-update)
+  POST /metrics/push                  workload autoscaling signals
   DELETE /api/<kind>/<name>           delete
 
 Single-threaded-per-request stdlib server (ThreadingHTTPServer): the
@@ -86,6 +88,9 @@ class ApiServer:
                         ns = q.get("namespace", ["default"])[0]
                         self._send(200, to_dict(
                             cluster.client.get(cls, parts[2], ns)))
+                    elif len(parts) == 3 and parts[0] == "logs":
+                        self._pod_logs(parts[1], parts[2],
+                                       parse_qs(url.query))
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -131,6 +136,42 @@ class ApiServer:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - malformed input
                     self._send(400, {"error": f"bad manifest: {e}"})
+
+            def _pod_logs(self, namespace: str, pod: str, q):
+                """GET /logs/<namespace>/<pod>[?tail=N] — kubectl-logs
+                analog, served from the process kubelets' log dirs
+                (newest incarnation)."""
+                import glob
+                import os
+                from grove_tpu.agent.process import ProcessKubelet
+                tail = q.get("tail", [None])[0]
+                if tail is not None:
+                    try:
+                        tail_n = int(tail)
+                    except ValueError:
+                        self._send(400, {"error": f"bad tail={tail!r}; "
+                                         "must be an integer"})
+                        return
+                # glob.escape: the URL segments are literals, never
+                # patterns (un-escaped, /logs/*/* would disclose any
+                # pod's logs across namespaces).
+                pattern = f"{glob.escape(namespace)}.{glob.escape(pod)}.*.log"
+                candidates = []
+                for r in cluster.manager.runnables:
+                    if isinstance(r, ProcessKubelet):
+                        candidates.extend(glob.glob(
+                            os.path.join(glob.escape(r.log_dir), pattern)))
+                if not candidates:
+                    self._send(404, {"error": f"no logs for pod {pod!r} "
+                                     "(fake nodes produce none)"})
+                    return
+                newest = max(candidates, key=os.path.getmtime)
+                with open(newest, "rb") as f:
+                    data = f.read().decode(errors="replace")
+                if tail is not None:
+                    lines = data.splitlines()[-tail_n:] if tail_n > 0 else []
+                    data = "\n".join(lines) + ("\n" if lines else "")
+                self._send(200, data, content_type="text/plain")
 
             def _metrics_push(self):
                 """Workload→control-plane metric ingestion: engines inside

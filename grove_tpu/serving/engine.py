@@ -226,16 +226,22 @@ class DecodeEngine:
     # ---- standalone mode (bench path) ----
 
     def admit_prompts(self, prompts: jnp.ndarray,
-                      max_new_tokens: int | None = None) -> None:
-        """Prefill a full batch [batch, s] into the lanes (all same len).
+                      max_new_tokens: int | None = None,
+                      lengths: jnp.ndarray | None = None) -> None:
+        """Prefill a full batch [batch, s] into the lanes.
 
-        With ``max_new_tokens`` each lane gets a tracked Request, so the
-        full completion bookkeeping runs (the real serving path); without
-        it, lanes decode untracked (raw-throughput loops).
+        ``lengths`` [batch] gives true per-lane prompt lengths for ragged
+        (right-padded) batches; defaults to s for all lanes. With
+        ``max_new_tokens`` each lane gets a tracked Request, so the full
+        completion bookkeeping runs (the real serving path); without it,
+        lanes decode untracked (raw-throughput loops).
         """
         b, s = prompts.shape
         assert b == self.batch
-        lengths = jnp.full((b,), s, jnp.int32)
+        if lengths is None:
+            lengths = jnp.full((b,), s, jnp.int32)
+        else:
+            lengths = jnp.asarray(lengths, jnp.int32)
         logits, self.cache = self._prefill(self.params, prompts, lengths,
                                            self.cache)
         if self._sampling:

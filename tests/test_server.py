@@ -84,6 +84,76 @@ def test_grovectl_client_verbs(server, tmp_path, capsys):
     assert main(["get", "PodCliqueSet", "websvc", "--server", base]) == 1
 
 
+def test_pod_logs_endpoint(tmp_path):
+    """GET /logs/<ns>/<pod> serves real-process pod output."""
+    import sys
+    from grove_tpu.agent.process import ProcessKubelet
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=1)], fake=False)
+    cl = new_cluster(fleet=fleet, fake_kubelet=False)
+    cl.manager.add_runnable(ProcessKubelet(cl.client,
+                                           log_dir=str(tmp_path)))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            _req(f"{base}/apply", "POST", f"""
+kind: PodCliqueSet
+metadata: {{name: logsvc}}
+spec:
+  template:
+    cliques:
+      - name: w
+        replicas: 1
+        tpu_chips_per_pod: 4
+        container:
+          argv: ["{sys.executable}", "-c",
+                 "print('hello from the pod'); import time; time.sleep(60)"]
+""")
+            def has_log():
+                s, body = _req(f"{base}/logs/default/logsvc-0-w-0?tail=5")
+                return s == 200 and "hello from the pod" in body
+            wait_for(has_log, timeout=20.0, desc="pod log over http")
+            # fake/unknown pod -> 404 with a hint
+            s, err = _req(f"{base}/logs/default/ghost-0")
+            assert s == 404 and "no logs" in err["error"]
+        finally:
+            srv.stop()
+
+
+def test_ragged_admit_prompts():
+    """Per-lane prompt lengths through the engine admission path."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import DecodeEngine
+    cfg = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                              max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    short = jax.random.randint(jax.random.PRNGKey(4), (1, 5), 0,
+                               cfg.vocab_size)
+
+    # Reference: batch-1 engine with the exact prompt.
+    eng_a = DecodeEngine(cfg, params, batch=1)
+    eng_a.admit_prompts(short)
+    seq_a = [int(np.asarray(eng_a._tokens)[0])]
+
+    # Ragged: same prompt padded into a 2-lane batch with lengths.
+    padded = jnp.concatenate(
+        [short, jnp.zeros((1, 7), jnp.int32)], axis=1)
+    batch2 = jnp.concatenate([padded, padded], axis=0)
+    eng_b = DecodeEngine(cfg, params, batch=2)
+    eng_b.admit_prompts(batch2, lengths=jnp.array([5, 12]))
+    assert int(np.asarray(eng_b._tokens)[0]) == seq_a[0]
+    for _ in range(4):
+        eng_a.step(); eng_b.step()
+        seq_a.append(int(np.asarray(eng_a._tokens)[0]))
+        assert int(np.asarray(eng_b._tokens)[0]) == seq_a[-1]
+
+
 def test_health_metrics_and_errors(server):
     base, _ = server
     status, health = _req(f"{base}/healthz")
