@@ -141,33 +141,43 @@ def _qkv(cfg: LlamaConfig, x, lp, cos, sin, positions):
     return q, k, v
 
 
-def _attn_out(x, attn, lp):
-    return x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]).astype(x.dtype)
+def _attn_out(x, attn, lp, tp_axis=None):
+    out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    if tp_axis is not None:
+        # Megatron-style manual TP inside shard_map: heads are sharded over
+        # tp, so wo produces a partial sum — reduce before the residual.
+        out = lax.psum(out, tp_axis)
+    return x + out.astype(x.dtype)
 
 
-def _mlp_block(cfg: LlamaConfig, x, lp):
+def _mlp_block(cfg: LlamaConfig, x, lp, tp_axis=None):
     """Pre-norm SwiGLU MLP with residual. Shared by prefill and decode."""
     hm = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", hm, lp["w_gate"])
     up = jnp.einsum("bsd,df->bsf", hm, lp["w_up"])
-    return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                          lp["w_down"]).astype(x.dtype)
+    out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    if tp_axis is not None:
+        # ff hidden dim sharded over tp → w_down yields a partial sum.
+        out = lax.psum(out, tp_axis)
+    return x + out.astype(x.dtype)
 
 
 def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset,
-                   attn_fn=None):
+                   attn_fn=None, tp_axis=None):
     """One decoder layer over a full sequence. x: [b, s, d_model].
 
     ``attn_fn(q, k, v)`` overrides the attention implementation (ring
     attention for sequence-parallel long context; pallas flash kernels).
+    ``tp_axis`` enables manual tensor parallelism under shard_map: heads
+    and ff are axis-sharded and the output projections psum over it.
     """
     q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
     if attn_fn is None:
         attn = causal_attention(q, k, v, q_offset=q_offset)
     else:
         attn = attn_fn(q, k, v)
-    x = _attn_out(x, attn, lp)
-    x = _mlp_block(cfg, x, lp)
+    x = _attn_out(x, attn, lp, tp_axis=tp_axis)
+    x = _mlp_block(cfg, x, lp, tp_axis=tp_axis)
     return x, (k, v)
 
 

@@ -23,14 +23,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from grove_tpu.models.llama import LlamaConfig, _layer_prefill, head
 from grove_tpu.ops.rope import rope_table
-from grove_tpu.parallel.mesh import AXIS_PP
+from grove_tpu.parallel.mesh import AXIS_PP, AXIS_TP
 
 
-def _stage_body(cfg: LlamaConfig, n_micro: int, tok_embed, lm_head,
+def _stage_body(cfg: LlamaConfig, n_micro: int, tp_axis, tok_embed, lm_head,
                 final_norm, layers, tokens):
-    """Per-stage SPMD body (under shard_map over pp).
+    """Per-stage SPMD body (under shard_map over pp [× tp]).
 
-    layers: this stage's layer shard (leading axis L/S).
+    layers: this stage's layer shard (leading axis L/S); when ``tp_axis``
+    is set, head/ff dims are additionally sharded over tp and the layer
+    body psums its output projections over that axis (Megatron-style).
     tokens: full [B, s] (replicated); microbatches split on B.
     """
     s_count = lax.axis_size(AXIS_PP)
@@ -43,7 +45,8 @@ def _stage_body(cfg: LlamaConfig, n_micro: int, tok_embed, lm_head,
 
     def run_stage(x):
         def body(x, lp):
-            x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+            x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0,
+                                  tp_axis=tp_axis)
             return x, None
         x, _ = lax.scan(body, x, layers)
         return x
@@ -85,31 +88,67 @@ def _stage_body(cfg: LlamaConfig, n_micro: int, tok_embed, lm_head,
                                (carry_in, outputs))
 
     # Only the last stage holds real outputs; psum broadcasts them, then
-    # every stage runs the shared final-norm + head (llama.head).
+    # every stage runs the final-norm + head. Under tp, lm_head is
+    # vocab-sharded (Megatron-style) so each tp member computes only its
+    # vocab slice — the result stays vocab-sharded on the way out.
     x = outputs.reshape(B, seq, d)
     x = jnp.where(stage == s_count - 1, x, jnp.zeros_like(x))
     x = lax.psum(x, AXIS_PP)
     return head(cfg, {"final_norm": final_norm, "lm_head": lm_head}, x)
 
 
+# Per-leaf tp sharding of the stacked layer weights (axis after the
+# leading layers axis that carries heads/kv_heads/ff). Norms replicate.
+_TP_LAYER_SPECS: dict[str, P] = {
+    "attn_norm": P(AXIS_PP),
+    "mlp_norm": P(AXIS_PP),
+    "wq": P(AXIS_PP, None, AXIS_TP, None),
+    "wk": P(AXIS_PP, None, AXIS_TP, None),
+    "wv": P(AXIS_PP, None, AXIS_TP, None),
+    "wo": P(AXIS_PP, AXIS_TP, None, None),
+    "w_gate": P(AXIS_PP, None, AXIS_TP),
+    "w_up": P(AXIS_PP, None, AXIS_TP),
+    "w_down": P(AXIS_PP, AXIS_TP, None),
+}
+
+
 def pipeline_forward(cfg: LlamaConfig, params, tokens: jnp.ndarray,
                      mesh: Mesh, n_microbatches: int = 2) -> jnp.ndarray:
     """Forward pass with layers pipelined over the mesh's ``pp`` axis.
 
-    Requires n_layers % pp == 0 and batch % n_microbatches == 0. The
-    dense-MLP Llama param layout is expected (layer-stacked leaves).
+    When the mesh also carries a ``tp`` axis > 1, each stage's layer
+    weights are tensor-parallel over it (heads and ff sharded; output
+    projections psum over tp inside the stage body) — the composed
+    pp×tp execution the orchestrator places as one gang per stage with
+    tp ICI-resident within each stage.
+
+    Requires n_layers % pp == 0, batch % n_microbatches == 0, and (for
+    tp > 1) n_heads/n_kv_heads/d_ff divisible by tp. The dense-MLP
+    Llama param layout is expected (layer-stacked leaves).
     """
     (pp_size,) = (mesh.shape[AXIS_PP],)
+    tp_size = dict(mesh.shape).get(AXIS_TP, 1)
     assert cfg.n_layers % pp_size == 0, \
         f"{cfg.n_layers} layers not divisible into {pp_size} stages"
     assert tokens.shape[0] % n_microbatches == 0
 
-    layer_spec = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
+    tp_axis = None
+    head_spec, out_spec = P(), P()
+    if tp_size > 1:
+        assert cfg.n_heads % tp_size == 0 and cfg.n_kv_heads % tp_size == 0 \
+            and cfg.d_ff % tp_size == 0 and cfg.vocab_size % tp_size == 0, \
+            f"heads/kv/ff/vocab not divisible by tp={tp_size}"
+        tp_axis = AXIS_TP
+        layer_spec = {k: _TP_LAYER_SPECS[k] for k in params["layers"]}
+        head_spec = P(None, AXIS_TP)       # lm_head vocab-sharded over tp
+        out_spec = P(None, None, AXIS_TP)  # logits stay vocab-sharded
+    else:
+        layer_spec = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
     fn = jax.shard_map(
-        partial(_stage_body, cfg, n_microbatches),
+        partial(_stage_body, cfg, n_microbatches, tp_axis),
         mesh=mesh,
-        in_specs=(P(), P(), P(), layer_spec, P()),
-        out_specs=P(),
+        in_specs=(P(), head_spec, P(), layer_spec, P()),
+        out_specs=out_spec,
     )
     return fn(params["tok_embed"], params["lm_head"], params["final_norm"],
               params["layers"], tokens)

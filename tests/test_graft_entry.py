@@ -1,0 +1,70 @@
+"""The driver's entry points, run the way the driver runs them.
+
+Round-1 regression: ``dryrun_multichip`` hung (MULTICHIP_r01 rc=124)
+because it never forced the CPU platform and the image's sitecustomize
+latch sent it to the (dead) TPU relay. These tests run the entry points
+in a FRESH subprocess with the driver's env — JAX_PLATFORMS left at the
+image default (axon), no conftest pre-forcing — under a hard timeout, so
+that failure mode can never ship undetected again.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _driver_env() -> dict[str, str]:
+    """The env the driver invokes entry points under: the image default
+    (JAX_PLATFORMS=axon → TPU relay), no CPU pre-forcing, no XLA flags."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "axon"
+    return env
+
+
+def _run(code: str, timeout: int) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=_driver_env(),
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_dryrun_multichip_8_under_driver_env():
+    """dryrun_multichip(8) must self-force the CPU platform and finish."""
+    proc = _run(
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8)",
+        timeout=420)
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-4000:]}"
+    assert "dryrun_multichip ok" in proc.stdout
+    # The composed pipeline×tensor-parallel step must have run on 8 devices.
+    assert "composed pp=2xtp=2" in proc.stdout, proc.stdout
+
+
+def test_dryrun_multichip_small_counts():
+    """Degenerate device counts still compile and run — n=1 (no pp, no
+    ring) and n=2 (pp=2 engages with tp=1). Separate subprocesses: the
+    device-count flag latches at backend init, so counts can't chain."""
+    for n in (1, 2):
+        proc = _run(
+            f"import __graft_entry__\n"
+            f"__graft_entry__.dryrun_multichip({n})\n", timeout=300)
+        assert proc.returncode == 0, f"n={n} stderr:\n{proc.stderr[-4000:]}"
+
+
+def test_entry_compiles_single_chip():
+    """entry() returns (fn, args) jittable on one device."""
+    proc = _run(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import __graft_entry__\n"
+        "fn, args = __graft_entry__.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "out.block_until_ready()\n"
+        "print('entry ok', out.shape)\n", timeout=300)
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-4000:]}"
+    assert "entry ok" in proc.stdout
