@@ -248,9 +248,15 @@ def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = params["tok_embed"][tokens].astype(cfg.dtype)
 
+    # TPU → pallas flash kernel; anything else → the XLA formulation.
+    # Trace-time choice, baked into the compiled prefill executable.
+    from grove_tpu.ops.attention import pick_causal_attention
+    attn_fn = pick_causal_attention(s, cfg.head_dim)
+
     def body(x, xs):
         lp, kc, vc = xs
-        x, (k, v) = _layer_prefill(cfg, x, lp, cos, sin, positions, 0)
+        x, (k, v) = _layer_prefill(cfg, x, lp, cos, sin, positions, 0,
+                                   attn_fn=attn_fn)
         kc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(kc, k, 0)
         vc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(vc, v, 0)
         return x, (kc, vc)

@@ -11,10 +11,67 @@ in behind the same signature (see grove_tpu/ops/pallas/).
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a TPU (incl. the tunnelled relay
+    platform, whose platform string differs but whose devices are TPUs)."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return (dev.platform in ("tpu", "axon")
+            or "tpu" in getattr(dev, "device_kind", "").lower())
+
+
+def pick_causal_attention(seq: int, head_dim: int,
+                          q_offset: jnp.ndarray | int = 0):
+    """Choose the prefill attention impl for the current backend.
+
+    Returns ``None`` to use the XLA ``causal_attention`` path, or a
+    callable ``(q, k, v) -> out`` running the pallas flash kernel
+    (grove_tpu/ops/pallas_flash.py) when the backend is a TPU and the
+    shape fits the kernel's tiling. ``GROVE_FLASH_ATTENTION=0`` forces
+    XLA; ``=1`` forces the kernel (interpret mode off-TPU — slow, for
+    parity checks only). Selection happens at trace time, so the choice
+    is baked into the compiled executable.
+    """
+    env = os.environ.get("GROVE_FLASH_ATTENTION", "auto")
+    if env == "0":
+        return None
+    # The kernel derives its causal mask from absolute positions starting
+    # at 0 and tiles seq into equal blocks; head_dim rides the MXU lanes.
+    if not isinstance(q_offset, int) or q_offset != 0:
+        return None
+    # seq must tile into full 128-blocks: shorter/unaligned shapes would
+    # hand Mosaic a block that violates its (sublane, lane) tiling. All
+    # serving paths pad to max_seq_len, a multiple of 128 for every config.
+    if seq % 128 != 0 or head_dim % 8 != 0:
+        return None
+    tpu = on_tpu()
+    if env != "1" and not tpu:
+        return None
+    from grove_tpu.ops.pallas_flash import flash_causal_attention
+    interpret = not tpu
+
+    def attn(q, k, v):
+        return flash_causal_attention(q, k, v, interpret=interpret)
+
+    attn.impl_name = "pallas-flash" + ("-interpret" if interpret else "")
+    return attn
+
+
+def active_prefill_attention(seq: int, head_dim: int) -> str:
+    """Name of the impl ``pick_causal_attention`` would select (for logs)."""
+    fn = pick_causal_attention(seq, head_dim)
+    return getattr(fn, "impl_name", "xla") if fn is not None else "xla"
 
 
 def _group_heads(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:

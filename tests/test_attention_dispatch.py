@@ -1,0 +1,82 @@
+"""Prefill attention dispatch: pallas flash on TPU, XLA elsewhere.
+
+Round-1 gap: the flash kernel existed but had no call site. The prefill
+path now selects it at trace time (models/llama.py prefill); these tests
+pin the selection rules and the numerics of the flash-backed prefill.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import llama
+from grove_tpu.ops.attention import (active_prefill_attention,
+                                     pick_causal_attention)
+from grove_tpu.ops.kvcache import KVCache
+
+
+@pytest.fixture
+def flash_forced(monkeypatch):
+    monkeypatch.setenv("GROVE_FLASH_ATTENTION", "1")
+
+
+def test_selection_defaults_to_xla_off_tpu():
+    os.environ.pop("GROVE_FLASH_ATTENTION", None)
+    assert active_prefill_attention(128, 64) == "xla"
+
+
+def test_selection_forced_off(monkeypatch):
+    monkeypatch.setenv("GROVE_FLASH_ATTENTION", "0")
+    assert pick_causal_attention(128, 64) is None
+
+
+def test_selection_forced_on_uses_interpret_off_tpu(flash_forced):
+    assert active_prefill_attention(128, 64) == "pallas-flash-interpret"
+
+
+def test_selection_rejects_unfit_shapes(flash_forced):
+    # seq not tiling into full 128-blocks → XLA (incl. short prefills:
+    # Mosaic's sublane tiling rejects partial blocks).
+    assert pick_causal_attention(129, 64) is None
+    assert pick_causal_attention(64, 64) is None
+    # head_dim off the lane grid → XLA.
+    assert pick_causal_attention(128, 12) is None
+    # chunked prefill (traced/static nonzero offset) → XLA.
+    assert pick_causal_attention(128, 64, q_offset=jnp.int32(4)) is None
+    assert pick_causal_attention(128, 64, q_offset=4) is None
+
+
+def test_prefill_with_flash_matches_xla(flash_forced, monkeypatch):
+    """Full llama.prefill through the flash kernel ≡ the XLA path."""
+    cfg = llama.CONFIGS["test-tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    b, s = 2, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                cfg.vocab_size)
+
+    def run():
+        cache = KVCache.create(cfg.n_layers, b, cfg.max_seq_len,
+                               cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+        return llama.prefill(cfg, params, tokens, cache)
+
+    logits_flash, cache_flash = run()
+    monkeypatch.setenv("GROVE_FLASH_ATTENTION", "0")
+    logits_xla, cache_xla = run()
+
+    # bf16 activations: reduction-order noise compounds through the layer
+    # stack, so the logit tolerance is looser than single-op parity.
+    np.testing.assert_allclose(np.asarray(logits_flash, np.float32),
+                               np.asarray(logits_xla, np.float32),
+                               atol=1e-1, rtol=1e-1)
+    # Layer 0's K/V are written before any attention runs, so they are
+    # impl-independent bit-for-bit; deeper layers inherit the bf16 noise.
+    np.testing.assert_array_equal(np.asarray(cache_flash.k[0], np.float32),
+                                  np.asarray(cache_xla.k[0], np.float32))
+    np.testing.assert_allclose(np.asarray(cache_flash.k, np.float32),
+                               np.asarray(cache_xla.k, np.float32),
+                               atol=1e-1, rtol=1e-1)
