@@ -14,8 +14,8 @@ from grove_tpu.api import constants as c
 from grove_tpu.api.podcliqueset import (
     HeadlessServiceConfig,
     PodCliqueSet,
-    StartupType,
     TopologyConstraint,
+    effective_startup_type,
 )
 
 
@@ -25,7 +25,7 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
         spec.replicas = 1
     tmpl = spec.template
     if tmpl.startup_type is None:
-        tmpl.startup_type = StartupType.ANY_ORDER
+        tmpl.startup_type = effective_startup_type(tmpl)
     if tmpl.termination_delay_seconds is None:
         tmpl.termination_delay_seconds = c.DEFAULT_TERMINATION_DELAY_SECONDS
     if tmpl.headless_service is None:

@@ -18,7 +18,8 @@ from __future__ import annotations
 import re
 
 from grove_tpu.api.clustertopology import ClusterTopology, DEFAULT_TPU_LEVELS
-from grove_tpu.api.podcliqueset import PodCliqueSet, TopologyConstraint
+from grove_tpu.api.podcliqueset import (PodCliqueSet, StartupType,
+                                        TopologyConstraint)
 from grove_tpu.scheduler.framework import Registry
 
 _NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?$")
@@ -153,6 +154,15 @@ def validate_podcliqueset(pcs: PodCliqueSet,
         _validate_topology(f + ".topology", t.topology, tmpl.topology, errs)
 
     # startup DAG (reference podcliquedeps.go:53: Tarjan SCC)
+    # Declared edges under IN_ORDER/ANY_ORDER would be silently ignored —
+    # reject the contradiction instead.
+    if tmpl.startup_type is not None and tmpl.startup_type != StartupType.EXPLICIT:
+        for t in tmpl.cliques:
+            if t.starts_after:
+                errs.append(
+                    f"clique {t.name!r}: starts_after requires startup_type "
+                    f"{StartupType.EXPLICIT.value}, got "
+                    f"{tmpl.startup_type.value}")
     known = set(names)
     graph = {t.name: [] for t in tmpl.cliques}
     for t in tmpl.cliques:
@@ -219,7 +229,19 @@ def validate_podcliqueset(pcs: PodCliqueSet,
             errs.append("clique set is immutable (got a different clique "
                         "name list); create a new PodCliqueSet instead")
         if old_tmpl.startup_type != tmpl.startup_type:
-            errs.append("startup_type is immutable")
+            # Both sides have been through defaulting, so a mismatch can
+            # come from inference (startup_type left unset, edges added or
+            # removed) — say so instead of blaming a field the user never
+            # touched.
+            msg = (f"startup_type is immutable (stored "
+                   f"{old_tmpl.startup_type.value if old_tmpl.startup_type else None}, "
+                   f"update resolves to "
+                   f"{tmpl.startup_type.value if tmpl.startup_type else None})")
+            if tmpl.startup_type is StartupType.EXPLICIT:
+                msg += ("; adding starts_after edges infers "
+                        "CliqueStartupTypeExplicit — set startup_type "
+                        "explicitly on create to use edges later")
+            errs.append(msg)
         old_sg = {sg.name: list(sg.clique_names)
                   for sg in old_tmpl.scaling_groups}
         new_sg = {sg.name: list(sg.clique_names)

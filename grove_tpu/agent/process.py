@@ -107,6 +107,8 @@ class ProcessKubelet:
             if code is not None:
                 del self._procs[key]
                 self._set_exit_status(pod, code)
+                continue
+            self._probe_readiness(pod)
 
         # Launch: bound pending pods whose barrier cleared.
         for key, pod in live_pods.items():
@@ -156,17 +158,58 @@ class ProcessKubelet:
         self._procs[(pod.meta.namespace, pod.meta.name)] = \
             (pod.meta.uid, proc)
 
+        probe = pod.spec.container.readiness_file
+        if probe:
+            # A leftover file from a crashed prior incarnation would mark
+            # the fresh process Ready while it is still starting up.
+            path = probe if os.path.isabs(probe) else os.path.join(
+                pod.spec.container.workdir or self.workdir or ".", probe)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
         def running(p: Pod) -> None:
             p.status.phase = PodPhase.RUNNING
             p.status.start_time = time.time()
-            p.status.conditions = set_condition(
-                p.status.conditions,
-                Condition(type=c.COND_READY, status="True",
-                          reason="ProcessRunning"))
+            if probe:
+                # Ready comes later, when the probe file appears.
+                p.status.conditions = set_condition(
+                    p.status.conditions,
+                    Condition(type=c.COND_READY, status="False",
+                              reason="AwaitingReadinessFile", message=probe))
+            else:
+                p.status.conditions = set_condition(
+                    p.status.conditions,
+                    Condition(type=c.COND_READY, status="True",
+                              reason="ProcessRunning"))
 
         self._write_status(pod, running)
         self.log.info("pod %s: started pid %d on %s", pod.meta.name,
                       proc.pid, node.meta.name)
+
+    def _probe_readiness(self, pod: Pod) -> None:
+        """Flip Ready → True once a declared readiness file appears."""
+        probe = pod.spec.container.readiness_file
+        if not probe:
+            return
+        ready = next((cd for cd in pod.status.conditions
+                      if cd.type == c.COND_READY), None)
+        if ready is not None and ready.status == "True":
+            return
+        path = probe if os.path.isabs(probe) else os.path.join(
+            pod.spec.container.workdir or self.workdir or ".", probe)
+        if not os.path.exists(path):
+            return
+
+        def mark_ready(p: Pod) -> None:
+            p.status.conditions = set_condition(
+                p.status.conditions,
+                Condition(type=c.COND_READY, status="True",
+                          reason="ReadinessFilePresent"))
+
+        self._write_status(pod, mark_ready)
+        self.log.info("pod %s: readiness file present", pod.meta.name)
 
     def _set_exit_status(self, pod: Pod, code: int) -> None:
         def exited(p: Pod) -> None:

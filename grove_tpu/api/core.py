@@ -32,6 +32,11 @@ class ContainerSpec:
     argv: list[str] = dataclasses.field(default_factory=list)
     env: dict[str, str] = dataclasses.field(default_factory=dict)
     workdir: str = ""
+    # Readiness-probe analog (k8s readinessProbe): when set, the node
+    # agent marks the pod Ready only once this file exists (absolute, or
+    # relative to the pod workdir) — e.g. written by a serving engine
+    # after weights load. Unset → Ready at process start.
+    readiness_file: str = ""
 
 
 @dataclasses.dataclass

@@ -29,6 +29,23 @@ class StartupType(str, enum.Enum):
     EXPLICIT = "CliqueStartupTypeExplicit"       # StartsAfter edges
 
 
+def effective_startup_type(tmpl: "PodCliqueSetTemplate") -> StartupType:
+    """Resolve an unset startup_type (shared by defaulting and
+    expected-state so direct-constructed specs behave like admitted ones).
+
+    The reference defaults to InOrder (admission/pcs/defaulting). One
+    deliberate divergence: a template that declares ``starts_after``
+    edges without naming a startup type gets EXPLICIT — under a silent
+    InOrder default those edges would be ignored, which round 1 shipped
+    as a live bug (the enum existed but nothing consumed it).
+    """
+    if tmpl.startup_type is not None:
+        return tmpl.startup_type
+    if any(t.starts_after for t in tmpl.cliques):
+        return StartupType.EXPLICIT
+    return StartupType.IN_ORDER
+
+
 class UpdateStrategyType(str, enum.Enum):
     ROLLING_RECREATE = "RollingRecreate"
     ON_DELETE = "OnDelete"
@@ -109,7 +126,9 @@ class ScalingGroupConfig:
 class PodCliqueSetTemplate:
     cliques: list[PodCliqueTemplate] = dataclasses.field(default_factory=list)
     scaling_groups: list[ScalingGroupConfig] = dataclasses.field(default_factory=list)
-    startup_type: StartupType = StartupType.ANY_ORDER
+    # None → resolved by effective_startup_type (IN_ORDER, or EXPLICIT
+    # when starts_after edges are declared).
+    startup_type: Optional[StartupType] = None
     priority_class: str = ""
     # Scheduling priority: higher-priority gangs are considered first
     # when capacity is contended (reference PriorityClassName; numeric

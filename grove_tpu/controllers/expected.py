@@ -21,6 +21,8 @@ from grove_tpu.api.podcliqueset import (
     PodCliqueSet,
     PodCliqueTemplate,
     ScalingGroupConfig,
+    StartupType,
+    effective_startup_type,
 )
 from grove_tpu.api.podgang import PodGang, PodGangSpec, PodGroup
 from grove_tpu.api.scalinggroup import (
@@ -69,6 +71,27 @@ def sg_min_available(sg: ScalingGroupConfig) -> int:
     return sg.min_available if sg.min_available is not None else 1
 
 
+def effective_starts_after(pcs: PodCliqueSet,
+                           t: PodCliqueTemplate) -> list[str]:
+    """Parent clique names for ``t`` under the template's startup type.
+
+    IN_ORDER translates clique declaration order into an implicit DAG —
+    each clique waits on the immediately preceding one (reference
+    podcliqueset/components/podclique/podclique.go:357-364; PCSG members
+    resolve against the base gang the same way, matching
+    podcliquescalinggroup/components/podclique/podclique.go:415-427).
+    EXPLICIT uses the declared ``starts_after`` edges; ANY_ORDER none.
+    """
+    st = effective_startup_type(pcs.spec.template)
+    if st == StartupType.EXPLICIT:
+        return list(t.starts_after)
+    if st == StartupType.IN_ORDER:
+        names = [q.name for q in pcs.spec.template.cliques]
+        i = names.index(t.name)
+        return [names[i - 1]] if i > 0 else []
+    return []
+
+
 def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
                        parents: list[str]) -> list[str]:
     """Map parent clique names to PCLQ FQNs within the same PCS replica.
@@ -97,7 +120,8 @@ def _clique_to_spec(pcs: PodCliqueSet, replica: int, t: PodCliqueTemplate,
         replicas=t.replicas,
         min_available=min_available(t),
         template=t,
-        starts_after=_starts_after_fqns(pcs, replica, t.starts_after),
+        starts_after=_starts_after_fqns(pcs, replica,
+                                        effective_starts_after(pcs, t)),
         auto_scaling=t.auto_scaling,
         pcs_name=pcs.meta.name,
         pcs_replica=replica,

@@ -29,6 +29,7 @@ from grove_tpu.api.podcliqueset import (
     PodCliqueSetSpec,
     PodCliqueSetTemplate,
     PodCliqueTemplate,
+    StartupType,
 )
 from grove_tpu.cluster import new_cluster
 from grove_tpu.scale.measurement import TimelineTracker
@@ -70,6 +71,10 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
                     min_available=per_clique, tpu_chips_per_pod=0,
                     container=ContainerSpec(argv=["sleep", "inf"]))
                     for i in range(cfg.cliques)],
+                # Concurrent deploy is the thing being measured (the
+                # reference's KWOK benchmark deploys all pods at once);
+                # the IN_ORDER default would serialize cliques into waves.
+                startup_type=StartupType.ANY_ORDER,
             )))
         client.create(pcs)
         tracker.record("deploy", "pcs-created")

@@ -1,0 +1,167 @@
+"""CliqueStartupTypeInOrder: declaration order becomes an implicit
+startup DAG (reference podcliqueset/components/podclique/podclique.go:
+357-364 and the PCSG analog). Round-1 gap: the enum existed but nothing
+consumed it — a user selecting InOrder silently got AnyOrder.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+from grove_tpu.runtime.errors import ValidationError
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.podclique import PodClique
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    StartupType,
+    effective_startup_type,
+)
+from grove_tpu.controllers.expected import effective_starts_after
+
+
+def _pcs(cliques, startup_type=None, scaling_groups=()):
+    return PodCliqueSet(
+        meta=new_meta("pcs"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=cliques, startup_type=startup_type,
+            scaling_groups=list(scaling_groups))))
+
+
+class TestEffectiveStartupType:
+    def test_unset_defaults_to_in_order(self):
+        tmpl = PodCliqueSetTemplate(cliques=[PodCliqueTemplate(name="a")])
+        assert effective_startup_type(tmpl) is StartupType.IN_ORDER
+
+    def test_unset_with_edges_defaults_to_explicit(self):
+        tmpl = PodCliqueSetTemplate(cliques=[
+            PodCliqueTemplate(name="a"),
+            PodCliqueTemplate(name="b", starts_after=["a"])])
+        assert effective_startup_type(tmpl) is StartupType.EXPLICIT
+
+    def test_explicit_setting_wins(self):
+        tmpl = PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(name="a")],
+            startup_type=StartupType.ANY_ORDER)
+        assert effective_startup_type(tmpl) is StartupType.ANY_ORDER
+
+    def test_defaulting_persists_resolution(self):
+        pcs = _pcs([PodCliqueTemplate(name="a")])
+        default_podcliqueset(pcs)
+        assert pcs.spec.template.startup_type is StartupType.IN_ORDER
+
+
+class TestEffectiveStartsAfter:
+    def test_in_order_chains_declaration_order(self):
+        pcs = _pcs([PodCliqueTemplate(name=n) for n in ("a", "b", "c")],
+                   startup_type=StartupType.IN_ORDER)
+        tmpl = pcs.spec.template
+        assert effective_starts_after(pcs, tmpl.cliques[0]) == []
+        assert effective_starts_after(pcs, tmpl.cliques[1]) == ["a"]
+        assert effective_starts_after(pcs, tmpl.cliques[2]) == ["b"]
+
+    def test_any_order_has_no_edges(self):
+        pcs = _pcs([PodCliqueTemplate(name=n) for n in ("a", "b")],
+                   startup_type=StartupType.ANY_ORDER)
+        assert effective_starts_after(pcs, pcs.spec.template.cliques[1]) == []
+
+    def test_explicit_uses_declared_edges(self):
+        pcs = _pcs([PodCliqueTemplate(name="a"),
+                    PodCliqueTemplate(name="b"),
+                    PodCliqueTemplate(name="c", starts_after=["a"])],
+                   startup_type=StartupType.EXPLICIT)
+        assert effective_starts_after(pcs, pcs.spec.template.cliques[2]) == ["a"]
+
+    def test_in_order_spans_scaling_group_members(self):
+        pcs = _pcs(
+            [PodCliqueTemplate(name="lead"), PodCliqueTemplate(name="work")],
+            startup_type=StartupType.IN_ORDER,
+            scaling_groups=[ScalingGroupConfig(
+                name="sg", clique_names=["work"], replicas=2)])
+        assert effective_starts_after(
+            pcs, pcs.spec.template.cliques[1]) == ["lead"]
+
+
+def test_declared_edges_under_in_order_rejected(cluster_factory=None):
+    from grove_tpu.cluster import new_cluster
+    with new_cluster() as cl:
+        with pytest.raises(ValidationError, match="starts_after requires"):
+            cl.client.create(_pcs(
+                [PodCliqueTemplate(name="a"),
+                 PodCliqueTemplate(name="b", starts_after=["a"])],
+                startup_type=StartupType.IN_ORDER))
+
+
+def test_in_order_translates_to_gates_in_store():
+    """Admitted IN_ORDER PCS produces PCLQs with chained starts_after."""
+    from grove_tpu.cluster import new_cluster
+    from test_e2e_simple import wait_for
+    with new_cluster() as cl:
+        cl.client.create(_pcs(
+            [PodCliqueTemplate(name=n) for n in ("a", "b", "c")]))
+        wait_for(lambda: len(cl.client.list(
+            PodClique, selector={c.LABEL_PCS_NAME: "pcs"})) == 3,
+            timeout=10.0, desc="cliques created")
+        by_role = {p.spec.role_name: p for p in cl.client.list(
+            PodClique, selector={c.LABEL_PCS_NAME: "pcs"})}
+        assert by_role["a"].spec.starts_after == []
+        assert by_role["b"].spec.starts_after == ["pcs-0-a"]
+        assert by_role["c"].spec.starts_after == ["pcs-0-b"]
+
+
+def test_in_order_processes_start_strictly_in_order(tmp_path):
+    """3-clique IN_ORDER PCS under the ProcessKubelet: the OS processes
+    observably start a → b → c (the VERDICT's done-criterion for this)."""
+    from grove_tpu.agent.process import ProcessKubelet
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+    from test_e2e_simple import wait_for
+
+    log = tmp_path / "order.log"
+
+    def payload(name: str, startup_s: float) -> ContainerSpec:
+        # Simulated startup work (weights loading etc.), then the pod
+        # reports ready via its readiness file. The FIRST clique is the
+        # slowest: without readiness gating, later cliques would
+        # overtake it and the log order would invert.
+        code = (
+            "import os, time\n"
+            f"time.sleep({startup_s})\n"
+            f"open({str(log)!r}, 'a').write("
+            "os.environ['GROVE_POD_NAME'] + '\\n')\n"
+            f"open({str(tmp_path)!r} + '/ready-' + "
+            "os.environ['GROVE_POD_NAME'], 'w').close()\n"
+            "time.sleep(120)\n"
+        )
+        return ContainerSpec(
+            argv=[sys.executable, "-c", code],
+            readiness_file=str(tmp_path) + f"/ready-ordered-0-{name}-0")
+
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=2)], fake=False)
+    cl = new_cluster(fleet=fleet, fake_kubelet=False)
+    kubelet = ProcessKubelet(cl.client, workdir=str(tmp_path))
+    cl.manager.add_runnable(kubelet)
+    with cl:
+        cl.client.create(PodCliqueSet(
+            meta=new_meta("ordered"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name=n, replicas=1, container=payload(n, delay))
+                    for n, delay in (("a", 1.0), ("b", 0.3), ("c", 0.0))],
+            ))))
+        wait_for(lambda: log.exists()
+                 and len(log.read_text().splitlines()) == 3,
+                 timeout=45.0, desc="all three processes started")
+        started = [line.rsplit("-", 2)[-2]
+                   for line in log.read_text().splitlines()]
+        assert started == ["a", "b", "c"], started
+        assert all(
+            p.status.phase == PodPhase.RUNNING for p in cl.client.list(
+                Pod, selector={c.LABEL_PCS_NAME: "ordered"}))
