@@ -135,6 +135,19 @@ class ProcessKubelet:
         env[c.ENV_TPU_SLICE_NAME] = node.meta.labels.get(c.NODE_LABEL_SLICE, "")
         env[c.ENV_TPU_SLICE_TOPOLOGY] = node.meta.labels.get(
             c.NODE_LABEL_TPU_TOPOLOGY, "")
+        probe = pod.spec.container.readiness_file
+        if probe:
+            # A leftover file from a crashed prior incarnation would mark
+            # the fresh process Ready while it is still starting up. Must
+            # happen BEFORE exec: a fast-starting payload may write the
+            # file immediately, and removing it afterwards would wedge the
+            # pod NotReady forever.
+            path = probe if os.path.isabs(probe) else os.path.join(
+                pod.spec.container.workdir or self.workdir or ".", probe)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
         try:
             os.makedirs(self.log_dir, exist_ok=True)
             log_path = os.path.join(
@@ -157,17 +170,6 @@ class ProcessKubelet:
             return
         self._procs[(pod.meta.namespace, pod.meta.name)] = \
             (pod.meta.uid, proc)
-
-        probe = pod.spec.container.readiness_file
-        if probe:
-            # A leftover file from a crashed prior incarnation would mark
-            # the fresh process Ready while it is still starting up.
-            path = probe if os.path.isabs(probe) else os.path.join(
-                pod.spec.container.workdir or self.workdir or ".", probe)
-            try:
-                os.remove(path)
-            except OSError:
-                pass
 
         def running(p: Pod) -> None:
             p.status.phase = PodPhase.RUNNING

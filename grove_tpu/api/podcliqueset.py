@@ -158,6 +158,10 @@ class UpdateProgress:
     updated_replicas: list[int] = dataclasses.field(default_factory=list)
     current_replica: Optional[int] = None
     target_hash: str = ""
+    # True → pod-shaping-only change: the selected replica's PodCliques
+    # roll their pods in place (gangs survive); False → the selected
+    # replica's children are deleted and recreated wholesale.
+    pod_level: bool = False
 
 
 @dataclasses.dataclass
@@ -175,6 +179,9 @@ class PodCliqueSetStatus:
     available_replicas: int = 0
     updated_replicas: int = 0
     generation_hash: str = ""
+    # Gang-shaping structure only (expected.structure_hash): decides
+    # replica-recreation vs in-place pod-level rolling on template change.
+    structure_hash: str = ""
     rolling_update: Optional[UpdateProgress] = None
     conditions: list[Condition] = dataclasses.field(default_factory=list)
     last_errors: list[LastError] = dataclasses.field(default_factory=list)

@@ -49,6 +49,23 @@ def generation_hash(pcs: PodCliqueSet) -> str:
     return compute_hash(tmpl)
 
 
+def structure_hash(pcs: PodCliqueSet) -> str:
+    """Hash of the gang-shaping structure only (clique set, replica
+    counts, scaling groups, topology, ordering). Pod-shaping fields
+    (the container) are excluded: when ONLY those change, each PodClique
+    rolls its own pods one at a time in place (reference
+    podclique/components/pod/rollingupdate.go:87-227) — tearing down
+    whole PCS replicas for an image tweak would destroy healthy gangs.
+    """
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.serde import clone
+    tmpl = clone(pcs.spec.template)
+    tmpl.priority = 0
+    for t in tmpl.cliques:
+        t.container = ContainerSpec()
+    return compute_hash(tmpl)
+
+
 def standalone_cliques(pcs: PodCliqueSet) -> list[PodCliqueTemplate]:
     grouped = {name for sg in pcs.spec.template.scaling_groups
                for name in sg.clique_names}

@@ -84,6 +84,12 @@ class PodCliqueReconciler:
             return result
         self._remove_gates_if_unblocked(pclq, pods, gang_name)
         self._update_status(pclq, pods)
+        # Pod-level rolling AFTER gate removal: replacement pods must be
+        # able to schedule (and go Ready) or the roll would deadlock
+        # waiting on a pod whose gate nothing lifts.
+        result = self._rolling_pods_pass(pclq, pods, req)
+        if result is not None:
+            return result
         return StepResult.finished()
 
     # ---- pod diff sync ----
@@ -97,22 +103,15 @@ class PodCliqueReconciler:
         failed = [p for p in pods if p.status.phase == PodPhase.FAILED]
         if failed:
             now = _time.time()
-            self.expectations.expect_deletes(
-                req.key, [p.meta.uid for p in failed])
             for p in failed:
                 bk = (p.meta.namespace, p.meta.name)
                 n, _ = self._crash_backoff.get(bk, (0, 0.0))
                 delay = min(self.CRASH_BACKOFF_BASE * (2 ** n),
                             self.CRASH_BACKOFF_MAX)
                 self._crash_backoff[bk] = (n + 1, now + delay)
-                try:
-                    self.client.delete(Pod, p.meta.name, p.meta.namespace)
-                    self.expectations.observe_delete(req.key, p.meta.uid)
-                except NotFoundError:
-                    self.expectations.observe_delete(req.key, p.meta.uid)
-                except GroveError as e:
-                    self.expectations.forget(req.key)
-                    return StepResult.fail(e)
+            err = self._delete_pods_observed(req, failed)
+            if err is not None:
+                return err
             return StepResult.requeue(0.05)
         want = pclq.spec.replicas
         if len(pods) < want:
@@ -161,18 +160,98 @@ class PodCliqueReconciler:
                 return StepResult.requeue(min(held))
         elif len(pods) > want:
             doomed = sorted(pods, key=_deletion_order)[:len(pods) - want]
-            self.expectations.expect_deletes(
-                req.key, [p.meta.uid for p in doomed])
-            for p in doomed:
-                try:
-                    self.client.delete(Pod, p.meta.name, p.meta.namespace)
-                    self.expectations.observe_delete(req.key, p.meta.uid)
-                except NotFoundError:
-                    self.expectations.observe_delete(req.key, p.meta.uid)
-                except GroveError as e:
-                    self.expectations.forget(req.key)
-                    return StepResult.fail(e)
+            err = self._delete_pods_observed(req, doomed)
+            if err is not None:
+                return err
         return None
+
+    def _delete_pods_observed(self, req: Request,
+                              doomed: list[Pod]) -> StepResult | None:
+        """Expectation-tracked pod deletion (shared by self-heal, scale-in
+        and rolling update). Returns a failure StepResult or None."""
+        self.expectations.expect_deletes(
+            req.key, [p.meta.uid for p in doomed])
+        for p in doomed:
+            try:
+                self.client.delete(Pod, p.meta.name, p.meta.namespace)
+                self.expectations.observe_delete(req.key, p.meta.uid)
+            except NotFoundError:
+                self.expectations.observe_delete(req.key, p.meta.uid)
+            except GroveError as e:
+                self.expectations.forget(req.key)
+                return StepResult.fail(e)
+        return None
+
+    # ---- pod-level rolling update (reference rollingupdate.go:87-227) ----
+
+    def _rolling_pods_pass(self, pclq: PodClique, pods: list[Pod],
+                           req: Request) -> StepResult | None:
+        """Replace pods whose template hash is stale, one ready pod at a
+        time (oldest first), holding the min_available floor.
+
+        Non-ready stale pods are deleted immediately (they serve nothing);
+        a ready stale pod is only taken down when every new-hash pod is
+        Ready again and ready >= min_available — so a template edit rolls
+        through the clique without ever collapsing the gang.
+        """
+        target = pclq.spec.pod_template_hash
+        if not target:
+            return None
+        if len(pods) != pclq.spec.replicas:
+            # Mid-scale (e.g. a replacement was just created and is not in
+            # this pass's listing): deleting another pod now could pierce
+            # the floor. Wait for the counts to settle.
+            return None
+        stale = [p for p in pods
+                 if p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) != target]
+        if not stale:
+            return None
+        # PCS-sequenced rollout: only the currently selected replica rolls
+        # (one replica at a time across the set, like the reference's
+        # replica-ordered update; the per-pod floor below handles within-
+        # replica safety). Poll while waiting — the turn handoff is a PCS
+        # status write, which raises no event for this PCLQ.
+        if pclq.spec.pcs_name:
+            try:
+                from grove_tpu.api import PodCliqueSet
+                from grove_tpu.api.podcliqueset import UpdateStrategyType
+                pcs = self.client.get(PodCliqueSet, pclq.spec.pcs_name,
+                                      pclq.meta.namespace)
+                if pcs.spec.update_strategy.type == \
+                        UpdateStrategyType.ON_DELETE:
+                    return None  # user deletes pods; no orchestration
+                ru = pcs.status.rolling_update
+                if ru is not None and ru.current_replica != pclq.spec.pcs_replica:
+                    return StepResult.requeue(0.2)
+            except NotFoundError:
+                pass
+
+        def ready(p: Pod) -> bool:
+            return is_condition_true(p.status.conditions, c.COND_READY)
+
+        stale_not_ready = [p for p in stale if not ready(p)]
+        if stale_not_ready:
+            err = self._delete_pods_observed(req, stale_not_ready)
+            if err is not None:
+                return err
+            return StepResult.requeue(0.05)
+
+        # The previous replacement must be fully back (all new-hash pods
+        # Ready) before the next ready pod is taken down.
+        fresh = [p for p in pods if p not in stale]
+        if any(not ready(p) for p in fresh):
+            return StepResult.requeue(0.1)
+        ready_count = sum(1 for p in pods if ready(p))
+        if ready_count < pclq.spec.min_available:
+            return StepResult.requeue(0.2)
+
+        victim = min(stale, key=lambda p: p.meta.creation_timestamp or 0.0)
+        self.log.info("%s: rolling pod %s -> hash %s (%d stale left)",
+                      pclq.meta.name, victim.meta.name, target, len(stale))
+        err = self._delete_pods_observed(req, [victim])
+        if err is not None:
+            return err
+        return StepResult.requeue(0.05)
 
     def _create_observed(self, key: str, pod: Pod) -> None:
         try:

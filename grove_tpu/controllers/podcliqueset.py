@@ -47,9 +47,28 @@ class PodCliqueSetReconciler:
         template_hash = exp.generation_hash(pcs)
         if not pcs.status.generation_hash:
             pcs.status.generation_hash = template_hash
+            pcs.status.structure_hash = exp.structure_hash(pcs)
             pcs = self.client.update_status(pcs)
         elif pcs.status.generation_hash != template_hash:
-            pcs = self._init_rolling_update(pcs, template_hash)
+            # Pod-shaping-only change (e.g. an image tweak): each PCLQ of
+            # the replica being updated rolls its pods one at a time in
+            # place — gangs and placements survive. Structure change:
+            # the selected replica is recreated wholesale. Either way the
+            # rollout is sequenced one PCS replica at a time. An empty
+            # stored structure_hash (status predating the field) means
+            # the prior structure is unknown — fall back to the safe
+            # replica-level recreation.
+            s_hash = exp.structure_hash(pcs)
+            pod_level = pcs.status.structure_hash == s_hash
+            self.log.info("%s: %s rolling update to %s", pcs.meta.name,
+                          "pod-level" if pod_level else "replica-level",
+                          template_hash)
+            pcs = self._init_rolling_update(pcs, template_hash, s_hash,
+                                            pod_level)
+        elif not pcs.status.structure_hash:
+            # Backfill for statuses written before structure_hash existed.
+            pcs.status.structure_hash = exp.structure_hash(pcs)
+            pcs = self.client.update_status(pcs)
 
         # Availability loops first (reference sync group G1): gang
         # termination and rolling-update orchestration may delete replica
@@ -81,11 +100,13 @@ class PodCliqueSetReconciler:
 
     # ---- rolling update bookkeeping (full orchestration in rollout.py) ----
 
-    def _init_rolling_update(self, pcs: PodCliqueSet,
-                             target_hash: str) -> PodCliqueSet:
+    def _init_rolling_update(self, pcs: PodCliqueSet, target_hash: str,
+                             s_hash: str, pod_level: bool) -> PodCliqueSet:
         from grove_tpu.api.podcliqueset import UpdateProgress
         pcs.status.generation_hash = target_hash
-        pcs.status.rolling_update = UpdateProgress(target_hash=target_hash)
+        pcs.status.structure_hash = s_hash
+        pcs.status.rolling_update = UpdateProgress(target_hash=target_hash,
+                                                   pod_level=pod_level)
         return self.client.update_status(pcs)
 
     # ---- component sync ----

@@ -134,15 +134,49 @@ def replica_pods_at_hash(client: Client, pcs: PodCliqueSet, replica: int,
 
 
 def _replica_available(client: Client, pcs: PodCliqueSet, replica: int) -> bool:
+    """Availability from LIVE pod objects, not aggregated PCLQ status —
+    the aggregate can lag a just-deleted pod by one sync, and advancing
+    the rollout on that stale read takes a second replica down while the
+    first is still recovering.
+
+    Standalone and gang-guaranteed PCSG-member cliques (pcsg_replica <
+    PCSG min_available) must each hold their per-clique floor; elastic
+    scaled replicas being down (e.g. preempted) must NOT stall a rollout.
+    """
+    from grove_tpu.api import Pod
+    from grove_tpu.api.meta import is_condition_true
     pclqs, pcsgs, _ = _replica_children(client, pcs, replica)
     standalone = [q for q in pclqs if not q.spec.pcsg_name]
     if not standalone and not pcsgs:
         return False
-    ok = all(q.status.ready_replicas >= q.spec.min_available
-             for q in standalone)
-    ok = ok and all(g.status.ready_replicas >= g.spec.min_available
-                    for g in pcsgs)
-    return ok
+    sg_min = {g.meta.name: g.spec.min_available for g in pcsgs}
+    # A PCSG whose member PCLQs have not materialised yet has zero ready
+    # pods — it must read as unavailable, not vacuously available.
+    members_of = {name: 0 for name in sg_min}
+    for q in pclqs:
+        if q.spec.pcsg_name in members_of:
+            members_of[q.spec.pcsg_name] += 1
+    if any(n == 0 and sg_min[name] > 0 for name, n in members_of.items()):
+        return False
+    pods = [p for p in client.list(
+        Pod, pcs.meta.namespace,
+        selector={c.LABEL_PCS_NAME: pcs.meta.name,
+                  c.LABEL_PCS_REPLICA: str(replica)})
+        if p.meta.deletion_timestamp is None]
+    ready_by_pclq: dict[str, int] = {}
+    for p in pods:
+        if is_condition_true(p.status.conditions, c.COND_READY):
+            name = p.meta.labels.get(c.LABEL_PCLQ_NAME, "")
+            ready_by_pclq[name] = ready_by_pclq.get(name, 0) + 1
+    for q in pclqs:
+        if q.spec.pcsg_name:
+            threshold = sg_min.get(q.spec.pcsg_name)
+            # Unknown PCSG (orphan member) → treat as guaranteed.
+            if threshold is not None and q.spec.pcsg_replica >= threshold:
+                continue  # elastic scaled-gang member may be down
+        if ready_by_pclq.get(q.meta.name, 0) < q.spec.min_available:
+            return False
+    return True
 
 
 def rolling_update_pass(client: Client, pcs: PodCliqueSet) -> float | None:
@@ -206,13 +240,31 @@ def rolling_update_pass(client: Client, pcs: PodCliqueSet) -> float | None:
     victim = pending[0]
 
     if progress.current_replica == victim:
-        # Already recreated; wait for it to come back at the target hash.
+        # Already selected (pod-level: its PCLQs are rolling pods;
+        # replica-level: recreated) — wait for it to reach the hash.
         return 0.2
     # Availability floor: never take a second replica down while the
     # previous one is still recovering (unless it is itself breached).
     if progress.current_replica is not None and \
             progress.current_replica != victim and \
             not _replica_available(client, pcs, progress.current_replica):
+        return 0.2
+
+    if progress.pod_level:
+        # Hand the turn to the replica's PodClique controllers (they roll
+        # pods one at a time, gated on current_replica); nothing is
+        # deleted here, so gangs and placements survive.
+        log.info("rolling update %s: pod-level update of replica %d -> %s",
+                 pcs.meta.name, victim, target)
+        EventRecorder(client, "replica-lifecycle").event(
+            pcs, "Normal", "RollingUpdateReplica",
+            f"replica {victim}: rolling pods in place to hash {target}",
+            key=f"replica-{victim}")
+        progress.current_replica = victim
+        try:
+            client.update_status(pcs)
+        except GroveError:
+            pass
         return 0.2
 
     slices = record_replica_slices(client, pcs, victim)

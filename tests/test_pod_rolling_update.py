@@ -1,0 +1,170 @@
+"""Pod-level rolling update within a PodClique (reference
+podclique/components/pod/rollingupdate.go:87-227): a pod-shaping-only
+template change (e.g. an image tweak) rolls individual pods by template
+hash, one ready pod at a time, holding the min_available floor — it must
+NOT tear down whole PCS replicas or their gangs (round-1 gap: any
+template change recreated the entire replica).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.api import Pod, PodCliqueSet, PodGang, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+)
+from grove_tpu.api.serde import clone
+from grove_tpu.cluster import new_cluster
+from grove_tpu.controllers.expected import generation_hash, structure_hash
+
+from test_e2e_simple import wait_for
+
+
+@pytest.fixture
+def cluster():
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])
+    with new_cluster(fleet=fleet) as cl:
+        yield cl
+
+
+def _pcs(name="pcs", replicas=4, min_available=3, image="v1"):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=replicas, min_available=min_available,
+                tpu_chips_per_pod=2,
+                container=ContainerSpec(argv=["serve", image]))],
+        )))
+
+
+def _pods(cl, name="pcs"):
+    return [p for p in cl.client.list(
+        Pod, selector={c.LABEL_PCS_NAME: name})
+        if p.meta.deletion_timestamp is None]
+
+
+def _all_ready_at(cl, hash_, n, name="pcs"):
+    pods = _pods(cl, name)
+    return (len(pods) == n
+            and all(p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) == hash_
+                    for p in pods)
+            and all(is_condition_true(p.status.conditions, c.COND_READY)
+                    for p in pods))
+
+
+class TestHashSplit:
+    def test_container_change_keeps_structure_hash(self):
+        a, b = _pcs(image="v1"), _pcs(image="v2")
+        assert generation_hash(a) != generation_hash(b)
+        assert structure_hash(a) == structure_hash(b)
+
+    def test_replica_change_moves_structure_hash(self):
+        a, b = _pcs(replicas=4), _pcs(replicas=5)
+        assert structure_hash(a) != structure_hash(b)
+
+    def test_scaling_group_change_moves_structure_hash(self):
+        a = _pcs()
+        b = clone(a)
+        b.spec.template.scaling_groups = [
+            ScalingGroupConfig(name="sg", clique_names=["w"])]
+        assert structure_hash(a) != structure_hash(b)
+
+
+def test_image_tweak_rolls_pods_without_gang_teardown(cluster):
+    cl = cluster
+    cl.client.create(_pcs(image="v1"))
+    old_hash = generation_hash(cl.client.get(PodCliqueSet, "pcs"))
+    wait_for(lambda: _all_ready_at(cl, old_hash, 4), timeout=15.0,
+             desc="initial pods ready")
+    gang_uid = cl.client.list(PodGang)[0].meta.uid
+    initial_uids = {p.meta.name: p.meta.uid for p in _pods(cl)}
+
+    # Watch the floor continuously while the rollout runs.
+    floor_violations = []
+
+    def ready_count():
+        n = sum(1 for p in _pods(cl)
+                if is_condition_true(p.status.conditions, c.COND_READY))
+        if n < 3:
+            floor_violations.append(n)
+        return n
+
+    live = cl.client.get(PodCliqueSet, "pcs")
+    live.spec.template.cliques[0].container = ContainerSpec(
+        argv=["serve", "v2"])
+    cl.client.update(live)
+    new_hash = generation_hash(live)
+    assert new_hash != old_hash
+
+    wait_for(lambda: (ready_count(), _all_ready_at(cl, new_hash, 4))[1],
+             timeout=30.0, desc="rollout to v2 complete")
+
+    # Every pod was recreated (new uids), one at a time above the floor.
+    final = {p.meta.name: p.meta.uid for p in _pods(cl)}
+    assert set(final) == set(initial_uids)  # same stable names
+    assert all(final[n] != initial_uids[n] for n in final)
+    assert not floor_violations, f"ready dipped to {floor_violations}"
+
+    # The gang survived: same object, never deleted/recreated.
+    gangs = cl.client.list(PodGang)
+    assert len(gangs) == 1 and gangs[0].meta.uid == gang_uid
+    # And no PCS-level replica rolling update was started.
+    assert cl.client.get(PodCliqueSet, "pcs").status.rolling_update is None
+
+
+def test_structural_change_still_recreates_replica(cluster):
+    cl = cluster
+    cl.client.create(_pcs(image="v1"))
+    old_hash = generation_hash(cl.client.get(PodCliqueSet, "pcs"))
+    wait_for(lambda: _all_ready_at(cl, old_hash, 4), timeout=15.0,
+             desc="initial pods ready")
+
+    live = cl.client.get(PodCliqueSet, "pcs")
+    live.spec.template.cliques[0].replicas = 5
+    live.spec.template.cliques[0].min_available = 4
+    cl.client.update(live)
+
+    # The PCS-level path engages (progress object appears), and the
+    # clique converges to 5 pods at the new hash.
+    new_hash = generation_hash(live)
+    wait_for(lambda: _all_ready_at(cl, new_hash, 5), timeout=30.0,
+             desc="replica recreated at new shape")
+
+
+def test_rolling_update_in_scaling_group_keeps_scaled_gangs(cluster):
+    cl = cluster
+    pcs = PodCliqueSet(
+        meta=new_meta("sgpcs"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, min_available=1, tpu_chips_per_pod=2,
+                container=ContainerSpec(argv=["serve", "v1"]))],
+            scaling_groups=[ScalingGroupConfig(
+                name="sg", clique_names=["w"], replicas=2, min_available=1)],
+        )))
+    cl.client.create(pcs)
+    old_hash = generation_hash(cl.client.get(PodCliqueSet, "sgpcs"))
+    wait_for(lambda: _all_ready_at(cl, old_hash, 4, name="sgpcs"),
+             timeout=15.0, desc="sg pods ready")
+    gang_uids = {g.meta.name: g.meta.uid for g in cl.client.list(PodGang)}
+    assert len(gang_uids) == 2  # base + one scaled
+
+    live = cl.client.get(PodCliqueSet, "sgpcs")
+    live.spec.template.cliques[0].container = ContainerSpec(
+        argv=["serve", "v2"])
+    cl.client.update(live)
+    new_hash = generation_hash(live)
+    wait_for(lambda: _all_ready_at(cl, new_hash, 4, name="sgpcs"),
+             timeout=30.0, desc="sg rollout complete")
+
+    after = {g.meta.name: g.meta.uid for g in cl.client.list(PodGang)}
+    assert after == gang_uids  # scaled gang survived too
