@@ -41,6 +41,32 @@ class AuthorizerConfig:
 
 
 @dataclasses.dataclass
+class ServerAuthConfig:
+    """HTTP API authentication (static bearer tokens → actor identities,
+    the k8s --token-auth-file analog). Mutating verbs (POST /apply,
+    DELETE) require an authenticated actor; the mapped identity flows
+    into store admission, so admission/authorization.py guards the wire
+    path the way the reference's authorization webhook guards kubectl
+    (admission/pcs/authorization/handler.go:40)."""
+
+    # token value -> actor identity (e.g. "system:grove-operator",
+    # "user:alice"). Empty + allow_anonymous_mutations=False means no
+    # remote mutations at all (grovectl serve generates a token).
+    # Configuring any token auto-enables the authorizer (cluster.py) —
+    # otherwise non-operator identities would be decorative.
+    tokens: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Escape hatch for closed dev/test environments only.
+    allow_anonymous_mutations: bool = False
+    # Autoscaling signal ingestion (POST /metrics/push) stays open by
+    # default: advisory, schema-validated, damped by the autoscaler, and
+    # in-pod engines hold no secrets. Flip to require a token.
+    require_token_for_metrics: bool = False
+    # Reads (GET /api, /logs) are open by default; healthz/metrics are
+    # always open (liveness probes must not need credentials).
+    require_token_for_reads: bool = False
+
+
+@dataclasses.dataclass
 class LogConfig:
     level: str = "info"
     format: str = "text"    # "text" | "json"
@@ -66,6 +92,8 @@ class OperatorConfiguration:
         default_factory=TopologyAwareSchedulingConfig)
     authorizer: AuthorizerConfig = dataclasses.field(
         default_factory=AuthorizerConfig)
+    server_auth: ServerAuthConfig = dataclasses.field(
+        default_factory=ServerAuthConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)

@@ -163,6 +163,16 @@ def cmd_serve(args: argparse.Namespace) -> int:
     cluster = _build_cluster(args)
     try:
         with cluster:
+            # Bootstrap credential (k3s-style): without configured tokens
+            # every remote mutation would be rejected, so generate an
+            # operator token and print it once.
+            auth = cluster.manager.config.server_auth
+            bootstrap_token = None
+            if not auth.tokens and not auth.allow_anonymous_mutations:
+                import secrets
+                from grove_tpu.admission.authorization import OPERATOR_ACTOR
+                bootstrap_token = secrets.token_urlsafe(24)
+                auth.tokens[bootstrap_token] = OPERATOR_ACTOR
             server = ApiServer(cluster, host=args.host, port=args.port)
             try:
                 server.start()
@@ -170,6 +180,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 print(f"error: cannot bind {args.host}:{args.port}: {e}",
                       file=sys.stderr)
                 return 1
+            if bootstrap_token is not None:
+                print(f"api token (generated): {bootstrap_token}\n"
+                      f"  export GROVE_API_TOKEN={bootstrap_token}")
             # Pods learn the control-plane URL so in-pod engines can push
             # autoscaling metrics (serving/metrics_push.py). Wildcard
             # binds map to loopback — pods launched by the in-process
@@ -195,11 +208,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
 def _http(server: str, path: str, method: str = "GET",
           body: bytes | None = None,
-          content_type: str = "application/yaml"):
+          content_type: str = "application/yaml",
+          token: str | None = None):
     """Request against a serve daemon. Returns (status, decoded-body);
     status 0 = could not reach the server. Shared by the client verbs and
-    the server tests."""
+    the server tests. ``token`` (default: $GROVE_API_TOKEN) authenticates
+    mutating verbs."""
     import json as _json
+    import os as _os
     import urllib.error
     import urllib.request
 
@@ -211,8 +227,13 @@ def _http(server: str, path: str, method: str = "GET",
                 pass
         return raw.decode(errors="replace")
 
+    headers = {"Content-Type": content_type}
+    if token is None:
+        token = _os.environ.get("GROVE_API_TOKEN", "")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(f"{server}{path}", method=method, data=body,
-                                 headers={"Content-Type": content_type})
+                                 headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=10) as resp:
             return resp.status, decode(resp.read(),

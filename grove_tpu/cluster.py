@@ -51,6 +51,11 @@ def new_cluster(config: OperatorConfiguration | None = None,
                 admission: bool = True) -> Cluster:
     mgr = Manager(config=config, store=store)
     registry = register_controllers(mgr)
+    # Configuring API tokens implies wanting their identities enforced —
+    # a user token that the authorizer never checks would be a silent
+    # no-op (every mapped actor could mutate managed children).
+    if mgr.config.server_auth.tokens and not mgr.config.authorizer.enabled:
+        mgr.config.authorizer.enabled = True
     if admission:
         from grove_tpu.admission import install_admission
         install_admission(mgr.store, mgr.config, registry)

@@ -14,6 +14,15 @@ control plane exposes its own minimal HTTP API so out-of-process clients
   POST /metrics/push                  workload autoscaling signals
   DELETE /api/<kind>/<name>           delete
 
+Authentication: mutating verbs require `Authorization: Bearer <token>`,
+mapped to an actor identity by ServerAuthConfig.tokens; anonymous
+mutations are rejected (401) and the mapped actor is impersonated on the
+store client so admission authorization fires on the wire path exactly
+as it does in-process — a token mapped to a plain user cannot mutate
+grove-managed children (403). Reads and /metrics/push stay open by
+default (config-gated). Plain TCP: this server is a loopback/VPC-internal
+control socket — front it with a TLS terminator for untrusted networks.
+
 Single-threaded-per-request stdlib server (ThreadingHTTPServer): the
 store is already thread-safe, and control-plane traffic is low-rate.
 """
@@ -27,7 +36,9 @@ from urllib.parse import parse_qs, urlparse
 
 from grove_tpu.api.serde import to_dict
 from grove_tpu.manifest import KIND_REGISTRY, load_manifest, load_object
-from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.errors import ForbiddenError, GroveError, NotFoundError
+
+ANONYMOUS_ACTOR = "system:anonymous"
 
 
 class ApiServer:
@@ -61,16 +72,56 @@ class ApiServer:
                                      "kinds": sorted(KIND_REGISTRY)})
                 return cls
 
+            def _auth_config(self):
+                return cluster.manager.config.server_auth
+
+            def _actor(self) -> str | None:
+                """Actor for this request: a token-mapped identity,
+                ANONYMOUS_ACTOR without credentials, or None (invalid
+                token — the caller tried to authenticate and failed)."""
+                hdr = self.headers.get("Authorization", "")
+                if not hdr:
+                    return ANONYMOUS_ACTOR
+                if not hdr.startswith("Bearer "):
+                    return None
+                return self._auth_config().tokens.get(hdr[7:].strip())
+
+            def _mutating_client(self):
+                """Impersonated client for a mutating request, or None
+                after an error response has been sent."""
+                actor = self._actor()
+                if actor is None:
+                    self._send(401, {"error": "invalid bearer token"})
+                    return None
+                if actor == ANONYMOUS_ACTOR and \
+                        not self._auth_config().allow_anonymous_mutations:
+                    self._send(401, {"error":
+                                     "authentication required: mutating "
+                                     "verbs need Authorization: Bearer "
+                                     "<token> (see server_auth.tokens)"})
+                    return None
+                return cluster.client.impersonate(actor)
+
             def do_GET(self):
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
                 try:
+                    # healthz/metrics are always open: liveness probes
+                    # must not need credentials.
                     if url.path == "/healthz":
                         self._send(200, cluster.manager.healthz())
-                    elif url.path == "/metrics":
+                        return
+                    if url.path == "/metrics":
                         self._send(200, cluster.manager.metrics_text(),
                                    content_type="text/plain; version=0.0.4")
-                    elif len(parts) == 2 and parts[0] == "api":
+                        return
+                    if self._auth_config().require_token_for_reads:
+                        actor = self._actor()
+                        if actor is None or actor == ANONYMOUS_ACTOR:
+                            self._send(401, {"error": "reads require a "
+                                             "bearer token"})
+                            return
+                    if len(parts) == 2 and parts[0] == "api":
                         cls = self._kind(parts[1])
                         if cls is None:
                             return
@@ -106,6 +157,9 @@ class ApiServer:
                 if path != "/apply":
                     self._send(404, {"error": "POST /apply or /metrics/push"})
                     return
+                client = self._mutating_client()
+                if client is None:
+                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length).decode()
                 try:
@@ -115,23 +169,41 @@ class ApiServer:
                     else:
                         objs = load_manifest(raw)
                     results = []
+                    forbidden = False
                     for obj in objs:
                         try:
-                            created = cluster.client.create(obj)
+                            created = client.create(obj)
                             results.append({"kind": created.KIND,
                                             "name": created.meta.name,
                                             "action": "created"})
+                        except ForbiddenError as e:
+                            # Report per-object and keep going: earlier
+                            # documents were already applied, and hiding
+                            # that behind an opaque 403 would leave the
+                            # caller blind to what now exists.
+                            forbidden = True
+                            results.append({"kind": obj.KIND,
+                                            "name": obj.meta.name,
+                                            "action": "forbidden",
+                                            "error": str(e)})
                         except GroveError as e:
                             if "exists" not in str(e):
                                 raise
-                            live = cluster.client.get(
-                                type(obj), obj.meta.name, obj.meta.namespace)
-                            live.spec = obj.spec
-                            cluster.client.update(live)
-                            results.append({"kind": obj.KIND,
-                                            "name": obj.meta.name,
-                                            "action": "updated"})
-                    self._send(200, results)
+                            try:
+                                live = client.get(type(obj), obj.meta.name,
+                                                  obj.meta.namespace)
+                                live.spec = obj.spec
+                                client.update(live)
+                                results.append({"kind": obj.KIND,
+                                                "name": obj.meta.name,
+                                                "action": "updated"})
+                            except ForbiddenError as e2:
+                                forbidden = True
+                                results.append({"kind": obj.KIND,
+                                                "name": obj.meta.name,
+                                                "action": "forbidden",
+                                                "error": str(e2)})
+                    self._send(403 if forbidden else 200, results)
                 except GroveError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - malformed input
@@ -180,6 +252,12 @@ class ApiServer:
                 if cluster.metrics is None:
                     self._send(503, {"error": "autoscaler disabled"})
                     return
+                if self._auth_config().require_token_for_metrics:
+                    actor = self._actor()
+                    if actor is None or actor == ANONYMOUS_ACTOR:
+                        self._send(401, {"error": "metrics push requires a "
+                                         "bearer token"})
+                        return
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -201,13 +279,18 @@ class ApiServer:
                 cls = self._kind(parts[1])
                 if cls is None:
                     return
+                client = self._mutating_client()
+                if client is None:
+                    return
                 try:
-                    cluster.client.delete(cls, parts[2])
+                    client.delete(cls, parts[2])
                     self._send(200, {"deleted": parts[2]})
                 except NotFoundError as e:
                     self._send(404, {"error": str(e)})
-                except GroveError as e:
+                except ForbiddenError as e:
                     self._send(403, {"error": str(e)})
+                except GroveError as e:
+                    self._send(400, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolve port 0

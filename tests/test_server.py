@@ -19,9 +19,19 @@ spec:
 """
 
 
+OPERATOR_TOKEN = "test-operator-token"
+
+
 @pytest.fixture
-def server():
-    cl = new_cluster(fleet=FleetSpec(
+def server(monkeypatch):
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens[OPERATOR_TOKEN] = OPERATOR_ACTOR
+    # The CLI verbs under test pick the credential up from the env, the
+    # way a real operator shell would.
+    monkeypatch.setenv("GROVE_API_TOKEN", OPERATOR_TOKEN)
+    cl = new_cluster(config=cfg, fleet=FleetSpec(
         slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
     with cl:
         srv = ApiServer(cl, port=0)
@@ -30,14 +40,15 @@ def server():
         srv.stop()
 
 
-def _req(url, method="GET", body=None, content_type="application/yaml"):
+def _req(url, method="GET", body=None, content_type="application/yaml",
+         token=None):
     """Thin shim over the CLI's shared _http helper (one copy of the
     request/decode logic for client verbs and tests alike)."""
     from grove_tpu.cli import _http
     scheme_host, _, rest = url.removeprefix("http://").partition("/")
     return _http(f"http://{scheme_host}", f"/{rest}", method=method,
                  body=body.encode() if body else None,
-                 content_type=content_type)
+                 content_type=content_type, token=token)
 
 
 def test_apply_watch_delete_over_http(server):
@@ -88,9 +99,13 @@ def test_pod_logs_endpoint(tmp_path):
     """GET /logs/<ns>/<pod> serves real-process pod output."""
     import sys
     from grove_tpu.agent.process import ProcessKubelet
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
     fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
                                         count=1)], fake=False)
-    cl = new_cluster(fleet=fleet, fake_kubelet=False)
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens[OPERATOR_TOKEN] = OPERATOR_ACTOR
+    cl = new_cluster(config=cfg, fleet=fleet, fake_kubelet=False)
     cl.manager.add_runnable(ProcessKubelet(cl.client,
                                            log_dir=str(tmp_path)))
     with cl:
@@ -98,7 +113,7 @@ def test_pod_logs_endpoint(tmp_path):
         srv.start()
         base = f"http://127.0.0.1:{srv.port}"
         try:
-            _req(f"{base}/apply", "POST", f"""
+            _req(f"{base}/apply", "POST", token=OPERATOR_TOKEN, body=f"""
 kind: PodCliqueSet
 metadata: {{name: logsvc}}
 spec:

@@ -1,0 +1,160 @@
+"""HTTP API authentication: bearer token → actor, anonymous mutation
+rejection, and admission authorization firing on the wire path (the
+round-1 hole: every remote caller acted as the privileged operator).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.admission.authorization import OPERATOR_ACTOR
+from grove_tpu.api import Pod, PodClique, constants as c
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.cluster import new_cluster
+from grove_tpu.server import ApiServer
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+from test_server import MANIFEST, _req
+
+OPERATOR_TOKEN = "op-token"
+USER_TOKEN = "alice-token"
+
+
+@pytest.fixture
+def server():
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    cfg.server_auth.tokens = {OPERATOR_TOKEN: OPERATOR_ACTOR,
+                              USER_TOKEN: "user:alice"}
+    cl = new_cluster(config=cfg, fleet=FleetSpec(
+        slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}", cl
+        srv.stop()
+
+
+def test_anonymous_apply_rejected(server, monkeypatch):
+    monkeypatch.delenv("GROVE_API_TOKEN", raising=False)
+    base, _ = server
+    status, err = _req(f"{base}/apply", "POST", MANIFEST)
+    assert status == 401, (status, err)
+    assert "authentication required" in err["error"]
+
+
+def test_anonymous_delete_rejected(server, monkeypatch):
+    monkeypatch.delenv("GROVE_API_TOKEN", raising=False)
+    base, _ = server
+    status, err = _req(f"{base}/api/PodCliqueSet/x", "DELETE")
+    assert status == 401, (status, err)
+
+
+def test_invalid_token_rejected(server):
+    base, _ = server
+    status, err = _req(f"{base}/apply", "POST", MANIFEST, token="wrong")
+    assert status == 401 and "invalid bearer token" in err["error"]
+
+
+def test_reads_stay_open(server, monkeypatch):
+    monkeypatch.delenv("GROVE_API_TOKEN", raising=False)
+    base, _ = server
+    assert _req(f"{base}/healthz")[0] == 200
+    assert _req(f"{base}/api/PodCliqueSet")[0] == 200
+
+
+def test_operator_token_can_apply_and_delete(server):
+    base, cl = server
+    status, out = _req(f"{base}/apply", "POST", MANIFEST,
+                       token=OPERATOR_TOKEN)
+    assert status == 200 and out[0]["action"] == "created"
+    wait_for(lambda: len(cl.client.list(
+        Pod, selector={c.LABEL_PCS_NAME: "websvc"})) == 2,
+        desc="pods created")
+    status, _ = _req(f"{base}/api/PodCliqueSet/websvc", "DELETE",
+                     token=OPERATOR_TOKEN)
+    assert status == 200
+
+
+def test_user_token_cannot_mutate_managed_children(server):
+    """The wire path now enforces what in-process admission always did:
+    a plain user may manage the PCS, never its managed children."""
+    base, cl = server
+    status, _ = _req(f"{base}/apply", "POST", MANIFEST, token=USER_TOKEN)
+    assert status == 200  # PCS itself is a user kind
+    wait_for(lambda: len(cl.client.list(
+        PodClique, selector={c.LABEL_PCS_NAME: "websvc"})) == 1,
+        desc="clique created")
+    pclq = cl.client.list(PodClique,
+                          selector={c.LABEL_PCS_NAME: "websvc"})[0]
+
+    # DELETE of the managed child as alice → 403 from the authorizer.
+    status, err = _req(f"{base}/api/PodClique/{pclq.meta.name}", "DELETE",
+                       token=USER_TOKEN)
+    assert status == 403, (status, err)
+    assert "may not delete" in err["error"]
+
+    # The operator identity may (it owns the children).
+    status, _ = _req(f"{base}/api/PodClique/{pclq.meta.name}", "DELETE",
+                     token=OPERATOR_TOKEN)
+    assert status == 200
+
+
+def test_user_token_may_manage_own_unmanaged_objects(server):
+    base, _ = server
+    status, out = _req(f"{base}/apply", "POST", MANIFEST, token=USER_TOKEN)
+    assert status == 200
+    status, _ = _req(f"{base}/api/PodCliqueSet/websvc", "DELETE",
+                     token=USER_TOKEN)
+    assert status == 200
+
+
+def test_apply_reports_per_object_forbidden(server):
+    """Multi-document apply: allowed docs land, forbidden ones are
+    reported per-object (not an opaque all-or-nothing 403)."""
+    base, cl = server
+    status, _ = _req(f"{base}/apply", "POST", MANIFEST, token=OPERATOR_TOKEN)
+    assert status == 200
+    wait_for(lambda: len(cl.client.list(
+        PodClique, selector={c.LABEL_PCS_NAME: "websvc"})) == 1,
+        desc="clique created")
+    pclq = cl.client.list(PodClique,
+                          selector={c.LABEL_PCS_NAME: "websvc"})[0]
+    import json as _json
+    payload = {"kind": PodClique.KIND,
+               "metadata": {"name": pclq.meta.name,
+                            "labels": dict(pclq.meta.labels)}}
+    status, results = _req(f"{base}/apply", "POST", _json.dumps(payload),
+                           content_type="application/json",
+                           token=USER_TOKEN)
+    assert status == 403, (status, results)
+    assert results[0]["action"] == "forbidden"
+    assert "may not" in results[0]["error"]
+
+
+def test_configuring_tokens_auto_enables_authorizer():
+    """A token registry without the authorizer would be decorative —
+    cluster bring-up flips it on."""
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens = {"t": "user:bob"}
+    assert not cfg.authorizer.enabled
+    with new_cluster(config=cfg) as cl:
+        assert cl.manager.config.authorizer.enabled
+
+
+def test_require_token_for_reads():
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens = {OPERATOR_TOKEN: OPERATOR_ACTOR}
+    cfg.server_auth.require_token_for_reads = True
+    with new_cluster(config=cfg) as cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            assert _req(f"{base}/api/Pod")[0] == 401
+            assert _req(f"{base}/api/Pod", token=OPERATOR_TOKEN)[0] == 200
+            # liveness endpoints never need credentials
+            assert _req(f"{base}/healthz")[0] == 200
+        finally:
+            srv.stop()
