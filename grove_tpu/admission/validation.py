@@ -4,12 +4,22 @@ Role parity with reference admission/pcs/validation/ (6,289 LoC across 13
 files), the rules that shape every downstream object:
 
 - structural: names, replica/min_available bounds, uniqueness
+- container: argv/env/workdir/readiness-probe shape, reserved env-var
+  protection (the injected TPU/GROVE contract must not be overridden)
+- name budgets: worst-case GENERATED child names (pod/service/gang) must
+  fit the DNS-label limit — a valid user name can still compose into an
+  invalid pod name (reference checks generated-name lengths the same way)
+- chips: per-pod chip counts must be achievable on a real TPU host, and
+  slice-packed gangs must fit a physically possible slice
+  (topology/tpu.py generations)
 - startup DAG: StartsAfter references exist and form a DAG (cycle
   detection via Tarjan SCC, reference podcliquedeps.go:53)
 - topology: levels must exist in the hierarchy; child constraints must be
   at least as strict as the parent's (reference topologyconstraints.go)
-- scaling groups: member cliques exist, belong to exactly one group
-- update immutability: startup type, clique set, scaling-group membership
+- scaling groups: member cliques exist, belong to exactly one group,
+  scale only through the group (no per-member autoscaling)
+- update immutability: an explicit field table (reference
+  podcliqueset.go:662-698), plus clique-set/SG-membership structure
 - scheduler-specific checks via Backend.validate_pcs
 """
 
@@ -17,12 +27,36 @@ from __future__ import annotations
 
 import re
 
+from grove_tpu.api import constants as c
 from grove_tpu.api.clustertopology import ClusterTopology, DEFAULT_TPU_LEVELS
+from grove_tpu.api.core import ContainerSpec
 from grove_tpu.api.podcliqueset import (PodCliqueSet, StartupType,
                                         TopologyConstraint)
 from grove_tpu.scheduler.framework import Registry
+from grove_tpu.topology.tpu import TPU_GENERATIONS
 
 _NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,50}[a-z0-9])?$")
+_ENV_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+# Generated child names are DNS labels (hostnames in the headless
+# service); k8s caps those at 63 characters.
+MAX_GENERATED_NAME = 63
+
+# Env vars the controllers inject (controllers/podclique.py _add_env +
+# the node agent). User env overriding these would silently break rank
+# identity / discovery inside the pod. Only these EXACT names are
+# reserved — TPU_* runtime tuning flags and user GROVE_*-prefixed vars
+# of their own invention stay usable.
+_RESERVED_ENV = frozenset({
+    c.ENV_PCS_NAME, c.ENV_PCS_INDEX, c.ENV_PCLQ_NAME,
+    c.ENV_PCLQ_POD_INDEX, c.ENV_PCSG_NAME, c.ENV_PCSG_INDEX,
+    c.ENV_PCSG_TEMPLATE_NUM_PODS, c.ENV_HEADLESS_SERVICE,
+    c.ENV_TPU_WORKER_ID, c.ENV_TPU_WORKER_HOSTNAMES,
+    c.ENV_TPU_SLICE_NAME, c.ENV_TPU_SLICE_TOPOLOGY,
+    c.ENV_MEGASLICE_INDEX, c.ENV_MEGASLICE_COUNT,
+    "GROVE_POD_NAME", "GROVE_NAMESPACE", "GROVE_NODE_NAME",
+    "GROVE_CONTROL_PLANE",
+})
 
 _LEVELS = [lvl.domain for lvl in DEFAULT_TPU_LEVELS]  # outer -> inner
 
@@ -104,11 +138,373 @@ def _validate_topology(field: str, topo: TopologyConstraint | None,
             "least as strict)")
 
 
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _is_num(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool))
+
+
+def _validate_shape(pcs: PodCliqueSet) -> list[str]:
+    """Type-shape sanity pass. Specs decoded through serde always have
+    the right types; direct Python construction (or a future decode bug)
+    may not — admission must REJECT malformed shapes, never crash on
+    them (proven by the fuzz tests). Returns errors; when non-empty the
+    semantic rules are skipped (they assume these shapes)."""
+    from grove_tpu.api.podcliqueset import (AutoScalingConfig,
+                                            PodCliqueSetTemplate,
+                                            PodCliqueTemplate,
+                                            ScalingGroupConfig)
+    errs: list[str] = []
+
+    def bad(path, want, got):
+        errs.append(f"{path}: expected {want}, got {type(got).__name__}")
+
+    if not isinstance(pcs.meta.name, str):
+        bad("metadata.name", "string", pcs.meta.name)
+    spec = pcs.spec
+    if not _is_int(spec.replicas):
+        bad("spec.replicas", "integer", spec.replicas)
+    if spec.auto_scaling is not None and \
+            not isinstance(spec.auto_scaling, AutoScalingConfig):
+        bad("spec.auto_scaling", "AutoScalingConfig", spec.auto_scaling)
+    tmpl = spec.template
+    if not isinstance(tmpl, PodCliqueSetTemplate):
+        bad("spec.template", "PodCliqueSetTemplate", tmpl)
+        return errs
+    if not _is_int(tmpl.priority):
+        bad("spec.template.priority", "integer", tmpl.priority)
+    if tmpl.termination_delay_seconds is not None and \
+            not _is_num(tmpl.termination_delay_seconds):
+        bad("spec.template.termination_delay_seconds", "number",
+            tmpl.termination_delay_seconds)
+    if tmpl.startup_type is not None and \
+            not isinstance(tmpl.startup_type, StartupType):
+        bad("spec.template.startup_type", "StartupType",
+            tmpl.startup_type)
+    for field in ("priority_class", "scheduler_name"):
+        if not isinstance(getattr(tmpl, field), str):
+            bad(f"spec.template.{field}", "string", getattr(tmpl, field))
+    if tmpl.topology is not None and \
+            not isinstance(tmpl.topology, TopologyConstraint):
+        bad("spec.template.topology", "TopologyConstraint", tmpl.topology)
+    if not isinstance(tmpl.cliques, list):
+        bad("spec.template.cliques", "list", tmpl.cliques)
+        return errs
+    if not isinstance(tmpl.scaling_groups, list):
+        bad("spec.template.scaling_groups", "list", tmpl.scaling_groups)
+        return errs
+
+    def check_common(f, obj):
+        if not isinstance(obj.name, str):
+            bad(f"{f}.name", "string", obj.name)
+        if not _is_int(obj.replicas):
+            bad(f"{f}.replicas", "integer", obj.replicas)
+        if obj.min_available is not None and not _is_int(obj.min_available):
+            bad(f"{f}.min_available", "integer", obj.min_available)
+        if obj.auto_scaling is not None:
+            if not isinstance(obj.auto_scaling, AutoScalingConfig):
+                bad(f"{f}.auto_scaling", "AutoScalingConfig",
+                    obj.auto_scaling)
+            else:
+                a = obj.auto_scaling
+                if not _is_int(a.min_replicas) or not _is_int(a.max_replicas):
+                    bad(f"{f}.auto_scaling.min/max_replicas", "integers",
+                        (a.min_replicas, a.max_replicas))
+        if obj.topology is not None and \
+                not isinstance(obj.topology, TopologyConstraint):
+            bad(f"{f}.topology", "TopologyConstraint", obj.topology)
+
+    for i, t in enumerate(tmpl.cliques):
+        f = f"spec.template.cliques[{i}]"
+        if not isinstance(t, PodCliqueTemplate):
+            bad(f, "PodCliqueTemplate", t)
+            continue
+        check_common(f, t)
+        if not _is_int(t.tpu_chips_per_pod):
+            bad(f"{f}.tpu_chips_per_pod", "integer", t.tpu_chips_per_pod)
+        if not isinstance(t.starts_after, list) or any(
+                not isinstance(d, str) for d in t.starts_after):
+            bad(f"{f}.starts_after", "list of strings", t.starts_after)
+        if not isinstance(t.priority_class, str):
+            bad(f"{f}.priority_class", "string", t.priority_class)
+        if t.container is not None and \
+                not isinstance(t.container, ContainerSpec):
+            bad(f"{f}.container", "ContainerSpec", t.container)
+    for i, sg in enumerate(tmpl.scaling_groups):
+        f = f"spec.template.scaling_groups[{i}]"
+        if not isinstance(sg, ScalingGroupConfig):
+            bad(f, "ScalingGroupConfig", sg)
+            continue
+        check_common(f, sg)
+        if not isinstance(sg.clique_names, list) or any(
+                not isinstance(m, str) for m in sg.clique_names):
+            bad(f"{f}.clique_names", "list of strings", sg.clique_names)
+    return errs
+
+
+def _validate_container(field: str, spec: ContainerSpec,
+                        errs: list[str]) -> None:
+    """Container shape rules (reference pod-template/container checks,
+    reshaped for exec-style workloads: argv instead of image+command).
+
+    An empty argv is legal — fake fleets (the KWOK analog) synthesise
+    readiness without executing anything — but whatever IS declared must
+    be executable as given.
+    """
+    if spec is None:
+        errs.append(f"{field}: container must not be null")
+        return
+    if not isinstance(spec.argv, list):
+        errs.append(f"{field}.argv must be a list of strings")
+    else:
+        items_ok = True
+        for i, a in enumerate(spec.argv):
+            if not isinstance(a, str) or a == "":
+                errs.append(f"{field}.argv[{i}] must be a non-empty string "
+                            f"(got {a!r})")
+                items_ok = False
+        if items_ok and spec.argv and not spec.argv[0].strip():
+            errs.append(f"{field}.argv[0] (the executable) is blank")
+    if not isinstance(spec.env, dict):
+        errs.append(f"{field}.env must be a string map")
+    else:
+        for k in spec.env:
+            if not isinstance(k, str) or not _ENV_RE.match(k):
+                errs.append(f"{field}.env: invalid variable name {k!r}")
+            elif k in _RESERVED_ENV:
+                errs.append(
+                    f"{field}.env: {k!r} is reserved (injected rank/"
+                    "discovery contract); overriding it would break "
+                    "multi-host bootstrap inside the pod")
+            if not isinstance(spec.env.get(k), str):
+                errs.append(f"{field}.env[{k!r}] must be a string")
+    if not isinstance(spec.workdir, str):
+        errs.append(f"{field}.workdir must be a string")
+    elif spec.workdir and not spec.workdir.startswith("/"):
+        errs.append(f"{field}.workdir must be an absolute path, got "
+                    f"{spec.workdir!r}")
+    if not isinstance(spec.readiness_file, str):
+        errs.append(f"{field}.readiness_file must be a string")
+    elif spec.readiness_file:
+        parts = spec.readiness_file.split("/")
+        if ".." in parts:
+            errs.append(f"{field}.readiness_file must not contain '..' "
+                        f"(path escape), got {spec.readiness_file!r}")
+
+
+def _digits(n: int) -> int:
+    return len(str(max(0, n)))
+
+
+def _clique_max_replicas(t) -> int:
+    """Largest replica count a clique can reach (autoscaling ceiling)."""
+    if t.auto_scaling is not None:
+        return max(t.replicas, t.auto_scaling.max_replicas)
+    return t.replicas
+
+
+def _sg_max_replicas(sg) -> int:
+    if sg.auto_scaling is not None:
+        return max(sg.replicas, sg.auto_scaling.max_replicas)
+    return sg.replicas
+
+
+def _validate_name_budgets(pcs: PodCliqueSet, errs: list[str]) -> None:
+    """Generated child names must fit the DNS-label budget at the WORST
+    CASE the spec allows (max replica indices incl. autoscaling ceilings).
+
+    A 52-char user name passes the name rule yet composes into
+    <pcs>-<r>-<pcsg>-<j>-<clique>-<i> — validation must fail the create,
+    not the first scale-out (reference validates generated-name budgets
+    for the same reason).
+    """
+    tmpl = pcs.spec.template
+    pcs_len = len(pcs.meta.name)
+    max_pcs_replicas = pcs.spec.replicas
+    if pcs.spec.auto_scaling is not None:
+        # The service-level autoscaler scales spec.replicas to this.
+        max_pcs_replicas = max(max_pcs_replicas,
+                               pcs.spec.auto_scaling.max_replicas)
+    r_digits = _digits(max_pcs_replicas - 1)
+    in_group = {name: sg for sg in tmpl.scaling_groups
+                for name in sg.clique_names}
+
+    def check(what: str, length: int) -> None:
+        if length > MAX_GENERATED_NAME:
+            errs.append(
+                f"{what} would generate a {length}-char name "
+                f"(max {MAX_GENERATED_NAME}); shorten the PodCliqueSet/"
+                "clique/scaling-group names or lower replica ceilings")
+
+    # headless service: <pcs>-<r>-svc
+    check("headless service", pcs_len + 1 + r_digits + 1 + 3)
+    for t in tmpl.cliques:
+        pod_digits = _digits(_clique_max_replicas(t) - 1)
+        sg = in_group.get(t.name)
+        if sg is None:
+            # <pcs>-<r>-<clique>-<i>
+            check(f"clique {t.name!r} pods",
+                  pcs_len + 1 + r_digits + 1 + len(t.name) + 1 + pod_digits)
+        else:
+            j_digits = _digits(_sg_max_replicas(sg) - 1)
+            # <pcs>-<r>-<sg>-<j>-<clique>-<i>
+            check(f"clique {t.name!r} pods (in scaling group {sg.name!r})",
+                  pcs_len + 1 + r_digits + 1 + len(sg.name) + 1 + j_digits
+                  + 1 + len(t.name) + 1 + pod_digits)
+
+
+_MAX_CHIPS_PER_HOST = max(g.chips_per_host for g in TPU_GENERATIONS.values())
+_MAX_SLICE_CHIPS = max(g.max_slice_chips for g in TPU_GENERATIONS.values())
+
+
+def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
+    """Chip requests must be physically realisable (topology/tpu.py):
+    a pod lands on ONE host, so per-pod chips cannot exceed any
+    generation's chips-per-host and must be a power of two (sub-host
+    granularity is 1/2/4); a slice-packed gang cannot need more chips
+    than the largest slice any generation builds.
+    """
+    tmpl = pcs.spec.template
+    per_gen = ", ".join(f"{g.name}={g.chips_per_host}/host"
+                        for g in TPU_GENERATIONS.values())
+    for t in tmpl.cliques:
+        n = t.tpu_chips_per_pod
+        if n <= 0:
+            continue
+        f = f"clique {t.name!r}"
+        if n > _MAX_CHIPS_PER_HOST:
+            errs.append(
+                f"{f}: tpu_chips_per_pod={n} exceeds every TPU "
+                f"generation's host ({per_gen}); multi-host groups are "
+                "expressed as replicas (one pod per host), not bigger pods")
+        elif n & (n - 1):
+            errs.append(f"{f}: tpu_chips_per_pod={n} is not a power of two "
+                        "(host chip partitions are 1/2/4)")
+
+    def gang_chips(cliques, replicas_of) -> int:
+        return sum(t.tpu_chips_per_pod * replicas_of(t)
+                   for t in cliques if t.tpu_chips_per_pod > 0)
+
+    by_name = {t.name: t for t in tmpl.cliques}
+    in_group = {name for sg in tmpl.scaling_groups for name in sg.clique_names}
+
+    def packed_to_slice(topo: TopologyConstraint | None) -> bool:
+        eff = topo or tmpl.topology
+        # Unknown levels are reported by _validate_topology; here they
+        # just mean "cannot assess the slice budget" — don't crash on
+        # the same typo twice.
+        return bool(eff and eff.required
+                    and eff.pack_level in _LEVELS
+                    and _level_index(eff.pack_level)
+                    >= _level_index("slice"))
+
+    standalone = [t for t in tmpl.cliques if t.name not in in_group]
+    for t in standalone:
+        if packed_to_slice(t.topology):
+            total = t.tpu_chips_per_pod * _clique_max_replicas(t)
+            if total > _MAX_SLICE_CHIPS:
+                errs.append(
+                    f"clique {t.name!r}: slice-packed gang needs {total} "
+                    f"chips; no TPU generation builds a slice that large "
+                    f"(max {_MAX_SLICE_CHIPS})")
+    for sg in tmpl.scaling_groups:
+        members = [by_name[m] for m in sg.clique_names if m in by_name]
+        if packed_to_slice(sg.topology):
+            total = gang_chips(members, _clique_max_replicas)
+            if total > _MAX_SLICE_CHIPS:
+                errs.append(
+                    f"scaling group {sg.name!r}: one slice-packed replica "
+                    f"needs {total} chips; no TPU generation builds a "
+                    f"slice that large (max {_MAX_SLICE_CHIPS})")
+
+
+# ---- update immutability table (reference podcliqueset.go:662-698) ----
+# Explicit per-field rules: (human path, getter). Structure fields whose
+# change cannot be reconciled by either rolling-update mode.
+
+_IMMUTABLE_TEMPLATE_FIELDS = [
+    ("spec.template.startup_type", lambda t: t.startup_type),
+    ("spec.template.headless_service",
+     lambda t: (t.headless_service.publish_not_ready_addresses
+                if t.headless_service else None)),
+    ("spec.template.scheduler_name", lambda t: t.scheduler_name),
+    ("spec.template.topology",
+     lambda t: (t.topology.pack_level, t.topology.required,
+                t.topology.spread_level) if t.topology else None),
+]
+
+_IMMUTABLE_CLIQUE_FIELDS = [
+    ("tpu_chips_per_pod", lambda t: t.tpu_chips_per_pod),
+    ("starts_after", lambda t: tuple(t.starts_after)),
+    ("topology", lambda t: (t.topology.pack_level, t.topology.required,
+                            t.topology.spread_level) if t.topology else None),
+]
+
+_IMMUTABLE_SG_FIELDS = [
+    ("clique_names", lambda sg: tuple(sg.clique_names)),
+    ("min_available", lambda sg: sg.min_available),
+    ("topology", lambda sg: (sg.topology.pack_level, sg.topology.required,
+                             sg.topology.spread_level) if sg.topology else None),
+]
+
+
+def _validate_update(pcs: PodCliqueSet, old: PodCliqueSet,
+                     errs: list[str]) -> None:
+    tmpl, old_tmpl = pcs.spec.template, old.spec.template
+    names = [t.name for t in tmpl.cliques]
+    if [t.name for t in old_tmpl.cliques] != names:
+        errs.append("clique set is immutable (got a different clique "
+                    "name list); create a new PodCliqueSet instead")
+    for path, get in _IMMUTABLE_TEMPLATE_FIELDS:
+        if get(old_tmpl) != get(tmpl):
+            if path.endswith("startup_type"):
+                # Both sides have been through defaulting, so a mismatch
+                # can come from inference (startup_type left unset, edges
+                # added or removed) — say so instead of blaming a field
+                # the user never touched.
+                msg = (f"startup_type is immutable (stored "
+                       f"{get(old_tmpl).value if get(old_tmpl) else None}, "
+                       f"update resolves to "
+                       f"{get(tmpl).value if get(tmpl) else None})")
+                if tmpl.startup_type is StartupType.EXPLICIT:
+                    msg += ("; adding starts_after edges infers "
+                            "CliqueStartupTypeExplicit — set startup_type "
+                            "explicitly on create to use edges later")
+                errs.append(msg)
+            else:
+                errs.append(f"{path} is immutable "
+                            f"(was {get(old_tmpl)!r}, got {get(tmpl)!r})")
+    old_cliques = {t.name: t for t in old_tmpl.cliques}
+    for t in tmpl.cliques:
+        o = old_cliques.get(t.name)
+        if o is None:
+            continue
+        for path, get in _IMMUTABLE_CLIQUE_FIELDS:
+            if get(o) != get(t):
+                errs.append(f"clique {t.name!r}: {path} is immutable "
+                            f"(was {get(o)!r}, got {get(t)!r})")
+    old_sgs = {sg.name: sg for sg in old_tmpl.scaling_groups}
+    if set(old_sgs) != {sg.name for sg in tmpl.scaling_groups}:
+        errs.append("scaling group set is immutable (names changed)")
+    for sg in tmpl.scaling_groups:
+        o = old_sgs.get(sg.name)
+        if o is None:
+            continue
+        for path, get in _IMMUTABLE_SG_FIELDS:
+            if get(o) != get(sg):
+                errs.append(f"scaling group {sg.name!r}: {path} is "
+                            f"immutable (was {get(o)!r}, got {get(sg)!r})")
+
+
 def validate_podcliqueset(pcs: PodCliqueSet,
                           registry: Registry | None = None,
                           old: PodCliqueSet | None = None) -> list[str]:
     """Return all problems (empty == admitted)."""
-    errs: list[str] = []
+    errs = _validate_shape(pcs)
+    if errs:
+        return errs
     if not _NAME_RE.match(pcs.meta.name):
         errs.append(f"metadata.name {pcs.meta.name!r} must be DNS-label-like "
                     "(lowercase alphanumerics and '-', <= 52 chars)")
@@ -141,6 +537,10 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                         f"[1, {t.replicas}]")
         if t.tpu_chips_per_pod < 0:
             errs.append(f"{f}: tpu_chips_per_pod must be >= 0")
+        if t.priority_class and not _NAME_RE.match(t.priority_class):
+            errs.append(f"{f}: invalid priority_class name "
+                        f"{t.priority_class!r}")
+        _validate_container(f + ".container", t.container, errs)
         if t.auto_scaling is not None:
             a = t.auto_scaling
             if a.min_replicas < 1:
@@ -179,6 +579,7 @@ def validate_podcliqueset(pcs: PodCliqueSet,
             errs.append(f"starts_after cycle detected: {sorted(scc)}")
 
     # scaling groups
+    clique_by_name = {t.name: t for t in tmpl.cliques}
     sg_names = [sg.name for sg in tmpl.scaling_groups]
     if len(set(sg_names)) != len(sg_names):
         errs.append(f"scaling group names must be unique: {sg_names}")
@@ -203,6 +604,13 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                             f"{seen_members[m]!r}")
             else:
                 seen_members[m] = sg.name
+                # Members scale with the group — a per-member autoscaler
+                # would fight the PCSG one over the same replica field.
+                if clique_by_name[m].auto_scaling is not None:
+                    errs.append(
+                        f"{f}: member clique {m!r} declares its own "
+                        "auto_scaling; scaling-group members scale only "
+                        "through the group's auto_scaling")
         if sg.auto_scaling is not None:
             a = sg.auto_scaling
             if a.min_replicas < 1:
@@ -220,34 +628,19 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     if tmpl.termination_delay_seconds is not None \
             and tmpl.termination_delay_seconds < 0:
         errs.append("termination_delay_seconds must be >= 0")
+    if not (-1_000_000 <= tmpl.priority <= 1_000_000):
+        errs.append(f"spec.template.priority {tmpl.priority} outside "
+                    "[-1000000, 1000000]")
+    if tmpl.priority_class and not _NAME_RE.match(tmpl.priority_class):
+        errs.append(f"invalid priority_class name {tmpl.priority_class!r}")
+
+    _validate_name_budgets(pcs, errs)
+    _validate_chips(pcs, errs)
 
     # update immutability (reference validation: structure is immutable,
     # content rolls)
     if old is not None:
-        old_tmpl = old.spec.template
-        if [t.name for t in old_tmpl.cliques] != names:
-            errs.append("clique set is immutable (got a different clique "
-                        "name list); create a new PodCliqueSet instead")
-        if old_tmpl.startup_type != tmpl.startup_type:
-            # Both sides have been through defaulting, so a mismatch can
-            # come from inference (startup_type left unset, edges added or
-            # removed) — say so instead of blaming a field the user never
-            # touched.
-            msg = (f"startup_type is immutable (stored "
-                   f"{old_tmpl.startup_type.value if old_tmpl.startup_type else None}, "
-                   f"update resolves to "
-                   f"{tmpl.startup_type.value if tmpl.startup_type else None})")
-            if tmpl.startup_type is StartupType.EXPLICIT:
-                msg += ("; adding starts_after edges infers "
-                        "CliqueStartupTypeExplicit — set startup_type "
-                        "explicitly on create to use edges later")
-            errs.append(msg)
-        old_sg = {sg.name: list(sg.clique_names)
-                  for sg in old_tmpl.scaling_groups}
-        new_sg = {sg.name: list(sg.clique_names)
-                  for sg in tmpl.scaling_groups}
-        if old_sg != new_sg:
-            errs.append("scaling group membership is immutable")
+        _validate_update(pcs, old, errs)
 
     # scheduler-specific validation (reference backend.ValidatePodCliqueSet)
     if registry is not None:
