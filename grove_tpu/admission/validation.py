@@ -435,8 +435,11 @@ _IMMUTABLE_TEMPLATE_FIELDS = [
                 t.topology.spread_level) if t.topology else None),
 ]
 
+# tpu_chips_per_pod is deliberately MUTABLE: a chip-count change is a
+# structural update the replica-recreation rollout reconciles (gangs are
+# re-planned); forbidding it would force delete-and-recreate for a
+# resource resize.
 _IMMUTABLE_CLIQUE_FIELDS = [
-    ("tpu_chips_per_pod", lambda t: t.tpu_chips_per_pod),
     ("starts_after", lambda t: tuple(t.starts_after)),
     ("topology", lambda t: (t.topology.pack_level, t.topology.required,
                             t.topology.spread_level) if t.topology else None),

@@ -36,33 +36,57 @@ def owner_ref(obj) -> OwnerReference:
     return OwnerReference(kind=obj.KIND, name=obj.meta.name, uid=obj.meta.uid)
 
 
-def generation_hash(pcs: PodCliqueSet) -> str:
-    """Hash of the pod-shaping template (rolling-update trigger; reference
-    reconcilespec.go:110-123).
+def _hash_neutral_template(pcs: PodCliqueSet):
+    """Template copy with every scaling/behavioral knob zeroed.
 
-    Fields that never reach a Pod spec are excluded — bumping scheduling
-    priority must not restart the workload.
+    Scaling (replica counts, availability floors, autoscaler bounds) and
+    lifecycle tuning (priority, termination delay) are NOT updates — a
+    kubectl-scale analog must never restart the workload (k8s excludes
+    .spec.replicas from the pod-template hash for the same reason).
     """
     from grove_tpu.api.serde import clone
     tmpl = clone(pcs.spec.template)
     tmpl.priority = 0
-    return compute_hash(tmpl)
+    tmpl.termination_delay_seconds = None
+    for t in tmpl.cliques:
+        t.replicas = 0
+        t.min_available = None
+        t.auto_scaling = None
+    for sg in tmpl.scaling_groups:
+        sg.replicas = 0
+        sg.auto_scaling = None
+        # Immutable at admission today, but neutralized anyway so the
+        # "floors are not updates" contract holds even if that rule is
+        # ever relaxed.
+        sg.min_available = None
+    return tmpl
+
+
+def generation_hash(pcs: PodCliqueSet) -> str:
+    """Hash of the pod-shaping template (rolling-update trigger; reference
+    reconcilespec.go:110-123). Scaling knobs are excluded (see
+    _hash_neutral_template) — only changes that alter what runs in the
+    pods (or how gangs are shaped) trigger an update.
+    """
+    return compute_hash(_hash_neutral_template(pcs))
 
 
 def structure_hash(pcs: PodCliqueSet) -> str:
-    """Hash of the gang-shaping structure only (clique set, replica
-    counts, scaling groups, topology, ordering). Pod-shaping fields
-    (the container) are excluded: when ONLY those change, each PodClique
-    rolls its own pods one at a time in place (reference
+    """Hash of the gang-shaping structure only (clique set, chip counts,
+    scaling-group membership, topology, ordering). Pod-shaping fields
+    (container, priority_class) are excluded: when ONLY those change,
+    each PodClique rolls its own pods one at a time in place (reference
     podclique/components/pod/rollingupdate.go:87-227) — tearing down
     whole PCS replicas for an image tweak would destroy healthy gangs.
+    Structure changes (e.g. tpu_chips_per_pod, which re-plans gangs)
+    keep the replica-recreation path.
     """
     from grove_tpu.api.core import ContainerSpec
-    from grove_tpu.api.serde import clone
-    tmpl = clone(pcs.spec.template)
-    tmpl.priority = 0
+    tmpl = _hash_neutral_template(pcs)
+    tmpl.priority_class = ""
     for t in tmpl.cliques:
         t.container = ContainerSpec()
+        t.priority_class = ""
     return compute_hash(tmpl)
 
 

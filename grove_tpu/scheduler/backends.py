@@ -20,7 +20,7 @@ import time
 from collections import defaultdict
 from typing import Optional
 
-from grove_tpu.api import Node, Pod, PodGang, constants as c
+from grove_tpu.api import Node, Pod, PodGang, constants as c, namegen
 from grove_tpu.api.meta import Condition, is_condition_true, set_condition
 from grove_tpu.api.podcliqueset import PodCliqueSet
 from grove_tpu.api.podgang import PodGangPhase
@@ -64,6 +64,7 @@ def build_host_views(client: Client, namespace: str | None = None,
             domains={domain: labels.get(label, "")
                      for domain, label in level_labels.items()},
             labels=dict(labels),
+            total_chips=node.status.allocatable_chips,
         ))
     return views
 
@@ -257,39 +258,62 @@ class GangBackend:
                 return PodRequest(p.meta.name, p.spec.tpu_chips,
                                   dict(p.spec.node_selector))
 
-            plan_fn = None
-            if any(grp.topology is not None and grp.topology.pack_level
-                   for grp in gang.spec.groups):
-                # Per-group constraints: hierarchical planning (each
-                # constrained group packed into its own sub-domain).
-                by_pod = {p.meta.name: p for p in bindable}
-                greqs = []
-                grouped_names: set[str] = set()
-                for grp in gang.spec.groups:
-                    pods_in = [by_pod[n] for n in grp.pod_names
-                               if n in by_pod]
-                    grouped_names.update(p.meta.name for p in pods_in)
-                    greqs.append(GroupRequest(
-                        [req(p) for p in pods_in],
-                        grp.topology.pack_level if grp.topology else "",
-                        grp.topology.required if grp.topology else True))
-                stray = [req(p) for p in bindable
-                         if p.meta.name not in grouped_names]
-                if stray:
-                    greqs.append(GroupRequest(stray))
-                plan_fn = lambda hv: plan_gang_grouped(
-                    greqs, hv, pack_level=pack_level, required=required,
-                    prefer_slice=self._reuse_slice(gang),
-                    spread_penalty=spread)
-            else:
-                requests = [req(p) for p in bindable]
-                plan_fn = lambda hv: plan_gang(
+            grouped = any(grp.topology is not None and grp.topology.pack_level
+                          for grp in gang.spec.groups)
+
+            def make_plan_fn(pods: list[Pod]):
+                if grouped:
+                    # Per-group constraints: hierarchical planning (each
+                    # constrained group packed into its own sub-domain).
+                    by_pod = {p.meta.name: p for p in pods}
+                    greqs = []
+                    grouped_names: set[str] = set()
+                    for grp in gang.spec.groups:
+                        pods_in = [by_pod[n] for n in grp.pod_names
+                                   if n in by_pod]
+                        grouped_names.update(p.meta.name for p in pods_in)
+                        greqs.append(GroupRequest(
+                            [req(p) for p in pods_in],
+                            grp.topology.pack_level if grp.topology else "",
+                            grp.topology.required if grp.topology else True))
+                    stray = [req(p) for p in pods
+                             if p.meta.name not in grouped_names]
+                    if stray:
+                        greqs.append(GroupRequest(stray))
+                    return lambda hv: plan_gang_grouped(
+                        greqs, hv, pack_level=pack_level, required=required,
+                        prefer_slice=self._reuse_slice(gang),
+                        spread_penalty=spread)
+                requests = [req(p) for p in pods]
+                return lambda hv: plan_gang(
                     requests, hv, pack_level=pack_level, required=required,
                     prefer_slice=self._reuse_slice(gang),
                     spread_penalty=spread)
+
+            plan_fn = make_plan_fn(bindable)
+            to_bind = bindable
             plan = plan_fn(hosts)
+            if plan is None and not self._try_preempt_for(gang, plan_fn,
+                                                          hosts):
+                # Min-floor fallback (reference GS5 semantics), tried
+                # only when preemption cannot seat the FULL gang: start
+                # with min_replicas per group; surplus pods stay pending
+                # and join via the straggler path when capacity appears.
+                # Candidate domains are restricted to those whose TOTAL
+                # capacity could hold the full gang — a required pack
+                # anchors stragglers to the floor's domain, and binding
+                # into an undersized one would cap the gang forever.
+                floor = self._floor_subset(gang, bindable)
+                if floor is not None and len(floor) < len(bindable):
+                    full_hosts = self._full_headroom_hosts(
+                        gang, bindable, hosts)
+                    floor_plan = make_plan_fn(floor)(full_hosts)
+                    if floor_plan is not None:
+                        plan, to_bind = floor_plan, floor
+            elif plan is None:
+                preempted = True
             if plan is not None:
-                self._bind(bindable, plan.assignments)
+                self._bind(to_bind, plan.assignments)
                 gang.status.assigned_slice = plan.slice_name
                 gang.status.placement_score = plan.score
                 placed_any = True
@@ -297,17 +321,19 @@ class GangBackend:
                 GLOBAL_METRICS.inc("grove_gang_placements_total")
                 self.recorder.event(
                     gang, "Normal", "GangPlaced",
-                    f"{len(bindable)} pods onto "
+                    f"{len(to_bind)} pods onto "
                     f"{plan.slice_name or 'multiple domains'} "
-                    f"(score {plan.score:.2f})")
+                    f"(score {plan.score:.2f})"
+                    + (f"; {len(bindable) - len(to_bind)} surplus pending"
+                       if len(to_bind) < len(bindable) else ""))
             else:
+                # Preemption was already attempted above (one victim per
+                # pass); nothing fit and no floor was possible.
                 self.recorder.event(
                     gang, "Warning", "GangUnschedulable",
                     f"no {pack_level or 'slice'} domain fits "
                     f"{len(bindable)} pods "
                     f"({sum(p.spec.tpu_chips for p in bindable)} chips)")
-                if self._try_preempt_for(gang, plan_fn, hosts):
-                    preempted = True
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate with their siblings,
@@ -330,6 +356,54 @@ class GangBackend:
 
         self._update_status(gang, initialized, placed_any)
         return placed_any, preempted
+
+    def _floor_subset(self, gang: PodGang,
+                      bindable: list[Pod]) -> list[Pod] | None:
+        """Per-group min_replicas subset of ``bindable`` (lowest pod
+        INDICES first — a JAX process group expects the contiguous low
+        worker ids, coordinator at rank 0); pods outside any group are
+        kept whole. None when some group cannot even meet its floor."""
+        def pod_index(p: Pod) -> int:
+            try:
+                return namegen.pod_index_from_name(p.meta.name)
+            except ValueError:
+                return 1 << 30
+        by_pod = {p.meta.name: p for p in bindable}
+        subset: list[Pod] = []
+        claimed: set[str] = set()
+        for grp in gang.spec.groups:
+            pods_in = [by_pod[n] for n in grp.pod_names if n in by_pod]
+            if len(pods_in) < grp.min_replicas:
+                return None
+            pods_in.sort(key=pod_index)
+            subset.extend(pods_in[:grp.min_replicas])
+            claimed.update(grp.pod_names)
+        subset.extend(p for p in bindable if p.meta.name not in claimed)
+        return subset
+
+    def _full_headroom_hosts(self, gang: PodGang, bindable: list[Pod],
+                             hosts: list[HostView]) -> list[HostView]:
+        """Hosts whose pack-level domain could hold the FULL gang by
+        total capacity. Only meaningful under a required pack (which
+        anchors later stragglers to the floor's domain); otherwise all
+        hosts qualify."""
+        topo = gang.spec.topology
+        if topo is None or not topo.required or not topo.pack_level:
+            return hosts
+        level_label = self._level_labels.get(topo.pack_level)
+        if level_label is None:
+            return hosts
+        need = sum(p.spec.tpu_chips for p in bindable)
+        # Physical capacity: ALL nodes count, including cordoned or
+        # not-ready ones — they are temporarily out, not absent, and the
+        # question is whether the domain could EVER hold the full gang.
+        total_by_domain: dict[str, int] = defaultdict(int)
+        for node in self.client.list(Node, self.namespace):
+            total_by_domain[node.meta.labels.get(level_label, "")] += \
+                node.status.allocatable_chips
+        return [h for h in hosts
+                if total_by_domain[h.domains.get(topo.pack_level, "")]
+                >= need]
 
     def _try_preempt_for(self, gang: PodGang, plan_fn,
                          hosts: list[HostView]) -> bool:

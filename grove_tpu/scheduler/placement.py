@@ -32,6 +32,10 @@ class HostView:
     free_chips: int
     domains: dict[str, str] = dataclasses.field(default_factory=dict)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Full allocatable capacity (free + in use): lets planners judge
+    # whether a domain could EVER hold a workload, not just whether it
+    # can right now (min-floor anchoring must avoid undersized domains).
+    total_chips: int = 0
 
     @property
     def slice_name(self) -> str:

@@ -1,0 +1,342 @@
+"""The gang-scheduling scaling matrix — analog of the reference's
+GS1-GS10 suite (e2e/tests/gang_scheduling_test.go:32-886): capacity is
+constrained by cordoning nodes, workloads deploy all-pending with zero
+partial binds (gang atomicity), capacity is released, everything places.
+On top: the scaling combinations — PCSG scale-out, PCS scale-out, both
+combined, scale-while-pending — and the min-replica variants.
+
+Arithmetic: 2x4 v5e slices = 8 chips over 2 hosts; every clique instance
+is 2 pods x 4 chips = exactly one slice, so slices-needed counts are
+exact. wl(): standalone clique 'a' (1 slice) + scaling group 'x' whose
+every replica is clique 'b' (1 slice each).
+"""
+
+from __future__ import annotations
+
+import time
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    TopologyConstraint,
+)
+
+# Multi-slice workloads: the gang packs at pool level, each clique
+# instance is slice-resident (admission's default would slice-pack the
+# WHOLE template, which a >1-slice workload can never satisfy).
+POOL = TopologyConstraint(pack_level="pool", required=True)
+SLICE = TopologyConstraint(pack_level="slice", required=True)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+PODS_PER_SLICE = 2
+
+
+def make_cluster(n_slices: int):
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=n_slices)])
+    return new_cluster(fleet=fleet)
+
+
+def slice_nodes(cl, *slice_idx: int) -> list[str]:
+    tags = [f"slice-{i}-" for i in slice_idx]
+    return [n.meta.name for n in cl.client.list(Node)
+            if any(t in n.meta.name for t in tags)]
+
+
+def set_cordon(cl, names, value: bool) -> None:
+    for name in names:
+        node = cl.client.get(Node, name)
+        node.spec.unschedulable = value
+        cl.client.update(node)
+
+
+def wl(name: str, sg_replicas: int = 1, sg_min: int | None = None,
+       pcs_replicas: int = 1):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=pcs_replicas,
+                              template=PodCliqueSetTemplate(
+            topology=POOL,
+            cliques=[
+                PodCliqueTemplate(name="a", replicas=2, tpu_chips_per_pod=4,
+                                  topology=SLICE,
+                                  container=ContainerSpec(argv=["x"])),
+                PodCliqueTemplate(name="b", replicas=2, tpu_chips_per_pod=4,
+                                  topology=SLICE,
+                                  container=ContainerSpec(argv=["x"])),
+            ],
+            scaling_groups=[ScalingGroupConfig(
+                name="x", clique_names=["b"], replicas=sg_replicas,
+                min_available=sg_min)],
+        )))
+
+
+def pods_of(cl, name):
+    return [p for p in cl.client.list(Pod, selector={c.LABEL_PCS_NAME: name})
+            if p.meta.deletion_timestamp is None]
+
+
+def bound(cl, name):
+    return [p for p in pods_of(cl, name) if p.status.node_name]
+
+
+def assert_no_partial_binds(cl, name):
+    """Gang atomicity: every gang is either fully bound or fully unbound."""
+    by_gang: dict[str, list[bool]] = {}
+    for p in pods_of(cl, name):
+        g = p.meta.labels.get(c.LABEL_PODGANG_NAME, "?")
+        by_gang.setdefault(g, []).append(bool(p.status.node_name))
+    for g, states in by_gang.items():
+        assert all(states) or not any(states), (
+            f"gang {g} partially bound: {states}")
+
+
+def gang_scheduled(cl, gang_name) -> bool:
+    try:
+        g = cl.client.get(PodGang, gang_name)
+    except Exception:
+        return False
+    return is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+
+
+def test_gs1_full_replicas_atomic_then_placed():
+    """GS1: capacity short by one slice → the whole workload pends with
+    zero partial binds; uncordon → everything places, one slice per
+    clique instance."""
+    cl = make_cluster(3)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 2), True)
+        # Needs 3 slices (a + 2 gang-guaranteed sg replicas); 2 available.
+        cl.client.create(wl("wl1", sg_replicas=2, sg_min=2))
+        wait_for(lambda: len(pods_of(cl, "wl1")) == 6, desc="pods created")
+        time.sleep(0.6)
+        assert bound(cl, "wl1") == [], "must be all-pending"
+        assert_no_partial_binds(cl, "wl1")
+        assert not gang_scheduled(cl, "wl1-0")
+
+        set_cordon(cl, slice_nodes(cl, 2), False)
+        wait_for(lambda: len(bound(cl, "wl1")) == 6, timeout=10.0,
+                 desc="placed after uncordon")
+        slices = {p.status.node_name.rsplit("-w", 1)[0]
+                  for p in pods_of(cl, "wl1")}
+        assert len(slices) == 3
+
+
+def test_gs2_pcsg_scale_out_under_pressure():
+    """GS2: scale the PCSG while capacity is exhausted — new scaled gang
+    pends fully, the running pods are untouched; free capacity → places."""
+    cl = make_cluster(3)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 2), True)
+        cl.client.create(wl("wl2", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(bound(cl, "wl2")) == 4, desc="base up")
+        before = {p.meta.name: p.meta.uid for p in pods_of(cl, "wl2")}
+
+        live = cl.client.get(PodCliqueSet, "wl2")
+        live.spec.template.scaling_groups[0].replicas = 2
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl2")) == 6,
+                 desc="scaled pods created")
+        time.sleep(0.6)
+        assert len(bound(cl, "wl2")) == 4, "scaled gang must wait"
+        assert_no_partial_binds(cl, "wl2")
+        after = {p.meta.name: p.meta.uid for p in pods_of(cl, "wl2")}
+        assert all(after[n] == before[n] for n in before), \
+            "scale-out must not touch running pods"
+
+        set_cordon(cl, slice_nodes(cl, 2), False)
+        wait_for(lambda: len(bound(cl, "wl2")) == 6, timeout=10.0,
+                 desc="scaled gang placed")
+
+
+def test_gs3_pcs_scale_out_under_pressure():
+    """GS3: scale PCS replicas — the new replica's base gang pends
+    atomically; capacity frees → it places and becomes available."""
+    cl = make_cluster(4)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 2, 3), True)
+        cl.client.create(wl("wl3", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(bound(cl, "wl3")) == 4, desc="replica 0 up")
+
+        live = cl.client.get(PodCliqueSet, "wl3")
+        live.spec.replicas = 2
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl3")) == 8,
+                 desc="replica 1 pods created")
+        time.sleep(0.6)
+        assert len(bound(cl, "wl3")) == 4
+        assert_no_partial_binds(cl, "wl3")
+        assert not gang_scheduled(cl, "wl3-1")
+
+        set_cordon(cl, slice_nodes(cl, 2, 3), False)
+        wait_for(lambda: len(bound(cl, "wl3")) == 8, timeout=10.0,
+                 desc="replica 1 placed")
+        wait_for(lambda: cl.client.get(
+            PodCliqueSet, "wl3").status.available_replicas == 2,
+            timeout=10.0, desc="both replicas available")
+
+
+def test_gs4_pcs_and_pcsg_scaling_combined():
+    """GS4: scale BOTH the PCS and the PCSG; per-replica scaled gangs and
+    the new base gang all form, each atomically."""
+    cl = make_cluster(6)
+    with cl:
+        cl.client.create(wl("wl4", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(bound(cl, "wl4")) == 4, desc="base up")
+
+        live = cl.client.get(PodCliqueSet, "wl4")
+        live.spec.replicas = 2
+        live.spec.template.scaling_groups[0].replicas = 2
+        cl.client.update(live)
+        # 2 replicas x (a + 2 sg replicas) x 2 pods = 12 pods
+        wait_for(lambda: len(bound(cl, "wl4")) == 12, timeout=15.0,
+                 desc="all gangs placed")
+        gangs = cl.client.list(PodGang, selector={c.LABEL_PCS_NAME: "wl4"})
+        assert {g.meta.name for g in gangs} == {
+            "wl4-0", "wl4-1", "wl4-0-x-1", "wl4-1-x-1"}
+        assert_no_partial_binds(cl, "wl4")
+
+
+def test_gs5_min_available_subset_starts():
+    """GS5: clique min_available < replicas — the gang places when only
+    the floor fits; surplus pods pend unbound until capacity frees."""
+    cl = make_cluster(3)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 1, 2), True)
+        pcs = PodCliqueSet(
+            meta=new_meta("wl5"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                topology=POOL,
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=3, min_available=2,
+                    tpu_chips_per_pod=4,
+                    container=ContainerSpec(argv=["x"]))])))
+        cl.client.create(pcs)
+        wait_for(lambda: len(bound(cl, "wl5")) == 2, timeout=10.0,
+                 desc="floor placed")
+        assert gang_scheduled(cl, "wl5-0")
+        assert len(pods_of(cl, "wl5")) == 3
+
+        set_cordon(cl, slice_nodes(cl, 1, 2), False)
+        wait_for(lambda: len(bound(cl, "wl5")) == 3, timeout=10.0,
+                 desc="surplus placed when capacity freed")
+
+
+def test_gs6_elastic_gangs_never_disturb_base():
+    """GS6: PCSG replicas beyond min_available are elastic — scaling the
+    group higher under pressure leaves base + running elastics intact."""
+    cl = make_cluster(3)
+    with cl:
+        cl.client.create(wl("wl6", sg_replicas=2, sg_min=1))
+        wait_for(lambda: len(bound(cl, "wl6")) == 6, desc="base+elastic up")
+        before = {p.meta.name: p.meta.uid for p in bound(cl, "wl6")}
+
+        live = cl.client.get(PodCliqueSet, "wl6")
+        live.spec.template.scaling_groups[0].replicas = 4
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl6")) == 10,
+                 desc="elastic pods created")
+        time.sleep(0.6)
+        assert len(bound(cl, "wl6")) == 6
+        assert_no_partial_binds(cl, "wl6")
+        after = {p.meta.name: p.meta.uid for p in bound(cl, "wl6")}
+        assert after == before
+
+
+def test_gs7_freed_capacity_admits_exactly_one_elastic():
+    """GS7 (advanced): two elastic gangs pending, one slice frees →
+    exactly one gang places (atomically); the other stays fully unbound."""
+    cl = make_cluster(3)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 2), True)
+        cl.client.create(wl("wl7", sg_replicas=3, sg_min=1))
+        wait_for(lambda: len(bound(cl, "wl7")) == 4, desc="base up")
+        time.sleep(0.6)
+        assert len(pods_of(cl, "wl7")) == 8  # a + 3 sg replicas, 2 pods each
+
+        set_cordon(cl, slice_nodes(cl, 2), False)  # room for ONE gang
+        wait_for(lambda: len(bound(cl, "wl7")) == 6, timeout=10.0,
+                 desc="one elastic admitted")
+        time.sleep(0.6)
+        assert len(bound(cl, "wl7")) == 6
+        assert_no_partial_binds(cl, "wl7")
+        scheduled = [g for g in ("wl7-0-x-1", "wl7-0-x-2")
+                     if gang_scheduled(cl, g)]
+        assert len(scheduled) == 1, scheduled
+
+
+def test_gs9_pcs_scale_up_while_first_replica_pending():
+    """GS9/GS10: scale the PCS while replica 0 is still pending — both
+    replicas pend with no partial binds anywhere; capacity arrives →
+    both place independently."""
+    cl = make_cluster(4)
+    with cl:
+        all_nodes = [n.meta.name for n in cl.client.list(Node)]
+        set_cordon(cl, all_nodes, True)
+        cl.client.create(wl("wl9", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(pods_of(cl, "wl9")) == 4, desc="pods created")
+        time.sleep(0.4)
+        assert bound(cl, "wl9") == []
+
+        live = cl.client.get(PodCliqueSet, "wl9")
+        live.spec.replicas = 2
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl9")) == 8,
+                 desc="replica 1 pods created while 0 pending")
+        time.sleep(0.6)
+        assert bound(cl, "wl9") == []
+        assert_no_partial_binds(cl, "wl9")
+
+        set_cordon(cl, all_nodes, False)
+        wait_for(lambda: len(bound(cl, "wl9")) == 8, timeout=10.0,
+                 desc="both replicas placed")
+        wait_for(lambda: cl.client.get(
+            PodCliqueSet, "wl9").status.available_replicas == 2,
+            timeout=10.0, desc="both available")
+
+
+def test_gs10_scale_in_releases_capacity_for_pending_gang():
+    """Scale-in admits a waiting gang: shrinking the PCSG frees its slice
+    and the pending workload places without manual intervention. big runs
+    at higher priority so late cannot simply preempt big's elastic gang
+    (cross-PCS base-gang preemption is covered in test_gang_scheduling)."""
+    cl = make_cluster(3)
+    with cl:
+        big = wl("big", sg_replicas=2, sg_min=1)
+        big.spec.template.priority = 10
+        cl.client.create(big)
+        wait_for(lambda: len(bound(cl, "big")) == 6, desc="big up (3 slices)")
+
+        late = PodCliqueSet(
+            meta=new_meta("late"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                topology=SLICE,
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, tpu_chips_per_pod=4,
+                    container=ContainerSpec(argv=["x"]))])))
+        cl.client.create(late)
+        time.sleep(0.6)
+        assert bound(cl, "late") == [], \
+            "late must wait (big's elastic outranks it)"
+
+        live = cl.client.get(PodCliqueSet, "big")
+        live.spec.template.scaling_groups[0].replicas = 1
+        cl.client.update(live)
+        wait_for(lambda: len(bound(cl, "late")) == 2, timeout=10.0,
+                 desc="late placed after scale-in freed capacity")
+        assert len(bound(cl, "big")) == 4  # base + sg-0 untouched

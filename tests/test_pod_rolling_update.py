@@ -67,8 +67,16 @@ class TestHashSplit:
         assert generation_hash(a) != generation_hash(b)
         assert structure_hash(a) == structure_hash(b)
 
-    def test_replica_change_moves_structure_hash(self):
-        a, b = _pcs(replicas=4), _pcs(replicas=5)
+    def test_scaling_is_hash_neutral(self):
+        # kubectl-scale analog: replica/floor changes are not updates.
+        a, b = _pcs(replicas=4, min_available=3), _pcs(replicas=5,
+                                                       min_available=4)
+        assert generation_hash(a) == generation_hash(b)
+        assert structure_hash(a) == structure_hash(b)
+
+    def test_chip_change_moves_structure_hash(self):
+        a, b = _pcs(), _pcs()
+        b.spec.template.cliques[0].tpu_chips_per_pod = 4
         assert structure_hash(a) != structure_hash(b)
 
     def test_scaling_group_change_moves_structure_hash(self):
@@ -127,17 +135,40 @@ def test_structural_change_still_recreates_replica(cluster):
     old_hash = generation_hash(cl.client.get(PodCliqueSet, "pcs"))
     wait_for(lambda: _all_ready_at(cl, old_hash, 4), timeout=15.0,
              desc="initial pods ready")
+    gang_uid = cl.client.list(PodGang)[0].meta.uid
+
+    # A chip resize is structural: gangs must be re-planned, so the
+    # replica-recreation rollout engages.
+    live = cl.client.get(PodCliqueSet, "pcs")
+    live.spec.template.cliques[0].tpu_chips_per_pod = 4
+    cl.client.update(live)
+
+    new_hash = generation_hash(live)
+    wait_for(lambda: _all_ready_at(cl, new_hash, 4), timeout=30.0,
+             desc="replica recreated at new chip shape")
+    gangs = cl.client.list(PodGang)
+    assert len(gangs) == 1 and gangs[0].meta.uid != gang_uid, \
+        "structural change must recreate the gang"
+
+
+def test_scale_out_does_not_roll_pods(cluster):
+    """Scaling a clique is not an update: existing pods keep running
+    (uids stable), new pods join, no rollout progress appears."""
+    cl = cluster
+    cl.client.create(_pcs(image="v1"))
+    h = generation_hash(cl.client.get(PodCliqueSet, "pcs"))
+    wait_for(lambda: _all_ready_at(cl, h, 4), timeout=15.0, desc="up")
+    before = {p.meta.name: p.meta.uid for p in _pods(cl)}
 
     live = cl.client.get(PodCliqueSet, "pcs")
     live.spec.template.cliques[0].replicas = 5
-    live.spec.template.cliques[0].min_available = 4
     cl.client.update(live)
-
-    # The PCS-level path engages (progress object appears), and the
-    # clique converges to 5 pods at the new hash.
-    new_hash = generation_hash(live)
-    wait_for(lambda: _all_ready_at(cl, new_hash, 5), timeout=30.0,
-             desc="replica recreated at new shape")
+    wait_for(lambda: _all_ready_at(cl, h, 5), timeout=20.0,
+             desc="scaled to 5 at the SAME hash")
+    after = {p.meta.name: p.meta.uid for p in _pods(cl)}
+    assert all(after[n] == before[n] for n in before), \
+        "scale-out must not recreate existing pods"
+    assert cl.client.get(PodCliqueSet, "pcs").status.rolling_update is None
 
 
 def test_rolling_update_in_scaling_group_keeps_scaled_gangs(cluster):

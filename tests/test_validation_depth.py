@@ -191,9 +191,11 @@ class TestImmutabilityTable:
         default_podcliqueset(new)
         return new, old
 
-    def test_chips_immutable(self):
+    def test_chips_mutable(self):
+        # A chip resize is structural but reconcilable: the replica-
+        # recreation rollout re-plans the gangs.
         new, old = self._pair(**{"cliques.tpu_chips_per_pod": 2})
-        assert_rejected(new, "tpu_chips_per_pod is immutable", old=old)
+        assert not errors_of(new, old=old)
 
     def test_clique_topology_immutable(self):
         new, old = self._pair(**{"cliques.topology": TopologyConstraint(
