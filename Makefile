@@ -10,8 +10,10 @@ test:            ## full suite on the virtual CPU mesh
 test-fast:       ## control-plane tests only (skip model numerics)
 	$(PY) -m pytest tests/ -q -k "not model and not ring and not moe and not pallas and not serving"
 
-scale:           ## 1000-pod deploy/steady/delete timeline
-	$(PY) -m grove_tpu.scale --pods 1000
+scale:           ## 1000-pod deploy/steady/delete timeline (+ history)
+	$(PY) -m grove_tpu.scale --pods 1000 \
+		--history scale-history/history.jsonl \
+		--label "$$(git rev-parse --short HEAD 2>/dev/null || echo dev)"
 
 soak:            ## repeated scale out/in cycles
 	$(PY) -m pytest tests/test_scale.py::test_soak_scale_cycles -q

@@ -76,6 +76,11 @@ class LogConfig:
 class AutoscalerConfig:
     enabled: bool = True
     sync_period_seconds: float = 5.0
+    # Downscale stabilization (k8s HPA analog): shrink only to the max
+    # desired value seen over this window. Flap control matters more
+    # here than in vanilla HPA — every PCSG flap is a gang
+    # create/destroy cycle on TPU slices.
+    scale_down_stabilization_seconds: float = 30.0
 
 
 @dataclasses.dataclass

@@ -81,16 +81,29 @@ def desired_replicas(value: float, target: float, lo: int, hi: int) -> int:
 
 class Autoscaler:
     def __init__(self, client: Client, metrics: MetricsRegistry,
-                 namespace: str | None = None, sync_period: float = 1.0):
+                 namespace: str | None = None, sync_period: float = 1.0,
+                 scale_down_stabilization: float = 30.0):
         """``namespace=None`` scans every namespace (the default: the rest
-        of the control plane is namespace-agnostic too)."""
+        of the control plane is namespace-agnostic too).
+
+        ``scale_down_stabilization``: scale-down uses the MAX desired
+        value observed over this window (the k8s HPA downscale
+        stabilization) — a noisy queue-depth signal must not thrash
+        replicas, because every PCSG flap is a gang create/destroy.
+        Scale-UP stays immediate (starving traffic to look smooth is the
+        wrong trade).
+        """
         self.client = client
         self.metrics = metrics
         self.namespace = namespace
         self.sync_period = sync_period
+        self.scale_down_stabilization = scale_down_stabilization
         self.log = get_logger("autoscaler")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # (kind, namespace, name) -> [(timestamp, desired)] recent history
+        self._history: dict[tuple[str, str, str],
+                            list[tuple[float, int]]] = {}
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="autoscaler",
@@ -109,17 +122,20 @@ class Autoscaler:
             self._stop.wait(self.sync_period)
 
     def _pass(self) -> None:
+        live_keys: set[tuple[str, str, str]] = set()
         for kind_cls in (PodClique, PodCliqueScalingGroup, PodCliqueSet):
             for obj in self.client.list(kind_cls, self.namespace):
                 a = obj.spec.auto_scaling
                 if a is None or obj.meta.deletion_timestamp is not None:
                     continue
+                live_keys.add((obj.KIND, obj.meta.namespace, obj.meta.name))
                 value = self.metrics.get(obj.KIND, obj.meta.name, a.metric,
                                          namespace=obj.meta.namespace)
                 if value is None:
                     continue
                 want = desired_replicas(value, a.target_value,
                                         a.min_replicas, a.max_replicas)
+                want = self._stabilized(obj, want)
                 if want != obj.spec.replicas:
                     self.log.info("scaling %s/%s %d -> %d (%s=%.2f)",
                                   obj.KIND, obj.meta.name, obj.spec.replicas,
@@ -129,3 +145,24 @@ class Autoscaler:
                         self.client.update(obj)
                     except GroveError:
                         pass  # conflict: next pass retries on fresh state
+        # Evict history of deleted objects: unbounded growth under churn,
+        # and a recreated same-name object must not inherit a dead
+        # object's spike window.
+        for key in [k for k in self._history if k not in live_keys]:
+            del self._history[key]
+
+    def _stabilized(self, obj, want: int) -> int:
+        """HPA downscale stabilization: record the raw desired value and
+        return max(desired over the window) when shrinking — scale-down
+        happens only after the signal has stayed low for the whole
+        window; scale-up passes through untouched."""
+        now = time.time()
+        key = (obj.KIND, obj.meta.namespace, obj.meta.name)
+        window = self._history.setdefault(key, [])
+        window.append((now, want))
+        cutoff = now - self.scale_down_stabilization
+        while window and window[0][0] < cutoff:
+            window.pop(0)
+        if want >= obj.spec.replicas:
+            return want
+        return min(obj.spec.replicas, max(w for _, w in window))

@@ -67,7 +67,9 @@ def new_cluster(config: OperatorConfiguration | None = None,
         metrics = MetricsRegistry()
         mgr.add_runnable(Autoscaler(
             mgr.client, metrics,
-            sync_period=mgr.config.autoscaler.sync_period_seconds))
+            sync_period=mgr.config.autoscaler.sync_period_seconds,
+            scale_down_stabilization=mgr.config.autoscaler
+            .scale_down_stabilization_seconds))
     if fleet is not None:
         create_fleet(mgr.client, fleet)
     return Cluster(manager=mgr, scheduler_registry=registry, metrics=metrics)

@@ -155,14 +155,59 @@ def main(argv=None) -> int:
     parser.add_argument("--pods", type=int, default=1000)
     parser.add_argument("--cliques", type=int, default=4)
     parser.add_argument("--json", help="write full timeline JSON here")
+    parser.add_argument("--history",
+                        help="append a summary line to this JSONL file and "
+                             "report regressions vs the best prior run "
+                             "(the scale-history analog of the reference's "
+                             "hack/scale-history.py)")
+    parser.add_argument("--label", default="",
+                        help="tag for the history entry (e.g. round/commit)")
     args = parser.parse_args(argv)
     result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques))
     timeline = result.pop("timeline")
     if args.json:
         with open(args.json, "w") as f:
             _json.dump({**result, "timeline": timeline}, f, indent=2)
+    if args.history:
+        _append_history(args.history, args.label, result)
     print(_json.dumps(result, indent=2))
     return 0
+
+
+def _append_history(path: str, label: str, result: dict) -> None:
+    """Run-over-run tracking: append this run, then compare the headline
+    metric (pods-ready latency) against prior runs at the same pod count
+    and flag regressions > 20% on stderr."""
+    import json as _json
+    import os
+    import sys
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    prior = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        prior.append(_json.loads(line))
+                    except ValueError:
+                        pass
+    entry = {"label": label, "ts": time.time(), **result}
+    with open(path, "a") as f:
+        f.write(_json.dumps(entry) + "\n")
+    same_scale = [p for p in prior if p.get("pods") == result["pods"]
+                  and "deploy_pods_ready_s" in p]
+    if same_scale:
+        best = min(p["deploy_pods_ready_s"] for p in same_scale)
+        now = result["deploy_pods_ready_s"]
+        if best > 0 and now > best * 1.2:
+            print(f"REGRESSION: pods-ready {now:.1f}s vs best "
+                  f"{best:.1f}s over {len(same_scale)} prior runs",
+                  file=sys.stderr)
+        else:
+            print(f"history: pods-ready {now:.1f}s (best prior "
+                  f"{best:.1f}s, {len(same_scale)} runs)", file=sys.stderr)
 
 
 if __name__ == "__main__":

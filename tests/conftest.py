@@ -29,3 +29,14 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
     return devs
+
+
+# @pytest.mark.timeout(N) enforcement (pytest-timeout is not installed;
+# see timeout_guard.py). Importing the hooks into this namespace
+# registers them for the whole suite.
+from timeout_guard import (  # noqa: E402,F401
+    pytest_configure,
+    pytest_runtest_call,
+    pytest_runtest_setup,
+    pytest_runtest_teardown,
+)

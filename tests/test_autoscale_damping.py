@@ -1,0 +1,87 @@
+"""Autoscaler flap control: downscale stabilization (k8s HPA analog).
+Round-1 gap: raw ceil(value/target) with no damping — a noisy
+queue-depth signal would thrash PCSG replicas, and each flap is a gang
+create/destroy on a TPU slice.
+"""
+
+from __future__ import annotations
+
+import time
+
+from grove_tpu.api import PodCliqueScalingGroup, new_meta
+from grove_tpu.api.podcliqueset import AutoScalingConfig
+from grove_tpu.api.scalinggroup import PodCliqueScalingGroupSpec
+from grove_tpu.autoscale import Autoscaler, MetricsRegistry
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+
+
+def make_scaler(stabilization: float):
+    client = Client(Store())
+    metrics = MetricsRegistry()
+    scaler = Autoscaler(client, metrics,
+                        scale_down_stabilization=stabilization)
+    pcsg = PodCliqueScalingGroup(
+        meta=new_meta("sg"),
+        spec=PodCliqueScalingGroupSpec(
+            clique_names=["w"], replicas=1, min_available=1,
+            auto_scaling=AutoScalingConfig(
+                min_replicas=1, max_replicas=5,
+                metric="queue_depth", target_value=10.0)))
+    client.create(pcsg)
+    return client, metrics, scaler
+
+
+def replicas(client):
+    return client.get(PodCliqueScalingGroup, "sg").spec.replicas
+
+
+def test_scale_up_is_immediate():
+    client, metrics, scaler = make_scaler(stabilization=300.0)
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
+    scaler._pass()
+    assert replicas(client) == 5
+
+
+def test_scale_down_waits_out_the_window():
+    client, metrics, scaler = make_scaler(stabilization=0.5)
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
+    scaler._pass()
+    assert replicas(client) == 5
+
+    # Signal drops — but the window still remembers the spike.
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 5.0)
+    scaler._pass()
+    assert replicas(client) == 5, "must not shrink inside the window"
+
+    # After the window drains, the low signal wins.
+    time.sleep(0.6)
+    scaler._pass()
+    assert replicas(client) == 1
+
+
+def test_noisy_signal_does_not_flap():
+    """Alternating 45/5 readings: replicas ratchet to the max and stay
+    there for the whole noisy phase — zero down-scaling flaps."""
+    client, metrics, scaler = make_scaler(stabilization=5.0)
+    seen = set()
+    for i in range(10):
+        metrics.set("PodCliqueScalingGroup", "sg", "queue_depth",
+                    45.0 if i % 2 == 0 else 5.0)
+        scaler._pass()
+        seen.add(replicas(client))
+    assert seen == {5}, f"replicas flapped: {seen}"
+
+
+def test_spike_during_drain_resets_the_window():
+    client, metrics, scaler = make_scaler(stabilization=0.5)
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
+    scaler._pass()
+    time.sleep(0.3)
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
+    scaler._pass()
+    time.sleep(0.3)
+    # 0.6s since the FIRST spike, only 0.3 since the second → hold.
+    metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 5.0)
+    scaler._pass()
+    assert replicas(client) == 5

@@ -50,6 +50,9 @@ def test_closed_autoscaling_loop_over_http(tmp_path):
                                         count=3)], fake=False)
     cfg = OperatorConfiguration()
     cfg.autoscaler.sync_period_seconds = 0.5
+    # Short downscale stabilization so the scale-back phase fits the
+    # test budget (production default is 30s).
+    cfg.autoscaler.scale_down_stabilization_seconds = 3.0
     cl = new_cluster(config=cfg, fleet=fleet, fake_kubelet=False)
     kubelet = ProcessKubelet(cl.client, workdir="/root/repo",
                              log_dir=str(tmp_path / "logs"))

@@ -38,9 +38,14 @@ from test_e2e_simple import simple_pcs, wait_for
 
 @pytest.fixture
 def cluster():
+    from grove_tpu.api.config import OperatorConfiguration
     fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
                                         count=3)])
-    cl = new_cluster(fleet=fleet)
+    cfg = OperatorConfiguration()
+    # Short downscale stabilization so scale-back assertions fit the test
+    # budget (flap control itself is covered by test_autoscale_damping).
+    cfg.autoscaler.scale_down_stabilization_seconds = 1.0
+    cl = new_cluster(config=cfg, fleet=fleet)
     with cl:
         yield cl
 

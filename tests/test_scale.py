@@ -37,8 +37,13 @@ def test_soak_scale_cycles():
         AutoScalingConfig, PodCliqueSetSpec, PodCliqueSetTemplate,
         PodCliqueTemplate, ScalingGroupConfig)
 
+    from grove_tpu.api.config import OperatorConfiguration
     fleet = FleetSpec(slices=[SliceSpec(topology="4x4", count=4)])
-    with new_cluster(fleet=fleet) as cl:
+    cfg = OperatorConfiguration()
+    # Fast scale-in cycles are the point of the soak; flap control is
+    # covered by test_autoscale_damping.
+    cfg.autoscaler.scale_down_stabilization_seconds = 0.5
+    with new_cluster(config=cfg, fleet=fleet) as cl:
         client = cl.client
         client.create(PodCliqueSet(
             meta=new_meta("soak"),

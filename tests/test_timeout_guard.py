@@ -1,0 +1,85 @@
+"""The conftest SIGALRM timeout guard actually enforces
+@pytest.mark.timeout (round-1 regression: the mark was silently inert,
+so a hang in the capstone hung the whole suite)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "tests") + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_hanging_test_is_killed_by_the_mark(tmp_path):
+    (tmp_path / "test_hang.py").write_text(textwrap.dedent("""
+        import time
+        import pytest
+
+        @pytest.mark.timeout(2)
+        def test_hangs_forever():
+            time.sleep(600)
+    """))
+    # The temp file lives outside tests/, so conftest does not apply —
+    # the guard is loaded explicitly as a plugin (-p timeout_guard).
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(tmp_path / "test_hang.py"),
+         "-q", "-p", "no:cacheprovider", "-p", "timeout_guard"],
+        cwd=os.path.join(REPO, "tests"), env=_env(),
+        capture_output=True, text=True, timeout=120)
+    elapsed = time.time() - t0
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    assert "TimeoutError" in proc.stdout
+    assert "exceeded its 2s timeout mark" in proc.stdout
+    assert elapsed < 60, f"guard too slow: {elapsed:.0f}s"
+
+
+def test_hanging_fixture_is_killed_too(tmp_path):
+    """Setup-phase hangs are guarded, not just the test body."""
+    (tmp_path / "test_fixture_hang.py").write_text(textwrap.dedent("""
+        import time
+        import pytest
+
+        @pytest.fixture
+        def stuck():
+            time.sleep(600)
+
+        @pytest.mark.timeout(2)
+        def test_never_starts(stuck):
+            pass
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         str(tmp_path / "test_fixture_hang.py"),
+         "-q", "-p", "no:cacheprovider", "-p", "timeout_guard"],
+        cwd=os.path.join(REPO, "tests"), env=_env(),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "exceeded its 2s timeout mark" in proc.stdout
+
+
+def test_fast_test_unaffected_by_the_mark(tmp_path):
+    (tmp_path / "test_fast.py").write_text(textwrap.dedent("""
+        import pytest
+
+        @pytest.mark.timeout(30)
+        def test_finishes():
+            assert True
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(tmp_path / "test_fast.py"),
+         "-q", "-p", "no:cacheprovider", "-p", "timeout_guard"],
+        cwd=os.path.join(REPO, "tests"), env=_env(),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout[-2000:]
