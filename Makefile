@@ -10,9 +10,9 @@ test:            ## full suite on the virtual CPU mesh
 test-fast:       ## control-plane tests only (skip model numerics)
 	$(PY) -m pytest tests/ -q -k "not model and not ring and not moe and not pallas and not serving"
 
-scale:           ## 1000-pod deploy/steady/delete timeline (+ history)
+scale:           ## 1000-pod deploy/steady/delete timeline (+ local history)
 	$(PY) -m grove_tpu.scale --pods 1000 \
-		--history scale-history/history.jsonl \
+		--history scale-history/local.jsonl \
 		--label "$$(git rev-parse --short HEAD 2>/dev/null || echo dev)"
 
 soak:            ## repeated scale out/in cycles

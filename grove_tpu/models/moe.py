@@ -1,32 +1,39 @@
 """Mixture-of-Experts decoder (Mixtral-shaped) — the second model family.
 
 Reuses the Llama attention stack; the MLP becomes a top-k token-choice
-router over E experts. TPU-first choices:
+router over E experts. Two execution paths, both TPU-first:
 
-- Experts are evaluated densely per token then combined by router weight
-  (einsum over the expert axis) — static shapes, no gather/scatter of
-  token groups, so XLA tiles everything onto the MXU. This is the right
-  trade below ~16 experts; a capacity-based dispatch kernel is the
-  pallas upgrade path for larger E.
-- Expert parallelism: the ``expert`` logical axis maps to the tp mesh
-  axis (grove_tpu/parallel/sharding.py), so experts shard over the same
-  fast ICI group as tensor parallelism (EP == TP group).
+- Dense (default, single chip / small E): every expert evaluated per
+  token, combined by router weight — static shapes, no gather/scatter,
+  XLA tiles everything onto the MXU. The right trade below ~16 experts.
+- Expert-parallel (``forward(..., mesh=mesh, ep=True)``): GShard-style
+  dispatch over the dedicated ``ep`` mesh axis. Tokens are bucketed per
+  expert with a capacity factor (static shapes — overflow assignments
+  drop, as in Switch/GShard), exchanged via ``lax.all_to_all`` over ICI,
+  processed by each device's expert shard, and returned by the inverse
+  all_to_all. This is the path that scales past the dense trade, and
+  the load-balance auxiliary loss keeps the router from collapsing onto
+  few experts (which would amplify capacity drops).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
+from functools import partial
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
 from grove_tpu.models import llama
 from grove_tpu.models.llama import LlamaConfig, _attn_out, _qkv
 from grove_tpu.ops.attention import causal_attention
 from grove_tpu.ops.norms import rms_norm
 from grove_tpu.ops.rope import rope_table
+from grove_tpu.parallel.mesh import AXIS_DP, AXIS_EP
 
 Params = dict[str, Any]
 
@@ -86,24 +93,177 @@ def _moe_block(cfg: MoeConfig, x, lp):
     return x + out.astype(x.dtype)
 
 
-def forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Full forward → logits [b, s, vocab]."""
+def router_load_balance_loss(router_logits: jnp.ndarray,
+                             top_idx: jnp.ndarray, n_experts: int
+                             ) -> jnp.ndarray:
+    """Switch-Transformer auxiliary loss: E · Σ_e f_e · p_e, minimised at
+    uniform routing. f_e = fraction of assignments to expert e; p_e =
+    mean router probability. Keeps the router balanced so capacity drops
+    stay rare on the expert-parallel path."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    p = probs.reshape(-1, n_experts).mean(axis=0)
+    counts = jax.nn.one_hot(top_idx.reshape(-1), n_experts,
+                            dtype=jnp.float32).mean(axis=0)
+    return n_experts * jnp.sum(counts * p)
+
+
+def _ep_moe_block(cfg: MoeConfig, x, lp, capacity_factor: float):
+    """Expert-parallel routed MLP under shard_map (GShard dispatch).
+
+    x: [bl, s, d] — this member's token shard. Experts are sharded over
+    the ``ep`` axis (lp["we_*"]: [E/ep, d, ff] local slices, global
+    expert e lives on member e // (E/ep)). Static capacity buckets make
+    every shape compile-time constant; overflow assignments are dropped
+    (their tokens keep the residual path only).
+    """
+    ep = lax.axis_size(AXIS_EP)
+    E, k = cfg.n_experts, cfg.experts_per_token
+    El = E // ep
+    bl, s, d = x.shape
+    n = bl * s
+    capacity = max(1, int(math.ceil(n * k / E * capacity_factor)))
+
+    hm = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).reshape(n, d)
+    logits = jnp.einsum("nd,de->ne", hm, lp["router"],
+                        preferred_element_type=jnp.float32)
+    top_vals, top_idx = lax.top_k(logits, k)               # [n, k]
+    gate_w = jax.nn.softmax(top_vals, axis=-1)
+    flat_e = top_idx.reshape(-1)                           # [n*k]
+    flat_w = gate_w.reshape(-1).astype(hm.dtype)
+
+    # Position of each assignment within its expert's bucket; beyond
+    # capacity → slot index `capacity`, which scatters into the void.
+    oh = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)
+    pos = jnp.cumsum(oh, axis=0) - 1
+    pos_in_e = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+    slot = jnp.where(pos_in_e < capacity, pos_in_e, capacity)
+
+    toks = jnp.repeat(hm, k, axis=0)                       # [n*k, d]
+    buckets = jnp.zeros((E, capacity, d), hm.dtype)
+    buckets = buckets.at[flat_e, slot].set(toks, mode="drop")
+
+    # Dispatch: bucket for global expert j*El+e goes to ep member j.
+    send = buckets.reshape(ep, El, capacity, d)
+    recv = lax.all_to_all(send, AXIS_EP, split_axis=0, concat_axis=0)
+    # recv[i, e] = peer i's bucket for my local expert e.
+    expert_in = recv.transpose(1, 0, 2, 3).reshape(El, ep * capacity, d)
+    g = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_gate"])
+    u = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
+    out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, lp["we_down"])
+    # Return: inverse exchange restores [E, capacity, d] on each member.
+    back = out.reshape(El, ep, capacity, d).transpose(1, 0, 2, 3)
+    mine = lax.all_to_all(back, AXIS_EP, split_axis=0, concat_axis=0)
+    mine = mine.reshape(E, capacity, d)
+
+    # Gather per assignment; dropped slots read the zero pad row.
+    padded = jnp.pad(mine, ((0, 0), (0, 1), (0, 0)))
+    out_assign = padded[flat_e, slot] * flat_w[:, None]
+    moe_out = out_assign.reshape(n, k, d).sum(axis=1)
+    return (x + moe_out.reshape(bl, s, d).astype(x.dtype),
+            router_load_balance_loss(logits, top_idx, E))
+
+
+def _decoder_stack(cfg: MoeConfig, params, tokens, moe_fn, aux0):
+    """The shared decoder skeleton (embed → [attention + moe] × L →
+    norm → head). ONE copy for both execution paths — ``moe_fn(x, lp)``
+    → (x, layer_aux) is the only difference between dense and
+    expert-parallel, so the paths cannot drift apart."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = params["tok_embed"][tokens].astype(cfg.dtype)
 
-    def body(x, lp):
+    def body(carry, lp):
+        x, aux = carry
         q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
         x = _attn_out(x, causal_attention(q, k, v), lp)
-        x = _moe_block(cfg, x, lp)
-        return x, None
+        x, layer_aux = moe_fn(x, lp)
+        return (x, aux + layer_aux), None
 
-    x, _ = lax.scan(body, x, params["layers"])
+    (x, aux), _ = lax.scan(body, (x, aux0), params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, aux / cfg.n_layers
 
 
-def loss_fn(cfg: MoeConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
-    return llama.next_token_loss(forward(cfg, params, tokens), tokens)
+def _ep_body(cfg: MoeConfig, capacity_factor: float, params, tokens):
+    """shard_map body: tokens batch-sharded over (dp, ep), experts
+    sharded over ep, attention token-local."""
+    # The aux accumulator must carry the device-varying type from the
+    # start (layer aux varies over dp/ep) or the scan carry types differ.
+    aux0 = lax.pcast(jnp.float32(0.0), (AXIS_DP, AXIS_EP), to="varying")
+    logits, aux = _decoder_stack(
+        cfg, params, tokens,
+        lambda x, lp: _ep_moe_block(cfg, x, lp, capacity_factor), aux0)
+    return logits, lax.pmean(aux, (AXIS_DP, AXIS_EP))
+
+
+_EP_PARAM_LEAVES = {"we_gate", "we_up", "we_down"}
+
+
+def _ep_param_specs(params) -> Any:
+    def leaf(path, _):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in _EP_PARAM_LEAVES:
+            # [L, E, ...] — experts sharded over ep.
+            return P(None, AXIS_EP)
+        return P()
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
+            mesh: Mesh | None = None, ep: bool = False,
+            capacity_factor: float = 1.25) -> jnp.ndarray:
+    """Full forward → logits [b, s, vocab].
+
+    ``ep=True`` (requires ``mesh`` with an ep axis > 1) runs the
+    expert-parallel dispatch path; batch must divide dp·ep and
+    n_experts must divide ep.
+    """
+    if not ep:
+        logits, _ = _decoder_stack(
+            cfg, params, tokens,
+            lambda x, lp: (_moe_block(cfg, x, lp), jnp.float32(0.0)),
+            jnp.float32(0.0))
+        return logits
+    logits, _ = ep_forward(cfg, params, tokens, mesh,
+                           capacity_factor=capacity_factor)
+    return logits
+
+
+def ep_forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
+               mesh: Mesh, capacity_factor: float = 1.25
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel forward → (logits, load_balance_aux)."""
+    assert mesh is not None, "ep path needs the mesh"
+    ep_size = dict(mesh.shape).get(AXIS_EP, 1)
+    assert ep_size > 1, f"mesh has no ep axis > 1 (shape {dict(mesh.shape)})"
+    assert cfg.n_experts % ep_size == 0, \
+        f"{cfg.n_experts} experts not divisible over ep={ep_size}"
+    dp_size = dict(mesh.shape).get(AXIS_DP, 1)
+    assert tokens.shape[0] % (dp_size * ep_size) == 0, \
+        f"batch {tokens.shape[0]} must divide dp*ep={dp_size * ep_size}"
+    batch_spec = P((AXIS_DP, AXIS_EP))
+    fn = jax.shard_map(
+        partial(_ep_body, cfg, capacity_factor),
+        mesh=mesh,
+        in_specs=(_ep_param_specs(params), batch_spec),
+        out_specs=(batch_spec, P()),
+    )
+    return fn(params, tokens)
+
+
+def loss_fn(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
+            mesh: Mesh | None = None, ep: bool = False,
+            aux_weight: float = 0.01,
+            capacity_factor: float = 1.25) -> jnp.ndarray:
+    """Next-token loss; on the ep path the Switch load-balance auxiliary
+    is added (weight 0.01, the usual setting). ``capacity_factor`` is
+    the training knob for expert bucket headroom (raise it while an
+    early unbalanced router is still dropping tokens)."""
+    if not ep:
+        return llama.next_token_loss(forward(cfg, params, tokens), tokens)
+    logits, aux = ep_forward(cfg, params, tokens, mesh,
+                             capacity_factor=capacity_factor)
+    return llama.next_token_loss(logits, tokens) + aux_weight * aux

@@ -29,31 +29,36 @@ from jax.sharding import Mesh
 
 AXIS_DP = "dp"
 AXIS_PP = "pp"
+AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
 
 # Canonical axis order: outermost (slowest fabric) ... innermost (fastest).
 # pp sits between dp and sp: stage hops are point-to-point activations —
 # cheaper than sp/tp collectives, tolerant of slower links than either.
-MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+# ep (expert parallelism) sits between pp and sp: its all_to_all dispatch
+# tolerates slower links than sp/tp collectives (and may cross slices for
+# very large expert counts), but is chattier than pp's stage hops.
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
-    """A concrete (dp, pp, sp, tp) factorisation of a device count."""
+    """A concrete (dp, pp, ep, sp, tp) factorisation of a device count."""
 
     dp: int = 1
     pp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.pp * self.sp * self.tp
+        return self.dp * self.pp * self.ep * self.sp * self.tp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {AXIS_DP: self.dp, AXIS_PP: self.pp, AXIS_SP: self.sp,
-                AXIS_TP: self.tp}
+        return {AXIS_DP: self.dp, AXIS_PP: self.pp, AXIS_EP: self.ep,
+                AXIS_SP: self.sp, AXIS_TP: self.tp}
 
 
 def _largest_pow2_divisor(n: int, cap: int) -> int:
@@ -102,7 +107,8 @@ def build_mesh(plan: MeshPlan | None = None,
     if plan.size != len(devices):
         raise ValueError(
             f"mesh plan {plan} needs {plan.size} devices, have {len(devices)}")
-    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.sp, plan.tp)
+    arr = np.array(devices).reshape(plan.dp, plan.pp, plan.ep, plan.sp,
+                                    plan.tp)
     return Mesh(arr, MESH_AXES)
 
 

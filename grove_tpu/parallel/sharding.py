@@ -13,10 +13,11 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from grove_tpu.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP
 
-# logical axis -> mesh axis (None = replicate)
-LOGICAL_RULES: dict[str, str | None] = {
+# logical axis -> mesh axis (None = replicate; a tuple shards over the
+# product of those axes)
+LOGICAL_RULES: dict[str, str | tuple[str, ...] | None] = {
     "batch": AXIS_DP,
     "seq": AXIS_SP,          # sequence parallelism for long context
     "vocab": AXIS_TP,
@@ -26,7 +27,11 @@ LOGICAL_RULES: dict[str, str | None] = {
     "head_dim": None,
     "mlp": AXIS_TP,          # ffn hidden over tp
     "layers": None,          # scan-stacked layer axis
-    "expert": AXIS_TP,       # MoE experts over tp (EP == TP group here)
+    # MoE experts: the dedicated ep axis first, tp as the inner factor —
+    # on a tp-only mesh (ep=1) experts still shard over tp (a Mixtral's
+    # expert weights replicated per device would blow the HBM budget);
+    # with ep>1 they shard over ep×tp.
+    "expert": (AXIS_EP, AXIS_TP),
 }
 
 
@@ -60,7 +65,7 @@ _PARAM_RULES: dict[str, tuple[str | None, ...]] = {
     "w_gate": ("embed", "mlp"),
     "w_up": ("embed", "mlp"),
     "w_down": ("mlp", "embed"),
-    # MoE (EP == TP group: experts shard over tp, ff replicated per expert)
+    # MoE: experts shard over the dedicated ep axis; router replicated
     "router": ("embed", None),
     "we_gate": ("expert", "embed", None),
     "we_up": ("expert", "embed", None),

@@ -42,7 +42,7 @@ def test_scaled_gang_pending_never_degrades_base(small_cluster):
 
     wait_for(lambda: client.get(
         PodCliqueSet, "over").status.available_replicas == 1,
-        timeout=15.0, desc="base available despite scaled pressure")
+        timeout=30.0, desc="base available despite scaled pressure")
 
     def states():
         gangs = {g.meta.name: g for g in client.list(
@@ -51,7 +51,7 @@ def test_scaled_gang_pending_never_degrades_base(small_cluster):
 
     wait_for(lambda: is_condition_true(
         states()["over-0-model-1"].status.conditions, c.COND_SCHEDULED),
-        timeout=10.0, desc="first scaled gang placed")
+        timeout=30.0, desc="first scaled gang placed")
     time.sleep(0.5)
     gangs = states()
     assert not is_condition_true(

@@ -41,6 +41,8 @@ def test_dryrun_multichip_8_under_driver_env():
     assert "dryrun_multichip ok" in proc.stdout
     # The composed pipeline×tensor-parallel step must have run on 8 devices.
     assert "composed pp=2xtp=2" in proc.stdout, proc.stdout
+    # And the expert-parallel MoE step (dp=2 × ep=4).
+    assert "moe dp=2xep=4" in proc.stdout, proc.stdout
 
 
 def test_dryrun_multichip_small_counts():

@@ -1,0 +1,96 @@
+"""MoE expert parallelism: GShard-style all_to_all dispatch over the
+dedicated ep mesh axis (round-1 gap: EP was TP-aliasing — all experts
+were computed densely on every member and there was no dispatch path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import moe
+from grove_tpu.parallel.mesh import MeshPlan, build_mesh
+
+CFG = moe.MOE_CONFIGS["moe-test-tiny"]  # E=4, k=2
+
+
+@pytest.fixture(scope="module")
+def ep_mesh(cpu_devices):
+    # dp=2 x ep=4: dispatch among 4 expert shards within each dp group.
+    return build_mesh(MeshPlan(dp=2, ep=4), cpu_devices[:8])
+
+
+@pytest.fixture(scope="module")
+def params():
+    return moe.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def tokens(b=8, s=16, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0,
+                              CFG.vocab_size)
+
+
+def test_ep_matches_dense_with_headroom(ep_mesh, params):
+    """With capacity ample enough that nothing drops, the dispatch path
+    must reproduce the dense path's logits (bf16 tolerance)."""
+    toks = tokens()
+    dense = moe.forward(CFG, params, toks)
+    ep_logits, aux = moe.ep_forward(CFG, params, toks, ep_mesh,
+                                    capacity_factor=float(CFG.n_experts))
+    np.testing.assert_allclose(np.asarray(ep_logits, np.float32),
+                               np.asarray(dense, np.float32),
+                               atol=8e-2, rtol=8e-2)
+    assert float(aux) > 0.0
+
+
+def test_tight_capacity_drops_but_stays_finite(ep_mesh, params):
+    """A sub-1 capacity factor forces drops: outputs differ from dense
+    (tokens fall back to the residual) but remain finite — the static-
+    shape overflow behavior of Switch/GShard."""
+    toks = tokens(seed=3)
+    ep_logits, _ = moe.ep_forward(CFG, params, toks, ep_mesh,
+                                  capacity_factor=0.25)
+    arr = np.asarray(ep_logits, np.float32)
+    assert np.all(np.isfinite(arr))
+    dense = np.asarray(moe.forward(CFG, params, toks), np.float32)
+    assert not np.allclose(arr, dense, atol=1e-3), \
+        "a 0.25 capacity factor should visibly drop assignments"
+
+
+def test_ep_train_step_grads_flow_through_all_to_all(ep_mesh, params):
+    """value_and_grad through the full ep loss: finite loss, finite and
+    non-zero expert grads (the backward all_to_all works)."""
+    toks = tokens(seed=5)
+
+    @jax.jit
+    def step(p):
+        return jax.value_and_grad(
+            lambda q: moe.loss_fn(CFG, q, toks, mesh=ep_mesh, ep=True))(p)
+
+    loss, grads = step(params)
+    assert bool(jnp.isfinite(loss))
+    leaves = jax.tree.leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+    expert_grad = grads["layers"]["we_gate"]
+    assert float(jnp.max(jnp.abs(expert_grad))) > 0.0
+
+
+def test_load_balance_loss_prefers_uniform_routing():
+    E = 4
+    n = 64
+    uniform = jnp.zeros((n, E))
+    collapsed = jnp.full((n, E), -10.0).at[:, 0].set(10.0)
+    uni_idx = jnp.tile(jnp.arange(2)[None], (n, 1))
+    col_idx = jnp.zeros((n, 2), jnp.int32)
+    lb_uniform = moe.router_load_balance_loss(uniform, uni_idx, E)
+    lb_collapsed = moe.router_load_balance_loss(collapsed, col_idx, E)
+    assert float(lb_collapsed) > float(lb_uniform)
+
+
+def test_ep_requires_divisible_experts(ep_mesh, params):
+    import dataclasses
+    bad = dataclasses.replace(CFG, n_experts=6)
+    with pytest.raises(AssertionError, match="divisible over ep"):
+        moe.ep_forward(bad, params, tokens(), ep_mesh)
