@@ -39,8 +39,13 @@ def main() -> None:
 
     out_dir = os.environ.get("GROVE_OUT_DIR")
     if out_dir:
-        with open(os.path.join(out_dir, f"result-{wid}.txt"), "w") as f:
+        # Atomic publish: readers poll for this file, so it must never be
+        # observable in a created-but-empty state.
+        final = os.path.join(out_dir, f"result-{wid}.txt")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
             f.write(f"{result}\n")
+        os.replace(tmp, final)
     print(f"worker {wid}/{n}: psum = {result}", flush=True)
 
     import time

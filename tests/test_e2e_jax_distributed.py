@@ -71,7 +71,10 @@ def test_gang_bootstraps_real_jax_process_group(tmp_path):
                 f = tmp_path / f"result-{i}.txt"
                 if not f.exists():
                     return False
-                vals.append(float(f.read_text().strip()))
+                text = f.read_text().strip()
+                if not text:  # mid-write (pre-atomic-publish workers)
+                    return False
+                vals.append(float(text))
             return all(v == expected for v in vals)
 
         wait_for(results_agree, timeout=60.0,
