@@ -33,7 +33,10 @@ from grove_tpu.models.llama import LlamaConfig, _attn_out, _qkv
 from grove_tpu.ops.attention import causal_attention
 from grove_tpu.ops.norms import rms_norm
 from grove_tpu.ops.rope import rope_table
-from grove_tpu.parallel.mesh import AXIS_DP, AXIS_EP
+from grove_tpu.parallel.mesh import (
+    AXIS_DP, AXIS_EP, AXIS_PP, AXIS_SP, AXIS_TP,
+)
+from grove_tpu.parallel.sharding import param_pspecs
 
 Params = dict[str, Any]
 
@@ -199,17 +202,16 @@ def _ep_body(cfg: MoeConfig, capacity_factor: float, params, tokens):
     return logits, lax.pmean(aux, (AXIS_DP, AXIS_EP))
 
 
-_EP_PARAM_LEAVES = {"we_gate", "we_up", "we_down"}
-
-
-def _ep_param_specs(params) -> Any:
-    def leaf(path, _):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name in _EP_PARAM_LEAVES:
-            # [L, E, ...] — experts sharded over ep.
-            return P(None, AXIS_EP)
-        return P()
-    return jax.tree_util.tree_map_with_path(leaf, params)
+def _collapse_to_dp_ep(spec: P) -> P:
+    """Drop mesh axes other than dp/ep from a PartitionSpec (valid only
+    when those axes have size 1, which ``ep_forward`` guards)."""
+    def keep(entry):
+        if entry is None:
+            return None
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a in (AXIS_DP, AXIS_EP))
+        return None if not kept else (kept if len(kept) > 1 else kept[0])
+    return P(*[keep(e) for e in spec])
 
 
 def forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
@@ -218,8 +220,8 @@ def forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
     """Full forward → logits [b, s, vocab].
 
     ``ep=True`` (requires ``mesh`` with an ep axis > 1) runs the
-    expert-parallel dispatch path; batch must divide dp·ep and
-    n_experts must divide ep.
+    expert-parallel dispatch path; dp·ep must divide the batch and
+    ep must divide n_experts.
     """
     if not ep:
         logits, _ = _decoder_stack(
@@ -237,18 +239,38 @@ def ep_forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
                ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel forward → (logits, load_balance_aux)."""
     assert mesh is not None, "ep path needs the mesh"
-    ep_size = dict(mesh.shape).get(AXIS_EP, 1)
-    assert ep_size > 1, f"mesh has no ep axis > 1 (shape {dict(mesh.shape)})"
+    shape = dict(mesh.shape)
+    ep_size = shape.get(AXIS_EP, 1)
+    assert ep_size > 1, f"mesh has no ep axis > 1 (shape {shape})"
     assert cfg.n_experts % ep_size == 0, \
-        f"{cfg.n_experts} experts not divisible over ep={ep_size}"
-    dp_size = dict(mesh.shape).get(AXIS_DP, 1)
+        f"ep={ep_size} must divide n_experts={cfg.n_experts}"
+    # The shard_map body is dp×ep only: tokens and weights mention no
+    # other axis, so a mesh with pp/sp/tp > 1 would silently replicate
+    # the whole forward over it (N-fold wasted FLOPs plus an
+    # expert-weight allgather per step). Refuse instead.
+    other = {a: s for a in (AXIS_PP, AXIS_SP, AXIS_TP)
+             if (s := shape.get(a, 1)) > 1}
+    assert not other, (
+        f"ep_forward composes with dp only; mesh has {other} — use a "
+        "dp×ep mesh (MoE tensor/pipeline parallelism inside the expert "
+        "shards is not implemented)")
+    dp_size = shape.get(AXIS_DP, 1)
     assert tokens.shape[0] % (dp_size * ep_size) == 0, \
-        f"batch {tokens.shape[0]} must divide dp*ep={dp_size * ep_size}"
+        f"dp*ep={dp_size * ep_size} must divide batch {tokens.shape[0]}"
     batch_spec = P((AXIS_DP, AXIS_EP))
+    # Parameter placement comes from the canonical rules
+    # (parallel/sharding.py param_pspecs) with the sp/tp axes collapsed:
+    # the guard above pins both to size 1, and mentioning them in
+    # in_specs would needlessly mark every value as varying over them
+    # inside the shard_map body. Expert leaves stay P(None, ep), which
+    # is exactly shard_params' placement at tp=1 — no resharding on
+    # entry.
+    specs = jax.tree.map(_collapse_to_dp_ep, param_pspecs(params),
+                         is_leaf=lambda x: isinstance(x, P))
     fn = jax.shard_map(
         partial(_ep_body, cfg, capacity_factor),
         mesh=mesh,
-        in_specs=(_ep_param_specs(params), batch_spec),
+        in_specs=(specs, batch_spec),
         out_specs=(batch_spec, P()),
     )
     return fn(params, tokens)

@@ -85,12 +85,24 @@ def param_pspec(name: str) -> P:
     return logical_pspec(*logical)
 
 
-def param_shardings(mesh: Mesh, params: Any) -> Any:
-    """A pytree of NamedShardings matching ``params`` (dict-of-dict layout)."""
+def param_pspecs(params: Any) -> Any:
+    """A pytree of PartitionSpecs matching ``params`` (dict-of-dict layout).
+
+    The single source of truth for parameter placement — consumed both by
+    ``param_shardings`` (device_put) and by shard_map in_specs (e.g. the
+    MoE expert-parallel path).
+    """
     def leaf(path, _):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        return NamedSharding(mesh, param_pspec(name))
+        return param_pspec(name)
     return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def param_shardings(mesh: Mesh, params: Any) -> Any:
+    """A pytree of NamedShardings matching ``params`` (dict-of-dict layout)."""
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        param_pspecs(params),
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_params(mesh: Mesh, params: Any) -> Any:

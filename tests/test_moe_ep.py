@@ -92,5 +92,15 @@ def test_load_balance_loss_prefers_uniform_routing():
 def test_ep_requires_divisible_experts(ep_mesh, params):
     import dataclasses
     bad = dataclasses.replace(CFG, n_experts=6)
-    with pytest.raises(AssertionError, match="divisible over ep"):
+    with pytest.raises(AssertionError, match="must divide n_experts"):
         moe.ep_forward(bad, params, tokens(), ep_mesh)
+
+
+def test_ep_refuses_pp_sp_tp_mesh(cpu_devices, params):
+    """A mesh with pp/sp/tp>1 would silently replicate the whole
+    shard_map body over that axis (wasted FLOPs + an expert-weight
+    allgather); ep_forward must refuse loudly instead."""
+    for extra in ({"tp": 2}, {"pp": 2}):
+        mesh = build_mesh(MeshPlan(dp=2, ep=2, **extra), cpu_devices[:8])
+        with pytest.raises(AssertionError, match="composes with dp only"):
+            moe.ep_forward(CFG, params, tokens(), mesh)
