@@ -67,6 +67,17 @@ class ServerAuthConfig:
 
 
 @dataclasses.dataclass
+class ProfilingConfig:
+    """Sampling-profiler surface (the reference's pprof endpoint toggle,
+    api/config/v1alpha1/types.go:186). Off by default: profiling leaks
+    code structure and costs a sampler thread per request window."""
+
+    enabled: bool = False
+    sample_interval_ms: float = 10.0
+    max_window_seconds: float = 30.0
+
+
+@dataclasses.dataclass
 class LogConfig:
     level: str = "info"
     format: str = "text"    # "text" | "json"
@@ -101,6 +112,8 @@ class OperatorConfiguration:
         default_factory=ServerAuthConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
+    profiling: ProfilingConfig = dataclasses.field(
+        default_factory=ProfilingConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
     # reconcile loop tuning
     requeue_base_seconds: float = 0.05
@@ -144,6 +157,12 @@ def validate_config(cfg: OperatorConfiguration) -> list[str]:
         errs.append(
             f"default_scheduler_profile {cfg.default_scheduler_profile!r} "
             f"not among profiles {names}")
+    if cfg.profiling.sample_interval_ms <= 0:
+        errs.append("profiling.sample_interval_ms must be > 0, got "
+                    f"{cfg.profiling.sample_interval_ms}")
+    if cfg.profiling.max_window_seconds <= 0:
+        errs.append("profiling.max_window_seconds must be > 0, got "
+                    f"{cfg.profiling.max_window_seconds}")
     if cfg.log.level not in ("debug", "info", "warning", "error"):
         errs.append(f"unknown log level {cfg.log.level!r}")
     if cfg.log.format not in ("text", "json"):

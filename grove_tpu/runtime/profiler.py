@@ -1,0 +1,171 @@
+"""Sampling profiler — the pprof/Pyroscope analog.
+
+The reference exposes a controller-runtime pprof endpoint gated by config
+(operator api/config/v1alpha1/types.go:186, wired at
+internal/controller/manager.go:115-123) and its scale harness captures
+per-phase profiles pushed to Pyroscope (e2e/tests/scale/scale_test.go:131,
+hack/infra_manager/pyroscope.py). This module is the standalone analog:
+
+- ``StackSampler`` — a wall-clock sampler over ``sys._current_frames()``
+  that sees EVERY thread (controllers, kubelets, HTTP handlers), not just
+  the caller. Output is collapsed-stack format (``a;b;c N``), directly
+  consumable by flamegraph tooling — the same shape Pyroscope ingests.
+- ``dump_stacks`` — a point-in-time all-threads stack dump (the
+  goroutine-dump analog, pprof's ``/debug/pprof/goroutine?debug=2``).
+- ``PhaseProfiler`` — per-phase capture for the scale runner: each phase
+  gets its own sampler; profiles export next to the timeline JSON (the
+  Pyroscope-push analog without a Pyroscope).
+
+Server wiring: ``GET /debug/profile`` and ``GET /debug/stacks`` in
+grove_tpu/server.py, gated by ``OperatorConfiguration.profiling.enabled``
+exactly as the reference gates pprof.
+
+A sampling (not tracing) profiler is the right tool here: it has ~zero
+overhead on the hot reconcile loops being measured, works across all
+threads, and needs nothing outside the stdlib.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+
+
+def dump_stacks() -> str:
+    """All-threads stack dump (goroutine-dump analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} (id {ident}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def _collapse(frame) -> str:
+    """One collapsed-stack line (root → leaf) for a frame."""
+    parts: list[str] = []
+    while frame is not None:
+        code = frame.f_code
+        mod = code.co_filename.rsplit("/", 1)[-1].removesuffix(".py")
+        parts.append(f"{mod}.{code.co_name}")
+        frame = frame.f_back
+    return ";".join(reversed(parts))
+
+
+class StackSampler:
+    """Samples every thread's stack at a fixed interval from a background
+    thread; aggregates identical stacks into counts."""
+
+    def __init__(self, interval: float = 0.01):
+        self.interval = interval
+        self._counts: collections.Counter[str] = collections.Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._t0 = 0.0
+        self.duration = 0.0
+
+    def start(self) -> "StackSampler":
+        assert self._thread is None, "sampler already started"
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._run,
+                                        name="stack-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.wait(self.interval):
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                self._counts[_collapse(frame)] += 1
+            self._samples += 1
+
+    def stop(self) -> "StackSampler":
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.duration = time.perf_counter() - self._t0
+        return self
+
+    @property
+    def samples(self) -> int:
+        return self._samples
+
+    def collapsed(self) -> str:
+        """Flamegraph-ready collapsed stacks, most frequent first."""
+        return "\n".join(f"{stack} {n}" for stack, n in
+                         self._counts.most_common()) + "\n"
+
+    def top(self, n: int = 20) -> list[dict]:
+        """Hottest leaf frames (self-time analog of ``pprof top``)."""
+        leaves: collections.Counter[str] = collections.Counter()
+        for stack, count in self._counts.items():
+            leaves[stack.rsplit(";", 1)[-1]] += count
+        total = sum(leaves.values()) or 1
+        return [{"func": f, "samples": c, "pct": round(100.0 * c / total, 1)}
+                for f, c in leaves.most_common(n)]
+
+
+def profile_window(seconds: float, interval: float = 0.01) -> StackSampler:
+    """Sample all threads for ``seconds``; returns the stopped sampler."""
+    s = StackSampler(interval=interval).start()
+    time.sleep(seconds)
+    return s.stop()
+
+
+class PhaseProfiler:
+    """Per-phase capture for scale/soak runs (Pyroscope-push analog:
+    one collapsed-stack artifact per phase, exported beside the timeline
+    JSON so run-over-run diffs are possible)."""
+
+    def __init__(self, enabled: bool = True, interval: float = 0.01):
+        self.enabled = enabled
+        self.interval = interval
+        self.phases: dict[str, StackSampler] = {}
+        self._active: tuple[str, StackSampler] | None = None
+
+    def __enter__(self) -> "PhaseProfiler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._active is not None:
+            self.end_phase()
+
+    def begin_phase(self, name: str) -> None:
+        if not self.enabled:
+            return
+        if self._active is not None:
+            self.end_phase()
+        self._active = (name, StackSampler(self.interval).start())
+
+    def end_phase(self) -> None:
+        if self._active is None:
+            return
+        name, sampler = self._active
+        self.phases[name] = sampler.stop()
+        self._active = None
+
+    def export_dir(self, path: str) -> dict:
+        """Write ``<phase>.collapsed`` per phase + a summary JSON; returns
+        the summary dict."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        summary = {}
+        for name, sampler in self.phases.items():
+            with open(os.path.join(path, f"{name}.collapsed"), "w") as f:
+                f.write(sampler.collapsed())
+            summary[name] = {"duration_s": round(sampler.duration, 3),
+                             "samples": sampler.samples,
+                             "top": sampler.top(10)}
+        with open(os.path.join(path, "profile-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        return summary

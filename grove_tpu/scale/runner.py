@@ -44,6 +44,9 @@ class ScaleConfig:
     deploy_timeout: float = 600.0  # reference budget: 10 min
     steady_window: float = 2.0
     poll: float = 0.05
+    # Per-phase sampling profiles exported here (the reference captures
+    # pprof per phase and pushes to Pyroscope, scale_test.go:131).
+    profile_dir: str | None = None
 
 
 def _fleet_for(pods: int) -> FleetSpec:
@@ -57,12 +60,16 @@ def _fleet_for(pods: int) -> FleetSpec:
 
 
 def run_scale_test(cfg: ScaleConfig) -> dict:
+    from grove_tpu.runtime.profiler import PhaseProfiler
+
     tracker = TimelineTracker()
+    profiler = PhaseProfiler(enabled=cfg.profile_dir is not None)
     cluster = new_cluster(fleet=_fleet_for(cfg.pods))
     per_clique = cfg.pods // cfg.cliques
     assert per_clique * cfg.cliques == cfg.pods, "pods must divide by cliques"
-    with cluster:
+    with cluster, profiler:
         client = cluster.client
+        profiler.begin_phase("deploy")
         pcs = PodCliqueSet(
             meta=new_meta(cfg.pcs_name),
             spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
@@ -108,6 +115,7 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
             raise TimeoutError(f"deploy milestones not reached: {missing}")
 
         # Steady-state no-op reconcile cost (reference scale_test.go:216-240)
+        profiler.begin_phase("steady-state")
         cluster.manager.wait_idle(timeout=30.0, settle=0.3)
         before = {name: v["reconciles"] for name, v in
                   cluster.manager.healthz()["controllers"].items()}
@@ -119,6 +127,7 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         steady_reconciles = sum(after[k] - before[k] for k in after)
 
         # Delete: request latency + full cascade
+        profiler.begin_phase("delete")
         t_del = time.time()
         client.delete(PodCliqueSet, cfg.pcs_name)
         delete_request_s = time.time() - t_del
@@ -144,6 +153,8 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
             "delete", "request-returned", "children-gone"),
         "timeline": tracker.export(),
     }
+    if cfg.profile_dir is not None:
+        result["profiles"] = profiler.export_dir(cfg.profile_dir)
     return result
 
 
@@ -162,8 +173,14 @@ def main(argv=None) -> int:
                              "hack/scale-history.py)")
     parser.add_argument("--label", default="",
                         help="tag for the history entry (e.g. round/commit)")
+    parser.add_argument("--profile-dir",
+                        help="capture per-phase sampling profiles "
+                             "(collapsed-stack files + summary) here — "
+                             "the Pyroscope-push analog")
     args = parser.parse_args(argv)
-    result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques))
+    result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques,
+                                        profile_dir=args.profile_dir))
+    result.pop("profiles", None)  # summarized in the dir, not the stdout line
     timeline = result.pop("timeline")
     if args.json:
         with open(args.json, "w") as f:

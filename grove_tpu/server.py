@@ -10,6 +10,12 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       selectors via ?l.<key>=<value>)
   GET  /api/<kind>/<name>             get one
   GET  /logs/<ns>/<pod>               pod logs (?tail=N; kubectl-logs analog)
+  GET  /debug/profile                 all-threads sampling profile over a
+                                      window (?seconds=, ?format=collapsed|
+                                      top); pprof-endpoint analog, gated by
+                                      config.profiling.enabled
+  GET  /debug/stacks                  all-threads stack dump (goroutine
+                                      dump analog; same gate)
   POST /apply                         YAML/JSON manifest (create-or-update)
   POST /metrics/push                  workload autoscaling signals
   DELETE /api/<kind>/<name>           delete
@@ -142,6 +148,10 @@ class ApiServer:
                     elif len(parts) == 3 and parts[0] == "logs":
                         self._pod_logs(parts[1], parts[2],
                                        parse_qs(url.query))
+                    elif url.path == "/debug/profile":
+                        self._debug_profile(parse_qs(url.query))
+                    elif url.path == "/debug/stacks":
+                        self._debug_stacks()
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -244,6 +254,54 @@ class ApiServer:
                     lines = data.splitlines()[-tail_n:] if tail_n > 0 else []
                     data = "\n".join(lines) + ("\n" if lines else "")
                 self._send(200, data, content_type="text/plain")
+
+            def _profiling_config(self):
+                """Profiling config when the surface is enabled, else None
+                (404 sent — the reference's pprof endpoints simply don't
+                exist unless config enables them, manager.go:115-123)."""
+                prof = cluster.manager.config.profiling
+                if not prof.enabled:
+                    self._send(404, {"error": "profiling disabled "
+                                     "(config: profiling.enabled)"})
+                    return None
+                return prof
+
+            def _debug_profile(self, q):
+                """GET /debug/profile?seconds=N&format=collapsed|top —
+                sample every thread's stack over the window."""
+                from grove_tpu.runtime.profiler import profile_window
+                prof = self._profiling_config()
+                if prof is None:
+                    return
+                try:
+                    seconds = float(q.get("seconds", ["1.0"])[0])
+                except ValueError:
+                    self._send(400, {"error": "bad seconds= value"})
+                    return
+                if not 0 < seconds <= prof.max_window_seconds:
+                    self._send(400, {"error": f"seconds must be in "
+                                     f"(0, {prof.max_window_seconds}]"})
+                    return
+                fmt = q.get("format", ["collapsed"])[0]
+                if fmt not in ("collapsed", "top"):
+                    self._send(400, {"error": "format must be "
+                                     "collapsed|top"})
+                    return
+                sampler = profile_window(
+                    seconds, interval=prof.sample_interval_ms / 1000.0)
+                if fmt == "top":
+                    self._send(200, {"seconds": seconds,
+                                     "samples": sampler.samples,
+                                     "top": sampler.top(30)})
+                else:
+                    self._send(200, sampler.collapsed(),
+                               content_type="text/plain")
+
+            def _debug_stacks(self):
+                from grove_tpu.runtime.profiler import dump_stacks
+                if self._profiling_config() is None:
+                    return
+                self._send(200, dump_stacks(), content_type="text/plain")
 
             def _metrics_push(self):
                 """Workload→control-plane metric ingestion: engines inside

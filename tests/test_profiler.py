@@ -1,0 +1,149 @@
+"""Sampling profiler (pprof/Pyroscope analog): all-threads stack
+sampling, collapsed-stack export, per-phase capture, and the config-gated
+HTTP debug surface."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from grove_tpu.runtime.profiler import (
+    PhaseProfiler,
+    StackSampler,
+    dump_stacks,
+    profile_window,
+)
+
+
+def _busy_marker_fn(stop: threading.Event) -> None:
+    while not stop.is_set():
+        sum(i * i for i in range(500))
+
+
+@pytest.fixture
+def busy_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                         name="busy-marker", daemon=True)
+    t.start()
+    yield
+    stop.set()
+    t.join()
+
+
+def test_sampler_sees_other_threads(busy_thread):
+    sampler = profile_window(0.3, interval=0.005)
+    assert sampler.samples > 10
+    collapsed = sampler.collapsed()
+    assert "_busy_marker_fn" in collapsed, collapsed[:500]
+    # collapsed format: "a;b;c N" per line
+    line = next(l for l in collapsed.splitlines() if "_busy_marker_fn" in l)
+    stack, _, count = line.rpartition(" ")
+    assert int(count) > 0 and ";" in stack
+
+
+def test_top_reports_leaf_percentages(busy_thread):
+    sampler = profile_window(0.3, interval=0.005)
+    top = sampler.top(10)
+    assert top and all({"func", "samples", "pct"} <= set(e) for e in top)
+    assert abs(sum(e["pct"] for e in sampler.top(10_000)) - 100.0) < 1.0
+
+
+def test_dump_stacks_includes_this_thread():
+    text = dump_stacks()
+    assert "test_dump_stacks_includes_this_thread" in text
+    assert "--- thread" in text
+
+
+def test_sampler_restart_refused():
+    s = StackSampler(interval=0.005).start()
+    with pytest.raises(AssertionError):
+        s.start()
+    s.stop()
+
+
+def test_phase_profiler_exports(tmp_path, busy_thread):
+    prof = PhaseProfiler(enabled=True, interval=0.005)
+    with prof:
+        prof.begin_phase("alpha")
+        time.sleep(0.15)
+        prof.begin_phase("beta")   # implicitly ends alpha
+        time.sleep(0.15)
+    assert set(prof.phases) == {"alpha", "beta"}
+    summary = prof.export_dir(str(tmp_path))
+    assert (tmp_path / "alpha.collapsed").exists()
+    assert (tmp_path / "beta.collapsed").exists()
+    assert (tmp_path / "profile-summary.json").exists()
+    assert summary["alpha"]["samples"] > 0
+    assert summary["alpha"]["duration_s"] > 0.1
+
+
+def test_phase_profiler_disabled_is_noop(tmp_path):
+    prof = PhaseProfiler(enabled=False)
+    with prof:
+        prof.begin_phase("alpha")
+    assert prof.phases == {}
+    assert prof.export_dir(str(tmp_path)) == {}
+
+
+# ---- HTTP debug surface -------------------------------------------------
+
+@pytest.fixture
+def server_factory():
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    stack = []
+
+    def make(profiling_enabled: bool):
+        cfg = OperatorConfiguration()
+        cfg.profiling.enabled = profiling_enabled
+        cl = new_cluster(config=cfg, fleet=FleetSpec(
+            slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
+        cl.start()
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        stack.append((cl, srv))
+        return f"http://127.0.0.1:{srv.port}"
+
+    yield make
+    for cl, srv in stack:
+        srv.stop()
+        cl.stop()
+
+
+def _get(base: str, path: str):
+    from grove_tpu.cli import _http
+    return _http(base, path)
+
+
+def test_debug_endpoints_gated_by_config(server_factory):
+    base = server_factory(profiling_enabled=False)
+    status, body = _get(base, "/debug/profile?seconds=0.1")
+    assert status == 404 and "disabled" in body["error"]
+    status, _ = _get(base, "/debug/stacks")
+    assert status == 404
+
+
+def test_debug_profile_and_stacks(server_factory, busy_thread):
+    base = server_factory(profiling_enabled=True)
+    status, text = _get(base, "/debug/profile?seconds=0.3")
+    assert status == 200 and "_busy_marker_fn" in text
+
+    status, payload = _get(base, "/debug/profile?seconds=0.2&format=top")
+    assert status == 200 and payload["samples"] > 0 and payload["top"]
+
+    status, text = _get(base, "/debug/stacks")
+    assert status == 200 and "--- thread" in text
+
+    # window cap + bad input
+    status, body = _get(base, "/debug/profile?seconds=9999")
+    assert status == 400
+    status, body = _get(base, "/debug/profile?seconds=nope")
+    assert status == 400
+    status, body = _get(base, "/debug/profile?seconds=0.1&format=wat")
+    assert status == 400
