@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench docs native lint clean
+.PHONY: test test-fast scale soak bench docs native lint clean ci render-deploy
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -29,6 +29,18 @@ native:          ## (re)build the C++ placement core
 
 serve:           ## run the control plane as a daemon with the HTTP API
 	$(PY) -m grove_tpu.cli serve --fleet v5e:4x4:2
+
+ci:              ## the CI gate (reference .github/workflows analog):
+	@#  lint (compile-check) → unit/e2e suite → budgeted scale point
+	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
+	$(PY) -m pytest tests/ -q
+	$(PY) -m grove_tpu.scale --pods 300 \
+		--history scale-history/ci.jsonl \
+		--label "ci-$$(git rev-parse --short HEAD 2>/dev/null || echo dev)"
+
+render-deploy:   ## render the GKE deploy bundle (Helm-chart analog)
+	$(PY) -m grove_tpu.cli render-deploy \
+		--values samples/deploy-values.yaml --target gke --out deploy/
 
 clean:
 	rm -rf pod-logs .pytest_cache grove_tpu/native/libplacement.so

@@ -144,6 +144,27 @@ def load_config(path: str) -> OperatorConfiguration:
     return cfg
 
 
+def load_token_file(path: str) -> dict[str, str]:
+    """Parse a ``token,actor`` lines file (kube-apiserver
+    --token-auth-file shape; rendered into the deploy bundle's Secret /
+    tokens file by grove_tpu/deploy.py) into a token→actor map."""
+    from grove_tpu.runtime.errors import ValidationError
+
+    tokens: dict[str, str] = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            token, sep, actor = line.partition(",")
+            if not sep or not token.strip() or not actor.strip():
+                raise ValidationError(
+                    f"token file {path!r} line {lineno}: expected "
+                    "'token,actor'")
+            tokens[token.strip()] = actor.strip()
+    return tokens
+
+
 def validate_config(cfg: OperatorConfiguration) -> list[str]:
     """Return a list of problems (empty == valid)."""
     errs: list[str] = []

@@ -96,10 +96,21 @@ def _table(rows) -> None:
 
 def _build_cluster(args: argparse.Namespace):
     """Shared bring-up for run/serve: config, fleet, --real agent."""
+    import os
     config = None
     if args.config:
         from grove_tpu.api.config import load_config
         config = load_config(args.config)
+    # Bearer tokens from a file (kube --token-auth-file analog; the
+    # deploy bundle mounts its Secret here via GROVE_TOKEN_FILE).
+    token_file = (getattr(args, "token_file", None)
+                  or os.environ.get("GROVE_TOKEN_FILE"))
+    if token_file:
+        from grove_tpu.api.config import OperatorConfiguration, \
+            load_token_file
+        if config is None:
+            config = OperatorConfiguration()
+        config.server_auth.tokens.update(load_token_file(token_file))
     fleet = parse_fleet(args.fleet)
     if args.real:
         fleet.fake = False
@@ -289,6 +300,30 @@ def cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_render_deploy(args: argparse.Namespace) -> int:
+    from grove_tpu.deploy import (
+        DeployValues,
+        load_values,
+        render_bundle,
+        validate_values,
+        write_bundle,
+    )
+    from grove_tpu.runtime.errors import ValidationError
+    try:
+        if args.values:
+            values = load_values(args.values)
+        else:
+            values = DeployValues()
+            validate_values(values)
+        files = render_bundle(values, args.target)
+    except ValidationError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    for path in write_bundle(files, args.out):
+        print(path)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="grovectl")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -318,7 +353,21 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--port", type=int, default=8087)
     serve.add_argument("--real", action="store_true")
     serve.add_argument("--config")
+    serve.add_argument("--token-file", dest="token_file",
+                       help="bearer tokens file, 'token,actor' per line "
+                            "(kube --token-auth-file analog; env "
+                            "GROVE_TOKEN_FILE)")
     serve.set_defaults(fn=cmd_serve)
+
+    render = sub.add_parser(
+        "render-deploy",
+        help="render the deploy bundle (Helm-chart analog): GKE "
+             "manifests or a systemd unit set from a values file")
+    render.add_argument("--values", help="values YAML (defaults if omitted)")
+    render.add_argument("--target", choices=("gke", "systemd"),
+                        default="gke")
+    render.add_argument("--out", required=True, help="output directory")
+    render.set_defaults(fn=cmd_render_deploy)
 
     run = sub.add_parser("run", help="run a cluster, apply manifests, report")
     run.add_argument("--fleet", default="v5e:4x4:2",

@@ -1,0 +1,292 @@
+"""Deploy bundle renderer — the Helm-chart analog (reference T1,
+operator/charts/templates/*.yaml).
+
+The reference packages its operator as a Helm chart: Deployment (+
+install-crds init container), webhook configs, RBAC, a ConfigMap'd
+OperatorConfiguration, and a PriorityClass. grove-tpu is a standalone
+control plane, so its deploy story has two targets rendered from one
+values file:
+
+- ``gke`` — Kubernetes manifests to run the serve daemon in-cluster on a
+  CPU node pool next to the TPU node pools it orchestrates: Namespace,
+  ServiceAccount, PriorityClass, ConfigMap (OperatorConfiguration),
+  Secret (API bearer tokens), Deployment (readiness on /healthz), and a
+  Service fronting the HTTP API. Webhook configs and install-crds have
+  no analog — admission is in-process and the typed API is the schema
+  (PARITY.md A7/W1).
+- ``systemd`` — unit + config + token env file + install script for a
+  GCE controller VM (the non-k8s footprint the reference never had).
+
+Rendering is pure: ``render_bundle(values) -> {filename: content}``;
+the CLI verb ``grovectl render-deploy`` writes the files. Values load
+strictly (unknown keys rejected) like the operator config itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import secrets
+
+import yaml
+
+from grove_tpu.api.serde import from_dict, to_dict, unknown_keys
+from grove_tpu.runtime.errors import ValidationError
+
+_DNS_LABEL = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+AUTO_TOKEN = "auto"  # value sentinel: generate a fresh token at render
+
+
+@dataclasses.dataclass
+class DeployResources:
+    cpu: str = "2"
+    memory: str = "2Gi"
+
+
+@dataclasses.dataclass
+class DeployValues:
+    """values.yaml schema (the chart's values analog)."""
+
+    name: str = "grove-tpu"
+    namespace: str = "grove-system"
+    # gke target
+    image: str = "grove-tpu:latest"
+    replicas: int = 1
+    priority_class: str = "grove-tpu-critical"
+    priority_value: int = 1000000
+    resources: DeployResources = dataclasses.field(
+        default_factory=DeployResources)
+    # both targets
+    host: str = "0.0.0.0"
+    port: int = 8087
+    fleet: str = ""            # e.g. "v5e:4x4:2" (empty = discover/none)
+    # actor -> token; token value "auto" generates one at render time
+    tokens: dict[str, str] = dataclasses.field(
+        default_factory=lambda: {"system:grove-operator": AUTO_TOKEN})
+    # OperatorConfiguration overrides, embedded verbatim into the
+    # rendered config file (strict-checked against the config schema).
+    config: dict = dataclasses.field(default_factory=dict)
+    # systemd target
+    user: str = "grove"
+    install_dir: str = "/opt/grove-tpu"
+
+
+def load_values(path: str) -> DeployValues:
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    unknown = unknown_keys(DeployValues, data)
+    if unknown:
+        raise ValidationError(
+            f"deploy values {path!r}: unknown keys {unknown}")
+    values = from_dict(DeployValues, data)
+    validate_values(values)
+    return values
+
+
+def validate_values(v: DeployValues) -> None:
+    errs = []
+    for field in ("name", "namespace"):
+        val = getattr(v, field)
+        if not _DNS_LABEL.match(val) or len(val) > 63:
+            errs.append(f"{field} {val!r} must be a DNS label (<= 63 chars)")
+    if v.replicas < 1:
+        errs.append(f"replicas must be >= 1, got {v.replicas}")
+    if not v.image:
+        errs.append("image must not be empty")
+    if not 0 < v.port < 65536:
+        errs.append(f"port must be in (0, 65536), got {v.port}")
+    if v.config:
+        from grove_tpu.api.config import OperatorConfiguration
+        unknown = unknown_keys(OperatorConfiguration, v.config)
+        if unknown:
+            errs.append(f"config overrides: unknown keys {unknown}")
+    if errs:
+        raise ValidationError("deploy values invalid: " + "; ".join(errs))
+
+
+def _resolve_tokens(v: DeployValues) -> dict[str, str]:
+    """actor -> concrete token (AUTO_TOKEN replaced with a fresh one)."""
+    return {actor: (secrets.token_urlsafe(24) if tok == AUTO_TOKEN else tok)
+            for actor, tok in v.tokens.items()}
+
+
+def _operator_config_yaml(v: DeployValues) -> str:
+    """The ConfigMap'd OperatorConfiguration content. Overrides are
+    strict-checked in validate_values; defaults come from the dataclass
+    so the rendered file is complete and self-documenting."""
+    from grove_tpu.api.config import OperatorConfiguration
+    cfg = to_dict(from_dict(OperatorConfiguration, v.config))
+    # server_auth.tokens land in the Secret / tokens.env, never in the
+    # world-readable config.
+    cfg["server_auth"]["tokens"] = {}
+    return yaml.safe_dump(cfg, sort_keys=False)
+
+
+def _labels(v: DeployValues) -> dict[str, str]:
+    return {"app.kubernetes.io/name": v.name,
+            "app.kubernetes.io/managed-by": "grovectl"}
+
+
+def _serve_args(v: DeployValues, config_path: str) -> list[str]:
+    args = ["serve", "--host", v.host, "--port", str(v.port),
+            "--config", config_path]
+    if v.fleet:
+        args += ["--fleet", v.fleet]
+    return args
+
+
+def render_gke(v: DeployValues) -> dict[str, str]:
+    labels = _labels(v)
+    tokens = _resolve_tokens(v)
+    # token file format consumed at startup: "token,actor" per line (the
+    # kube-apiserver --token-auth-file shape).
+    token_lines = "".join(f"{tok},{actor}\n" for actor, tok in tokens.items())
+
+    def manifest(obj) -> str:
+        return yaml.safe_dump(obj, sort_keys=False)
+
+    deployment = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": v.name, "namespace": v.namespace,
+                     "labels": labels},
+        "spec": {
+            "replicas": v.replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "serviceAccountName": v.name,
+                    "priorityClassName": v.priority_class,
+                    "containers": [{
+                        "name": "controller",
+                        "image": v.image,
+                        "args": _serve_args(v, "/etc/grove/config.yaml"),
+                        "ports": [{"name": "api",
+                                   "containerPort": v.port}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/healthz", "port": v.port},
+                            "periodSeconds": 5},
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": v.port},
+                            "initialDelaySeconds": 10,
+                            "periodSeconds": 10},
+                        "resources": {
+                            "requests": {"cpu": v.resources.cpu,
+                                         "memory": v.resources.memory}},
+                        "volumeMounts": [
+                            {"name": "config", "mountPath": "/etc/grove"},
+                            {"name": "tokens",
+                             "mountPath": "/etc/grove-tokens",
+                             "readOnly": True}],
+                        "env": [{
+                            "name": "GROVE_TOKEN_FILE",
+                            "value": "/etc/grove-tokens/tokens"}],
+                    }],
+                    "volumes": [
+                        {"name": "config",
+                         "configMap": {"name": f"{v.name}-config"}},
+                        {"name": "tokens",
+                         "secret": {"secretName": f"{v.name}-tokens"}}],
+                },
+            },
+        },
+    }
+    return {
+        "namespace.yaml": manifest({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": v.namespace, "labels": labels}}),
+        "serviceaccount.yaml": manifest({
+            "apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": v.name, "namespace": v.namespace,
+                         "labels": labels}}),
+        "priorityclass.yaml": manifest({
+            "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+            "metadata": {"name": v.priority_class, "labels": labels},
+            "value": v.priority_value,
+            "globalDefault": False,
+            "description": "grove-tpu control plane priority"}),
+        "configmap-operator.yaml": manifest({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": f"{v.name}-config",
+                         "namespace": v.namespace, "labels": labels},
+            "data": {"config.yaml": _operator_config_yaml(v)}}),
+        "secret-tokens.yaml": manifest({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": f"{v.name}-tokens",
+                         "namespace": v.namespace, "labels": labels},
+            "type": "Opaque",
+            "stringData": {"tokens": token_lines}}),
+        "deployment.yaml": manifest(deployment),
+        "service.yaml": manifest({
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": v.name, "namespace": v.namespace,
+                         "labels": labels},
+            "spec": {"selector": labels,
+                     "ports": [{"name": "api", "port": v.port,
+                                "targetPort": v.port}]}}),
+    }
+
+
+def render_systemd(v: DeployValues) -> dict[str, str]:
+    tokens = _resolve_tokens(v)
+    token_lines = "".join(f"{tok},{actor}\n" for actor, tok in tokens.items())
+    args = " ".join(_serve_args(v, f"{v.install_dir}/config.yaml"))
+    unit = f"""\
+[Unit]
+Description=grove-tpu control plane
+After=network-online.target
+Wants=network-online.target
+
+[Service]
+User={v.user}
+WorkingDirectory={v.install_dir}
+Environment=GROVE_TOKEN_FILE={v.install_dir}/tokens
+ExecStart=/usr/bin/env python3 -m grove_tpu.cli {args}
+Restart=on-failure
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+"""
+    install = f"""\
+#!/bin/sh
+# Install the grove-tpu control plane as a systemd service.
+set -eu
+install -d -m 755 {v.install_dir}
+install -m 644 config.yaml {v.install_dir}/config.yaml
+install -m 600 tokens {v.install_dir}/tokens
+install -m 644 {v.name}.service /etc/systemd/system/{v.name}.service
+systemctl daemon-reload
+systemctl enable --now {v.name}.service
+"""
+    return {
+        f"{v.name}.service": unit,
+        "config.yaml": _operator_config_yaml(v),
+        "tokens": token_lines,
+        "install.sh": install,
+    }
+
+
+def render_bundle(v: DeployValues, target: str) -> dict[str, str]:
+    if target == "gke":
+        return render_gke(v)
+    if target == "systemd":
+        return render_systemd(v)
+    raise ValidationError(f"unknown deploy target {target!r} "
+                          "(expected gke|systemd)")
+
+
+def write_bundle(files: dict[str, str], out_dir: str) -> list[str]:
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for name, content in sorted(files.items()):
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            f.write(content)
+        if name in ("tokens",) or name.startswith("secret-"):
+            os.chmod(path, 0o600)
+        written.append(path)
+    return written

@@ -1,0 +1,167 @@
+"""Deploy bundle renderer (Helm-chart analog, reference T1
+operator/charts/templates/) + the token-file auth path it feeds."""
+
+from __future__ import annotations
+
+import yaml
+import pytest
+
+from grove_tpu.deploy import (
+    AUTO_TOKEN,
+    DeployValues,
+    load_values,
+    render_bundle,
+    validate_values,
+    write_bundle,
+)
+from grove_tpu.runtime.errors import ValidationError
+
+
+def test_gke_bundle_complete_and_parseable():
+    files = render_bundle(DeployValues(), "gke")
+    assert set(files) == {"namespace.yaml", "serviceaccount.yaml",
+                          "priorityclass.yaml", "configmap-operator.yaml",
+                          "secret-tokens.yaml", "deployment.yaml",
+                          "service.yaml"}
+    parsed = {name: yaml.safe_load(content)
+              for name, content in files.items()}
+    dep = parsed["deployment.yaml"]
+    # wiring: deployment mounts the rendered ConfigMap and Secret
+    vols = {v["name"]: v for v in
+            dep["spec"]["template"]["spec"]["volumes"]}
+    assert vols["config"]["configMap"]["name"] == \
+        parsed["configmap-operator.yaml"]["metadata"]["name"]
+    assert vols["tokens"]["secret"]["secretName"] == \
+        parsed["secret-tokens.yaml"]["metadata"]["name"]
+    ctr = dep["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert dep["spec"]["template"]["spec"]["priorityClassName"] == \
+        parsed["priorityclass.yaml"]["metadata"]["name"]
+    # the service selects the deployment's pods
+    assert parsed["service.yaml"]["spec"]["selector"] == \
+        dep["spec"]["selector"]["matchLabels"]
+
+
+def test_embedded_operator_config_is_valid_and_tokenless():
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.serde import from_dict, unknown_keys
+
+    files = render_bundle(
+        DeployValues(config={"autoscaler": {"enabled": False}}), "gke")
+    cm = yaml.safe_load(files["configmap-operator.yaml"])
+    data = yaml.safe_load(cm["data"]["config.yaml"])
+    assert unknown_keys(OperatorConfiguration, data) == []
+    cfg = from_dict(OperatorConfiguration, data)
+    assert cfg.autoscaler.enabled is False            # override survived
+    assert cfg.server_auth.tokens == {}               # secrets not in CM
+
+
+def test_auto_tokens_resolved_and_secret_shaped():
+    files = render_bundle(DeployValues(), "gke")
+    secret = yaml.safe_load(files["secret-tokens.yaml"])
+    lines = [l for l in secret["stringData"]["tokens"].splitlines() if l]
+    assert len(lines) == 1
+    token, actor = lines[0].split(",")
+    assert actor == "system:grove-operator"
+    assert token != AUTO_TOKEN and len(token) > 20
+    # each render generates fresh tokens
+    files2 = render_bundle(DeployValues(), "gke")
+    assert files2["secret-tokens.yaml"] != files["secret-tokens.yaml"]
+
+
+def test_systemd_bundle():
+    v = DeployValues(name="grove-ctl", fleet="v5e:4x4:2")
+    files = render_bundle(v, "systemd")
+    assert set(files) == {"grove-ctl.service", "config.yaml", "tokens",
+                          "install.sh"}
+    unit = files["grove-ctl.service"]
+    assert "-m grove_tpu.cli serve" in unit
+    assert "--fleet v5e:4x4:2" in unit
+    assert f"GROVE_TOKEN_FILE={v.install_dir}/tokens" in unit
+    assert "systemctl enable --now grove-ctl.service" in files["install.sh"]
+
+
+def test_values_validation():
+    with pytest.raises(ValidationError, match="DNS label"):
+        validate_values(DeployValues(name="Not_A_Label"))
+    with pytest.raises(ValidationError, match="replicas"):
+        validate_values(DeployValues(replicas=0))
+    with pytest.raises(ValidationError, match="unknown keys"):
+        validate_values(DeployValues(config={"autoscalr": {}}))
+    with pytest.raises(ValidationError, match="unknown deploy target"):
+        render_bundle(DeployValues(), "helm")
+
+
+def test_load_values_strict(tmp_path):
+    p = tmp_path / "values.yaml"
+    p.write_text("name: custom\nreplicsa: 2\n")
+    with pytest.raises(ValidationError, match="unknown keys"):
+        load_values(str(p))
+    p.write_text("name: custom\nreplicas: 2\n")
+    v = load_values(str(p))
+    assert v.name == "custom" and v.replicas == 2
+
+
+def test_write_bundle_secret_modes(tmp_path):
+    import os
+    files = render_bundle(DeployValues(), "systemd")
+    written = write_bundle(files, str(tmp_path / "out"))
+    assert len(written) == 4
+    mode = os.stat(tmp_path / "out" / "tokens").st_mode & 0o777
+    assert mode == 0o600
+
+
+def test_cli_render_deploy(tmp_path, capsys):
+    from grove_tpu.cli import main
+    rc = main(["render-deploy", "--target", "gke",
+               "--out", str(tmp_path / "gke")])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    assert len(out) == 7 and all((tmp_path / "gke").as_posix() in l
+                                 for l in out)
+
+
+def test_token_file_feeds_server_auth(tmp_path):
+    """The rendered tokens file authenticates wire mutations end-to-end:
+    GROVE_TOKEN_FILE → ServerAuthConfig → admission on the HTTP path."""
+    from grove_tpu.api.config import OperatorConfiguration, load_token_file
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+    from grove_tpu.cli import _http
+
+    tf = tmp_path / "tokens"
+    tf.write_text("# comment\n\nsekret-abc,system:grove-operator\n"
+                  "user-tok,user:alice\n")
+    tokens = load_token_file(str(tf))
+    assert tokens == {"sekret-abc": "system:grove-operator",
+                      "user-tok": "user:alice"}
+
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens.update(tokens)
+    cl = new_cluster(config=cfg, fleet=FleetSpec(
+        slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    manifest = ("kind: PodCliqueSet\nmetadata: {name: tf-pcs}\n"
+                "spec:\n  replicas: 1\n  template:\n    cliques:\n"
+                "      - {name: w, replicas: 1, tpu_chips_per_pod: 4}\n")
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            status, _ = _http(base, "/apply", method="POST",
+                              body=manifest.encode(), token="wrong")
+            assert status == 401
+            status, out = _http(base, "/apply", method="POST",
+                                body=manifest.encode(), token="sekret-abc")
+            assert status == 200 and out[0]["action"] == "created"
+        finally:
+            srv.stop()
+
+
+def test_token_file_rejects_malformed(tmp_path):
+    from grove_tpu.api.config import load_token_file
+    tf = tmp_path / "tokens"
+    tf.write_text("justatokennoactor\n")
+    with pytest.raises(ValidationError, match="line 1"):
+        load_token_file(str(tf))
