@@ -105,6 +105,88 @@ def unknown_keys(cls: type, data: Any, prefix: str = "") -> list[str]:
     return problems
 
 
+def type_problems(obj: Any, prefix: str = "") -> list[str]:
+    """Recursively check a dataclass instance's field values against its
+    type hints; returns problem paths ("spec.replicas: expected int, got
+    dict"). ``from_dict`` passes scalars through untouched, so a
+    wrong-typed leaf (a dict where an int belongs) survives decoding —
+    this is the companion check that catches it before the object enters
+    the store."""
+    problems: list[str] = []
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        cls = type(obj)
+        if cls not in _HINTS_CACHE:
+            _HINTS_CACHE[cls] = get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            path = f"{prefix}.{f.name}" if prefix else f.name
+            _check_type(_HINTS_CACHE[cls][f.name], getattr(obj, f.name),
+                        path, problems)
+    return problems
+
+
+def _check_type(tp: Any, value: Any, path: str, problems: list[str]) -> None:
+    origin = get_origin(tp)
+    if tp is Any:
+        return
+    if origin is typing.Union or origin is types.UnionType:
+        if value is None and type(None) in get_args(tp):
+            return
+        stripped = _strip_optional(tp)
+        if stripped is tp:       # true multi-type union: accept
+            return
+        tp, origin = stripped, get_origin(stripped)
+    if value is None:
+        problems.append(f"{path}: expected {_tpname(tp)}, got null")
+        return
+    if origin in (list, tuple):
+        if not isinstance(value, (list, tuple)):
+            problems.append(f"{path}: expected list, got "
+                            f"{type(value).__name__}")
+            return
+        (elem,) = get_args(tp) or (Any,)
+        for i, item in enumerate(value):
+            _check_type(elem, item, f"{path}[{i}]", problems)
+        return
+    if origin is dict:
+        if not isinstance(value, dict):
+            problems.append(f"{path}: expected dict, got "
+                            f"{type(value).__name__}")
+            return
+        args = get_args(tp)
+        vt = args[1] if len(args) == 2 else Any
+        for k, v in value.items():
+            _check_type(vt, v, f"{path}[{k!r}]", problems)
+        return
+    if dataclasses.is_dataclass(tp):
+        if not isinstance(value, tp):
+            problems.append(f"{path}: expected {_tpname(tp)}, got "
+                            f"{type(value).__name__}")
+        else:
+            problems.extend(type_problems(value, path))
+        return
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        if not isinstance(value, tp):
+            problems.append(f"{path}: expected {_tpname(tp)}, got "
+                            f"{value!r}")
+        return
+    if tp is float:
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            problems.append(f"{path}: expected float, got "
+                            f"{type(value).__name__}")
+        return
+    if tp in (int, str, bool):
+        if not isinstance(value, tp) or (tp is int
+                                         and isinstance(value, bool)):
+            problems.append(f"{path}: expected {_tpname(tp)}, got "
+                            f"{type(value).__name__}")
+        return
+    # unhandled hint shapes (e.g. protocols): accept
+
+
+def _tpname(tp: Any) -> str:
+    return getattr(tp, "__name__", str(tp))
+
+
 def clone(obj: T) -> T:
     """Deep copy an API object (the zz_generated deepcopy analog).
 

@@ -290,6 +290,25 @@ def cmd_apply(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_patch(args: argparse.Namespace) -> int:
+    """JSON-merge-patch a resource on a running serve daemon."""
+    import json as _json
+    try:
+        _json.loads(args.patch)
+    except ValueError as e:
+        print(f"error: patch is not valid JSON: {e}", file=sys.stderr)
+        return 1
+    status, out = _http(args.server, f"/api/{args.kind}/{args.name}",
+                        "PATCH", args.patch.encode(),
+                        content_type="application/merge-patch+json")
+    if status != 200:
+        print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
+        return 1
+    print(f"{args.kind}/{args.name} patched "
+          f"(generation {out['meta']['generation']})")
+    return 0
+
+
 def cmd_delete(args: argparse.Namespace) -> int:
     """Delete a resource on a running serve daemon."""
     status, out = _http(args.server, f"/api/{args.kind}/{args.name}", "DELETE")
@@ -339,6 +358,16 @@ def main(argv: list[str] | None = None) -> int:
     apply_p.add_argument("-f", "--file", required=True)
     apply_p.add_argument("--server", default=default_server)
     apply_p.set_defaults(fn=cmd_apply)
+
+    patch_p = sub.add_parser(
+        "patch", help="JSON-merge-patch a resource on a serve daemon "
+                      "(spec/labels/annotations)")
+    patch_p.add_argument("kind")
+    patch_p.add_argument("name")
+    patch_p.add_argument("-p", "--patch", required=True,
+                         help='e.g. \'{"spec": {"replicas": 3}}\'')
+    patch_p.add_argument("--server", default=default_server)
+    patch_p.set_defaults(fn=cmd_patch)
 
     delete = sub.add_parser("delete", help="delete a resource on a serve daemon")
     delete.add_argument("kind")

@@ -22,7 +22,7 @@ from grove_tpu.api import (
 )
 from grove_tpu.api.core import Service
 from grove_tpu.api.meta import ObjectMeta, new_meta
-from grove_tpu.api.serde import from_dict
+from grove_tpu.api.serde import from_dict, type_problems, unknown_keys
 from grove_tpu.runtime.errors import ValidationError
 from grove_tpu.runtime.events import Event
 
@@ -51,7 +51,16 @@ def load_object(doc: dict[str, Any]) -> Any:
         spec_cls = type(obj.spec) if hasattr(obj, "spec") else None
         if spec_cls is None:
             raise ValidationError(f"{kind} does not take a spec")
+        # Strict decode, same posture as the operator config: a typo'd
+        # key silently becoming a default is the worst failure mode, and
+        # from_dict passes wrong-typed scalars through untouched.
+        unknown = unknown_keys(spec_cls, doc["spec"], prefix="spec")
+        if unknown:
+            raise ValidationError(f"{kind}: unknown keys {unknown}")
         obj.spec = from_dict(spec_cls, doc["spec"])
+        problems = type_problems(obj.spec, prefix="spec")
+        if problems:
+            raise ValidationError(f"{kind}: " + "; ".join(problems))
     return obj
 
 

@@ -17,6 +17,8 @@ control plane exposes its own minimal HTTP API so out-of-process clients
   GET  /debug/stacks                  all-threads stack dump (goroutine
                                       dump analog; same gate)
   POST /apply                         YAML/JSON manifest (create-or-update)
+  PATCH /api/<kind>/<name>            RFC 7386 JSON merge patch on
+                                      spec/labels/annotations
   POST /metrics/push                  workload autoscaling signals
   DELETE /api/<kind>/<name>           delete
 
@@ -328,6 +330,34 @@ class ApiServer:
                 except (KeyError, TypeError, ValueError) as e:
                     self._send(400, {"error": f"bad metric payload: {e}; "
                                      "need kind/name/metric/value"})
+
+            def do_PATCH(self):
+                parts = [p for p in urlparse(self.path).path.split("/")
+                         if p]
+                if len(parts) != 3 or parts[0] != "api":
+                    self._send(404, {"error": "PATCH /api/<kind>/<name>"})
+                    return
+                cls = self._kind(parts[1])
+                if cls is None:
+                    return
+                client = self._mutating_client()
+                if client is None:
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    patch = json.loads(self.rfile.read(length) or b"")
+                except ValueError as e:
+                    self._send(400, {"error": f"bad patch JSON: {e}"})
+                    return
+                try:
+                    updated = client.patch(cls, parts[2], patch)
+                    self._send(200, to_dict(updated))
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except ForbiddenError as e:
+                    self._send(403, {"error": str(e)})
+                except GroveError as e:
+                    self._send(400, {"error": str(e)})
 
             def do_DELETE(self):
                 parts = [p for p in urlparse(self.path).path.split("/") if p]

@@ -48,6 +48,22 @@ class Client:
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         return self._store.delete(kind_cls, name, namespace, actor=self.actor)
 
+    def patch(self, kind_cls: type, name: str, patch: dict,
+              namespace: str = "default", retries: int = 3) -> Any:
+        """JSON-merge-patch (RFC 7386) against spec/labels/annotations
+        with a bounded optimistic-concurrency retry (the client-go
+        MergeFrom analog — see store/patch.py)."""
+        from grove_tpu.runtime.errors import ConflictError
+        from grove_tpu.store.patch import apply_patch
+        last: Exception | None = None
+        for _ in range(max(1, retries)):
+            live = self.get(kind_cls, name, namespace)
+            try:
+                return self.update(apply_patch(live, patch))
+            except ConflictError as e:  # raced a writer; re-read and retry
+                last = e
+        raise last
+
     def watch(self, kinds: Iterable[str] | None = None,
               selector: dict[str, str] | None = None) -> Watcher:
         return self._store.watch(kinds, selector)
@@ -134,6 +150,14 @@ class FakeClient(Client):
             except (NotFoundError, ConflictError) as e:
                 results.append(e)
         return results
+
+    def patch(self, kind_cls: type, name: str, patch: dict,
+              namespace: str = "default", retries: int = 3) -> Any:
+        # Recorded as its own verb; the get/update it decomposes into
+        # are ALSO recorded and injectable — patch retry behavior is
+        # exactly what failure-injection tests want to poke.
+        self._intercept("patch", kind_cls.KIND, name)
+        return super().patch(kind_cls, name, patch, namespace, retries)
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         self._intercept("delete", kind_cls.KIND, name)

@@ -1,0 +1,86 @@
+"""JSON-merge-patch support — the reference's patch/apply-helper analog.
+
+Reference R8 (operator/internal/utils/kubernetes/) wraps client-go's
+patch machinery (MergeFrom / server-side apply) so controllers and
+tooling can mutate a narrow slice of an object without round-tripping
+the whole spec through read-modify-write conflicts. Here the analog is:
+
+- ``json_merge_patch`` — RFC 7386 on plain data: dicts merge
+  recursively, ``null`` deletes a key, everything else replaces.
+- ``apply_patch`` — apply a merge patch to a typed API object's
+  mutable surface (``spec`` + ``metadata.labels``/``annotations``);
+  identity/system fields (name, uid, resourceVersion, status…) are
+  rejected, mirroring what the apiserver refuses or what belongs to the
+  status subresource.
+- ``Client.patch`` (store/client.py) — get → apply → update with a
+  bounded optimistic-concurrency retry, so callers patch without
+  holding a fresh read. Exposed on the wire as
+  ``PATCH /api/<kind>/<name>`` and as ``grovectl patch``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from grove_tpu.api.serde import from_dict, to_dict, type_problems
+from grove_tpu.runtime.errors import ValidationError
+
+# metadata keys a patch may touch; everything else in metadata is
+# identity/bookkeeping owned by the store.
+_PATCHABLE_META = {"labels", "annotations"}
+
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386: returns the patched copy of ``target``."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    result = dict(target) if isinstance(target, dict) else {}
+    for key, value in patch.items():
+        if value is None:
+            result.pop(key, None)
+        else:
+            result[key] = json_merge_patch(result.get(key), value)
+    return result
+
+
+def apply_patch(obj: Any, patch: dict) -> Any:
+    """Apply a merge patch to a typed object; returns a new object.
+
+    Allowed top-level keys: ``spec`` and ``metadata`` (labels /
+    annotations only). Unknown or immutable keys raise ValidationError —
+    a patch that silently ignored half its content would be worse than
+    one that fails."""
+    if not isinstance(patch, dict):
+        raise ValidationError("patch must be a JSON object")
+    allowed = {"spec", "metadata"}
+    unknown = set(patch) - allowed
+    if unknown:
+        raise ValidationError(
+            f"patch keys {sorted(unknown)} not patchable "
+            f"(allowed: {sorted(allowed)}; status has no patch surface)")
+    meta_patch = patch.get("metadata", {})
+    if not isinstance(meta_patch, dict):
+        raise ValidationError("patch metadata must be a JSON object")
+    bad_meta = set(meta_patch) - _PATCHABLE_META
+    if bad_meta:
+        raise ValidationError(
+            f"metadata keys {sorted(bad_meta)} not patchable "
+            f"(allowed: {sorted(_PATCHABLE_META)})")
+
+    cls = type(obj)
+    data = to_dict(obj)
+    if "spec" in patch:
+        data["spec"] = json_merge_patch(data.get("spec"), patch["spec"])
+    for key in _PATCHABLE_META & set(meta_patch):
+        data["meta"][key] = json_merge_patch(
+            data["meta"].get(key), meta_patch[key])
+    try:
+        patched = from_dict(cls, data)
+    except (TypeError, ValueError, KeyError) as e:
+        raise ValidationError(f"patch does not fit {cls.KIND} schema: {e}")
+    problems = type_problems(patched)
+    if problems:
+        raise ValidationError(
+            f"patch does not fit {cls.KIND} schema: " + "; ".join(problems))
+    return patched

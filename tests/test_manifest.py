@@ -1,0 +1,60 @@
+"""Manifest codec: strict decoding of user YAML (typo'd keys and
+wrong-typed leaves must fail loudly, not silently become defaults)."""
+
+import pytest
+
+from grove_tpu.manifest import load_manifest, load_object
+from grove_tpu.runtime.errors import ValidationError
+
+GOOD = """
+kind: PodCliqueSet
+metadata: {name: ok}
+spec:
+  replicas: 2
+  template:
+    cliques:
+      - {name: w, replicas: 2, tpu_chips_per_pod: 4}
+---
+kind: ClusterTopology
+metadata: {name: topo}
+"""
+
+
+def test_multi_doc_manifest_loads():
+    objs = load_manifest(GOOD)
+    assert [o.KIND for o in objs] == ["PodCliqueSet", "ClusterTopology"]
+    assert objs[0].spec.replicas == 2
+    assert objs[0].spec.template.cliques[0].tpu_chips_per_pod == 4
+
+
+def test_unknown_spec_key_rejected():
+    doc = {"kind": "PodCliqueSet", "metadata": {"name": "x"},
+           "spec": {"replicsa": 2}}
+    with pytest.raises(ValidationError, match="spec.replicsa"):
+        load_object(doc)
+
+
+def test_nested_unknown_key_rejected():
+    doc = {"kind": "PodCliqueSet", "metadata": {"name": "x"},
+           "spec": {"template": {"cliques": [
+               {"name": "w", "replicaz": 2}]}}}
+    with pytest.raises(ValidationError, match="replicaz"):
+        load_object(doc)
+
+
+def test_wrong_typed_leaf_rejected():
+    doc = {"kind": "PodCliqueSet", "metadata": {"name": "x"},
+           "spec": {"replicas": {"oops": 1}}}
+    with pytest.raises(ValidationError, match="spec.replicas"):
+        load_object(doc)
+    doc = {"kind": "PodCliqueSet", "metadata": {"name": "x"},
+           "spec": {"replicas": "two"}}
+    with pytest.raises(ValidationError, match="expected int"):
+        load_object(doc)
+
+
+def test_unknown_kind_and_missing_name():
+    with pytest.raises(ValidationError, match="unknown kind"):
+        load_object({"kind": "PodSet", "metadata": {"name": "x"}})
+    with pytest.raises(ValidationError, match="metadata.name"):
+        load_object({"kind": "PodCliqueSet", "metadata": {}})

@@ -1,0 +1,172 @@
+"""JSON-merge-patch (R8 patch/apply-helper analog): RFC 7386 semantics,
+the typed-object surface restriction, client conflict retry, and the
+wire path (PATCH verb + grovectl patch)."""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.api import Pod, PodCliqueSet, new_meta
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.runtime.errors import ConflictError, ValidationError
+from grove_tpu.store.client import FakeClient
+from grove_tpu.store.patch import apply_patch, json_merge_patch
+
+
+def pcs(name="web", replicas=1):
+    return PodCliqueSet(
+        meta=new_meta(name, labels={"team": "infra"}),
+        spec=PodCliqueSetSpec(replicas=replicas, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, tpu_chips_per_pod=4,
+                container=ContainerSpec(argv=["sleep", "inf"]))])))
+
+
+# ---- RFC 7386 ----------------------------------------------------------
+
+def test_merge_patch_semantics():
+    target = {"a": {"b": 1, "c": 2}, "d": [1, 2], "e": "x"}
+    patch = {"a": {"b": 9, "c": None}, "d": [3], "f": {"g": 1}}
+    got = json_merge_patch(target, patch)
+    assert got == {"a": {"b": 9}, "d": [3], "e": "x", "f": {"g": 1}}
+    # null deletes; scalars/lists replace wholesale; target untouched
+    assert target["a"] == {"b": 1, "c": 2}
+    assert json_merge_patch({"a": 1}, "scalar") == "scalar"
+    assert json_merge_patch("scalar", {"a": 1}) == {"a": 1}
+
+
+# ---- typed surface -----------------------------------------------------
+
+def test_apply_patch_spec_and_labels():
+    obj = pcs()
+    out = apply_patch(obj, {"spec": {"replicas": 3},
+                            "metadata": {"labels": {"tier": "prod",
+                                                    "team": None}}})
+    assert out.spec.replicas == 3
+    assert out.meta.labels == {"tier": "prod"}
+    # untouched nested spec survives
+    assert out.spec.template.cliques[0].replicas == 2
+    # original object untouched
+    assert obj.spec.replicas == 1 and obj.meta.labels == {"team": "infra"}
+
+
+def test_apply_patch_rejects_immutable_surfaces():
+    obj = pcs()
+    with pytest.raises(ValidationError, match="not patchable"):
+        apply_patch(obj, {"status": {"available_replicas": 5}})
+    with pytest.raises(ValidationError, match="not patchable"):
+        apply_patch(obj, {"metadata": {"name": "stolen"}})
+    with pytest.raises(ValidationError, match="JSON object"):
+        apply_patch(obj, ["not", "a", "dict"])
+    with pytest.raises(ValidationError, match="schema"):
+        apply_patch(obj, {"spec": {"replicas": {"not": "an int"}}})
+
+
+# ---- client ------------------------------------------------------------
+
+def test_client_patch_round_trip():
+    client = FakeClient()
+    client.create(pcs())
+    gen0 = client.get(PodCliqueSet, "web").meta.generation
+    out = client.patch(PodCliqueSet, "web", {"spec": {"replicas": 2}})
+    assert out.spec.replicas == 2
+    live = client.get(PodCliqueSet, "web")
+    assert live.spec.replicas == 2
+    assert live.meta.generation == gen0 + 1  # spec change bumped generation
+    assert ("patch", "PodCliqueSet", "web") in client.calls("patch")
+
+
+def test_client_patch_retries_conflicts():
+    client = FakeClient()
+    client.create(pcs())
+    client.inject_error("update", ConflictError("stale"), times=2)
+    out = client.patch(PodCliqueSet, "web", {"spec": {"replicas": 4}})
+    assert out.spec.replicas == 4
+    assert len(client.calls("update")) == 3  # two conflicts + success
+
+
+def test_client_patch_conflict_exhaustion():
+    client = FakeClient()
+    client.create(pcs())
+    client.inject_error("update", ConflictError("stale"), times=-1)
+    with pytest.raises(ConflictError):
+        client.patch(PodCliqueSet, "web", {"spec": {"replicas": 4}},
+                     retries=2)
+
+
+# ---- wire path ---------------------------------------------------------
+
+@pytest.fixture
+def server():
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens["tok-op"] = OPERATOR_ACTOR
+    cl = new_cluster(config=cfg, fleet=FleetSpec(
+        slices=[SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}", cl
+        srv.stop()
+
+
+def test_http_patch_scales_the_gang(server):
+    """PATCH on replicas drives the real reconcile: pods double."""
+    import time
+    base, cl = server
+    from grove_tpu.cli import _http
+    from grove_tpu.api import constants as c
+
+    cl.client.create(pcs(name="psvc"))
+    deadline = time.time() + 20
+    sel = {c.LABEL_PCS_NAME: "psvc"}
+    while time.time() < deadline and \
+            len(cl.client.list(Pod, selector=sel)) < 2:
+        time.sleep(0.05)
+
+    # anonymous PATCH refused
+    status, _ = _http(base, "/api/PodCliqueSet/psvc", "PATCH",
+                      b'{"spec": {"replicas": 2}}')
+    assert status == 401
+    # bad patch → 400
+    status, body = _http(base, "/api/PodCliqueSet/psvc", "PATCH",
+                         b'{"status": {}}', token="tok-op")
+    assert status == 400 and "not patchable" in body["error"]
+    # missing object → 404
+    status, _ = _http(base, "/api/PodCliqueSet/nope", "PATCH",
+                      b'{"spec": {"replicas": 2}}', token="tok-op")
+    assert status == 404
+
+    status, body = _http(base, "/api/PodCliqueSet/psvc", "PATCH",
+                         b'{"spec": {"replicas": 2}}', token="tok-op")
+    assert status == 200 and body["spec"]["replicas"] == 2
+    while time.time() < deadline and \
+            len(cl.client.list(Pod, selector=sel)) < 4:
+        time.sleep(0.05)
+    assert len(cl.client.list(Pod, selector=sel)) == 4
+
+
+def test_grovectl_patch_verb(server, capsys, monkeypatch):
+    base, cl = server
+    from grove_tpu.cli import main
+    cl.client.create(pcs(name="csvc"))
+    monkeypatch.setenv("GROVE_API_TOKEN", "tok-op")
+    rc = main(["patch", "PodCliqueSet", "csvc",
+               "-p", '{"spec": {"replicas": 2}}', "--server", base])
+    out = capsys.readouterr().out
+    assert rc == 0 and "PodCliqueSet/csvc patched" in out
+    assert cl.client.get(PodCliqueSet, "csvc").spec.replicas == 2
+    # malformed local JSON caught client-side
+    rc = main(["patch", "PodCliqueSet", "csvc", "-p", "{nope",
+               "--server", base])
+    assert rc == 1
