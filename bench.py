@@ -43,6 +43,11 @@ BATCH = 8
 PROMPT_LEN = 128
 DECODE_STEPS = 64
 TIMED_ITERS = 3
+# KV-cache allocation length: the serving context budget (prompt + max
+# new tokens + margin), NOT the model's max_seq_len — decode attention
+# reads the full padded cache every step, so an oversized cache turns
+# directly into wasted HBM bandwidth (2048 would read 4x the bytes).
+MAX_LEN = int(os.environ.get("GROVE_BENCH_MAX_LEN", 512))
 
 # v5e roofline (per chip). Overridable for other generations.
 PEAK_FLOPS = float(os.environ.get("GROVE_PEAK_FLOPS", 197e12))  # bf16
@@ -94,11 +99,14 @@ def decode_flops_per_token(cfg, ctx: int) -> float:
     return 2.0 * w_matmul + attn
 
 
-def decode_hbm_bytes_per_token(cfg, ctx: int, batch: int) -> float:
+def decode_hbm_bytes_per_token(cfg, cache_len: int, batch: int) -> float:
     """HBM bytes moved per decoded token: full weight read amortized over
-    the batch, plus this lane's KV cache read and one-entry write."""
+    the batch, plus this lane's KV cache read and one-entry write.
+    ``cache_len`` is the ALLOCATED cache length — the padded read is what
+    the implementation actually moves, regardless of live context."""
     itemsize = jnp.dtype(cfg.dtype).itemsize
-    kv_read = 2 * cfg.n_layers * ctx * cfg.n_kv_heads * cfg.head_dim * itemsize
+    kv_read = (2 * cfg.n_layers * cache_len * cfg.n_kv_heads
+               * cfg.head_dim * itemsize)
     kv_write = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * itemsize
     return cfg.params_bytes / batch + kv_read + kv_write
 
@@ -115,17 +123,17 @@ def time_loop(run_steps) -> float:
     return BATCH * DECODE_STEPS / best
 
 
-def check_flash_parity(cfg) -> None:
+def check_flash_parity(cfg, prompt_len: int = PROMPT_LEN) -> None:
     """When the pallas flash kernel is the active prefill attention, assert
     it matches the XLA formulation on this backend before timing anything."""
     from grove_tpu.ops.attention import causal_attention, pick_causal_attention
-    flash = pick_causal_attention(PROMPT_LEN, cfg.head_dim)
+    flash = pick_causal_attention(prompt_len, cfg.head_dim)
     if flash is None:
         return
     key = jax.random.PRNGKey(7)
     kq, kk, kv = jax.random.split(key, 3)
-    shape_q = (2, PROMPT_LEN, cfg.n_heads, cfg.head_dim)
-    shape_kv = (2, PROMPT_LEN, cfg.n_kv_heads, cfg.head_dim)
+    shape_q = (2, prompt_len, cfg.n_heads, cfg.head_dim)
+    shape_kv = (2, prompt_len, cfg.n_kv_heads, cfg.head_dim)
     q = jax.random.normal(kq, shape_q, jnp.bfloat16)
     k = jax.random.normal(kk, shape_kv, jnp.bfloat16)
     v = jax.random.normal(kv, shape_kv, jnp.bfloat16)
@@ -144,62 +152,70 @@ def run_bench() -> dict:
 
     model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
     cfg = llama.CONFIGS[model]
+    max_len = min(MAX_LEN, cfg.max_seq_len)
+    # Geometry adapts to tiny configs (test-tiny's max_seq_len is 128):
+    # the flagship path keeps prompt 128 / budget 320 inside cache 512.
+    prompt_len = min(PROMPT_LEN, max_len // 4)
+    budget = min((TIMED_ITERS + 2) * DECODE_STEPS,
+                 max_len - prompt_len - 1)
     dev = init_devices()[0]
-    attn_impl = active_prefill_attention(PROMPT_LEN, cfg.head_dim)
+    attn_impl = active_prefill_attention(prompt_len, cfg.head_dim)
     log(f"bench device: {dev.platform} {dev.device_kind}; "
         f"model {model} ({cfg.params_bytes / 1e9:.2f} GB bf16), "
-        f"batch={BATCH} prompt={PROMPT_LEN} steps={DECODE_STEPS}; "
-        f"prefill attention: {attn_impl}")
-    check_flash_parity(cfg)
+        f"batch={BATCH} prompt={prompt_len} steps={DECODE_STEPS} "
+        f"cache_len={max_len}; prefill attention: {attn_impl}")
+    check_flash_parity(cfg, prompt_len)
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    eng = DecodeEngine(cfg, params, batch=BATCH)
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PROMPT_LEN),
+    eng = DecodeEngine(cfg, params, batch=BATCH, max_len=max_len)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (BATCH, prompt_len),
                                 0, cfg.vocab_size)
 
-    # ---- bare-metal path: raw loop over the engine's compiled callables
-    # (identical XLA programs; measures pure model throughput).
-    cache = KVCache.create(cfg.n_layers, BATCH, cfg.max_seq_len,
+    # ---- bare-metal path: raw loop over the engine's compiled block
+    # callable (identical XLA program as the framework path; measures
+    # pure model throughput at the same dispatch granularity).
+    cache = KVCache.create(cfg.n_layers, BATCH, max_len,
                            cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
-    lengths = jnp.full((BATCH,), PROMPT_LEN, jnp.int32)
-    prefill, step = eng.compiled_prefill(), eng.compiled_step()
+    lengths = jnp.full((BATCH,), prompt_len, jnp.int32)
+    prefill = eng.compiled_prefill()
+    step_block, block = eng.compiled_step_block()
+    assert DECODE_STEPS % block == 0, (DECODE_STEPS, block)
     logits, cache = prefill(params, prompt, lengths, cache)       # compiles
     tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    tokens, cache = step(params, tokens, cache)                   # compiles
+    tokens, cache, _ = step_block(params, tokens, cache)          # compiles
     np.asarray(tokens)  # warmup sync
 
     state = {"tokens": tokens, "cache": cache}
 
     def bare_steps():
         t, kv = state["tokens"], state["cache"]
-        for _ in range(DECODE_STEPS):
-            t, kv = step(params, t, kv)
+        for _ in range(DECODE_STEPS // block):
+            t, kv, _w = step_block(params, t, kv)
         np.asarray(t)
         state["tokens"], state["cache"] = t, kv
 
     bare = time_loop(bare_steps)
-    log(f"bare-metal decode: {bare:.1f} tok/s/chip")
+    log(f"bare-metal decode: {bare:.1f} tok/s/chip "
+        f"(block dispatch, {block} steps/dispatch)")
 
-    # ---- framework path: the serving engine's step loop over the same
-    # compiled functions, with tracked requests so the REAL serving-layer
-    # costs run — completion bookkeeping with windowed host drains.
-    eng.admit_prompts(prompt,
-                      max_new_tokens=(TIMED_ITERS + 2) * DECODE_STEPS)
-    eng.step()
-    eng.sync()  # warmup
+    # ---- framework path: the serving engine's run loop over the same
+    # compiled block program, with tracked requests so the REAL
+    # serving-layer costs run — completion bookkeeping drained
+    # asynchronously one window behind the dispatch chain.
+    eng.admit_prompts(prompt, max_new_tokens=budget)
+    eng.run(DECODE_STEPS)  # warmup: block path primed + bookkeeping live
 
     def engine_steps():
-        for _ in range(DECODE_STEPS):
-            eng.step()
-        eng.sync()
+        eng.run(DECODE_STEPS)
 
     fw = time_loop(engine_steps)
     log(f"framework decode: {fw:.1f} tok/s/chip")
 
-    # Roofline placement at the mid-window context length.
-    ctx = PROMPT_LEN + DECODE_STEPS // 2
+    # Roofline placement: FLOPs at the mid-window live context, HBM at
+    # the allocated cache length (what the padded read actually moves).
+    ctx = prompt_len + DECODE_STEPS // 2
     mfu = fw * decode_flops_per_token(cfg, ctx) / PEAK_FLOPS
-    hbm = fw * decode_hbm_bytes_per_token(cfg, ctx, BATCH) / PEAK_HBM_BW
+    hbm = fw * decode_hbm_bytes_per_token(cfg, max_len, BATCH) / PEAK_HBM_BW
     log(f"roofline: MFU={mfu * 100:.2f}% HBM={hbm * 100:.1f}% "
         f"(v5e peaks {PEAK_FLOPS / 1e12:.0f} TFLOP/s, "
         f"{PEAK_HBM_BW / 1e9:.0f} GB/s)")

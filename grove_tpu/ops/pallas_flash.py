@@ -38,9 +38,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     # Causal: skip k-blocks entirely in the future of this q-block.
     @pl.when(ki * block_k <= qi * block_q + block_q - 1)
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # [bq, d]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)              # [bk, d]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale      # [bq, d]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)              # [bk, d]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, 1), 0)
@@ -61,7 +61,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(ki == n_k - 1)
     def _finalize():
         out = acc_ref[:] / jnp.maximum(l_ref[:][:, None], 1e-30)
-        o_ref[0, :, 0, :] = out.astype(o_ref.dtype)
+        o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
 
 
 def flash_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -80,20 +80,29 @@ def flash_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     grid = (b, h, s // block_q, n_k)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, scale=d ** -0.5, n_k=n_k)
-    return pl.pallas_call(
+    # Head-major layout: Mosaic requires a block's LAST TWO dims to be
+    # (divisible by 8, divisible by 128) or equal to the array dims. In
+    # the model's native [b, s, h, d] a per-head block is (1, bq, 1, d)
+    # whose trailing (1, d) violates the sublane rule for h > 1, so the
+    # wrapper transposes to [b/n_kv-heads-major] once outside the kernel
+    # and blocks become (1, 1, bq, d) — trailing (bq, d) = (128, 128).
+    qt = q.transpose(0, 2, 1, 3)   # [b, h, s, d]
+    kt = k.transpose(0, 2, 1, 3)   # [b, n_kv, s, d]
+    vt = v.transpose(0, 2, 1, 3)
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, 1, d),
-                         lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, ki, hi // g, 0)),
-            pl.BlockSpec((1, block_k, 1, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, ki, hi // g, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, 1, d),
-                               lambda bi, hi, qi, ki: (bi, qi, hi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -103,4 +112,5 @@ def flash_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -56,10 +56,18 @@ def sample_tokens(logits: jnp.ndarray, key: jax.Array,
 @dataclasses.dataclass
 class Request:
     rid: int
-    prompt: np.ndarray              # [s] int32
+    prompt: np.ndarray              # [s] int32 (may be right-padded)
     max_new_tokens: int = 32
     generated: list[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # True prompt length (≠ len(prompt) for padded rows). Lets the engine
+    # do all cache-capacity math on the host: after g generated tokens
+    # the lane's next write lands at prompt_len + g - 1.
+    prompt_len: int = -1
+
+    def __post_init__(self):
+        if self.prompt_len < 0:
+            self.prompt_len = len(self.prompt)
 
 
 @dataclasses.dataclass
@@ -183,6 +191,34 @@ class DecodeEngine:
         self._step = jax.jit(step_greedy, donate_argnums=(2,))
         self._step_sampled = jax.jit(step_sampled, donate_argnums=(2,))
 
+        # Block decode: host_sync_interval steps fused into ONE executable
+        # via lax.scan, window tokens [K, b] stacked on device. One
+        # dispatch + one async fetch per window instead of K dispatches —
+        # the difference between dispatch-bound and HBM-bound decode on
+        # high-latency transports (the tunnelled PJRT relay most of all).
+        K = self.host_sync_interval
+
+        def block_greedy(params, tokens, cache):
+            def body(carry, _):
+                t, c = carry
+                nt, c = step_greedy(params, t, c)
+                return (nt, c), nt
+            (t, c), window = jax.lax.scan(body, (tokens, cache), None,
+                                          length=K)
+            return t, c, window
+
+        def block_sampled(params, tokens, cache, key):
+            def body(carry, _):
+                t, c, k = carry
+                nt, c, k = step_sampled(params, t, c, k)
+                return (nt, c, k), nt
+            (t, c, key), window = jax.lax.scan(body, (tokens, cache, key),
+                                               None, length=K)
+            return t, c, window, key
+
+        self._step_block = jax.jit(block_greedy, donate_argnums=(2,))
+        self._step_block_sampled = jax.jit(block_sampled, donate_argnums=(2,))
+
         def pf(params, tokens, lengths, cache):
             return llama.prefill(cfg, params, tokens, cache, lengths)
 
@@ -204,6 +240,12 @@ class DecodeEngine:
         """The jitted decode step: (params, tokens[b], cache) ->
         (next tokens [b], cache). Cache argument is donated."""
         return self._step
+
+    def compiled_step_block(self):
+        """The jitted K-step decode block (K = host_sync_interval):
+        (params, tokens[b], cache) -> (tokens[b], cache, window[K, b]).
+        One dispatch decodes K tokens per lane; cache is donated."""
+        return self._step_block, self.host_sync_interval
 
     # ---- request intake ----
 
@@ -252,11 +294,13 @@ class DecodeEngine:
         self._active[:] = True
         if max_new_tokens is not None:
             prompts_np = np.asarray(prompts)
+            lengths_np = np.asarray(lengths)
             first = np.asarray(self._tokens)
             self._lane_window_start[:] = len(self._pending_tokens)
             for i in range(b):
                 req = Request(rid=self._next_rid, prompt=prompts_np[i],
-                              max_new_tokens=max_new_tokens)
+                              max_new_tokens=max_new_tokens,
+                              prompt_len=int(lengths_np[i]))
                 self._next_rid += 1
                 self._requests[i] = req
                 # Count the prefill-sampled token like insert() does —
@@ -282,6 +326,7 @@ class DecodeEngine:
         self._requests[lane] = request
         self._lane_window_start[lane] = len(self._pending_tokens)
         if request is not None:
+            request.prompt_len = result.length
             request.generated.append(result.next_token)
 
     def admit_from_queue(self, prefiller: PrefillWorker) -> int:
@@ -315,26 +360,40 @@ class DecodeEngine:
             if len(self._pending_tokens) >= self.host_sync_interval:
                 self._drain()
 
+    def _lane_has_room(self, req: Request, n: int) -> bool:
+        """Host-side capacity check (no device fetch): after g generated
+        tokens the lane's next write lands at prompt_len + g - 1, so n
+        more steps fit iff that stays within max_len. write_row clamps
+        silently past max_len — completing the lane a window early
+        prevents the clamp from corrupting the cache tail."""
+        return req.prompt_len + len(req.generated) - 1 + n <= self.max_len
+
     def _drain(self) -> None:
-        """Process accumulated tokens: one host fetch per window."""
+        """Process accumulated single-step tokens: one host fetch per
+        window."""
         if not self._pending_tokens:
             return
         toks = np.asarray(jnp.stack(self._pending_tokens))  # [w, batch]
         self._pending_tokens.clear()
-        # A lane must keep a full window of cache room: drains happen every
-        # host_sync_interval steps, and write_row clamps silently past
-        # max_len — completing the lane a window early prevents that.
-        room = np.asarray(self.cache.has_room(self.host_sync_interval))
+        self._process_window(toks, offsets=self._lane_window_start)
+        self._lane_window_start[:] = 0
+
+    def _process_window(self, toks: np.ndarray,
+                        offsets: np.ndarray | None = None) -> None:
+        """Completion bookkeeping over a [w, batch] token window.
+        ``offsets[i]`` = rows belonging to lane i's previous occupant
+        (single-step path; block windows never contain them)."""
         freed = False
         for i, req in enumerate(self._requests):
             if req is None or not self._active[i]:
                 continue
-            start = int(self._lane_window_start[i])
+            start = int(offsets[i]) if offsets is not None else 0
             for t in toks[start:, i]:
                 req.generated.append(int(t))
                 if len(req.generated) >= req.max_new_tokens:
                     break
-            if len(req.generated) >= req.max_new_tokens or not room[i]:
+            if len(req.generated) >= req.max_new_tokens or \
+                    not self._lane_has_room(req, self.host_sync_interval):
                 req.done = True
                 self.completed.append(req)
                 self._requests[i] = None
@@ -342,7 +401,6 @@ class DecodeEngine:
                 freed = True
                 lengths = self.cache.lengths.at[i].set(0)
                 self.cache = self.cache._replace(lengths=lengths)
-        self._lane_window_start[:] = 0
         if freed:
             self._report_metric()
 
@@ -354,6 +412,53 @@ class DecodeEngine:
         np.asarray(self._tokens)
 
     def run(self, steps: int) -> None:
+        """Decode ``steps`` steps with block dispatch (throughput mode):
+        full windows go through the fused K-step executable — one
+        dispatch per window, window tokens accumulating ON DEVICE — and
+        bookkeeping drains with a single concatenated fetch at the end
+        (on high-RTT transports every mid-run fetch would stall the
+        dispatch chain for a round trip). The remainder decodes through
+        single steps. Completion is therefore observed per ``run`` call,
+        not per window: callers wanting tighter completion latency call
+        ``step()`` (latency mode) or ``run`` in smaller chunks. Lane
+        admission happens between calls, never inside one."""
+        K = self.host_sync_interval
+        self._drain()  # single-step leftovers use the offset bookkeeping
+        tracked = any(r is not None for r in self._requests)
+        if tracked:
+            # Deferred bookkeeping can't free lanes mid-run, so cap the
+            # block phase at the steps every tracked lane has room for;
+            # the rest goes through the draining single-step path.
+            safe = min((self.max_len - req.prompt_len
+                        - len(req.generated) + 1
+                        for req in self._requests if req is not None),
+                       default=steps)
+            block_steps = min(steps, max(0, safe))
+        else:
+            block_steps = steps
+        steps -= (block_steps // K) * K
+        windows: list[jnp.ndarray] = []
+        for _ in range(block_steps // K):
+            if self._sampling:
+                self._tokens, self.cache, window, self._rng = \
+                    self._step_block_sampled(self.params, self._tokens,
+                                             self.cache, self._rng)
+            else:
+                self._tokens, self.cache, window = self._step_block(
+                    self.params, self._tokens, self.cache)
+            self.steps += K
+            if tracked:
+                windows.append(window)
+        fetched = False
+        if windows:
+            # This fetch doubles as the hard sync for the block phase:
+            # it waits on the last window's compute, and its final row
+            # IS the current token state — no second round trip needed.
+            toks = np.asarray(windows[0] if len(windows) == 1
+                              else jnp.concatenate(windows, axis=0))
+            self._process_window(toks)
+            fetched = True
         for _ in range(steps):
             self.step()
-        self.sync()
+        if steps or not fetched:
+            self.sync()

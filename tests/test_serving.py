@@ -149,3 +149,62 @@ def test_metric_hook_reports_queue_depth():
     for _ in range(3):
         eng.submit(np.array([1, 2, 3]))
     assert seen == [1, 2, 3]
+
+
+def test_run_block_matches_step_loop():
+    """run() (fused K-step block dispatch, deferred drain) must produce
+    the exact same tokens and completion bookkeeping as a step() loop."""
+    params = _params()
+    prompts = jax.random.randint(jax.random.PRNGKey(7), (2, 6), 0,
+                                 CFG.vocab_size)
+
+    stepper = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    stepper.admit_prompts(prompts, max_new_tokens=12)
+    for _ in range(14):
+        stepper.step()
+    stepper.sync()
+
+    runner = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    runner.admit_prompts(prompts, max_new_tokens=12)
+    runner.run(14)  # 3 full blocks + 2 single steps
+
+    assert len(runner.completed) == len(stepper.completed) == 2
+    for a, b in zip(sorted(runner.completed, key=lambda r: r.rid),
+                    sorted(stepper.completed, key=lambda r: r.rid)):
+        assert a.generated == b.generated
+    np.testing.assert_array_equal(np.asarray(runner._tokens),
+                                  np.asarray(stepper._tokens))
+
+
+def test_run_respects_cache_capacity():
+    """A tracked lane near max_len must be completed by the single-step
+    path before the silent write clamp could corrupt the cache: run()
+    caps its block phase at the steps every lane has room for."""
+    params = _params()
+    eng = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    s = CFG.max_seq_len - 6  # only ~5 decode steps of room
+    prompts = jax.random.randint(jax.random.PRNGKey(8), (2, s), 0,
+                                 CFG.vocab_size)
+    eng.admit_prompts(prompts, max_new_tokens=1000)
+    eng.run(24)
+    assert len(eng.completed) == 2  # freed at capacity, not clamped
+    for r in eng.completed:
+        assert r.prompt_len + len(r.generated) - 1 <= CFG.max_seq_len
+
+
+def test_run_untracked_block_path():
+    """Untracked lanes (no max_new_tokens) run pure block dispatch with
+    no drains; tokens still advance exactly like step()."""
+    params = _params()
+    prompts = jax.random.randint(jax.random.PRNGKey(9), (2, 6), 0,
+                                 CFG.vocab_size)
+    a = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    a.admit_prompts(prompts)
+    a.run(8)
+    b = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    b.admit_prompts(prompts)
+    for _ in range(8):
+        b.step()
+    b.sync()
+    np.testing.assert_array_equal(np.asarray(a._tokens),
+                                  np.asarray(b._tokens))
