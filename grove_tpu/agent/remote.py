@@ -1,0 +1,113 @@
+"""Remote node agent: one per TPU host, talking to the control plane
+over HTTP.
+
+The multi-host deployment shape: a single ``grovectl serve`` daemon owns
+the store and controllers; every TPU host runs ``grovectl agent`` with
+an ``HttpClient`` pinned to its node. The agent
+
+1. self-registers its Node (labels = the GKE TPU node-label contract,
+   built by ``topology.fleet.build_node``) if it does not exist, and
+   publishes capacity via a status write (the wire create path cannot
+   carry status, and allocatable_chips defaults to 0 — an unpublished
+   node would never receive a pod),
+2. heartbeats ``status.heartbeat_time``/``ready`` at a fixed cadence
+   (the node-lease analog), and
+3. runs a ``ProcessKubelet`` against the HttpClient — pods bound to the
+   node exec as OS processes, with the startup barrier and status
+   write-backs flowing over the wire exactly as they do in-process
+   (ProcessKubelet is client-agnostic by construction).
+
+Role parity: the reference's workload pods land on kubelet-run nodes and
+its initc watches the apiserver from inside the pod boundary
+(operator/initc/); here the host agent IS the kubelet analog and the
+barrier runs in it, before exec.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from grove_tpu.agent.process import ProcessKubelet
+from grove_tpu.api import Node
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+
+
+class RemoteAgent:
+    def __init__(self, client, node_name: str, register: Node | None = None,
+                 namespace: str = "default", heartbeat_seconds: float = 5.0,
+                 tick: float = 0.25, workdir: str | None = None,
+                 log_dir: str | None = None,
+                 extra_env: dict[str, str] | None = None):
+        """``client`` is any store-client surface (HttpClient in real
+        deployments; an in-process Client works for tests). ``register``
+        is the Node to create if absent — None means the node must
+        already exist (pre-provisioned fleet)."""
+        self.client = client
+        self.node_name = node_name
+        self.register = register
+        self.namespace = namespace
+        self.heartbeat_seconds = heartbeat_seconds
+        self.log = get_logger("agent.remote")
+        self.kubelet = ProcessKubelet(client, namespace=namespace,
+                                      node_name=node_name, tick=tick,
+                                      workdir=workdir, log_dir=log_dir,
+                                      extra_env=extra_env)
+        self._stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self.ensure_node()
+        self.kubelet.start()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           name="agent-heartbeat",
+                                           daemon=True)
+        self._hb_thread.start()
+        self.log.info("remote agent up: node %s", self.node_name)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(2.0)
+        self.kubelet.stop()
+
+    def ensure_node(self) -> None:
+        try:
+            self.client.get(Node, self.node_name, self.namespace)
+            return
+        except NotFoundError:
+            pass
+        if self.register is None:
+            raise GroveError(
+                f"node {self.node_name!r} not found and no registration "
+                "template given (pass --register)")
+        assert self.register.meta.name == self.node_name, \
+            (self.register.meta.name, self.node_name)
+        self.client.create(self.register)
+        self.log.info("registered node %s (%d chips)", self.node_name,
+                      self.register.spec.tpu_chips)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self.heartbeat()
+            self._stop.wait(self.heartbeat_seconds)
+
+    def heartbeat(self) -> None:
+        """Publish ready/capacity/heartbeat_time (read-modify-write with
+        conflict retry; a missed beat is retried next period)."""
+        for _ in range(3):
+            try:
+                node = self.client.get(Node, self.node_name, self.namespace)
+                node.status.ready = True
+                if node.status.allocatable_chips == 0:
+                    node.status.allocatable_chips = node.spec.tpu_chips
+                node.status.heartbeat_time = time.time()
+                self.client.update_status(node)
+                return
+            except NotFoundError:
+                return  # deregistered underneath us; next beat re-checks
+            except GroveError as e:
+                last = e
+                time.sleep(0.05)
+        self.log.warning("heartbeat failed: %s", last)

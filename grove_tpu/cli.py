@@ -319,6 +319,49 @@ def cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_agent(args: argparse.Namespace) -> int:
+    """Per-host node agent against a remote control plane (HTTP)."""
+    import os
+    from grove_tpu.agent.remote import RemoteAgent
+    from grove_tpu.store.httpclient import HttpClient
+    from grove_tpu.runtime.errors import GroveError
+
+    token = args.token or os.environ.get("GROVE_API_TOKEN", "")
+    client = HttpClient(args.server, token=token)
+    register = None
+    if args.register:
+        from grove_tpu.topology.fleet import build_node, node_name
+        try:
+            gen, topo, slice_name, worker = args.register.split(":")
+            register = build_node(gen, topo, slice_name, int(worker),
+                                  namespace=args.namespace, fake=False)
+        except (ValueError, KeyError) as e:
+            print(f"error: bad --register {args.register!r} "
+                  f"(want gen:topology:slice:worker): {e}", file=sys.stderr)
+            return 1
+        if node_name(slice_name, int(worker)) != args.node:
+            print(f"error: --register names node "
+                  f"{node_name(slice_name, int(worker))!r} but --node is "
+                  f"{args.node!r}", file=sys.stderr)
+            return 1
+    agent = RemoteAgent(client, node_name=args.node, register=register,
+                        namespace=args.namespace, tick=args.tick,
+                        workdir=args.workdir)
+    try:
+        agent.start()
+    except GroveError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"node agent running: node {args.node} -> {args.server} "
+          "(ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
 def cmd_render_deploy(args: argparse.Namespace) -> int:
     from grove_tpu.deploy import (
         DeployValues,
@@ -387,6 +430,22 @@ def main(argv: list[str] | None = None) -> int:
                             "(kube --token-auth-file analog; env "
                             "GROVE_TOKEN_FILE)")
     serve.set_defaults(fn=cmd_serve)
+
+    agent_p = sub.add_parser(
+        "agent", help="run a per-host node agent (process kubelet + "
+                      "heartbeat) against a remote serve daemon")
+    agent_p.add_argument("--server", default=default_server)
+    agent_p.add_argument("--node", required=True,
+                         help="this host's Node name")
+    agent_p.add_argument("--register",
+                         help="gen:topology:slice:worker — self-register "
+                              "the Node if absent")
+    agent_p.add_argument("--namespace", default="default")
+    agent_p.add_argument("--token", help="bearer token "
+                                         "(default $GROVE_API_TOKEN)")
+    agent_p.add_argument("--tick", type=float, default=0.25)
+    agent_p.add_argument("--workdir")
+    agent_p.set_defaults(fn=cmd_agent)
 
     render = sub.add_parser(
         "render-deploy",

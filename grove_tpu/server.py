@@ -19,6 +19,10 @@ control plane exposes its own minimal HTTP API so out-of-process clients
   POST /apply                         YAML/JSON manifest (create-or-update)
   PATCH /api/<kind>/<name>            RFC 7386 JSON merge patch on
                                       spec/labels/annotations
+  PUT  /api/<kind>/<name>/status      status-subresource write (full
+                                      object body; optimistic concurrency
+                                      — stale resource_version is 409).
+                                      The remote node agent's write path.
   POST /metrics/push                  workload autoscaling signals
   DELETE /api/<kind>/<name>           delete
 
@@ -42,9 +46,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from grove_tpu.api.serde import to_dict
+from grove_tpu.api.serde import from_dict, to_dict
 from grove_tpu.manifest import KIND_REGISTRY, load_manifest, load_object
-from grove_tpu.runtime.errors import ForbiddenError, GroveError, NotFoundError
+from grove_tpu.runtime.errors import (
+    ConflictError,
+    ForbiddenError,
+    GroveError,
+    NotFoundError,
+)
 
 ANONYMOUS_ACTOR = "system:anonymous"
 
@@ -134,10 +143,13 @@ class ApiServer:
                         if cls is None:
                             return
                         q = parse_qs(url.query)
+                        # "*" = all namespaces (kubectl -A analog).
                         ns = q.get("namespace", ["default"])[0]
                         selector = {k[2:]: v[0] for k, v in q.items()
                                     if k.startswith("l.")}
-                        objs = cluster.client.list(cls, ns, selector or None)
+                        objs = cluster.client.list(
+                            cls, None if ns == "*" else ns,
+                            selector or None)
                         self._send(200, [to_dict(o) for o in objs])
                     elif len(parts) == 3 and parts[0] == "api":
                         cls = self._kind(parts[1])
@@ -332,8 +344,8 @@ class ApiServer:
                                      "need kind/name/metric/value"})
 
             def do_PATCH(self):
-                parts = [p for p in urlparse(self.path).path.split("/")
-                         if p]
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
                 if len(parts) != 3 or parts[0] != "api":
                     self._send(404, {"error": "PATCH /api/<kind>/<name>"})
                     return
@@ -343,6 +355,7 @@ class ApiServer:
                 client = self._mutating_client()
                 if client is None:
                     return
+                ns = parse_qs(url.query).get("namespace", ["default"])[0]
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     patch = json.loads(self.rfile.read(length) or b"")
@@ -350,17 +363,63 @@ class ApiServer:
                     self._send(400, {"error": f"bad patch JSON: {e}"})
                     return
                 try:
-                    updated = client.patch(cls, parts[2], patch)
+                    updated = client.patch(cls, parts[2], patch,
+                                           namespace=ns)
                     self._send(200, to_dict(updated))
                 except NotFoundError as e:
                     self._send(404, {"error": str(e)})
                 except ForbiddenError as e:
                     self._send(403, {"error": str(e)})
+                except ConflictError as e:
+                    self._send(409, {"error": str(e)})
+                except GroveError as e:
+                    self._send(400, {"error": str(e)})
+
+            def do_PUT(self):
+                """PUT /api/<kind>/<name>/status — the status-subresource
+                write (the remote node agent's path; spec/meta edits in
+                the body are ignored by the store, exactly as in-process
+                update_status)."""
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                if len(parts) != 4 or parts[0] != "api" \
+                        or parts[3] != "status":
+                    self._send(404,
+                               {"error": "PUT /api/<kind>/<name>/status"})
+                    return
+                cls = self._kind(parts[1])
+                if cls is None:
+                    return
+                client = self._mutating_client()
+                if client is None:
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    obj = from_dict(cls, json.loads(
+                        self.rfile.read(length) or b""))
+                except (ValueError, TypeError, KeyError) as e:
+                    self._send(400, {"error": f"bad status body: {e}"})
+                    return
+                if obj.meta.name != parts[2]:
+                    self._send(400, {"error": f"body names "
+                                     f"{obj.meta.name!r}, URL names "
+                                     f"{parts[2]!r}"})
+                    return
+                try:
+                    updated = client.update_status(obj)
+                    self._send(200, to_dict(updated))
+                except NotFoundError as e:
+                    self._send(404, {"error": str(e)})
+                except ForbiddenError as e:
+                    self._send(403, {"error": str(e)})
+                except ConflictError as e:
+                    self._send(409, {"error": str(e)})
                 except GroveError as e:
                     self._send(400, {"error": str(e)})
 
             def do_DELETE(self):
-                parts = [p for p in urlparse(self.path).path.split("/") if p]
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
                 if len(parts) != 3 or parts[0] != "api":
                     self._send(404, {"error": "DELETE /api/<kind>/<name>"})
                     return
@@ -370,8 +429,9 @@ class ApiServer:
                 client = self._mutating_client()
                 if client is None:
                     return
+                ns = parse_qs(url.query).get("namespace", ["default"])[0]
                 try:
-                    client.delete(cls, parts[2])
+                    client.delete(cls, parts[2], ns)
                     self._send(200, {"deleted": parts[2]})
                 except NotFoundError as e:
                     self._send(404, {"error": str(e)})

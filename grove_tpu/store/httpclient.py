@@ -1,0 +1,121 @@
+"""HTTP-backed client — the store-client surface over the wire.
+
+Implements the same verbs as ``store.client.Client`` (get/list/create/
+update_status/patch/delete) against a remote serve daemon's HTTP API,
+with wire status codes mapped back to the typed error model (404 →
+NotFoundError, 403 → ForbiddenError, 409 → ConflictError, 4xx →
+GroveError). Anything that takes a ``Client`` and sticks to these verbs
+— most importantly the ProcessKubelet and the startup barrier — runs
+unchanged against a remote control plane, which is how one serve daemon
+spans multiple hosts: each TPU host runs ``grovectl agent`` with an
+HttpClient pinned to its node (see grove_tpu/agent docs and the
+reference's in-pod initc, which likewise talks to the apiserver from
+inside the workload boundary).
+
+No watch support: remote consumers poll (list) at agent cadence; the
+event-driven path stays in-process with the controllers.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+from urllib.parse import quote, urlencode
+
+from grove_tpu.api.serde import from_dict, to_dict
+from grove_tpu.runtime.errors import (
+    ConflictError,
+    ForbiddenError,
+    GroveError,
+    NotFoundError,
+)
+
+
+class HttpClient:
+    def __init__(self, server: str, token: str = "", timeout: float = 10.0):
+        self.server = server.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        import urllib.error
+        import urllib.request
+
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(f"{self.server}{path}", method=method,
+                                     data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                msg = json.loads(raw).get("error", raw.decode(errors="replace"))
+            except ValueError:
+                msg = raw.decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(msg)
+            if e.code == 403:
+                raise ForbiddenError(msg)
+            if e.code == 409:
+                raise ConflictError(msg)
+            if e.code == 401:
+                raise ForbiddenError(f"unauthenticated: {msg}")
+            raise GroveError(msg)
+        except urllib.error.URLError as e:
+            raise GroveError(f"cannot reach {self.server}: {e.reason}")
+
+    # -- verbs ------------------------------------------------------------
+
+    def get(self, kind_cls: type, name: str,
+            namespace: str = "default") -> Any:
+        data = self._request(
+            "GET", f"/api/{kind_cls.KIND}/{quote(name)}"
+                   f"?{urlencode({'namespace': namespace})}")
+        return from_dict(kind_cls, data)
+
+    def list(self, kind_cls: type, namespace: str | None = "default",
+             selector: dict[str, str] | None = None) -> list[Any]:
+        params = {"namespace": namespace if namespace is not None else "*"}
+        for k, v in (selector or {}).items():
+            params[f"l.{k}"] = v
+        data = self._request(
+            "GET", f"/api/{kind_cls.KIND}?{urlencode(params)}")
+        return [from_dict(kind_cls, d) for d in data]
+
+    def create(self, obj: Any) -> Any:
+        doc = {"kind": obj.KIND,
+               "metadata": {"name": obj.meta.name,
+                            "namespace": obj.meta.namespace,
+                            "labels": dict(obj.meta.labels),
+                            "annotations": dict(obj.meta.annotations)}}
+        if hasattr(obj, "spec"):
+            doc["spec"] = to_dict(obj.spec)
+        results = self._request("POST", "/apply", doc)
+        action = results[0].get("action") if results else None
+        if action == "forbidden":
+            raise ForbiddenError(results[0].get("error", "forbidden"))
+        return self.get(type(obj), obj.meta.name, obj.meta.namespace)
+
+    def update_status(self, obj: Any) -> Any:
+        data = self._request(
+            "PUT", f"/api/{obj.KIND}/{quote(obj.meta.name)}/status",
+            to_dict(obj))
+        return from_dict(type(obj), data)
+
+    def patch(self, kind_cls: type, name: str, patch: dict,
+              namespace: str = "default") -> Any:
+        data = self._request(
+            "PATCH", f"/api/{kind_cls.KIND}/{quote(name)}"
+                     f"?{urlencode({'namespace': namespace})}", patch)
+        return from_dict(kind_cls, data)
+
+    def delete(self, kind_cls: type, name: str,
+               namespace: str = "default") -> None:
+        self._request("DELETE", f"/api/{kind_cls.KIND}/{quote(name)}"
+                                f"?{urlencode({'namespace': namespace})}")

@@ -37,32 +37,42 @@ def node_name(slice_name: str, worker: int) -> str:
     return f"{slice_name}-w{worker}"
 
 
+def build_node(generation: str, topology: str, slice_name: str, worker: int,
+               pool: str = "pool-0", superblock: str = "",
+               namespace: str = "default", fake: bool = True) -> Node:
+    """One host's Node object (labels = the GKE TPU node-label contract).
+    Shared by fleet creation and remote-agent self-registration."""
+    gen = TPU_GENERATIONS[generation]
+    name = node_name(slice_name, worker)
+    return Node(
+        meta=new_meta(name, namespace=namespace, labels={
+            c.NODE_LABEL_TPU_ACCELERATOR: f"tpu-{generation}",
+            c.NODE_LABEL_TPU_TOPOLOGY: topology,
+            c.NODE_LABEL_SLICE: slice_name,
+            c.NODE_LABEL_SLICE_WORKER: str(worker),
+            c.NODE_LABEL_POOL: pool,
+            c.NODE_LABEL_SUPERBLOCK: superblock or pool,
+            c.NODE_LABEL_HOST: name,
+        }),
+        spec=NodeSpec(tpu_chips=gen.chips_per_host, fake=fake),
+        status=NodeStatus(ready=True,
+                          allocatable_chips=gen.chips_per_host),
+    )
+
+
 def create_fleet(client: Client, fleet: FleetSpec,
                  namespace: str = "default") -> list[Node]:
     """Create Node objects for every host of every slice in the fleet."""
     nodes: list[Node] = []
     slice_seq = 0
     for spec in fleet.slices:
-        gen = TPU_GENERATIONS[spec.generation]
         hosts = slice_hosts(spec.generation, spec.topology)
         for _ in range(spec.count):
             slice_name = f"{spec.pool}-slice-{slice_seq}"
             slice_seq += 1
             for w in range(hosts):
-                name = node_name(slice_name, w)
-                node = Node(
-                    meta=new_meta(name, namespace=namespace, labels={
-                        c.NODE_LABEL_TPU_ACCELERATOR: f"tpu-{spec.generation}",
-                        c.NODE_LABEL_TPU_TOPOLOGY: spec.topology,
-                        c.NODE_LABEL_SLICE: slice_name,
-                        c.NODE_LABEL_SLICE_WORKER: str(w),
-                        c.NODE_LABEL_POOL: spec.pool,
-                        c.NODE_LABEL_SUPERBLOCK: spec.superblock or spec.pool,
-                        c.NODE_LABEL_HOST: name,
-                    }),
-                    spec=NodeSpec(tpu_chips=gen.chips_per_host, fake=fleet.fake),
-                    status=NodeStatus(ready=True,
-                                      allocatable_chips=gen.chips_per_host),
-                )
-                nodes.append(client.create(node))
+                nodes.append(client.create(build_node(
+                    spec.generation, spec.topology, slice_name, w,
+                    pool=spec.pool, superblock=spec.superblock,
+                    namespace=namespace, fake=fleet.fake)))
     return nodes

@@ -1,0 +1,196 @@
+"""Remote node agent: the HttpClient store surface, the status wire
+verb, and the multi-host e2e — pods exec on an agent that talks to the
+control plane ONLY over HTTP (one serve daemon + per-host agents, the
+real deployment shape)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import pytest
+
+from grove_tpu.admission.authorization import NODE_ACTOR, OPERATOR_ACTOR
+from grove_tpu.api import Node, Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.runtime.errors import (
+    ConflictError,
+    ForbiddenError,
+    NotFoundError,
+)
+from grove_tpu.store.httpclient import HttpClient
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
+
+from test_e2e_simple import wait_for
+
+AGENT_TOKEN = "tok-agent"
+OPERATOR_TOKEN = "tok-operator"
+
+
+@pytest.fixture
+def wired_cluster():
+    """Cluster + API server + tokens; NO in-process kubelet — every
+    node-side action must arrive over the wire."""
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.server import ApiServer
+
+    cfg = OperatorConfiguration()
+    cfg.server_auth.tokens[OPERATOR_TOKEN] = OPERATOR_ACTOR
+    cfg.server_auth.tokens[AGENT_TOKEN] = NODE_ACTOR
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=1)], fake=False)
+    cl = new_cluster(config=cfg, fleet=fleet, fake_kubelet=False)
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield cl, f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+
+def test_httpclient_verbs(wired_cluster):
+    cl, base = wired_cluster
+    http = HttpClient(base, token=OPERATOR_TOKEN)
+
+    # list + selector + all-namespaces
+    nodes = http.list(Node)
+    assert len(nodes) == 2 and all(isinstance(n, Node) for n in nodes)
+    sel = http.list(Node, selector={
+        c.NODE_LABEL_SLICE_WORKER: "0"})
+    assert [n.meta.name for n in sel] == ["pool-0-slice-0-w0"]
+    assert len(http.list(Node, namespace=None)) == 2
+
+    # get + typed NotFound
+    node = http.get(Node, "pool-0-slice-0-w1")
+    assert node.spec.tpu_chips == 4
+    with pytest.raises(NotFoundError):
+        http.get(Node, "nope")
+
+    # update_status round-trip + stale-write conflict → ConflictError
+    node.status.heartbeat_time = 123.0
+    updated = http.update_status(node)
+    assert updated.status.heartbeat_time == 123.0
+    with pytest.raises(ConflictError):
+        http.update_status(node)  # stale resource_version
+
+    # create via manifest path, then delete
+    http.create(build_node("v5e", "2x2", "pool-9-slice-0", 0,
+                           pool="pool-9", fake=False))
+    assert http.get(Node, "pool-9-slice-0-w0").meta.labels[
+        c.NODE_LABEL_POOL] == "pool-9"
+    http.delete(Node, "pool-9-slice-0-w0")
+    with pytest.raises(NotFoundError):
+        http.get(Node, "pool-9-slice-0-w0")
+
+    # unauthenticated mutation → typed Forbidden
+    anon = HttpClient(base)
+    with pytest.raises(ForbiddenError):
+        anon.update_status(http.get(Node, "pool-0-slice-0-w1"))
+
+
+def test_remote_agent_runs_pods_over_the_wire(wired_cluster, tmp_path):
+    """The capstone for multi-host: agents owning one node each, all
+    traffic over HTTP — pods exec, env contract lands, statuses flow
+    back, pods go Ready, completion propagates."""
+    from grove_tpu.agent.remote import RemoteAgent
+
+    cl, base = wired_cluster
+    agents = []
+    for w in (0, 1):
+        agent = RemoteAgent(
+            HttpClient(base, token=AGENT_TOKEN),
+            node_name=f"pool-0-slice-0-w{w}",
+            heartbeat_seconds=0.5, tick=0.1, workdir=str(tmp_path))
+        agent.start()
+        agents.append(agent)
+    try:
+        out = (
+            "import os, time\n"
+            f"open(os.path.join({str(tmp_path)!r}, "
+            "os.environ['GROVE_POD_NAME'] + '.out'), 'w')"
+            ".write(os.environ['TPU_WORKER_ID'])\n"
+            "time.sleep(60)\n")
+        cl.client.create(PodCliqueSet(
+            meta=new_meta("remotepcs"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, min_available=2,
+                    tpu_chips_per_pod=4,
+                    container=ContainerSpec(
+                        argv=[sys.executable, "-c", out]))],
+            ))))
+
+        sel = {c.LABEL_PCS_NAME: "remotepcs"}
+
+        def all_ready():
+            pods = cl.client.list(Pod, selector=sel)
+            return len(pods) == 2 and all(
+                p.status.phase == PodPhase.RUNNING
+                and is_condition_true(p.status.conditions, c.COND_READY)
+                for p in pods)
+
+        wait_for(all_ready, timeout=30.0, desc="remote pods ready")
+        wait_for(lambda: all(
+            (tmp_path / f"remotepcs-0-w-{i}.out").exists()
+            for i in (0, 1)), timeout=10.0, desc="payload outputs")
+        assert sorted((tmp_path / f"remotepcs-0-w-{i}.out").read_text()
+                      for i in (0, 1)) == ["0", "1"]
+
+        # Heartbeats land over the wire.
+        def beaten():
+            n = cl.client.get(Node, "pool-0-slice-0-w0")
+            return n.status.heartbeat_time > 0
+        wait_for(beaten, timeout=5.0, desc="heartbeat recorded")
+
+        # Delete → processes terminate and pods go away.
+        cl.client.delete(PodCliqueSet, "remotepcs")
+        wait_for(lambda: not cl.client.list(Pod, selector=sel),
+                 timeout=15.0, desc="pods gone")
+        wait_for(lambda: not any(a.kubelet._procs for a in agents),
+                 timeout=10.0, desc="processes reaped")
+    finally:
+        for a in agents:
+            a.stop()
+
+
+def test_remote_agent_self_registration(wired_cluster, tmp_path):
+    """An agent for an unknown node self-registers it and publishes
+    capacity; a one-host v5e 2x2 slice then becomes schedulable."""
+    from grove_tpu.agent.remote import RemoteAgent
+
+    cl, base = wired_cluster
+    reg = build_node("v5e", "2x2", "pool-1-slice-0", 0, pool="pool-1",
+                     fake=False)
+    agent = RemoteAgent(HttpClient(base, token=AGENT_TOKEN),
+                        node_name="pool-1-slice-0-w0", register=reg,
+                        heartbeat_seconds=0.2, tick=0.1,
+                        workdir=str(tmp_path))
+    agent.start()
+    try:
+        def registered():
+            try:
+                n = cl.client.get(Node, "pool-1-slice-0-w0")
+            except NotFoundError:
+                return False
+            return n.status.ready and n.status.allocatable_chips == 4
+        wait_for(registered, timeout=5.0, desc="node registered w/ capacity")
+    finally:
+        agent.stop()
+
+
+def test_remote_agent_requires_existing_or_registration(wired_cluster):
+    from grove_tpu.agent.remote import RemoteAgent
+    from grove_tpu.runtime.errors import GroveError
+
+    _, base = wired_cluster
+    agent = RemoteAgent(HttpClient(base, token=AGENT_TOKEN),
+                        node_name="ghost-node")
+    with pytest.raises(GroveError, match="no registration"):
+        agent.start()
+    agent.stop()
