@@ -67,6 +67,18 @@ class ServerAuthConfig:
 
 
 @dataclasses.dataclass
+class NodeLifecycleConfig:
+    """Heartbeat-driven host-loss detection (node-lifecycle-controller
+    analog; only acts on non-fake nodes that have heartbeated)."""
+
+    enabled: bool = True
+    # NotReady after this long without a heartbeat. Default = 3 missed
+    # beats at the agent's default 5s cadence.
+    grace_seconds: float = 15.0
+    sync_period_seconds: float = 1.0
+
+
+@dataclasses.dataclass
 class ProfilingConfig:
     """Sampling-profiler surface (the reference's pprof endpoint toggle,
     api/config/v1alpha1/types.go:186). Off by default: profiling leaks
@@ -112,6 +124,8 @@ class OperatorConfiguration:
         default_factory=ServerAuthConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
+    node_lifecycle: NodeLifecycleConfig = dataclasses.field(
+        default_factory=NodeLifecycleConfig)
     profiling: ProfilingConfig = dataclasses.field(
         default_factory=ProfilingConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
@@ -178,6 +192,12 @@ def validate_config(cfg: OperatorConfiguration) -> list[str]:
         errs.append(
             f"default_scheduler_profile {cfg.default_scheduler_profile!r} "
             f"not among profiles {names}")
+    if cfg.node_lifecycle.grace_seconds <= 0:
+        errs.append("node_lifecycle.grace_seconds must be > 0, got "
+                    f"{cfg.node_lifecycle.grace_seconds}")
+    if cfg.node_lifecycle.sync_period_seconds <= 0:
+        errs.append("node_lifecycle.sync_period_seconds must be > 0, got "
+                    f"{cfg.node_lifecycle.sync_period_seconds}")
     if cfg.profiling.sample_interval_ms <= 0:
         errs.append("profiling.sample_interval_ms must be > 0, got "
                     f"{cfg.profiling.sample_interval_ms}")

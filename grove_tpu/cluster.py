@@ -70,6 +70,14 @@ def new_cluster(config: OperatorConfiguration | None = None,
             sync_period=mgr.config.autoscaler.sync_period_seconds,
             scale_down_stabilization=mgr.config.autoscaler
             .scale_down_stabilization_seconds))
+    if mgr.config.node_lifecycle.enabled:
+        from grove_tpu.controllers.nodelifecycle import (
+            NodeLifecycleController,
+        )
+        mgr.add_runnable(NodeLifecycleController(
+            mgr.client,
+            grace_seconds=mgr.config.node_lifecycle.grace_seconds,
+            sync_period=mgr.config.node_lifecycle.sync_period_seconds))
     if fleet is not None:
         create_fleet(mgr.client, fleet)
     return Cluster(manager=mgr, scheduler_registry=registry, metrics=metrics)

@@ -1,0 +1,115 @@
+"""Node lifecycle: heartbeat-driven failure detection for agent-managed
+hosts.
+
+The reference's failure story starts at pod conditions (kubelet/node
+controller mark pods, Grove rolls breaches up to gang termination —
+SURVEY.md §5). With remote agents heartbeating over HTTP
+(agent/remote.py), this controller is the node-lifecycle-controller
+analog that closes the loop for host loss:
+
+- a non-fake node whose ``status.heartbeat_time`` goes stale past
+  ``grace_seconds`` is marked NotReady (schedulers already skip
+  not-ready nodes, scheduler/backends.py) and a Warning event records
+  why;
+- its Pending/Running pods are marked Failed ("node lost"), which flips
+  PodClique readiness, breaches MinAvailable, and hands recovery to the
+  standard machinery: pod self-heal onto live nodes, then gang
+  termination + recreate if the breach persists past TerminationDelay.
+
+Nodes that have never heartbeated (``heartbeat_time == 0``) are exempt:
+in-process fleets publish status at creation and have no agent to beat.
+Recovery is owned by the agent — its next heartbeat sets ready=True.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from grove_tpu.api import Node, Pod, constants as c
+from grove_tpu.api.core import PodPhase
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.events import EventRecorder
+from grove_tpu.runtime.logger import get_logger
+
+
+class NodeLifecycleController:
+    def __init__(self, client, grace_seconds: float = 15.0,
+                 sync_period: float = 1.0, namespace: str | None = None):
+        self.client = client
+        self.grace_seconds = grace_seconds
+        self.sync_period = sync_period
+        self.namespace = namespace
+        self.log = get_logger("node-lifecycle")
+        self.recorder = EventRecorder(client, "node-lifecycle")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="node-lifecycle", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._pass()
+            except Exception:  # noqa: BLE001 - controller survival
+                self.log.exception("node lifecycle pass panicked")
+            self._stop.wait(self.sync_period)
+
+    def _pass(self) -> None:
+        now = time.time()
+        for node in self.client.list(Node, self.namespace):
+            if node.spec.fake or node.status.heartbeat_time <= 0:
+                continue
+            stale = now - node.status.heartbeat_time > self.grace_seconds
+            if stale and node.status.ready:
+                self._mark_lost(node, now)
+
+    def _mark_lost(self, node: Node, now: float) -> None:
+        age = now - node.status.heartbeat_time
+        try:
+            live = self.client.get(Node, node.meta.name, node.meta.namespace)
+            if not live.status.ready or \
+                    live.status.heartbeat_time != node.status.heartbeat_time:
+                return  # raced a heartbeat or another pass
+            live.status.ready = False
+            live.status.message = (f"heartbeat stale for {age:.1f}s "
+                                   f"(grace {self.grace_seconds:.0f}s)")
+            self.client.update_status(live)
+        except (NotFoundError, GroveError):
+            return  # next pass re-evaluates
+        self.log.warning("node %s lost: heartbeat stale %.1fs",
+                         node.meta.name, age)
+        self.recorder.event(node, "Warning", "NodeLost",
+                            f"heartbeat stale for {age:.1f}s; failing its "
+                            "pods")
+        self._fail_pods(node)
+
+    def _fail_pods(self, node: Node) -> None:
+        for pod in self.client.list(Pod, None):
+            if pod.status.node_name != node.meta.name:
+                continue
+            if pod.status.phase not in (PodPhase.PENDING, PodPhase.RUNNING):
+                continue
+            try:
+                live = self.client.get(Pod, pod.meta.name,
+                                       pod.meta.namespace)
+                if live.meta.uid != pod.meta.uid:
+                    continue
+                live.status.phase = PodPhase.FAILED
+                live.status.message = f"node {node.meta.name} lost"
+                live.status.conditions = set_condition(
+                    live.status.conditions,
+                    Condition(type=c.COND_READY, status="False",
+                              reason="NodeLost"))
+                self.client.update_status(live)
+            except (NotFoundError, GroveError):
+                continue  # pod vanished or raced; self-heal handles it

@@ -1,0 +1,130 @@
+"""Node lifecycle: heartbeat staleness → NotReady + pod failure →
+standard gang self-healing; agent heartbeats keep nodes alive and
+recover them."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import Node, Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.controllers.nodelifecycle import NodeLifecycleController
+from grove_tpu.store.client import FakeClient
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
+
+from test_e2e_simple import wait_for
+
+
+def test_stale_heartbeat_marks_node_lost_and_fails_pods():
+    client = FakeClient()
+    node = build_node("v5e", "2x2", "s0", 0, fake=False)
+    node.status.heartbeat_time = time.time() - 100.0
+    client.create(node)
+    pod = Pod(meta=new_meta("p0"))
+    pod.status.node_name = node.meta.name
+    pod.status.phase = PodPhase.RUNNING
+    client.create(pod)
+
+    ctl = NodeLifecycleController(client, grace_seconds=10.0)
+    ctl._pass()
+
+    live = client.get(Node, node.meta.name)
+    assert live.status.ready is False
+    assert "heartbeat stale" in live.status.message
+    failed = client.get(Pod, "p0")
+    assert failed.status.phase == PodPhase.FAILED
+    assert "lost" in failed.status.message
+
+
+def test_fake_and_never_heartbeated_nodes_exempt():
+    client = FakeClient()
+    fake = build_node("v5e", "2x2", "s1", 0, fake=True)
+    fake.status.heartbeat_time = time.time() - 100.0
+    client.create(fake)
+    fresh = build_node("v5e", "2x2", "s2", 0, fake=False)  # hb 0.0
+    client.create(fresh)
+
+    NodeLifecycleController(client, grace_seconds=10.0)._pass()
+    assert client.get(Node, fake.meta.name).status.ready is True
+    assert client.get(Node, fresh.meta.name).status.ready is True
+
+
+def test_recent_heartbeat_keeps_node_ready():
+    client = FakeClient()
+    node = build_node("v5e", "2x2", "s3", 0, fake=False)
+    node.status.heartbeat_time = time.time()
+    client.create(node)
+    NodeLifecycleController(client, grace_seconds=10.0)._pass()
+    assert client.get(Node, node.meta.name).status.ready is True
+
+
+def test_node_loss_triggers_gang_self_heal():
+    """e2e on a fake-kubelet cluster: kill one 'remote' host (stop its
+    heartbeats) → its pods fail → the PodClique self-heals onto the
+    surviving capacity."""
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x2",
+                                        count=3)], fake=True)
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        client = cl.client
+        # Tight lifecycle loop for the test.
+        ctl = NodeLifecycleController(client, grace_seconds=0.5,
+                                      sync_period=0.1)
+        ctl.start()
+        try:
+            client.create(PodCliqueSet(
+                meta=new_meta("healpcs"),
+                spec=PodCliqueSetSpec(replicas=1,
+                                      template=PodCliqueSetTemplate(
+                    # chips=0 (CPU-style pods): placement is free to move
+                    # pods between slices — the subject here is node-loss
+                    # healing, not slice packing.
+                    cliques=[PodCliqueTemplate(
+                        name="w", replicas=2, min_available=1,
+                        tpu_chips_per_pod=0,
+                        container=ContainerSpec(argv=["sleep", "inf"]))],
+                ))))
+            sel = {c.LABEL_PCS_NAME: "healpcs"}
+            wait_for(lambda: len([
+                p for p in client.list(Pod, selector=sel)
+                if p.status.phase == PodPhase.RUNNING]) == 2,
+                timeout=15.0, desc="pods running")
+
+            # Adopt the node under pod[0] as agent-managed whose agent
+            # just died: non-fake, heartbeat already stale.
+            victim_name = client.list(Pod, selector=sel)[0].status.node_name
+            victim = client.get(Node, victim_name)
+            victim.spec.fake = False
+            client.update(victim)
+            victim = client.get(Node, victim_name)
+            victim.status.heartbeat_time = time.time() - 100.0
+            client.update_status(victim)
+
+            wait_for(lambda: client.get(
+                Node, victim_name).status.ready is False,
+                timeout=10.0, desc="victim marked NotReady")
+
+            def healed():
+                pods = [p for p in client.list(Pod, selector=sel)
+                        if p.status.phase == PodPhase.RUNNING]
+                return len(pods) == 2 and all(
+                    p.status.node_name != victim_name for p in pods)
+            wait_for(healed, timeout=15.0,
+                     desc="pods self-healed off the lost node")
+        finally:
+            ctl.stop()
+
+
+def test_config_validation():
+    from grove_tpu.api.config import OperatorConfiguration, validate_config
+    cfg = OperatorConfiguration()
+    cfg.node_lifecycle.grace_seconds = 0
+    assert any("grace_seconds" in e for e in validate_config(cfg))
