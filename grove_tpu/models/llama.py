@@ -195,11 +195,16 @@ def head(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
 
 def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
             positions: jnp.ndarray | None = None,
-            mesh=None, ring: bool = False) -> jnp.ndarray:
+            mesh=None, ring: bool = False,
+            sp: str | None = None) -> jnp.ndarray:
     """Full forward pass → logits [b, s, vocab]. Training / compile-check path.
 
-    ``ring=True`` (requires ``mesh``) computes attention with ring
-    sequence parallelism over the sp axis — the long-context path.
+    ``sp`` selects the sequence-parallel attention strategy over the sp
+    mesh axis (requires ``mesh``): ``"ring"`` — K/V blocks rotate via
+    ppermute, O(s/sp) memory, any head count; ``"ulysses"`` — two
+    all_to_all exchanges swap seq for head sharding, fewer collectives,
+    heads must divide sp. ``ring=True`` is the legacy spelling of
+    ``sp="ring"``.
     """
     b, s = tokens.shape
     _pos_arg = positions
@@ -208,16 +213,26 @@ def forward(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = embed(cfg, params, tokens)
 
-    attn_fn = None
     if ring:
-        from grove_tpu.ops.ringattention import ring_attention
-        assert mesh is not None, "ring attention needs the mesh"
-        # The ring path derives its causal mask from shard offsets and
-        # assumes default contiguous positions; custom positions would
-        # silently disagree with the mask.
+        assert sp in (None, "ring"), f"ring=True conflicts with sp={sp!r}"
+        sp = "ring"
+    attn_fn = None
+    if sp is not None:
+        assert mesh is not None, "sequence parallelism needs the mesh"
+        # Both SP paths derive causality from shard offsets and assume
+        # default contiguous positions; custom positions would silently
+        # disagree with the mask.
         assert _pos_arg is None, \
-            "ring=True does not support custom positions"
-        attn_fn = lambda q, k, v: ring_attention(mesh, q, k, v)  # noqa: E731
+            "sequence parallelism does not support custom positions"
+        if sp == "ring":
+            from grove_tpu.ops.ringattention import ring_attention
+            attn_fn = lambda q, k, v: ring_attention(mesh, q, k, v)  # noqa: E731
+        elif sp == "ulysses":
+            from grove_tpu.ops.ulysses import ulysses_attention
+            attn_fn = lambda q, k, v: ulysses_attention(mesh, q, k, v)  # noqa: E731
+        else:
+            raise ValueError(f"unknown sp strategy {sp!r} "
+                             "(expected 'ring' or 'ulysses')")
 
     def body(x, lp):
         x, _ = _layer_prefill(cfg, x, lp, cos, sin, positions, 0,
@@ -315,7 +330,8 @@ def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
 
 
 def loss_fn(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
-            mesh=None, ring: bool = False) -> jnp.ndarray:
+            mesh=None, ring: bool = False,
+            sp: str | None = None) -> jnp.ndarray:
     """Next-token cross-entropy (training path for the multichip dry-run)."""
-    return next_token_loss(forward(cfg, params, tokens, mesh=mesh, ring=ring),
-                           tokens)
+    return next_token_loss(forward(cfg, params, tokens, mesh=mesh, ring=ring,
+                                   sp=sp), tokens)

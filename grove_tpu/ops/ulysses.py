@@ -1,0 +1,77 @@
+"""Ulysses (all-to-all) sequence parallelism — the second SP strategy.
+
+DeepSpeed-Ulysses-style context parallelism: activations arrive
+seq-sharded over ``sp``; one ``lax.all_to_all`` re-shards heads over
+``sp`` and assembles the FULL sequence on every member, local causal
+attention runs on the head subset, and the inverse all_to_all restores
+seq sharding. Versus ring attention (ops/ringattention.py):
+
+- two all_to_all collectives total instead of ``sp`` ppermute rounds —
+  fewer, larger transfers that ride ICI's bisection rather than hop
+  neighbour-to-neighbour, and no per-step collective latency on the
+  critical path;
+- the full [b, s, h/sp, d] sequence is resident per member, so memory
+  is O(s) (ring stays O(s/sp)) — the right trade for moderate contexts
+  where attention FLOPs, not activation memory, dominate;
+- heads must divide over sp (GQA: KV heads too) — ring has no such
+  constraint.
+
+Both strategies present the same (mesh, q, k, v) surface and both rely
+on the orchestrator's slice-atomic placement to keep the sp group on one
+ICI domain (SURVEY.md §2.7: the operator packs the participants; the
+engine inside the pods runs the actual SP).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from grove_tpu.ops.attention import causal_attention
+from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+
+def _ulysses_local(q, k, v, axis_name: str):
+    """Per-shard body (under shard_map).
+
+    q: [b, s_local, h_l, d]; k/v: [b, s_local, n_kv_l, d]. h_l/n_kv_l are
+    the per-member head counts AFTER any tp sharding; sp further divides
+    them for the attention phase.
+    """
+    sp = lax.axis_size(axis_name)
+    h_l, n_kv_l = q.shape[2], k.shape[2]
+    assert h_l % sp == 0 and n_kv_l % sp == 0, (
+        f"ulysses needs heads divisible by sp={sp}: have q heads {h_l}, "
+        f"kv heads {n_kv_l} per member (use ring attention otherwise)")
+    # Gather sequence, scatter heads: [b, s_l, h_l, d] -> [b, s, h_l/sp, d].
+    # Shards hold contiguous sequence blocks in axis-index order, so the
+    # concat along seq reassembles absolute positions 0..s-1.
+    qf = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kf = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vf = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    out = causal_attention(qf, kf, vf)           # [b, s, h_l/sp, d]
+    # Inverse: gather heads, scatter sequence.
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(mesh: Mesh, q, k, v, *, axis_name: str = AXIS_SP):
+    """Causal GQA attention with all-to-all sequence parallelism.
+
+    q: [b, s, h, d], k/v: [b, s, n_kv, d] — global shapes; s sharded over
+    ``sp``, heads over ``tp``, batch over ``dp`` (same contract as
+    ring_attention)."""
+    spec = P(AXIS_DP, axis_name, AXIS_TP, None)
+    fn = jax.shard_map(
+        partial(_ulysses_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
