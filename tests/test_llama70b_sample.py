@@ -1,0 +1,78 @@
+"""The north-star shape (BASELINE.md): Llama-70B disaggregated serving
+on a v5e-256 slice — the sample must admit, gang-schedule slice-packed,
+and its chip/memory math must actually hold."""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.api import Node, Pod, PodCliqueSet, constants as c
+from grove_tpu.api.core import PodPhase
+from grove_tpu.cluster import new_cluster
+from grove_tpu.manifest import load_manifest
+from grove_tpu.models import llama
+from grove_tpu.parallel.mesh import MeshPlan, validate_plan_fits_slice
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+V5E_HBM_BYTES = 16e9
+
+
+def test_instance_memory_and_mesh_math():
+    """The sample's comment block, checked: tp=8 x pp=2 = 16 chips per
+    instance carries the bf16 weights with KV headroom on v5e."""
+    cfg = llama.CONFIGS["llama-70b"]
+    assert cfg.n_kv_heads == 8  # tp=8 shards KV heads exactly
+    plan = MeshPlan(pp=2, tp=8)
+    validate_plan_fits_slice(plan, 256)  # ICI groups fit the slice
+    chips = plan.size
+    assert chips == 16
+    weights_per_chip = cfg.params_bytes / chips
+    assert weights_per_chip < 0.6 * V5E_HBM_BYTES, weights_per_chip
+    # KV cache at the serving point (batch 8, 8k context) fits the rest.
+    kv_bytes = (2 * cfg.n_layers * 8 * cfg.max_seq_len * cfg.n_kv_heads
+                * cfg.head_dim * 2) / chips
+    assert weights_per_chip + kv_bytes < 0.9 * V5E_HBM_BYTES
+
+
+def test_sample_schedules_slice_packed_on_v5e_256():
+    objs = load_manifest(open("samples/llama70b-disagg.yaml"))
+    assert len(objs) == 1 and isinstance(objs[0], PodCliqueSet)
+
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="16x16",
+                                        count=1)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        cl.client.create(objs[0])
+        sel = {c.LABEL_PCS_NAME: "llama70b"}
+
+        def all_up():
+            pods = cl.client.list(Pod, selector=sel)
+            # 4 sg replicas x (4 prefill + 4 decode) + 1 router
+            return len(pods) == 33 and all(
+                p.status.phase == PodPhase.RUNNING for p in pods)
+        wait_for(all_up, timeout=30.0, desc="all 33 pods running")
+
+        # Slice-packed: every chip-bearing pod landed on ONE slice.
+        pods = cl.client.list(Pod, selector=sel)
+        slices = set()
+        for p in pods:
+            if p.spec.tpu_chips == 0:
+                continue
+            node = cl.client.get(Node, p.status.node_name)
+            slices.add(node.meta.labels[c.NODE_LABEL_SLICE])
+        assert len(slices) == 1, slices
+
+        # Chip accounting: 4 instances x 32 chips = 128 of 256.
+        used = sum(p.spec.tpu_chips for p in pods)
+        assert used == 128
+
+        # Startup wiring: the router pod carries a barrier on both pools
+        # (it may legitimately start before SCALED gang replicas — the
+        # barrier covers the base gang's instances).
+        router = [p for p in pods if "-router-" in p.meta.name][0]
+        barrier = router.spec.startup_barrier
+        assert barrier is not None and barrier.parent_cliques
+        parents = " ".join(barrier.parent_cliques)
+        assert "prefill" in parents and "decode" in parents, parents

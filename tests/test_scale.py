@@ -73,3 +73,36 @@ def test_soak_scale_cycles():
             desc="gangs pruned")
         # No leaked pods.
         assert len(client.list(Pod, selector={c.LABEL_PCS_NAME: "soak"})) == 2
+
+
+def test_scale_dashboard_renders(tmp_path):
+    """tools/scale_dashboard.py: history JSONL → markdown with per-run
+    deltas and the 20% regression verdict."""
+    import json
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import scale_dashboard
+    finally:
+        sys.path.pop(0)
+    hist = tmp_path / "h.jsonl"
+    rows = [
+        {"label": "r1", "ts": 1.0, "pods": 100, "deploy_pods_ready_s": 10.0,
+         "deploy_pods_created_s": 1.0, "deploy_pods_scheduled_s": 5.0,
+         "steady_reconciles_per_s": 0.0, "delete_cascade_s": 0.1},
+        {"label": "r2", "ts": 2.0, "pods": 100, "deploy_pods_ready_s": 13.0,
+         "deploy_pods_created_s": 1.0, "deploy_pods_scheduled_s": 5.0,
+         "steady_reconciles_per_s": 0.0, "delete_cascade_s": 0.1},
+        "not json",
+    ]
+    hist.write_text("\n".join(
+        r if isinstance(r, str) else json.dumps(r) for r in rows) + "\n")
+    runs = scale_dashboard.load_runs([str(hist)])
+    assert len(runs) == 2
+    report = scale_dashboard.render(runs)
+    assert "## 100 pods" in report and "REGRESSION" in report  # 13 > 10*1.2
+    assert "| r1 |" in report and "best" in report and "+30%" in report
+    assert scale_dashboard.sparkline([1.0, 1.0]) == "▁▁"
+    out = tmp_path / "d.md"
+    assert scale_dashboard.main([str(hist), "-o", str(out)]) == 0
+    assert out.read_text() == report

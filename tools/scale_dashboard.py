@@ -1,0 +1,111 @@
+"""Scale-history dashboard — the reference's hack/scale-dashboard +
+scale-history.py analog.
+
+Renders run-over-run scale results (the JSONL that `python -m
+grove_tpu.scale --history` appends) into a markdown report: one table
+per pod count with per-run deltas against the best run, a unicode
+trend line for the headline metric (pods-ready latency), and a
+regression verdict matching the runner's 20% threshold.
+
+    python tools/scale_dashboard.py scale-history/*.jsonl \
+        [-o scale-history/DASHBOARD.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+SPARKS = "▁▂▃▄▅▆▇█"
+REGRESSION_FACTOR = 1.2  # keep in lockstep with scale/runner.py
+
+
+def load_runs(paths: list[str]) -> list[dict]:
+    runs = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        entry = json.loads(line)
+                    except ValueError:
+                        continue
+                    if "pods" in entry and "deploy_pods_ready_s" in entry:
+                        entry["_source"] = path
+                        runs.append(entry)
+        except OSError as e:
+            print(f"warning: {path}: {e}", file=sys.stderr)
+    return runs
+
+
+def sparkline(values: list[float]) -> str:
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return SPARKS[0] * len(values)
+    return "".join(SPARKS[int((v - lo) / (hi - lo) * (len(SPARKS) - 1))]
+                   for v in values)
+
+
+def render(runs: list[dict]) -> str:
+    out = ["# Scale history", ""]
+    if not runs:
+        return "\n".join(out + ["_no runs recorded_", ""])
+    by_pods: dict[int, list[dict]] = {}
+    for r in runs:
+        by_pods.setdefault(r["pods"], []).append(r)
+    for pods in sorted(by_pods, reverse=True):
+        entries = sorted(by_pods[pods], key=lambda r: r.get("ts", 0.0))
+        ready = [r["deploy_pods_ready_s"] for r in entries]
+        best = min(ready)
+        latest = ready[-1]
+        verdict = ("REGRESSION" if latest > best * REGRESSION_FACTOR
+                   else "ok")
+        out += [f"## {pods} pods — latest {latest:.1f}s ready "
+                f"(best {best:.1f}s, {len(entries)} runs, {verdict})",
+                "",
+                f"trend: `{sparkline(ready)}`  (older → newer)",
+                "",
+                "| label | when | created | scheduled | ready | vs best "
+                "| steady rec/s | delete cascade |",
+                "|---|---|---|---|---|---|---|---|"]
+        for r in entries:
+            when = time.strftime("%Y-%m-%d %H:%M",
+                                 time.localtime(r.get("ts", 0.0)))
+            rd = r["deploy_pods_ready_s"]
+            delta = "best" if rd == best else f"+{(rd / best - 1) * 100:.0f}%"
+            out.append(
+                f"| {r.get('label') or '—'} | {when} "
+                f"| {r.get('deploy_pods_created_s', 0):.1f}s "
+                f"| {r.get('deploy_pods_scheduled_s', 0):.1f}s "
+                f"| {rd:.1f}s | {delta} "
+                f"| {r.get('steady_reconciles_per_s', 0):.1f} "
+                f"| {r.get('delete_cascade_s', 0):.2f}s |")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="scale-dashboard")
+    parser.add_argument("history", nargs="+", help="history JSONL file(s)")
+    parser.add_argument("-o", "--out", help="write markdown here "
+                                            "(default stdout)")
+    args = parser.parse_args(argv)
+    report = render(load_runs(args.history))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
