@@ -130,19 +130,26 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
     }
 
 
+def _w(w):
+    """Materialize a (possibly int8-quantized) weight for a matmul. XLA
+    fuses the upcast+scale into the operand read, so quantized weights
+    cross HBM as int8 (serving/quant.py)."""
+    return w.materialize() if hasattr(w, "materialize") else w
+
+
 def _qkv(cfg: LlamaConfig, x, lp, cos, sin, positions):
     """Pre-norm + QKV projections + rope. Shared by prefill and decode."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wq"]))
+    k = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wk"]))
+    v = jnp.einsum("bsd,dhk->bshk", h, _w(lp["wv"]))
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     return q, k, v
 
 
 def _attn_out(x, attn, lp, tp_axis=None):
-    out = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    out = jnp.einsum("bshk,hkd->bsd", attn, _w(lp["wo"]))
     if tp_axis is not None:
         # Megatron-style manual TP inside shard_map: heads are sharded over
         # tp, so wo produces a partial sum — reduce before the residual.
@@ -153,9 +160,10 @@ def _attn_out(x, attn, lp, tp_axis=None):
 def _mlp_block(cfg: LlamaConfig, x, lp, tp_axis=None):
     """Pre-norm SwiGLU MLP with residual. Shared by prefill and decode."""
     hm = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", hm, lp["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", hm, lp["w_up"])
-    out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    gate = jnp.einsum("bsd,df->bsf", hm, _w(lp["w_gate"]))
+    up = jnp.einsum("bsd,df->bsf", hm, _w(lp["w_up"]))
+    out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                     _w(lp["w_down"]))
     if tp_axis is not None:
         # ff hidden dim sharded over tp → w_down yields a partial sum.
         out = lax.psum(out, tp_axis)
@@ -183,13 +191,17 @@ def _layer_prefill(cfg: LlamaConfig, x, lp, cos, sin, positions, q_offset,
 
 def embed(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
     """Token embedding (shared by dense/ring/pipeline forwards)."""
-    return params["tok_embed"][tokens].astype(cfg.dtype)
+    te = params["tok_embed"]
+    if hasattr(te, "materialize"):  # int8: gather rows, then scale them
+        return (te.q[tokens].astype(te.scale.dtype)
+                * te.scale[tokens]).astype(cfg.dtype)
+    return te[tokens].astype(cfg.dtype)
 
 
 def head(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     """Final norm + LM head (shared by dense/ring/pipeline forwards)."""
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+    return jnp.einsum("bsd,dv->bsv", x, _w(params["lm_head"]),
                       preferred_element_type=jnp.float32)
 
 
@@ -261,7 +273,7 @@ def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
         lengths = jnp.full((b,), s, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    x = embed(cfg, params, tokens)
 
     # TPU → pallas flash kernel; anything else → the XLA formulation.
     # Trace-time choice, baked into the compiled prefill executable.
@@ -281,7 +293,7 @@ def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     # Last valid token per lane (ragged batches: pad rows carry garbage).
     x_last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    logits = jnp.einsum("bd,dv->bv", x_last, params["lm_head"],
+    logits = jnp.einsum("bd,dv->bv", x_last, _w(params["lm_head"]),
                         preferred_element_type=jnp.float32)
     new_cache = KVCache(k=k_all, v=v_all, lengths=lengths.astype(jnp.int32))
     return logits, new_cache
@@ -301,7 +313,7 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     b = tokens.shape[0]
     positions = cache.lengths[:, None]  # [b, 1]
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
-    x = params["tok_embed"][tokens[:, None]].astype(cfg.dtype)  # [b, 1, d]
+    x = embed(cfg, params, tokens[:, None])  # [b, 1, d]
     new_lengths = cache.lengths + 1
 
     def body(x, xs):
@@ -316,7 +328,7 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
 
     x, (k_all, v_all) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"],
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], _w(params["lm_head"]),
                         preferred_element_type=jnp.float32)
     return logits, KVCache(k=k_all, v=v_all, lengths=new_lengths)
 

@@ -87,9 +87,14 @@ class PrefillWorker:
 
     def __init__(self, cfg: LlamaConfig, params, batch: int = 1,
                  max_prompt: int | None = None,
-                 sampler: SamplerConfig | None = None):
+                 sampler: SamplerConfig | None = None,
+                 quant: str | None = None):
         self.cfg = cfg
         self.params = params
+        assert quant in (None, "int8"), f"unknown quant mode {quant!r}"
+        if quant == "int8":
+            from grove_tpu.serving.quant import quantize_params
+            self.params = quantize_params(self.params)
         self.batch = batch
         self.max_prompt = max_prompt or cfg.max_seq_len
         self.sampler = sampler or SamplerConfig()
@@ -140,7 +145,8 @@ class DecodeEngine:
                  max_len: int | None = None,
                  metric_hook: Callable[[int], None] | None = None,
                  host_sync_interval: int = 8,
-                 sampler: SamplerConfig | None = None):
+                 sampler: SamplerConfig | None = None,
+                 quant: str | None = None):
         self.cfg = cfg
         # Init-only: the sampled step closes over this config at compile
         # time, so later mutation cannot take effect (and is rejected).
@@ -149,6 +155,13 @@ class DecodeEngine:
             self.params = llama.init_params(cfg, key_or_params)
         else:
             self.params = key_or_params
+        # Weight-only int8 (serving/quant.py): decode is HBM-bound on the
+        # weight read, so this is ~the bandwidth win it looks like.
+        assert quant in (None, "int8"), f"unknown quant mode {quant!r}"
+        self.quant = quant
+        if quant == "int8":
+            from grove_tpu.serving.quant import quantize_params
+            self.params = quantize_params(self.params)
         self.batch = batch
         self.max_len = max_len or cfg.max_seq_len
         self.metric_hook = metric_hook

@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization: numerics vs bf16, pytree behavior
+through scan/jit, engine integration, byte accounting."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.models import llama
+from grove_tpu.serving.quant import (
+    QTensor,
+    params_bytes,
+    quantize_params,
+    quantize_tensor,
+)
+
+CFG = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32)
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _cos(a, b) -> float:
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-30))
+
+
+def test_quantize_tensor_roundtrip():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+    qt = quantize_tensor(w, axes=(0,))
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 32)
+    err = np.abs(np.asarray(qt.materialize(), np.float32) - np.asarray(w))
+    # per-channel symmetric int8: rounding ≤ scale/2, plus the bf16
+    # storage of the scale itself (relative ~0.4% on |q| ≤ 127)
+    bound = np.asarray(qt.scale, np.float32) * 1.1 + 1e-4
+    assert np.all(err <= bound)
+
+
+def test_quantized_forward_tracks_bf16():
+    params = _params()
+    qparams = quantize_params(params)
+    # norms untouched, matmuls quantized
+    assert isinstance(qparams["layers"]["wq"], QTensor)
+    assert not isinstance(qparams["layers"]["attn_norm"], QTensor)
+    assert isinstance(qparams["tok_embed"], QTensor)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                CFG.vocab_size)
+    full = llama.forward(CFG, params, tokens)       # scan over QTensor lp
+    quant = llama.forward(CFG, qparams, tokens)
+    assert _cos(full, quant) > 0.995, _cos(full, quant)
+
+
+def test_quantized_params_bytes_halve():
+    params = _params()
+    full = params_bytes(params)
+    quant = params_bytes(quantize_params(params))
+    # bf16 -> int8 on matmul weights (norms + scales are overhead)
+    assert quant < 0.65 * full, (quant, full)
+
+
+def test_engine_int8_decode_matches_quality():
+    """The int8 engine decodes coherently: same compiled surface, tokens
+    overwhelmingly agree with the bf16 engine on a greedy rollout."""
+    from grove_tpu.serving.engine import DecodeEngine
+    params = _params()
+    prompts = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                 CFG.vocab_size)
+
+    def rollout(quant):
+        eng = DecodeEngine(CFG, params, batch=2, quant=quant)
+        eng.admit_prompts(prompts)
+        toks = [np.asarray(eng._tokens)]
+        for _ in range(8):
+            eng.step()
+            toks.append(np.asarray(eng._tokens))
+        eng.sync()
+        return np.stack(toks)
+
+    bf16 = rollout(None)
+    int8 = rollout("int8")
+    agree = float((bf16 == int8).mean())
+    assert agree >= 0.75, agree  # random-init logits are nearly flat;
+    # real checkpoints agree far higher — this guards gross breakage
+
+
+def test_prefill_worker_quant_handoff():
+    """Disaggregated path: int8 prefill worker -> int8 decode engine
+    still produces a working KV handoff."""
+    from grove_tpu.serving.engine import DecodeEngine, PrefillWorker
+    params = _params()
+    pw = PrefillWorker(CFG, params, batch=1, max_prompt=16, quant="int8")
+    eng = DecodeEngine(CFG, params, batch=1, quant="int8")
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(4), (8,), 0,
+                                           CFG.vocab_size))
+    rid = eng.submit(prompt, max_new_tokens=4)
+    assert eng.admit_from_queue(pw) == 1
+    while not eng.completed:
+        eng.step()
+    assert eng.completed[0].rid == rid
+    assert len(eng.completed[0].generated) == 4
