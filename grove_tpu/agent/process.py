@@ -97,6 +97,7 @@ class ProcessKubelet:
 
         # Reap: processes whose pod vanished or was replaced (same name,
         # new uid); exited processes.
+        reaped: set[tuple[str, str]] = set()
         for key, (uid, proc) in list(self._procs.items()):
             pod = live_pods.get(key)
             if pod is None or pod.meta.deletion_timestamp is not None \
@@ -107,8 +108,32 @@ class ProcessKubelet:
             if code is not None:
                 del self._procs[key]
                 self._set_exit_status(pod, code)
+                reaped.add(key)
                 continue
             self._probe_readiness(pod)
+
+        # Orphans: a RUNNING pod on my node with no process entry means
+        # its process belonged to a previous agent incarnation (or its
+        # exit-status write was lost) — the process is gone either way.
+        # Fail it so the standard self-heal recreates it; critical for
+        # persistent-state restarts (store/persist.py), where pods
+        # survive the reboot but their processes do not.
+        # (skip pods reaped THIS pass: live_pods is a pre-reap snapshot,
+        # so they still read RUNNING here and the orphan write would
+        # stomp their just-written exit status.)
+        for key, pod in live_pods.items():
+            if (pod.status.phase == PodPhase.RUNNING
+                    and key not in self._procs
+                    and key not in reaped
+                    and pod.meta.deletion_timestamp is None):
+                def orphaned(p: Pod) -> None:
+                    if p.status.phase != PodPhase.RUNNING:
+                        return  # raced a fresher write; no-op suppressed
+                    p.status.phase = PodPhase.FAILED
+                    p.status.message = "process lost (agent restart)"
+                self._write_status(pod, orphaned)
+                self.log.warning("pod %s/%s: orphaned (no process); "
+                                 "failing for self-heal", *key)
 
         # Launch: bound pending pods whose barrier cleared.
         for key, pod in live_pods.items():

@@ -111,14 +111,17 @@ def _build_cluster(args: argparse.Namespace):
         if config is None:
             config = OperatorConfiguration()
         config.server_auth.tokens.update(load_token_file(token_file))
+    state_dir = getattr(args, "state_dir", None)
     fleet = parse_fleet(args.fleet)
     if args.real:
         fleet.fake = False
-        cluster = new_cluster(config=config, fleet=fleet, fake_kubelet=False)
+        cluster = new_cluster(config=config, fleet=fleet, fake_kubelet=False,
+                              state_dir=state_dir)
         from grove_tpu.agent.process import ProcessKubelet
         cluster.manager.add_runnable(ProcessKubelet(cluster.client))
     else:
-        cluster = new_cluster(config=config, fleet=fleet)
+        cluster = new_cluster(config=config, fleet=fleet,
+                              state_dir=state_dir)
     return cluster
 
 
@@ -429,6 +432,9 @@ def main(argv: list[str] | None = None) -> int:
                        help="bearer tokens file, 'token,actor' per line "
                             "(kube --token-auth-file analog; env "
                             "GROVE_TOKEN_FILE)")
+    serve.add_argument("--state-dir", dest="state_dir",
+                       help="durable control-plane state (WAL+snapshot); "
+                            "restart resumes every resource")
     serve.set_defaults(fn=cmd_serve)
 
     agent_p = sub.add_parser(

@@ -48,7 +48,15 @@ def new_cluster(config: OperatorConfiguration | None = None,
                 fleet: FleetSpec | None = None,
                 store: Store | None = None,
                 fake_kubelet: bool = True,
-                admission: bool = True) -> Cluster:
+                admission: bool = True,
+                state_dir: str | None = None) -> Cluster:
+    """``state_dir`` enables durable control-plane state (WAL + snapshot,
+    store/persist.py): a restarted cluster pointed at the same directory
+    resumes with every resource intact and reconciles from there —
+    restart is free, as with the reference's etcd. ``create_fleet`` is
+    idempotent, so passing the same ``fleet`` on reboot is safe."""
+    if store is None and state_dir is not None:
+        store = Store(state_dir=state_dir)
     mgr = Manager(config=config, store=store)
     registry = register_controllers(mgr)
     # Configuring API tokens implies wanting their identities enforced —

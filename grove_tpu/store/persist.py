@@ -1,0 +1,126 @@
+"""Store persistence: WAL + snapshot — the etcd-durability analog.
+
+The reference keeps every scrap of control-plane state in etcd, which is
+why operator restart is free (SURVEY.md §5 checkpoint/resume). This
+module gives the standalone store the same property: every mutation
+appends one JSONL record to a write-ahead log, the log compacts into a
+full snapshot every N records, and a fresh ``Store(state_dir=...)``
+rebuilds objects + resource-version counter from snapshot+WAL before
+serving its first read. Controllers then reconcile from the loaded
+state exactly as reference controllers do from informer resync.
+
+Format: ``snapshot.json`` = {"rv": N, "objects": [{"kind", "data"}]},
+``wal.jsonl`` = {"op": "put"|"delete", "kind", "data"|("ns","name")}
+per line. Object payloads are the full serde dict (meta+spec+status),
+decoded through the same KIND_REGISTRY the manifest codec uses.
+Appends flush to the OS on every record; fsync durability is not
+attempted (matching the in-memory store's crash model: a torn final
+line is skipped on load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from grove_tpu.api.serde import from_dict, to_dict
+
+
+def _registry() -> dict[str, type]:
+    from grove_tpu.manifest import KIND_REGISTRY
+    return KIND_REGISTRY
+
+
+class StatePersister:
+    def __init__(self, state_dir: str, compact_every: int = 1000):
+        self.state_dir = state_dir
+        self.compact_every = compact_every
+        os.makedirs(state_dir, exist_ok=True)
+        self.snapshot_path = os.path.join(state_dir, "snapshot.json")
+        self.wal_path = os.path.join(state_dir, "wal.jsonl")
+        self._wal_file = None
+        self._wal_records = 0
+
+    # ---- load ------------------------------------------------------------
+
+    def load(self) -> tuple[list[Any], int]:
+        """Return (objects, max_rv) from snapshot + WAL replay."""
+        registry = _registry()
+        objects: dict[tuple[str, str, str], Any] = {}
+        max_rv = 0
+
+        def put(kind: str, data: dict) -> None:
+            nonlocal max_rv
+            cls = registry.get(kind)
+            if cls is None:  # kind from a newer build; preserve nothing
+                return
+            obj = from_dict(cls, data)
+            objects[(kind, obj.meta.namespace, obj.meta.name)] = obj
+            max_rv = max(max_rv, obj.meta.resource_version)
+
+        if os.path.exists(self.snapshot_path):
+            with open(self.snapshot_path) as f:
+                snap = json.load(f)
+            max_rv = snap.get("rv", 0)
+            for entry in snap.get("objects", []):
+                put(entry["kind"], entry["data"])
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break  # torn tail record: ignore it and stop
+                    if rec["op"] == "put":
+                        put(rec["kind"], rec["data"])
+                    elif rec["op"] == "delete":
+                        objects.pop((rec["kind"], rec["ns"], rec["name"]),
+                                    None)
+                    self._wal_records += 1
+        return list(objects.values()), max_rv
+
+    # ---- append ----------------------------------------------------------
+
+    def _append(self, record: dict) -> None:
+        if self._wal_file is None:
+            self._wal_file = open(self.wal_path, "a")
+        self._wal_file.write(json.dumps(record) + "\n")
+        self._wal_file.flush()
+        self._wal_records += 1
+
+    def record_put(self, obj: Any) -> None:
+        self._append({"op": "put", "kind": obj.KIND, "data": to_dict(obj)})
+
+    def record_delete(self, obj: Any) -> None:
+        self._append({"op": "delete", "kind": obj.KIND,
+                      "ns": obj.meta.namespace, "name": obj.meta.name})
+
+    def maybe_compact(self, objects: list[Any], rv: int) -> bool:
+        """Snapshot + truncate the WAL once it exceeds the threshold.
+        Caller passes a consistent view (holds the store lock)."""
+        if self._wal_records < self.compact_every:
+            return False
+        self.compact(objects, rv)
+        return True
+
+    def compact(self, objects: list[Any], rv: int) -> None:
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rv": rv,
+                       "objects": [{"kind": o.KIND, "data": to_dict(o)}
+                                   for o in objects]}, f)
+        os.replace(tmp, self.snapshot_path)
+        if self._wal_file is not None:
+            self._wal_file.close()
+            self._wal_file = None
+        open(self.wal_path, "w").close()
+        self._wal_records = 0
+
+    def close(self) -> None:
+        if self._wal_file is not None:
+            self._wal_file.close()
+            self._wal_file = None

@@ -81,12 +81,45 @@ class Watcher:
 
 
 class Store:
-    def __init__(self) -> None:
+    def __init__(self, state_dir: str | None = None) -> None:
         self._lock = threading.RLock()
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = itertools.count(1)
         self._watchers: list[Watcher] = []
         self._admission = None   # AdmissionChain (see grove_tpu.admission)
+        # Durability (etcd analog, store/persist.py): WAL every mutation,
+        # snapshot compaction, full state restore on construction.
+        self._persister = None
+        if state_dir is not None:
+            from grove_tpu.store.persist import StatePersister
+            self._persister = StatePersister(state_dir)
+            objects, max_rv = self._persister.load()
+            for obj in objects:
+                self._objects.setdefault(obj.KIND, {})[_key(obj)] = obj
+            self._rv = itertools.count(max_rv + 1)
+
+    def _persist_put(self, obj: Any) -> None:
+        if self._persister is not None:
+            self._persister.record_put(obj)
+            self._maybe_compact()
+
+    def _persist_delete(self, obj: Any) -> None:
+        if self._persister is not None:
+            self._persister.record_delete(obj)
+            self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        # Called under the lock: the object view handed to the persister
+        # is consistent, and stored objects are never mutated in place.
+        self._persister.maybe_compact(
+            [o for objs in self._objects.values() for o in objs.values()],
+            rv=self._peek_rv())
+
+    def _peek_rv(self) -> int:
+        # itertools.count has no peek; track via a probe-and-restore.
+        rv = next(self._rv)
+        self._rv = itertools.count(rv)
+        return rv - 1
 
     def set_admission(self, chain) -> None:
         self._admission = chain
@@ -159,6 +192,7 @@ class Store:
             stored.meta.resource_version = next(self._rv)
             stored.meta.generation = 1
             objs[key] = stored
+            self._persist_put(stored)
             self._emit(EventType.ADDED, stored)
             return clone(stored)
 
@@ -187,6 +221,7 @@ class Store:
                 stored.meta.generation += 1
             stored.meta.resource_version = next(self._rv)
             self._objects[obj.KIND][_key(obj)] = stored
+            self._persist_put(stored)
             self._emit(EventType.MODIFIED, stored)
             if stored.meta.deletion_timestamp and not stored.meta.finalizers:
                 self._remove(stored)
@@ -221,6 +256,7 @@ class Store:
         stored.status = clone(obj.status)
         stored.meta.resource_version = next(self._rv)
         self._objects[obj.KIND][_key(obj)] = stored
+        self._persist_put(stored)
         self._emit(EventType.MODIFIED, stored)
         return stored
 
@@ -264,6 +300,7 @@ class Store:
                     marked.meta.deletion_timestamp = time.time()
                     marked.meta.resource_version = next(self._rv)
                     self._objects[kind_cls.KIND][(namespace, name)] = marked
+                    self._persist_put(marked)
                     self._emit(EventType.MODIFIED, marked)
                 return
             self._remove(obj)
@@ -271,6 +308,7 @@ class Store:
     def _remove(self, obj: Any) -> None:
         """Unconditional removal + owner-reference cascade (GC analog)."""
         self._objects[obj.KIND].pop(_key(obj), None)
+        self._persist_delete(obj)
         self._emit(EventType.DELETED, obj)
         # Cascade: anything owned (controller ref) by this uid gets deleted.
         uid = obj.meta.uid
@@ -286,6 +324,7 @@ class Store:
                     marked.meta.deletion_timestamp = time.time()
                     marked.meta.resource_version = next(self._rv)
                     self._objects[dep.KIND][_key(dep)] = marked
+                    self._persist_put(marked)
                     self._emit(EventType.MODIFIED, marked)
             else:
                 self._remove(dep)

@@ -63,6 +63,8 @@ def build_node(generation: str, topology: str, slice_name: str, worker: int,
 def create_fleet(client: Client, fleet: FleetSpec,
                  namespace: str = "default") -> list[Node]:
     """Create Node objects for every host of every slice in the fleet."""
+    from grove_tpu.runtime.errors import AlreadyExistsError
+
     nodes: list[Node] = []
     slice_seq = 0
     for spec in fleet.slices:
@@ -71,8 +73,15 @@ def create_fleet(client: Client, fleet: FleetSpec,
             slice_name = f"{spec.pool}-slice-{slice_seq}"
             slice_seq += 1
             for w in range(hosts):
-                nodes.append(client.create(build_node(
+                node = build_node(
                     spec.generation, spec.topology, slice_name, w,
                     pool=spec.pool, superblock=spec.superblock,
-                    namespace=namespace, fake=fleet.fake)))
+                    namespace=namespace, fake=fleet.fake)
+                try:
+                    nodes.append(client.create(node))
+                except AlreadyExistsError:
+                    # Persistent-state reboot with the same fleet flag:
+                    # the node survived the restart; keep it.
+                    nodes.append(client.get(Node, node.meta.name,
+                                            namespace))
     return nodes
