@@ -34,11 +34,16 @@ class ProcessKubelet:
     def __init__(self, client: Client, namespace: str | None = None,
                  node_name: str | None = None, tick: float = 0.05,
                  workdir: str | None = None, log_dir: str | None = None,
-                 extra_env: dict[str, str] | None = None):
+                 extra_env: dict[str, str] | None = None,
+                 wake: threading.Event | None = None):
         self.client = client
         self.namespace = namespace
         self.node_name = node_name
         self.tick = tick
+        # Optional wake signal: when set, the loop re-passes immediately
+        # instead of waiting out the tick (the remote agent's watch feed
+        # sets it on relevant events, so tick can be a slow fallback).
+        self.wake = wake
         self.workdir = workdir
         # Agent-level env for every pod (e.g. GROVE_CONTROL_PLANE in serve
         # mode). Read at launch time, so the dict may be filled after
@@ -64,6 +69,8 @@ class ProcessKubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.wake is not None:
+            self.wake.set()  # unblock a waiting loop promptly
         if self._thread is not None:
             self._thread.join(2.0)
         for key, (_, proc) in list(self._procs.items()):
@@ -75,7 +82,11 @@ class ProcessKubelet:
                 self._pass()
             except Exception:  # noqa: BLE001 - agent survival barrier
                 self.log.exception("process kubelet pass panicked")
-            time.sleep(self.tick)
+            if self.wake is not None:
+                self.wake.wait(self.tick)
+                self.wake.clear()
+            else:
+                time.sleep(self.tick)
 
     def _my_nodes(self) -> dict[str, Node]:
         nodes = {}

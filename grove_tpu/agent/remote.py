@@ -39,23 +39,33 @@ class RemoteAgent:
                  namespace: str = "default", heartbeat_seconds: float = 5.0,
                  tick: float = 0.25, workdir: str | None = None,
                  log_dir: str | None = None,
-                 extra_env: dict[str, str] | None = None):
+                 extra_env: dict[str, str] | None = None,
+                 use_watch: bool = True):
         """``client`` is any store-client surface (HttpClient in real
         deployments; an in-process Client works for tests). ``register``
         is the Node to create if absent — None means the node must
-        already exist (pre-provisioned fleet)."""
+        already exist (pre-provisioned fleet).
+
+        With ``use_watch`` and an HttpClient, the agent consumes the
+        server's event feed and wakes the kubelet immediately on pod
+        events — ``tick`` then only bounds the polling fallback, so it
+        can be slow without costing reaction latency."""
         self.client = client
         self.node_name = node_name
         self.register = register
         self.namespace = namespace
         self.heartbeat_seconds = heartbeat_seconds
         self.log = get_logger("agent.remote")
-        self.kubelet = ProcessKubelet(client, namespace=namespace,
-                                      node_name=node_name, tick=tick,
-                                      workdir=workdir, log_dir=log_dir,
-                                      extra_env=extra_env)
+        self._wake = threading.Event()
+        self._use_watch = use_watch and hasattr(client, "watch_events")
+        self.kubelet = ProcessKubelet(
+            client, namespace=namespace, node_name=node_name,
+            tick=(max(tick, 2.0) if self._use_watch else tick),
+            workdir=workdir, log_dir=log_dir, extra_env=extra_env,
+            wake=self._wake)
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        self._watch_thread: threading.Thread | None = None
 
     def start(self) -> None:
         self.ensure_node()
@@ -64,13 +74,40 @@ class RemoteAgent:
                                            name="agent-heartbeat",
                                            daemon=True)
         self._hb_thread.start()
-        self.log.info("remote agent up: node %s", self.node_name)
+        if self._use_watch:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="agent-watch", daemon=True)
+            self._watch_thread.start()
+        self.log.info("remote agent up: node %s (watch=%s)",
+                      self.node_name, self._use_watch)
 
     def stop(self) -> None:
         self._stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(2.0)
         self.kubelet.stop()
+        # The watch thread is daemon + blocks in a long poll; it dies
+        # with the process (the server also unblocks it at timeout).
+
+    def _watch_loop(self) -> None:
+        """Consume the wire event feed; any Pod/PodClique event wakes the
+        kubelet (it re-lists, so coarse filtering is enough). On gaps or
+        transport errors, back off and bootstrap a fresh watch — the
+        kubelet's fallback tick covers the blind window."""
+        from grove_tpu.store.httpclient import WatchGoneError
+        while not self._stop.is_set():
+            try:
+                for _seq, _etype, _obj in self.client.watch_events(
+                        kinds=["Pod", "PodClique"], namespace=None,
+                        poll_timeout=20.0):
+                    self._wake.set()
+                    if self._stop.is_set():
+                        return
+            except WatchGoneError:
+                self._wake.set()  # force a prompt re-list pass
+            except GroveError as e:
+                self.log.warning("watch feed error: %s; retrying", e)
+            self._stop.wait(1.0)
 
     def ensure_node(self) -> None:
         try:

@@ -10,6 +10,13 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       selectors via ?l.<key>=<value>)
   GET  /api/<kind>/<name>             get one
   GET  /logs/<ns>/<pod>               pod logs (?tail=N; kubectl-logs analog)
+  GET  /watch                         resumable long-poll event feed
+                                      (?since=<rv>&timeout=&kinds=A,B&
+                                      namespace=&l.<k>=<v>); since past
+                                      the history ring -> 410 Gone,
+                                      relist and resume (kube watch
+                                      semantics). The informer feed for
+                                      remote agents.
   GET  /debug/profile                 all-threads sampling profile over a
                                       window (?seconds=, ?format=collapsed|
                                       top); pprof-endpoint analog, gated by
@@ -162,6 +169,8 @@ class ApiServer:
                     elif len(parts) == 3 and parts[0] == "logs":
                         self._pod_logs(parts[1], parts[2],
                                        parse_qs(url.query))
+                    elif url.path == "/watch":
+                        self._watch(parse_qs(url.query))
                     elif url.path == "/debug/profile":
                         self._debug_profile(parse_qs(url.query))
                     elif url.path == "/debug/stacks":
@@ -268,6 +277,50 @@ class ApiServer:
                     lines = data.splitlines()[-tail_n:] if tail_n > 0 else []
                     data = "\n".join(lines) + ("\n" if lines else "")
                 self._send(200, data, content_type="text/plain")
+
+            def _watch(self, q):
+                """Long-poll the store's event history. Returns
+                {"rv": N, "events": [...]} — empty events on timeout
+                (client re-polls with the same since); 410 when history
+                no longer covers ``since``."""
+                import time as _time
+
+                store = cluster.manager.store
+                try:
+                    since = int(q.get("since", ["-1"])[0])
+                    timeout = min(float(q.get("timeout", ["25"])[0]), 60.0)
+                except ValueError:
+                    self._send(400, {"error": "bad since/timeout value"})
+                    return
+                if since < 0:  # bootstrap: current rv, no events
+                    self._send(200, {"rv": store.current_rv(),
+                                     "events": []})
+                    return
+                kinds_arg = q.get("kinds", [""])[0]
+                kinds = set(kinds_arg.split(",")) if kinds_arg else None
+                ns = q.get("namespace", [None])[0]
+                ns = None if ns in (None, "*") else ns
+                selector = {k[2:]: v[0] for k, v in q.items()
+                            if k.startswith("l.")} or None
+                deadline = _time.time() + timeout
+                while True:
+                    events, ok = store.replay(since, kinds=kinds,
+                                              namespace=ns,
+                                              selector=selector)
+                    if not ok:
+                        self._send(410, {"error": f"history gone before "
+                                         f"rv {since}; relist"})
+                        return
+                    if events or _time.time() >= deadline:
+                        payload = [{"seq": seq, "type": ev.type.value,
+                                    "kind": ev.obj.KIND,
+                                    "object": to_dict(ev.obj)}
+                                   for seq, ev in events]
+                        self._send(200, {
+                            "rv": events[-1][0] if events else since,
+                            "events": payload})
+                        return
+                    _time.sleep(0.05)
 
             def _profiling_config(self):
                 """Profiling config when the surface is enabled, else None

@@ -12,8 +12,9 @@ HttpClient pinned to its node (see grove_tpu/agent docs and the
 reference's in-pod initc, which likewise talks to the apiserver from
 inside the workload boundary).
 
-No watch support: remote consumers poll (list) at agent cadence; the
-event-driven path stays in-process with the controllers.
+``watch_events`` is the wire informer feed: a blocking generator over
+the server's resumable long-poll ``GET /watch`` (history-ring replay;
+a gap raises ``WatchGoneError`` — relist and restart, kube semantics).
 """
 
 from __future__ import annotations
@@ -31,6 +32,11 @@ from grove_tpu.runtime.errors import (
 )
 
 
+class WatchGoneError(GroveError):
+    """The server's event history no longer covers the resume point;
+    relist and start a fresh watch."""
+
+
 class HttpClient:
     def __init__(self, server: str, token: str = "", timeout: float = 10.0):
         self.server = server.rstrip("/")
@@ -39,7 +45,8 @@ class HttpClient:
 
     # -- plumbing ---------------------------------------------------------
 
-    def _request(self, method: str, path: str, body: dict | None = None):
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 timeout: float | None = None):
         import urllib.error
         import urllib.request
 
@@ -50,7 +57,8 @@ class HttpClient:
         req = urllib.request.Request(f"{self.server}{path}", method=method,
                                      data=data, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             raw = e.read()
@@ -64,6 +72,8 @@ class HttpClient:
                 raise ForbiddenError(msg)
             if e.code == 409:
                 raise ConflictError(msg)
+            if e.code == 410:
+                raise WatchGoneError(msg)
             if e.code == 401:
                 raise ForbiddenError(f"unauthenticated: {msg}")
             raise GroveError(msg)
@@ -119,3 +129,35 @@ class HttpClient:
                namespace: str = "default") -> None:
         self._request("DELETE", f"/api/{kind_cls.KIND}/{quote(name)}"
                                 f"?{urlencode({'namespace': namespace})}")
+
+    def watch_events(self, kinds: list[str] | None = None,
+                     namespace: str | None = None,
+                     selector: dict[str, str] | None = None,
+                     since: int | None = None,
+                     poll_timeout: float = 25.0):
+        """Blocking generator of (seq, type_str, obj) from the server's
+        event feed. ``since=None`` bootstraps at the current rv (only
+        NEW events flow). Raises WatchGoneError when the server's
+        history no longer covers the resume point."""
+        from grove_tpu.manifest import KIND_REGISTRY
+
+        if since is None:
+            since = self._request("GET", "/watch")["rv"]
+        params: dict[str, str] = {"since": str(since),
+                                  "timeout": str(poll_timeout)}
+        if kinds:
+            params["kinds"] = ",".join(kinds)
+        params["namespace"] = namespace if namespace is not None else "*"
+        for k, v in (selector or {}).items():
+            params[f"l.{k}"] = v
+        while True:
+            params["since"] = str(since)
+            resp = self._request(
+                "GET", f"/watch?{urlencode(params)}",
+                timeout=poll_timeout + 5.0)
+            for ev in resp["events"]:
+                cls = KIND_REGISTRY.get(ev["kind"])
+                if cls is None:
+                    continue
+                yield ev["seq"], ev["type"], from_dict(cls, ev["object"])
+            since = resp["rv"]

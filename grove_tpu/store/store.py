@@ -14,6 +14,7 @@ is structurally impossible).
 
 from __future__ import annotations
 
+import collections
 import enum
 import itertools
 import queue
@@ -87,6 +88,12 @@ class Store:
         self._rv = itertools.count(1)
         self._watchers: list[Watcher] = []
         self._admission = None   # AdmissionChain (see grove_tpu.admission)
+        # Event history ring for resumable (wire) watches: (seq, event).
+        # seq is the rv that produced the event (deletes allocate one).
+        # A watcher further behind than the ring must relist (410-Gone
+        # semantics, exactly the kube watch contract).
+        self._history: collections.deque[tuple[int, Event]] = \
+            collections.deque(maxlen=4096)
         # Durability (etcd analog, store/persist.py): WAL every mutation,
         # snapshot compaction, full state restore on construction.
         self._persister = None
@@ -138,15 +145,55 @@ class Store:
             self._watchers.append(w)
         return w
 
-    def _emit(self, etype: EventType, obj: Any) -> None:
-        if not self._watchers:
-            return
-        # One clone shared by all watchers: event payloads are read-only
-        # by convention (mappers extract names/labels; reconcilers re-read
-        # through the client, never mutate event objects).
+    def _emit(self, etype: EventType, obj: Any, seq: int | None = None) -> None:
+        # One clone shared by all watchers AND the history ring: event
+        # payloads are read-only by convention (mappers extract
+        # names/labels; reconcilers re-read through the client, never
+        # mutate event objects).
         shared = Event(etype, clone(obj))
+        self._history.append(
+            (obj.meta.resource_version if seq is None else seq, shared))
         for w in self._watchers:
             w._offer(shared)
+
+    def current_rv(self) -> int:
+        """The highest resource version issued so far (watch bootstrap)."""
+        with self._lock:
+            rv = next(self._rv)
+            self._rv = itertools.count(rv)
+            return rv - 1
+
+    def replay(self, since: int,
+               kinds: set[str] | None = None,
+               namespace: str | None = None,
+               selector: dict[str, str] | None = None
+               ) -> tuple[list[tuple[int, Event]], bool]:
+        """Events with seq > ``since``, filtered. Returns (events, ok);
+        ok=False means ``since`` predates the ring (the caller must
+        relist — kube's 410 Gone). Seqs are consecutive (every allocated
+        rv emits exactly one event; no-op suppression allocates none),
+        so history is lost iff the first retained seq skips past
+        ``since + 1`` — or the ring is empty while events have happened
+        (e.g. a persistent store freshly rebooted)."""
+        with self._lock:
+            if self._history:
+                if since + 1 < self._history[0][0]:
+                    return [], False
+            elif since < self.current_rv():
+                return [], False
+            out = []
+            for seq, ev in self._history:
+                if seq <= since:
+                    continue
+                if kinds is not None and ev.obj.KIND not in kinds:
+                    continue
+                if namespace is not None \
+                        and ev.obj.meta.namespace != namespace:
+                    continue
+                if not matches_labels(ev.obj, selector):
+                    continue
+                out.append((seq, ev))
+            return out, True
 
     # ---- reads ----
 
@@ -309,7 +356,9 @@ class Store:
         """Unconditional removal + owner-reference cascade (GC analog)."""
         self._objects[obj.KIND].pop(_key(obj), None)
         self._persist_delete(obj)
-        self._emit(EventType.DELETED, obj)
+        # Deletions get their own seq (kube bumps rv on delete too) so
+        # resumable watches order them after the final MODIFIED.
+        self._emit(EventType.DELETED, obj, seq=next(self._rv))
         # Cascade: anything owned (controller ref) by this uid gets deleted.
         uid = obj.meta.uid
         dependents = [
