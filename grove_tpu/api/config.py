@@ -67,6 +67,33 @@ class ServerAuthConfig:
 
 
 @dataclasses.dataclass
+class ServerTlsConfig:
+    """TLS for the HTTP API server (the reference's webhook cert
+    machinery, cert.go:50-117: self-provisioned + rotated certs or a
+    BYO secret). Off by default — the serve daemon binds loopback; flip
+    on for anything that leaves the host."""
+
+    enabled: bool = False
+    mode: str = "self-managed"      # "self-managed" | "byo"
+    # self-managed: CA + leaf are generated/rotated under cert_dir
+    # (ca.crt is the file clients pin; rotation never changes it).
+    cert_dir: str = "certs"
+    validity_days: float = 30.0
+    # Re-issue the leaf when less than this fraction of its validity
+    # remains (reference rotates ahead of expiry for the same reason:
+    # a restart must never be required to stay serveable).
+    rotation_fraction: float = 0.2
+    rotation_check_seconds: float = 3600.0
+    sans: list[str] = dataclasses.field(
+        default_factory=lambda: ["localhost", "127.0.0.1"])
+    # byo: operator-supplied PEM files (validated: pair matches, not
+    # expired). ca_file is advertised to clients, never loaded here.
+    cert_file: str = ""
+    key_file: str = ""
+    ca_file: str = ""
+
+
+@dataclasses.dataclass
 class NodeLifecycleConfig:
     """Heartbeat-driven host-loss detection (node-lifecycle-controller
     analog; only acts on non-fake nodes that have heartbeated)."""
@@ -122,6 +149,8 @@ class OperatorConfiguration:
         default_factory=AuthorizerConfig)
     server_auth: ServerAuthConfig = dataclasses.field(
         default_factory=ServerAuthConfig)
+    server_tls: ServerTlsConfig = dataclasses.field(
+        default_factory=ServerTlsConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
     node_lifecycle: NodeLifecycleConfig = dataclasses.field(
@@ -192,6 +221,21 @@ def validate_config(cfg: OperatorConfiguration) -> list[str]:
         errs.append(
             f"default_scheduler_profile {cfg.default_scheduler_profile!r} "
             f"not among profiles {names}")
+    tls = cfg.server_tls
+    if tls.mode not in ("self-managed", "byo"):
+        errs.append(f"server_tls.mode must be self-managed|byo, "
+                    f"got {tls.mode!r}")
+    if tls.validity_days <= 0:
+        errs.append(f"server_tls.validity_days must be > 0, "
+                    f"got {tls.validity_days}")
+    if not 0 < tls.rotation_fraction < 1:
+        errs.append(f"server_tls.rotation_fraction must be in (0, 1), "
+                    f"got {tls.rotation_fraction}")
+    if tls.enabled and tls.mode == "byo" \
+            and not (tls.cert_file and tls.key_file):
+        errs.append("server_tls mode 'byo' requires cert_file and key_file")
+    if tls.enabled and tls.mode == "self-managed" and not tls.sans:
+        errs.append("server_tls.sans must not be empty")
     if cfg.node_lifecycle.grace_seconds <= 0:
         errs.append("node_lifecycle.grace_seconds must be > 0, got "
                     f"{cfg.node_lifecycle.grace_seconds}")

@@ -187,6 +187,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 from grove_tpu.admission.authorization import OPERATOR_ACTOR
                 bootstrap_token = secrets.token_urlsafe(24)
                 auth.tokens[bootstrap_token] = OPERATOR_ACTOR
+            tls_cfg = cluster.manager.config.server_tls
+            if args.tls:
+                tls_cfg.enabled = True
+            if args.tls_cert_dir:
+                tls_cfg.enabled = True
+                tls_cfg.cert_dir = args.tls_cert_dir
+            if getattr(args, "tls_san", None):
+                tls_cfg.enabled = True
+                tls_cfg.sans.extend(s for s in args.tls_san
+                                    if s not in tls_cfg.sans)
+            if tls_cfg.enabled:
+                # The serving address must be in the leaf's SANs or every
+                # off-host client fails hostname verification. Wildcard
+                # binds get this host's names; explicit hosts get added.
+                import socket as _socket
+                extra = ([_socket.gethostname(), _socket.getfqdn()]
+                         if args.host in ("0.0.0.0", "::")
+                         else [args.host])
+                tls_cfg.sans.extend(s for s in extra
+                                    if s and s not in tls_cfg.sans)
             server = ApiServer(cluster, host=args.host, port=args.port)
             try:
                 server.start()
@@ -197,17 +217,22 @@ def cmd_serve(args: argparse.Namespace) -> int:
             if bootstrap_token is not None:
                 print(f"api token (generated): {bootstrap_token}\n"
                       f"  export GROVE_API_TOKEN={bootstrap_token}")
+            if server.ca_file:
+                print(f"tls ca certificate: {server.ca_file}\n"
+                      f"  export GROVE_API_CA={server.ca_file}")
             # Pods learn the control-plane URL so in-pod engines can push
             # autoscaling metrics (serving/metrics_push.py). Wildcard
             # binds map to loopback — pods launched by the in-process
             # kubelet are local, and 0.0.0.0 is not a routable target.
             push_host = "127.0.0.1" if args.host in ("0.0.0.0", "::") \
                 else args.host
-            url = f"http://{push_host}:{server.port}"
+            url = f"{server.scheme}://{push_host}:{server.port}"
             from grove_tpu.agent.process import ProcessKubelet
             for r in cluster.manager.runnables:
                 if isinstance(r, ProcessKubelet):
                     r.extra_env["GROVE_CONTROL_PLANE"] = url
+                    if server.ca_file:
+                        r.extra_env["GROVE_API_CA"] = server.ca_file
             print(f"grove-tpu control plane serving on "
                   f"{url}  (ctrl-c to stop)")
             try:
@@ -223,11 +248,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
 def _http(server: str, path: str, method: str = "GET",
           body: bytes | None = None,
           content_type: str = "application/yaml",
-          token: str | None = None):
+          token: str | None = None, ca: str | None = None):
     """Request against a serve daemon. Returns (status, decoded-body);
     status 0 = could not reach the server. Shared by the client verbs and
     the server tests. ``token`` (default: $GROVE_API_TOKEN) authenticates
-    mutating verbs."""
+    mutating verbs; ``ca`` (default: $GROVE_API_CA) pins the TLS CA for
+    https:// servers."""
     import json as _json
     import os as _os
     import urllib.error
@@ -246,10 +272,16 @@ def _http(server: str, path: str, method: str = "GET",
         token = _os.environ.get("GROVE_API_TOKEN", "")
     if token:
         headers["Authorization"] = f"Bearer {token}"
+    ctx = None
+    if server.startswith("https"):
+        import ssl
+        if ca is None:
+            ca = _os.environ.get("GROVE_API_CA", "")
+        ctx = ssl.create_default_context(cafile=ca or None)
     req = urllib.request.Request(f"{server}{path}", method=method, data=body,
                                  headers=headers)
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
             return resp.status, decode(resp.read(),
                                        resp.headers.get("Content-Type", ""))
     except urllib.error.HTTPError as e:
@@ -268,7 +300,7 @@ def cmd_get(args: argparse.Namespace) -> int:
     """Read resources from a running serve daemon."""
     import json as _json
     path = f"/api/{args.kind}" + (f"/{args.name}" if args.name else "")
-    status, body = _http(args.server, path)
+    status, body = _http(args.server, path, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
         return 1
@@ -284,7 +316,7 @@ def cmd_apply(args: argparse.Namespace) -> int:
     except OSError as e:
         print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
         return 1
-    status, out = _http(args.server, "/apply", "POST", body)
+    status, out = _http(args.server, "/apply", "POST", body, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
         return 1
@@ -303,7 +335,8 @@ def cmd_patch(args: argparse.Namespace) -> int:
         return 1
     status, out = _http(args.server, f"/api/{args.kind}/{args.name}",
                         "PATCH", args.patch.encode(),
-                        content_type="application/merge-patch+json")
+                        content_type="application/merge-patch+json",
+                        ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
         return 1
@@ -314,7 +347,8 @@ def cmd_patch(args: argparse.Namespace) -> int:
 
 def cmd_delete(args: argparse.Namespace) -> int:
     """Delete a resource on a running serve daemon."""
-    status, out = _http(args.server, f"/api/{args.kind}/{args.name}", "DELETE")
+    status, out = _http(args.server, f"/api/{args.kind}/{args.name}",
+                        "DELETE", ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
         return 1
@@ -330,7 +364,8 @@ def cmd_agent(args: argparse.Namespace) -> int:
     from grove_tpu.runtime.errors import GroveError
 
     token = args.token or os.environ.get("GROVE_API_TOKEN", "")
-    client = HttpClient(args.server, token=token)
+    ca = args.ca or os.environ.get("GROVE_API_CA", "")
+    client = HttpClient(args.server, token=token, ca_file=ca)
     register = None
     if args.register:
         from grove_tpu.topology.fleet import build_node, node_name
@@ -394,15 +429,21 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     default_server = "http://127.0.0.1:8087"
+    def add_ca(p):
+        p.add_argument("--ca", help="CA certificate to pin for https "
+                                    "servers (default $GROVE_API_CA)")
+
     get = sub.add_parser("get", help="read resources from a serve daemon")
     get.add_argument("kind")
     get.add_argument("name", nargs="?")
     get.add_argument("--server", default=default_server)
+    add_ca(get)
     get.set_defaults(fn=cmd_get)
 
     apply_p = sub.add_parser("apply", help="apply a manifest to a serve daemon")
     apply_p.add_argument("-f", "--file", required=True)
     apply_p.add_argument("--server", default=default_server)
+    add_ca(apply_p)
     apply_p.set_defaults(fn=cmd_apply)
 
     patch_p = sub.add_parser(
@@ -413,12 +454,14 @@ def main(argv: list[str] | None = None) -> int:
     patch_p.add_argument("-p", "--patch", required=True,
                          help='e.g. \'{"spec": {"replicas": 3}}\'')
     patch_p.add_argument("--server", default=default_server)
+    add_ca(patch_p)
     patch_p.set_defaults(fn=cmd_patch)
 
     delete = sub.add_parser("delete", help="delete a resource on a serve daemon")
     delete.add_argument("kind")
     delete.add_argument("name")
     delete.add_argument("--server", default=default_server)
+    add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
 
     serve = sub.add_parser("serve", help="run the control plane as a "
@@ -432,6 +475,17 @@ def main(argv: list[str] | None = None) -> int:
                        help="bearer tokens file, 'token,actor' per line "
                             "(kube --token-auth-file analog; env "
                             "GROVE_TOKEN_FILE)")
+    serve.add_argument("--tls", action="store_true",
+                       help="serve HTTPS with self-managed certificates "
+                            "(config: server_tls)")
+    serve.add_argument("--tls-cert-dir", dest="tls_cert_dir",
+                       help="certificate directory for --tls "
+                            "(implies --tls; default 'certs')")
+    serve.add_argument("--tls-san", dest="tls_san", action="append",
+                       help="extra subject-alternative-name for the "
+                            "server certificate (repeatable; implies "
+                            "--tls). The bind host is added "
+                            "automatically.")
     serve.add_argument("--state-dir", dest="state_dir",
                        help="durable control-plane state (WAL+snapshot); "
                             "restart resumes every resource")
@@ -449,6 +503,7 @@ def main(argv: list[str] | None = None) -> int:
     agent_p.add_argument("--namespace", default="default")
     agent_p.add_argument("--token", help="bearer token "
                                          "(default $GROVE_API_TOKEN)")
+    add_ca(agent_p)
     agent_p.add_argument("--tick", type=float, default=0.25)
     agent_p.add_argument("--workdir")
     agent_p.set_defaults(fn=cmd_agent)

@@ -1,0 +1,294 @@
+"""Managed TLS certificates for the HTTP API server — the C6 analog.
+
+The reference gates its webhook server behind TLS with either
+self-provisioned + rotated certs or a BYO secret
+(operator/internal/controller/cert/cert.go:50-117, modes at
+api/config/v1alpha1/types.go:230). grove-tpu's standalone control plane
+ships its own HTTP API instead of webhooks, so the same machinery lands
+here: a ``CertManager`` that either
+
+- **self-managed** (default): generates a long-lived CA and a short-lived
+  leaf server certificate into ``cert_dir`` (``ca.crt``, ``ca.key``,
+  ``tls.crt``, ``tls.key``), re-issuing the leaf when it enters the
+  rotation window. Clients pin ``ca.crt`` once; rotation never changes it
+  (the CA lives 10x the leaf validity).
+- **byo**: serves operator-supplied ``cert_file``/``key_file`` unmodified,
+  after checking the pair actually matches and has not expired — the two
+  failure modes that otherwise surface as undebuggable handshake errors.
+
+Rotation is applied by reloading the chain into the live
+``ssl.SSLContext`` — new handshakes pick up the new leaf; established
+connections are untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import ipaddress
+import os
+import ssl
+import threading
+
+from grove_tpu.runtime.errors import ValidationError
+
+_DAY = datetime.timedelta(days=1)
+
+
+@dataclasses.dataclass
+class CertPaths:
+    cert_file: str
+    key_file: str
+    ca_file: str = ""
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _new_key():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _key_pem(key) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+def _name(cn: str):
+    from cryptography import x509
+    from cryptography.x509.oid import NameOID
+
+    return x509.Name([
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, "grove-tpu"),
+        x509.NameAttribute(NameOID.COMMON_NAME, cn),
+    ])
+
+
+def _san_entries(sans: list[str]):
+    from cryptography import x509
+
+    entries = []
+    for san in sans:
+        try:
+            entries.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            entries.append(x509.DNSName(san))
+    return entries
+
+
+def generate_ca(validity: datetime.timedelta):
+    """Self-signed CA (key, cert)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+
+    key = _new_key()
+    now = _now()
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("grove-tpu-ca"))
+        .issuer_name(_name("grove-tpu-ca"))
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _DAY)           # clock-skew slack
+        .not_valid_after(now + validity)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .add_extension(
+            x509.KeyUsage(digital_signature=True, key_cert_sign=True,
+                          crl_sign=True, content_commitment=False,
+                          key_encipherment=False, data_encipherment=False,
+                          key_agreement=False, encipher_only=False,
+                          decipher_only=False),
+            critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    return key, cert
+
+
+def issue_leaf(ca_key, ca_cert, sans: list[str],
+               validity: datetime.timedelta):
+    """Server leaf certificate signed by the CA."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.x509.oid import ExtendedKeyUsageOID
+
+    key = _new_key()
+    now = _now()
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("grove-tpu-api"))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - _DAY)
+        .not_valid_after(now + validity)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .add_extension(x509.SubjectAlternativeName(_san_entries(sans)),
+                       critical=False)
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.SERVER_AUTH]),
+            critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+    return key, cert
+
+
+def _load_cert(path: str):
+    from cryptography import x509
+
+    with open(path, "rb") as f:
+        return x509.load_pem_x509_certificate(f.read())
+
+
+def _load_key(path: str):
+    from cryptography.hazmat.primitives import serialization
+
+    with open(path, "rb") as f:
+        return serialization.load_pem_private_key(f.read(), password=None)
+
+
+def _pair_matches(cert, key) -> bool:
+    from cryptography.hazmat.primitives import serialization
+
+    pub = serialization.Encoding.PEM, serialization.PublicFormat.SubjectPublicKeyInfo
+    return (cert.public_key().public_bytes(*pub)
+            == key.public_key().public_bytes(*pub))
+
+
+def _write_private(path: str, data: bytes) -> None:
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
+class CertManager:
+    """Provision, validate, and rotate the API server's TLS material.
+
+    ``ensure()`` is idempotent and cheap when nothing needs doing; the
+    server calls it at startup and on a timer (``maybe_rotate``) so a
+    long-lived daemon never serves an expired leaf.
+    """
+
+    def __init__(self, tls_config):
+        self.cfg = tls_config
+        self._lock = threading.Lock()
+        self._context: ssl.SSLContext | None = None
+
+    # -- provisioning -----------------------------------------------------
+
+    def ensure(self) -> CertPaths:
+        if self.cfg.mode == "byo":
+            return self._ensure_byo()
+        return self._ensure_self_managed()
+
+    def _ensure_byo(self) -> CertPaths:
+        cfg = self.cfg
+        if not cfg.cert_file or not cfg.key_file:
+            raise ValidationError(
+                "server_tls mode 'byo' requires cert_file and key_file")
+        for p in (cfg.cert_file, cfg.key_file):
+            if not os.path.exists(p):
+                raise ValidationError(f"server_tls: {p!r} does not exist")
+        cert = _load_cert(cfg.cert_file)
+        if not _pair_matches(cert, _load_key(cfg.key_file)):
+            raise ValidationError(
+                f"server_tls: key {cfg.key_file!r} does not match "
+                f"certificate {cfg.cert_file!r}")
+        if cert.not_valid_after_utc <= _now():
+            raise ValidationError(
+                f"server_tls: certificate {cfg.cert_file!r} expired "
+                f"{cert.not_valid_after_utc.isoformat()}")
+        return CertPaths(cfg.cert_file, cfg.key_file, cfg.ca_file)
+
+    def _paths(self) -> CertPaths:
+        # Absolute: these paths are handed to other processes (pod env,
+        # printed export hints) whose cwd is not the daemon's.
+        d = os.path.abspath(self.cfg.cert_dir)
+        return CertPaths(os.path.join(d, "tls.crt"),
+                         os.path.join(d, "tls.key"),
+                         os.path.join(d, "ca.crt"))
+
+    def _ensure_self_managed(self) -> CertPaths:
+        with self._lock:
+            paths = self._paths()
+            d = self.cfg.cert_dir
+            os.makedirs(d, exist_ok=True)
+            ca_key_path = os.path.join(d, "ca.key")
+            validity = datetime.timedelta(days=self.cfg.validity_days)
+
+            ca_ok = os.path.exists(paths.ca_file) and os.path.exists(ca_key_path)
+            if ca_ok:
+                ca_cert = _load_cert(paths.ca_file)
+                # Re-root ONLY once the CA has actually expired (every
+                # pinned client is already broken at that point).
+                # Replacing a still-valid trust anchor behind running
+                # agents' backs would cut off the whole fleet — rotating
+                # the CA early is a deliberate operator action (remove
+                # cert_dir, redistribute ca.crt).
+                ca_ok = ca_cert.not_valid_after_utc > _now()
+            if not ca_ok:
+                ca_key, ca_cert = generate_ca(10 * validity)
+                _write_private(ca_key_path, _key_pem(ca_key))
+                with open(paths.ca_file, "wb") as f:
+                    f.write(_cert_pem(ca_cert))
+
+            if self._leaf_needs_issue(paths, ca_cert):
+                ca_key = _load_key(ca_key_path)
+                # Leaf lifetime never outlives the CA that signed it.
+                leaf_validity = min(validity,
+                                    ca_cert.not_valid_after_utc - _now())
+                key, cert = issue_leaf(ca_key, ca_cert,
+                                       list(self.cfg.sans), leaf_validity)
+                _write_private(paths.key_file, _key_pem(key))
+                with open(paths.cert_file, "wb") as f:
+                    f.write(_cert_pem(cert))
+            return paths
+
+    def _leaf_needs_issue(self, paths: CertPaths, ca_cert) -> bool:
+        if not (os.path.exists(paths.cert_file)
+                and os.path.exists(paths.key_file)):
+            return True
+        cert = _load_cert(paths.cert_file)
+        if cert.issuer != ca_cert.subject:
+            return True                      # CA was re-rooted
+        total = cert.not_valid_after_utc - cert.not_valid_before_utc
+        remaining = cert.not_valid_after_utc - _now()
+        return remaining <= total * self.cfg.rotation_fraction
+
+    # -- the live server context ------------------------------------------
+
+    def server_context(self) -> ssl.SSLContext:
+        paths = self.ensure()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        ctx.load_cert_chain(paths.cert_file, paths.key_file)
+        self._context = ctx
+        return ctx
+
+    def maybe_rotate(self) -> bool:
+        """Rotate the leaf if due and reload it into the live context.
+        Returns True when a rotation happened. BYO mode never rotates —
+        the operator owns the files."""
+        if self.cfg.mode == "byo" or self._context is None:
+            return False
+        paths = self._paths()
+        ca_cert = _load_cert(paths.ca_file)
+        if not self._leaf_needs_issue(paths, ca_cert):
+            return False
+        paths = self.ensure()
+        self._context.load_cert_chain(paths.cert_file, paths.key_file)
+        return True
+
+
+def _cert_pem(cert) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+
+    return cert.public_bytes(serialization.Encoding.PEM)

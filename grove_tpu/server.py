@@ -39,8 +39,11 @@ mutations are rejected (401) and the mapped actor is impersonated on the
 store client so admission authorization fires on the wire path exactly
 as it does in-process — a token mapped to a plain user cannot mutate
 grove-managed children (403). Reads and /metrics/push stay open by
-default (config-gated). Plain TCP: this server is a loopback/VPC-internal
-control socket — front it with a TLS terminator for untrusted networks.
+default (config-gated). TLS: config.server_tls enables managed
+certificates (self-provisioned CA + rotated leaf, or BYO files — the
+reference's webhook cert machinery, cert.go:50-117; see
+grove_tpu/runtime/certs.py); clients pin the CA via HttpClient(ca_file=)
+or ``grovectl --ca``. Default remains plain loopback TCP.
 
 Single-threaded-per-request stdlib server (ThreadingHTTPServer): the
 store is already thread-safe, and control-plane traffic is low-rate.
@@ -71,11 +74,78 @@ class ApiServer:
         self.host = host
         self.port = port
         self._httpd: ThreadingHTTPServer | None = None
+        self._certs = None              # CertManager when TLS is on
+        self._rotate_timer: threading.Timer | None = None
+        self._stopped = False
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self._certs is not None else "http"
+
+    @property
+    def ca_file(self) -> str | None:
+        """Trust anchor clients should pin (self-managed mode), the
+        configured ca_file (byo), or None over plain HTTP."""
+        if self._certs is None:
+            return None
+        paths = self._certs.ensure()
+        return paths.ca_file or None
+
+    def _setup_tls(self) -> None:
+        """Wrap the listening socket when config.server_tls.enabled —
+        the C6 cert-controller analog (self-managed CA + rotated leaf,
+        or BYO files; grove_tpu/runtime/certs.py)."""
+        tls = self.cluster.manager.config.server_tls
+        if not tls.enabled:
+            return
+        from grove_tpu.runtime.certs import CertManager
+
+        self._certs = CertManager(tls)
+        ctx = self._certs.server_context()
+        # Handshake is deferred to the per-connection handler thread
+        # (Handler.setup): with do_handshake_on_connect=True the accept
+        # loop itself would run the handshake, so one client that opens
+        # a TCP connection and never speaks TLS wedges ALL accepts.
+        self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                             server_side=True,
+                                             do_handshake_on_connect=False)
+        if tls.mode != "byo" and tls.rotation_check_seconds > 0:
+            self._schedule_rotation(tls.rotation_check_seconds)
+
+    def _schedule_rotation(self, period: float) -> None:
+        def tick():
+            if self._stopped:
+                return
+            try:
+                self._certs.maybe_rotate()
+            except Exception:           # noqa: BLE001 — keep serving on
+                pass                    # the old leaf; next tick retries
+            # Re-check after the (possibly slow) rotation: a stop() that
+            # raced this tick must not leave a fresh timer pinning the
+            # dead server for another period.
+            if not self._stopped:
+                self._schedule_rotation(period)
+
+        self._rotate_timer = threading.Timer(period, tick)
+        self._rotate_timer.daemon = True
+        self._rotate_timer.start()
 
     def start(self) -> None:
         cluster = self.cluster
+        api = self
 
         class Handler(BaseHTTPRequestHandler):
+            def setup(self):
+                # TLS handshake runs HERE, in this connection's own
+                # thread with a bounded timeout (see _setup_tls for why
+                # not in the accept loop). Cleared afterwards so the
+                # timeout never fires inside a long-poll /watch.
+                if api._certs is not None:
+                    self.request.settimeout(10.0)
+                    self.request.do_handshake()
+                    self.request.settimeout(None)
+                super().setup()
+
             def log_message(self, *args):  # quiet
                 pass
 
@@ -304,23 +374,26 @@ class ApiServer:
                             if k.startswith("l.")} or None
                 deadline = _time.time() + timeout
                 while True:
-                    events, ok = store.replay(since, kinds=kinds,
-                                              namespace=ns,
-                                              selector=selector)
+                    events, ok, scanned = store.replay(since, kinds=kinds,
+                                                       namespace=ns,
+                                                       selector=selector)
                     if not ok:
                         self._send(410, {"error": f"history gone before "
                                          f"rv {since}; relist"})
                         return
+                    # Advance past filtered-out events too: a cursor
+                    # pinned at the last *matching* seq would 410 as
+                    # soon as unrelated churn wraps the ring.
+                    since = scanned
                     if events or _time.time() >= deadline:
                         payload = [{"seq": seq, "type": ev.type.value,
                                     "kind": ev.obj.KIND,
                                     "object": to_dict(ev.obj)}
                                    for seq, ev in events]
-                        self._send(200, {
-                            "rv": events[-1][0] if events else since,
-                            "events": payload})
+                        self._send(200, {"rv": since, "events": payload})
                         return
-                    _time.sleep(0.05)
+                    store.wait_events(since,
+                                      timeout=deadline - _time.time())
 
             def _profiling_config(self):
                 """Profiling config when the surface is enabled, else None
@@ -493,12 +566,32 @@ class ApiServer:
                 except GroveError as e:
                     self._send(400, {"error": str(e)})
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        api_server = self
+
+        class QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # Failed/timed-out TLS handshakes (port scans, plain-HTTP
+                # probes, half-open connections) are expected noise, not
+                # server errors worth a traceback.
+                import ssl
+                import sys
+                exc = sys.exc_info()[1]
+                if api_server._certs is not None and isinstance(
+                        exc, (ssl.SSLError, TimeoutError, ConnectionError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = QuietServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolve port 0
+        self._setup_tls()
         threading.Thread(target=self._httpd.serve_forever,
                          name="api-server", daemon=True).start()
 
     def stop(self) -> None:
+        self._stopped = True
+        if self._rotate_timer is not None:
+            self._rotate_timer.cancel()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+            self._httpd = None

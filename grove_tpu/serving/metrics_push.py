@@ -5,6 +5,8 @@ to the control plane's HTTP API using only the injected environment:
 
 - ``GROVE_CONTROL_PLANE`` — the serve daemon URL (injected by the node
   agent when the cluster runs in serve mode),
+- ``GROVE_API_CA`` — CA bundle pinning an https control plane (injected
+  alongside the URL when serve runs with --tls),
 - ``GROVE_PCSG_NAME`` / ``GROVE_PCLQ_NAME`` — which object the metric
   scales.
 
@@ -21,6 +23,7 @@ import urllib.error
 import urllib.request
 
 ENV_CONTROL_PLANE = "GROVE_CONTROL_PLANE"
+ENV_CA = "GROVE_API_CA"
 
 
 def push_metric(metric: str, value: float, *, kind: str | None = None,
@@ -54,9 +57,18 @@ def push_metric(metric: str, value: float, *, kind: str | None = None,
         f"{server}/metrics/push", data=payload, method="POST",
         headers={"Content-Type": "application/json"})
     try:
-        with urllib.request.urlopen(req, timeout=2) as resp:
+        ctx = None
+        if server.startswith("https"):
+            import ssl
+            # Inside the try: a missing/unreadable CA file must degrade
+            # to a skipped push, not crash the engine's metrics loop.
+            ctx = ssl.create_default_context(
+                cafile=os.environ.get(ENV_CA) or None)
+        with urllib.request.urlopen(req, timeout=2, context=ctx) as resp:
             return resp.status == 200
-    except (urllib.error.URLError, OSError):
+    except (OSError, ValueError):
+        # URLError, SSLError, FileNotFoundError are all OSError;
+        # ValueError covers a malformed CA bundle path/content.
         return False
 
 

@@ -38,12 +38,29 @@ class WatchGoneError(GroveError):
 
 
 class HttpClient:
-    def __init__(self, server: str, token: str = "", timeout: float = 10.0):
+    def __init__(self, server: str, token: str = "", timeout: float = 10.0,
+                 ca_file: str = ""):
+        """``ca_file`` pins the server's CA for https:// endpoints (the
+        self-managed cert manager's ca.crt, or the BYO CA). Without it,
+        https uses the system trust store — which will reject the
+        self-signed control-plane CA, by design."""
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.ca_file = ca_file
+        self._ssl_ctx = None
 
     # -- plumbing ---------------------------------------------------------
+
+    def _context(self):
+        import ssl
+
+        if not self.server.startswith("https"):
+            return None
+        if self._ssl_ctx is None:
+            self._ssl_ctx = ssl.create_default_context(
+                cafile=self.ca_file or None)
+        return self._ssl_ctx
 
     def _request(self, method: str, path: str, body: dict | None = None,
                  timeout: float | None = None):
@@ -58,7 +75,8 @@ class HttpClient:
                                      data=data, headers=headers)
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
+                    req, timeout=timeout or self.timeout,
+                    context=self._context()) as resp:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             raw = e.read()
@@ -79,6 +97,13 @@ class HttpClient:
             raise GroveError(msg)
         except urllib.error.URLError as e:
             raise GroveError(f"cannot reach {self.server}: {e.reason}")
+        except (OSError, ValueError) as e:
+            # Mid-read failures (reset/timeout during resp.read(), or a
+            # truncated JSON body) are neither HTTPError nor URLError;
+            # unwrapped they'd kill callers' retry loops — the remote
+            # agent's watch thread only handles GroveError.
+            raise GroveError(f"request to {self.server} failed "
+                             f"mid-response: {e}")
 
     # -- verbs ------------------------------------------------------------
 

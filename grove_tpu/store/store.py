@@ -84,6 +84,9 @@ class Watcher:
 class Store:
     def __init__(self, state_dir: str | None = None) -> None:
         self._lock = threading.RLock()
+        # Signalled on every _emit: wire long-polls block on this instead
+        # of rescanning the ring on a poll interval.
+        self._event_cond = threading.Condition(self._lock)
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = itertools.count(1)
         self._watchers: list[Watcher] = []
@@ -155,36 +158,57 @@ class Store:
             (obj.meta.resource_version if seq is None else seq, shared))
         for w in self._watchers:
             w._offer(shared)
+        self._event_cond.notify_all()
 
     def current_rv(self) -> int:
         """The highest resource version issued so far (watch bootstrap)."""
         with self._lock:
-            rv = next(self._rv)
-            self._rv = itertools.count(rv)
-            return rv - 1
+            return self._peek_rv()
+
+    def wait_events(self, since: int, timeout: float) -> None:
+        """Block until the ring holds an event with seq > ``since`` or
+        ``timeout`` elapses — the wire long-poll's wakeup (no ring
+        rescan per poll tick; _emit notifies)."""
+        with self._event_cond:
+            self._event_cond.wait_for(
+                lambda: bool(self._history
+                             and self._history[-1][0] > since),
+                timeout=timeout)
 
     def replay(self, since: int,
                kinds: set[str] | None = None,
                namespace: str | None = None,
                selector: dict[str, str] | None = None
-               ) -> tuple[list[tuple[int, Event]], bool]:
-        """Events with seq > ``since``, filtered. Returns (events, ok);
-        ok=False means ``since`` predates the ring (the caller must
-        relist — kube's 410 Gone). Seqs are consecutive (every allocated
-        rv emits exactly one event; no-op suppression allocates none),
-        so history is lost iff the first retained seq skips past
+               ) -> tuple[list[tuple[int, Event]], bool, int]:
+        """Events with seq > ``since``, filtered. Returns
+        (events, ok, scanned): ok=False means ``since`` predates the
+        ring (the caller must relist — kube's 410 Gone); ``scanned`` is
+        the highest seq examined (>= since), which the caller MUST use
+        as its next resume point even when every event was filtered out
+        — resuming at the last *matching* seq pins the cursor while
+        unrelated events wrap the ring, turning a quiet filtered watch
+        into a spurious 410. Seqs are consecutive (every allocated rv
+        emits exactly one event; no-op suppression allocates none), so
+        history is lost iff the first retained seq skips past
         ``since + 1`` — or the ring is empty while events have happened
         (e.g. a persistent store freshly rebooted)."""
         with self._lock:
             if self._history:
                 if since + 1 < self._history[0][0]:
-                    return [], False
-            elif since < self.current_rv():
-                return [], False
+                    return [], False, since
+            elif since < self._peek_rv():
+                return [], False, since
             out = []
-            for seq, ev in self._history:
+            scanned = since
+            # Seqs are consecutive, so the resume offset is arithmetic —
+            # no head-scan past already-delivered entries (at 1000-pod
+            # churn the skip-scan would dominate every long-poll).
+            start = max(0, since + 1 - self._history[0][0]) \
+                if self._history else 0
+            for seq, ev in itertools.islice(self._history, start, None):
                 if seq <= since:
                     continue
+                scanned = max(scanned, seq)
                 if kinds is not None and ev.obj.KIND not in kinds:
                     continue
                 if namespace is not None \
@@ -193,7 +217,7 @@ class Store:
                 if not matches_labels(ev.obj, selector):
                     continue
                 out.append((seq, ev))
-            return out, True
+            return out, True, scanned
 
     # ---- reads ----
 

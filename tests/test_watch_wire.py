@@ -46,19 +46,40 @@ def test_store_replay_semantics():
     s.update_status(live)
     s.delete(Node, n1.meta.name)
 
-    events, ok = s.replay(rv0)
+    events, ok, scanned = s.replay(rv0)
     assert ok
     assert [e.type.value for _, e in events] == \
         ["ADDED", "MODIFIED", "DELETED"]
     seqs = [seq for seq, _ in events]
     assert seqs == sorted(seqs) and len(set(seqs)) == 3
     # resume mid-stream
-    events2, ok = s.replay(seqs[0])
+    events2, ok, _ = s.replay(seqs[0])
     assert ok and [e.type.value for _, e in events2] == \
         ["MODIFIED", "DELETED"]
     # kind filter
-    ev3, ok = s.replay(rv0, kinds={"Pod"})
+    ev3, ok, scanned3 = s.replay(rv0, kinds={"Pod"})
     assert ok and ev3 == []
+    # filtered-out events still advance the cursor
+    assert scanned3 == seqs[-1]
+
+
+def test_filtered_watch_cursor_survives_unrelated_churn():
+    """A kind-filtered watcher whose cursor advances past filtered-out
+    events must NOT get 410 when unrelated events wrap the ring (the
+    round-2 review finding: a cursor pinned at the last *matching* seq
+    turned quiet filtered watches into periodic relist storms)."""
+    s = Store()
+    s._history = type(s._history)(maxlen=8)  # tiny ring
+    node = s.create(build_node("v5e", "2x2", "s9", 0))
+    cursor = s.current_rv()
+    for i in range(20):  # > 2x ring of Node-only churn
+        live = s.get(Node, node.meta.name)
+        live.status.heartbeat_time = float(i)
+        s.update_status(live)
+        # the watcher polls as churn happens, sees nothing, but advances
+        events, ok, cursor = s.replay(cursor, kinds={"Pod"})
+        assert ok, "filtered watcher got 410 despite polling steadily"
+        assert events == []
 
 
 def test_store_replay_gone_after_ring_overflow():
@@ -69,9 +90,9 @@ def test_store_replay_gone_after_ring_overflow():
         live = s.get(Node, first.meta.name)
         live.status.heartbeat_time = float(i)
         s.update_status(live)
-    _, ok = s.replay(0)
+    _, ok, _ = s.replay(0)
     assert not ok  # history before the ring start is gone
-    _, ok = s.replay(s.current_rv())
+    _, ok, _ = s.replay(s.current_rv())
     assert ok
 
 
@@ -81,9 +102,9 @@ def test_rebooted_persistent_store_reports_gone(tmp_path):
     s1.create(pcs("a"))
     rv = s1.current_rv()
     s2 = Store(state_dir=d)  # ring empty, rv > 0
-    _, ok = s2.replay(rv - 1)
+    _, ok, _ = s2.replay(rv - 1)
     assert not ok
-    _, ok = s2.replay(s2.current_rv())
+    _, ok, _ = s2.replay(s2.current_rv())
     assert ok
 
 
