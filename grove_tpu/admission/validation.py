@@ -32,6 +32,7 @@ from grove_tpu.api.clustertopology import ClusterTopology, DEFAULT_TPU_LEVELS
 from grove_tpu.api.core import ContainerSpec
 from grove_tpu.api.podcliqueset import (PodCliqueSet, StartupType,
                                         TopologyConstraint)
+from grove_tpu.api.reservation import ReservationScope
 from grove_tpu.scheduler.framework import Registry
 from grove_tpu.topology.tpu import TPU_GENERATIONS
 
@@ -53,7 +54,7 @@ _RESERVED_ENV = frozenset({
     c.ENV_PCSG_TEMPLATE_NUM_PODS, c.ENV_HEADLESS_SERVICE,
     c.ENV_TPU_WORKER_ID, c.ENV_TPU_WORKER_HOSTNAMES,
     c.ENV_TPU_SLICE_NAME, c.ENV_TPU_SLICE_TOPOLOGY,
-    c.ENV_MEGASLICE_INDEX, c.ENV_MEGASLICE_COUNT,
+    c.ENV_MEGASLICE_INDEX, c.ENV_MEGASLICE_COUNT, c.ENV_RESERVATION,
     "GROVE_POD_NAME", "GROVE_NAMESPACE", "GROVE_NODE_NAME",
     "GROVE_CONTROL_PLANE",
 })
@@ -420,6 +421,50 @@ def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
                     f"slice that large (max {_MAX_SLICE_CHIPS})")
 
 
+def _validate_reservations(pcs: PodCliqueSet, errs: list[str]) -> None:
+    """Reservation templates (api/reservation.py; reference resource-
+    sharing validation, proposal 390): unique DNS names, known slice
+    shapes, existing clique filters, and non-overlapping coverage —
+    a clique served by two reservations would have no well-defined
+    placement fence."""
+    tmpl = pcs.spec.template
+    if not tmpl.reservations:
+        return
+    clique_names = {t.name for t in tmpl.cliques}
+    seen: set[str] = set()
+    covered: dict[str, str] = {}   # clique -> reservation template
+    for rt in tmpl.reservations:
+        f = f"reservation {rt.name!r}"
+        if not _NAME_RE.match(rt.name or ""):
+            errs.append(f"{f}: invalid name (DNS-label-like, <= 52 chars)")
+        if rt.name in seen:
+            errs.append(f"duplicate reservation template name {rt.name!r}")
+        seen.add(rt.name)
+        if not isinstance(rt.scope, ReservationScope):
+            errs.append(f"{f}: scope must be one of "
+                        f"{[s.value for s in ReservationScope]}")
+        if rt.slice_count < 1:
+            errs.append(f"{f}: slice_count must be >= 1, "
+                        f"got {rt.slice_count}")
+        if rt.generation and rt.generation not in TPU_GENERATIONS:
+            errs.append(f"{f}: unknown generation {rt.generation!r} "
+                        f"(known: {sorted(TPU_GENERATIONS)})")
+        if rt.topology and not re.fullmatch(r"\d+x\d+(x\d+)?", rt.topology):
+            errs.append(f"{f}: topology {rt.topology!r} is not an ICI mesh "
+                        "shape like '4x4' or '4x4x4'")
+        targets = rt.clique_names or sorted(clique_names)
+        for cn in rt.clique_names:
+            if cn not in clique_names:
+                errs.append(f"{f}: clique_names entry {cn!r} matches no "
+                            f"clique (have {sorted(clique_names)})")
+        for cn in targets:
+            if cn in covered and cn in clique_names:
+                errs.append(f"{f}: clique {cn!r} already covered by "
+                            f"reservation {covered[cn]!r} (coverage must "
+                            "not overlap)")
+            covered.setdefault(cn, rt.name)
+
+
 # ---- update immutability table (reference podcliqueset.go:662-698) ----
 # Explicit per-field rules: (human path, getter). Structure fields whose
 # change cannot be reconciled by either rolling-update mode.
@@ -433,6 +478,13 @@ _IMMUTABLE_TEMPLATE_FIELDS = [
     ("spec.template.topology",
      lambda t: (t.topology.pack_level, t.topology.required,
                 t.topology.spread_level) if t.topology else None),
+    # Resource sharing is immutable in the reference (proposal 390
+    # "Immutability of Resource Sharing Fields"): re-scoping a live
+    # reservation would strand placed gangs outside their fence.
+    ("spec.template.reservations",
+     lambda t: tuple((rt.name, rt.scope, rt.generation, rt.topology,
+                      rt.slice_count, tuple(rt.clique_names))
+                     for rt in t.reservations)),
 ]
 
 # tpu_chips_per_pod is deliberately MUTABLE: a chip-count change is a
@@ -639,6 +691,7 @@ def validate_podcliqueset(pcs: PodCliqueSet,
 
     _validate_name_budgets(pcs, errs)
     _validate_chips(pcs, errs)
+    _validate_reservations(pcs, errs)
 
     # update immutability (reference validation: structure is immutable,
     # content rolls)

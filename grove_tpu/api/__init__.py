@@ -36,6 +36,13 @@ from grove_tpu.api.podcliqueset import (
     UpdateStrategy,
 )
 from grove_tpu.api.podclique import PodClique, PodCliqueSpec, PodCliqueStatus
+from grove_tpu.api.reservation import (
+    ReservationScope,
+    ReservationTemplate,
+    SliceReservation,
+    SliceReservationSpec,
+    SliceReservationStatus,
+)
 from grove_tpu.api.scalinggroup import (
     PodCliqueScalingGroup,
     PodCliqueScalingGroupSpec,

@@ -32,6 +32,10 @@ NODE_LABEL_SLICE_WORKER = f"{DOMAIN}/tpu-slice-worker"
 NODE_LABEL_POOL = f"{DOMAIN}/node-pool"
 NODE_LABEL_SUPERBLOCK = f"{DOMAIN}/superblock"
 NODE_LABEL_HOST = "kubernetes.io/hostname"
+# Reservation mark, taint-like: set on every node of a bound slice by the
+# reservation controller; pods carrying the matching node_selector are the
+# ONLY pods placement admits onto such nodes (placement._selector_matches).
+LABEL_RESERVATION = f"{DOMAIN}/reservation"
 
 # ---- env vars injected into workload pods ----
 ENV_PCS_NAME = "GROVE_PCS_NAME"
@@ -45,6 +49,7 @@ ENV_HEADLESS_SERVICE = "GROVE_HEADLESS_SERVICE"
 # TPU/JAX bootstrap contract (multi-host process group on a slice)
 ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_RESERVATION = "GROVE_RESERVATION"
 ENV_TPU_SLICE_NAME = "GROVE_TPU_SLICE"
 ENV_TPU_SLICE_TOPOLOGY = "GROVE_TPU_SLICE_TOPOLOGY"
 ENV_MEGASLICE_INDEX = "GROVE_MULTISLICE_INDEX"  # DCN slice index (PCS replica)

@@ -54,5 +54,15 @@ def headless_service_name(pcs: str, pcs_replica: int) -> str:
     return f"{pcs}-{pcs_replica}-svc"
 
 
+def reservation_name(pcs: str, template: str,
+                     pcs_replica: int | None = None) -> str:
+    """AllReplicas scope: <pcs>-<template>-rsv (one shared object);
+    PerReplica: <pcs>-<replica>-<template>-rsv (reference ResourceClaim
+    naming convention, proposal 390)."""
+    if pcs_replica is None:
+        return f"{pcs}-{template}-rsv"
+    return f"{pcs}-{pcs_replica}-{template}-rsv"
+
+
 def hpa_name(target_kind: str, target: str) -> str:
     return f"{target_kind.lower()}-{target}-hpa"

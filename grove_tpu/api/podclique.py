@@ -30,6 +30,10 @@ class PodCliqueSpec:
     scheduler_name: str = ""
     priority_class: str = ""
     subdomain: str = ""
+    # Resolved SliceReservation name when a PCS reservation template
+    # covers this clique ("" = unreserved). Pods inherit it as an
+    # exclusive node_selector (api/reservation.py).
+    reservation: str = ""
 
 
 @dataclasses.dataclass

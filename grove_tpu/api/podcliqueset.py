@@ -21,6 +21,7 @@ from typing import Optional
 
 from grove_tpu.api.core import ContainerSpec
 from grove_tpu.api.meta import Condition, ObjectMeta
+from grove_tpu.api.reservation import ReservationTemplate
 
 
 class StartupType(str, enum.Enum):
@@ -126,6 +127,12 @@ class ScalingGroupConfig:
 class PodCliqueSetTemplate:
     cliques: list[PodCliqueTemplate] = dataclasses.field(default_factory=list)
     scaling_groups: list[ScalingGroupConfig] = dataclasses.field(default_factory=list)
+    # Hierarchical slice-capacity sharing (the reference's resourceSharing
+    # ResourceClaim templates, proposal 390 / podcliqueset.go:402-478):
+    # each template materializes SliceReservation children whose bound
+    # slices are the ONLY capacity covered cliques may land on.
+    reservations: list[ReservationTemplate] = dataclasses.field(
+        default_factory=list)
     # None → resolved by effective_startup_type (IN_ORDER, or EXPLICIT
     # when starts_after edges are declared).
     startup_type: Optional[StartupType] = None

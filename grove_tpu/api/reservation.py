@@ -1,0 +1,83 @@
+"""SliceReservation — hierarchical sharing of TPU slice capacity.
+
+The reference shares scarce accelerator resources across its hierarchy
+via DRA ResourceClaims with scope control (proposal
+390-hierarchical-resource-sharing; types at
+operator/api/core/v1alpha1/podcliqueset.go:402-478 and
+resourcesharing.go, realized by the resourceclaim components). On TPU
+the fabric itself needs no claim — ICI comes free with slice membership
+— but the *slices* are the scarce resource. The same sharing semantics
+land here as slice reservations:
+
+- A PCS declares ``ReservationTemplate``s; each materializes
+  ``SliceReservation`` children (the ResourceClaim analog).
+- ``scope: AllReplicas`` → ONE reservation shared by every PCS replica
+  (the claim-per-PCS scope); ``scope: PerReplica`` → one reservation per
+  PCS replica (disjoint slice pools, the claim-per-replica scope).
+- ``clique_names`` filters which cliques consume the reservation
+  (the reference's broadcast filters).
+
+A bound reservation labels its slices' Nodes with
+``constants.LABEL_RESERVATION``; covered pods carry the matching
+node_selector, and placement treats the label as exclusive (taint-like)
+— uncovered pods never land on reserved capacity. Binding and healing
+live in ``controllers/reservation.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from grove_tpu.api.meta import Condition, ObjectMeta
+
+
+class ReservationScope(str, enum.Enum):
+    ALL_REPLICAS = "AllReplicas"
+    PER_REPLICA = "PerReplica"
+
+
+@dataclasses.dataclass
+class ReservationTemplate:
+    """PCS-level declaration (reference ResourceClaimTemplate ref +
+    scope + filter, podcliqueset.go:402-478)."""
+
+    name: str = ""
+    scope: ReservationScope = ReservationScope.ALL_REPLICAS
+    # Slice shape this reservation claims ("" = any generation/topology).
+    generation: str = ""
+    topology: str = ""
+    slice_count: int = 1
+    # Which cliques consume the reservation ([] = all cliques).
+    clique_names: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SliceReservationSpec:
+    generation: str = ""
+    topology: str = ""
+    slice_count: int = 1
+
+
+class ReservationPhase(str, enum.Enum):
+    PENDING = "Pending"      # waiting for free matching slices
+    BOUND = "Bound"
+
+
+@dataclasses.dataclass
+class SliceReservationStatus:
+    phase: ReservationPhase = ReservationPhase.PENDING
+    bound_slices: list[str] = dataclasses.field(default_factory=list)
+    message: str = ""
+    conditions: list[Condition] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SliceReservation:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    spec: SliceReservationSpec = dataclasses.field(
+        default_factory=SliceReservationSpec)
+    status: SliceReservationStatus = dataclasses.field(
+        default_factory=SliceReservationStatus)
+
+    KIND = "SliceReservation"

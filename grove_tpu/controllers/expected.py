@@ -25,6 +25,12 @@ from grove_tpu.api.podcliqueset import (
     effective_startup_type,
 )
 from grove_tpu.api.podgang import PodGang, PodGangSpec, PodGroup
+from grove_tpu.api.reservation import (
+    ReservationScope,
+    SliceReservation,
+    SliceReservationSpec,
+)
+from grove_tpu.api.serde import clone
 from grove_tpu.api.scalinggroup import (
     PodCliqueScalingGroup,
     PodCliqueScalingGroupSpec,
@@ -153,10 +159,51 @@ def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
     return fqns
 
 
+def reservation_for(pcs: PodCliqueSet, replica: int,
+                    clique_name: str) -> str:
+    """The SliceReservation name covering ``clique_name`` in PCS replica
+    ``replica``, or "". First matching template wins (validation rejects
+    overlapping filters)."""
+    for rt in pcs.spec.template.reservations:
+        if rt.clique_names and clique_name not in rt.clique_names:
+            continue
+        if rt.scope == ReservationScope.PER_REPLICA:
+            return namegen.reservation_name(pcs.meta.name, rt.name, replica)
+        return namegen.reservation_name(pcs.meta.name, rt.name)
+    return ""
+
+
+def expected_reservations(pcs: PodCliqueSet) -> list[SliceReservation]:
+    """SliceReservation children per template: one for AllReplicas scope,
+    one per PCS replica for PerReplica (the ResourceClaim components'
+    expected state, reference podcliqueset/components/resourceclaim/)."""
+    out = []
+    for rt in pcs.spec.template.reservations:
+        spec = SliceReservationSpec(generation=rt.generation,
+                                    topology=rt.topology,
+                                    slice_count=rt.slice_count)
+        if rt.scope == ReservationScope.PER_REPLICA:
+            for r in range(pcs.spec.replicas):
+                name = namegen.reservation_name(pcs.meta.name, rt.name, r)
+                out.append(SliceReservation(
+                    meta=_meta(pcs, name, _labels(pcs, r, {})),
+                    spec=clone(spec)))
+        else:
+            name = namegen.reservation_name(pcs.meta.name, rt.name)
+            out.append(SliceReservation(
+                meta=_meta(pcs, name, {
+                    c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                    c.LABEL_PCS_NAME: pcs.meta.name,
+                }),
+                spec=clone(spec)))
+    return out
+
+
 def _clique_to_spec(pcs: PodCliqueSet, replica: int, t: PodCliqueTemplate,
                     name: str, pcsg: str = "", pcsg_replica: int = 0,
                     template_hash: str = "") -> PodCliqueSpec:
     return PodCliqueSpec(
+        reservation=reservation_for(pcs, replica, t.name),
         role_name=t.name,
         replicas=t.replicas,
         min_available=min_available(t),

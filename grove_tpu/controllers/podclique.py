@@ -299,6 +299,11 @@ class PodCliqueReconciler:
                 hostname=name,
                 subdomain=spec.subdomain,
                 priority_class=spec.priority_class,
+                # Reserved cliques may ONLY land on their reservation's
+                # slices; placement treats the label as exclusive, so
+                # this selector is both grant and fence.
+                node_selector=({c.LABEL_RESERVATION: spec.reservation}
+                               if spec.reservation else {}),
             ),
         )
         pod.meta.owner_references = [OwnerReference(
@@ -348,6 +353,8 @@ class PodCliqueReconciler:
         env[c.ENV_TPU_WORKER_ID] = str(index)
         env[c.ENV_TPU_WORKER_HOSTNAMES] = hostnames
         env[c.ENV_MEGASLICE_INDEX] = str(spec.pcs_replica)
+        if spec.reservation:
+            env[c.ENV_RESERVATION] = spec.reservation
 
     # ---- gate removal (reference syncflow.go:254-427) ----
 

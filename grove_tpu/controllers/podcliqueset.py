@@ -11,7 +11,13 @@ from __future__ import annotations
 
 import dataclasses
 
-from grove_tpu.api import PodClique, PodCliqueScalingGroup, PodCliqueSet, PodGang
+from grove_tpu.api import (
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+    SliceReservation,
+)
 from grove_tpu.api import constants as c
 from grove_tpu.api.core import Service
 from grove_tpu.api.meta import Condition, is_condition_true, set_condition
@@ -113,8 +119,13 @@ class PodCliqueSetReconciler:
 
     def _sync_components(self, pcs: PodCliqueSet,
                          template_hash: str) -> list[Exception]:
-        # G1: services
+        # G1: services + slice reservations (reservations must exist
+        # before cliques so the binding controller can work while pods
+        # are still being created).
         errors = self._sync_children(Service, exp.expected_services(pcs), pcs)
+        errors += self._sync_children(
+            SliceReservation, exp.expected_reservations(pcs), pcs,
+            update_spec=True)
         if errors:
             return errors
         # G2: standalone PCLQs (must exist before podgangs reference pods).

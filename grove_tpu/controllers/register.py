@@ -8,7 +8,7 @@ and agents as manager runnables.
 
 from __future__ import annotations
 
-from grove_tpu.api import constants as c
+from grove_tpu.api import SliceReservation, constants as c
 from grove_tpu.controllers.podclique import PodCliqueReconciler
 from grove_tpu.controllers.podcliqueset import PodCliqueSetReconciler
 from grove_tpu.controllers.podgang import PodGangReconciler
@@ -85,6 +85,41 @@ def register_controllers(mgr: Manager) -> Registry:
                            backoff_max=cfg.requeue_max_seconds)
     gang_ctrl.watches(["PodGang"], self_requests)
     mgr.add_controller(gang_ctrl)
+
+    from grove_tpu.controllers.reservation import SliceReservationReconciler
+    rsv = SliceReservationReconciler(mgr.client)
+    rsv_ctrl = Controller("slicereservation", mgr.client, rsv.reconcile,
+                          workers=1,
+                          backoff_base=cfg.requeue_base_seconds,
+                          backoff_max=cfg.requeue_max_seconds)
+    rsv_ctrl.watches(["SliceReservation"], self_requests)
+
+    # Only structural node changes (join/loss/readiness/labels) concern
+    # reservations; heartbeat-only status updates arrive every few
+    # seconds per node and would otherwise fan into full-cluster scans.
+    node_shape: dict[str, tuple] = {}
+
+    def node_to_reservations(event: Event) -> list[Request]:
+        from grove_tpu.controllers.reservation import SWEEP_REQUEST
+        node = event.obj
+        ns = node.meta.namespace
+        shape = (tuple(sorted(node.meta.labels.items())),
+                 node.status.ready, node.spec.unschedulable)
+        key = f"{ns}/{node.meta.name}"
+        if event.type.value == "DELETED":
+            node_shape.pop(key, None)
+        else:
+            if node_shape.get(key) == shape:
+                return []                      # heartbeat-only churn
+            node_shape[key] = shape
+        reqs = [Request(ns, r.meta.name) for r in mgr.client.list(
+            SliceReservation, ns)]
+        # No live reservations: still sweep — a crash-lost delete event
+        # must not leave orphaned reservation labels fencing this node.
+        return reqs or [Request(ns, SWEEP_REQUEST)]
+
+    rsv_ctrl.watches(["Node"], node_to_reservations)
+    mgr.add_controller(rsv_ctrl)
 
     if cfg.topology_aware_scheduling.enabled:
         from grove_tpu.controllers.clustertopology import (

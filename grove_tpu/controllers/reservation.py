@@ -1,0 +1,202 @@
+"""SliceReservation controller — bind reservations to concrete slices.
+
+The binding half of hierarchical slice sharing (api/reservation.py; the
+reference's resourceclaim machinery creates DRA claims and lets the DRA
+driver allocate — here the controller IS the allocator):
+
+- **Bind**: pick ``slice_count`` free slices whose nodes match the
+  requested generation/topology, label every node in them with
+  ``LABEL_RESERVATION = <reservation name>``, and record them in
+  ``status.bound_slices``. A slice is free when none of its nodes carry
+  a reservation label and no pods are bound to it (reserving under a
+  running workload would strand it — placement treats the label as
+  exclusive).
+- **Heal**: a bound slice whose nodes vanished (host loss, fleet
+  shrink) is replaced by a fresh free slice; surviving bindings are
+  kept (pods already placed there keep their home).
+- **Sweep**: nodes labeled for a reservation that no longer exists (or
+  no longer claims their slice) are unlabeled — covers PCS deletion
+  pruning the reservation objects and heal-time rebinding alike.
+
+Deleting a reservation therefore returns its slices to the general pool
+on the next sweep, the ResourceClaim GC analog (owner refs + deletion in
+the reference, proposal 390 "Owner References and Garbage Collection").
+"""
+
+from __future__ import annotations
+
+import collections
+
+from grove_tpu.api import Node, Pod, SliceReservation, constants as c
+from grove_tpu.api.core import PodPhase
+from grove_tpu.api.reservation import ReservationPhase
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.events import EventRecorder
+from grove_tpu.runtime.flow import StepResult
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.store.client import Client
+
+# Sentinel request name: "sweep labels only" (no such reservation can
+# exist — validation requires DNS-label names).
+SWEEP_REQUEST = "~sweep"
+
+
+class SliceReservationReconciler:
+    def __init__(self, client: Client):
+        self.client = client
+        self.log = get_logger("reservation")
+        self.recorder = EventRecorder(client, "reservation-controller")
+
+    # ---- reconcile one reservation --------------------------------------
+
+    # The per-reservation resync cadence: bindings and orphan labels are
+    # re-checked even when no event fires (the sweep's durability story —
+    # a crash that loses a delete event must not strand labels forever).
+    RESYNC_SECONDS = 30.0
+
+    def reconcile(self, req: Request) -> StepResult:
+        if req.name == SWEEP_REQUEST:
+            # Label-hygiene sentinel (node events with no live
+            # reservations): nothing to bind, just sweep.
+            if not self._sweep_orphan_labels(req.namespace):
+                return StepResult.requeue(2.0)
+            return StepResult.finished()
+        try:
+            rsv = self.client.get(SliceReservation, req.name, req.namespace)
+        except NotFoundError:
+            # Deleted: its labels are cleaned by the sweep (watch on the
+            # reservation delete event routes here too).
+            if not self._sweep_orphan_labels(req.namespace):
+                return StepResult.requeue(2.0)
+            return StepResult.finished()
+        if rsv.meta.deletion_timestamp is not None:
+            return StepResult.finished()
+
+        nodes = self.client.list(Node, req.namespace)
+        by_slice = _nodes_by_slice(nodes)
+
+        # Drop bindings whose slice no longer exists (heal path).
+        bound = [s for s in rsv.status.bound_slices if s in by_slice]
+        lost = [s for s in rsv.status.bound_slices if s not in by_slice]
+
+        missing = rsv.spec.slice_count - len(bound)
+        if missing > 0:
+            free = self._free_slices(rsv, by_slice, exclude=set(bound))
+            take = free[:missing]
+            bound.extend(take)
+            missing -= len(take)
+
+        try:
+            self._apply_labels(rsv, by_slice, set(bound))
+        except GroveError as e:
+            return StepResult.fail(e)
+
+        phase = (ReservationPhase.BOUND if missing <= 0
+                 else ReservationPhase.PENDING)
+        msg = "" if missing <= 0 else (
+            f"waiting for {missing} free "
+            f"{rsv.spec.generation or 'any'}/{rsv.spec.topology or 'any'} "
+            f"slice(s)")
+        changed = (sorted(bound) != sorted(rsv.status.bound_slices)
+                   or phase != rsv.status.phase
+                   or msg != rsv.status.message)
+        if changed:
+            if lost:
+                self.recorder.event(rsv, "Warning", "SliceLost",
+                                    f"bound slice(s) {lost} vanished; "
+                                    "rebinding")
+            rsv.status.bound_slices = sorted(bound)
+            rsv.status.phase = phase
+            rsv.status.message = msg
+            try:
+                self.client.update_status(rsv)
+            except GroveError as e:
+                return StepResult.fail(e)
+            self.log.info("reservation %s: %s (%s)", rsv.meta.name,
+                          phase.value, rsv.status.bound_slices)
+        self._sweep_orphan_labels(req.namespace)  # piggyback hygiene
+        if missing > 0:
+            return StepResult.requeue(2.0)
+        return StepResult.requeue(self.RESYNC_SECONDS)
+
+    # ---- helpers --------------------------------------------------------
+
+    def _free_slices(self, rsv: SliceReservation,
+                     by_slice: dict[str, list[Node]],
+                     exclude: set[str]) -> list[str]:
+        """Free, shape-matching slices — no reservation label on any
+        node, no pods bound to any node. Sorted for determinism."""
+        occupied_hosts = {
+            p.status.node_name
+            for p in self.client.list(Pod, rsv.meta.namespace)
+            if p.status.node_name
+            and p.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)}
+        out = []
+        for slice_name, nodes in sorted(by_slice.items()):
+            if slice_name in exclude:
+                continue
+            if rsv.spec.generation and any(
+                    n.meta.labels.get(c.NODE_LABEL_TPU_ACCELERATOR)
+                    != f"tpu-{rsv.spec.generation}" for n in nodes):
+                continue
+            if rsv.spec.topology and any(
+                    n.meta.labels.get(c.NODE_LABEL_TPU_TOPOLOGY)
+                    != rsv.spec.topology for n in nodes):
+                continue
+            if any(n.meta.labels.get(c.LABEL_RESERVATION) for n in nodes):
+                continue
+            if any(n.meta.name in occupied_hosts for n in nodes):
+                continue
+            out.append(slice_name)
+        return out
+
+    def _apply_labels(self, rsv: SliceReservation,
+                      by_slice: dict[str, list[Node]],
+                      bound: set[str]) -> None:
+        """Converge node labels: bound slices carry this reservation's
+        mark; slices this reservation no longer claims lose it."""
+        for slice_name, nodes in by_slice.items():
+            want = rsv.meta.name if slice_name in bound else None
+            for node in nodes:
+                have = node.meta.labels.get(c.LABEL_RESERVATION)
+                if want is not None and have != want:
+                    self.client.patch(Node, node.meta.name, {
+                        "metadata": {"labels": {c.LABEL_RESERVATION: want}}},
+                        namespace=node.meta.namespace)
+                elif want is None and have == rsv.meta.name:
+                    self.client.patch(Node, node.meta.name, {
+                        "metadata": {"labels": {c.LABEL_RESERVATION: None}}},
+                        namespace=node.meta.namespace)
+
+    def _sweep_orphan_labels(self, namespace: str) -> bool:
+        """Unlabel nodes whose reservation is gone or disowns their
+        slice (deletion GC + heal cleanup). Returns False when any patch
+        failed — a label left behind fences the node out of ALL
+        placement, so callers must retry."""
+        ok = True
+        live: dict[str, set[str]] = {}
+        for rsv in self.client.list(SliceReservation, namespace):
+            live[rsv.meta.name] = set(rsv.status.bound_slices)
+        for node in self.client.list(Node, namespace):
+            holder = node.meta.labels.get(c.LABEL_RESERVATION)
+            if not holder:
+                continue
+            slice_name = node.meta.labels.get(c.NODE_LABEL_SLICE, "")
+            if slice_name not in live.get(holder, set()):
+                try:
+                    self.client.patch(Node, node.meta.name, {
+                        "metadata": {"labels": {c.LABEL_RESERVATION: None}}},
+                        namespace=node.meta.namespace)
+                except GroveError:
+                    ok = False  # caller requeues
+        return ok
+
+
+def _nodes_by_slice(nodes: list[Node]) -> dict[str, list[Node]]:
+    out: dict[str, list[Node]] = collections.defaultdict(list)
+    for n in nodes:
+        slice_name = n.meta.labels.get(c.NODE_LABEL_SLICE)
+        if slice_name and n.status.ready:
+            out[slice_name].append(n)
+    return dict(out)

@@ -19,6 +19,7 @@ from grove_tpu.api import (
     PodCliqueScalingGroup,
     PodCliqueSet,
     PodGang,
+    SliceReservation,
 )
 from grove_tpu.api.core import Service
 from grove_tpu.api.meta import ObjectMeta, new_meta
@@ -29,7 +30,7 @@ from grove_tpu.runtime.events import Event
 KIND_REGISTRY: dict[str, type] = {
     cls.KIND: cls
     for cls in (PodCliqueSet, PodClique, PodCliqueScalingGroup, PodGang,
-                ClusterTopology, Pod, Node, Service, Event)
+                ClusterTopology, Pod, Node, Service, Event, SliceReservation)
 }
 
 

@@ -123,7 +123,11 @@ def native_plan_gang(pods, hosts, pack_level: str, required: bool,
     if lib is None:
         return NotImplemented
 
-    from grove_tpu.scheduler.placement import PlacementPlan, _domain_of
+    from grove_tpu.scheduler.placement import (
+        PlacementPlan,
+        _domain_of,
+        _selector_matches,
+    )
 
     n_pods = len(pods)
     n_hosts = len(hosts)
@@ -150,8 +154,10 @@ def native_plan_gang(pods, hosts, pack_level: str, required: bool,
     for p_i, p in enumerate(pods):
         pod_chips[p_i] = p.chips
         for h_i, h in enumerate(hosts):
-            ok = all(h.labels.get(k) == v for k, v in p.node_selector.items())
-            eligible[p_i * n_hosts + h_i] = 1 if ok else 0
+            # ONE eligibility definition for both planners: the python
+            # matcher owns selector + reservation-taint semantics.
+            eligible[p_i * n_hosts + h_i] = \
+                1 if _selector_matches(p, h) else 0
 
     n_domains = len(domain_names)
     penalty = (ctypes.c_double * n_domains)()

@@ -18,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 from collections import defaultdict
 
+from grove_tpu.api.constants import LABEL_RESERVATION as _LABEL_RESERVATION
+
 
 @dataclasses.dataclass
 class HostView:
@@ -43,6 +45,14 @@ class HostView:
 
 
 def _selector_matches(pod: "PodRequest", host: HostView) -> bool:
+    # Reserved capacity is exclusive (taint-like): a host carrying a
+    # reservation label admits ONLY pods that select that reservation —
+    # otherwise general workloads would squat on slices a PCS paid to
+    # hold (api/reservation.py). Constant hoisted: this runs per
+    # pod-host pair in the planners' eligibility loops.
+    held_by = host.labels.get(_LABEL_RESERVATION)
+    if held_by and pod.node_selector.get(_LABEL_RESERVATION) != held_by:
+        return False
     return all(host.labels.get(k) == v for k, v in pod.node_selector.items())
 
 

@@ -1,0 +1,264 @@
+"""Hierarchical slice sharing: SliceReservation binding, scope semantics
+(AllReplicas vs PerReplica), exclusivity of reserved capacity, clique
+filters, GC on PCS delete, and heal on slice loss (the reference's
+resource-sharing machinery, proposal 390, mapped to TPU slice capacity —
+api/reservation.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    SliceReservation,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.api.reservation import (
+    ReservationPhase,
+    ReservationScope,
+    ReservationTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+
+def _pcs(name, *, replicas=1, reservations, cliques=None, topology=None):
+    cliques = cliques or [PodCliqueTemplate(
+        name="w", replicas=2, min_available=2,
+        container=ContainerSpec(argv=["sleep", "inf"]),
+        tpu_chips_per_pod=4)]
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplate(
+                cliques=cliques, reservations=reservations,
+                topology=topology)))
+
+
+@pytest.fixture
+def cluster():
+    # 4 slices x 2 hosts (v5e 2x4): room for reserved + general capacity
+    cl = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=4)]))
+    with cl:
+        yield cl
+
+
+def _pod_slices(client, pcs_name, replica=None):
+    sel = {c.LABEL_PCS_NAME: pcs_name}
+    if replica is not None:
+        sel[c.LABEL_PCS_REPLICA] = str(replica)
+    nodes = {n.meta.name: n for n in client.list(Node)}
+    out = set()
+    for p in client.list(Pod, selector=sel):
+        if p.status.node_name:
+            out.add(nodes[p.status.node_name].meta.labels[c.NODE_LABEL_SLICE])
+    return out
+
+
+def _placed(client, pcs_name, count):
+    def ok():
+        pods = client.list(Pod, selector={c.LABEL_PCS_NAME: pcs_name})
+        return (len(pods) == count
+                and all(p.status.node_name for p in pods))
+    return ok
+
+
+def test_all_replicas_share_one_reserved_pool(cluster):
+    client = cluster.client
+    client.create(_pcs("shared", replicas=2, reservations=[
+        ReservationTemplate(name="pool", scope=ReservationScope.ALL_REPLICAS,
+                            generation="v5e", slice_count=2)]))
+
+    def bound():
+        rs = client.list(SliceReservation,
+                         selector={c.LABEL_PCS_NAME: "shared"})
+        return (len(rs) == 1
+                and rs[0].status.phase == ReservationPhase.BOUND
+                and len(rs[0].status.bound_slices) == 2)
+    wait_for(bound, desc="AllReplicas reservation bound to 2 slices")
+    rsv = client.list(SliceReservation,
+                      selector={c.LABEL_PCS_NAME: "shared"})[0]
+    assert rsv.meta.name == "shared-pool-rsv"
+
+    wait_for(_placed(client, "shared", 4), desc="all pods placed")
+    # every pod of BOTH replicas inside the one shared pool
+    assert _pod_slices(client, "shared") <= set(rsv.status.bound_slices)
+
+
+def test_per_replica_reservations_are_disjoint(cluster):
+    client = cluster.client
+    client.create(_pcs("split", replicas=2, reservations=[
+        ReservationTemplate(name="own", scope=ReservationScope.PER_REPLICA,
+                            slice_count=1)]))
+
+    def bound():
+        rs = client.list(SliceReservation,
+                         selector={c.LABEL_PCS_NAME: "split"})
+        return len(rs) == 2 and all(
+            r.status.phase == ReservationPhase.BOUND for r in rs)
+    wait_for(bound, desc="two per-replica reservations bound")
+    rs = {r.meta.name: r for r in client.list(
+        SliceReservation, selector={c.LABEL_PCS_NAME: "split"})}
+    assert set(rs) == {"split-0-own-rsv", "split-1-own-rsv"}
+    pools = [set(r.status.bound_slices) for r in rs.values()]
+    assert pools[0].isdisjoint(pools[1])
+
+    wait_for(_placed(client, "split", 4), desc="all pods placed")
+    assert _pod_slices(client, "split", replica=0) <= \
+        set(rs["split-0-own-rsv"].status.bound_slices)
+    assert _pod_slices(client, "split", replica=1) <= \
+        set(rs["split-1-own-rsv"].status.bound_slices)
+
+
+def test_reserved_slices_are_exclusive(cluster):
+    """An unreserved PCS never lands on reserved slices, even when they
+    are idle — and reserved slices return to the pool on PCS delete."""
+    client = cluster.client
+    client.create(_pcs("holder", reservations=[
+        ReservationTemplate(name="held", slice_count=2)]))
+    wait_for(lambda: any(
+        r.status.phase == ReservationPhase.BOUND
+        for r in client.list(SliceReservation,
+                             selector={c.LABEL_PCS_NAME: "holder"})),
+        desc="reservation bound")
+    held = set(client.list(
+        SliceReservation,
+        selector={c.LABEL_PCS_NAME: "holder"})[0].status.bound_slices)
+    # holder's own pods go inside; a second, unreserved PCS must avoid
+    client.create(_pcs("outsider", reservations=[]))
+    wait_for(_placed(client, "outsider", 2), desc="outsider placed")
+    assert _pod_slices(client, "outsider").isdisjoint(held)
+
+    # GC: deleting the holder frees its slices for general use
+    client.delete(PodCliqueSet, "holder")
+
+    def freed():
+        if client.list(SliceReservation,
+                       selector={c.LABEL_PCS_NAME: "holder"}):
+            return False
+        return not any(n.meta.labels.get(c.LABEL_RESERVATION)
+                       for n in client.list(Node))
+    wait_for(freed, desc="reservation GC'd and node labels swept")
+
+
+def test_clique_filter_scopes_coverage(cluster):
+    """Only filtered cliques are fenced into the reservation; the rest
+    place on general capacity."""
+    client = cluster.client
+    slice_pack = TopologyConstraint(pack_level="slice", required=True)
+    cliques = [
+        PodCliqueTemplate(name="prefill", replicas=2, min_available=2,
+                          container=ContainerSpec(argv=["sleep", "inf"]),
+                          tpu_chips_per_pod=4, topology=slice_pack),
+        PodCliqueTemplate(name="decode", replicas=2, min_available=2,
+                          container=ContainerSpec(argv=["sleep", "inf"]),
+                          tpu_chips_per_pod=4, topology=slice_pack),
+    ]
+    # Mixed fenced/unfenced cliques cannot be slice-atomic as a WHOLE
+    # gang; pack each clique to its own slice inside one pool — the
+    # disaggregated-serving shape (samples/disaggregated.yaml).
+    client.create(_pcs("filt", cliques=cliques,
+                       topology=TopologyConstraint(pack_level="pool",
+                                                   required=True),
+                       reservations=[
+                           ReservationTemplate(name="pf", slice_count=1,
+                                               clique_names=["prefill"])]))
+    wait_for(_placed(client, "filt", 4), desc="all pods placed")
+    rsv = client.list(SliceReservation,
+                      selector={c.LABEL_PCS_NAME: "filt"})[0]
+    held = set(rsv.status.bound_slices)
+    nodes = {n.meta.name: n for n in client.list(Node)}
+
+    def slices_of(role):
+        return {nodes[p.status.node_name].meta.labels[c.NODE_LABEL_SLICE]
+                for p in client.list(Pod, selector={
+                    c.LABEL_PCS_NAME: "filt", c.LABEL_PCLQ_ROLE: role})}
+
+    assert slices_of("prefill") <= held
+    assert slices_of("decode").isdisjoint(held)
+
+
+def test_insufficient_capacity_stays_pending(cluster):
+    client = cluster.client
+    client.create(_pcs("greedy", reservations=[
+        ReservationTemplate(name="all", slice_count=9)]))  # fleet has 4
+
+    def pending():
+        rs = client.list(SliceReservation,
+                         selector={c.LABEL_PCS_NAME: "greedy"})
+        return (len(rs) == 1
+                and rs[0].status.phase == ReservationPhase.PENDING
+                and "waiting for" in rs[0].status.message)
+    wait_for(pending, desc="oversized reservation pending with reason")
+
+
+def test_heal_rebinds_on_slice_loss(cluster):
+    client = cluster.client
+    client.create(_pcs("healme", reservations=[
+        ReservationTemplate(name="h", slice_count=1)]))
+    wait_for(lambda: any(
+        r.status.phase == ReservationPhase.BOUND
+        for r in client.list(SliceReservation,
+                             selector={c.LABEL_PCS_NAME: "healme"})),
+        desc="bound")
+    rsv = client.list(SliceReservation,
+                      selector={c.LABEL_PCS_NAME: "healme"})[0]
+    lost = rsv.status.bound_slices[0]
+    for n in list(client.list(Node)):
+        if n.meta.labels.get(c.NODE_LABEL_SLICE) == lost:
+            client.delete(Node, n.meta.name)
+
+    def rebound():
+        r = client.get(SliceReservation, rsv.meta.name)
+        return (r.status.phase == ReservationPhase.BOUND
+                and r.status.bound_slices
+                and r.status.bound_slices[0] != lost)
+    wait_for(rebound, desc="reservation healed onto a fresh slice")
+
+
+def test_validation_rules():
+    from grove_tpu.admission.validation import validate_podcliqueset
+
+    def errs_for(reservations, cliques=None):
+        return "; ".join(validate_podcliqueset(
+            _pcs("v", reservations=reservations, cliques=cliques)))
+
+    assert "slice_count" in errs_for(
+        [ReservationTemplate(name="a", slice_count=0)])
+    assert "unknown generation" in errs_for(
+        [ReservationTemplate(name="a", generation="v99")])
+    assert "matches no clique" in errs_for(
+        [ReservationTemplate(name="a", clique_names=["nope"])])
+    assert "duplicate reservation" in errs_for(
+        [ReservationTemplate(name="a"), ReservationTemplate(name="a")])
+    assert "already covered" in errs_for(
+        [ReservationTemplate(name="a"), ReservationTemplate(name="b")])
+    assert "ICI mesh" in errs_for(
+        [ReservationTemplate(name="a", topology="banana")])
+    assert errs_for([ReservationTemplate(name="a", generation="v5e",
+                                         topology="2x4")]) == ""
+
+
+def test_reservations_immutable():
+    from grove_tpu.admission.validation import validate_podcliqueset
+    from grove_tpu.api.serde import clone
+
+    old = _pcs("imm", reservations=[ReservationTemplate(name="a")])
+    new = clone(old)
+    new.spec.template.reservations[0].slice_count = 3
+    errs = "; ".join(validate_podcliqueset(new, old=old))
+    assert "reservations" in errs and "immutable" in errs
