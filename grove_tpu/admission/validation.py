@@ -354,6 +354,13 @@ def _validate_name_budgets(pcs: PodCliqueSet, errs: list[str]) -> None:
             check(f"clique {t.name!r} pods (in scaling group {sg.name!r})",
                   pcs_len + 1 + r_digits + 1 + len(sg.name) + 1 + j_digits
                   + 1 + len(t.name) + 1 + pod_digits)
+    for rt in tmpl.reservations:
+        # AllReplicas <pcs>-<rt>-rsv; PerReplica <pcs>-<r>-<rt>-rsv.
+        # Also a node-label VALUE (LABEL_RESERVATION), same 63-char cap.
+        length = pcs_len + 1 + len(rt.name) + 4
+        if rt.scope == ReservationScope.PER_REPLICA:
+            length += 1 + r_digits
+        check(f"reservation {rt.name!r}", length)
 
 
 _MAX_CHIPS_PER_HOST = max(g.chips_per_host for g in TPU_GENERATIONS.values())
@@ -463,6 +470,24 @@ def _validate_reservations(pcs: PodCliqueSet, errs: list[str]) -> None:
                             f"reservation {covered[cn]!r} (coverage must "
                             "not overlap)")
             covered.setdefault(cn, rt.name)
+    # Generated OBJECT names must be unique across templates x replicas:
+    # AllReplicas '1-x' and PerReplica 'x' at replica 1 both compose to
+    # '<pcs>-1-x-rsv' — two templates silently sharing one reservation.
+    generated: dict[str, str] = {}
+    from grove_tpu.api import namegen
+    for rt in tmpl.reservations:
+        if rt.scope == ReservationScope.PER_REPLICA:
+            gen_names = [namegen.reservation_name(pcs.meta.name, rt.name, r)
+                         for r in range(max(1, pcs.spec.replicas))]
+        else:
+            gen_names = [namegen.reservation_name(pcs.meta.name, rt.name)]
+        for gn in gen_names:
+            if gn in generated and generated[gn] != rt.name:
+                errs.append(
+                    f"reservation {rt.name!r} generates object name {gn!r} "
+                    f"which collides with reservation {generated[gn]!r}; "
+                    "rename one template")
+            generated.setdefault(gn, rt.name)
 
 
 # ---- update immutability table (reference podcliqueset.go:662-698) ----

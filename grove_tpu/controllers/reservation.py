@@ -47,6 +47,7 @@ class SliceReservationReconciler:
         self.client = client
         self.log = get_logger("reservation")
         self.recorder = EventRecorder(client, "reservation-controller")
+        self._last_sweep = 0.0
 
     # ---- reconcile one reservation --------------------------------------
 
@@ -115,7 +116,13 @@ class SliceReservationReconciler:
                 return StepResult.fail(e)
             self.log.info("reservation %s: %s (%s)", rsv.meta.name,
                           phase.value, rsv.status.bound_slices)
-        self._sweep_orphan_labels(req.namespace)  # piggyback hygiene
+        # Rate-limited hygiene: at most one full-namespace sweep per
+        # resync period across ALL reservations (per-reconcile sweeping
+        # would be O(reservations x nodes) for redundant scans).
+        import time
+        if time.time() - self._last_sweep > self.RESYNC_SECONDS:
+            self._last_sweep = time.time()
+            self._sweep_orphan_labels(req.namespace)
         if missing > 0:
             return StepResult.requeue(2.0)
         return StepResult.requeue(self.RESYNC_SECONDS)
@@ -136,6 +143,8 @@ class SliceReservationReconciler:
         for slice_name, nodes in sorted(by_slice.items()):
             if slice_name in exclude:
                 continue
+            if not all(n.status.ready for n in nodes):
+                continue  # never bind onto flapping capacity
             if rsv.spec.generation and any(
                     n.meta.labels.get(c.NODE_LABEL_TPU_ACCELERATOR)
                     != f"tpu-{rsv.spec.generation}" for n in nodes):
@@ -194,9 +203,14 @@ class SliceReservationReconciler:
 
 
 def _nodes_by_slice(nodes: list[Node]) -> dict[str, list[Node]]:
+    """ALL nodes by slice, ready or not: a binding survives a heartbeat
+    flap (NotReady nodes still exist — dropping the binding would unlabel
+    the slice and let general pods squat it in the recovery window); only
+    node DELETION counts as slice loss. Readiness gates NEW bindings
+    (_free_slices), not existing ones."""
     out: dict[str, list[Node]] = collections.defaultdict(list)
     for n in nodes:
         slice_name = n.meta.labels.get(c.NODE_LABEL_SLICE)
-        if slice_name and n.status.ready:
+        if slice_name:
             out[slice_name].append(n)
     return dict(out)

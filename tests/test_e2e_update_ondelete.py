@@ -1,0 +1,126 @@
+"""OnDelete update-strategy e2e (reference
+operator/e2e/tests/update/ondelete_test.go + proposal 291): a template
+edit under OnDelete does ONLY bookkeeping — no pod is touched until the
+user deletes it, and each user-deleted pod is recreated at the NEW
+template while untouched pods keep running the old one."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import Pod, PodCliqueSet, constants as c
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import UpdateStrategy, UpdateStrategyType
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def cluster():
+    cl = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        yield cl
+
+
+def _ready_pods(client, name):
+    return [p for p in client.list(Pod, selector={c.LABEL_PCS_NAME: name})
+            if is_condition_true(p.status.conditions, c.COND_READY)]
+
+
+def _on_delete_pcs(name, replicas=2):
+    pcs = simple_pcs(name=name, replicas=replicas, pods=2, chips=4)
+    pcs.spec.update_strategy = UpdateStrategy(
+        type=UpdateStrategyType.ON_DELETE)
+    return pcs
+
+
+def test_template_edit_touches_nothing(cluster):
+    client = cluster.client
+    client.create(_on_delete_pcs("od"))
+    wait_for(lambda: len(_ready_pods(client, "od")) == 4, desc="ready")
+    before = {p.meta.name: p.meta.uid
+              for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "od"})}
+    old_hash = client.get(PodCliqueSet, "od").status.generation_hash
+
+    live = client.get(PodCliqueSet, "od")
+    live.spec.template.cliques[0].container.env["VERSION"] = "v2"
+    client.update(live)
+
+    # bookkeeping appears (hash moved, progress tracked, zero updated)...
+    def bookkeeping():
+        s = client.get(PodCliqueSet, "od")
+        return (s.status.generation_hash != old_hash
+                and s.status.rolling_update is not None
+                and s.status.updated_replicas == 0)
+    wait_for(bookkeeping, desc="OnDelete bookkeeping")
+
+    # ...and stays that way: no pod is deleted or recreated
+    time.sleep(1.0)
+    after = {p.meta.name: p.meta.uid
+             for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "od"})}
+    assert after == before, "OnDelete must not touch pods on its own"
+    assert all(p.spec.container.env.get("VERSION") != "v2"
+               for p in client.list(Pod,
+                                    selector={c.LABEL_PCS_NAME: "od"}))
+
+
+def test_user_deletion_drives_the_rollout(cluster):
+    client = cluster.client
+    client.create(_on_delete_pcs("odroll"))
+    wait_for(lambda: len(_ready_pods(client, "odroll")) == 4, desc="ready")
+    live = client.get(PodCliqueSet, "odroll")
+    live.spec.template.cliques[0].container.env["VERSION"] = "v2"
+    client.update(live)
+    new_hash_seen = lambda: client.get(  # noqa: E731
+        PodCliqueSet, "odroll").status.rolling_update is not None
+    wait_for(new_hash_seen, desc="update registered")
+    target = client.get(PodCliqueSet,
+                        "odroll").status.rolling_update.target_hash
+
+    # user deletes replica 0's pods only
+    r0 = [p for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "odroll"})
+          if p.meta.labels[c.LABEL_PCS_REPLICA] == "0"]
+    for p in r0:
+        client.delete(Pod, p.meta.name)
+
+    def replica0_updated():
+        pods = _ready_pods(client, "odroll")
+        r0_pods = [p for p in pods
+                   if p.meta.labels[c.LABEL_PCS_REPLICA] == "0"]
+        r1_pods = [p for p in pods
+                   if p.meta.labels[c.LABEL_PCS_REPLICA] == "1"]
+        return (len(r0_pods) == 2 and len(r1_pods) == 2
+                and all(p.meta.labels[c.LABEL_POD_TEMPLATE_HASH] == target
+                        for p in r0_pods)
+                and all(p.spec.container.env.get("VERSION") == "v2"
+                        for p in r0_pods)
+                and all(p.meta.labels[c.LABEL_POD_TEMPLATE_HASH] != target
+                        for p in r1_pods))
+    wait_for(replica0_updated, timeout=20.0,
+             desc="replica 0 recreated at new template, replica 1 untouched")
+
+    # partial progress is visible
+    wait_for(lambda: client.get(
+        PodCliqueSet, "odroll").status.updated_replicas == 1,
+        desc="updated_replicas == 1")
+
+    # finishing the rollout by hand completes the bookkeeping
+    for p in [p for p in client.list(Pod,
+                                     selector={c.LABEL_PCS_NAME: "odroll"})
+              if p.meta.labels[c.LABEL_PCS_REPLICA] == "1"]:
+        client.delete(Pod, p.meta.name)
+
+    def done():
+        s = client.get(PodCliqueSet, "odroll")
+        pods = _ready_pods(client, "odroll")
+        return (s.status.rolling_update is None
+                and s.status.updated_replicas == 2
+                and len(pods) == 4
+                and all(p.meta.labels[c.LABEL_POD_TEMPLATE_HASH] == target
+                        for p in pods))
+    wait_for(done, timeout=20.0, desc="rollout complete after user deletes")

@@ -253,6 +253,62 @@ def test_validation_rules():
                                          topology="2x4")]) == ""
 
 
+def test_notready_flap_keeps_binding(cluster):
+    """A heartbeat flap (nodes NotReady but present) must NOT drop the
+    binding — unlabeling the slice would let general pods squat it in
+    the recovery window (round-2 review finding)."""
+    client = cluster.client
+    client.create(_pcs("flap", reservations=[
+        ReservationTemplate(name="f", slice_count=1)]))
+    wait_for(lambda: any(
+        r.status.phase == ReservationPhase.BOUND
+        for r in client.list(SliceReservation,
+                             selector={c.LABEL_PCS_NAME: "flap"})),
+        desc="bound")
+    rsv = client.list(SliceReservation,
+                      selector={c.LABEL_PCS_NAME: "flap"})[0]
+    held = rsv.status.bound_slices[0]
+    for n in list(client.list(Node)):
+        if n.meta.labels.get(c.NODE_LABEL_SLICE) == held:
+            n.status.ready = False
+            client.update_status(n)
+    import time
+    time.sleep(0.5)
+    live = client.get(SliceReservation, rsv.meta.name)
+    assert live.status.bound_slices == [held], \
+        "NotReady flap must not drop the binding"
+    assert all(n.meta.labels.get(c.LABEL_RESERVATION) == rsv.meta.name
+               for n in client.list(Node)
+               if n.meta.labels.get(c.NODE_LABEL_SLICE) == held)
+
+
+def test_generated_name_rules():
+    from grove_tpu.admission.validation import validate_podcliqueset
+
+    # budget: long pcs + template name over the 63-char composed cap
+    pcs = _pcs("p" * 40, reservations=[
+        ReservationTemplate(name="r" * 30,
+                            scope=ReservationScope.PER_REPLICA)])
+    errs = "; ".join(validate_podcliqueset(pcs))
+    assert "would generate" in errs
+
+    # collision: AllReplicas '1-x' vs PerReplica 'x' at replica 1
+    pcs = _pcs("p", replicas=2, cliques=[
+        PodCliqueTemplate(name="a", replicas=1,
+                          container=ContainerSpec(argv=["sleep", "inf"]),
+                          tpu_chips_per_pod=4),
+        PodCliqueTemplate(name="b", replicas=1,
+                          container=ContainerSpec(argv=["sleep", "inf"]),
+                          tpu_chips_per_pod=4),
+    ], reservations=[
+        ReservationTemplate(name="1-x", clique_names=["a"]),
+        ReservationTemplate(name="x", scope=ReservationScope.PER_REPLICA,
+                            clique_names=["b"]),
+    ])
+    errs = "; ".join(validate_podcliqueset(pcs))
+    assert "collides" in errs
+
+
 def test_reservations_immutable():
     from grove_tpu.admission.validation import validate_podcliqueset
     from grove_tpu.api.serde import clone
