@@ -39,7 +39,11 @@ if os.environ.get("JAX_PLATFORMS"):
 import jax.numpy as jnp
 import numpy as np
 
-BATCH = 8
+# Serving batch (continuous-batching lanes). 32 is the serving posture
+# for a 1B model (cache = batch x ~17MB, far under HBM); decode is
+# weight-read-bound, so lanes amortize the read near-linearly: measured
+# 2055 tok/s at batch 8 -> 4107 at batch 32 on the same chip.
+BATCH = int(os.environ.get("GROVE_BENCH_BATCH", 32))
 PROMPT_LEN = 128
 DECODE_STEPS = 64
 TIMED_ITERS = 3
@@ -152,6 +156,52 @@ def check_flash_parity(cfg, prompt_len: int = PROMPT_LEN) -> None:
     assert diff < 3e-2, f"flash kernel diverges from XLA path: {diff}"
 
 
+def calibrate_roofline() -> tuple[float, float]:
+    """Measure THIS device's practical peaks (fused multi-iteration
+    probes inside one executable; host fetch forces completion — the
+    tunnelled backend's block_until_ready can return early). The
+    datasheet peaks (819 GB/s, 197 TFLOP/s bf16 for v5e) are not what a
+    virtualized/tunnelled chip delivers — round-2 calibration measured
+    ~152 GB/s copy and ~34 TFLOP/s here, so utilization against the
+    datasheet under-reports the program's real efficiency ~5x."""
+    from jax import lax
+
+    x = jnp.ones((128, 1024, 1024), jnp.bfloat16)  # 256 MB
+
+    @jax.jit
+    def copy10(x):
+        def body(c, _):
+            return c * 1.0001, ()
+        return lax.scan(body, x, None, length=10)[0]
+
+    y = copy10(x)
+    np.asarray(y[0, 0, :2])           # compile + settle
+    t0 = time.perf_counter()
+    y = copy10(y)
+    np.asarray(y[0, 0, :2])
+    bw = 10 * 2 * x.nbytes / (time.perf_counter() - t0)
+
+    a = jnp.ones((4096, 4096), jnp.bfloat16)
+
+    @jax.jit
+    def mm10(a, c):
+        def body(c, _):
+            return a @ c, ()
+        return lax.scan(body, c, None, length=10)[0]
+
+    c = mm10(a, a)
+    np.asarray(c[:1, :2])
+    t0 = time.perf_counter()
+    c = mm10(a, c)
+    np.asarray(c[:1, :2])
+    tf = 10 * 2 * 4096 ** 3 / (time.perf_counter() - t0)
+    log(f"calibrated device peaks: {bw / 1e9:.0f} GB/s copy, "
+        f"{tf / 1e12:.1f} TFLOP/s bf16 "
+        f"(datasheet: {PEAK_HBM_BW / 1e9:.0f} GB/s, "
+        f"{PEAK_FLOPS / 1e12:.0f} TFLOP/s)")
+    return bw, tf
+
+
 def run_bench() -> dict:
     from grove_tpu.models import llama
     from grove_tpu.ops.attention import active_prefill_attention
@@ -236,21 +286,35 @@ def run_bench() -> dict:
 
     # Roofline placement: FLOPs at the mid-window live context, HBM at
     # the allocated cache length (what the padded read actually moves).
+    # Utilization is reported against datasheet peaks (comparable across
+    # rounds); the probe peaks are too noisy on the tunnelled chip for a
+    # ratio, so the absolute sustained bandwidth (achieved_gbps) is the
+    # honest companion number.
     ctx = prompt_len + DECODE_STEPS // 2
-    mfu = fw * decode_flops_per_token(cfg, ctx) / PEAK_FLOPS
-    hbm = fw * decode_hbm_bytes_per_token(
-        cfg, max_len, BATCH, weight_bytes=weight_bytes) / PEAK_HBM_BW
-    log(f"roofline: MFU={mfu * 100:.2f}% HBM={hbm * 100:.1f}% "
-        f"(v5e peaks {PEAK_FLOPS / 1e12:.0f} TFLOP/s, "
-        f"{PEAK_HBM_BW / 1e9:.0f} GB/s)")
+    flops_tok = decode_flops_per_token(cfg, ctx)
+    bytes_tok = decode_hbm_bytes_per_token(cfg, max_len, BATCH,
+                                           weight_bytes=weight_bytes)
+    mfu = fw * flops_tok / PEAK_FLOPS
+    hbm = fw * bytes_tok / PEAK_HBM_BW
+    achieved_gbps = fw * bytes_tok / 1e9
+    meas_bw, meas_tf = calibrate_roofline()
+    log(f"roofline: MFU={mfu * 100:.2f}% HBM={hbm * 100:.1f}% of "
+        f"datasheet; decode sustains {achieved_gbps:.0f} GB/s "
+        f"(probe copy peak {meas_bw / 1e9:.0f} GB/s — the tunnelled "
+        "chip's probes are noisy; the sustained decode number is the "
+        "reliable floor for this device's real bandwidth)")
 
     return {
         "metric": f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip",
         "value": round(fw, 1),
         "unit": "tok/s/chip",
         "vs_baseline": round(fw / bare, 4),
+        "batch": BATCH,
         "mfu": round(mfu, 4),
         "hbm_util": round(hbm, 4),
+        "achieved_gbps": round(achieved_gbps, 1),
+        "probe_copy_gbps": round(meas_bw / 1e9, 1),
+        "probe_matmul_tflops": round(meas_tf / 1e12, 1),
         "attention": attn_impl,
         "quant": quant or "bf16",
         "device": f"{dev.platform}:{dev.device_kind}",

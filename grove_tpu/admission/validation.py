@@ -361,6 +361,15 @@ def _validate_name_budgets(pcs: PodCliqueSet, errs: list[str]) -> None:
         if rt.scope == ReservationScope.PER_REPLICA:
             length += 1 + r_digits
         check(f"reservation {rt.name!r}", length)
+    for sg in tmpl.scaling_groups:
+        for rt in sg.reservations:
+            # <pcs>-<r>-<sg>[-<j>]-<rt>-rsv
+            length = (pcs_len + 1 + r_digits + 1 + len(sg.name) + 1
+                      + len(rt.name) + 4)
+            if rt.scope == ReservationScope.PER_REPLICA:
+                length += 1 + _digits(_sg_max_replicas(sg) - 1)
+            check(f"scaling group {sg.name!r} reservation {rt.name!r}",
+                  length)
 
 
 _MAX_CHIPS_PER_HOST = max(g.chips_per_host for g in TPU_GENERATIONS.values())
@@ -428,37 +437,68 @@ def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
                     f"slice that large (max {_MAX_SLICE_CHIPS})")
 
 
+def _check_reservation_template(rt, f: str, seen: set[str],
+                                errs: list[str]) -> None:
+    """Shape rules shared by PCS-level and PCSG-level templates."""
+    if not _NAME_RE.match(rt.name or ""):
+        errs.append(f"{f}: invalid name (DNS-label-like, <= 52 chars)")
+    if rt.name in seen:
+        errs.append(f"duplicate reservation template name {rt.name!r}")
+    seen.add(rt.name)
+    if not isinstance(rt.scope, ReservationScope):
+        errs.append(f"{f}: scope must be one of "
+                    f"{[s.value for s in ReservationScope]}")
+    if rt.slice_count < 1:
+        errs.append(f"{f}: slice_count must be >= 1, got {rt.slice_count}")
+    if rt.generation and rt.generation not in TPU_GENERATIONS:
+        errs.append(f"{f}: unknown generation {rt.generation!r} "
+                    f"(known: {sorted(TPU_GENERATIONS)})")
+    if rt.topology and not re.fullmatch(r"\d+x\d+(x\d+)?", rt.topology):
+        errs.append(f"{f}: topology {rt.topology!r} is not an ICI mesh "
+                    "shape like '4x4' or '4x4x4'")
+
+
 def _validate_reservations(pcs: PodCliqueSet, errs: list[str]) -> None:
-    """Reservation templates (api/reservation.py; reference resource-
-    sharing validation, proposal 390): unique DNS names, known slice
-    shapes, existing clique filters, and non-overlapping coverage —
-    a clique served by two reservations would have no well-defined
-    placement fence."""
+    """Reservation templates at both levels (api/reservation.py;
+    reference resource-sharing validation, proposal 390): unique DNS
+    names, known slice shapes, existing clique filters, and
+    non-overlapping coverage — a clique served by two reservations (at
+    any level) would have no well-defined placement fence."""
     tmpl = pcs.spec.template
-    if not tmpl.reservations:
+    sg_reservations = [(sg, rt) for sg in tmpl.scaling_groups
+                       for rt in sg.reservations]
+    if not tmpl.reservations and not sg_reservations:
         return
     clique_names = {t.name for t in tmpl.cliques}
-    seen: set[str] = set()
-    covered: dict[str, str] = {}   # clique -> reservation template
+    # Template names are unique PER SCOPE (two groups may both call
+    # their reservation 'own' — composed object names cannot collide
+    # since group names are unique; claim() below guards the rest).
+    seen_by_scope: dict[str, set[str]] = {}
+    covered: dict[str, str] = {}   # clique -> covering template name
+
+    # PCSG-level first: nearest scope wins, so its coverage is claimed
+    # before PCS-level templates are checked against it.
+    for sg, rt in sg_reservations:
+        f = f"scaling group {sg.name!r} reservation {rt.name!r}"
+        _check_reservation_template(
+            rt, f, seen_by_scope.setdefault(sg.name, set()), errs)
+        members = set(sg.clique_names)
+        for cn in rt.clique_names:
+            if cn not in members:
+                errs.append(f"{f}: clique_names entry {cn!r} is not a "
+                            f"member of the group (members: "
+                            f"{sorted(members)})")
+        for cn in (rt.clique_names or sorted(members)):
+            if cn in covered and cn in clique_names:
+                errs.append(f"{f}: clique {cn!r} already covered by "
+                            f"reservation {covered[cn]!r} (coverage must "
+                            "not overlap)")
+            covered.setdefault(cn, rt.name)
+
     for rt in tmpl.reservations:
         f = f"reservation {rt.name!r}"
-        if not _NAME_RE.match(rt.name or ""):
-            errs.append(f"{f}: invalid name (DNS-label-like, <= 52 chars)")
-        if rt.name in seen:
-            errs.append(f"duplicate reservation template name {rt.name!r}")
-        seen.add(rt.name)
-        if not isinstance(rt.scope, ReservationScope):
-            errs.append(f"{f}: scope must be one of "
-                        f"{[s.value for s in ReservationScope]}")
-        if rt.slice_count < 1:
-            errs.append(f"{f}: slice_count must be >= 1, "
-                        f"got {rt.slice_count}")
-        if rt.generation and rt.generation not in TPU_GENERATIONS:
-            errs.append(f"{f}: unknown generation {rt.generation!r} "
-                        f"(known: {sorted(TPU_GENERATIONS)})")
-        if rt.topology and not re.fullmatch(r"\d+x\d+(x\d+)?", rt.topology):
-            errs.append(f"{f}: topology {rt.topology!r} is not an ICI mesh "
-                        "shape like '4x4' or '4x4x4'")
+        _check_reservation_template(
+            rt, f, seen_by_scope.setdefault("", set()), errs)
         targets = rt.clique_names or sorted(clique_names)
         for cn in rt.clique_names:
             if cn not in clique_names:
@@ -466,28 +506,49 @@ def _validate_reservations(pcs: PodCliqueSet, errs: list[str]) -> None:
                             f"clique (have {sorted(clique_names)})")
         for cn in targets:
             if cn in covered and cn in clique_names:
-                errs.append(f"{f}: clique {cn!r} already covered by "
-                            f"reservation {covered[cn]!r} (coverage must "
-                            "not overlap)")
+                errs.append(
+                    f"{f}: clique {cn!r} already covered by reservation "
+                    f"{covered[cn]!r} (coverage must not overlap; a "
+                    "cover-all PCS-level template needs a clique_names "
+                    "filter when group-level reservations exist)")
             covered.setdefault(cn, rt.name)
+
     # Generated OBJECT names must be unique across templates x replicas:
     # AllReplicas '1-x' and PerReplica 'x' at replica 1 both compose to
     # '<pcs>-1-x-rsv' — two templates silently sharing one reservation.
     generated: dict[str, str] = {}
     from grove_tpu.api import namegen
+
+    def claim(gn: str, owner: str) -> None:
+        if gn in generated and generated[gn] != owner:
+            errs.append(
+                f"reservation {owner!r} generates object name {gn!r} "
+                f"which collides with reservation {generated[gn]!r}; "
+                "rename one template")
+        generated.setdefault(gn, owner)
+
+    # Worst-case replica range includes the autoscaling ceiling — the
+    # collision must be caught at create, not at the first scale-out.
+    max_r = pcs.spec.replicas
+    if pcs.spec.auto_scaling is not None:
+        max_r = max(max_r, pcs.spec.auto_scaling.max_replicas)
     for rt in tmpl.reservations:
         if rt.scope == ReservationScope.PER_REPLICA:
-            gen_names = [namegen.reservation_name(pcs.meta.name, rt.name, r)
-                         for r in range(max(1, pcs.spec.replicas))]
+            for r in range(max(1, max_r)):
+                claim(namegen.reservation_name(pcs.meta.name, rt.name, r),
+                      rt.name)
         else:
-            gen_names = [namegen.reservation_name(pcs.meta.name, rt.name)]
-        for gn in gen_names:
-            if gn in generated and generated[gn] != rt.name:
-                errs.append(
-                    f"reservation {rt.name!r} generates object name {gn!r} "
-                    f"which collides with reservation {generated[gn]!r}; "
-                    "rename one template")
-            generated.setdefault(gn, rt.name)
+            claim(namegen.reservation_name(pcs.meta.name, rt.name), rt.name)
+    for sg, rt in sg_reservations:
+        owner = f"{sg.name}/{rt.name}"
+        for r in range(max(1, max_r)):
+            if rt.scope == ReservationScope.PER_REPLICA:
+                for j in range(max(1, _sg_max_replicas(sg))):
+                    claim(namegen.pcsg_reservation_name(
+                        pcs.meta.name, r, sg.name, rt.name, j), owner)
+            else:
+                claim(namegen.pcsg_reservation_name(
+                    pcs.meta.name, r, sg.name, rt.name), owner)
 
 
 # ---- update immutability table (reference podcliqueset.go:662-698) ----
@@ -527,6 +588,10 @@ _IMMUTABLE_SG_FIELDS = [
     ("min_available", lambda sg: sg.min_available),
     ("topology", lambda sg: (sg.topology.pack_level, sg.topology.required,
                              sg.topology.spread_level) if sg.topology else None),
+    ("reservations",
+     lambda sg: tuple((rt.name, rt.scope, rt.generation, rt.topology,
+                       rt.slice_count, tuple(rt.clique_names))
+                      for rt in sg.reservations)),
 ]
 
 
@@ -668,6 +733,11 @@ def validate_podcliqueset(pcs: PodCliqueSet,
         f = f"scaling group {sg.name!r}"
         if not _NAME_RE.match(sg.name or ""):
             errs.append(f"{f}: invalid name")
+        if sg.name in known:
+            # Generated names interleave <clique> and <sg> segments at
+            # the same position; one string naming both makes child
+            # names (and debugging) ambiguous.
+            errs.append(f"{f}: name collides with a clique name")
         if not sg.clique_names:
             errs.append(f"{f}: clique_names must not be empty")
         if sg.replicas < 1:

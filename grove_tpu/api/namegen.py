@@ -64,5 +64,17 @@ def reservation_name(pcs: str, template: str,
     return f"{pcs}-{pcs_replica}-{template}-rsv"
 
 
+def pcsg_reservation_name(pcs: str, pcs_replica: int, group: str,
+                          template: str,
+                          pcsg_replica: int | None = None) -> str:
+    """PCSG-level sharing: AllReplicas = one pool per PCSG object
+    (<pcs>-<r>-<group>-<template>-rsv); PerReplica = one pool per model
+    instance (<pcs>-<r>-<group>-<j>-<template>-rsv)."""
+    base = f"{pcs}-{pcs_replica}-{group}"
+    if pcsg_replica is None:
+        return f"{base}-{template}-rsv"
+    return f"{base}-{pcsg_replica}-{template}-rsv"
+
+
 def hpa_name(target_kind: str, target: str) -> str:
     return f"{target_kind.lower()}-{target}-hpa"

@@ -121,6 +121,13 @@ class ScalingGroupConfig:
     min_available: Optional[int] = None
     auto_scaling: Optional[AutoScalingConfig] = None
     topology: Optional[TopologyConstraint] = None
+    # PCSG-level slice sharing (reference proposal 390 PCSG scope):
+    # AllReplicas = one pool shared by every replica of this group;
+    # PerReplica = one pool PER MODEL INSTANCE — the TPU-iconic shape
+    # (each multi-host instance pinned to its own slice set). Scales
+    # with live (autoscaled) replica counts.
+    reservations: list[ReservationTemplate] = dataclasses.field(
+        default_factory=list)
 
 
 @dataclasses.dataclass

@@ -30,7 +30,6 @@ from grove_tpu.api.reservation import (
     SliceReservation,
     SliceReservationSpec,
 )
-from grove_tpu.api.serde import clone
 from grove_tpu.api.scalinggroup import (
     PodCliqueScalingGroup,
     PodCliqueScalingGroupSpec,
@@ -159,11 +158,22 @@ def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
     return fqns
 
 
-def reservation_for(pcs: PodCliqueSet, replica: int,
-                    clique_name: str) -> str:
+def reservation_for(pcs: PodCliqueSet, replica: int, clique_name: str,
+                    pcsg_replica: int = 0) -> str:
     """The SliceReservation name covering ``clique_name`` in PCS replica
-    ``replica``, or "". First matching template wins (validation rejects
-    overlapping filters)."""
+    ``replica``, or "". PCSG-level templates take precedence for their
+    members (the nearest-scope rule); first matching template wins at
+    each level (validation rejects overlapping filters)."""
+    sg = _sg_of_clique(pcs).get(clique_name)
+    if sg is not None:
+        for rt in sg.reservations:
+            if rt.clique_names and clique_name not in rt.clique_names:
+                continue
+            if rt.scope == ReservationScope.PER_REPLICA:
+                return namegen.pcsg_reservation_name(
+                    pcs.meta.name, replica, sg.name, rt.name, pcsg_replica)
+            return namegen.pcsg_reservation_name(
+                pcs.meta.name, replica, sg.name, rt.name)
     for rt in pcs.spec.template.reservations:
         if rt.clique_names and clique_name not in rt.clique_names:
             continue
@@ -173,21 +183,34 @@ def reservation_for(pcs: PodCliqueSet, replica: int,
     return ""
 
 
-def expected_reservations(pcs: PodCliqueSet) -> list[SliceReservation]:
-    """SliceReservation children per template: one for AllReplicas scope,
-    one per PCS replica for PerReplica (the ResourceClaim components'
-    expected state, reference podcliqueset/components/resourceclaim/)."""
+def _sg_of_clique(pcs: PodCliqueSet) -> dict[str, ScalingGroupConfig]:
+    return {cn: sg for sg in pcs.spec.template.scaling_groups
+            for cn in sg.clique_names}
+
+
+def _rt_spec(rt) -> SliceReservationSpec:
+    return SliceReservationSpec(generation=rt.generation,
+                                topology=rt.topology,
+                                slice_count=rt.slice_count)
+
+
+def expected_reservations(pcs: PodCliqueSet,
+                          live_replicas: dict[str, int] | None = None
+                          ) -> list[SliceReservation]:
+    """SliceReservation children for PCS-level templates (AllReplicas =
+    one shared object, PerReplica = one per PCS replica) and PCSG-level
+    templates (AllReplicas = one per PCSG object, PerReplica = one per
+    model instance, following live autoscaled replica counts — scale-in
+    prunes the instance's reservation and frees its slices)."""
+    live_replicas = live_replicas or {}
     out = []
     for rt in pcs.spec.template.reservations:
-        spec = SliceReservationSpec(generation=rt.generation,
-                                    topology=rt.topology,
-                                    slice_count=rt.slice_count)
         if rt.scope == ReservationScope.PER_REPLICA:
             for r in range(pcs.spec.replicas):
                 name = namegen.reservation_name(pcs.meta.name, rt.name, r)
                 out.append(SliceReservation(
                     meta=_meta(pcs, name, _labels(pcs, r, {})),
-                    spec=clone(spec)))
+                    spec=_rt_spec(rt)))
         else:
             name = namegen.reservation_name(pcs.meta.name, rt.name)
             out.append(SliceReservation(
@@ -195,7 +218,28 @@ def expected_reservations(pcs: PodCliqueSet) -> list[SliceReservation]:
                     c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
                     c.LABEL_PCS_NAME: pcs.meta.name,
                 }),
-                spec=clone(spec)))
+                spec=_rt_spec(rt)))
+    for r in range(pcs.spec.replicas):
+        for sg in pcs.spec.template.scaling_groups:
+            if not sg.reservations:
+                continue
+            pcsg_name = namegen.pcsg_name(pcs.meta.name, r, sg.name)
+            replicas = live_replicas.get(pcsg_name, sg.replicas)
+            for rt in sg.reservations:
+                extra = {c.LABEL_PCSG_NAME: pcsg_name}
+                if rt.scope == ReservationScope.PER_REPLICA:
+                    for j in range(replicas):
+                        name = namegen.pcsg_reservation_name(
+                            pcs.meta.name, r, sg.name, rt.name, j)
+                        out.append(SliceReservation(
+                            meta=_meta(pcs, name, _labels(pcs, r, extra)),
+                            spec=_rt_spec(rt)))
+                else:
+                    name = namegen.pcsg_reservation_name(
+                        pcs.meta.name, r, sg.name, rt.name)
+                    out.append(SliceReservation(
+                        meta=_meta(pcs, name, _labels(pcs, r, extra)),
+                        spec=_rt_spec(rt)))
     return out
 
 
@@ -203,7 +247,8 @@ def _clique_to_spec(pcs: PodCliqueSet, replica: int, t: PodCliqueTemplate,
                     name: str, pcsg: str = "", pcsg_replica: int = 0,
                     template_hash: str = "") -> PodCliqueSpec:
     return PodCliqueSpec(
-        reservation=reservation_for(pcs, replica, t.name),
+        reservation=reservation_for(pcs, replica, t.name,
+                                    pcsg_replica=pcsg_replica),
         role_name=t.name,
         replicas=t.replicas,
         min_available=min_available(t),

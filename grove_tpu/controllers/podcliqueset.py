@@ -119,12 +119,15 @@ class PodCliqueSetReconciler:
 
     def _sync_components(self, pcs: PodCliqueSet,
                          template_hash: str) -> list[Exception]:
+        # Live (autoscaled) replica counts shape both gang pod references
+        # and per-instance PCSG reservations.
+        live = self._live_replicas(pcs)
         # G1: services + slice reservations (reservations must exist
         # before cliques so the binding controller can work while pods
         # are still being created).
         errors = self._sync_children(Service, exp.expected_services(pcs), pcs)
         errors += self._sync_children(
-            SliceReservation, exp.expected_reservations(pcs), pcs,
+            SliceReservation, exp.expected_reservations(pcs, live), pcs,
             update_spec=True)
         if errors:
             return errors
@@ -140,7 +143,6 @@ class PodCliqueSetReconciler:
         # G3: scaling groups ∥ podgangs. Gangs reference live (possibly
         # autoscaled) replica counts and carry placement-reuse hints for
         # replicas being recreated by a rolling update.
-        live = self._live_replicas(pcs)
         gangs = exp.expected_podgangs(pcs, live)
         for gang in gangs:
             r = gang.meta.labels.get(c.LABEL_PCS_REPLICA, "")

@@ -26,6 +26,7 @@ the reference, proposal 390 "Owner References and Garbage Collection").
 from __future__ import annotations
 
 import collections
+import time
 
 from grove_tpu.api import Node, Pod, SliceReservation, constants as c
 from grove_tpu.api.core import PodPhase
@@ -119,9 +120,9 @@ class SliceReservationReconciler:
         # Rate-limited hygiene: at most one full-namespace sweep per
         # resync period across ALL reservations (per-reconcile sweeping
         # would be O(reservations x nodes) for redundant scans).
-        import time
-        if time.time() - self._last_sweep > self.RESYNC_SECONDS:
-            self._last_sweep = time.time()
+        # Monotonic: a wall-clock step backwards must not suppress it.
+        if time.monotonic() - self._last_sweep > self.RESYNC_SECONDS:
+            self._last_sweep = time.monotonic()
             self._sweep_orphan_labels(req.namespace)
         if missing > 0:
             return StepResult.requeue(2.0)

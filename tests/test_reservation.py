@@ -253,6 +253,110 @@ def test_validation_rules():
                                          topology="2x4")]) == ""
 
 
+def _sg_pcs(name, *, sg_replicas=2, min_avail=None,
+            scope=ReservationScope.PER_REPLICA):
+    from grove_tpu.api.podcliqueset import ScalingGroupConfig
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, min_available=2,
+                container=ContainerSpec(argv=["sleep", "inf"]),
+                tpu_chips_per_pod=4,
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True))],
+            topology=TopologyConstraint(pack_level="pool", required=True),
+            scaling_groups=[ScalingGroupConfig(
+                name="inst", clique_names=["w"], replicas=sg_replicas,
+                min_available=(sg_replicas if min_avail is None
+                               else min_avail),
+                reservations=[ReservationTemplate(name="own",
+                                                  scope=scope,
+                                                  slice_count=1)])])))
+
+
+def test_pcsg_per_instance_reservations(cluster):
+    """PCSG PerReplica scope: each model instance gets its OWN slice
+    pool — instance 0 and 1 land on disjoint reserved slices."""
+    client = cluster.client
+    client.create(_sg_pcs("inst-own"))
+
+    def bound():
+        rs = client.list(SliceReservation,
+                         selector={c.LABEL_PCS_NAME: "inst-own"})
+        return len(rs) == 2 and all(
+            r.status.phase == ReservationPhase.BOUND for r in rs)
+    wait_for(bound, desc="two per-instance reservations bound")
+    rs = {r.meta.name: r for r in client.list(
+        SliceReservation, selector={c.LABEL_PCS_NAME: "inst-own"})}
+    assert set(rs) == {"inst-own-0-inst-0-own-rsv",
+                       "inst-own-0-inst-1-own-rsv"}
+    wait_for(_placed(client, "inst-own", 4), desc="all instance pods placed")
+    nodes = {n.meta.name: n for n in client.list(Node)}
+
+    def slices_of(j):
+        return {nodes[p.status.node_name].meta.labels[c.NODE_LABEL_SLICE]
+                for p in client.list(Pod, selector={
+                    c.LABEL_PCS_NAME: "inst-own",
+                    c.LABEL_PCSG_REPLICA: str(j)})}
+
+    s0, s1 = slices_of(0), slices_of(1)
+    assert s0 <= set(rs["inst-own-0-inst-0-own-rsv"].status.bound_slices)
+    assert s1 <= set(rs["inst-own-0-inst-1-own-rsv"].status.bound_slices)
+    assert s0.isdisjoint(s1)
+
+
+def test_pcsg_scale_in_frees_instance_reservation(cluster):
+    """Scaling the group down prunes the vanished instance's reservation
+    and returns its slices to the pool."""
+    from grove_tpu.api import PodCliqueScalingGroup
+    client = cluster.client
+    client.create(_sg_pcs("inst-scale", min_avail=1))
+    wait_for(lambda: len(client.list(
+        SliceReservation,
+        selector={c.LABEL_PCS_NAME: "inst-scale"})) == 2, desc="2 rsv")
+
+    live = client.get(PodCliqueSet, "inst-scale")
+    live.spec.template.scaling_groups[0].replicas = 1
+    client.update(live)
+
+    def pruned():
+        rs = client.list(SliceReservation,
+                         selector={c.LABEL_PCS_NAME: "inst-scale"})
+        if len(rs) != 1:
+            return False
+        labeled = {n.meta.labels.get(c.LABEL_RESERVATION)
+                   for n in client.list(Node)} - {None}
+        return labeled == {rs[0].meta.name}
+    wait_for(pruned, timeout=15.0,
+             desc="scale-in pruned the instance reservation + labels")
+
+
+def test_pcsg_level_validation():
+    from grove_tpu.admission.validation import validate_podcliqueset
+    from grove_tpu.api.podcliqueset import ScalingGroupConfig
+
+    # filter must name a group member
+    pcs = _sg_pcs("v")
+    pcs.spec.template.scaling_groups[0].reservations[0].clique_names = ["zz"]
+    errs = "; ".join(validate_podcliqueset(pcs))
+    assert "not a member" in errs
+
+    # PCS-level cover-all overlapping a group-level reservation
+    pcs = _sg_pcs("v2")
+    pcs.spec.template.reservations = [ReservationTemplate(name="all")]
+    errs = "; ".join(validate_podcliqueset(pcs))
+    assert "already covered" in errs and "cover-all" in errs
+
+    # group-level reservations are immutable
+    from grove_tpu.api.serde import clone
+    old = _sg_pcs("v3")
+    new = clone(old)
+    new.spec.template.scaling_groups[0].reservations[0].slice_count = 2
+    errs = "; ".join(validate_podcliqueset(new, old=old))
+    assert "reservations" in errs and "immutable" in errs
+
+
 def test_notready_flap_keeps_binding(cluster):
     """A heartbeat flap (nodes NotReady but present) must NOT drop the
     binding — unlabeling the slice would let general pods squat it in

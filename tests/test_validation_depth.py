@@ -322,3 +322,11 @@ def test_fuzz_from_dict_decode_never_crashes_validation():
         pcs = PodCliqueSet(meta=new_meta("fuzz"), spec=spec)
         errs = validate_podcliqueset(pcs)
         assert isinstance(errs, list), i
+
+
+def test_scaling_group_name_collides_with_clique():
+    pcs = make_pcs()
+    clique = pcs.spec.template.cliques[0]
+    pcs.spec.template.scaling_groups = [
+        ScalingGroupConfig(name=clique.name, clique_names=[clique.name])]
+    assert_rejected(pcs, "collides with a clique name")
