@@ -29,6 +29,20 @@ def authorize(config: AuthorizerConfig, actor: str, verb: str,
         return None
     if actor in _SYSTEM_ACTORS or actor in config.exempt_actors:
         return None
+    if actor.startswith(c.WORKLOAD_ACTOR_PREFIX):
+        # Workload identity tokens are metrics-push credentials, full
+        # stop — a compromised pod must not be able to mutate ANY
+        # object, including user kinds an anonymous caller could not
+        # touch either (server.py also rejects these before admission;
+        # this is the defense-in-depth layer).
+        return (f"workload actor {actor!r} may not {verb} anything; "
+                "workload tokens only authenticate metric pushes")
+    if obj.KIND == "Secret":
+        # Secrets are control-plane-minted only: letting users create
+        # one lets them squat the deterministic workload-token name and
+        # silently disable a PCS's workload identity.
+        return (f"actor {actor!r} may not {verb} Secrets; they are "
+                "minted by the control plane")
     if obj.KIND in _USER_KINDS:
         return None
     if obj.meta.labels.get(c.LABEL_MANAGED_BY) == c.LABEL_MANAGED_BY_VALUE:

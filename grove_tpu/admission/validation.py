@@ -341,6 +341,8 @@ def _validate_name_budgets(pcs: PodCliqueSet, errs: list[str]) -> None:
 
     # headless service: <pcs>-<r>-svc
     check("headless service", pcs_len + 1 + r_digits + 1 + 3)
+    # workload token secret: <pcs>-workload-token
+    check("workload token secret", pcs_len + 15)
     for t in tmpl.cliques:
         pod_digits = _digits(_clique_max_replicas(t) - 1)
         sg = in_group.get(t.name)

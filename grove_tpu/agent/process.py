@@ -157,6 +157,56 @@ class ProcessKubelet:
                 continue
             self._launch(pod, nodes[pod.status.node_name])
 
+    def _inject_workload_token(self, pod: Pod, env: dict[str, str]) -> bool:
+        """GROVE_API_TOKEN = the pod's PCS workload identity token
+        (satokensecret analog): in-pod engines authenticate metric
+        pushes with a PCS-scoped credential instead of inheriting
+        whatever operator token sits in the kubelet's environment. An
+        explicit container-env value wins; inherited shell values are
+        OVERRIDDEN — leaking the operator credential into workloads is
+        the failure mode this exists to close.
+
+        Returns False on a TRANSIENT read failure: env is fixed at
+        exec, so launching credential-less would silently 401 every
+        metric push for the pod's whole life — defer the launch and let
+        the next tick retry instead. A genuinely absent secret (legacy
+        PCS, conflict) launches without a token."""
+        if "GROVE_API_TOKEN" in pod.spec.container.env:
+            return True
+        env.pop("GROVE_API_TOKEN", None)       # never leak operator creds
+        pcs_name = pod.meta.labels.get(c.LABEL_PCS_NAME)
+        if not pcs_name:
+            return True
+        from grove_tpu.api.core import Secret
+        from grove_tpu.api.namegen import workload_token_secret_name
+        from grove_tpu.runtime.errors import (
+            ForbiddenError,
+            GroveError,
+            NotFoundError,
+        )
+        try:
+            sec = self.client.get(Secret,
+                                  workload_token_secret_name(pcs_name),
+                                  pod.meta.namespace)
+        except NotFoundError:
+            return True
+        except ForbiddenError:
+            # Persistent: this agent's credential cannot read Secrets
+            # (not a system actor) — deferring would deadlock the
+            # launch. Run without workload identity and say why.
+            self.log.warning("pod %s: agent credential may not read the "
+                             "workload token secret; launching without "
+                             "workload identity", pod.meta.name)
+            return True
+        except GroveError as e:
+            self.log.warning("pod %s: workload token read failed (%s); "
+                             "deferring launch", pod.meta.name, e)
+            return False
+        token = sec.data.get("token", "")
+        if token:
+            env["GROVE_API_TOKEN"] = token
+        return True
+
     def _launch(self, pod: Pod, node: Node) -> None:
         argv = pod.spec.container.argv
         if not argv:
@@ -165,6 +215,8 @@ class ProcessKubelet:
         env = dict(os.environ)
         env.update(self.extra_env)
         env.update(pod.spec.container.env)
+        if not self._inject_workload_token(pod, env):
+            return                             # retried next tick
         env["GROVE_POD_NAME"] = pod.meta.name
         env["GROVE_NAMESPACE"] = pod.meta.namespace
         env["GROVE_NODE_NAME"] = node.meta.name

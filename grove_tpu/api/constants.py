@@ -23,6 +23,12 @@ LABEL_POD_INDEX = f"{DOMAIN}/pod-index"
 LABEL_POD_TEMPLATE_HASH = f"{DOMAIN}/pod-template-hash"
 LABEL_SCHEDULER_NAME = f"{DOMAIN}/scheduler-name"
 LABEL_COMPONENT = f"{DOMAIN}/component"
+# Marks control-plane-minted token secrets; the server maps bearer
+# tokens found in such secrets to the workload actor derived from the
+# secret's PCS label (server.py _workload_actor).
+LABEL_TOKEN_KIND = f"{DOMAIN}/token-kind"
+TOKEN_KIND_WORKLOAD = "workload"
+WORKLOAD_ACTOR_PREFIX = "system:workload:"
 
 # ---- node labels (TPU topology; GKE-compatible names kept alongside) ----
 NODE_LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"

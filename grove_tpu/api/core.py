@@ -108,6 +108,24 @@ class Node:
 
 
 @dataclasses.dataclass
+class Secret:
+    """Opaque key/value material the control plane mints for workloads —
+    the reference's per-PCS service-account token Secret
+    (podcliqueset/components/satokensecret/). Today's single use: the
+    workload identity token (`<pcs>-workload-token`, data keys
+    ``token``) that in-pod engines present for authenticated,
+    PCS-scoped metric pushes. Wire reads are restricted to system
+    actors (server.py); the identity an accepted token maps to is
+    derived from the secret's OWN labels, never from its data — a
+    user-minted secret can therefore never escalate."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    data: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    KIND = "Secret"
+
+
+@dataclasses.dataclass
 class Service:
     """Headless service: DNS-style discovery record for a PCS replica's
     pods (reference: podcliqueset/components/service/). In this control

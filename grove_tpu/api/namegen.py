@@ -76,5 +76,11 @@ def pcsg_reservation_name(pcs: str, pcs_replica: int, group: str,
     return f"{base}-{pcsg_replica}-{template}-rsv"
 
 
+def workload_token_secret_name(pcs: str) -> str:
+    """The per-PCS workload identity token secret (reference
+    satokensecret component analog)."""
+    return f"{pcs}-workload-token"
+
+
 def hpa_name(target_kind: str, target: str) -> str:
     return f"{target_kind.lower()}-{target}-hpa"

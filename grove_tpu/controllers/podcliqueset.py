@@ -26,7 +26,11 @@ from grove_tpu.controllers import expected as exp
 from grove_tpu.controllers import replica_lifecycle as lifecycle
 from grove_tpu.runtime.concurrent import run_concurrently
 from grove_tpu.runtime.controller import Request
-from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.errors import (
+    AlreadyExistsError,
+    GroveError,
+    NotFoundError,
+)
 from grove_tpu.runtime.flow import StepResult
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.store.client import Client
@@ -129,6 +133,7 @@ class PodCliqueSetReconciler:
         errors += self._sync_children(
             SliceReservation, exp.expected_reservations(pcs, live), pcs,
             update_spec=True)
+        self._ensure_workload_token(pcs, errors)
         if errors:
             return errors
         # G2: standalone PCLQs (must exist before podgangs reference pods).
@@ -165,6 +170,52 @@ class PodCliqueSetReconciler:
                 PodGang, gangs, pcs, update_spec=True)),
         ])
         return errors
+
+    def _ensure_workload_token(self, pcs: PodCliqueSet,
+                               errors: list[Exception]) -> None:
+        """Mint the per-PCS workload identity token (reference
+        satokensecret component): create-once — a regenerated token
+        would invalidate running pods' credentials — removed by the
+        owner cascade with the PCS. Kubelets inject it as
+        GROVE_API_TOKEN; the server maps it to the PCS-scoped workload
+        actor for authenticated metric pushes (api/core.py Secret)."""
+        import secrets as pysecrets
+        from grove_tpu.api import namegen
+        from grove_tpu.api.core import Secret
+        from grove_tpu.api.meta import new_meta
+
+        name = namegen.workload_token_secret_name(pcs.meta.name)
+        try:
+            cur = self.client.get(Secret, name, pcs.meta.namespace)
+            if cur.meta.labels.get(c.LABEL_TOKEN_KIND) != \
+                    c.TOKEN_KIND_WORKLOAD:
+                # Squatted name (admission now forbids user Secrets, but
+                # one may predate that or arrive via a privileged
+                # actor): the server will never map it, so say so
+                # loudly instead of silently serving no identity.
+                from grove_tpu.runtime.events import EventRecorder
+                EventRecorder(self.client, "podcliqueset").event(
+                    pcs, "Warning", "WorkloadTokenConflict",
+                    f"Secret {name!r} exists but is not a control-plane "
+                    "workload token; pods of this PodCliqueSet run "
+                    "without workload identity until it is removed")
+            return
+        except NotFoundError:
+            pass
+        sec = Secret(
+            meta=new_meta(name, namespace=pcs.meta.namespace, labels={
+                c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                c.LABEL_PCS_NAME: pcs.meta.name,
+                c.LABEL_TOKEN_KIND: c.TOKEN_KIND_WORKLOAD,
+            }),
+            data={"token": pysecrets.token_urlsafe(24)})
+        sec.meta.owner_references = [exp.owner_ref(pcs)]
+        try:
+            self.client.create(sec)
+        except AlreadyExistsError:
+            pass                               # concurrent sync won the race
+        except GroveError as e:
+            errors.append(e)
 
     def _live_replicas(self, pcs: PodCliqueSet) -> dict[str, int]:
         """Live replica counts for auto-scaled children (they own their

@@ -114,9 +114,16 @@ def register_controllers(mgr: Manager) -> Registry:
             node_shape[key] = shape
         reqs = [Request(ns, r.meta.name) for r in mgr.client.list(
             SliceReservation, ns)]
-        # No live reservations: still sweep — a crash-lost delete event
-        # must not leave orphaned reservation labels fencing this node.
-        return reqs or [Request(ns, SWEEP_REQUEST)]
+        if reqs:
+            return reqs
+        # No live reservations: sweep ONLY if this node carries a
+        # reservation label (a crash-lost delete event left an orphan
+        # fencing it). An unlabeled node joining a reservation-free
+        # namespace needs nothing — at fleet-creation scale (1000
+        # nodes) unconditional sweeps were a measurable startup tax.
+        if node.meta.labels.get(c.LABEL_RESERVATION):
+            return [Request(ns, SWEEP_REQUEST)]
+        return []
 
     rsv_ctrl.watches(["Node"], node_to_reservations)
     mgr.add_controller(rsv_ctrl)

@@ -21,7 +21,7 @@ from grove_tpu.api import (
     PodGang,
     SliceReservation,
 )
-from grove_tpu.api.core import Service
+from grove_tpu.api.core import Secret, Service
 from grove_tpu.api.meta import ObjectMeta, new_meta
 from grove_tpu.api.serde import from_dict, type_problems, unknown_keys
 from grove_tpu.runtime.errors import ValidationError
@@ -30,7 +30,8 @@ from grove_tpu.runtime.events import Event
 KIND_REGISTRY: dict[str, type] = {
     cls.KIND: cls
     for cls in (PodCliqueSet, PodClique, PodCliqueScalingGroup, PodGang,
-                ClusterTopology, Pod, Node, Service, Event, SliceReservation)
+                ClusterTopology, Pod, Node, Service, Event, SliceReservation,
+                Secret)
 }
 
 

@@ -56,6 +56,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from grove_tpu.api import constants as c
 from grove_tpu.api.serde import from_dict, to_dict
 from grove_tpu.manifest import KIND_REGISTRY, load_manifest, load_object
 from grove_tpu.runtime.errors import (
@@ -77,6 +78,40 @@ class ApiServer:
         self._certs = None              # CertManager when TLS is on
         self._rotate_timer: threading.Timer | None = None
         self._stopped = False
+        self._token_index: dict[str, str] = {}
+        self._token_index_at = 0.0
+        self._token_lock = threading.Lock()
+
+    TOKEN_INDEX_TTL = 2.0
+
+    def _workload_token_index(self) -> dict[str, str]:
+        """token -> workload actor, rebuilt at most every TTL seconds.
+        A freshly minted token may be unknown for up to one TTL; metric
+        pushers retry, and that beats a cluster-wide Secret list on
+        every request carrying an unknown bearer token."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._token_lock:
+            if now - self._token_index_at < self.TOKEN_INDEX_TTL:
+                return self._token_index
+            from grove_tpu.api import constants as _c
+            from grove_tpu.api.core import Secret
+
+            index: dict[str, str] = {}
+            for s in self.cluster.client.list(
+                    Secret, None,
+                    selector={_c.LABEL_TOKEN_KIND: _c.TOKEN_KIND_WORKLOAD,
+                              _c.LABEL_MANAGED_BY:
+                                  _c.LABEL_MANAGED_BY_VALUE}):
+                pcs = s.meta.labels.get(_c.LABEL_PCS_NAME, "")
+                token = s.data.get("token", "")
+                if pcs and token:
+                    index[token] = (f"{_c.WORKLOAD_ACTOR_PREFIX}"
+                                    f"{s.meta.namespace}:{pcs}")
+            self._token_index = index
+            self._token_index_at = now
+            return index
 
     @property
     def scheme(self) -> str:
@@ -166,6 +201,17 @@ class ApiServer:
                                      "kinds": sorted(KIND_REGISTRY)})
                 return cls
 
+            def _guard_secret_read(self, cls) -> bool:
+                """Secrets hold credentials: wire reads require a SYSTEM
+                actor even when reads are otherwise open (the reference
+                scopes its SA token secret behind RBAC the same way).
+                Returns False after sending the error."""
+                if cls.KIND != "Secret" or self._secret_visible():
+                    return True
+                self._send(403, {"error": "Secret reads require a "
+                                 "system-actor bearer token"})
+                return False
+
             def _auth_config(self):
                 return cluster.manager.config.server_auth
 
@@ -178,7 +224,38 @@ class ApiServer:
                     return ANONYMOUS_ACTOR
                 if not hdr.startswith("Bearer "):
                     return None
-                return self._auth_config().tokens.get(hdr[7:].strip())
+                token = hdr[7:].strip()
+                actor = self._auth_config().tokens.get(token)
+                if actor is not None:
+                    return actor
+                return self._workload_actor(token)
+
+            def _workload_actor(self, token: str) -> str | None:
+                """Resolve a control-plane-minted workload token (the
+                per-PCS Secret, satokensecret analog) to its PCS-scoped
+                actor, via the server's TTL-cached index — the steady-
+                state metrics hot path (and garbage-token floods) must
+                not list Secrets per request. The identity derives from
+                the secret's OWN labels — data never names an actor, so
+                a user-minted secret cannot escalate (and unmanaged
+                secrets are ignored outright)."""
+                import hmac
+
+                for cand, actor in api._workload_token_index().items():
+                    if hmac.compare_digest(cand, token):
+                        return actor
+                return None
+
+            def _secret_visible(self) -> bool:
+                """ONE rule for every wire surface that can show Secret
+                material (reads, watch events): system actors only."""
+                from grove_tpu.admission.authorization import (
+                    _SYSTEM_ACTORS,
+                )
+                actor = self._actor()
+                return (actor in _SYSTEM_ACTORS
+                        or (actor or "") in cluster.manager.config
+                        .authorizer.exempt_actors)
 
             def _mutating_client(self):
                 """Impersonated client for a mutating request, or None
@@ -186,6 +263,14 @@ class ApiServer:
                 actor = self._actor()
                 if actor is None:
                     self._send(401, {"error": "invalid bearer token"})
+                    return None
+                if actor.startswith(c.WORKLOAD_ACTOR_PREFIX):
+                    # Metrics-only credential: a pod's token must grant
+                    # strictly LESS than anonymity does, not more.
+                    self._send(403, {"error":
+                                     "workload tokens only authenticate "
+                                     "metric pushes; mutations need an "
+                                     "operator/user token"})
                     return None
                 if actor == ANONYMOUS_ACTOR and \
                         not self._auth_config().allow_anonymous_mutations:
@@ -219,6 +304,8 @@ class ApiServer:
                         cls = self._kind(parts[1])
                         if cls is None:
                             return
+                        if not self._guard_secret_read(cls):
+                            return
                         q = parse_qs(url.query)
                         # "*" = all namespaces (kubectl -A analog).
                         ns = q.get("namespace", ["default"])[0]
@@ -231,6 +318,8 @@ class ApiServer:
                     elif len(parts) == 3 and parts[0] == "api":
                         cls = self._kind(parts[1])
                         if cls is None:
+                            return
+                        if not self._guard_secret_read(cls):
                             return
                         q = parse_qs(url.query)
                         ns = q.get("namespace", ["default"])[0]
@@ -372,11 +461,17 @@ class ApiServer:
                 ns = None if ns in (None, "*") else ns
                 selector = {k[2:]: v[0] for k, v in q.items()
                             if k.startswith("l.")} or None
+                # Secret events carry credentials: visible only to
+                # system actors (same rule as direct reads).
+                secrets_ok = self._secret_visible()
                 deadline = _time.time() + timeout
                 while True:
                     events, ok, scanned = store.replay(since, kinds=kinds,
                                                        namespace=ns,
                                                        selector=selector)
+                    if not secrets_ok:
+                        events = [(seq, ev) for seq, ev in events
+                                  if ev.obj.KIND != "Secret"]
                     if not ok:
                         self._send(410, {"error": f"history gone before "
                                          f"rv {since}; relist"})
@@ -443,6 +538,29 @@ class ApiServer:
                     return
                 self._send(200, dump_stacks(), content_type="text/plain")
 
+            def _workload_owns(self, actor: str, payload: dict) -> bool:
+                """A workload actor (system:workload:<ns>:<pcs>) may only
+                report scaling signals for objects its own PCS owns —
+                checked against the live object's PCS label, not a name
+                prefix (PCS 'foo' must not reach 'foo-bar' objects)."""
+                try:
+                    _, _, ns, pcs = actor.split(":", 3)
+                    kind = payload["kind"]
+                    name = payload["name"]
+                    target_ns = payload.get("namespace", "default")
+                except (ValueError, KeyError, TypeError):
+                    return False
+                if target_ns != ns:
+                    return False
+                cls = KIND_REGISTRY.get(kind)
+                if cls is None:
+                    return False
+                try:
+                    obj = cluster.client.get(cls, name, target_ns)
+                except Exception:  # noqa: BLE001 — unknown object
+                    return False
+                return obj.meta.labels.get(c.LABEL_PCS_NAME) == pcs
+
             def _metrics_push(self):
                 """Workload→control-plane metric ingestion: engines inside
                 pods report autoscaling signals (queue depth, rps) here;
@@ -450,8 +568,8 @@ class ApiServer:
                 if cluster.metrics is None:
                     self._send(503, {"error": "autoscaler disabled"})
                     return
+                actor = self._actor()
                 if self._auth_config().require_token_for_metrics:
-                    actor = self._actor()
                     if actor is None or actor == ANONYMOUS_ACTOR:
                         self._send(401, {"error": "metrics push requires a "
                                          "bearer token"})
@@ -459,6 +577,14 @@ class ApiServer:
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     payload = json.loads(self.rfile.read(length) or b"{}")
+                    if actor and actor.startswith(
+                            c.WORKLOAD_ACTOR_PREFIX) and \
+                            not self._workload_owns(actor, payload):
+                        self._send(403, {"error":
+                                         f"workload actor {actor!r} may "
+                                         "only report metrics for its own "
+                                         "PodCliqueSet's components"})
+                        return
                     cluster.metrics.set(
                         payload["kind"], payload["name"], payload["metric"],
                         float(payload["value"]),

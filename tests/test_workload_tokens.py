@@ -1,0 +1,213 @@
+"""Per-PCS workload identity tokens (the reference's satokensecret
+component, C1g): minted once per PodCliqueSet, injected into pods as
+GROVE_API_TOKEN, mapped by the server to a PCS-scoped workload actor
+that may push metrics ONLY for its own PCS — and the Secret material
+itself is invisible to non-system actors on every wire surface."""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from grove_tpu.admission.authorization import OPERATOR_ACTOR
+from grove_tpu.api import Pod, PodCliqueSet, constants as c
+from grove_tpu.api.core import Secret
+from grove_tpu.api.namegen import workload_token_secret_name
+from grove_tpu.cluster import new_cluster
+from grove_tpu.server import ApiServer
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+from test_server import _req
+
+OPERATOR_TOKEN = "wt-operator-token"
+
+
+@pytest.fixture
+def cluster():
+    cl = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        yield cl
+
+
+@pytest.fixture
+def server():
+    from grove_tpu.api.config import OperatorConfiguration
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    cfg.server_auth.tokens = {OPERATOR_TOKEN: OPERATOR_ACTOR}
+    cl = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}", cl
+        srv.stop()
+
+
+def _workload_token(client, pcs_name) -> str:
+    sec = client.get(Secret, workload_token_secret_name(pcs_name))
+    return sec.data["token"]
+
+
+def test_secret_minted_once_and_cascades(cluster):
+    client = cluster.client
+    client.create(simple_pcs(name="tok"))
+    wait_for(lambda: client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "tok"}), desc="secret minted")
+    sec = client.get(Secret, "tok-workload-token")
+    assert sec.meta.labels[c.LABEL_TOKEN_KIND] == c.TOKEN_KIND_WORKLOAD
+    token = sec.data["token"]
+    assert len(token) >= 24
+
+    # stable across reconciles (a regenerated token would cut off
+    # running pods)
+    import time
+    time.sleep(0.5)
+    assert client.get(Secret, "tok-workload-token").data["token"] == token
+
+    client.delete(PodCliqueSet, "tok")
+    wait_for(lambda: not client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "tok"}),
+        desc="secret removed with the PCS")
+
+
+def test_pods_receive_workload_token(tmp_path):
+    """The ProcessKubelet injects GROVE_API_TOKEN from the PCS's secret
+    — and never leaks an operator token inherited from its own shell.
+    Needs REAL processes (fake kubelets never exec)."""
+    import os
+    from grove_tpu.agent.process import ProcessKubelet
+    cl = new_cluster(
+        fleet=FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                          count=2)], fake=False),
+        fake_kubelet=False)
+    cl.manager.add_runnable(ProcessKubelet(cl.client,
+                                           workdir=str(tmp_path)))
+    client = cl.client
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    os.environ["GROVE_API_TOKEN"] = "operator-shell-secret"
+    try:
+        with cl:
+            out = (
+                "import os\n"
+                f"open({str(out_dir)!r} + '/' "
+                "+ os.environ['GROVE_POD_NAME'], 'w')"
+                ".write(os.environ.get('GROVE_API_TOKEN', 'MISSING'))\n"
+                "import time; time.sleep(60)\n")
+            pcs = simple_pcs(name="podtok", pods=2, chips=4)
+            pcs.spec.template.cliques[0].container.argv = [
+                sys.executable, "-c", out]
+            client.create(pcs)
+            wait_for(lambda: len(list(out_dir.iterdir())) == 2,
+                     timeout=20.0, desc="pods wrote their token env")
+            expected = _workload_token(client, "podtok")
+    finally:
+        os.environ.pop("GROVE_API_TOKEN", None)
+    for f in out_dir.iterdir():
+        got = f.read_text()
+        assert got == expected, f"{f.name}: {got!r}"
+        assert got != "operator-shell-secret"
+
+
+def test_secret_reads_require_system_actor(server):
+    base, cl = server
+    cl.client.create(simple_pcs(name="sec"))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "sec"}), desc="minted")
+
+    status, body = _req(f"{base}/api/Secret", token="")
+    assert status == 403, (status, body)
+    status, body = _req(f"{base}/api/Secret/sec-workload-token", token="")
+    assert status == 403
+    status, body = _req(f"{base}/api/Secret", token=OPERATOR_TOKEN)
+    assert status == 200 and body[0]["data"]["token"]
+
+
+def test_watch_hides_secret_events(server):
+    base, cl = server
+    # bootstrap the cursor BEFORE the secret exists
+    status, boot = _req(f"{base}/watch", token="")
+    assert status == 200
+    cl.client.create(simple_pcs(name="wsec"))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "wsec"}), desc="minted")
+    status, resp = _req(f"{base}/watch?since={boot['rv']}&timeout=1",
+                        token="")
+    assert status == 200
+    kinds = {ev["kind"] for ev in resp["events"]}
+    assert "Secret" not in kinds and kinds  # other events flow
+    # a system actor DOES see them
+    status, resp = _req(f"{base}/watch?since={boot['rv']}&timeout=1",
+                        token=OPERATOR_TOKEN)
+    assert "Secret" in {ev["kind"] for ev in resp["events"]}
+
+
+def _push(base, token, kind, name, value=3.0, namespace="default"):
+    body = json.dumps({"kind": kind, "name": name, "metric": "queue_depth",
+                      "value": value, "namespace": namespace}).encode()
+    return _req(f"{base}/metrics/push", "POST", body.decode(),
+                content_type="application/json", token=token)
+
+
+def test_workload_token_scopes_metric_pushes(server):
+    base, cl = server
+    cl.client.create(simple_pcs(name="mine"))
+    cl.client.create(simple_pcs(name="other", pods=2))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "mine"}), desc="minted")
+    wait_for(lambda: cl.client.list(Pod,
+                                    selector={c.LABEL_PCS_NAME: "other"}),
+             desc="other pods")
+    token = _workload_token(cl.client, "mine")
+
+    # own PCLQ: accepted
+    status, body = _push(base, token, "PodClique", "mine-0-workers")
+    assert status == 200, body
+    # another PCS's PCLQ: rejected
+    status, body = _push(base, token, "PodClique", "other-0-workers")
+    assert status == 403 and "its own" in body["error"]
+    # nonexistent object: rejected
+    status, body = _push(base, token, "PodClique", "ghost")
+    assert status == 403
+
+
+def test_workload_token_grants_no_mutations(server):
+    """The escalation the review caught: a workload token must grant
+    strictly LESS than anonymity, not a full actor — every mutating
+    verb is rejected at the server before admission even runs."""
+    base, cl = server
+    cl.client.create(simple_pcs(name="esc"))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "esc"}), desc="minted")
+    token = _workload_token(cl.client, "esc")
+
+    manifest = "kind: PodCliqueSet\nmetadata: {name: sneaky}\nspec:\n" \
+               "  replicas: 1\n  template:\n    cliques:\n" \
+               "      - {name: w, replicas: 1, tpu_chips_per_pod: 4}\n"
+    status, body = _req(f"{base}/apply", "POST", manifest, token=token)
+    assert status == 403 and "metric pushes" in body["error"]
+    status, body = _req(f"{base}/api/PodCliqueSet/esc", "DELETE",
+                        token=token)
+    assert status == 403
+    # and it cannot read secrets either
+    status, body = _req(f"{base}/api/Secret", token=token)
+    assert status == 403
+
+
+def test_require_token_for_metrics_accepts_workload_tokens(server):
+    base, cl = server
+    cl.manager.config.server_auth.require_token_for_metrics = True
+    cl.client.create(simple_pcs(name="gated"))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "gated"}), desc="minted")
+    status, body = _push(base, "", "PodClique", "gated-0-workers")
+    assert status == 401
+    token = _workload_token(cl.client, "gated")
+    status, body = _push(base, token, "PodClique", "gated-0-workers")
+    assert status == 200, body
