@@ -16,8 +16,10 @@ scale:           ## 1000-pod deploy/steady/delete timeline (+ local history)
 		--label "$$(git rev-parse --short HEAD 2>/dev/null || echo dev)"
 
 dashboard:       ## render scale-history JSONL into DASHBOARD.md
-	$(PY) tools/scale_dashboard.py scale-history/*.jsonl \
-		-o scale-history/DASHBOARD.md
+	@# committed sources only — local.jsonl is gitignored scratch, and
+	@# rows without committed backing would make the dashboard lie
+	$(PY) tools/scale_dashboard.py scale-history/history.jsonl \
+		scale-history/ci.jsonl -o scale-history/DASHBOARD.md
 
 soak:            ## repeated scale out/in cycles
 	$(PY) -m pytest tests/test_scale.py::test_soak_scale_cycles -q

@@ -187,6 +187,15 @@ class PodCliqueSetReconciler:
         name = namegen.workload_token_secret_name(pcs.meta.name)
         try:
             cur = self.client.get(Secret, name, pcs.meta.namespace)
+        except NotFoundError:
+            cur = None
+        except GroveError as e:
+            # Same error contract as the create path: record and let the
+            # rest of the PCS sync proceed (a transient read failure
+            # must not skip G2+ child syncs for this pass).
+            errors.append(e)
+            return
+        if cur is not None:
             if cur.meta.labels.get(c.LABEL_TOKEN_KIND) != \
                     c.TOKEN_KIND_WORKLOAD:
                 # Squatted name (admission now forbids user Secrets, but
@@ -200,8 +209,6 @@ class PodCliqueSetReconciler:
                     "workload token; pods of this PodCliqueSet run "
                     "without workload identity until it is removed")
             return
-        except NotFoundError:
-            pass
         sec = Secret(
             meta=new_meta(name, namespace=pcs.meta.namespace, labels={
                 c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,

@@ -85,10 +85,13 @@ class ApiServer:
     TOKEN_INDEX_TTL = 2.0
 
     def _workload_token_index(self) -> dict[str, str]:
-        """token -> workload actor, rebuilt at most every TTL seconds.
-        A freshly minted token may be unknown for up to one TTL; metric
-        pushers retry, and that beats a cluster-wide Secret list on
-        every request carrying an unknown bearer token."""
+        """sha256(token) -> workload actor, rebuilt at most every TTL
+        seconds. Hash-keyed so lookup is one digest + one dict hit
+        (timing-safe: the comparison happens on digests) instead of an
+        O(secrets) scan on the metrics hot path. A freshly minted token
+        may be unknown for up to one TTL; metric pushers retry, and
+        that beats a cluster-wide Secret list per request."""
+        import hashlib
         import time as _time
 
         now = _time.monotonic()
@@ -107,8 +110,9 @@ class ApiServer:
                 pcs = s.meta.labels.get(_c.LABEL_PCS_NAME, "")
                 token = s.data.get("token", "")
                 if pcs and token:
-                    index[token] = (f"{_c.WORKLOAD_ACTOR_PREFIX}"
-                                    f"{s.meta.namespace}:{pcs}")
+                    digest = hashlib.sha256(token.encode()).hexdigest()
+                    index[digest] = (f"{_c.WORKLOAD_ACTOR_PREFIX}"
+                                     f"{s.meta.namespace}:{pcs}")
             self._token_index = index
             self._token_index_at = now
             return index
@@ -201,14 +205,17 @@ class ApiServer:
                                      "kinds": sorted(KIND_REGISTRY)})
                 return cls
 
-            def _guard_secret_read(self, cls) -> bool:
-                """Secrets hold credentials: wire reads require a SYSTEM
-                actor even when reads are otherwise open (the reference
-                scopes its SA token secret behind RBAC the same way).
-                Returns False after sending the error."""
+            def _guard_secret_access(self, cls) -> bool:
+                """Secrets hold credentials: EVERY wire verb that can
+                touch or echo one requires a SYSTEM actor — reads,
+                and also mutations, whose responses echo the object
+                (admission catches mutations too, but only when the
+                authorizer is enabled; this guard holds even in the
+                dev escape-hatch config). Returns False after sending
+                the error."""
                 if cls.KIND != "Secret" or self._secret_visible():
                     return True
-                self._send(403, {"error": "Secret reads require a "
+                self._send(403, {"error": "Secret access requires a "
                                  "system-actor bearer token"})
                 return False
 
@@ -239,12 +246,10 @@ class ApiServer:
                 the secret's OWN labels — data never names an actor, so
                 a user-minted secret cannot escalate (and unmanaged
                 secrets are ignored outright)."""
-                import hmac
+                import hashlib
 
-                for cand, actor in api._workload_token_index().items():
-                    if hmac.compare_digest(cand, token):
-                        return actor
-                return None
+                digest = hashlib.sha256(token.encode()).hexdigest()
+                return api._workload_token_index().get(digest)
 
             def _secret_visible(self) -> bool:
                 """ONE rule for every wire surface that can show Secret
@@ -304,7 +309,7 @@ class ApiServer:
                         cls = self._kind(parts[1])
                         if cls is None:
                             return
-                        if not self._guard_secret_read(cls):
+                        if not self._guard_secret_access(cls):
                             return
                         q = parse_qs(url.query)
                         # "*" = all namespaces (kubectl -A analog).
@@ -319,7 +324,7 @@ class ApiServer:
                         cls = self._kind(parts[1])
                         if cls is None:
                             return
-                        if not self._guard_secret_read(cls):
+                        if not self._guard_secret_access(cls):
                             return
                         q = parse_qs(url.query)
                         ns = q.get("namespace", ["default"])[0]
@@ -360,6 +365,9 @@ class ApiServer:
                         objs = [load_object(json.loads(raw))]
                     else:
                         objs = load_manifest(raw)
+                    for obj in objs:
+                        if not self._guard_secret_access(type(obj)):
+                            return
                     results = []
                     forbidden = False
                     for obj in objs:
@@ -604,6 +612,8 @@ class ApiServer:
                 cls = self._kind(parts[1])
                 if cls is None:
                     return
+                if not self._guard_secret_access(cls):
+                    return
                 client = self._mutating_client()
                 if client is None:
                     return
@@ -642,6 +652,8 @@ class ApiServer:
                 cls = self._kind(parts[1])
                 if cls is None:
                     return
+                if not self._guard_secret_access(cls):
+                    return
                 client = self._mutating_client()
                 if client is None:
                     return
@@ -677,6 +689,8 @@ class ApiServer:
                     return
                 cls = self._kind(parts[1])
                 if cls is None:
+                    return
+                if not self._guard_secret_access(cls):
                     return
                 client = self._mutating_client()
                 if client is None:

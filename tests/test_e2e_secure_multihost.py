@@ -1,0 +1,138 @@
+"""Capstone e2e: the full secure multi-host deployment shape in one
+test — TLS control plane (self-managed CA), bearer-token agents on two
+"hosts" talking ONLY over HTTPS, a gang spanning both, real pod
+processes, workload identity tokens flowing into those processes, and a
+PCS-scoped metric push landing in the autoscaler registry over the same
+secure wire. This is the reference's operator+kubelet+initc+RBAC stack
+compressed to its grove-tpu equivalents, exercised together."""
+
+from __future__ import annotations
+
+import json
+import ssl
+import sys
+import urllib.request
+
+import pytest
+
+from grove_tpu.admission.authorization import NODE_ACTOR, OPERATOR_ACTOR
+from grove_tpu.agent.remote import RemoteAgent
+from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.server import ApiServer
+from grove_tpu.store.httpclient import HttpClient
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+AGENT_TOKEN = "sm-agent-token"
+OPERATOR_TOKEN = "sm-operator-token"
+
+
+@pytest.fixture
+def secure_stack(tmp_path):
+    from grove_tpu.api.config import OperatorConfiguration
+
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    cfg.server_auth.tokens = {OPERATOR_TOKEN: OPERATOR_ACTOR,
+                              AGENT_TOKEN: NODE_ACTOR}
+    cfg.server_auth.require_token_for_metrics = True
+    cfg.server_tls.enabled = True
+    cfg.server_tls.cert_dir = str(tmp_path / "certs")
+    # one v5e 2x4 slice = 2 hosts; NO in-process kubelet — every
+    # node-side action crosses the wire
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                        count=1)], fake=False)
+    cl = new_cluster(config=cfg, fleet=fleet, fake_kubelet=False)
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"https://127.0.0.1:{srv.port}"
+        agents = []
+        for w in range(2):
+            agent = RemoteAgent(
+                HttpClient(base, token=AGENT_TOKEN, ca_file=srv.ca_file),
+                node_name=f"pool-0-slice-0-w{w}",
+                heartbeat_seconds=0.5, tick=0.1,
+                workdir=str(tmp_path / f"host{w}"),
+                # what `grovectl serve`/agent inject in deployment
+                extra_env={"GROVE_CONTROL_PLANE": base,
+                           "GROVE_API_CA": srv.ca_file or ""})
+            agent.start()
+            agents.append(agent)
+        try:
+            yield cl, base, srv
+        finally:
+            for a in agents:
+                a.stop()
+
+
+def test_secure_multihost_gang(secure_stack, tmp_path):
+    cl, base, srv = secure_stack
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    payload = (
+        "import json, os, time, urllib.request\n"
+        "tok = os.environ.get('GROVE_API_TOKEN', '')\n"
+        "body = json.dumps({'kind': 'PodClique',\n"
+        "                   'name': os.environ['GROVE_PCLQ_NAME'],\n"
+        "                   'metric': 'queue_depth', 'value': 7.0,\n"
+        "                   'reporter': os.environ['GROVE_POD_NAME']})\n"
+        "import ssl\n"
+        "ctx = ssl.create_default_context(cafile=os.environ['GROVE_API_CA'])\n"
+        "req = urllib.request.Request(\n"
+        "    os.environ['GROVE_CONTROL_PLANE'] + '/metrics/push',\n"
+        "    data=body.encode(), method='POST',\n"
+        "    headers={'Content-Type': 'application/json',\n"
+        "             'Authorization': 'Bearer ' + tok})\n"
+        "status = urllib.request.urlopen(req, timeout=5, context=ctx).status\n"
+        f"open(os.path.join({str(out_dir)!r}, "
+        "os.environ['GROVE_POD_NAME']), 'w')"
+        ".write(json.dumps({'push': status, 'worker':\n"
+        "    os.environ['TPU_WORKER_ID'], 'host':\n"
+        "    os.environ['GROVE_NODE_NAME']}))\n"
+        "time.sleep(120)\n")
+
+    http = HttpClient(base, token=OPERATOR_TOKEN, ca_file=srv.ca_file)
+    pcs = PodCliqueSet(
+        meta=new_meta("securepcs"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            topology=TopologyConstraint(pack_level="slice", required=True),
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=2, min_available=2,
+                tpu_chips_per_pod=4,
+                container=ContainerSpec(
+                    argv=[sys.executable, "-c", payload]))])))
+    http.create(pcs)
+
+    wait_for(lambda: len(list(out_dir.iterdir())) == 2, timeout=30.0,
+             desc="both hosts' pods ran and pushed over https")
+    results = {f.name: json.loads(f.read_text())
+               for f in out_dir.iterdir()}
+    # gang spanned both hosts with distinct worker ids
+    assert {r["host"] for r in results.values()} == {
+        "pool-0-slice-0-w0", "pool-0-slice-0-w1"}
+    assert {r["worker"] for r in results.values()} == {"0", "1"}
+    # every push was accepted (workload token over TLS, gated metrics)
+    assert all(r["push"] == 200 for r in results.values())
+    # and the signal landed in the autoscaler registry
+    total = cl.metrics.get("PodClique", "securepcs-0-w", "queue_depth")
+    assert total == 14.0, total
+
+
+def test_unpinned_agent_rejected(secure_stack, tmp_path):
+    """An agent without the CA cannot even connect — the fleet's wire is
+    closed to unpinned clients."""
+    from grove_tpu.runtime.errors import GroveError
+    _, base, _ = secure_stack
+    bad = HttpClient(base, token=AGENT_TOKEN)  # no ca_file
+    with pytest.raises(GroveError, match="cannot reach|failed"):
+        bad.list(Pod)

@@ -177,6 +177,46 @@ def test_workload_token_scopes_metric_pushes(server):
     assert status == 403
 
 
+def test_secret_mutating_verbs_guarded_even_without_authorizer():
+    """The PATCH-echo leak: mutating verbs reply with the full object,
+    so Secret access is guarded at the server for EVERY verb — even in
+    the dev escape-hatch config (anonymous mutations on, authorizer
+    off) where admission would not catch it."""
+    from grove_tpu.api.config import OperatorConfiguration
+
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = False
+    cfg.server_auth.allow_anonymous_mutations = True
+    cl = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            cl.client.create(simple_pcs(name="leak"))
+            wait_for(lambda: cl.client.list(
+                Secret, selector={c.LABEL_PCS_NAME: "leak"}),
+                desc="minted")
+            real = _workload_token(cl.client, "leak")
+            status, body = _req(
+                f"{base}/api/Secret/leak-workload-token", "PATCH", "{}",
+                content_type="application/merge-patch+json", token="")
+            assert status == 403, (status, body)
+            assert real not in json.dumps(body)
+            status, body = _req(
+                f"{base}/api/Secret/leak-workload-token", "DELETE",
+                token="")
+            assert status == 403
+            manifest = ("kind: Secret\nmetadata: {name: sneaky-secret}\n"
+                        "data: {token: injected}\n")
+            status, body = _req(f"{base}/apply", "POST", manifest,
+                                token="")
+            assert status == 403, (status, body)
+        finally:
+            srv.stop()
+
+
 def test_workload_token_grants_no_mutations(server):
     """The escalation the review caught: a workload token must grant
     strictly LESS than anonymity, not a full actor — every mutating
