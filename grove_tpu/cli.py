@@ -356,6 +356,51 @@ def cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_logs(args: argparse.Namespace) -> int:
+    """Stream a pod's log from a serve daemon (kubectl-logs analog)."""
+    path = f"/logs/{args.namespace}/{args.pod}"
+    if args.tail is not None:
+        path += f"?tail={args.tail}"
+    status, body = _http(args.server, path, ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
+        return 1
+    sys.stdout.write(body if isinstance(body, str) else str(body))
+    return 0
+
+
+def cmd_events(args: argparse.Namespace) -> int:
+    """List cluster events, newest last (kubectl-get-events analog)."""
+    import time as _time
+    status, body = _http(
+        args.server, f"/api/Event?namespace={args.namespace}", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
+        return 1
+    rows = sorted(body, key=lambda e: e.get("last_seen", 0.0))
+    if args.involved:
+        rows = [e for e in rows if e.get("involved_name") == args.involved]
+    now = _time.time()
+
+    def age(ts: float) -> str:
+        d = max(0, now - ts)
+        if d < 120:
+            return f"{d:.0f}s"
+        if d < 7200:
+            return f"{d / 60:.0f}m"
+        return f"{d / 3600:.1f}h"
+
+    fmt = "{:<6} {:<8} {:<24} {:<28} {:<5} {}"
+    print(fmt.format("AGE", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE"))
+    for e in rows:
+        print(fmt.format(
+            age(e.get("last_seen", 0.0)), e.get("type", ""),
+            e.get("reason", ""),
+            f"{e.get('involved_kind', '')}/{e.get('involved_name', '')}",
+            e.get("count", 1), e.get("message", "")))
+    return 0
+
+
 def cmd_agent(args: argparse.Namespace) -> int:
     """Per-host node agent against a remote control plane (HTTP)."""
     import os
@@ -463,6 +508,24 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("--server", default=default_server)
     add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
+
+    logs_p = sub.add_parser("logs", help="print a pod's log from a serve "
+                                         "daemon (kubectl logs analog)")
+    logs_p.add_argument("pod")
+    logs_p.add_argument("--namespace", default="default")
+    logs_p.add_argument("--tail", type=int)
+    logs_p.add_argument("--server", default=default_server)
+    add_ca(logs_p)
+    logs_p.set_defaults(fn=cmd_logs)
+
+    events_p = sub.add_parser("events", help="list cluster events "
+                                             "(kubectl get events analog)")
+    events_p.add_argument("--namespace", default="default")
+    events_p.add_argument("--involved", help="filter by involved object "
+                                             "name")
+    events_p.add_argument("--server", default=default_server)
+    add_ca(events_p)
+    events_p.set_defaults(fn=cmd_events)
 
     serve = sub.add_parser("serve", help="run the control plane as a "
                                          "daemon with an HTTP API")

@@ -9,22 +9,65 @@ rebuilds objects + resource-version counter from snapshot+WAL before
 serving its first read. Controllers then reconcile from the loaded
 state exactly as reference controllers do from informer resync.
 
-Format: ``snapshot.json`` = {"rv": N, "objects": [{"kind", "data"}]},
-``wal.jsonl`` = {"op": "put"|"delete", "kind", "data"|("ns","name")}
-per line. Object payloads are the full serde dict (meta+spec+status),
-decoded through the same KIND_REGISTRY the manifest codec uses.
-Appends flush to the OS on every record; fsync durability is not
-attempted (matching the in-memory store's crash model: a torn final
-line is skipped on load).
+Format: ``snapshot.json`` = {"version": V, "rv": N,
+"objects": [{"kind", "data"}]}, ``wal.jsonl`` =
+{"op": "put"|"delete", "kind", "data"|("ns","name")} per line. Object
+payloads are the full serde dict (meta+spec+status), decoded through
+the same KIND_REGISTRY the manifest codec uses. Appends flush to the OS
+on every record; fsync durability is not attempted (matching the
+in-memory store's crash model: a torn final line is skipped on load).
+
+Schema evolution (the reference's self-managed CRD upgrade story,
+proposal 436-crd-upgrader): field ADDITIONS are free — serde's
+from_dict defaults missing fields and ignores unknown ones — but
+renames/restructures need a migration. ``STATE_VERSION`` stamps the
+snapshot; ``MIGRATIONS[v]`` rewrites one (kind, data) pair from version
+v to v+1 (returning None drops the object). A load of older state runs
+the chain and immediately compacts, so the on-disk state is atomically
+at the current version before the first new WAL append — a mixed-
+version WAL can never exist. State from a NEWER build refuses to load
+(downgrades silently corrupting state is the one unrecoverable
+failure).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any
+from typing import Any, Callable, Optional
 
 from grove_tpu.api.serde import from_dict, to_dict
+
+# Current on-disk schema version. Bump when a persisted field is
+# renamed/restructured, and register the rewrite in MIGRATIONS.
+STATE_VERSION = 2
+
+# version v -> fn(kind, data) -> (kind, data) | None (drop object).
+# v1 (round-2 pre-versioning snapshots, no "version" key) is
+# structurally identical to v2 — the migration is the identity; its
+# purpose is pinning the machinery with a real entry.
+MIGRATIONS: dict[int, Callable[[str, dict], Optional[tuple[str, dict]]]] = {
+    1: lambda kind, data: (kind, data),
+}
+
+
+class StateVersionError(RuntimeError):
+    """State on disk was written by a newer build; refuse to load."""
+
+
+def migrate_object(kind: str, data: dict,
+                   from_version: int) -> Optional[tuple[str, dict]]:
+    """Run the migration chain from ``from_version`` to STATE_VERSION."""
+    for v in range(from_version, STATE_VERSION):
+        step = MIGRATIONS.get(v)
+        if step is None:
+            raise StateVersionError(
+                f"no migration registered for state version {v} -> {v + 1}")
+        migrated = step(kind, data)
+        if migrated is None:
+            return None
+        kind, data = migrated
+    return kind, data
 
 
 def _registry() -> dict[str, type]:
@@ -45,13 +88,21 @@ class StatePersister:
     # ---- load ------------------------------------------------------------
 
     def load(self) -> tuple[list[Any], int]:
-        """Return (objects, max_rv) from snapshot + WAL replay."""
+        """Return (objects, max_rv) from snapshot + WAL replay, running
+        schema migrations when the state predates STATE_VERSION (and
+        compacting immediately after, so disk is atomically current)."""
         registry = _registry()
         objects: dict[tuple[str, str, str], Any] = {}
         max_rv = 0
+        version = STATE_VERSION
 
         def put(kind: str, data: dict) -> None:
             nonlocal max_rv
+            if version < STATE_VERSION:
+                migrated = migrate_object(kind, data, version)
+                if migrated is None:
+                    return
+                kind, data = migrated
             cls = registry.get(kind)
             if cls is None:  # kind from a newer build; preserve nothing
                 return
@@ -62,32 +113,78 @@ class StatePersister:
         if os.path.exists(self.snapshot_path):
             with open(self.snapshot_path) as f:
                 snap = json.load(f)
+            version = snap.get("version", 1)
+            if version > STATE_VERSION:
+                raise StateVersionError(
+                    f"state dir {self.state_dir!r} is at schema version "
+                    f"{version}, written by a newer build than this one "
+                    f"(STATE_VERSION={STATE_VERSION}); refusing to load — "
+                    "downgrading would silently corrupt control-plane "
+                    "state")
             max_rv = snap.get("rv", 0)
             for entry in snap.get("objects", []):
                 put(entry["kind"], entry["data"])
+        elif os.path.exists(self.wal_path):
+            # WAL with no snapshot: a pre-versioning layout (v1) UNLESS
+            # the WAL leads with a version header (every WAL this build
+            # writes does — see _append), which is authoritative.
+            version = 1
         if os.path.exists(self.wal_path):
-            with open(self.wal_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        break  # torn tail record: ignore it and stop
-                    if rec["op"] == "put":
-                        put(rec["kind"], rec["data"])
-                    elif rec["op"] == "delete":
-                        objects.pop((rec["kind"], rec["ns"], rec["name"]),
-                                    None)
-                    self._wal_records += 1
-        return list(objects.values()), max_rv
+            with open(self.wal_path, "rb") as f:
+                raw = f.read()
+            good = 0   # byte length of the valid prefix
+            for line in raw.split(b"\n"):
+                if not line.strip():
+                    good += len(line) + 1
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail record: stop (and truncate below)
+                good += len(line) + 1
+                if rec["op"] == "version":
+                    version = rec["v"]
+                    if version > STATE_VERSION:
+                        raise StateVersionError(
+                            f"state dir {self.state_dir!r} WAL is at "
+                            f"schema version {version}, written by a "
+                            f"newer build (STATE_VERSION="
+                            f"{STATE_VERSION}); refusing to load")
+                    continue
+                if rec["op"] == "put":
+                    put(rec["kind"], rec["data"])
+                elif rec["op"] == "delete":
+                    objects.pop((rec["kind"], rec["ns"], rec["name"]),
+                                None)
+                self._wal_records += 1
+            good = min(good, len(raw))
+            if good < len(raw):
+                # Truncate the torn tail NOW: appending after it would
+                # merge two records into one undecodable line, and the
+                # NEXT restart would then discard every record after
+                # the tear.
+                with open(self.wal_path, "r+b") as f:
+                    f.truncate(good)
+        loaded = list(objects.values())
+        if version < STATE_VERSION:
+            # Upgrade completes atomically BEFORE the first new append —
+            # a WAL can then never mix schema versions.
+            self.compact(loaded, max_rv)
+        return loaded, max_rv
 
     # ---- append ----------------------------------------------------------
 
     def _append(self, record: dict) -> None:
         if self._wal_file is None:
+            fresh = (not os.path.exists(self.wal_path)
+                     or os.path.getsize(self.wal_path) == 0)
             self._wal_file = open(self.wal_path, "a")
+            if fresh:
+                # Fresh WAL leads with its schema version: a WAL-only
+                # state dir (no snapshot yet) must still refuse to load
+                # in an older build.
+                self._wal_file.write(json.dumps(
+                    {"op": "version", "v": STATE_VERSION}) + "\n")
         self._wal_file.write(json.dumps(record) + "\n")
         self._wal_file.flush()
         self._wal_records += 1
@@ -110,7 +207,7 @@ class StatePersister:
     def compact(self, objects: list[Any], rv: int) -> None:
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"rv": rv,
+            json.dump({"version": STATE_VERSION, "rv": rv,
                        "objects": [{"kind": o.KIND, "data": to_dict(o)}
                                    for o in objects]}, f)
         os.replace(tmp, self.snapshot_path)

@@ -88,7 +88,8 @@ def test_compaction_truncates_wal(tmp_path):
     s1._persister.compact_every = 20
     for i in range(15):
         s1.create(pcs(f"p{i:02d}"))
-    assert len((d / "wal.jsonl").read_text().splitlines()) == 15
+    # 15 puts + the leading version-header record
+    assert len((d / "wal.jsonl").read_text().splitlines()) == 16
     for i in range(15):
         live = s1.get(PodCliqueSet, f"p{i:02d}")
         live.spec.replicas = 2
@@ -181,3 +182,125 @@ def test_restart_heals_orphaned_processes(tmp_path):
             return pods and all(p.meta.uid != old_uid for p in pods)
         wait_for(healed, timeout=20.0,
                  desc="orphan failed and replacement running")
+
+
+# ---- schema versioning / migrations (CRD-upgrader analog) --------------
+
+def test_v1_state_upgrades_and_compacts_on_load(tmp_path):
+    """Pre-versioning state (no "version" key) loads through the v1
+    migration and the dir is atomically rewritten at STATE_VERSION
+    before any new append."""
+    import json
+    from grove_tpu.store.persist import STATE_VERSION, StatePersister
+
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("mig-a"))
+    s1.create(pcs("mig-b"))
+    # strip the version stamp to simulate a v1 layout
+    s1._persister.compact(
+        [o for objs in s1._objects.values() for o in objs.values()],
+        rv=s1.current_rv())
+    snap = json.load(open(f"{d}/snapshot.json"))
+    del snap["version"]
+    json.dump(snap, open(f"{d}/snapshot.json", "w"))
+
+    s2 = Store(state_dir=d)
+    assert {o.meta.name for o in s2.list(PodCliqueSet)} == {"mig-a", "mig-b"}
+    upgraded = json.load(open(f"{d}/snapshot.json"))
+    assert upgraded["version"] == STATE_VERSION
+    assert open(f"{d}/wal.jsonl").read() == ""  # truncated by compact
+
+    p = StatePersister(d)  # fresh load at current version: no rewrite
+    objs, rv = p.load()
+    assert len(objs) == 2 and rv == s1.current_rv()
+
+
+def test_migration_chain_rewrites_objects(tmp_path, monkeypatch):
+    """A registered migration transforms (or drops) objects on load."""
+    import json
+    from grove_tpu.store import persist
+
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("keepme"))
+    s1.create(pcs("dropme"))
+    s1._persister.compact(
+        [o for objs in s1._objects.values() for o in objs.values()],
+        rv=s1.current_rv())
+    snap = json.load(open(f"{d}/snapshot.json"))
+    snap["version"] = 2  # pretend current is 3 with a 2->3 migration
+
+    def migrate_2_to_3(kind, data):
+        if data["meta"]["name"] == "dropme":
+            return None
+        data["meta"]["labels"]["migrated"] = "yes"
+        return kind, data
+
+    json.dump(snap, open(f"{d}/snapshot.json", "w"))
+    monkeypatch.setattr(persist, "STATE_VERSION", 3)
+    monkeypatch.setitem(persist.MIGRATIONS, 2, migrate_2_to_3)
+
+    s2 = Store(state_dir=d)
+    objs = s2.list(PodCliqueSet)
+    assert [o.meta.name for o in objs] == ["keepme"]
+    assert objs[0].meta.labels["migrated"] == "yes"
+
+
+def test_future_state_version_refuses_to_load(tmp_path):
+    import json
+    import pytest
+    from grove_tpu.store.persist import StateVersionError
+
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("future"))
+    s1._persister.compact(
+        [o for objs in s1._objects.values() for o in objs.values()],
+        rv=s1.current_rv())
+    snap = json.load(open(f"{d}/snapshot.json"))
+    snap["version"] = 99
+    json.dump(snap, open(f"{d}/snapshot.json", "w"))
+    with pytest.raises(StateVersionError, match="newer build"):
+        Store(state_dir=d)
+
+
+def test_wal_only_dir_carries_version_header(tmp_path):
+    """A WAL with no snapshot still refuses to load in an older build:
+    every fresh WAL leads with a version record (the review's rollback-
+    corruption scenario)."""
+    import json
+    from grove_tpu.store import persist
+
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("hdr"))
+    first = open(f"{d}/wal.jsonl").readline()
+    assert json.loads(first) == {"op": "version",
+                                 "v": persist.STATE_VERSION}
+
+    # an "older build" (smaller STATE_VERSION) must refuse this WAL
+    import pytest
+    from unittest import mock
+    with mock.patch.object(persist, "STATE_VERSION",
+                           persist.STATE_VERSION - 1):
+        with pytest.raises(persist.StateVersionError, match="newer"):
+            Store(state_dir=d)
+
+
+def test_torn_wal_tail_truncated_so_appends_stay_parseable(tmp_path):
+    """A torn tail is physically truncated on load; the next append must
+    not merge into the partial record (which would silently drop every
+    subsequent record at the NEXT restart)."""
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("torn-a"))
+    with open(f"{d}/wal.jsonl", "a") as f:
+        f.write('{"op": "put", "kind": "PodCl')  # torn mid-append
+
+    s2 = Store(state_dir=d)                      # load truncates the tear
+    s2.create(pcs("torn-b"))                     # append after the tear
+
+    s3 = Store(state_dir=d)                      # and NOTHING is lost
+    assert {o.meta.name for o in s3.list(PodCliqueSet)} == \
+        {"torn-a", "torn-b"}
