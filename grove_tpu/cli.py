@@ -371,16 +371,16 @@ def cmd_logs(args: argparse.Namespace) -> int:
 
 def cmd_events(args: argparse.Namespace) -> int:
     """List cluster events, newest last (kubectl-get-events analog)."""
-    import time as _time
     status, body = _http(
         args.server, f"/api/Event?namespace={args.namespace}", ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
         return 1
-    rows = sorted(body, key=lambda e: e.get("last_seen", 0.0))
+    events = sorted(body, key=lambda e: e.get("last_seen", 0.0))
     if args.involved:
-        rows = [e for e in rows if e.get("involved_name") == args.involved]
-    now = _time.time()
+        events = [e for e in events
+                  if e.get("involved_name") == args.involved]
+    now = time.time()
 
     def age(ts: float) -> str:
         d = max(0, now - ts)
@@ -390,14 +390,14 @@ def cmd_events(args: argparse.Namespace) -> int:
             return f"{d / 60:.0f}m"
         return f"{d / 3600:.1f}h"
 
-    fmt = "{:<6} {:<8} {:<24} {:<28} {:<5} {}"
-    print(fmt.format("AGE", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE"))
-    for e in rows:
-        print(fmt.format(
+    rows = [("AGE", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
+    for e in events:
+        rows.append((
             age(e.get("last_seen", 0.0)), e.get("type", ""),
             e.get("reason", ""),
             f"{e.get('involved_kind', '')}/{e.get('involved_name', '')}",
-            e.get("count", 1), e.get("message", "")))
+            str(e.get("count", 1)), e.get("message", "")))
+    _table(rows)
     return 0
 
 

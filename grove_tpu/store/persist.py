@@ -45,10 +45,20 @@ STATE_VERSION = 2
 # version v -> fn(kind, data) -> (kind, data) | None (drop object).
 # v1 (round-2 pre-versioning snapshots, no "version" key) is
 # structurally identical to v2 — the migration is the identity; its
-# purpose is pinning the machinery with a real entry.
+# purpose is pinning the machinery with a real entry. NOTE: because
+# v1 ≡ v2, a headerless WAL (which could be either) replays correctly
+# through the v1 chain; any future migration starts at 2, where every
+# WAL carries a version header.
 MIGRATIONS: dict[int, Callable[[str, dict], Optional[tuple[str, dict]]]] = {
     1: lambda kind, data: (kind, data),
 }
+
+# version v -> fn(kind, ns, name) -> (kind, ns, name). Delete records
+# carry only the object KEY; a migration that renames a kind (or
+# re-namespaces objects) must register the key rewrite here or replayed
+# deletes would miss the migrated puts and resurrect deleted objects.
+KEY_MIGRATIONS: dict[
+    int, Callable[[str, str, str], tuple[str, str, str]]] = {}
 
 
 class StateVersionError(RuntimeError):
@@ -68,6 +78,16 @@ def migrate_object(kind: str, data: dict,
             return None
         kind, data = migrated
     return kind, data
+
+
+def migrate_key(kind: str, ns: str, name: str,
+                from_version: int) -> tuple[str, str, str]:
+    """Run the key-migration chain (identity unless registered)."""
+    for v in range(from_version, STATE_VERSION):
+        step = KEY_MIGRATIONS.get(v)
+        if step is not None:
+            kind, ns, name = step(kind, ns, name)
+    return kind, ns, name
 
 
 def _registry() -> dict[str, type]:
@@ -94,9 +114,16 @@ class StatePersister:
         registry = _registry()
         objects: dict[tuple[str, str, str], Any] = {}
         max_rv = 0
-        version = STATE_VERSION
+        snap_version = STATE_VERSION
+        # WAL records are versioned by the WAL'S OWN header, never by
+        # the snapshot: a crash between the upgrade-compact's snapshot
+        # replace and its WAL truncation leaves a current-version
+        # snapshot next to an old WAL — inferring the WAL's version
+        # from the snapshot would replay those records unmigrated.
+        # A headerless non-empty WAL is by construction pre-versioning.
+        wal_version = 1
 
-        def put(kind: str, data: dict) -> None:
+        def put(kind: str, data: dict, version: int) -> None:
             nonlocal max_rv
             if version < STATE_VERSION:
                 migrated = migrate_object(kind, data, version)
@@ -113,22 +140,17 @@ class StatePersister:
         if os.path.exists(self.snapshot_path):
             with open(self.snapshot_path) as f:
                 snap = json.load(f)
-            version = snap.get("version", 1)
-            if version > STATE_VERSION:
+            snap_version = snap.get("version", 1)
+            if snap_version > STATE_VERSION:
                 raise StateVersionError(
                     f"state dir {self.state_dir!r} is at schema version "
-                    f"{version}, written by a newer build than this one "
-                    f"(STATE_VERSION={STATE_VERSION}); refusing to load — "
-                    "downgrading would silently corrupt control-plane "
-                    "state")
+                    f"{snap_version}, written by a newer build than this "
+                    f"one (STATE_VERSION={STATE_VERSION}); refusing to "
+                    "load — downgrading would silently corrupt "
+                    "control-plane state")
             max_rv = snap.get("rv", 0)
             for entry in snap.get("objects", []):
-                put(entry["kind"], entry["data"])
-        elif os.path.exists(self.wal_path):
-            # WAL with no snapshot: a pre-versioning layout (v1) UNLESS
-            # the WAL leads with a version header (every WAL this build
-            # writes does — see _append), which is authoritative.
-            version = 1
+                put(entry["kind"], entry["data"], snap_version)
         if os.path.exists(self.wal_path):
             with open(self.wal_path, "rb") as f:
                 raw = f.read()
@@ -143,18 +165,19 @@ class StatePersister:
                     break  # torn tail record: stop (and truncate below)
                 good += len(line) + 1
                 if rec["op"] == "version":
-                    version = rec["v"]
-                    if version > STATE_VERSION:
+                    wal_version = rec["v"]
+                    if wal_version > STATE_VERSION:
                         raise StateVersionError(
                             f"state dir {self.state_dir!r} WAL is at "
-                            f"schema version {version}, written by a "
+                            f"schema version {wal_version}, written by a "
                             f"newer build (STATE_VERSION="
                             f"{STATE_VERSION}); refusing to load")
                     continue
                 if rec["op"] == "put":
-                    put(rec["kind"], rec["data"])
+                    put(rec["kind"], rec["data"], wal_version)
                 elif rec["op"] == "delete":
-                    objects.pop((rec["kind"], rec["ns"], rec["name"]),
+                    objects.pop(migrate_key(rec["kind"], rec["ns"],
+                                            rec["name"], wal_version),
                                 None)
                 self._wal_records += 1
             good = min(good, len(raw))
@@ -166,7 +189,8 @@ class StatePersister:
                 with open(self.wal_path, "r+b") as f:
                     f.truncate(good)
         loaded = list(objects.values())
-        if version < STATE_VERSION:
+        if snap_version < STATE_VERSION or (
+                self._wal_records and wal_version < STATE_VERSION):
             # Upgrade completes atomically BEFORE the first new append —
             # a WAL can then never mix schema versions.
             self.compact(loaded, max_rv)

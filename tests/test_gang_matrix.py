@@ -340,3 +340,54 @@ def test_gs10_scale_in_releases_capacity_for_pending_gang():
         wait_for(lambda: len(bound(cl, "late")) == 2, timeout=10.0,
                  desc="late placed after scale-in freed capacity")
         assert len(bound(cl, "big")) == 4  # base + sg-0 untouched
+
+
+def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
+    """GS8 (gang_scheduling_test.go:584): the PCSG is scaled UP while
+    every pod is still pending on a fully cordoned fleet; capacity then
+    releases in stages and pods come up in gang increments — base gang
+    first, then one scaled gang per freed slice, atomically, never a
+    partial bind."""
+    cl = make_cluster(4)
+    with cl:
+        set_cordon(cl, slice_nodes(cl, 0, 1, 2, 3), True)
+        cl.client.create(wl("gs8", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(pods_of(cl, "gs8")) == 4,
+                 desc="4 pods created, all pending")
+        time.sleep(0.3)
+        assert not bound(cl, "gs8")
+
+        # scale the PCSG 1 -> 3 while everything is pending
+        live = cl.client.get(PodCliqueSet, "gs8")
+        live.spec.template.scaling_groups[0].replicas = 3
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "gs8")) == 8,
+                 desc="scale-out adds 4 more pending pods")
+        time.sleep(0.3)
+        assert not bound(cl, "gs8")
+        assert_no_partial_binds(cl, "gs8")
+
+        # stage 1: two slices -> exactly the base gang (a + x-0)
+        set_cordon(cl, slice_nodes(cl, 0, 1), False)
+        wait_for(lambda: len(bound(cl, "gs8")) == 4,
+                 desc="base gang binds first")
+        time.sleep(0.3)
+        assert len(bound(cl, "gs8")) == 4
+        assert gang_scheduled(cl, "gs8-0")
+        assert_no_partial_binds(cl, "gs8")
+
+        # stage 2: one more slice -> exactly ONE scaled gang
+        set_cordon(cl, slice_nodes(cl, 2), False)
+        wait_for(lambda: len(bound(cl, "gs8")) == 6,
+                 desc="one scaled gang admitted")
+        time.sleep(0.3)
+        assert len(bound(cl, "gs8")) == 6
+        assert_no_partial_binds(cl, "gs8")
+
+        # stage 3: last slice -> everything placed
+        set_cordon(cl, slice_nodes(cl, 3), False)
+        wait_for(lambda: len(bound(cl, "gs8")) == 8,
+                 desc="final scaled gang admitted")
+        assert_no_partial_binds(cl, "gs8")
+        assert gang_scheduled(cl, "gs8-0-x-1")
+        assert gang_scheduled(cl, "gs8-0-x-2")

@@ -304,3 +304,29 @@ def test_torn_wal_tail_truncated_so_appends_stay_parseable(tmp_path):
     s3 = Store(state_dir=d)                      # and NOTHING is lost
     assert {o.meta.name for o in s3.list(PodCliqueSet)} == \
         {"torn-a", "torn-b"}
+
+
+def test_delete_records_follow_key_migrations(tmp_path, monkeypatch):
+    """A kind-renaming migration must rewrite delete-record KEYS too, or
+    replayed deletes miss the migrated puts and resurrect objects."""
+    import json
+    from grove_tpu.store import persist
+
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("ghost"))
+    s1.delete(PodCliqueSet, "ghost")
+    del s1  # WAL: header, put ghost, (finalizer update), delete ghost
+
+    # pretend current is 3 and migration 2->3 renames the kind
+    monkeypatch.setattr(persist, "STATE_VERSION", 3)
+    monkeypatch.setitem(
+        persist.MIGRATIONS, 2,
+        lambda kind, data: ("PodCliqueSet", data))  # same shape
+    monkeypatch.setitem(
+        persist.KEY_MIGRATIONS, 2,
+        lambda kind, ns, name: ("PodCliqueSet", ns, name))
+
+    s2 = Store(state_dir=d)
+    assert s2.list(PodCliqueSet) == [], \
+        "deleted object resurrected across migration"
