@@ -255,6 +255,22 @@ def run_bench() -> dict:
     assert DECODE_STEPS % block == 0, (DECODE_STEPS, block)
     logits, cache = prefill(params, prompt, lengths, cache)       # compiles
     tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    np.asarray(tokens)
+    # Prefill visibility (stderr only; decode stays the headline):
+    # best-of-2 full-batch prefills through the active attention impl,
+    # cache allocation hoisted out of the timed window.
+    pf_cache = KVCache.create(cfg.n_layers, BATCH, max_len,
+                              cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+    pf_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        pf_logits, _ = prefill(params, prompt, lengths, pf_cache)
+        np.asarray(jnp.argmax(pf_logits[:1, :2], axis=-1))
+        pf_dt = min(pf_dt, time.perf_counter() - t0)
+    del pf_cache
+    log(f"prefill: {BATCH * prompt_len / pf_dt:.0f} tok/s/chip "
+        f"({attn_impl}, batch={BATCH} x prompt={prompt_len} "
+        f"in {pf_dt * 1e3:.1f} ms)")
     tokens, cache, _ = step_block(params, tokens, cache)          # compiles
     np.asarray(tokens)  # warmup sync
 

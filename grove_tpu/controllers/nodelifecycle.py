@@ -45,6 +45,7 @@ class NodeLifecycleController:
         self.recorder = EventRecorder(client, "node-lifecycle")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._known_nodes: set[tuple[str, str]] | None = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run,
@@ -66,12 +67,63 @@ class NodeLifecycleController:
 
     def _pass(self) -> None:
         now = time.time()
-        for node in self.client.list(Node, self.namespace):
+        nodes = self.client.list(Node, self.namespace)
+        for node in nodes:
             if node.spec.fake or node.status.heartbeat_time <= 0:
                 continue
             stale = now - node.status.heartbeat_time > self.grace_seconds
             if stale and node.status.ready:
                 self._mark_lost(node, now)
+        known = {(n.meta.namespace, n.meta.name) for n in nodes}
+        # Sweep for orphans only when the node set SHRANK (or on the
+        # first pass after start — deletions may predate us): a steady
+        # fleet must not pay an O(pods) list every second.
+        if self._known_nodes is None or not known >= self._known_nodes:
+            self._fail_orphans_of_deleted_nodes(known)
+        self._known_nodes = known
+
+    def _fail_orphans_of_deleted_nodes(
+            self, known: set[tuple[str, str]]) -> None:
+        """A pod whose node OBJECT is gone (fleet shrink, operator
+        delete) can never run or report again — fail it so self-heal
+        reschedules (kube's node controller evicts pods of deleted
+        nodes the same way). Applies to fake nodes too: node-object
+        deletion is unambiguous, unlike a missed heartbeat."""
+        for pod in self.client.list(Pod, self.namespace):
+            if not pod.status.node_name \
+                    or pod.status.phase not in (PodPhase.PENDING,
+                                                PodPhase.RUNNING):
+                continue
+            if (pod.meta.namespace, pod.status.node_name) in known:
+                continue
+            try:
+                # Node re-check closes the register-then-bind race: a
+                # node created after our node list (and a pod bound to
+                # it) is alive, not orphaned.
+                try:
+                    self.client.get(Node, pod.status.node_name,
+                                    pod.meta.namespace)
+                    continue
+                except NotFoundError:
+                    pass
+                live = self.client.get(Pod, pod.meta.name,
+                                       pod.meta.namespace)
+                if live.meta.uid != pod.meta.uid \
+                        or live.status.node_name != pod.status.node_name:
+                    continue
+                live.status.phase = PodPhase.FAILED
+                live.status.message = \
+                    f"node {pod.status.node_name} deleted"
+                live.status.conditions = set_condition(
+                    live.status.conditions,
+                    Condition(type=c.COND_READY, status="False",
+                              reason="NodeDeleted"))
+                self.client.update_status(live)
+                self.log.warning("pod %s/%s: node %s deleted; failing "
+                                 "for self-heal", pod.meta.namespace,
+                                 pod.meta.name, pod.status.node_name)
+            except (NotFoundError, GroveError):
+                continue
 
     def _mark_lost(self, node: Node, now: float) -> None:
         age = now - node.status.heartbeat_time

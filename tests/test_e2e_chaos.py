@@ -1,0 +1,179 @@
+"""Cross-subsystem chaos e2e: reservations x slice loss x autoscaling x
+gang atomicity in ONE flow. Each subsystem has its own suite; this test
+exercises their interplay — a healed reservation must re-fence
+self-healed pods, autoscaled instances must respect fences and gang
+atomicity under churn, and scale-in must return capacity cleanly."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    PodGang,
+    SliceReservation,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    TopologyConstraint,
+)
+from grove_tpu.api.reservation import ReservationPhase, ReservationTemplate
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
+
+from test_e2e_simple import wait_for
+
+SLICE = TopologyConstraint(pack_level="slice", required=True)
+POOL = TopologyConstraint(pack_level="pool", required=True)
+
+
+@pytest.fixture
+def cluster():
+    from grove_tpu.api.config import OperatorConfiguration
+    cfg = OperatorConfiguration()
+    cfg.autoscaler.scale_down_stabilization_seconds = 1.0
+    # 7 slices x 1 host (2x2 = one 4-chip host each): every clique
+    # instance is exactly one slice, so capacity math is exact.
+    cl = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x2", count=7)]))
+    with cl:
+        yield cl
+
+
+def _pcs():
+    return PodCliqueSet(
+        meta=new_meta("chaos"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            topology=POOL,
+            reservations=[ReservationTemplate(
+                name="pf", slice_count=1, clique_names=["prefill"])],
+            cliques=[
+                PodCliqueTemplate(name="prefill", replicas=1,
+                                  min_available=1, tpu_chips_per_pod=4,
+                                  topology=SLICE,
+                                  container=ContainerSpec(argv=["x"])),
+                PodCliqueTemplate(name="decode", replicas=1,
+                                  min_available=1, tpu_chips_per_pod=4,
+                                  topology=SLICE,
+                                  container=ContainerSpec(argv=["x"])),
+            ],
+            scaling_groups=[ScalingGroupConfig(
+                name="inst", clique_names=["decode"], replicas=1,
+                min_available=1,
+                auto_scaling=AutoScalingConfig(
+                    min_replicas=1, max_replicas=3,
+                    metric="queue_depth", target_value=10.0))],
+        )))
+
+
+def _ready(client):
+    return [p for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "chaos"})
+            if is_condition_true(p.status.conditions, c.COND_READY)]
+
+
+def _slices_of(client, role):
+    nodes = {n.meta.name: n for n in client.list(Node)}
+    return {nodes[p.status.node_name].meta.labels[c.NODE_LABEL_SLICE]
+            for p in client.list(Pod, selector={
+                c.LABEL_PCS_NAME: "chaos", c.LABEL_PCLQ_ROLE: role})
+            if p.status.node_name and p.status.node_name in nodes}
+
+
+def _held(client):
+    rsv = client.get(SliceReservation, "chaos-pf-rsv")
+    return rsv, set(rsv.status.bound_slices)
+
+
+def _assert_fences(client):
+    _, held = _held(client)
+    assert _slices_of(client, "prefill") <= held, "prefill escaped fence"
+    assert _slices_of(client, "decode").isdisjoint(held), \
+        "decode squatting reserved capacity"
+
+
+def _no_partial_binds(client):
+    by_gang: dict[str, list[bool]] = {}
+    for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "chaos"}):
+        g = p.meta.labels.get(c.LABEL_PODGANG_NAME, "?")
+        by_gang.setdefault(g, []).append(bool(p.status.node_name))
+    for g, states in by_gang.items():
+        assert all(states) or not any(states), \
+            f"gang {g} partially bound: {states}"
+
+
+def test_chaos_reservation_heal_under_autoscale(cluster):
+    client = cluster.client
+    client.create(_pcs())
+    wait_for(lambda: len(_ready(client)) == 2, desc="base up (2 pods)")
+    _assert_fences(client)
+    rsv, held_before = _held(client)
+    assert rsv.status.phase == ReservationPhase.BOUND
+
+    # --- chaos 1: scale decode out to 3 instances under load ---------
+    cluster.metrics.set("PodCliqueScalingGroup", "chaos-0-inst",
+                        "queue_depth", 25.0)
+    wait_for(lambda: len(_ready(client)) == 4, timeout=15.0,
+             desc="3 decode instances + prefill")
+    _assert_fences(client)
+    _no_partial_binds(client)
+
+    # --- chaos 2: kill the reserved slice's node -----------------------
+    lost = next(iter(held_before))
+    lost_nodes = [n for n in client.list(Node)
+                  if n.meta.labels.get(c.NODE_LABEL_SLICE) == lost]
+    for n in lost_nodes:
+        client.delete(Node, n.meta.name)
+
+    def healed():
+        r = client.get(SliceReservation, "chaos-pf-rsv")
+        if r.status.phase != ReservationPhase.BOUND \
+                or set(r.status.bound_slices) == held_before:
+            return False
+        # prefill self-healed INTO the new fence: non-vacuous — the pod
+        # must be bound to a LIVE node inside the new pool (a pod still
+        # referencing the deleted node resolves to an empty slice set,
+        # which must not pass)
+        placed = _slices_of(client, "prefill")
+        return bool(placed) and placed <= set(r.status.bound_slices)
+    wait_for(healed, timeout=20.0,
+             desc="reservation healed and prefill re-fenced")
+    _assert_fences(client)
+    _no_partial_binds(client)
+
+    # the lost slice's node returns (host repaired) — it must NOT carry
+    # a stale reservation label once the sweep runs
+    for n in lost_nodes:
+        fresh = build_node("v5e", "2x2", lost,
+                           int(n.meta.labels[c.NODE_LABEL_SLICE_WORKER]))
+        client.create(fresh)
+    time.sleep(0.5)
+    assert all(not n.meta.labels.get(c.LABEL_RESERVATION)
+               for n in client.list(Node)
+               if n.meta.labels.get(c.NODE_LABEL_SLICE) == lost)
+
+    # --- chaos 3: load drops, instances scale back in ------------------
+    cluster.metrics.set("PodCliqueScalingGroup", "chaos-0-inst",
+                        "queue_depth", 1.0)
+    wait_for(lambda: len(_ready(client)) == 2, timeout=20.0,
+             desc="scaled back to base")
+    wait_for(lambda: {g.meta.name for g in client.list(
+        PodGang, selector={c.LABEL_PCS_NAME: "chaos"})} == {"chaos-0"},
+        desc="scaled gangs pruned")
+    _assert_fences(client)
+
+    # steady state: everything consistent after the full chaos sequence
+    rsv, held_after = _held(client)
+    assert rsv.status.phase == ReservationPhase.BOUND
+    assert len(held_after) == 1 and held_after != held_before
