@@ -6,8 +6,19 @@ JAX_PLATFORMS=axon → one v5e chip). Prints ONE JSON line:
    "mfu": ..., "hbm_util": ..., ...}
 On hard failure it still prints a parseable JSON line with an "error"
 field (round-1 regression: a dead relay produced rc=1 and no line at
-all), after retrying backend init with bounded backoff — relay flaps
-are a known transient failure mode of the tunnelled backend.
+all). The tunnelled backend is a known-flaky dependency and its flaps
+are NOT confined to init (round 2: init succeeded, parity passed, then
+``init_params`` died UNAVAILABLE and the round's perf artifact was
+forfeit) — so the WHOLE benchmark is wrapped in a bounded outer retry:
+a supervisor process spawns each attempt as a CHILD with a watchdog
+timeout (a relay that HANGS in backend init — observed in round 3:
+``jax.devices()`` blocked >15 min without erroring — cannot be
+interrupted from inside the process; killing the child is the only
+reliable reset), a cheap relay smoke probe (tiny matmul + host fetch)
+gates each attempt before the expensive phases, and the failure JSON
+carries whatever partial results the furthest attempt reached (phase,
+parity, prefill, bare-loop numbers — checkpointed to a file so even a
+SIGKILLed attempt leaves evidence on the board).
 
 The reference (ai-dynamo/grove) publishes no benchmark numbers
 (BASELINE.md); its north star for this repo is serving throughput ≥ 90%
@@ -59,6 +70,18 @@ PEAK_HBM_BW = float(os.environ.get("GROVE_PEAK_HBM_BW", 819e9))  # bytes/s
 
 INIT_RETRIES = 3
 INIT_RETRY_DELAY_S = 30.0
+# Whole-run attempts: a relay flap ANYWHERE in the ~90s of bench work
+# restarts the run from device init (round 2's failure arrived after
+# init, inside init_params — init-only retry was predictable
+# under-coverage).
+RUN_ATTEMPTS = int(os.environ.get("GROVE_BENCH_ATTEMPTS", 3))
+RUN_RETRY_DELAY_S = float(os.environ.get("GROVE_BENCH_RETRY_DELAY", 30.0))
+# Watchdog per attempt: generous vs the ~3-4 min a healthy run takes,
+# small vs forfeiting the round to a hung relay.
+ATTEMPT_TIMEOUT_S = float(os.environ.get("GROVE_BENCH_ATTEMPT_TIMEOUT", 600))
+# Set in the child's env by the supervisor; the child runs ONE attempt.
+_CHILD_ENV = "GROVE_BENCH_CHILD"
+_PARTIAL_ENV = "GROVE_BENCH_PARTIAL_FILE"
 
 
 def log(msg: str) -> None:
@@ -84,6 +107,29 @@ def init_devices() -> list:
             # retry works because xla_bridge.backends() does not cache a
             # loud init failure — the next devices() call re-attempts.
     raise last
+
+
+def checkpoint_partial(partial: dict) -> None:
+    """Persist the attempt's partial results where the supervisor can
+    read them even if this process is killed by the hang watchdog."""
+    path = os.environ.get(_PARTIAL_ENV)
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            f.write(json.dumps(partial))
+    except OSError:
+        pass
+
+
+def smoke_probe() -> None:
+    """Cheap relay liveness gate: one tiny matmul compiled and fetched to
+    host. Costs <1s warm; if the relay is down or half-up this fails in
+    seconds instead of forfeiting minutes of bench work mid-phase."""
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    got = float(np.asarray((x @ x)[0, 0]))
+    assert got == 256.0, f"smoke probe wrong result: {got}"
+    log("relay smoke probe ok")
 
 
 def decode_flops_per_token(cfg, ctx: int) -> float:
@@ -135,13 +181,26 @@ def time_loop(run_steps) -> float:
     return BATCH * DECODE_STEPS / best
 
 
-def check_flash_parity(cfg, prompt_len: int = PROMPT_LEN) -> None:
+def check_flash_parity(cfg, prompt_len: int = PROMPT_LEN) -> float | None:
     """When the pallas flash kernel is the active prefill attention, assert
-    it matches the XLA formulation on this backend before timing anything."""
+    it matches the XLA formulation on this backend before timing anything.
+
+    Error model for the tolerance (VERDICT r2 weak-7 asked for one): the
+    attention output is a convex combination of V rows, so |o| ≤ max|v|.
+    The two paths agree in exact arithmetic; they differ by (a) the XLA
+    path rounding each softmax weight to bf16 before the PV matmul
+    (``probs.astype(v.dtype)``, attention.py) — the weighted sum of those
+    roundings is bounded by eps_bf16 · Σpₛ|vₛ| ≤ eps_bf16 · max|v| — and
+    (b) one bf16 rounding of the final output, another eps_bf16 · max|v|.
+    Hence tol = 2 · eps_bf16 · max|v| with eps_bf16 = 2⁻⁸; for this
+    test's N(0,1) values (max|v| ≈ 4.2 over 131k samples) that is
+    ≈ 3.3e-2 — the old hard-coded 3e-2 was the right magnitude but
+    unexplained; now it is derived from the data actually drawn.
+    """
     from grove_tpu.ops.attention import causal_attention, pick_causal_attention
     flash = pick_causal_attention(prompt_len, cfg.head_dim)
     if flash is None:
-        return
+        return None
     key = jax.random.PRNGKey(7)
     kq, kk, kv = jax.random.split(key, 3)
     shape_q = (2, prompt_len, cfg.n_heads, cfg.head_dim)
@@ -152,8 +211,12 @@ def check_flash_parity(cfg, prompt_len: int = PROMPT_LEN) -> None:
     got = np.asarray(jax.jit(flash)(q, k, v), np.float32)
     want = np.asarray(jax.jit(causal_attention)(q, k, v), np.float32)
     diff = float(np.max(np.abs(got - want)))
-    log(f"flash parity vs XLA: max|Δ|={diff:.2e}")
-    assert diff < 3e-2, f"flash kernel diverges from XLA path: {diff}"
+    eps_bf16 = 2.0 ** -8
+    tol = 2.0 * eps_bf16 * float(np.max(np.abs(np.asarray(v, np.float32))))
+    log(f"flash parity vs XLA: max|Δ|={diff:.2e} (tol {tol:.2e} = "
+        f"2·eps_bf16·max|v|)")
+    assert diff < tol, f"flash kernel diverges from XLA path: {diff} ≥ {tol}"
+    return diff
 
 
 def calibrate_roofline() -> tuple[float, float]:
@@ -202,7 +265,23 @@ def calibrate_roofline() -> tuple[float, float]:
     return bw, tf
 
 
-def run_bench() -> dict:
+def prefill_flops_per_token(cfg, prompt_len: int) -> float:
+    """Model FLOPs per prompt token: weight matmuls plus causal attention
+    at the average context (prompt_len / 2)."""
+    c = cfg
+    w_matmul = (c.n_layers * (c.d_model * c.n_heads * c.head_dim
+                              + 2 * c.d_model * c.n_kv_heads * c.head_dim
+                              + c.n_heads * c.head_dim * c.d_model
+                              + 3 * c.d_model * c.d_ff)
+                + c.d_model * c.vocab_size)
+    attn = 4 * (prompt_len / 2) * c.n_layers * c.n_heads * c.head_dim
+    return 2.0 * w_matmul + attn
+
+
+def run_bench(partial: dict) -> dict:
+    """One full bench attempt. ``partial`` is updated in place as phases
+    complete, so an attempt killed by a relay flap still leaves its
+    furthest results for the failure JSON."""
     from grove_tpu.models import llama
     from grove_tpu.ops.attention import active_prefill_attention
     from grove_tpu.ops.kvcache import KVCache
@@ -219,12 +298,19 @@ def run_bench() -> dict:
     budget = min((TIMED_ITERS + 3) * DECODE_STEPS,
                  max_len - prompt_len - 1)
     dev = init_devices()[0]
+    partial["phase"] = "init"
+    checkpoint_partial(partial)
+    smoke_probe()
     attn_impl = active_prefill_attention(prompt_len, cfg.head_dim)
     log(f"bench device: {dev.platform} {dev.device_kind}; "
         f"model {model} ({cfg.params_bytes / 1e9:.2f} GB bf16), "
         f"batch={BATCH} prompt={prompt_len} steps={DECODE_STEPS} "
         f"cache_len={max_len}; prefill attention: {attn_impl}")
-    check_flash_parity(cfg, prompt_len)
+    diff = check_flash_parity(cfg, prompt_len)
+    if diff is not None:
+        partial["flash_parity_maxdiff"] = round(diff, 6)
+    partial["phase"] = "parity-done"
+    checkpoint_partial(partial)
 
     # Serving posture: weight-only int8 (the TPU serving default; quality
     # guarded by tests/test_quant.py). GROVE_BENCH_QUANT=bf16 disables.
@@ -256,19 +342,31 @@ def run_bench() -> dict:
     logits, cache = prefill(params, prompt, lengths, cache)       # compiles
     tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     np.asarray(tokens)
-    # Prefill visibility (stderr only; decode stays the headline):
-    # best-of-2 full-batch prefills through the active attention impl,
-    # cache allocation hoisted out of the timed window.
+    # Prefill timing (promoted into the official JSON this round):
+    # best-of-2 full-batch prefills through the active attention impl.
+    # The prefill executable DONATES its cache argument, so each timed
+    # call feeds the previous call's returned cache back in (every entry
+    # in [0, prompt_len) is rewritten, so reuse is exact) — allocation
+    # stays out of the timed window without reusing a dead buffer.
     pf_cache = KVCache.create(cfg.n_layers, BATCH, max_len,
                               cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
     pf_dt = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        pf_logits, _ = prefill(params, prompt, lengths, pf_cache)
+        pf_logits, pf_cache = prefill(params, prompt, lengths, pf_cache)
         np.asarray(jnp.argmax(pf_logits[:1, :2], axis=-1))
         pf_dt = min(pf_dt, time.perf_counter() - t0)
     del pf_cache
-    log(f"prefill: {BATCH * prompt_len / pf_dt:.0f} tok/s/chip "
+    pf_tok_s = BATCH * prompt_len / pf_dt
+    # Prefill roofline: compute-bound (every prompt token hits the MXU),
+    # so MFU is the number to watch — promoted into the official JSON
+    # this round (r2 logged it to stderr only).
+    pf_mfu = pf_tok_s * prefill_flops_per_token(cfg, prompt_len) / PEAK_FLOPS
+    partial["prefill_tok_s"] = round(pf_tok_s, 1)
+    partial["prefill_mfu"] = round(pf_mfu, 4)
+    partial["phase"] = "prefill-done"
+    checkpoint_partial(partial)
+    log(f"prefill: {pf_tok_s:.0f} tok/s/chip, MFU={pf_mfu * 100:.1f}% "
         f"({attn_impl}, batch={BATCH} x prompt={prompt_len} "
         f"in {pf_dt * 1e3:.1f} ms)")
     tokens, cache, _ = step_block(params, tokens, cache)          # compiles
@@ -284,6 +382,9 @@ def run_bench() -> dict:
         state["tokens"], state["cache"] = t, kv
 
     bare = time_loop(bare_steps)
+    partial["bare_tok_s"] = round(bare, 1)
+    partial["phase"] = "bare-done"
+    checkpoint_partial(partial)
     log(f"bare-metal decode: {bare:.1f} tok/s/chip "
         f"(block dispatch, {block} steps/dispatch)")
 
@@ -298,6 +399,9 @@ def run_bench() -> dict:
         eng.run(DECODE_STEPS)
 
     fw = time_loop(engine_steps)
+    partial["value"] = round(fw, 1)
+    partial["phase"] = "decode-done"
+    checkpoint_partial(partial)
     log(f"framework decode: {fw:.1f} tok/s/chip")
 
     # Roofline placement: FLOPs at the mid-window live context, HBM at
@@ -329,6 +433,9 @@ def run_bench() -> dict:
         "mfu": round(mfu, 4),
         "hbm_util": round(hbm, 4),
         "achieved_gbps": round(achieved_gbps, 1),
+        "prefill_tok_s": partial["prefill_tok_s"],
+        "prefill_mfu": partial["prefill_mfu"],
+        "flash_parity_maxdiff": partial.get("flash_parity_maxdiff"),
         "probe_copy_gbps": round(meas_bw / 1e9, 1),
         "probe_matmul_tflops": round(meas_tf / 1e12, 1),
         "attention": attn_impl,
@@ -337,22 +444,131 @@ def run_bench() -> dict:
     }
 
 
-def main() -> None:
+def append_history(record: dict) -> None:
+    """Append the run to bench-history/history.jsonl (the committed perf
+    record, mirroring scale-history/): git label + timestamp + knobs, so
+    the repo carries in-tree perf evidence even when the driver's capture
+    window hits a relay flap. GROVE_BENCH_HISTORY=0 disables."""
+    if os.environ.get("GROVE_BENCH_HISTORY", "1") == "0":
+        return
+    import subprocess
+    from datetime import datetime, timezone
+
+    here = os.path.dirname(os.path.abspath(__file__))
     try:
-        result = run_bench()
+        git = subprocess.run(
+            ["git", "-C", here, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        git = "unknown"
+    row = {"ts": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+           "git": git or "unknown", **record}
+    path = os.path.join(here, "bench-history")
+    try:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "history.jsonl"), "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError as e:
+        log(f"history append failed (non-fatal): {e}")
+
+
+def _metric_name() -> str:
+    model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
+    return f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip"
+
+
+def child_main() -> None:
+    """One attempt: run the bench, print the result JSON (success or
+    failure-with-partials) on stdout. The supervisor owns retries."""
+    partial: dict = {}
+    try:
+        result = run_bench(partial)
     except Exception as e:  # noqa: BLE001 — emit a parseable failure line
         import traceback
         traceback.print_exc(file=sys.stderr)
-        model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
         print(json.dumps({
-            "metric": f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip",
+            "metric": _metric_name(),
             "value": 0.0,
             "unit": "tok/s/chip",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
+            **{k: v for k, v in partial.items() if k != "value"},
         }))
         sys.exit(1)
     print(json.dumps(result))
+
+
+def supervisor_main() -> None:
+    """Spawn child attempts under a watchdog; forward the final JSON.
+
+    The child inherits stderr (the driver's log tail stays live) and its
+    stdout's last line is the result JSON. A child that exceeds the
+    watchdog is killed and retried — its checkpointed partials file
+    stands in for the JSON it never printed."""
+    import subprocess
+    import tempfile
+
+    last_failure: dict | None = None
+    for attempt in range(1, RUN_ATTEMPTS + 1):
+        with tempfile.NamedTemporaryFile("r", suffix=".json") as pf:
+            env = dict(os.environ, **{_CHILD_ENV: "1", _PARTIAL_ENV: pf.name})
+            proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                    env=env, stdout=subprocess.PIPE, text=True)
+            try:
+                out, _ = proc.communicate(timeout=ATTEMPT_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+                log(f"bench attempt {attempt}/{RUN_ATTEMPTS} exceeded the "
+                    f"{ATTEMPT_TIMEOUT_S:.0f}s watchdog (hung relay); killed")
+                partial = {}
+                try:
+                    pf.seek(0)
+                    partial = json.loads(pf.read() or "{}")
+                except ValueError:
+                    pass
+                last_failure = {
+                    "metric": _metric_name(), "value": 0.0,
+                    "unit": "tok/s/chip", "vs_baseline": 0.0,
+                    "error": f"attempt hung >{ATTEMPT_TIMEOUT_S:.0f}s in "
+                             f"phase {partial.get('phase', 'pre-init')!r}",
+                    **{k: v for k, v in partial.items() if k != "value"},
+                }
+            else:
+                line = (out or "").strip().splitlines()
+                parsed = None
+                if line:
+                    try:
+                        parsed = json.loads(line[-1])
+                    except ValueError:
+                        pass
+                if proc.returncode == 0 and parsed is not None:
+                    append_history(parsed)
+                    print(json.dumps(parsed))
+                    return
+                last_failure = parsed or {
+                    "metric": _metric_name(), "value": 0.0,
+                    "unit": "tok/s/chip", "vs_baseline": 0.0,
+                    "error": f"child exited rc={proc.returncode} with no "
+                             "result line",
+                }
+                log(f"bench attempt {attempt}/{RUN_ATTEMPTS} failed in "
+                    f"phase {last_failure.get('phase', 'pre-init')!r}: "
+                    f"{last_failure.get('error')}")
+        if attempt < RUN_ATTEMPTS:
+            log(f"retrying in {RUN_RETRY_DELAY_S:.0f}s")
+            time.sleep(RUN_RETRY_DELAY_S)
+    failure = dict(last_failure or {}, attempts=RUN_ATTEMPTS)
+    append_history(failure)
+    print(json.dumps(failure))
+    sys.exit(1)
+
+
+def main() -> None:
+    if os.environ.get(_CHILD_ENV):
+        child_main()
+    else:
+        supervisor_main()
 
 
 if __name__ == "__main__":

@@ -112,16 +112,21 @@ def _build_cluster(args: argparse.Namespace):
             config = OperatorConfiguration()
         config.server_auth.tokens.update(load_token_file(token_file))
     state_dir = getattr(args, "state_dir", None)
+    takeover = bool(getattr(args, "takeover", False))
+    if takeover and state_dir:
+        print(f"standing by for state-dir lease {state_dir!r} "
+              "(takes over when the current holder exits)",
+              file=sys.stderr, flush=True)
     fleet = parse_fleet(args.fleet)
     if args.real:
         fleet.fake = False
         cluster = new_cluster(config=config, fleet=fleet, fake_kubelet=False,
-                              state_dir=state_dir)
+                              state_dir=state_dir, state_takeover=takeover)
         from grove_tpu.agent.process import ProcessKubelet
         cluster.manager.add_runnable(ProcessKubelet(cluster.client))
     else:
         cluster = new_cluster(config=config, fleet=fleet,
-                              state_dir=state_dir)
+                              state_dir=state_dir, state_takeover=takeover)
     return cluster
 
 
@@ -552,6 +557,11 @@ def main(argv: list[str] | None = None) -> int:
     serve.add_argument("--state-dir", dest="state_dir",
                        help="durable control-plane state (WAL+snapshot); "
                             "restart resumes every resource")
+    serve.add_argument("--takeover", action="store_true",
+                       help="when --state-dir is locked by another serve, "
+                            "wait as a standby and take over when the "
+                            "holder exits (leader-election analog); "
+                            "default is to refuse immediately")
     serve.set_defaults(fn=cmd_serve)
 
     agent_p = sub.add_parser(

@@ -49,14 +49,20 @@ def new_cluster(config: OperatorConfiguration | None = None,
                 store: Store | None = None,
                 fake_kubelet: bool = True,
                 admission: bool = True,
-                state_dir: str | None = None) -> Cluster:
+                state_dir: str | None = None,
+                state_takeover: bool = False) -> Cluster:
     """``state_dir`` enables durable control-plane state (WAL + snapshot,
     store/persist.py): a restarted cluster pointed at the same directory
     resumes with every resource intact and reconciles from there —
     restart is free, as with the reference's etcd. ``create_fleet`` is
-    idempotent, so passing the same ``fleet`` on reboot is safe."""
+    idempotent, so passing the same ``fleet`` on reboot is safe.
+
+    The state dir is single-writer (flock; the leader-election analog,
+    reference manager.go:55-147): a second cluster on the same dir
+    raises ``StateLockError``, or with ``state_takeover=True`` blocks as
+    a standby until the holder exits, then loads and takes over."""
     if store is None and state_dir is not None:
-        store = Store(state_dir=state_dir)
+        store = Store(state_dir=state_dir, takeover_wait=state_takeover)
     mgr = Manager(config=config, store=store)
     registry = register_controllers(mgr)
     # Configuring API tokens implies wanting their identities enforced —

@@ -75,7 +75,13 @@ def mesh_axes_for(n_devices: int, *, want_sp: bool = True,
     Heuristic: give ``tp`` the largest power-of-two divisor up to ``max_tp``
     (tensor parallelism wants the fastest links and benefits most from being
     wide), then one factor of 2 to ``sp`` when available (ring attention needs
-    ≥2 to exercise the ring), and the remainder to ``dp``.
+    ≥2 to exercise the ring), and the remainder to ``dp`` — then rebalance
+    one factor of 2 from ``tp`` back to ``dp`` when that is the only way to
+    get dp ≥ 2: a flagship plan whose every axis is > 1 exercises dp grad
+    sync, ring-SP, and tp psums in ONE train step (at 8 devices this yields
+    (dp=2, sp=2, tp=2), not (1, 2, 4)), and dp is the axis that scales
+    across slices over DCN, so a plan without it under-represents the
+    deployment shape.
     """
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
@@ -85,6 +91,12 @@ def mesh_axes_for(n_devices: int, *, want_sp: bool = True,
     if want_sp and rest % 2 == 0 and rest >= 2:
         sp = 2
     dp = rest // sp
+    while dp == 1 and tp > 2:
+        tp //= 2
+        dp *= 2
+    while sp == 1 and want_sp and tp > 2:
+        tp //= 2
+        sp *= 2
     plan = MeshPlan(dp=dp, sp=sp, tp=tp)
     assert plan.size == n_devices, (plan, n_devices)
     return plan

@@ -259,6 +259,23 @@ class CertManager:
         cert = _load_cert(paths.cert_file)
         if cert.issuer != ca_cert.subject:
             return True                      # CA was re-rooted
+        # A leaf that no longer covers every configured SAN must be
+        # re-issued immediately: restarting serve with a new --host or
+        # --tls-san against an existing cert_dir would otherwise keep
+        # serving the old leaf, and clients dialing the new name fail
+        # hostname verification until the rotation window.
+        from cryptography import x509
+
+        try:
+            san_ext = cert.extensions.get_extension_for_class(
+                x509.SubjectAlternativeName).value
+            have = ({str(n) for n in san_ext.get_values_for_type(x509.DNSName)}
+                    | {str(ip) for ip in
+                       san_ext.get_values_for_type(x509.IPAddress)})
+        except x509.ExtensionNotFound:
+            have = set()
+        if not set(self.cfg.sans) <= have:
+            return True
         total = cert.not_valid_after_utc - cert.not_valid_before_utc
         remaining = cert.not_valid_after_utc - _now()
         return remaining <= total * self.cfg.rotation_fraction

@@ -9,6 +9,7 @@ StepResult can ask for a delayed requeue.
 
 from __future__ import annotations
 
+import collections
 import heapq
 import itertools
 import threading
@@ -136,6 +137,12 @@ class Controller:
         self._stop = threading.Event()
         self.reconcile_count = 0
         self.error_count = 0
+        # Recent reconcile wall times (ring, thread-safe via GIL append):
+        # the steady-state scale phase reports p50/p95 from here, the
+        # analog of the reference profiling its no-op reconcile cost
+        # (scale_test.go:216-240).
+        self.durations: "collections.deque[float]" = \
+            collections.deque(maxlen=4096)
 
     # ---- wiring ----
 
@@ -213,8 +220,12 @@ class Controller:
     def _process(self, req: Request) -> None:
         self.reconcile_count += 1
         GLOBAL_METRICS.inc("grove_reconcile_total", controller=self.name)
+        t0 = time.perf_counter()
         try:
-            result = self.reconcile(req) or StepResult.finished()
+            try:
+                result = self.reconcile(req) or StepResult.finished()
+            finally:
+                self.durations.append(time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 - reconcile panic barrier
             self.error_count += 1
             self.log.warning("reconcile %s panicked: %s", req.key, e,

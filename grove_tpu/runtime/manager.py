@@ -1,9 +1,11 @@
 """Manager: owns the store, clients, controllers, and agents.
 
 Role parity with reference internal/controller/manager.go:55-147 +
-cmd/main.go:44-143 — minus leader election (single-process control plane;
-the seam is Manager.start) and webhook TLS (admission runs in-process at
-the client boundary, see grove_tpu.admission).
+cmd/main.go:44-143. Leader election's single-writer guarantee lives at
+the state-dir instead (flock + standby takeover, store/persist.py
+_acquire_state_lock — a second `serve --state-dir X` is refused or
+waits as a standby); webhook TLS is subsumed by admission running
+in-process at the client boundary (see grove_tpu.admission).
 """
 
 from __future__ import annotations

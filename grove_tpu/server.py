@@ -173,6 +173,34 @@ class ApiServer:
         cluster = self.cluster
         api = self
 
+        # Watch-replay render cache: an event's object is serialized
+        # once per (uid, rv) STATE, not once per watcher per poll — with
+        # M agents watching a deploy storm, re-walking every dataclass
+        # for every watcher made replay the server's dominant cost
+        # (measured: ~5s of a 300-pod create phase).
+        import collections as _collections
+
+        render_cache: "_collections.OrderedDict[tuple, dict]" = \
+            _collections.OrderedDict()
+        render_lock = threading.Lock()
+
+        def render_event_obj(obj) -> str:
+            """Serialized JSON of the object — cached so both the
+            dataclass walk AND json.dumps happen once per state, not
+            once per watcher per poll."""
+            key = (obj.KIND, obj.meta.uid, obj.meta.resource_version)
+            with render_lock:
+                hit = render_cache.get(key)
+                if hit is not None:
+                    render_cache.move_to_end(key)
+                    return hit
+            data = json.dumps(to_dict(obj))
+            with render_lock:
+                render_cache[key] = data
+                if len(render_cache) > 4096:   # ≥ the event-history ring
+                    render_cache.popitem(last=False)
+            return data
+
         class Handler(BaseHTTPRequestHandler):
             def setup(self):
                 # TLS handshake runs HERE, in this connection's own
@@ -188,8 +216,11 @@ class ApiServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, code: int, payload, content_type="application/json"):
-                body = (json.dumps(payload, indent=2).encode()
+            def _send(self, code: int, payload,
+                      content_type="application/json",
+                      preserialized: bool = False):
+                body = (payload.encode() if preserialized
+                        else json.dumps(payload, indent=2).encode()
                         if content_type == "application/json"
                         else payload.encode())
                 self.send_response(code)
@@ -351,8 +382,14 @@ class ApiServer:
                 if path == "/metrics/push":
                     self._metrics_push()
                     return
+                parts = [p for p in path.split("/") if p]
+                if len(parts) == 3 and parts[0] == "batch" \
+                        and parts[2] == "status":
+                    self._status_batch(parts[1])
+                    return
                 if path != "/apply":
-                    self._send(404, {"error": "POST /apply or /metrics/push"})
+                    self._send(404, {"error": "POST /apply, /metrics/push "
+                                     "or /batch/<kind>/status"})
                     return
                 client = self._mutating_client()
                 if client is None:
@@ -489,14 +526,27 @@ class ApiServer:
                     # soon as unrelated churn wraps the ring.
                     since = scanned
                     if events or _time.time() >= deadline:
-                        payload = [{"seq": seq, "type": ev.type.value,
-                                    "kind": ev.obj.KIND,
-                                    "object": to_dict(ev.obj)}
-                                   for seq, ev in events]
-                        self._send(200, {"rv": since, "events": payload})
+                        frags = (
+                            f'{{"seq": {seq}, "type": "{ev.type.value}", '
+                            f'"kind": "{ev.obj.KIND}", '
+                            f'"object": {render_event_obj(ev.obj)}}}'
+                            for seq, ev in events)
+                        raw = (f'{{"rv": {since}, "events": '
+                               f'[{",".join(frags)}]}}')
+                        self._send(200, raw, content_type="application/json",
+                                   preserialized=True)
                         return
                     store.wait_events(since,
                                       timeout=deadline - _time.time())
+                    # Debounce: during a deploy storm events arrive one
+                    # at a time; answering each wake immediately turns N
+                    # events into N×watchers HTTP cycles (measured ~860
+                    # req/s at 300 pods / 4 agents). 30ms of batching
+                    # collapses the burst into one reply per watcher at
+                    # a latency cost no reconcile loop can notice.
+                    if _time.time() < deadline:
+                        _time.sleep(min(0.03, max(0.0,
+                                                  deadline - _time.time())))
 
             def _profiling_config(self):
                 """Profiling config when the surface is enabled, else None
@@ -603,11 +653,51 @@ class ApiServer:
                     self._send(400, {"error": f"bad metric payload: {e}; "
                                      "need kind/name/metric/value"})
 
+            def _status_batch(self, kind: str):
+                """POST /batch/<kind>/status — batched status merge
+                patches ({"namespace", "items": [{"name", "patch"}]}),
+                applied under ONE store lock acquisition so controllers
+                coalesce the burst (a kubelet fleet marking a gang Ready
+                is hundreds of writes at once; N sequential wire PATCHes
+                would hand controllers N wake-ups). Returns one result
+                per item: null or {"error"}."""
+                cls = self._kind(kind)
+                if cls is None:
+                    return
+                if not self._guard_secret_access(cls):
+                    return
+                client = self._mutating_client()
+                if client is None:
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"")
+                    items = [(i["name"], i["patch"]) for i in body["items"]]
+                except (ValueError, TypeError, KeyError) as e:
+                    self._send(400, {"error": f"bad batch body: {e}"})
+                    return
+                try:
+                    results = client.patch_status_many(
+                        cls, items, namespace=body.get("namespace",
+                                                       "default"))
+                except ForbiddenError as e:
+                    self._send(403, {"error": str(e)})
+                    return
+                self._send(200, {"results": [
+                    None if r is None else {"error": str(r)}
+                    for r in results]})
+
             def do_PATCH(self):
+                """PATCH /api/<kind>/<name> (spec/labels/annotations merge
+                patch) and PATCH /api/<kind>/<name>/status (status-
+                subresource merge, conditions by type — the kubelet
+                status-write pattern; no rv precondition)."""
                 url = urlparse(self.path)
                 parts = [p for p in url.path.split("/") if p]
-                if len(parts) != 3 or parts[0] != "api":
-                    self._send(404, {"error": "PATCH /api/<kind>/<name>"})
+                status_sub = (len(parts) == 4 and parts[3] == "status")
+                if not (len(parts) == 3 or status_sub) or parts[0] != "api":
+                    self._send(404, {"error":
+                                     "PATCH /api/<kind>/<name>[/status]"})
                     return
                 cls = self._kind(parts[1])
                 if cls is None:
@@ -625,8 +715,12 @@ class ApiServer:
                     self._send(400, {"error": f"bad patch JSON: {e}"})
                     return
                 try:
-                    updated = client.patch(cls, parts[2], patch,
-                                           namespace=ns)
+                    if status_sub:
+                        updated = client.patch_status(cls, parts[2], patch,
+                                                      namespace=ns)
+                    else:
+                        updated = client.patch(cls, parts[2], patch,
+                                               namespace=ns)
                     self._send(200, to_dict(updated))
                 except NotFoundError as e:
                     self._send(404, {"error": str(e)})

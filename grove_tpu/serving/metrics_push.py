@@ -53,9 +53,16 @@ def push_metric(metric: str, value: float, *, kind: str | None = None,
         # reporters instead of last-write-wins.
         "reporter": os.environ.get("GROVE_POD_NAME", "_default"),
     }).encode()
+    headers = {"Content-Type": "application/json"}
+    # Workload identity: the kubelet injects GROVE_API_TOKEN alongside the
+    # control-plane URL; without it, a server running with
+    # require_token_for_metrics rejects the push as anonymous (401).
+    token = os.environ.get("GROVE_API_TOKEN", "")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(
         f"{server}/metrics/push", data=payload, method="POST",
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     try:
         ctx = None
         if server.startswith("https"):

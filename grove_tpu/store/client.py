@@ -45,6 +45,20 @@ class Client:
     def update_status_many(self, objs: list[Any]) -> list[Exception | None]:
         return self._store.update_status_many(objs, actor=self.actor)
 
+    def patch_status(self, kind_cls: type, name: str, patch: dict,
+                     namespace: str = "default") -> Any:
+        """Status-subresource merge patch (conditions merge by type; no
+        rv precondition — see Store.patch_status)."""
+        return self._store.patch_status(kind_cls, name, patch, namespace,
+                                        actor=self.actor)
+
+    def patch_status_many(self, kind_cls: type,
+                          items: list[tuple[str, dict]],
+                          namespace: str = "default"
+                          ) -> list[Exception | None]:
+        return self._store.patch_status_many(kind_cls, items, namespace,
+                                             actor=self.actor)
+
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         return self._store.delete(kind_cls, name, namespace, actor=self.actor)
 
@@ -158,6 +172,11 @@ class FakeClient(Client):
         # exactly what failure-injection tests want to poke.
         self._intercept("patch", kind_cls.KIND, name)
         return super().patch(kind_cls, name, patch, namespace, retries)
+
+    def patch_status(self, kind_cls: type, name: str, patch: dict,
+                     namespace: str = "default") -> Any:
+        self._intercept("patch_status", kind_cls.KIND, name)
+        return super().patch_status(kind_cls, name, patch, namespace)
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         self._intercept("delete", kind_cls.KIND, name)

@@ -150,6 +150,32 @@ class HttpClient:
                      f"?{urlencode({'namespace': namespace})}", patch)
         return from_dict(kind_cls, data)
 
+    def patch_status_many(self, kind_cls: type,
+                          items: list[tuple[str, dict]],
+                          namespace: str = "default"
+                          ) -> list[Exception | None]:
+        """Batched status merge patches in ONE round trip (the server
+        applies them under one store lock — POST /batch/<kind>/status).
+        Returns one entry per item: None or GroveError."""
+        data = self._request(
+            "POST", f"/batch/{kind_cls.KIND}/status",
+            {"namespace": namespace,
+             "items": [{"name": n, "patch": p} for n, p in items]})
+        return [None if r is None else GroveError(r["error"])
+                for r in data["results"]]
+
+    def patch_status(self, kind_cls: type, name: str, patch: dict,
+                     namespace: str = "default") -> Any:
+        """Status-subresource merge patch: one round trip, no read, no
+        rv conflict (the server merges under its lock; conditions merge
+        by type). The kubelet status-write pattern — what lets a fleet
+        of agents write readiness without conflict-looping against
+        controllers."""
+        data = self._request(
+            "PATCH", f"/api/{kind_cls.KIND}/{quote(name)}/status"
+                     f"?{urlencode({'namespace': namespace})}", patch)
+        return from_dict(kind_cls, data)
+
     def delete(self, kind_cls: type, name: str,
                namespace: str = "default") -> None:
         self._request("DELETE", f"/api/{kind_cls.KIND}/{quote(name)}"

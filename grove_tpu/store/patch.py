@@ -84,3 +84,46 @@ def apply_patch(obj: Any, patch: dict) -> Any:
         raise ValidationError(
             f"patch does not fit {cls.KIND} schema: " + "; ".join(problems))
     return patched
+
+
+def merge_status(status_obj: Any, patch: dict) -> Any:
+    """Apply a merge patch to a typed status object — the
+    status-subresource counterpart of ``apply_patch`` (the kubelet
+    PATCHes pod status; reference R8's client-go Status().Patch()).
+
+    RFC 7386 semantics, with one strategic-merge extension mirroring
+    upstream kube: a ``conditions`` list merges BY ``type`` (the
+    patchMergeKey on every k8s conditions field) instead of being
+    replaced wholesale — a writer updating Ready must not clobber the
+    Scheduled condition another controller owns. A condition entry of
+    ``null`` body deletes that type.
+    """
+    if not isinstance(patch, dict):
+        raise ValidationError("status patch must be a JSON object")
+    cls = type(status_obj)
+    data = to_dict(status_obj)
+    cond_patch = patch.get("conditions")
+    rest = {k: v for k, v in patch.items() if k != "conditions"}
+    merged = json_merge_patch(data, rest)
+    if cond_patch is not None:
+        if not isinstance(cond_patch, list):
+            raise ValidationError("status patch conditions must be a list")
+        by_type = {c.get("type"): dict(c)
+                   for c in data.get("conditions") or []}
+        for entry in cond_patch:
+            if not isinstance(entry, dict) or "type" not in entry:
+                raise ValidationError(
+                    "each conditions patch entry needs a 'type'")
+            others = {k: v for k, v in entry.items() if k != "type"}
+            if others and all(v is None for v in others.values()):
+                by_type.pop(entry["type"], None)   # explicit-null delete
+            else:
+                by_type[entry["type"]] = json_merge_patch(
+                    by_type.get(entry["type"], {}), entry)
+        merged["conditions"] = list(by_type.values())
+    try:
+        patched = from_dict(cls, merged)
+    except (TypeError, ValueError, KeyError) as e:
+        raise ValidationError(f"status patch does not fit "
+                              f"{cls.__name__}: {e}")
+    return patched

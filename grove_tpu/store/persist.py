@@ -65,6 +65,56 @@ class StateVersionError(RuntimeError):
     """State on disk was written by a newer build; refuse to load."""
 
 
+class StateLockError(RuntimeError):
+    """Another process holds the state-dir's single-writer lock."""
+
+
+# state dirs (realpath) this PROCESS already holds the flock for. The
+# lock is cross-PROCESS single-writer protection; within one process,
+# sequential Store instances over one dir (the test harness's simulated
+# restarts) share the held lock. Entries live until process exit — the
+# kernel then releases the flock, even on SIGKILL, which is what makes
+# standby takeover work without a heartbeat protocol.
+_PROCESS_LOCKS: dict[str, int] = {}
+
+
+def _acquire_state_lock(state_dir: str, wait: bool) -> None:
+    """Exclusive flock on <state_dir>/LOCK — the leader-election analog
+    (reference runs leader-elected, manager.go:55-147; without this, two
+    ``serve --state-dir X`` processes interleave WAL appends and clobber
+    each other's snapshots, silently corrupting the state the WAL exists
+    to protect). ``wait=True`` blocks until the current holder exits
+    (standby takeover); ``wait=False`` refuses immediately with the
+    holder's identity."""
+    import fcntl
+
+    key = os.path.realpath(state_dir)
+    if key in _PROCESS_LOCKS:
+        return
+    fd = os.open(os.path.join(state_dir, "LOCK"),
+                 os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | (0 if wait else fcntl.LOCK_NB))
+    except OSError:
+        holder = ""
+        try:
+            holder = os.read(fd, 256).decode(errors="replace").strip()
+        except OSError:
+            pass
+        os.close(fd)
+        raise StateLockError(
+            f"state dir {state_dir!r} is locked by another process"
+            + (f" ({holder})" if holder else "") +
+            "; a second writer would interleave WAL appends and corrupt "
+            "control-plane state. Stop the other serve, or run with "
+            "takeover enabled (grovectl serve --takeover) to wait for "
+            "its lease") from None
+    # Held. Stamp the holder for the refusal diagnostic above.
+    os.ftruncate(fd, 0)
+    os.write(fd, f"pid={os.getpid()}\n".encode())
+    _PROCESS_LOCKS[key] = fd
+
+
 def migrate_object(kind: str, data: dict,
                    from_version: int) -> Optional[tuple[str, dict]]:
     """Run the migration chain from ``from_version`` to STATE_VERSION."""
@@ -96,10 +146,14 @@ def _registry() -> dict[str, type]:
 
 
 class StatePersister:
-    def __init__(self, state_dir: str, compact_every: int = 1000):
+    def __init__(self, state_dir: str, compact_every: int = 1000,
+                 takeover_wait: bool = False):
         self.state_dir = state_dir
         self.compact_every = compact_every
         os.makedirs(state_dir, exist_ok=True)
+        # Single-writer guard BEFORE the first read: a takeover must
+        # re-load state after the previous holder's final appends.
+        _acquire_state_lock(state_dir, wait=takeover_wait)
         self.snapshot_path = os.path.join(state_dir, "snapshot.json")
         self.wal_path = os.path.join(state_dir, "wal.jsonl")
         self._wal_file = None
@@ -188,6 +242,14 @@ class StatePersister:
                 # the tear.
                 with open(self.wal_path, "r+b") as f:
                     f.truncate(good)
+            elif raw and not raw.endswith(b"\n"):
+                # Final record's JSON is complete but its newline was
+                # lost (torn exactly at the line boundary): terminate it
+                # before any append, or the next record concatenates onto
+                # it and the merged line loses BOTH records on the
+                # following load.
+                with open(self.wal_path, "ab") as f:
+                    f.write(b"\n")
         loaded = list(objects.values())
         if snap_version < STATE_VERSION or (
                 self._wal_records and wal_version < STATE_VERSION):

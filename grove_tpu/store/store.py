@@ -28,6 +28,7 @@ from grove_tpu.runtime.errors import (
     AlreadyExistsError,
     ConflictError,
     NotFoundError,
+    ValidationError,
 )
 
 
@@ -82,7 +83,8 @@ class Watcher:
 
 
 class Store:
-    def __init__(self, state_dir: str | None = None) -> None:
+    def __init__(self, state_dir: str | None = None,
+                 takeover_wait: bool = False) -> None:
         self._lock = threading.RLock()
         # Signalled on every _emit: wire long-polls block on this instead
         # of rescanning the ring on a poll interval.
@@ -102,7 +104,8 @@ class Store:
         self._persister = None
         if state_dir is not None:
             from grove_tpu.store.persist import StatePersister
-            self._persister = StatePersister(state_dir)
+            self._persister = StatePersister(state_dir,
+                                             takeover_wait=takeover_wait)
             objects, max_rv = self._persister.load()
             for obj in objects:
                 self._objects.setdefault(obj.KIND, {})[_key(obj)] = obj
@@ -330,6 +333,60 @@ class Store:
         self._persist_put(stored)
         self._emit(EventType.MODIFIED, stored)
         return stored
+
+    def patch_status(self, kind_cls: type, name: str, patch: dict,
+                     namespace: str = "default",
+                     actor: str = "system:grove-operator") -> Any:
+        """Server-side status merge (the kubelet PATCH pattern —
+        store/patch.py merge_status; conditions merge by type). No
+        resource-version precondition: the read-modify-write happens
+        atomically under the store lock, which is the consistency the
+        optimistic-concurrency dance approximates from outside. This is
+        what keeps a fleet of wire agents from conflict-looping against
+        controllers that also write the same objects' status."""
+        with self._lock:
+            return clone(self._patch_status_locked(kind_cls, name, patch,
+                                                   namespace, actor))
+
+    def _patch_status_locked(self, kind_cls: type, name: str, patch: dict,
+                             namespace: str, actor: str) -> Any:
+        from grove_tpu.store.patch import merge_status
+        live = self._objects.get(kind_cls.KIND, {}).get((namespace, name))
+        if live is None:
+            raise NotFoundError(
+                f"{kind_cls.KIND} {namespace}/{name} not found")
+        updated = clone(live)
+        updated.status = merge_status(live.status, patch)
+        self._admit("update_status", clone(updated), clone(live), actor)
+        if to_dict(updated.status) == to_dict(live.status):
+            return live                     # no-op suppression, as PUT
+        updated.meta.resource_version = next(self._rv)
+        self._objects[kind_cls.KIND][(namespace, name)] = updated
+        self._persist_put(updated)
+        self._emit(EventType.MODIFIED, updated)
+        return updated
+
+    def patch_status_many(self, kind_cls: type,
+                          items: list[tuple[str, dict]],
+                          namespace: str = "default",
+                          actor: str = "system:grove-operator"
+                          ) -> list[Exception | None]:
+        """Batched status merge-patches under ONE lock acquisition — the
+        wire twin of ``update_status_many`` (a kubelet fleet marking a
+        gang's pods Ready writes hundreds of statuses at once; one
+        locked batch lets watching controllers coalesce the burst into
+        one reconcile instead of N). Returns one entry per item: None on
+        success, NotFound/Validation otherwise."""
+        results: list[Exception | None] = []
+        with self._lock:
+            for name, patch in items:
+                try:
+                    self._patch_status_locked(kind_cls, name, patch,
+                                              namespace, actor)
+                    results.append(None)
+                except (NotFoundError, ValidationError) as e:
+                    results.append(e)
+        return results
 
     def update_status_many(self, objs: list[Any],
                            actor: str = "system:grove-operator"

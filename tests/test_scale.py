@@ -13,12 +13,17 @@ from grove_tpu.scale.runner import ScaleConfig, run_scale_test
 def test_scale_300_pods_within_budget():
     res = run_scale_test(ScaleConfig(pods=300, cliques=3,
                                      deploy_timeout=120.0,
-                                     steady_window=1.0))
+                                     steady_touches=30))
     assert res["deploy_pods_created_s"] < 30
     assert res["deploy_pods_ready_s"] < 90
     assert res["deploy_available_s"] < 90
-    # Steady state must be quiet (no-op reconcile storm would show here).
-    assert res["steady_reconciles_per_s"] < 20
+    # Steady state is measured under a STIMULUS (annotation touches on
+    # pods, reference scale_test.go:216-240): the touches must produce a
+    # reconcile ripple — coalesced by the workqueue dirty-set to ~one
+    # reconcile per owning clique — and each reconcile must stay cheap.
+    assert res["steady_touches"] == 30
+    assert res["steady_reconciles"] >= 3
+    assert 0 < res["steady_p95_ms"] < 250
     # Delete request returns fast; cascade completes.
     assert res["delete_request_s"] < 1.0
     assert res["delete_cascade_s"] < 30

@@ -202,3 +202,32 @@ def test_config_validation():
     cfg.server_tls.enabled = True
     cfg.server_tls.mode = "byo"
     assert any("cert_file" in p for p in validate_config(cfg))
+
+
+def test_new_san_triggers_leaf_reissue(tmp_path):
+    """Restarting serve with a new --host/--tls-san against an existing
+    cert_dir must re-issue the leaf immediately — keeping the old leaf
+    makes clients dialing the new name fail hostname verification until
+    the rotation window (reference cert.go re-issues on config change)."""
+    import dataclasses
+
+    from cryptography import x509
+
+    from grove_tpu.api.config import OperatorConfiguration
+
+    cfg = OperatorConfiguration().server_tls
+    cfg.enabled = True
+    cfg.cert_dir = str(tmp_path / "certs")
+    before = _load_cert(CertManager(cfg).ensure().cert_file)
+
+    # same cert_dir, restarted with an extra SAN
+    cfg2 = dataclasses.replace(cfg, sans=list(cfg.sans) + ["grove.internal"])
+    cert = _load_cert(CertManager(cfg2).ensure().cert_file)
+    san = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    assert "grove.internal" in san.get_values_for_type(x509.DNSName)
+    assert cert.issuer == before.issuer       # trust anchor unchanged
+
+    # unchanged config must NOT churn the leaf on every restart
+    again = _load_cert(CertManager(cfg2).ensure().cert_file)
+    assert again.serial_number == cert.serial_number

@@ -1,0 +1,144 @@
+"""Single-writer state-dir lock (the leader-election analog; reference
+operator/internal/controller/manager.go:55-147 runs leader-elected so two
+manager replicas can never both write).
+
+Without the lock, two ``serve --state-dir X`` processes interleave WAL
+appends and clobber each other's snapshots — silently corrupting the
+exact state the WAL exists to protect. The lock is an flock: held for
+the process lifetime, released by the kernel on ANY exit including
+SIGKILL, which is what gives a blocking standby takeover semantics
+without a heartbeat protocol."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from grove_tpu.api import PodCliqueSet
+from grove_tpu.store.persist import StateLockError
+from grove_tpu.store.store import Store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child(code: str, state_dir: str, *, wait: bool = False):
+    """Run a python child that opens Store(state_dir) and executes code."""
+    prog = textwrap.dedent(f"""
+        import json, sys, time
+        from grove_tpu.api import PodCliqueSet
+        from grove_tpu.api.meta import new_meta
+        from grove_tpu.store.persist import StateLockError
+        from grove_tpu.store.store import Store
+
+        def pcs(name):
+            o = PodCliqueSet(meta=new_meta(name))
+            return o
+
+        state_dir = {state_dir!r}
+    """) + textwrap.dedent(code)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, "-c", prog], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def _wait_file(path: str, timeout: float = 20.0) -> None:
+    t0 = time.time()
+    while not os.path.exists(path):
+        assert time.time() - t0 < timeout, f"timed out waiting for {path}"
+        time.sleep(0.05)
+
+
+def test_second_writer_refused_and_standby_takes_over(tmp_path):
+    """The VERDICT r2 scenario end to end: two processes race for one
+    state dir — one wins; a non-takeover second writer is refused; a
+    standby blocks, the winner is SIGKILLed mid-tenure, the standby
+    takes over and sees every record the winner appended; final state
+    is uncorrupted."""
+    d = str(tmp_path / "state")
+    ready = str(tmp_path / "winner-ready")
+
+    winner = _child(f"""
+        s = Store(state_dir=state_dir)
+        s.create(pcs("from-winner"))
+        open({ready!r}, "w").write("ok")
+        time.sleep(60)   # hold the lock until killed
+    """, d)
+    try:
+        _wait_file(ready)
+
+        # A second writer without takeover is refused immediately, with
+        # the holder's pid in the message.
+        refused = _child("""
+            try:
+                Store(state_dir=state_dir)
+            except StateLockError as e:
+                print("REFUSED", e)
+                sys.exit(7)
+            sys.exit(0)
+        """, d)
+        out, err = refused.communicate(timeout=30)
+        assert refused.returncode == 7, (out, err)
+        assert "REFUSED" in out and f"pid={winner.pid}" in out
+
+        # A standby blocks on the lease...
+        standby = _child("""
+            s = Store(state_dir=state_dir, takeover_wait=True)
+            s.create(pcs("from-standby"))
+            names = sorted(o.meta.name for o in s.list(PodCliqueSet))
+            print("TOOK-OVER", json.dumps(names))
+        """, d)
+        time.sleep(1.0)
+        assert standby.poll() is None, standby.communicate()
+
+        # ...the winner dies hard (no cleanup path runs)...
+        os.kill(winner.pid, signal.SIGKILL)
+        winner.wait(timeout=10)
+
+        # ...and the standby takes over, loading the winner's appends.
+        out, err = standby.communicate(timeout=30)
+        assert standby.returncode == 0, (out, err)
+        assert '"from-winner"' in out and '"from-standby"' in out, (out, err)
+    finally:
+        for p in (winner,):
+            if p.poll() is None:
+                p.kill()
+
+    # The dir loads clean afterwards: nothing torn, nothing lost.
+    s = Store(state_dir=d)
+    assert {o.meta.name for o in s.list(PodCliqueSet)} == \
+        {"from-winner", "from-standby"}
+
+
+def test_same_process_reopen_allowed(tmp_path):
+    """Sequential Store instances over one dir in ONE process (simulated
+    restarts, the pattern all persistence tests use) share the held
+    lock — the guard is cross-process."""
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(PodCliqueSet(meta=__import__(
+        "grove_tpu.api.meta", fromlist=["new_meta"]).new_meta("one")))
+    s2 = Store(state_dir=d)   # no StateLockError
+    assert {o.meta.name for o in s2.list(PodCliqueSet)} == {"one"}
+
+
+def test_serve_cli_exposes_takeover(tmp_path):
+    """grovectl serve --takeover is wired through to the store (a refused
+    non-takeover serve exits with the StateLockError message)."""
+    d = str(tmp_path / "state")
+    s = Store(state_dir=d)   # this pytest process holds the lock
+    del s
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.cli", "serve", "--state-dir", d,
+         "--port", "0"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "locked by another process" in (proc.stderr + proc.stdout)

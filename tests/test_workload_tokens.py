@@ -251,3 +251,27 @@ def test_require_token_for_metrics_accepts_workload_tokens(server):
     token = _workload_token(cl.client, "gated")
     status, body = _push(base, token, "PodClique", "gated-0-workers")
     assert status == 200, body
+
+
+def test_push_metric_helper_sends_workload_token(server, monkeypatch):
+    """The shipped push_metric helper must attach the injected
+    GROVE_API_TOKEN itself — with require_token_for_metrics on, a helper
+    that omits the Authorization header gets 401 and the autoscaling
+    feedback loop silently dies (pushes are advisory and swallowed)."""
+    base, cl = server
+    cl.manager.config.server_auth.require_token_for_metrics = True
+    cl.client.create(simple_pcs(name="helper"))
+    wait_for(lambda: cl.client.list(
+        Secret, selector={c.LABEL_PCS_NAME: "helper"}), desc="minted")
+
+    from grove_tpu.serving import metrics_push
+
+    monkeypatch.setenv("GROVE_CONTROL_PLANE", base)
+    monkeypatch.setenv("GROVE_PCLQ_NAME", "helper-0-workers")
+    monkeypatch.delenv("GROVE_PCSG_NAME", raising=False)
+    monkeypatch.delenv("GROVE_API_TOKEN", raising=False)
+    # anonymous helper push: rejected by the gated server
+    assert metrics_push.push_metric("queue_depth", 3.0) is False
+    # with the kubelet-injected env, the helper authenticates by itself
+    monkeypatch.setenv("GROVE_API_TOKEN", _workload_token(cl.client, "helper"))
+    assert metrics_push.push_metric("queue_depth", 3.0) is True

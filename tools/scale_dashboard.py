@@ -73,8 +73,8 @@ def render(runs: list[dict]) -> str:
                 f"trend: `{sparkline(ready)}`  (older → newer)",
                 "",
                 "| label | when | created | scheduled | ready | vs best "
-                "| steady rec/s | delete cascade |",
-                "|---|---|---|---|---|---|---|---|"]
+                "| steady rec/s | steady p95 | delete cascade |",
+                "|---|---|---|---|---|---|---|---|---|"]
         for r in entries:
             when = time.strftime("%Y-%m-%d %H:%M",
                                  time.localtime(r.get("ts", 0.0)))
@@ -86,6 +86,7 @@ def render(runs: list[dict]) -> str:
                 f"| {r.get('deploy_pods_scheduled_s', 0):.1f}s "
                 f"| {rd:.1f}s | {delta} "
                 f"| {r.get('steady_reconciles_per_s', 0):.1f} "
+                f"| {r.get('steady_p95_ms', 0):.0f}ms "
                 f"| {r.get('delete_cascade_s', 0):.2f}s |")
         out.append("")
     return "\n".join(out)
