@@ -24,10 +24,12 @@ The reference (ai-dynamo/grove) publishes no benchmark numbers
 (BASELINE.md); its north star for this repo is serving throughput ≥ 90%
 of bare-metal JAX. ``vs_baseline`` is therefore the ratio of the
 framework-served decode path (DecodeEngine: continuous-batching lanes,
-completion bookkeeping, metric hooks) to a bare loop over the SAME
-compiled prefill/decode callables on the same chip — 1.0 means zero
-serving-layer overhead, and no extra compilations are spent on the
-comparison. ``mfu`` and ``hbm_util`` place the absolute number against
+completion bookkeeping, metric hooks) to an INDEPENDENT bare-JAX
+reference loop — a separate jit of models/llama.decode_step in a plain
+scan, written without any DecodeEngine code — on the same chip.
+``vs_engine_bare`` is the companion ratio against a raw loop over the
+engine's own compiled callables (1.0 there means zero serving-layer
+overhead). ``mfu`` and ``hbm_util`` place the absolute number against
 the chip's roofline (v5e: ~197 TFLOP/s bf16, ~819 GB/s HBM) — decode at
 small batch is HBM-bound, so hbm_util is the one to watch.
 """
@@ -52,8 +54,8 @@ import numpy as np
 
 # Serving batch (continuous-batching lanes). 32 is the serving posture
 # for a 1B model (cache = batch x ~17MB, far under HBM); decode is
-# weight-read-bound, so lanes amortize the read near-linearly: measured
-# 2055 tok/s at batch 8 -> 4107 at batch 32 on the same chip.
+# weight-read-bound, so lanes amortize the weight read near-linearly
+# (see bench-history/history.jsonl for the committed batch sweep).
 BATCH = int(os.environ.get("GROVE_BENCH_BATCH", 32))
 PROMPT_LEN = 128
 DECODE_STEPS = 64
@@ -68,17 +70,23 @@ MAX_LEN = int(os.environ.get("GROVE_BENCH_MAX_LEN", 512))
 PEAK_FLOPS = float(os.environ.get("GROVE_PEAK_FLOPS", 197e12))  # bf16
 PEAK_HBM_BW = float(os.environ.get("GROVE_PEAK_HBM_BW", 819e9))  # bytes/s
 
-INIT_RETRIES = 3
-INIT_RETRY_DELAY_S = 30.0
-# Whole-run attempts: a relay flap ANYWHERE in the ~90s of bench work
-# restarts the run from device init (round 2's failure arrived after
-# init, inside init_params — init-only retry was predictable
-# under-coverage).
-RUN_ATTEMPTS = int(os.environ.get("GROVE_BENCH_ATTEMPTS", 3))
-RUN_RETRY_DELAY_S = float(os.environ.get("GROVE_BENCH_RETRY_DELAY", 30.0))
-# Watchdog per attempt: generous vs the ~3-4 min a healthy run takes,
-# small vs forfeiting the round to a hung relay.
-ATTEMPT_TIMEOUT_S = float(os.environ.get("GROVE_BENCH_ATTEMPT_TIMEOUT", 600))
+INIT_RETRIES = 2
+INIT_RETRY_DELAY_S = 15.0
+# Whole-run attempts: a relay flap ANYWHERE in the bench work restarts
+# the run from device init (round 2's failure arrived after init, inside
+# init_params — init-only retry was predictable under-coverage).
+RUN_ATTEMPTS = int(os.environ.get("GROVE_BENCH_ATTEMPTS", 2))
+RUN_RETRY_DELAY_S = float(os.environ.get("GROVE_BENCH_RETRY_DELAY", 15.0))
+# Watchdog per attempt + TOTAL supervisor budget. Round-3 lesson: the
+# supervisor's worst case (attempts x watchdog + delays) MUST fit inside
+# the driver's own capture window or the designed failure JSON never
+# prints — 3x600s+delays exceeded it and the round's artifact was
+# `parsed: null`. Worst case here: 2x230 + 15 = 475s < ~500s, and the
+# supervisor additionally clamps each attempt to the remaining total
+# budget so the LAST line on stdout is always a parseable JSON no matter
+# when the driver stops reading.
+ATTEMPT_TIMEOUT_S = float(os.environ.get("GROVE_BENCH_ATTEMPT_TIMEOUT", 230))
+TOTAL_BUDGET_S = float(os.environ.get("GROVE_BENCH_TOTAL_BUDGET", 490))
 # Set in the child's env by the supervisor; the child runs ONE attempt.
 _CHILD_ENV = "GROVE_BENCH_CHILD"
 _PARTIAL_ENV = "GROVE_BENCH_PARTIAL_FILE"
@@ -388,6 +396,7 @@ def run_bench(partial: dict) -> dict:
     log(f"bare-metal decode: {bare:.1f} tok/s/chip "
         f"(block dispatch, {block} steps/dispatch)")
 
+
     # ---- framework path: the serving engine's run loop over the same
     # compiled block program, with tracked requests so the REAL
     # serving-layer costs run — completion bookkeeping drained
@@ -403,6 +412,54 @@ def run_bench(partial: dict) -> dict:
     partial["phase"] = "decode-done"
     checkpoint_partial(partial)
     log(f"framework decode: {fw:.1f} tok/s/chip")
+
+    # ---- INDEPENDENT reference loop: bare JAX built straight from
+    # models/llama.py — its own jit, its own block scan, greedy
+    # sampling, zero DecodeEngine involvement. ``vs_baseline`` against
+    # THIS loop is the defensible "≥90% of bare-metal JAX" number
+    # (BASELINE.md north star); the engine-callable loop above only
+    # proves zero serving-layer overhead (both sides there run the
+    # engine's own compiled programs). GROVE_BENCH_INDEP=0 skips it
+    # (saves two compiles when sweeping knobs).
+    indep = None
+    if os.environ.get("GROVE_BENCH_INDEP", "1") != "0":
+        from jax import lax as _lax
+
+        def _indep_block(p, tokens, kv):
+            def body(carry, _):
+                t, c2 = carry
+                logits, c2 = llama.decode_step(cfg, p, t, c2)
+                t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (t, c2), ()
+            (t, kv), _ = _lax.scan(body, (tokens, kv), None, length=block)
+            return t, kv
+
+        indep_fn = jax.jit(_indep_block, donate_argnums=(2,))
+        indep_prefill = jax.jit(
+            lambda p, t, c, ln: llama.prefill(cfg, p, t, c, ln),
+            donate_argnums=(2,))
+        icache = KVCache.create(cfg.n_layers, BATCH, max_len,
+                                cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+        ilogits, icache = indep_prefill(params, prompt, icache, lengths)
+        itok = jnp.argmax(ilogits, axis=-1).astype(jnp.int32)
+        itok, icache = indep_fn(params, itok, icache)      # compiles
+        np.asarray(itok)
+        istate = {"tokens": itok, "cache": icache}
+
+        def indep_steps():
+            t, kv = istate["tokens"], istate["cache"]
+            for _ in range(DECODE_STEPS // block):
+                t, kv = indep_fn(params, t, kv)
+            np.asarray(t)
+            istate["tokens"], istate["cache"] = t, kv
+
+        indep = time_loop(indep_steps)
+        del istate, icache
+        partial["independent_tok_s"] = round(indep, 1)
+        partial["phase"] = "independent-done"
+        checkpoint_partial(partial)
+        log(f"independent bare-JAX decode: {indep:.1f} tok/s/chip "
+            "(own jit of models/llama.decode_step, no engine code)")
 
     # Roofline placement: FLOPs at the mid-window live context, HBM at
     # the allocated cache length (what the padded read actually moves).
@@ -428,8 +485,15 @@ def run_bench(partial: dict) -> dict:
         "metric": f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip",
         "value": round(fw, 1),
         "unit": "tok/s/chip",
-        "vs_baseline": round(fw / bare, 4),
+        # Headline ratio: framework vs the INDEPENDENT bare-JAX loop
+        # (falls back to the engine-callable loop only when the
+        # independent loop was explicitly skipped).
+        "vs_baseline": round(fw / (indep or bare), 4),
+        "vs_engine_bare": round(fw / bare, 4),
+        "independent_tok_s": round(indep, 1) if indep else None,
+        "bare_tok_s": round(bare, 1),
         "batch": BATCH,
+        "block": block,
         "mfu": round(mfu, 4),
         "hbm_util": round(hbm, 4),
         "achieved_gbps": round(achieved_gbps, 1),
@@ -498,42 +562,81 @@ def child_main() -> None:
     print(json.dumps(result))
 
 
+def _read_partials(pf) -> dict:
+    try:
+        pf.seek(0)
+        return json.loads(pf.read() or "{}")
+    except ValueError:
+        return {}
+
+
 def supervisor_main() -> None:
-    """Spawn child attempts under a watchdog; forward the final JSON.
+    """Spawn child attempts under a watchdog; the LAST stdout line is
+    always a parseable result JSON.
 
     The child inherits stderr (the driver's log tail stays live) and its
     stdout's last line is the result JSON. A child that exceeds the
     watchdog is killed and retried — its checkpointed partials file
-    stands in for the JSON it never printed."""
+    stands in for the JSON it never printed. The current-best failure
+    JSON is printed after EVERY failed attempt (a later success or a
+    better failure simply prints again — the driver parses the last
+    line), so a driver kill at any moment still leaves a parsed
+    artifact. The whole supervisor fits inside TOTAL_BUDGET_S."""
     import subprocess
     import tempfile
 
+    t_start = time.monotonic()
     last_failure: dict | None = None
+
+    def emit_failure(f: dict) -> None:
+        nonlocal last_failure
+        # Keep the attempt that got FURTHEST (most partial keys wins).
+        if last_failure is None or len(f) >= len(last_failure):
+            last_failure = f
+        print(json.dumps(dict(last_failure, attempts=attempt)), flush=True)
+
     for attempt in range(1, RUN_ATTEMPTS + 1):
+        remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
+        timeout = min(ATTEMPT_TIMEOUT_S, remaining - 5)
+        # Stop only when the TOTAL budget can't fund a meaningful
+        # attempt — an operator-set small ATTEMPT_TIMEOUT_S must still
+        # get its first attempt.
+        if timeout < min(60.0, ATTEMPT_TIMEOUT_S):
+            log(f"total budget exhausted ({remaining:.0f}s left); stopping")
+            break
         with tempfile.NamedTemporaryFile("r", suffix=".json") as pf:
             env = dict(os.environ, **{_CHILD_ENV: "1", _PARTIAL_ENV: pf.name})
             proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                                     env=env, stdout=subprocess.PIPE, text=True)
             try:
-                out, _ = proc.communicate(timeout=ATTEMPT_TIMEOUT_S)
+                out, _ = proc.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 out, _ = proc.communicate()
                 log(f"bench attempt {attempt}/{RUN_ATTEMPTS} exceeded the "
-                    f"{ATTEMPT_TIMEOUT_S:.0f}s watchdog (hung relay); killed")
-                partial = {}
-                try:
-                    pf.seek(0)
-                    partial = json.loads(pf.read() or "{}")
-                except ValueError:
-                    pass
-                last_failure = {
-                    "metric": _metric_name(), "value": 0.0,
-                    "unit": "tok/s/chip", "vs_baseline": 0.0,
-                    "error": f"attempt hung >{ATTEMPT_TIMEOUT_S:.0f}s in "
+                    f"{timeout:.0f}s watchdog (hung relay); killed")
+                partial = _read_partials(pf)
+                # If the attempt got far enough to measure the headline
+                # framework decode (killed later, e.g. mid-independent-
+                # loop), report that value as a DEGRADED result instead
+                # of 0.0 — partial evidence beats none.
+                # Denominator preference mirrors the headline metric:
+                # independent bare-JAX loop when the attempt measured
+                # it, engine-bare otherwise (degraded rows stay
+                # comparable to healthy ones).
+                denom = (partial.get("independent_tok_s")
+                         or partial.get("bare_tok_s"))
+                emit_failure({
+                    "metric": _metric_name(),
+                    "value": partial.get("value", 0.0),
+                    "unit": "tok/s/chip",
+                    "vs_baseline": (
+                        round(partial["value"] / denom, 4)
+                        if partial.get("value") and denom else 0.0),
+                    "error": f"attempt hung >{timeout:.0f}s in "
                              f"phase {partial.get('phase', 'pre-init')!r}",
                     **{k: v for k, v in partial.items() if k != "value"},
-                }
+                })
             else:
                 line = (out or "").strip().splitlines()
                 parsed = None
@@ -544,23 +647,35 @@ def supervisor_main() -> None:
                         pass
                 if proc.returncode == 0 and parsed is not None:
                     append_history(parsed)
-                    print(json.dumps(parsed))
+                    print(json.dumps(parsed), flush=True)
                     return
-                last_failure = parsed or {
-                    "metric": _metric_name(), "value": 0.0,
-                    "unit": "tok/s/chip", "vs_baseline": 0.0,
-                    "error": f"child exited rc={proc.returncode} with no "
-                             "result line",
-                }
+                if parsed is None:
+                    # Child died without a result line (e.g. OOM SIGKILL):
+                    # the checkpointed partials are still on disk — merge
+                    # them so even this path carries furthest-phase
+                    # evidence.
+                    partial = _read_partials(pf)
+                    parsed = {
+                        "metric": _metric_name(), "value": 0.0,
+                        "unit": "tok/s/chip", "vs_baseline": 0.0,
+                        "error": f"child exited rc={proc.returncode} with "
+                                 "no result line",
+                        **{k: v for k, v in partial.items()
+                           if k != "value"},
+                    }
                 log(f"bench attempt {attempt}/{RUN_ATTEMPTS} failed in "
-                    f"phase {last_failure.get('phase', 'pre-init')!r}: "
-                    f"{last_failure.get('error')}")
+                    f"phase {parsed.get('phase', 'pre-init')!r}: "
+                    f"{parsed.get('error')}")
+                emit_failure(parsed)
         if attempt < RUN_ATTEMPTS:
             log(f"retrying in {RUN_RETRY_DELAY_S:.0f}s")
             time.sleep(RUN_RETRY_DELAY_S)
-    failure = dict(last_failure or {}, attempts=RUN_ATTEMPTS)
+    failure = dict(last_failure or {
+        "metric": _metric_name(), "value": 0.0, "unit": "tok/s/chip",
+        "vs_baseline": 0.0, "error": "no attempt ran"},
+        attempts=RUN_ATTEMPTS)
     append_history(failure)
-    print(json.dumps(failure))
+    print(json.dumps(failure), flush=True)
     sys.exit(1)
 
 

@@ -137,6 +137,13 @@ class Controller:
         self._stop = threading.Event()
         self.reconcile_count = 0
         self.error_count = 0
+        # Per-request-key reconcile totals (under _count_lock: worker
+        # threads race on +=). The scale runner's steady-state phase
+        # asserts per-clique deltas from here — an aggregate count can't
+        # distinguish "coalescing works" from "fan-out lost" (a floor
+        # met with zero margin looks identical either way).
+        self.key_counts: collections.Counter = collections.Counter()
+        self._count_lock = threading.Lock()
         # Recent reconcile wall times (ring, thread-safe via GIL append):
         # the steady-state scale phase reports p50/p95 from here, the
         # analog of the reference profiling its no-op reconcile cost
@@ -145,6 +152,12 @@ class Controller:
             collections.deque(maxlen=4096)
 
     # ---- wiring ----
+
+    def snapshot_key_counts(self) -> dict[str, int]:
+        """Copy of per-key reconcile totals under the writers' lock (an
+        unlocked dict() can race a first-seen-key insert mid-iteration)."""
+        with self._count_lock:
+            return dict(self.key_counts)
 
     def watches(self, kinds: list[str] | None,
                 mapper: Callable[[Event], list[Request]],
@@ -218,7 +231,9 @@ class Controller:
                 self.queue.done(req)
 
     def _process(self, req: Request) -> None:
-        self.reconcile_count += 1
+        with self._count_lock:
+            self.reconcile_count += 1
+            self.key_counts[req.key] += 1
         GLOBAL_METRICS.inc("grove_reconcile_total", controller=self.name)
         t0 = time.perf_counter()
         try:

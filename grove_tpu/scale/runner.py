@@ -79,10 +79,19 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
     assert per_clique * cfg.cliques == cfg.pods, "pods must divide by cliques"
     server = None
     agents: list = []
-    with cluster, profiler:
+    # ExitStack so the remote-agent processes are reaped on EVERY exit
+    # path (assertion failure, deploy timeout) — atexit alone would leak
+    # them for the rest of a pytest session. LIFO order stops agents
+    # before cluster teardown; _stop_remote_agents is idempotent, so the
+    # explicit stop at the end of the happy path is fine.
+    import contextlib
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(cluster)
+        stack.enter_context(profiler)
         client = cluster.client
         if cfg.remote_agents > 0:
             server, agents = _spawn_remote_agents(cluster, cfg.remote_agents)
+            stack.callback(lambda: _stop_remote_agents(server, agents))
         profiler.begin_phase("deploy")
         pcs = PodCliqueSet(
             meta=new_meta(cfg.pcs_name),
@@ -140,16 +149,32 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         cluster.manager.wait_idle(timeout=30.0, settle=0.3)
         before = {name: v["reconciles"] for name, v in
                   cluster.manager.healthz()["controllers"].items()}
+        pclq_ctrl = next(ct for ct in cluster.manager.controllers
+                         if ct.name == "podclique")
+        keys_before = pclq_ctrl.snapshot_key_counts()
         for ctrl in cluster.manager.controllers:
             ctrl.durations.clear()
         tracker.record("steady-state", "window-start")
         t_win = time.time()
+        # Round-robin the touches over the cliques: a naive list PREFIX
+        # touches whichever clique's pods happen to sort first (creation
+        # interleaving is nondeterministic under concurrent deploy), so
+        # the per-clique floor below would flake. Interleaving makes the
+        # stimulus — and the assertion — deterministic.
+        by_clique: dict[str, list] = {}
+        for pod in client.list(Pod, selector=sel):
+            by_clique.setdefault(
+                pod.meta.labels.get(c.LABEL_PCLQ_NAME, ""), []).append(pod)
+        rr = [p for group in zip(*(v for v in by_clique.values() if v))
+              for p in group]
         touched = 0
-        for pod in client.list(Pod, selector=sel)[:cfg.steady_touches]:
+        touched_cliques: set[str] = set()
+        for pod in rr[:cfg.steady_touches]:
             live = client.get(Pod, pod.meta.name)
             live.meta.annotations["grove.io/scale-touch"] = str(time.time())
             client.update(live)
             touched += 1
+            touched_cliques.add(pod.meta.labels.get(c.LABEL_PCLQ_NAME, ""))
         # Drain the ripple: idle again means every touched object's
         # reconcile (and any fan-out) has completed.
         cluster.manager.wait_idle(timeout=60.0, settle=0.3)
@@ -158,6 +183,7 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         after = {name: v["reconciles"] for name, v in
                  cluster.manager.healthz()["controllers"].items()}
         steady_reconciles = sum(after[k] - before[k] for k in after)
+        keys_after = pclq_ctrl.snapshot_key_counts()
         durations = sorted(
             d for ctrl in cluster.manager.controllers
             for d in list(ctrl.durations))
@@ -177,18 +203,36 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         # p95 at 300 pods / 4 agents vs ~20ms in-process) without
         # implying any algorithmic regression — the bound still catches
         # quadratic blowups.
-        budget = cfg.steady_p95_budget_s * (2 if cfg.remote_agents else 1)
+        # Env-tunable for loaded shared CI runners (a hard wall-clock
+        # bound on a noisy box is a flake, not a regression catch).
+        import os as _os
+        budget = float(_os.environ.get("GROVE_SCALE_P95_BUDGET_S",
+                                       cfg.steady_p95_budget_s)) \
+            * (2 if cfg.remote_agents else 1)
         assert touched > 0, "steady-state stimulus touched nothing"
         # Pod touches map to their owning clique's request and the
-        # workqueue dirty-set COALESCES them (50 touches over 3 cliques
-        # legitimately cost ~3-4 reconciles — that dedupe is the design,
-        # reference expectations/workqueue semantics). The floor is one
-        # reconcile per touched clique; reconciles ≈ touches would mean
-        # coalescing broke and steady state pays per-event.
-        assert steady_reconciles >= min(cfg.cliques, touched), (
+        # workqueue dirty-set COALESCES them (30 touches over 3 cliques
+        # legitimately cost ~3-6 reconciles — that dedupe is the design,
+        # reference expectations/workqueue semantics). The floor is
+        # PER-CLIQUE: every clique whose pod was touched must see ≥1
+        # podclique reconcile — an aggregate floor met with zero margin
+        # can't distinguish "coalescing works" from "fan-out lost".
+        # Reconciles ≈ touches would mean coalescing broke and steady
+        # state pays per-event.
+        per_clique = {}
+        ns = pcs.meta.namespace
+        for clique in touched_cliques:
+            key = f"{ns}/{clique}"
+            per_clique[clique] = (keys_after.get(key, 0)
+                                  - keys_before.get(key, 0))
+        missing = [k for k, v in per_clique.items() if v < 1]
+        assert not missing, (
+            f"touched cliques saw no reconcile: {missing} "
+            f"(per-clique deltas {per_clique}, {touched} touches) — "
+            "touches are not reaching controllers")
+        assert steady_reconciles >= len(touched_cliques), (
             f"stimulus produced {steady_reconciles} reconciles for "
-            f"{touched} touches over {cfg.cliques} cliques — touches are "
-            "not reaching controllers")
+            f"{touched} touches over {len(touched_cliques)} cliques")
         assert durations, "no reconcile durations captured in the window"
         assert _pct(0.95) < budget, (
             f"steady-state reconcile p95 {_pct(0.95) * 1e3:.1f}ms over "
@@ -219,6 +263,8 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         "deploy_available_s": tracker.duration(
             "deploy", "pcs-created", "pcs-available"),
         "steady_touches": touched,
+        "steady_touched_cliques": len(touched_cliques),
+        "steady_per_clique_reconciles": per_clique,
         "steady_reconciles": steady_reconciles,
         "steady_reconciles_per_s": steady_reconciles / steady_window_s,
         "steady_p50_ms": round(_pct(0.50) * 1e3, 3),

@@ -1,0 +1,41 @@
+"""bench.py supervisor contract: the driver parses the LAST stdout line,
+and its capture window is finite — so (a) the supervisor's worst case
+must fit the window and (b) every exit path must leave a parseable JSON
+line (round-3 regression: 3x600s watchdogs exceeded the window and the
+round's perf artifact was `parsed: null`)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import bench
+
+
+def test_supervisor_worst_case_fits_driver_window():
+    """attempts x watchdog + delays must stay under the total budget,
+    and the total budget under ~500s (the driver's observed window)."""
+    worst = (bench.RUN_ATTEMPTS * bench.ATTEMPT_TIMEOUT_S
+             + (bench.RUN_ATTEMPTS - 1) * bench.RUN_RETRY_DELAY_S)
+    assert worst <= bench.TOTAL_BUDGET_S
+    assert bench.TOTAL_BUDGET_S <= 500
+    assert bench.ATTEMPT_TIMEOUT_S <= 240
+
+
+def test_failed_attempt_still_prints_parseable_json():
+    """A failing child leaves a parseable failure JSON as the last line
+    even when the supervisor is killed before its final summary — the
+    per-attempt emission is the guarantee."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GROVE_BENCH_MODEL="nosuch",
+               GROVE_BENCH_HISTORY="0", GROVE_BENCH_ATTEMPTS="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert len(lines) >= 2          # one per attempt + final summary
+    for ln in lines:
+        parsed = json.loads(ln)     # every line is parseable
+        assert parsed["value"] == 0.0
+        assert "error" in parsed

@@ -18,15 +18,36 @@ def test_scale_300_pods_within_budget():
     assert res["deploy_pods_ready_s"] < 90
     assert res["deploy_available_s"] < 90
     # Steady state is measured under a STIMULUS (annotation touches on
-    # pods, reference scale_test.go:216-240): the touches must produce a
+    # pods, reference scale_test.go:216-240): the touches are spread
+    # round-robin over the cliques, so every clique must see its own
     # reconcile ripple — coalesced by the workqueue dirty-set to ~one
     # reconcile per owning clique — and each reconcile must stay cheap.
     assert res["steady_touches"] == 30
+    assert res["steady_touched_cliques"] == 3
+    assert all(v >= 1 for v in res["steady_per_clique_reconciles"].values())
     assert res["steady_reconciles"] >= 3
-    assert 0 < res["steady_p95_ms"] < 250
+    import os
+    budget_ms = float(os.environ.get("GROVE_SCALE_P95_BUDGET_S", "0.25")) * 1e3
+    assert 0 < res["steady_p95_ms"] < budget_ms
     # Delete request returns fast; cascade completes.
     assert res["delete_request_s"] < 1.0
     assert res["delete_cascade_s"] < 30
+
+
+def test_scale_remote_agents_smoke():
+    """CI-size wire-mode run: pod readiness driven by real agent
+    PROCESSES over the HTTP API (watch + batched status writes +
+    heartbeats) instead of the in-process fake kubelet. Keeps the
+    --remote-agents path — watch feed, PATCH status, /batch status —
+    from regressing silently between the big out-of-band runs."""
+    res = run_scale_test(ScaleConfig(pods=48, cliques=2,
+                                     deploy_timeout=60.0,
+                                     steady_touches=10,
+                                     remote_agents=2))
+    assert res["remote_agents"] == 2
+    assert res["deploy_pods_ready_s"] < 60
+    assert res["steady_touched_cliques"] == 2
+    assert all(v >= 1 for v in res["steady_per_clique_reconciles"].values())
 
 
 def test_soak_scale_cycles():
