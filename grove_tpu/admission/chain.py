@@ -24,9 +24,22 @@ from grove_tpu.scheduler.framework import Registry
 
 class AdmissionChain:
     def __init__(self, config: OperatorConfiguration,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 store: Any = None):
         self.config = config
         self.registry = registry
+        self._store = store    # fleet access for requests-vs-host rules
+
+    def _fleet_nodes(self) -> list | None:
+        """Live Nodes for the fleet-fit validation rules. The store's
+        RLock makes the nested list safe from inside an admit call."""
+        if self._store is None:
+            return None
+        from grove_tpu.api import Node
+        try:
+            return self._store.list(Node, namespace=None)
+        except Exception:  # noqa: BLE001 — fleet rules are best-effort
+            return None
 
     def admit(self, verb: str, obj: Any, old: Any, actor: str) -> Any:
         """Mutate (defaulting) and validate; raise on rejection."""
@@ -37,7 +50,11 @@ class AdmissionChain:
             return obj
         if obj.KIND == "PodCliqueSet":
             obj = default_podcliqueset(obj)
-            problems = validate_podcliqueset(obj, self.registry, old)
+            # Fleet-fit rules gate creation only — don't pay an
+            # O(fleet) Node list+clone on every spec update.
+            nodes = self._fleet_nodes() if old is None else None
+            problems = validate_podcliqueset(obj, self.registry, old,
+                                             nodes=nodes)
             if problems:
                 raise ValidationError(
                     f"PodCliqueSet {obj.meta.name!r} rejected: "
@@ -55,6 +72,6 @@ class AdmissionChain:
 
 def install_admission(store, config: OperatorConfiguration,
                       registry: Registry | None = None) -> AdmissionChain:
-    chain = AdmissionChain(config, registry)
+    chain = AdmissionChain(config, registry, store=store)
     store.set_admission(chain)
     return chain

@@ -33,11 +33,18 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
     uses_tpu = any(t.tpu_chips_per_pod > 0 for t in tmpl.cliques)
     if tmpl.topology is None and uses_tpu:
         tmpl.topology = TopologyConstraint(pack_level="slice", required=True)
-    # Contradictory auto_scaling bounds are NOT silently repaired here —
-    # validation rejects them uniformly at every level (clique/SG/PCS).
+    # Semantic inference (reference defaulting podcliqueset.go:80,97):
+    # an autoscaler without an explicit floor never scales below the
+    # declared steady-state replicas. Contradictory bounds are NOT
+    # silently repaired — validation rejects them uniformly.
+    if spec.auto_scaling is not None \
+            and spec.auto_scaling.min_replicas is None:
+        spec.auto_scaling.min_replicas = spec.replicas
     for t in tmpl.cliques:
         if t.replicas < 1:
             t.replicas = 1
+        if t.auto_scaling is not None and t.auto_scaling.min_replicas is None:
+            t.auto_scaling.min_replicas = t.replicas
         if t.min_available is None:
             # Autoscaled cliques default their gang floor to the scaling
             # floor (so scale-in below the initial replica count works);
@@ -50,6 +57,9 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
     for sg in tmpl.scaling_groups:
         if sg.replicas < 1:
             sg.replicas = 1
+        if sg.auto_scaling is not None \
+                and sg.auto_scaling.min_replicas is None:
+            sg.auto_scaling.min_replicas = sg.replicas
         if sg.min_available is None:
             sg.min_available = 1  # one gang-guaranteed instance; rest elastic
     return pcs

@@ -210,7 +210,9 @@ def _validate_shape(pcs: PodCliqueSet) -> list[str]:
                     obj.auto_scaling)
             else:
                 a = obj.auto_scaling
-                if not _is_int(a.min_replicas) or not _is_int(a.max_replicas):
+                if (a.min_replicas is not None
+                        and not _is_int(a.min_replicas)) \
+                        or not _is_int(a.max_replicas):
                     bad(f"{f}.auto_scaling.min/max_replicas", "integers",
                         (a.min_replicas, a.max_replicas))
         if obj.topology is not None and \
@@ -293,6 +295,60 @@ def _validate_container(field: str, spec: ContainerSpec,
         if ".." in parts:
             errs.append(f"{field}.readiness_file must not contain '..' "
                         f"(path escape), got {spec.readiness_file!r}")
+        if len(spec.readiness_file) > 4096:
+            errs.append(f"{field}.readiness_file exceeds 4096 chars")
+    # Probe timing bounds (k8s probe-field validation analog; the node
+    # agent honors these — agent/process.py _probe_readiness).
+    probe_declared = isinstance(spec.readiness_file, str) \
+        and bool(spec.readiness_file)
+    for pf, lo, hi in (("readiness_initial_delay_s", 0.0, 3600.0),
+                       ("readiness_period_s", 0.05, 300.0),
+                       ("readiness_timeout_s", 0.0, 86400.0)):
+        v = getattr(spec, pf)
+        if not _is_num(v):
+            errs.append(f"{field}.{pf} must be a number")
+            continue
+        if pf == "readiness_timeout_s" and v == 0:
+            continue                      # 0 = no deadline, always legal
+        if not (lo <= v <= hi):
+            errs.append(f"{field}.{pf} {v} outside [{lo}, {hi}]")
+        if not probe_declared and v != ContainerSpec.__dataclass_fields__[
+                pf].default:
+            errs.append(f"{field}.{pf} set without readiness_file; probe "
+                        "timing without a probe does nothing")
+    if probe_declared and _is_num(spec.readiness_timeout_s) \
+            and _is_num(spec.readiness_period_s) \
+            and 0 < spec.readiness_timeout_s < spec.readiness_period_s:
+        errs.append(f"{field}.readiness_timeout_s "
+                    f"{spec.readiness_timeout_s} < readiness_period_s "
+                    f"{spec.readiness_period_s}: the probe would time out "
+                    "before its first check")
+
+
+def _validate_autoscaling(field: str, a, replicas: int,
+                          min_available, errs: list[str]) -> None:
+    """Shared HPA-bounds rules (reference validateScaleConfig,
+    validation/podcliqueset.go:573): floor >= 1, floor <= ceiling,
+    ceiling >= declared replicas (an autoscaler whose max is below the
+    steady state would fight the declared shape on its first pass), and
+    floor >= the gang floor (scaling below min_available would
+    permanently breach the gang). min_replicas may be None when
+    validating a spec that has not been through defaulting admission —
+    it then resolves to ``replicas``, matching the defaulting inference.
+    """
+    lo = a.min_replicas if a.min_replicas is not None else replicas
+    if lo < 1:
+        errs.append(f"{field}: auto_scaling.min_replicas must be >= 1")
+    if lo > a.max_replicas:
+        errs.append(f"{field}: auto_scaling min {lo} > max "
+                    f"{a.max_replicas}")
+    if a.max_replicas < replicas:
+        errs.append(f"{field}: auto_scaling.max_replicas "
+                    f"{a.max_replicas} < replicas {replicas}; the "
+                    "autoscaler would fight the declared steady state")
+    if min_available is not None and lo < min_available:
+        errs.append(f"{field}: auto_scaling.min_replicas must be >= "
+                    "min_available (the gang floor)")
 
 
 def _digits(n: int) -> int:
@@ -437,6 +493,31 @@ def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
                     f"scaling group {sg.name!r}: one slice-packed replica "
                     f"needs {total} chips; no TPU generation builds a "
                     f"slice that large (max {_MAX_SLICE_CHIPS})")
+
+
+def _validate_fleet_fit(pcs: PodCliqueSet, errs: list[str],
+                        nodes: list | None) -> None:
+    """Per-pod requests vs the LIVE fleet's host shapes (reference
+    webhook validation checks pod resource requests against what nodes
+    can serve; _validate_chips above only checks physical possibility
+    across ALL TPU generations). A pod asking for more chips than any
+    host in this fleet has can never schedule — growth doesn't fix it,
+    because new slices of the fleet's generation have the same host
+    shape. GANG-level fit is deliberately NOT checked here: a gang
+    bigger than today's largest slice stays Pending and schedules when
+    a bigger slice joins (the scheduler's optimism; proven by
+    test_gang_does_not_fit_stays_pending). Skipped when the fleet is
+    empty."""
+    if not nodes:
+        return
+    max_host = max(n.spec.tpu_chips for n in nodes)
+    for t in pcs.spec.template.cliques:
+        n_chips = t.tpu_chips_per_pod
+        if 0 < max_host < n_chips:
+            errs.append(
+                f"clique {t.name!r}: tpu_chips_per_pod={n_chips} but the "
+                f"largest host in the live fleet has {max_host} chips; "
+                "no node can serve this pod")
 
 
 def _check_reservation_template(rt, f: str, seen: set[str],
@@ -647,8 +728,11 @@ def _validate_update(pcs: PodCliqueSet, old: PodCliqueSet,
 
 def validate_podcliqueset(pcs: PodCliqueSet,
                           registry: Registry | None = None,
-                          old: PodCliqueSet | None = None) -> list[str]:
-    """Return all problems (empty == admitted)."""
+                          old: PodCliqueSet | None = None,
+                          nodes: list | None = None) -> list[str]:
+    """Return all problems (empty == admitted). ``nodes`` (the live
+    fleet, supplied by the admission chain) enables the
+    requests-vs-host-shapes rules; None skips them."""
     errs = _validate_shape(pcs)
     if errs:
         return errs
@@ -660,12 +744,8 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     if spec.replicas < 1:
         errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
     if spec.auto_scaling is not None:
-        a = spec.auto_scaling
-        if a.min_replicas > a.max_replicas:
-            errs.append(f"spec.auto_scaling min {a.min_replicas} > max "
-                        f"{a.max_replicas}")
-        if a.min_replicas < 1:
-            errs.append("spec.auto_scaling.min_replicas must be >= 1")
+        _validate_autoscaling("spec", spec.auto_scaling, spec.replicas,
+                              None, errs)
     if not tmpl.cliques:
         errs.append("spec.template.cliques must not be empty")
 
@@ -689,15 +769,8 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                         f"{t.priority_class!r}")
         _validate_container(f + ".container", t.container, errs)
         if t.auto_scaling is not None:
-            a = t.auto_scaling
-            if a.min_replicas < 1:
-                errs.append(f"{f}: auto_scaling.min_replicas must be >= 1")
-            if a.min_replicas > a.max_replicas:
-                errs.append(f"{f}: auto_scaling min {a.min_replicas} > max "
-                            f"{a.max_replicas}")
-            if t.min_available is not None and a.min_replicas < t.min_available:
-                errs.append(f"{f}: auto_scaling.min_replicas must be >= "
-                            f"min_available (the gang floor)")
+            _validate_autoscaling(f, t.auto_scaling, t.replicas,
+                                  t.min_available, errs)
         _validate_topology(f + ".topology", t.topology, tmpl.topology, errs)
 
     # startup DAG (reference podcliquedeps.go:53: Tarjan SCC)
@@ -713,8 +786,16 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     known = set(names)
     graph = {t.name: [] for t in tmpl.cliques}
     for t in tmpl.cliques:
+        if len(set(t.starts_after)) != len(t.starts_after):
+            # reference sliceMustHaveUniqueElements
+            # (validation/podcliqueset.go:549)
+            errs.append(f"clique {t.name!r}: starts_after has duplicate "
+                        f"entries: {t.starts_after}")
         for dep in t.starts_after:
-            if dep == t.name:
+            if not dep:
+                errs.append(f"clique {t.name!r}: starts_after entry is "
+                            "empty")
+            elif dep == t.name:
                 errs.append(f"clique {t.name!r}: starts_after itself")
             elif dep not in known:
                 errs.append(f"clique {t.name!r}: starts_after unknown clique "
@@ -764,16 +845,8 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                         "auto_scaling; scaling-group members scale only "
                         "through the group's auto_scaling")
         if sg.auto_scaling is not None:
-            a = sg.auto_scaling
-            if a.min_replicas < 1:
-                errs.append(f"{f}: auto_scaling.min_replicas must be >= 1")
-            if a.min_replicas > a.max_replicas:
-                errs.append(f"{f}: auto_scaling min {a.min_replicas} > max "
-                            f"{a.max_replicas}")
-            if sg.min_available is not None \
-                    and a.min_replicas < sg.min_available:
-                errs.append(f"{f}: auto_scaling.min_replicas must be >= "
-                            "min_available (the gang floor)")
+            _validate_autoscaling(f, sg.auto_scaling, sg.replicas,
+                                  sg.min_available, errs)
         _validate_topology(f + ".topology", sg.topology, tmpl.topology, errs)
 
     _validate_topology("spec.template.topology", tmpl.topology, None, errs)
@@ -788,6 +861,12 @@ def validate_podcliqueset(pcs: PodCliqueSet,
 
     _validate_name_budgets(pcs, errs)
     _validate_chips(pcs, errs)
+    if old is None:
+        # Live-fleet fit gates CREATION only: a fleet that shrinks
+        # under a running PCS must not brick every subsequent spec
+        # update (autoscaler replica writes included) of an object
+        # that was admissible when created.
+        _validate_fleet_fit(pcs, errs, nodes)
     _validate_reservations(pcs, errs)
 
     # update immutability (reference validation: structure is immutable,

@@ -59,6 +59,7 @@ class ProcessKubelet:
         # updates) so a stale process is never adopted; the namespace in
         # the key keeps same-named pods in different namespaces apart.
         self._procs: dict[tuple[str, str], tuple[str, subprocess.Popen]] = {}
+        self._last_probe: dict[tuple[str, str], float] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -118,10 +119,13 @@ class ProcessKubelet:
             code = proc.poll()
             if code is not None:
                 del self._procs[key]
+                self._last_probe.pop(key, None)
                 self._set_exit_status(pod, code)
                 reaped.add(key)
                 continue
-            self._probe_readiness(pod)
+            if self._probe_readiness(pod):
+                reaped.add(key)    # probe-timeout FAILED: keep the
+                # orphan pass from stomping the ProbeTimeout status
 
         # Orphans: a RUNNING pod on my node with no process entry means
         # its process belonged to a previous agent incarnation (or its
@@ -278,19 +282,54 @@ class ProcessKubelet:
         self.log.info("pod %s: started pid %d on %s", pod.meta.name,
                       proc.pid, node.meta.name)
 
-    def _probe_readiness(self, pod: Pod) -> None:
-        """Flip Ready → True once a declared readiness file appears."""
-        probe = pod.spec.container.readiness_file
+    def _probe_readiness(self, pod: Pod) -> bool:
+        """Flip Ready → True once a declared readiness file appears,
+        honoring the probe-timing contract (admission-validated bounds):
+        no check before initial_delay after start; checks at most every
+        period; a timeout > 0 FAILS the pod if the file never appears
+        within initial_delay + timeout (→ MinAvailableBreached → the
+        standard gang self-heal, exactly what a pod that will never
+        serve should trigger). Returns True iff the pod was failed for
+        probe timeout this call."""
+        spec = pod.spec.container
+        probe = spec.readiness_file
         if not probe:
-            return
+            return False
         ready = next((cd for cd in pod.status.conditions
                       if cd.type == c.COND_READY), None)
         if ready is not None and ready.status == "True":
-            return
+            return False
+        now = time.time()
+        started = pod.status.start_time or now
+        if now < started + spec.readiness_initial_delay_s:
+            return False
+        key = (pod.meta.namespace, pod.meta.name)
+        last = self._last_probe.get(key, 0.0)
+        if now - last < spec.readiness_period_s:
+            return False
+        self._last_probe[key] = now
         path = probe if os.path.isabs(probe) else os.path.join(
             pod.spec.container.workdir or self.workdir or ".", probe)
         if not os.path.exists(path):
-            return
+            t = spec.readiness_timeout_s
+            if t > 0 and now > started + spec.readiness_initial_delay_s + t:
+                self.log.warning("pod %s: readiness probe timed out "
+                                 "(%.1fs); failing", pod.meta.name, t)
+                entry = self._procs.pop(key, None)
+                if entry is not None:
+                    self._terminate(key, entry[1])
+
+                def probe_timeout(p: Pod) -> None:
+                    p.status.phase = PodPhase.FAILED
+                    p.status.message = f"readiness probe timed out ({t}s)"
+                    p.status.conditions = set_condition(
+                        p.status.conditions,
+                        Condition(type=c.COND_READY, status="False",
+                                  reason="ProbeTimeout", message=probe))
+
+                self._write_status(pod, probe_timeout)
+                return True
+            return False
 
         def mark_ready(p: Pod) -> None:
             p.status.conditions = set_condition(
@@ -333,6 +372,7 @@ class ProcessKubelet:
 
     def _terminate(self, key, proc: subprocess.Popen) -> None:
         self._procs.pop(key, None)
+        self._last_probe.pop(key, None)
         if proc.poll() is None:
             try:
                 os.killpg(proc.pid, signal.SIGTERM)

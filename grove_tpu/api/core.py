@@ -37,6 +37,15 @@ class ContainerSpec:
     # relative to the pod workdir) — e.g. written by a serving engine
     # after weights load. Unset → Ready at process start.
     readiness_file: str = ""
+    # Probe timing (k8s initialDelaySeconds / periodSeconds /
+    # failureThreshold×period analog; bounds enforced by admission,
+    # honored by the node agent): no probe before initial_delay after
+    # process start; checks at most every period; timeout > 0 fails the
+    # pod (→ MinAvailableBreached → gang handling) if the file never
+    # appears within initial_delay + timeout.
+    readiness_initial_delay_s: float = 0.0
+    readiness_period_s: float = 0.5
+    readiness_timeout_s: float = 0.0
 
 
 @dataclasses.dataclass

@@ -76,9 +76,15 @@ class TopologyConstraint:
 @dataclasses.dataclass
 class AutoScalingConfig:
     """HPA-analog config (reference podclique.go:89-109): the autoscaler
-    controller scales replicas between bounds on a target metric."""
+    controller scales replicas between bounds on a target metric.
 
-    min_replicas: int = 1
+    ``min_replicas`` left unset is inferred by defaulting admission from
+    the owning scope's ``replicas`` (reference defaulting
+    podcliqueset.go:80,97: ScaleConfig.MinReplicas ← Replicas) — the
+    autoscaler then never scales below the declared steady state unless
+    the user explicitly allows it."""
+
+    min_replicas: Optional[int] = None
     max_replicas: int = 1
     metric: str = "queue_depth"
     target_value: float = 0.0

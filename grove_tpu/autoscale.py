@@ -133,8 +133,11 @@ class Autoscaler:
                                          namespace=obj.meta.namespace)
                 if value is None:
                     continue
+                # min_replicas is filled by defaulting admission for
+                # template-declared configs; an un-admitted object
+                # (direct construction) floors at 1.
                 want = desired_replicas(value, a.target_value,
-                                        a.min_replicas, a.max_replicas)
+                                        a.min_replicas or 1, a.max_replicas)
                 want = self._stabilized(obj, want)
                 if want != obj.spec.replicas:
                     self.log.info("scaling %s/%s %d -> %d (%s=%.2f)",

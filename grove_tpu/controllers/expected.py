@@ -139,18 +139,29 @@ def effective_starts_after(pcs: PodCliqueSet,
 
 
 def _starts_after_fqns(pcs: PodCliqueSet, replica: int,
-                       parents: list[str]) -> list[str]:
+                       parents: list[str], child: str = "",
+                       pcsg_replica: int = 0) -> list[str]:
     """Map parent clique names to PCLQ FQNs within the same PCS replica.
 
-    A parent inside a scaling group resolves to its replica-0..minAvailable
-    instances (the gang-guaranteed ones)."""
+    A parent in the SAME scaling group as the ``child`` clique resolves
+    instance-locally — replica j's worker waits on replica j's leader,
+    not instance 0's (each PCSG replica is one independent model
+    instance; cross-instance ordering would serialize scale-out and
+    wait on the wrong pods). A parent in a DIFFERENT group (or a
+    standalone child's grouped parent) resolves to the parent group's
+    gang-guaranteed instances [0, minAvailable) — the ones the base
+    PodGang promises exist."""
     sg_of = {name: sg for sg in pcs.spec.template.scaling_groups
              for name in sg.clique_names}
+    child_sg = sg_of.get(child)
     fqns: list[str] = []
     for parent in parents:
         sg = sg_of.get(parent)
         if sg is None:
             fqns.append(namegen.pclq_name(pcs.meta.name, replica, parent))
+        elif child_sg is not None and sg.name == child_sg.name:
+            fqns.append(namegen.pcsg_pclq_name(
+                pcs.meta.name, replica, sg.name, pcsg_replica, parent))
         else:
             for j in range(sg_min_available(sg)):
                 fqns.append(namegen.pcsg_pclq_name(
@@ -254,7 +265,9 @@ def _clique_to_spec(pcs: PodCliqueSet, replica: int, t: PodCliqueTemplate,
         min_available=min_available(t),
         template=t,
         starts_after=_starts_after_fqns(pcs, replica,
-                                        effective_starts_after(pcs, t)),
+                                        effective_starts_after(pcs, t),
+                                        child=t.name,
+                                        pcsg_replica=pcsg_replica),
         auto_scaling=t.auto_scaling,
         pcs_name=pcs.meta.name,
         pcs_replica=replica,

@@ -118,8 +118,24 @@ def merge_status(status_obj: Any, patch: dict) -> Any:
             if others and all(v is None for v in others.values()):
                 by_type.pop(entry["type"], None)   # explicit-null delete
             else:
-                by_type[entry["type"]] = json_merge_patch(
-                    by_type.get(entry["type"], {}), entry)
+                old = by_type.get(entry["type"], {})
+                new = json_merge_patch(old, entry)
+                # Condition-timestamp invariant (api/meta.set_condition):
+                # last_transition_time stamps when ``status`` last
+                # CHANGED. Wire writers don't supply it, so the merge
+                # must maintain it — otherwise a condition patched over
+                # the wire carries 0.0/stale and every transition-age
+                # reader (e.g. breach_started_at in replica_lifecycle)
+                # sees "breached since epoch" → instant gang
+                # termination.
+                if entry.get("last_transition_time") is None:
+                    import time as _time
+                    if old.get("status") != new.get("status"):
+                        new["last_transition_time"] = _time.time()
+                    else:
+                        new["last_transition_time"] = \
+                            old.get("last_transition_time", 0.0)
+                by_type[entry["type"]] = new
         merged["conditions"] = list(by_type.values())
     try:
         patched = from_dict(cls, merged)

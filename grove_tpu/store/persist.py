@@ -73,9 +73,86 @@ class StateLockError(RuntimeError):
 # lock is cross-PROCESS single-writer protection; within one process,
 # sequential Store instances over one dir (the test harness's simulated
 # restarts) share the held lock. Entries live until process exit — the
-# kernel then releases the flock, even on SIGKILL, which is what makes
-# standby takeover work without a heartbeat protocol.
+# kernel then releases the flock, even on SIGKILL, which covers every
+# DEAD holder without a heartbeat protocol. The lease below covers the
+# one case flock can't: a holder that is alive but WEDGED.
 _PROCESS_LOCKS: dict[str, int] = {}
+
+# Lease TTL for wedged-holder fencing (reference leader election renews
+# a Lease with a TTL, manager.go:55-147 — a leader that stops renewing
+# loses leadership even if its process is still alive). The holder
+# re-stamps <state_dir>/LEASE every TTL/5; a takeover standby that sees
+# the flock held AND the lease stale beyond the TTL SIGKILLs the holder
+# (fencing — a flock cannot be revoked from outside, so terminating the
+# wedged process is what releases it). Must be consistent across the
+# processes sharing a state dir.
+def _lease_ttl() -> float:
+    return float(os.environ.get("GROVE_LEASE_TTL", 10.0))
+
+
+def _lease_path(state_dir: str) -> str:
+    return os.path.join(state_dir, "LEASE")
+
+
+def _stamp_lease(state_dir: str) -> None:
+    import time
+    path = _lease_path(state_dir)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"pid": os.getpid(), "ts": time.time()}))
+        os.replace(tmp, path)                 # atomic: readers never tear
+    except OSError:
+        pass                                  # lease is advisory liveness
+
+
+def _start_lease_heartbeat(state_dir: str) -> None:
+    """Daemon renewal thread for the process lifetime. A SIGSTOPped or
+    otherwise wedged process stops renewing (all its threads freeze),
+    which is exactly the signal the standby fences on."""
+    import threading
+    import time
+
+    _stamp_lease(state_dir)
+
+    def loop() -> None:
+        interval = max(_lease_ttl() / 5.0, 0.05)
+        while True:
+            time.sleep(interval)
+            _stamp_lease(state_dir)
+
+    threading.Thread(target=loop, name="state-lease", daemon=True).start()
+
+
+def _maybe_fence_wedged_holder(state_dir: str, lock_fd: int) -> None:
+    """SIGKILL the lock holder iff its lease expired AND the lease pid
+    still matches the LOCK stamp (guards against recycled pids and the
+    window where a new holder just took over)."""
+    import signal
+    import time
+    try:
+        with open(_lease_path(state_dir)) as f:
+            lease = json.loads(f.read())
+        pid, ts = int(lease["pid"]), float(lease["ts"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return          # no lease evidence: wait for flock release only
+    if time.time() - ts <= _lease_ttl():
+        return
+    try:
+        os.lseek(lock_fd, 0, os.SEEK_SET)
+        holder = os.read(lock_fd, 256).decode(errors="replace")
+        holder_pid = int(holder.strip().split("pid=")[1].split()[0])
+    except (OSError, IndexError, ValueError):
+        return
+    # Exact pid comparison — a substring match would let a stale lease
+    # whose pid is a numeric prefix of the holder's (123 vs 1234) fence
+    # an unrelated (possibly recycled) pid.
+    if holder_pid != pid or pid <= 1 or pid == os.getpid():
+        return
+    try:
+        os.kill(pid, signal.SIGKILL)          # works on stopped processes
+    except (ProcessLookupError, PermissionError):
+        pass                                  # gone already / not ours
 
 
 def _acquire_state_lock(state_dir: str, wait: bool) -> None:
@@ -83,36 +160,51 @@ def _acquire_state_lock(state_dir: str, wait: bool) -> None:
     (reference runs leader-elected, manager.go:55-147; without this, two
     ``serve --state-dir X`` processes interleave WAL appends and clobber
     each other's snapshots, silently corrupting the state the WAL exists
-    to protect). ``wait=True`` blocks until the current holder exits
-    (standby takeover); ``wait=False`` refuses immediately with the
-    holder's identity."""
+    to protect). ``wait=True`` waits until the current holder exits OR
+    its lease goes stale — a holder that is alive but wedged (hung
+    relay, deadlock, SIGSTOP) is fenced by SIGKILL after the lease TTL,
+    closing the liveness hole a pure flock leaves open. ``wait=False``
+    refuses immediately with the holder's identity."""
     import fcntl
+    import time
 
     key = os.path.realpath(state_dir)
     if key in _PROCESS_LOCKS:
         return
     fd = os.open(os.path.join(state_dir, "LOCK"),
                  os.O_CREAT | os.O_RDWR, 0o644)
-    try:
-        fcntl.flock(fd, fcntl.LOCK_EX | (0 if wait else fcntl.LOCK_NB))
-    except OSError:
-        holder = ""
+    while True:
         try:
-            holder = os.read(fd, 256).decode(errors="replace").strip()
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
         except OSError:
-            pass
-        os.close(fd)
-        raise StateLockError(
-            f"state dir {state_dir!r} is locked by another process"
-            + (f" ({holder})" if holder else "") +
-            "; a second writer would interleave WAL appends and corrupt "
-            "control-plane state. Stop the other serve, or run with "
-            "takeover enabled (grovectl serve --takeover) to wait for "
-            "its lease") from None
-    # Held. Stamp the holder for the refusal diagnostic above.
+            if not wait:
+                holder = ""
+                try:
+                    holder = os.read(fd, 256).decode(
+                        errors="replace").strip()
+                except OSError:
+                    pass
+                os.close(fd)
+                raise StateLockError(
+                    f"state dir {state_dir!r} is locked by another process"
+                    + (f" ({holder})" if holder else "") +
+                    "; a second writer would interleave WAL appends and "
+                    "corrupt control-plane state. Stop the other serve, or "
+                    "run with takeover enabled (grovectl serve --takeover) "
+                    "to wait for its lease") from None
+            _maybe_fence_wedged_holder(state_dir, fd)
+            time.sleep(min(_lease_ttl() / 10.0, 0.2))
+    # Held. Stamp the holder for the refusal diagnostic above, then keep
+    # the lease fresh for the process lifetime. (Rewind first: the
+    # fencing path may have read this fd, and ftruncate does not reset
+    # the offset — writing at a nonzero offset would leave NUL bytes
+    # before the stamp.)
     os.ftruncate(fd, 0)
+    os.lseek(fd, 0, os.SEEK_SET)
     os.write(fd, f"pid={os.getpid()}\n".encode())
     _PROCESS_LOCKS[key] = fd
+    _start_lease_heartbeat(state_dir)
 
 
 def migrate_object(kind: str, data: dict,

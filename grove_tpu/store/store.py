@@ -376,7 +376,13 @@ class Store:
         gang's pods Ready writes hundreds of statuses at once; one
         locked batch lets watching controllers coalesce the burst into
         one reconcile instead of N). Returns one entry per item: None on
-        success, NotFound/Validation otherwise."""
+        success, NotFound/Validation/Forbidden otherwise — admission
+        denials are per-item results, NOT a batch-level exception:
+        earlier items have already committed and emitted by the time a
+        later one is denied, so an exception here would report a
+        partially-applied batch as total failure with no indication of
+        which items landed."""
+        from grove_tpu.runtime.errors import ForbiddenError
         results: list[Exception | None] = []
         with self._lock:
             for name, patch in items:
@@ -384,7 +390,7 @@ class Store:
                     self._patch_status_locked(kind_cls, name, patch,
                                               namespace, actor)
                     results.append(None)
-                except (NotFoundError, ValidationError) as e:
+                except (NotFoundError, ValidationError, ForbiddenError) as e:
                     results.append(e)
         return results
 

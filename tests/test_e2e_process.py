@@ -18,6 +18,7 @@ from grove_tpu.api.podcliqueset import (
     PodCliqueSetSpec,
     PodCliqueSetTemplate,
     PodCliqueTemplate,
+    StartupType,
 )
 from grove_tpu.cluster import new_cluster
 from grove_tpu.topology.fleet import FleetSpec, SliceSpec
@@ -133,3 +134,61 @@ def test_crash_self_heals_with_new_process(cluster):
         p.status.phase == PodPhase.RUNNING
         for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "crashy"})),
         timeout=15.0, desc="eventually running")
+
+
+def test_readiness_probe_timeout_fails_pod(cluster):
+    """Probe timing contract end to end: a pod whose readiness file
+    never appears within initial_delay + timeout goes FAILED with its
+    process killed (→ the standard gang self-heal path), and a pod
+    whose file appears inside the window goes Ready."""
+    cl, tmp = cluster
+    client = cl.client
+    starts = tmp / "never-starts"
+    starts.mkdir()
+    # Each incarnation drops a file and then sleeps WITHOUT ever writing
+    # its readiness file — only a ProbeTimeout fail-and-recreate cycle
+    # can produce a second start.
+    never_code = (
+        "import os, time, uuid\n"
+        f"open(os.path.join({str(starts)!r}, str(uuid.uuid4())), "
+        "'w').close()\n"
+        "time.sleep(120)\n")
+    client.create(PodCliqueSet(
+        meta=new_meta("probes"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[
+                PodCliqueTemplate(
+                    name="never", replicas=1, tpu_chips_per_pod=4,
+                    container=ContainerSpec(
+                        argv=[sys.executable, "-c", never_code],
+                        readiness_file="never-ready",
+                        readiness_period_s=0.1,
+                        readiness_timeout_s=5.0)),
+                PodCliqueTemplate(
+                    name="slow", replicas=1, tpu_chips_per_pod=4,
+                    container=ContainerSpec(
+                        argv=[sys.executable, "-c",
+                              "import time, os\n"
+                              "time.sleep(0.5)\n"
+                              "open('slow-ready', 'w').close()\n"
+                              "time.sleep(120)"],
+                        readiness_file="slow-ready",
+                        readiness_period_s=0.1,
+                        readiness_timeout_s=30.0)),
+            ],
+            startup_type=StartupType.ANY_ORDER,
+        ))))
+    sel_slow = {c.LABEL_PCLQ_ROLE: "slow"}
+    from grove_tpu.api.meta import is_condition_true
+    wait_for(lambda: any(
+        is_condition_true(p.status.conditions, c.COND_READY)
+        for p in client.list(Pod, selector=sel_slow)),
+        timeout=15.0, desc="slow pod ready once file appears")
+    # ≥2 starts of the never-ready payload proves the ProbeTimeout →
+    # FAILED → gang self-heal → relaunch cycle ran (the FAILED status
+    # itself is transient: the controller replaces the pod within ms).
+    # Timeout 5s (not lower): every python child in this image takes
+    # ~2s to start (sitecustomize registers the TPU relay) — a tighter
+    # probe deadline would kill the payload before user code runs.
+    wait_for(lambda: len(list(starts.iterdir())) >= 2, timeout=45.0,
+             desc="probe-timeout pod failed and was relaunched")

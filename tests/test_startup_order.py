@@ -165,3 +165,38 @@ def test_in_order_processes_start_strictly_in_order(tmp_path):
         assert all(
             p.status.phase == PodPhase.RUNNING for p in cl.client.list(
                 Pod, selector={c.LABEL_PCS_NAME: "ordered"}))
+
+
+def test_same_group_edges_resolve_instance_locally():
+    """A scaled instance's intra-group startup edge points at ITS OWN
+    instance's parent clique (replica j's worker waits on replica j's
+    leader), while cross-scope edges resolve to the parent group's
+    gang-guaranteed instances [0, minAvailable) (controllers/expected.py
+    _starts_after_fqns; reference initc wires per-gang parents the same
+    way)."""
+    from grove_tpu.api import PodCliqueSet, new_meta
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec, PodCliqueSetTemplate, PodCliqueTemplate,
+        ScalingGroupConfig, StartupType)
+    from grove_tpu.controllers.expected import _starts_after_fqns
+
+    pcs = PodCliqueSet(
+        meta=new_meta("svc"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            startup_type=StartupType.EXPLICIT,
+            cliques=[
+                PodCliqueTemplate(name="frontend",
+                                  starts_after=["leader"]),
+                PodCliqueTemplate(name="leader"),
+                PodCliqueTemplate(name="worker", starts_after=["leader"]),
+            ],
+            scaling_groups=[ScalingGroupConfig(
+                name="model", clique_names=["leader", "worker"],
+                replicas=3, min_available=2)],
+        )))
+    # worker of instance j=2 waits on leader of instance j=2 — not j=0.
+    assert _starts_after_fqns(pcs, 0, ["leader"], child="worker",
+                              pcsg_replica=2) == ["svc-0-model-2-leader"]
+    # standalone frontend waits on the gang-guaranteed leader instances.
+    assert _starts_after_fqns(pcs, 0, ["leader"], child="frontend") == [
+        "svc-0-model-0-leader", "svc-0-model-1-leader"]

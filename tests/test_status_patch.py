@@ -1,0 +1,204 @@
+"""Status-subresource merge surface (store/patch.merge_status +
+Store.patch_status[_many] + the wire verbs): merge-by-type, explicit-null
+delete, the condition-timestamp invariant, no-op rv suppression, and
+per-item batch outcomes including mid-batch admission denials.
+
+This is the surface a fleet of wire agents writes through (the kubelet
+PATCH pattern); a silent regression here merges into every node
+heartbeat and readiness flip."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.admission.chain import install_admission
+from grove_tpu.api import Pod, constants as c, new_meta
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.meta import get_condition
+from grove_tpu.runtime.errors import (
+    ForbiddenError,
+    NotFoundError,
+    ValidationError,
+)
+from grove_tpu.store.client import Client
+from grove_tpu.store.patch import merge_status
+from grove_tpu.store.store import Store
+
+
+def pod_status():
+    p = Pod(meta=new_meta("p"))
+    return type(p.status)(**{})
+
+
+# ---- merge_status unit surface ----------------------------------------
+
+def test_conditions_merge_by_type():
+    """Updating Ready must not clobber Scheduled (the patchMergeKey
+    semantics every kube conditions field carries)."""
+    s = pod_status()
+    s2 = merge_status(s, {"conditions": [
+        {"type": "Scheduled", "status": "True", "reason": "placed"}]})
+    s3 = merge_status(s2, {"conditions": [
+        {"type": "Ready", "status": "True", "reason": "probe"}]})
+    assert get_condition(s3.conditions, "Scheduled").status == "True"
+    assert get_condition(s3.conditions, "Ready").status == "True"
+    # partial update of one type preserves its other fields
+    s4 = merge_status(s3, {"conditions": [
+        {"type": "Ready", "message": "all containers up"}]})
+    ready = get_condition(s4.conditions, "Ready")
+    assert ready.reason == "probe" and ready.message == "all containers up"
+
+
+def test_conditions_explicit_null_delete():
+    s = merge_status(pod_status(), {"conditions": [
+        {"type": "Ready", "status": "True"}]})
+    s2 = merge_status(s, {"conditions": [{"type": "Ready", "status": None}]})
+    assert get_condition(s2.conditions, "Ready") is None
+
+
+def test_conditions_reject_malformed():
+    with pytest.raises(ValidationError):
+        merge_status(pod_status(), {"conditions": [{"status": "True"}]})
+    with pytest.raises(ValidationError):
+        merge_status(pod_status(), {"conditions": {"type": "Ready"}})
+    with pytest.raises(ValidationError):
+        merge_status(pod_status(), ["not", "a", "dict"])
+
+
+def test_condition_transition_time_stamped_on_status_change():
+    """The invariant set_condition maintains (api/meta.py): ltt records
+    when ``status`` last CHANGED. Wire writers don't supply it, so the
+    merge must — otherwise transition-age readers (breach_started_at in
+    replica_lifecycle) see 'breached since epoch' and gang-terminate
+    instantly."""
+    t0 = time.time()
+    s = merge_status(pod_status(), {"conditions": [
+        {"type": "Ready", "status": "False", "reason": "starting"}]})
+    first = get_condition(s.conditions, "Ready").last_transition_time
+    assert first >= t0                       # new condition: stamped now
+    # same status → timestamp preserved, not re-stamped
+    s2 = merge_status(s, {"conditions": [
+        {"type": "Ready", "status": "False", "reason": "still starting"}]})
+    assert get_condition(s2.conditions, "Ready").last_transition_time == first
+    # status flip → re-stamped
+    time.sleep(0.01)
+    s3 = merge_status(s2, {"conditions": [
+        {"type": "Ready", "status": "True"}]})
+    assert get_condition(s3.conditions, "Ready").last_transition_time > first
+    # a writer that DOES supply the time is honored verbatim
+    s4 = merge_status(s3, {"conditions": [
+        {"type": "Ready", "status": "False", "last_transition_time": 42.0}]})
+    assert get_condition(s4.conditions, "Ready").last_transition_time == 42.0
+
+
+# ---- store surface -----------------------------------------------------
+
+def test_patch_status_noop_suppressed():
+    store = Store()
+    client = Client(store)
+    client.create(Pod(meta=new_meta("p")))
+    out = store.patch_status(Pod, "p", {"conditions": [
+        {"type": "Ready", "status": "True"}]})
+    rv = out.meta.resource_version
+    # identical patch: same status → ltt preserved → no-op → same rv
+    out2 = store.patch_status(Pod, "p", {"conditions": [
+        {"type": "Ready", "status": "True"}]})
+    assert out2.meta.resource_version == rv
+
+
+def test_patch_status_many_reports_per_item_outcomes():
+    """A mid-batch admission denial must not mask the items that already
+    committed: results carry one entry per item (None | error)."""
+    store = Store()
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    install_admission(store, cfg, registry=None)
+    operator = Client(store)
+    operator.create(Pod(meta=new_meta("mine")))          # unmanaged: alice ok
+    operator.create(Pod(meta=new_meta("managed", labels={
+        c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE})))
+    patch = {"conditions": [{"type": "Ready", "status": "True"}]}
+    results = store.patch_status_many(
+        Pod, [("mine", patch), ("managed", patch), ("ghost", patch)],
+        actor="alice")
+    assert results[0] is None
+    assert isinstance(results[1], ForbiddenError)
+    assert isinstance(results[2], NotFoundError)
+    # the first item really landed despite the later denial
+    live = operator.get(Pod, "mine")
+    assert get_condition(live.status.conditions, "Ready").status == "True"
+    # and the denied one did not
+    live = operator.get(Pod, "managed")
+    assert get_condition(live.status.conditions, "Ready") is None
+
+
+# ---- wire surface ------------------------------------------------------
+
+@pytest.fixture
+def server():
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cfg = OperatorConfiguration()
+    cfg.authorizer.enabled = True
+    cfg.server_auth.tokens["tok-op"] = OPERATOR_ACTOR
+    cfg.server_auth.tokens["tok-alice"] = "alice"
+    cl = new_cluster(config=cfg, fleet=FleetSpec(
+        slices=[SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        yield f"http://127.0.0.1:{srv.port}", cl
+        srv.stop()
+
+
+def test_wire_patch_status_stamps_transition_time(server):
+    """PATCH /api/Pod/<name>/status: the advisory regression — a wire
+    writer's condition must carry a live transition time, not 0.0."""
+    import json
+    from grove_tpu.cli import _http
+
+    base, cl = server
+    cl.client.create(Pod(meta=new_meta("wp")))
+    t0 = time.time()
+    body = json.dumps({"conditions": [
+        {"type": "Ready", "status": "True", "reason": "wire"}]}).encode()
+    status, got = _http(base, "/api/Pod/wp/status", "PATCH", body,
+                        token="tok-op")
+    assert status == 200
+    cond = [x for x in got["status"]["conditions"] if x["type"] == "Ready"][0]
+    assert cond["last_transition_time"] >= t0
+    live = cl.client.get(Pod, "wp")
+    assert get_condition(live.status.conditions, "Ready").status == "True"
+    # anonymous status write refused
+    status, _ = _http(base, "/api/Pod/wp/status", "PATCH", body)
+    assert status == 401
+
+
+def test_wire_status_batch_per_item_results(server):
+    import json
+    from grove_tpu.cli import _http
+
+    base, cl = server
+    cl.client.create(Pod(meta=new_meta("b1")))
+    cl.client.create(Pod(meta=new_meta("b2", labels={
+        c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE})))
+    patch = {"conditions": [{"type": "Ready", "status": "True"}]}
+    body = json.dumps({"items": [
+        {"name": "b1", "patch": patch},
+        {"name": "b2", "patch": patch},          # managed → alice forbidden
+        {"name": "nope", "patch": patch},        # missing → not found
+    ]}).encode()
+    status, got = _http(base, "/batch/Pod/status", "POST", body,
+                        token="tok-alice")
+    assert status == 200
+    res = got["results"]
+    assert res[0] is None
+    assert "may not" in res[1]["error"]
+    assert "not found" in res[2]["error"]
+    live = cl.client.get(Pod, "b1")
+    assert get_condition(live.status.conditions, "Ready").status == "True"

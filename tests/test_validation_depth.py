@@ -164,6 +164,166 @@ class TestScalingGroupCrossChecks:
         assert_rejected(pcs, "scale only")
 
 
+class TestProbeBounds:
+    """Readiness-probe timing rules (round-3 residual: probe bounds)."""
+
+    def test_negative_delay(self):
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", container=ContainerSpec(
+                readiness_file="/tmp/ready",
+                readiness_initial_delay_s=-1.0))])
+        assert_rejected(pcs, "readiness_initial_delay_s")
+
+    def test_period_too_small_or_large(self):
+        for period in (0.0, 301.0):
+            pcs = make_pcs(cliques=[PodCliqueTemplate(
+                name="w", container=ContainerSpec(
+                    readiness_file="/tmp/ready",
+                    readiness_period_s=period))])
+            assert_rejected(pcs, "readiness_period_s")
+
+    def test_timeout_below_period(self):
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", container=ContainerSpec(
+                readiness_file="/tmp/ready",
+                readiness_period_s=5.0, readiness_timeout_s=1.0))])
+        assert_rejected(pcs, "time out before its first check")
+
+    def test_timing_without_probe_rejected(self):
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", container=ContainerSpec(
+                readiness_timeout_s=30.0))])
+        assert_rejected(pcs, "without readiness_file")
+
+    def test_zero_timeout_means_no_deadline(self):
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", container=ContainerSpec(
+                readiness_file="/tmp/ready", readiness_timeout_s=0.0))])
+        assert not errors_of(pcs)
+
+    def test_sane_probe_passes(self):
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", container=ContainerSpec(
+                readiness_file="/tmp/ready",
+                readiness_initial_delay_s=2.0,
+                readiness_period_s=1.0, readiness_timeout_s=60.0))])
+        assert not errors_of(pcs)
+
+
+class TestStartsAfterDepth:
+    def test_duplicate_edges_rejected(self):
+        # reference sliceMustHaveUniqueElements (podcliqueset.go:549)
+        pcs = make_pcs(cliques=[
+            PodCliqueTemplate(name="a"),
+            PodCliqueTemplate(name="b", starts_after=["a", "a"])])
+        assert_rejected(pcs, "duplicate")
+
+    def test_empty_edge_rejected(self):
+        pcs = make_pcs(cliques=[
+            PodCliqueTemplate(name="a"),
+            PodCliqueTemplate(name="b", starts_after=[""])])
+        assert_rejected(pcs, "empty")
+
+
+class TestAutoscalerVsReplicas:
+    def test_max_below_declared_replicas(self):
+        # reference validateScaleConfig (podcliqueset.go:585): an
+        # autoscaler capped below the steady state fights the shape.
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", replicas=4, auto_scaling=AutoScalingConfig(
+                min_replicas=1, max_replicas=2))])
+        assert_rejected(pcs, "max_replicas")
+
+    def test_sg_max_below_replicas(self):
+        pcs = make_pcs(
+            cliques=[PodCliqueTemplate(name="w")],
+            scaling_groups=[ScalingGroupConfig(
+                name="sg", clique_names=["w"], replicas=3,
+                auto_scaling=AutoScalingConfig(min_replicas=1,
+                                               max_replicas=2))])
+        assert_rejected(pcs, "max_replicas")
+
+    def test_min_replicas_inferred_from_replicas(self):
+        # reference defaulting podcliqueset.go:80: unset MinReplicas ←
+        # Replicas, so the autoscaler never scales below steady state.
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", replicas=3, auto_scaling=AutoScalingConfig(
+                max_replicas=6))])
+        out = default_podcliqueset(pcs)
+        assert out.spec.template.cliques[0].auto_scaling.min_replicas == 3
+        assert out.spec.template.cliques[0].min_available == 3
+        assert not errors_of(out)
+
+
+class TestFleetFit:
+    """Requests vs live host shapes (round-3 residual: per-pod resource
+    requests vs fleet host capacity, topology/tpu.py shapes)."""
+
+    def _nodes(self):
+        # Two 2x2 v5e slices: one 4-chip host each.
+        from grove_tpu.topology.fleet import build_node
+        return [build_node("v5e", "2x2", f"pool-0-slice-{s}", 0)
+                for s in range(2)]
+
+    def test_pod_bigger_than_any_live_host(self):
+        # 4 chips/pod is physically fine (a full v5e host), but THIS
+        # fleet runs 2-chip host partitions — reject up front instead
+        # of leaving the gang Pending forever.
+        nodes = self._nodes()
+        for n in nodes:
+            n.spec.tpu_chips = 2
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", tpu_chips_per_pod=4)])
+        errs = validate_podcliqueset(pcs, nodes=nodes)
+        assert any("largest host in the live fleet" in e for e in errs)
+
+    def test_gang_bigger_than_live_slices_stays_admittable(self):
+        # Gang-level fit is deliberately NOT an admission rule: a gang
+        # bigger than today's largest slice stays Pending and schedules
+        # when a bigger slice joins (test_gang_does_not_fit_stays_pending
+        # proves the scheduler side).
+        nodes = self._nodes()    # 2x2 slices: 4 chips each
+        pcs = make_pcs(
+            cliques=[PodCliqueTemplate(name="w", replicas=4,
+                                       tpu_chips_per_pod=4)],
+            topology=TopologyConstraint(pack_level="slice", required=True))
+        assert not validate_podcliqueset(pcs, nodes=nodes)
+
+    def test_fitting_request_passes(self):
+        nodes = self._nodes()
+        pcs = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", tpu_chips_per_pod=4)],
+            topology=TopologyConstraint(pack_level="slice", required=True))
+        assert not validate_podcliqueset(pcs, nodes=nodes)
+
+    def test_empty_fleet_skips(self):
+        # A 16-pod slice-packed gang (64 chips) is globally buildable
+        # (v5e builds 256-chip slices) and must pass with NO fleet —
+        # the cluster may be about to grow.
+        pcs = make_pcs(
+            cliques=[PodCliqueTemplate(name="w", replicas=16,
+                                       tpu_chips_per_pod=4)],
+            topology=TopologyConstraint(pack_level="slice", required=True))
+        assert not validate_podcliqueset(pcs, nodes=[])
+
+    def test_wired_through_admission_chain(self):
+        from grove_tpu.admission.chain import install_admission
+        from grove_tpu.api.config import OperatorConfiguration
+        from grove_tpu.runtime.errors import ValidationError
+        from grove_tpu.store.client import Client
+        from grove_tpu.store.store import Store
+
+        store = Store()
+        install_admission(store, OperatorConfiguration(), registry=None)
+        client = Client(store)
+        for n in self._nodes():
+            n.spec.tpu_chips = 2           # sub-host partition fleet
+            client.create(n)
+        with pytest.raises(ValidationError, match="largest host"):
+            client.create(make_pcs(cliques=[PodCliqueTemplate(
+                name="w", tpu_chips_per_pod=4)]))
+
+
 class TestPriorityBounds:
     def test_priority_out_of_bounds(self):
         pcs = make_pcs(priority=10_000_000)
