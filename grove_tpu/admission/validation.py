@@ -325,16 +325,30 @@ def _validate_container(field: str, spec: ContainerSpec,
                     "before its first check")
 
 
+def _ratchet(old_obj, new_obj, *getters) -> bool:
+    """True when a rule should be ENFORCED: on create (no old), or when
+    an update touched the fields the rule reads. Rules added after
+    objects were persisted must ratchet this way — re-validating an
+    unchanged stanza under new rules would brick every subsequent
+    update of a legally-admitted object (the k8s ratcheting-validation
+    convention)."""
+    if old_obj is None:
+        return True
+    return any(g(old_obj) != g(new_obj) for g in getters)
+
+
 def _validate_autoscaling(field: str, a, replicas: int,
-                          min_available, errs: list[str]) -> None:
+                          min_available, errs: list[str],
+                          enforce_ceiling: bool = True) -> None:
     """Shared HPA-bounds rules (reference validateScaleConfig,
     validation/podcliqueset.go:573): floor >= 1, floor <= ceiling,
     ceiling >= declared replicas (an autoscaler whose max is below the
-    steady state would fight the declared shape on its first pass), and
-    floor >= the gang floor (scaling below min_available would
-    permanently breach the gang). min_replicas may be None when
-    validating a spec that has not been through defaulting admission —
-    it then resolves to ``replicas``, matching the defaulting inference.
+    steady state would fight the declared shape on its first pass —
+    ratcheted via ``enforce_ceiling``), and floor >= the gang floor
+    (scaling below min_available would permanently breach the gang).
+    min_replicas may be None when validating a spec that has not been
+    through defaulting admission — it then resolves to ``replicas``,
+    matching the defaulting inference.
     """
     lo = a.min_replicas if a.min_replicas is not None else replicas
     if lo < 1:
@@ -342,13 +356,19 @@ def _validate_autoscaling(field: str, a, replicas: int,
     if lo > a.max_replicas:
         errs.append(f"{field}: auto_scaling min {lo} > max "
                     f"{a.max_replicas}")
-    if a.max_replicas < replicas:
+    if enforce_ceiling and a.max_replicas < replicas:
         errs.append(f"{field}: auto_scaling.max_replicas "
                     f"{a.max_replicas} < replicas {replicas}; the "
                     "autoscaler would fight the declared steady state")
     if min_available is not None and lo < min_available:
         errs.append(f"{field}: auto_scaling.min_replicas must be >= "
                     "min_available (the gang floor)")
+
+
+def _scaling_shape(obj):
+    """Fields the autoscaling-ceiling rule reads (for ratcheting)."""
+    return (obj.replicas,
+            obj.auto_scaling.max_replicas if obj.auto_scaling else None)
 
 
 def _digits(n: int) -> int:
@@ -741,11 +761,18 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                     "(lowercase alphanumerics and '-', <= 52 chars)")
     spec = pcs.spec
     tmpl = spec.template
+    # Old-object lookups for ratcheted rules (see _ratchet).
+    _old_cliques = {t.name: t for t in
+                    old.spec.template.cliques} if old else {}
+    _old_sgs = {sg.name: sg for sg in
+                old.spec.template.scaling_groups} if old else {}
     if spec.replicas < 1:
         errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
     if spec.auto_scaling is not None:
-        _validate_autoscaling("spec", spec.auto_scaling, spec.replicas,
-                              None, errs)
+        _validate_autoscaling(
+            "spec", spec.auto_scaling, spec.replicas, None, errs,
+            enforce_ceiling=_ratchet(old.spec if old else None, spec,
+                                     _scaling_shape))
     if not tmpl.cliques:
         errs.append("spec.template.cliques must not be empty")
 
@@ -769,8 +796,10 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                         f"{t.priority_class!r}")
         _validate_container(f + ".container", t.container, errs)
         if t.auto_scaling is not None:
-            _validate_autoscaling(f, t.auto_scaling, t.replicas,
-                                  t.min_available, errs)
+            _validate_autoscaling(
+                f, t.auto_scaling, t.replicas, t.min_available, errs,
+                enforce_ceiling=_ratchet(_old_cliques.get(t.name), t,
+                                         _scaling_shape))
         _validate_topology(f + ".topology", t.topology, tmpl.topology, errs)
 
     # startup DAG (reference podcliquedeps.go:53: Tarjan SCC)
@@ -786,15 +815,21 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     known = set(names)
     graph = {t.name: [] for t in tmpl.cliques}
     for t in tmpl.cliques:
-        if len(set(t.starts_after)) != len(t.starts_after):
+        # Ratcheted (starts_after is immutable on update, so without
+        # ratcheting a pre-existing duplicate would brick the object).
+        edges_enforced = _ratchet(_old_cliques.get(t.name), t,
+                                  lambda x: tuple(x.starts_after))
+        if edges_enforced and \
+                len(set(t.starts_after)) != len(t.starts_after):
             # reference sliceMustHaveUniqueElements
             # (validation/podcliqueset.go:549)
             errs.append(f"clique {t.name!r}: starts_after has duplicate "
                         f"entries: {t.starts_after}")
         for dep in t.starts_after:
             if not dep:
-                errs.append(f"clique {t.name!r}: starts_after entry is "
-                            "empty")
+                if edges_enforced:
+                    errs.append(f"clique {t.name!r}: starts_after entry "
+                                "is empty")
             elif dep == t.name:
                 errs.append(f"clique {t.name!r}: starts_after itself")
             elif dep not in known:
@@ -845,8 +880,10 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                         "auto_scaling; scaling-group members scale only "
                         "through the group's auto_scaling")
         if sg.auto_scaling is not None:
-            _validate_autoscaling(f, sg.auto_scaling, sg.replicas,
-                                  sg.min_available, errs)
+            _validate_autoscaling(
+                f, sg.auto_scaling, sg.replicas, sg.min_available, errs,
+                enforce_ceiling=_ratchet(_old_sgs.get(sg.name), sg,
+                                         _scaling_shape))
         _validate_topology(f + ".topology", sg.topology, tmpl.topology, errs)
 
     _validate_topology("spec.template.topology", tmpl.topology, None, errs)

@@ -56,6 +56,11 @@ class ScaleConfig:
     # the in-process fake kubelet — proves the wire path holds at scale
     # (the reference's KWOK nodes still go through the apiserver).
     remote_agents: int = 0
+    # > 0: after steady state, scale the whole PCS out (replicas 2) and
+    # back in this many times, requiring full convergence each way — the
+    # reference soak_test.go cycle, runnable in wire mode.
+    soak_cycles: int = 0
+    soak_timeout: float = 300.0
 
 
 def _fleet_for(pods: int) -> FleetSpec:
@@ -238,6 +243,37 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
             f"steady-state reconcile p95 {_pct(0.95) * 1e3:.1f}ms over "
             f"budget {budget * 1e3:.0f}ms")
 
+        # Soak: scale-out/in cycles with full convergence each way
+        # (reference e2e/tests/scale/soak_test.go; here optionally over
+        # the wire — the kubelet fleet driving readiness remotely).
+        soak_cycle_s: list[float] = []
+        if cfg.soak_cycles:
+            profiler.begin_phase("soak")
+            for cyc in range(cfg.soak_cycles):
+                t_cyc = time.time()
+                for want_replicas, want_pods in ((2, 2 * cfg.pods),
+                                                 (1, cfg.pods)):
+                    # patch, not get+update: the PCS controller's status
+                    # writes race this (rv bump between get and update
+                    # → ConflictError); Client.patch retries conflicts.
+                    client.patch(PodCliqueSet, cfg.pcs_name,
+                                 {"spec": {"replicas": want_replicas}})
+                    deadline = time.time() + cfg.soak_timeout
+                    while time.time() < deadline:
+                        pods = client.list(Pod, selector=sel)
+                        if len(pods) == want_pods and all(
+                                is_condition_true(p.status.conditions,
+                                                  c.COND_READY)
+                                for p in pods):
+                            break
+                        time.sleep(cfg.poll)
+                    else:
+                        raise TimeoutError(
+                            f"soak cycle {cyc}: never converged to "
+                            f"{want_pods} ready pods")
+                soak_cycle_s.append(time.time() - t_cyc)
+                tracker.record("soak", f"cycle-{cyc}")
+
         # Delete: request latency + full cascade
         profiler.begin_phase("delete")
         t_del = time.time()
@@ -272,6 +308,8 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         "delete_request_s": delete_request_s,
         "delete_cascade_s": tracker.duration(
             "delete", "request-returned", "children-gone"),
+        "soak_cycles": cfg.soak_cycles,
+        "soak_cycle_s": [round(s, 3) for s in soak_cycle_s],
         "timeline": tracker.export(),
     }
     if cfg.profile_dir is not None:
@@ -352,6 +390,10 @@ def main(argv=None) -> int:
                         help="drive pod readiness through this many agent "
                              "processes over the HTTP wire (watch + status "
                              "writes + heartbeats) instead of in-process")
+    parser.add_argument("--soak-cycles", type=int, default=0,
+                        help="scale the PCS out (replicas 2) and back in "
+                             "this many times after steady state, requiring "
+                             "full convergence each way (soak_test analog)")
     parser.add_argument("--json", help="write full timeline JSON here")
     parser.add_argument("--history",
                         help="append a summary line to this JSONL file and "
@@ -367,7 +409,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques,
                                         profile_dir=args.profile_dir,
-                                        remote_agents=args.remote_agents))
+                                        remote_agents=args.remote_agents,
+                                        soak_cycles=args.soak_cycles))
     result.pop("profiles", None)  # summarized in the dir, not the stdout line
     timeline = result.pop("timeline")
     if args.json:

@@ -130,7 +130,11 @@ def merge_status(status_obj: Any, patch: dict) -> Any:
                 # termination.
                 if entry.get("last_transition_time") is None:
                     import time as _time
-                    if old.get("status") != new.get("status"):
+                    # A type not previously present is a NEW condition:
+                    # stamped now even if the patch omitted 'status'
+                    # (set_condition stamps every new condition; a 0.0
+                    # default here would read as 'since epoch').
+                    if not old or old.get("status") != new.get("status"):
                         new["last_transition_time"] = _time.time()
                     else:
                         new["last_transition_time"] = \

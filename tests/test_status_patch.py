@@ -95,6 +95,15 @@ def test_condition_transition_time_stamped_on_status_change():
 
 # ---- store surface -----------------------------------------------------
 
+def test_new_condition_without_status_still_stamped():
+    """A type not previously present is NEW even when the patch omits
+    'status' — 0.0 here would read as 'transitioned at epoch'."""
+    t0 = time.time()
+    s = merge_status(pod_status(), {"conditions": [
+        {"type": "Degraded", "reason": "disk"}]})
+    assert get_condition(s.conditions, "Degraded").last_transition_time >= t0
+
+
 def test_patch_status_noop_suppressed():
     store = Store()
     client = Client(store)

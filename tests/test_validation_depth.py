@@ -243,6 +243,25 @@ class TestAutoscalerVsReplicas:
                                                max_replicas=2))])
         assert_rejected(pcs, "max_replicas")
 
+    def test_ceiling_rule_ratchets_on_update(self):
+        """A rule added after objects were persisted must not brick a
+        legally-admitted object: updates that don't touch the offending
+        stanza pass; touching it re-enforces (k8s ratcheting-validation
+        convention)."""
+        old = make_pcs(cliques=[PodCliqueTemplate(
+            name="w", replicas=4, auto_scaling=AutoScalingConfig(
+                min_replicas=1, max_replicas=2))])
+        # Unrelated update (annotation-ish: bump PCS replicas) passes
+        # despite the pre-existing max<replicas violation...
+        upd = clone(old)
+        upd.spec.replicas = 2
+        assert not [e for e in errors_of(upd, old=old)
+                    if "max_replicas" in e]
+        # ...but touching the autoscaling shape re-enforces the rule.
+        upd2 = clone(old)
+        upd2.spec.template.cliques[0].auto_scaling.max_replicas = 3
+        assert_rejected(upd2, "max_replicas", old=old)
+
     def test_min_replicas_inferred_from_replicas(self):
         # reference defaulting podcliqueset.go:80: unset MinReplicas ←
         # Replicas, so the autoscaler never scales below steady state.
