@@ -308,10 +308,11 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         "delete_request_s": delete_request_s,
         "delete_cascade_s": tracker.duration(
             "delete", "request-returned", "children-gone"),
-        "soak_cycles": cfg.soak_cycles,
-        "soak_cycle_s": [round(s, 3) for s in soak_cycle_s],
         "timeline": tracker.export(),
     }
+    if cfg.soak_cycles:
+        result["soak_cycles"] = cfg.soak_cycles
+        result["soak_cycle_s"] = [round(s, 3) for s in soak_cycle_s]
     if cfg.profile_dir is not None:
         result["profiles"] = profiler.export_dir(cfg.profile_dir)
     return result
@@ -394,6 +395,9 @@ def main(argv=None) -> int:
                         help="scale the PCS out (replicas 2) and back in "
                              "this many times after steady state, requiring "
                              "full convergence each way (soak_test analog)")
+    parser.add_argument("--soak-timeout", type=float, default=300.0,
+                        help="per-direction convergence deadline for each "
+                             "soak cycle (seconds)")
     parser.add_argument("--json", help="write full timeline JSON here")
     parser.add_argument("--history",
                         help="append a summary line to this JSONL file and "
@@ -410,7 +414,8 @@ def main(argv=None) -> int:
     result = run_scale_test(ScaleConfig(pods=args.pods, cliques=args.cliques,
                                         profile_dir=args.profile_dir,
                                         remote_agents=args.remote_agents,
-                                        soak_cycles=args.soak_cycles))
+                                        soak_cycles=args.soak_cycles,
+                                        soak_timeout=args.soak_timeout))
     result.pop("profiles", None)  # summarized in the dir, not the stdout line
     timeline = result.pop("timeline")
     if args.json:

@@ -42,9 +42,12 @@ def cluster():
     fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
                                         count=3)])
     cfg = OperatorConfiguration()
-    # Short downscale stabilization so scale-back assertions fit the test
-    # budget (flap control itself is covered by test_autoscale_damping).
+    # Short downscale stabilization + fast sync so scale assertions fit
+    # the test budget (flap control itself is covered by
+    # test_autoscale_damping; the 5s production cadence adds ~8s of pure
+    # waiting per autoscaling test).
     cfg.autoscaler.scale_down_stabilization_seconds = 1.0
+    cfg.autoscaler.sync_period_seconds = 0.3
     cl = new_cluster(config=cfg, fleet=fleet)
     with cl:
         yield cl

@@ -53,14 +53,15 @@ def test_dryrun_multichip_8_under_driver_env():
 
 
 def test_dryrun_multichip_small_counts():
-    """Degenerate device counts still compile and run — n=1 (no pp, no
-    ring) and n=2 (pp=2 engages with tp=1). Separate subprocesses: the
-    device-count flag latches at backend init, so counts can't chain."""
-    for n in (1, 2):
-        proc = _run(
-            f"import __graft_entry__\n"
-            f"__graft_entry__.dryrun_multichip({n})\n", timeout=300)
-        assert proc.returncode == 0, f"n={n} stderr:\n{proc.stderr[-4000:]}"
+    """A degenerate device count still compiles and runs — n=2 engages
+    pp=2 with tp=1 and the no-ring fallbacks (n=1 exercises strictly
+    fewer paths and costs a full extra subprocess+compile cycle; the
+    single-device path is already covered by test_entry_compiles and
+    every plain-jit test in the suite)."""
+    proc = _run(
+        "import __graft_entry__\n"
+        "__graft_entry__.dryrun_multichip(2)\n", timeout=300)
+    assert proc.returncode == 0, f"n=2 stderr:\n{proc.stderr[-4000:]}"
 
 
 def test_entry_compiles_single_chip():

@@ -69,6 +69,7 @@ def test_soak_scale_cycles():
     # Fast scale-in cycles are the point of the soak; flap control is
     # covered by test_autoscale_damping.
     cfg.autoscaler.scale_down_stabilization_seconds = 0.5
+    cfg.autoscaler.sync_period_seconds = 0.3
     with new_cluster(config=cfg, fleet=fleet) as cl:
         client = cl.client
         client.create(PodCliqueSet(
