@@ -41,6 +41,31 @@ class AdmissionChain:
         except Exception:  # noqa: BLE001 — fleet rules are best-effort
             return None
 
+    def _topology_levels(self) -> list | None:
+        """The active ClusterTopology's outer→inner domain names, so
+        constraint levels validate against the hierarchy the scheduler
+        actually uses (reference validateResolvableTopologyConstraint).
+        Selection is deterministic and matches the scheduler side: the
+        CT named 'default' (what ensure_default_topology creates and
+        the backends sync), else the single existing CT; with multiple
+        non-default CTs the hierarchy is ambiguous → skip (fall back to
+        built-in levels) rather than guess one the scheduler may not
+        use."""
+        if self._store is None:
+            return None
+        from grove_tpu.api import ClusterTopology
+        try:
+            cts = [ct for ct in self._store.list(ClusterTopology,
+                                                 namespace=None)
+                   if ct.spec.levels]
+        except Exception:  # noqa: BLE001 — best-effort
+            return None
+        chosen = next((ct for ct in cts if ct.meta.name == "default"),
+                      cts[0] if len(cts) == 1 else None)
+        if chosen is None:
+            return None
+        return [lvl.domain for lvl in chosen.spec.levels]
+
     def admit(self, verb: str, obj: Any, old: Any, actor: str) -> Any:
         """Mutate (defaulting) and validate; raise on rejection."""
         denial = authorize(self.config.authorizer, actor, verb, obj)
@@ -52,9 +77,14 @@ class AdmissionChain:
             obj = default_podcliqueset(obj)
             # Fleet-fit rules gate creation only — don't pay an
             # O(fleet) Node list+clone on every spec update.
+            # Live-cluster context (fleet shapes, CT levels) gates
+            # CREATION only — ratcheting: a fleet/CT change under a
+            # running PCS must not brick its spec updates.
             nodes = self._fleet_nodes() if old is None else None
-            problems = validate_podcliqueset(obj, self.registry, old,
-                                             nodes=nodes)
+            levels = self._topology_levels() if old is None else None
+            problems = validate_podcliqueset(
+                obj, self.registry, old, nodes=nodes,
+                topology_levels=levels)
             if problems:
                 raise ValidationError(
                     f"PodCliqueSet {obj.meta.name!r} rejected: "

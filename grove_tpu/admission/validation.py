@@ -121,17 +121,38 @@ def tarjan_sccs(graph: dict[str, list[str]]) -> list[list[str]]:
 
 def _validate_topology(field: str, topo: TopologyConstraint | None,
                        parent: TopologyConstraint | None,
-                       errs: list[str]) -> None:
+                       errs: list[str],
+                       levels: list[str] | None = None,
+                       resolve: bool = True) -> None:
+    """Constraint levels must RESOLVE against the topology hierarchy the
+    scheduler actually uses (reference validateResolvableTopologyConstraint,
+    validation/podcliqueset.go:774: constraints are checked against the
+    bound ClusterTopology's levels, not a hard-coded set). ``levels`` is
+    the active CT's outer→inner domain list; None falls back to the
+    built-in TPU hierarchy. ``resolve=False`` (updates) skips the
+    resolution errors — topology fields are immutable on update, so
+    re-resolving an unchanged constraint against a possibly-changed CT
+    could only brick the object; strictness comparison still runs when
+    both levels are known."""
+    lv = levels if levels else _LEVELS
+
+    def idx(level: str) -> int:
+        return lv.index(level)
+
     if topo is None:
         return
-    if topo.pack_level and topo.pack_level not in _LEVELS:
-        errs.append(f"{field}.pack_level: unknown level {topo.pack_level!r}; "
-                    f"levels: {_LEVELS}")
-    if topo.spread_level and topo.spread_level not in _LEVELS:
-        errs.append(f"{field}.spread_level: unknown level "
-                    f"{topo.spread_level!r}; levels: {_LEVELS}")
-    if (parent is not None and parent.pack_level and topo.pack_level
-            and _level_index(topo.pack_level) < _level_index(parent.pack_level)):
+    if resolve:
+        if topo.pack_level and topo.pack_level not in lv:
+            errs.append(f"{field}.pack_level: level {topo.pack_level!r} "
+                        "does not resolve against the cluster topology; "
+                        f"levels: {lv}")
+        if topo.spread_level and topo.spread_level not in lv:
+            errs.append(f"{field}.spread_level: level "
+                        f"{topo.spread_level!r} does not resolve against "
+                        f"the cluster topology; levels: {lv}")
+    if (parent is not None and parent.pack_level in lv
+            and topo.pack_level in lv
+            and idx(topo.pack_level) < idx(parent.pack_level)):
         # child packs at an outer (looser) level than the parent demands
         errs.append(
             f"{field}.pack_level {topo.pack_level!r} is looser than the "
@@ -749,10 +770,15 @@ def _validate_update(pcs: PodCliqueSet, old: PodCliqueSet,
 def validate_podcliqueset(pcs: PodCliqueSet,
                           registry: Registry | None = None,
                           old: PodCliqueSet | None = None,
-                          nodes: list | None = None) -> list[str]:
+                          nodes: list | None = None,
+                          topology_levels: list[str] | None = None
+                          ) -> list[str]:
     """Return all problems (empty == admitted). ``nodes`` (the live
     fleet, supplied by the admission chain) enables the
-    requests-vs-host-shapes rules; None skips them."""
+    requests-vs-host-shapes rules; ``topology_levels`` (the active
+    ClusterTopology's outer→inner domains, also chain-supplied) makes
+    constraint resolution validate against the hierarchy the scheduler
+    actually uses. None falls back to the built-in TPU levels."""
     errs = _validate_shape(pcs)
     if errs:
         return errs
@@ -800,7 +826,9 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                 f, t.auto_scaling, t.replicas, t.min_available, errs,
                 enforce_ceiling=_ratchet(_old_cliques.get(t.name), t,
                                          _scaling_shape))
-        _validate_topology(f + ".topology", t.topology, tmpl.topology, errs)
+        _validate_topology(f + ".topology", t.topology, tmpl.topology,
+                           errs, levels=topology_levels,
+                           resolve=old is None)
 
     # startup DAG (reference podcliquedeps.go:53: Tarjan SCC)
     # Declared edges under IN_ORDER/ANY_ORDER would be silently ignored —
@@ -884,9 +912,13 @@ def validate_podcliqueset(pcs: PodCliqueSet,
                 f, sg.auto_scaling, sg.replicas, sg.min_available, errs,
                 enforce_ceiling=_ratchet(_old_sgs.get(sg.name), sg,
                                          _scaling_shape))
-        _validate_topology(f + ".topology", sg.topology, tmpl.topology, errs)
+        _validate_topology(f + ".topology", sg.topology, tmpl.topology,
+                           errs, levels=topology_levels,
+                           resolve=old is None)
 
-    _validate_topology("spec.template.topology", tmpl.topology, None, errs)
+    _validate_topology("spec.template.topology", tmpl.topology, None,
+                       errs, levels=topology_levels,
+                       resolve=old is None)
     if tmpl.termination_delay_seconds is not None \
             and tmpl.termination_delay_seconds < 0:
         errs.append("termination_delay_seconds must be >= 0")

@@ -313,6 +313,85 @@ def cmd_get(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_describe(args: argparse.Namespace) -> int:
+    """Human-oriented single-object view: identity, spec highlights,
+    status, conditions with transition ages, and the object's events —
+    the kubectl-describe analog built from the same wire verbs."""
+    import json as _json
+    status, obj = _http(args.server, f"/api/{args.kind}/{args.name}"
+                        f"?namespace={args.namespace}", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(obj)}", file=sys.stderr)
+        return 1
+    meta = obj.get("meta", {})
+    now = time.time()
+
+    def age(ts: float) -> str:
+        d = max(0.0, now - ts)
+        if d < 120:
+            return f"{d:.0f}s"
+        if d < 7200:
+            return f"{d / 60:.0f}m"
+        return f"{d / 3600:.1f}h"
+
+    print(f"Name:       {meta.get('name', '')}")
+    print(f"Namespace:  {meta.get('namespace', '')}")
+    print(f"Kind:       {args.kind}")
+    print(f"UID:        {meta.get('uid', '')}")
+    print(f"Created:    {age(meta.get('creation_timestamp', now))} ago "
+          f"(generation {meta.get('generation', 0)}, "
+          f"rv {meta.get('resource_version', 0)})")
+    if meta.get("labels"):
+        print("Labels:     " + ", ".join(
+            f"{k}={v}" for k, v in sorted(meta["labels"].items())))
+    owners = meta.get("owner_references") or []
+    if owners:
+        print("Owner:      " + ", ".join(
+            f"{o.get('kind')}/{o.get('name')}" for o in owners))
+    if meta.get("deletion_timestamp"):
+        print("State:      TERMINATING")
+    st = obj.get("status", {}) or {}
+    scalars = {k: v for k, v in st.items()
+               if isinstance(v, (int, float, str, bool)) and v != ""
+               and k != "conditions"}
+    if scalars:
+        print("Status:")
+        for k, v in sorted(scalars.items()):
+            print(f"  {k}: {v}")
+    conds = st.get("conditions") or []
+    if conds:
+        print("Conditions:")
+        rows = [("  TYPE", "STATUS", "AGE", "REASON", "MESSAGE")]
+        for cd in conds:
+            rows.append(("  " + cd.get("type", ""), cd.get("status", ""),
+                         age(cd.get("last_transition_time", now)),
+                         cd.get("reason", ""), cd.get("message", "")))
+        _table(rows)
+    errs = st.get("last_errors") or []
+    if errs:
+        print("Last errors:")
+        for e in errs:
+            print(f"  [{e.get('code', '')}] {e.get('operation', '')}: "
+                  f"{e.get('message', '')}")
+    ev_status, events = _http(
+        args.server, f"/api/Event?namespace={args.namespace}", ca=args.ca)
+    if ev_status == 200:
+        mine = [e for e in events
+                if e.get("involved_name") == args.name
+                and e.get("involved_kind") == args.kind]
+        if mine:
+            print("Events:")
+            rows = [("  AGE", "TYPE", "REASON", "COUNT", "MESSAGE")]
+            for e in sorted(mine, key=lambda e: e.get("last_seen", 0.0)):
+                rows.append(("  " + age(e.get("last_seen", 0.0)),
+                             e.get("type", ""), e.get("reason", ""),
+                             str(e.get("count", 1)), e.get("message", "")))
+            _table(rows)
+    if args.json:
+        print(_json.dumps(obj, indent=2))
+    return 0
+
+
 def cmd_apply(args: argparse.Namespace) -> int:
     """Apply a manifest against a running serve daemon."""
     try:
@@ -489,6 +568,18 @@ def main(argv: list[str] | None = None) -> int:
     get.add_argument("--server", default=default_server)
     add_ca(get)
     get.set_defaults(fn=cmd_get)
+
+    desc = sub.add_parser("describe", help="human-oriented single-object "
+                          "view: status, conditions, events (kubectl "
+                          "describe analog)")
+    desc.add_argument("kind")
+    desc.add_argument("name")
+    desc.add_argument("--namespace", default="default")
+    desc.add_argument("--json", action="store_true",
+                      help="also dump the raw object JSON")
+    desc.add_argument("--server", default=default_server)
+    add_ca(desc)
+    desc.set_defaults(fn=cmd_describe)
 
     apply_p = sub.add_parser("apply", help="apply a manifest to a serve daemon")
     apply_p.add_argument("-f", "--file", required=True)

@@ -391,3 +391,108 @@ def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
         assert_no_partial_binds(cl, "gs8")
         assert gang_scheduled(cl, "gs8-0-x-1")
         assert gang_scheduled(cl, "gs8-0-x-2")
+
+
+def test_gs11_interleaved_pcs_pcsg_scaling_with_floors():
+    """GS11 (gang_scheduling_test.go:886): interleave capacity releases
+    with PCS and PCSG scale-ups under min-available floors — every stage
+    places exactly the gangs whose floor fits, base before elastic,
+    never a partial bind."""
+    cl = make_cluster(8)
+    with cl:
+        all_nodes = [n.meta.name for n in cl.client.list(Node)]
+        set_cordon(cl, all_nodes, True)
+        cl.client.create(wl("wl11", sg_replicas=2, sg_min=1))
+        # base (a + x-0) = 4 pods, elastic x-1 = 2 pods — all pending.
+        wait_for(lambda: len(pods_of(cl, "wl11")) == 6, desc="created")
+        time.sleep(0.5)
+        assert len(bound(cl, "wl11")) == 0
+
+        # 2 slices free → exactly the base gang (the floor) places.
+        set_cordon(cl, slice_nodes(cl, 0, 1), False)
+        wait_for(lambda: len(bound(cl, "wl11")) == 4, desc="base placed")
+        time.sleep(0.4)
+        assert len(bound(cl, "wl11")) == 4
+        assert_no_partial_binds(cl, "wl11")
+
+        # 1 more slice → the elastic places.
+        set_cordon(cl, slice_nodes(cl, 2), False)
+        wait_for(lambda: len(bound(cl, "wl11")) == 6, desc="elastic placed")
+
+        # Scale the PCSG to 3 under pressure → new elastic pends.
+        live = cl.client.get(PodCliqueSet, "wl11")
+        live.spec.template.scaling_groups[0].replicas = 3
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl11")) == 8, desc="x-2 created")
+        time.sleep(0.4)
+        assert len(bound(cl, "wl11")) == 6
+        set_cordon(cl, slice_nodes(cl, 3), False)
+        wait_for(lambda: len(bound(cl, "wl11")) == 8, desc="x-2 placed")
+
+        # Scale the PCS to 2 → replica-1 base + its 2 elastics all pend.
+        live = cl.client.get(PodCliqueSet, "wl11")
+        live.spec.replicas = 2
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl11")) == 16,
+                 desc="replica-1 pods created")
+        time.sleep(0.4)
+        assert len(bound(cl, "wl11")) == 8
+
+        # 2 slices free → replica-1's BASE places; elastics still gated.
+        set_cordon(cl, slice_nodes(cl, 4, 5), False)
+        wait_for(lambda: len(bound(cl, "wl11")) == 12,
+                 desc="replica-1 base placed")
+        time.sleep(0.4)
+        assert len(bound(cl, "wl11")) == 12
+        assert_no_partial_binds(cl, "wl11")
+
+        # Last 2 slices → everything places.
+        set_cordon(cl, slice_nodes(cl, 6, 7), False)
+        wait_for(lambda: len(bound(cl, "wl11")) == 16, desc="all placed")
+        assert_no_partial_binds(cl, "wl11")
+
+
+def test_gs12_scale_everything_while_pending_then_staged_release():
+    """GS12 (gang_scheduling_test.go:1014): scale the PCS AND both
+    replicas' PCSGs while the whole workload is pending, then release
+    capacity in waves — bases place first (min-available shape across
+    BOTH replicas), elastics follow, zero partial binds throughout."""
+    cl = make_cluster(8)
+    with cl:
+        all_nodes = [n.meta.name for n in cl.client.list(Node)]
+        set_cordon(cl, all_nodes, True)
+        cl.client.create(wl("wl12", sg_replicas=1, sg_min=1))
+        wait_for(lambda: len(pods_of(cl, "wl12")) == 4, desc="created")
+
+        # Scale PCS to 2 while everything is pending.
+        live = cl.client.get(PodCliqueSet, "wl12")
+        live.spec.replicas = 2
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl12")) == 8,
+                 desc="replica-1 created")
+
+        # Scale the scaling group to 3 (applies to BOTH replicas).
+        live = cl.client.get(PodCliqueSet, "wl12")
+        live.spec.template.scaling_groups[0].replicas = 3
+        cl.client.update(live)
+        wait_for(lambda: len(pods_of(cl, "wl12")) == 16,
+                 desc="all elastic pods created")
+        time.sleep(0.5)
+        assert len(bound(cl, "wl12")) == 0
+
+        # 4 slices free → both BASES place (4 pods each), elastics gated.
+        set_cordon(cl, slice_nodes(cl, 0, 1, 2, 3), False)
+        wait_for(lambda: len(bound(cl, "wl12")) == 8,
+                 desc="both bases placed")
+        time.sleep(0.4)
+        assert len(bound(cl, "wl12")) == 8
+        assert_no_partial_binds(cl, "wl12")
+
+        # Remaining 4 slices → all 4 elastic gangs place.
+        set_cordon(cl, slice_nodes(cl, 4, 5, 6, 7), False)
+        wait_for(lambda: len(bound(cl, "wl12")) == 16, desc="all placed")
+        gangs = cl.client.list(PodGang, selector={c.LABEL_PCS_NAME: "wl12"})
+        assert {g.meta.name for g in gangs} == {
+            "wl12-0", "wl12-1",
+            "wl12-0-x-1", "wl12-0-x-2", "wl12-1-x-1", "wl12-1-x-2"}
+        assert_no_partial_binds(cl, "wl12")

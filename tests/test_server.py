@@ -90,6 +90,18 @@ def test_grovectl_client_verbs(server, tmp_path, capsys):
                       in capsys.readouterr().out),
              desc="available via grovectl get")
 
+    # describe: identity + status + conditions table (kubectl describe
+    # analog), driven over the same wire verbs.
+    assert main(["describe", "PodCliqueSet", "websvc",
+                 "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert "Name:       websvc" in out
+    assert "Kind:       PodCliqueSet" in out
+    assert "available_replicas: 1" in out
+    assert "Conditions:" in out and "AGE" in out
+    assert main(["describe", "PodCliqueSet", "nope", "--server", base]) == 1
+    capsys.readouterr()
+
     assert main(["delete", "PodCliqueSet", "websvc", "--server", base]) == 0
     assert "deleted" in capsys.readouterr().out
     assert main(["get", "PodCliqueSet", "websvc", "--server", base]) == 1

@@ -343,6 +343,69 @@ class TestFleetFit:
                 name="w", tpu_chips_per_pod=4)]))
 
 
+class TestResolvableTopology:
+    """Constraint levels validate against the ACTIVE ClusterTopology's
+    hierarchy (reference validateResolvableTopologyConstraint), not a
+    hard-coded set — and only at creation (ratcheting)."""
+
+    def test_custom_hierarchy_levels_resolve(self):
+        pcs = make_pcs(topology=TopologyConstraint(pack_level="cell",
+                                                   required=True))
+        # Default hierarchy: 'cell' is unknown.
+        assert any("does not resolve" in e for e in errors_of(pcs))
+        # Custom hierarchy that defines it: admitted.
+        assert not validate_podcliqueset(
+            pcs, topology_levels=["region", "cell", "host"])
+        # And 'slice' no longer resolves under that hierarchy.
+        pcs2 = make_pcs(topology=TopologyConstraint(pack_level="slice",
+                                                    required=True))
+        errs = validate_podcliqueset(
+            pcs2, topology_levels=["region", "cell", "host"])
+        assert any("does not resolve" in e for e in errs)
+
+    def test_update_does_not_rebrick_custom_level_object(self):
+        """Ratchet: a PCS admitted under a custom CT stays updatable —
+        topology fields are immutable on update, so re-resolving the
+        unchanged constraint (against a default or changed hierarchy)
+        could only brick the object."""
+        old = make_pcs(topology=TopologyConstraint(pack_level="cell",
+                                                   required=True))
+        upd = clone(old)
+        upd.spec.replicas = 3
+        # No custom levels supplied on update (chain passes None): must
+        # NOT fall back to rejecting 'cell' against the built-ins.
+        assert not [e for e in errors_of(upd, old=old)
+                    if "does not resolve" in e]
+
+    def test_wired_through_chain_with_live_ct(self):
+        from grove_tpu.admission.chain import install_admission
+        from grove_tpu.api import ClusterTopology
+        from grove_tpu.api.clustertopology import (ClusterTopologySpec,
+                                                   TopologyLevel)
+        from grove_tpu.api.config import OperatorConfiguration
+        from grove_tpu.api import new_meta as nm
+        from grove_tpu.runtime.errors import ValidationError
+        from grove_tpu.store.client import Client
+        from grove_tpu.store.store import Store
+
+        store = Store()
+        install_admission(store, OperatorConfiguration(), registry=None)
+        client = Client(store)
+        client.create(ClusterTopology(
+            meta=nm("default"),
+            spec=ClusterTopologySpec(levels=[
+                TopologyLevel("region", "topology.example.com/region"),
+                TopologyLevel("cell", "topology.example.com/cell"),
+                TopologyLevel("host", "kubernetes.io/hostname")])))
+        # 'slice' does not exist in this cluster's hierarchy.
+        with pytest.raises(ValidationError, match="does not resolve"):
+            client.create(make_pcs(topology=TopologyConstraint(
+                pack_level="slice", required=True)))
+        # 'cell' does.
+        client.create(make_pcs(name="ok", topology=TopologyConstraint(
+            pack_level="cell", required=True)))
+
+
 class TestPriorityBounds:
     def test_priority_out_of_bounds(self):
         pcs = make_pcs(priority=10_000_000)
