@@ -78,17 +78,29 @@ INIT_RETRY_DELAY_S = 15.0
 RUN_ATTEMPTS = int(os.environ.get("GROVE_BENCH_ATTEMPTS", 2))
 RUN_RETRY_DELAY_S = float(os.environ.get("GROVE_BENCH_RETRY_DELAY", 15.0))
 # Watchdog per attempt + TOTAL supervisor budget. Round-3 lesson: the
-# supervisor's worst case (attempts x watchdog + delays) MUST fit inside
-# the driver's own capture window or the designed failure JSON never
-# prints — 3x600s+delays exceeded it and the round's artifact was
-# `parsed: null`. Worst case here: 2x230 + 15 = 475s < ~500s, and the
-# supervisor additionally clamps each attempt to the remaining total
-# budget so the LAST line on stdout is always a parseable JSON no matter
-# when the driver stops reading.
+# supervisor's worst case MUST fit inside the driver's own capture
+# window or the designed failure JSON never prints — 3x600s+delays
+# exceeded it and the round's artifact was `parsed: null`. The
+# supervisor clamps every child (probe or attempt) to the REMAINING
+# total budget, so the whole run ends within ~TOTAL_BUDGET_S and the
+# LAST stdout line is always a parseable JSON no matter when the driver
+# stops reading.
 ATTEMPT_TIMEOUT_S = float(os.environ.get("GROVE_BENCH_ATTEMPT_TIMEOUT", 230))
 TOTAL_BUDGET_S = float(os.environ.get("GROVE_BENCH_TOTAL_BUDGET", 490))
-# Set in the child's env by the supervisor; the child runs ONE attempt.
+# Probe gate: before spending a full attempt, a tiny child just inits
+# the backend and runs the smoke matmul under a SHORT watchdog. A dead
+# or hung relay then costs ~PROBE_TIMEOUT_S per poll instead of a whole
+# ATTEMPT_TIMEOUT_S — so within one TOTAL_BUDGET_S window the supervisor
+# can keep polling and still launch a full attempt the moment a relay
+# window opens (observed relay outages last minutes-to-hours with
+# recovery windows in between).
+PROBE_TIMEOUT_S = float(os.environ.get("GROVE_BENCH_PROBE_TIMEOUT", 45))
+PROBE_RETRY_DELAY_S = float(os.environ.get("GROVE_BENCH_PROBE_DELAY", 10))
+
+# Set in the child's env by the supervisor; the child runs ONE attempt
+# (or, with _PROBE_ENV, just the init+smoke probe).
 _CHILD_ENV = "GROVE_BENCH_CHILD"
+_PROBE_ENV = "GROVE_BENCH_PROBE"
 _PARTIAL_ENV = "GROVE_BENCH_PARTIAL_FILE"
 
 
@@ -541,6 +553,19 @@ def _metric_name() -> str:
     return f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip"
 
 
+def probe_main() -> None:
+    """Probe-only child: backend init + smoke matmul, then exit 0. A
+    hung relay hangs HERE (under the supervisor's short probe watchdog)
+    instead of inside a full attempt."""
+    try:
+        dev = jax.devices()[0]
+        smoke_probe()
+        print(f"PROBE-OK {dev.platform}:{dev.device_kind}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"PROBE-FAIL {type(e).__name__}: {e}", flush=True)
+        sys.exit(1)
+
+
 def child_main() -> None:
     """One attempt: run the bench, print the result JSON (success or
     failure-with-partials) on stdout. The supervisor owns retries."""
@@ -595,15 +620,58 @@ def supervisor_main() -> None:
             last_failure = f
         print(json.dumps(dict(last_failure, attempts=attempt)), flush=True)
 
-    for attempt in range(1, RUN_ATTEMPTS + 1):
+    def probe_ok(budget: float) -> tuple[bool, str]:
+        """Run the probe child, clamped to the remaining budget."""
+        timeout = min(PROBE_TIMEOUT_S, budget)
+        env = dict(os.environ, **{_PROBE_ENV: "1"})
+        proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                                env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return False, f"probe hung >{timeout:.0f}s"
+        line = (out or "").strip().splitlines()
+        if proc.returncode == 0 and line and line[-1].startswith("PROBE-OK"):
+            return True, line[-1]
+        return False, (line[-1] if line else f"probe rc={proc.returncode}")
+
+    # Minimum attempt worth launching: operator-shrunk watchdogs keep
+    # their guarantee of a first attempt.
+    min_attempt = min(60.0, ATTEMPT_TIMEOUT_S)
+    attempt = 0
+    while True:
+        remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
+        # Stop only when the TOTAL budget can't fund a meaningful
+        # attempt (or attempts are spent).
+        if attempt >= RUN_ATTEMPTS or remaining - 5 < min_attempt:
+            log(f"budget/attempts exhausted ({remaining:.0f}s left, "
+                f"{attempt} attempts run); stopping")
+            break
+        # Probe-gate: poll the relay cheaply until it answers (a dead
+        # relay costs one probe per poll, not a full attempt). The probe
+        # is clamped to the remaining budget like every other child.
+        ok, probe_msg = probe_ok(remaining - 5)
+        if not ok:
+            log(f"relay probe failed ({probe_msg}); "
+                f"{remaining:.0f}s budget left")
+            if last_failure is None:
+                emit_failure({
+                    "metric": _metric_name(), "value": 0.0,
+                    "unit": "tok/s/chip", "vs_baseline": 0.0,
+                    "error": f"relay probe failed: {probe_msg}",
+                })
+            time.sleep(PROBE_RETRY_DELAY_S)
+            continue
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
         timeout = min(ATTEMPT_TIMEOUT_S, remaining - 5)
-        # Stop only when the TOTAL budget can't fund a meaningful
-        # attempt — an operator-set small ATTEMPT_TIMEOUT_S must still
-        # get its first attempt.
-        if timeout < min(60.0, ATTEMPT_TIMEOUT_S):
-            log(f"total budget exhausted ({remaining:.0f}s left); stopping")
+        if timeout < min_attempt:
+            log(f"probe ok but only {remaining:.0f}s left (< "
+                f"{min_attempt:.0f}s attempt floor); stopping")
             break
+        log(f"relay probe ok ({probe_msg}); launching attempt")
+        attempt += 1
         with tempfile.NamedTemporaryFile("r", suffix=".json") as pf:
             env = dict(os.environ, **{_CHILD_ENV: "1", _PARTIAL_ENV: pf.name})
             proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
@@ -668,19 +736,21 @@ def supervisor_main() -> None:
                     f"{parsed.get('error')}")
                 emit_failure(parsed)
         if attempt < RUN_ATTEMPTS:
-            log(f"retrying in {RUN_RETRY_DELAY_S:.0f}s")
+            log(f"re-probing in {RUN_RETRY_DELAY_S:.0f}s")
             time.sleep(RUN_RETRY_DELAY_S)
     failure = dict(last_failure or {
         "metric": _metric_name(), "value": 0.0, "unit": "tok/s/chip",
         "vs_baseline": 0.0, "error": "no attempt ran"},
-        attempts=RUN_ATTEMPTS)
+        attempts=attempt)
     append_history(failure)
     print(json.dumps(failure), flush=True)
     sys.exit(1)
 
 
 def main() -> None:
-    if os.environ.get(_CHILD_ENV):
+    if os.environ.get(_PROBE_ENV):
+        probe_main()
+    elif os.environ.get(_CHILD_ENV):
         child_main()
     else:
         supervisor_main()

@@ -475,14 +475,21 @@ _MAX_CHIPS_PER_HOST = max(g.chips_per_host for g in TPU_GENERATIONS.values())
 _MAX_SLICE_CHIPS = max(g.max_slice_chips for g in TPU_GENERATIONS.values())
 
 
-def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
+def _validate_chips(pcs: PodCliqueSet, errs: list[str],
+                    levels: list[str] | None = None) -> None:
     """Chip requests must be physically realisable (topology/tpu.py):
     a pod lands on ONE host, so per-pod chips cannot exceed any
     generation's chips-per-host and must be a power of two (sub-host
     granularity is 1/2/4); a slice-packed gang cannot need more chips
-    than the largest slice any generation builds.
+    than the largest slice any generation builds. ``levels`` is the
+    ACTIVE hierarchy (custom ClusterTopology) — the slice-budget rule
+    applies whenever that hierarchy has a level named 'slice' (same
+    physical meaning: one ICI mesh), at its position in THAT ordering;
+    hierarchies without a slice level skip the budget (their domains'
+    physics are unknown).
     """
     tmpl = pcs.spec.template
+    lv = levels if levels else _LEVELS
     per_gen = ", ".join(f"{g.name}={g.chips_per_host}/host"
                         for g in TPU_GENERATIONS.values())
     for t in tmpl.cliques:
@@ -512,9 +519,9 @@ def _validate_chips(pcs: PodCliqueSet, errs: list[str]) -> None:
         # just mean "cannot assess the slice budget" — don't crash on
         # the same typo twice.
         return bool(eff and eff.required
-                    and eff.pack_level in _LEVELS
-                    and _level_index(eff.pack_level)
-                    >= _level_index("slice"))
+                    and "slice" in lv
+                    and eff.pack_level in lv
+                    and lv.index(eff.pack_level) >= lv.index("slice"))
 
     standalone = [t for t in tmpl.cliques if t.name not in in_group]
     for t in standalone:
@@ -929,7 +936,7 @@ def validate_podcliqueset(pcs: PodCliqueSet,
         errs.append(f"invalid priority_class name {tmpl.priority_class!r}")
 
     _validate_name_budgets(pcs, errs)
-    _validate_chips(pcs, errs)
+    _validate_chips(pcs, errs, levels=topology_levels)
     if old is None:
         # Live-fleet fit gates CREATION only: a fleet that shrinks
         # under a running PCS must not brick every subsequent spec
