@@ -62,10 +62,6 @@ _RESERVED_ENV = frozenset({
 _LEVELS = [lvl.domain for lvl in DEFAULT_TPU_LEVELS]  # outer -> inner
 
 
-def _level_index(level: str) -> int:
-    return _LEVELS.index(level)
-
-
 def tarjan_sccs(graph: dict[str, list[str]]) -> list[list[str]]:
     """Tarjan strongly-connected components (iterative)."""
     index: dict[str, int] = {}

@@ -440,6 +440,50 @@ def cmd_delete(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_cordon(args: argparse.Namespace) -> int:
+    """Mark a node (un)schedulable; --drain also fails the node's pods
+    so the standard gang self-heal reschedules them elsewhere (kubectl
+    cordon/uncordon/drain analog, over the same PATCH verbs)."""
+    import json as _json
+    want = args.verb == "cordon" or args.drain
+    body = _json.dumps({"spec": {"unschedulable": want}}).encode()
+    status, out = _http(args.server, f"/api/Node/{args.name}", "PATCH",
+                        body, ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
+        return 1
+    print(f"Node/{args.name} {'cordoned' if want else 'uncordoned'}")
+    if not args.drain:
+        return 0
+    status, pods = _http(args.server, "/api/Pod?namespace=*", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(pods)}", file=sys.stderr)
+        return 1
+    mine = [p for p in pods
+            if p.get("status", {}).get("node_name") == args.name
+            and not p.get("meta", {}).get("deletion_timestamp")]
+    failed = 0
+    for p in mine:
+        patch = _json.dumps({
+            "phase": "Failed",
+            "message": f"drained from {args.name}",
+            "conditions": [{"type": "Ready", "status": "False",
+                            "reason": "Drained"}],
+        }).encode()
+        st, out = _http(args.server,
+                        f"/api/Pod/{p['meta']['name']}/status"
+                        f"?namespace={p['meta']['namespace']}",
+                        "PATCH", patch, ca=args.ca)
+        if st == 200:
+            failed += 1
+        else:
+            print(f"warning: pod {p['meta']['name']}: {_err_text(out)}",
+                  file=sys.stderr)
+    print(f"drained {failed}/{len(mine)} pods from {args.name} "
+          "(gang self-heal reschedules them)")
+    return 0
+
+
 def cmd_logs(args: argparse.Namespace) -> int:
     """Stream a pod's log from a serve daemon (kubectl-logs analog)."""
     path = f"/logs/{args.namespace}/{args.pod}"
@@ -604,6 +648,19 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("--server", default=default_server)
     add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
+
+    for verb in ("cordon", "uncordon"):
+        cp = sub.add_parser(verb, help=f"{verb} a node "
+                            "(kubectl analog; cordon takes --drain)")
+        cp.add_argument("name")
+        if verb == "cordon":
+            cp.add_argument("--drain", action="store_true",
+                            help="also fail the node's pods so gang "
+                                 "self-heal reschedules them")
+        cp.add_argument("--server", default=default_server)
+        add_ca(cp)
+        cp.set_defaults(fn=cmd_cordon, verb=verb,
+                        **({} if verb == "cordon" else {"drain": False}))
 
     logs_p = sub.add_parser("logs", help="print a pod's log from a serve "
                                          "daemon (kubectl logs analog)")

@@ -198,3 +198,39 @@ def test_health_metrics_and_errors(server):
     status, err = _req(f"{base}/apply", "POST",
                        bad.replace("websvc", "broken"))
     assert status == 400 and "min_available" in err["error"]
+
+def test_grovectl_cordon_drain_uncordon(server, capsys):
+    """kubectl node-ops parity over the wire: cordon marks the node
+    unschedulable, --drain fails its pods (gang self-heal reschedules
+    them onto remaining capacity), uncordon restores it."""
+    import time
+    from grove_tpu.api import Node, Pod, constants as c
+    from grove_tpu.cli import main
+    base, cl = server
+    # One 4x4 slice = 4 hosts; a 2-pod gang leaves spare hosts to
+    # reschedule onto after the drain.
+    _req(f"{base}/apply", "POST", MANIFEST)
+    sel = {c.LABEL_PCS_NAME: "websvc"}
+    wait_for(lambda: len([p for p in cl.client.list(Pod, selector=sel)
+                          if p.status.node_name]) == 2, desc="placed")
+    victim = next(p.status.node_name
+                  for p in cl.client.list(Pod, selector=sel)
+                  if p.status.node_name)
+
+    assert main(["cordon", victim, "--drain", "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert f"Node/{victim} cordoned" in out and "drained" in out
+    assert cl.client.get(Node, victim).spec.unschedulable
+
+    def rescheduled():
+        pods = [p for p in cl.client.list(Pod, selector=sel)
+                if p.status.node_name and p.meta.deletion_timestamp is None
+                and p.status.phase.value == "Running"]
+        return (len(pods) == 2
+                and all(p.status.node_name != victim for p in pods))
+    wait_for(rescheduled, timeout=15.0,
+             desc="drained pods rescheduled off the node")
+
+    assert main(["uncordon", victim, "--server", base]) == 0
+    assert "uncordoned" in capsys.readouterr().out
+    assert not cl.client.get(Node, victim).spec.unschedulable
