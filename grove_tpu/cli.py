@@ -301,14 +301,87 @@ def _err_text(body) -> str:
     return body.get("error", body) if isinstance(body, dict) else str(body)
 
 
+# kubectl-style printcolumns per kind (the reference declares
+# printcolumns on every CRD, podcliqueset.go:28-35): (header, getter).
+def _age(ts: float, now: float) -> str:
+    d = max(0.0, now - ts)
+    if d < 120:
+        return f"{d:.0f}s"
+    if d < 7200:
+        return f"{d / 60:.0f}m"
+    return f"{d / 3600:.1f}h"
+
+
+def _cond(obj: dict, ctype: str) -> str:
+    for cd in (obj.get("status", {}) or {}).get("conditions") or []:
+        if cd.get("type") == ctype:
+            return cd.get("status", "")
+    return ""
+
+
+_PRINT_COLUMNS: dict = {
+    "PodCliqueSet": [
+        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
+        ("AVAILABLE", lambda o, now: str(
+            o["status"].get("available_replicas", 0))),
+        ("UPDATED", lambda o, now: str(
+            o["status"].get("updated_replicas", 0))),
+    ],
+    "PodClique": [
+        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
+        ("READY", lambda o, now: str(o["status"].get("ready_replicas", 0))),
+        ("MINAVAIL", lambda o, now: str(
+            o["spec"].get("min_available", 0))),
+        ("BREACHED", lambda o, now: _cond(o, c.COND_MIN_AVAILABLE_BREACHED)),
+    ],
+    "PodCliqueScalingGroup": [
+        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
+        ("READY", lambda o, now: str(o["status"].get("ready_replicas", 0))),
+        ("SCHEDULED", lambda o, now: str(
+            o["status"].get("scheduled_replicas", 0))),
+    ],
+    "PodGang": [
+        ("PHASE", lambda o, now: str(o["status"].get("phase", ""))),
+        ("SCHEDULED", lambda o, now: _cond(o, c.COND_SCHEDULED)),
+        ("READY", lambda o, now: _cond(o, c.COND_READY)),
+    ],
+    "Pod": [
+        ("PHASE", lambda o, now: str(o["status"].get("phase", ""))),
+        ("READY", lambda o, now: _cond(o, c.COND_READY)),
+        ("NODE", lambda o, now: o["status"].get("node_name", "")),
+    ],
+    "Node": [
+        ("READY", lambda o, now: str(o["status"].get("ready", ""))),
+        ("CHIPS", lambda o, now: str(o["spec"].get("tpu_chips", 0))),
+        ("CORDONED", lambda o, now: (
+            "true" if o["spec"].get("unschedulable") else "")),
+    ],
+}
+
+
 def cmd_get(args: argparse.Namespace) -> int:
-    """Read resources from a running serve daemon."""
+    """Read resources from a running serve daemon. ``-o table`` renders
+    the kind's printcolumns (the reference declares printcolumns on
+    every CRD); default stays JSON for scripting."""
     import json as _json
     path = f"/api/{args.kind}" + (f"/{args.name}" if args.name else "")
     status, body = _http(args.server, path, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
         return 1
+    if args.output == "table":
+        objs = body if isinstance(body, list) else [body]
+        now = time.time()
+        cols = _PRINT_COLUMNS.get(args.kind, [])
+        rows = [("NAME", *(h for h, _ in cols), "AGE")]
+        for o in objs:
+            rows.append((
+                o.get("meta", {}).get("name", ""),
+                *(get(o, now) for _, get in cols),
+                _age(o.get("meta", {}).get("creation_timestamp", now),
+                     now)))
+        _table(rows)
+        return 0
     print(_json.dumps(body, indent=2))
     return 0
 
@@ -327,12 +400,7 @@ def cmd_describe(args: argparse.Namespace) -> int:
     now = time.time()
 
     def age(ts: float) -> str:
-        d = max(0.0, now - ts)
-        if d < 120:
-            return f"{d:.0f}s"
-        if d < 7200:
-            return f"{d / 60:.0f}m"
-        return f"{d / 3600:.1f}h"
+        return _age(ts, now)
 
     print(f"Name:       {meta.get('name', '')}")
     print(f"Namespace:  {meta.get('namespace', '')}")
@@ -447,8 +515,9 @@ def cmd_cordon(args: argparse.Namespace) -> int:
     import json as _json
     want = args.verb == "cordon" or args.drain
     body = _json.dumps({"spec": {"unschedulable": want}}).encode()
-    status, out = _http(args.server, f"/api/Node/{args.name}", "PATCH",
-                        body, ca=args.ca)
+    status, out = _http(args.server,
+                        f"/api/Node/{args.name}?namespace={args.namespace}",
+                        "PATCH", body, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
         return 1
@@ -461,13 +530,18 @@ def cmd_cordon(args: argparse.Namespace) -> int:
         return 1
     mine = [p for p in pods
             if p.get("status", {}).get("node_name") == args.name
-            and not p.get("meta", {}).get("deletion_timestamp")]
+            and not p.get("meta", {}).get("deletion_timestamp")
+            # terminal pods keep their outcome (kubectl drain skips them
+            # too) — rewriting Succeeded to Failed would falsify a
+            # finished run and trigger a pointless self-heal
+            and p.get("status", {}).get("phase") not in ("Succeeded",
+                                                         "Failed")]
     failed = 0
     for p in mine:
         patch = _json.dumps({
             "phase": "Failed",
             "message": f"drained from {args.name}",
-            "conditions": [{"type": "Ready", "status": "False",
+            "conditions": [{"type": c.COND_READY, "status": "False",
                             "reason": "Drained"}],
         }).encode()
         st, out = _http(args.server,
@@ -511,12 +585,7 @@ def cmd_events(args: argparse.Namespace) -> int:
     now = time.time()
 
     def age(ts: float) -> str:
-        d = max(0, now - ts)
-        if d < 120:
-            return f"{d:.0f}s"
-        if d < 7200:
-            return f"{d / 60:.0f}m"
-        return f"{d / 3600:.1f}h"
+        return _age(ts, now)
 
     rows = [("AGE", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
     for e in events:
@@ -609,6 +678,10 @@ def main(argv: list[str] | None = None) -> int:
     get = sub.add_parser("get", help="read resources from a serve daemon")
     get.add_argument("kind")
     get.add_argument("name", nargs="?")
+    get.add_argument("-o", "--output", choices=["json", "table"],
+                     default="json",
+                     help="table renders the kind's printcolumns "
+                          "(kubectl-get analog); json for scripting")
     get.add_argument("--server", default=default_server)
     add_ca(get)
     get.set_defaults(fn=cmd_get)
@@ -657,6 +730,7 @@ def main(argv: list[str] | None = None) -> int:
             cp.add_argument("--drain", action="store_true",
                             help="also fail the node's pods so gang "
                                  "self-heal reschedules them")
+        cp.add_argument("--namespace", default="default")
         cp.add_argument("--server", default=default_server)
         add_ca(cp)
         cp.set_defaults(fn=cmd_cordon, verb=verb,

@@ -102,6 +102,17 @@ def test_grovectl_client_verbs(server, tmp_path, capsys):
     assert main(["describe", "PodCliqueSet", "nope", "--server", base]) == 1
     capsys.readouterr()
 
+    # -o table: the kind's printcolumns (kubectl-get analog).
+    assert main(["get", "PodCliqueSet", "-o", "table",
+                 "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0].split() == [
+        "NAME", "REPLICAS", "AVAILABLE", "UPDATED", "AGE"]
+    assert "websvc" in out
+    assert main(["get", "Pod", "-o", "table", "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert "PHASE" in out and "NODE" in out and "websvc-0-w-0" in out
+
     assert main(["delete", "PodCliqueSet", "websvc", "--server", base]) == 0
     assert "deleted" in capsys.readouterr().out
     assert main(["get", "PodCliqueSet", "websvc", "--server", base]) == 1
