@@ -321,40 +321,40 @@ def _cond(obj: dict, ctype: str) -> str:
 
 _PRINT_COLUMNS: dict = {
     "PodCliqueSet": [
-        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
-        ("AVAILABLE", lambda o, now: str(
+        ("REPLICAS", lambda o: str(o["spec"].get("replicas", 0))),
+        ("AVAILABLE", lambda o: str(
             o["status"].get("available_replicas", 0))),
-        ("UPDATED", lambda o, now: str(
+        ("UPDATED", lambda o: str(
             o["status"].get("updated_replicas", 0))),
     ],
     "PodClique": [
-        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
-        ("READY", lambda o, now: str(o["status"].get("ready_replicas", 0))),
-        ("MINAVAIL", lambda o, now: str(
+        ("REPLICAS", lambda o: str(o["spec"].get("replicas", 0))),
+        ("READY", lambda o: str(o["status"].get("ready_replicas", 0))),
+        ("MINAVAIL", lambda o: str(
             o["spec"].get("min_available", 0))),
-        ("BREACHED", lambda o, now: _cond(o, c.COND_MIN_AVAILABLE_BREACHED)),
+        ("BREACHED", lambda o: _cond(o, c.COND_MIN_AVAILABLE_BREACHED)),
     ],
     "PodCliqueScalingGroup": [
-        ("REPLICAS", lambda o, now: str(o["spec"].get("replicas", 0))),
-        ("READY", lambda o, now: str(o["status"].get("ready_replicas", 0))),
-        ("SCHEDULED", lambda o, now: str(
+        ("REPLICAS", lambda o: str(o["spec"].get("replicas", 0))),
+        ("READY", lambda o: str(o["status"].get("ready_replicas", 0))),
+        ("SCHEDULED", lambda o: str(
             o["status"].get("scheduled_replicas", 0))),
     ],
     "PodGang": [
-        ("PHASE", lambda o, now: str(o["status"].get("phase", ""))),
-        ("SCHEDULED", lambda o, now: _cond(o, c.COND_SCHEDULED)),
-        ("READY", lambda o, now: _cond(o, c.COND_READY)),
+        ("PHASE", lambda o: str(o["status"].get("phase", ""))),
+        ("SCHEDULED", lambda o: _cond(o, c.COND_SCHEDULED)),
+        ("READY", lambda o: _cond(o, c.COND_READY)),
     ],
     "Pod": [
-        ("PHASE", lambda o, now: str(o["status"].get("phase", ""))),
-        ("READY", lambda o, now: _cond(o, c.COND_READY)),
-        ("NODE", lambda o, now: o["status"].get("node_name", "")),
+        ("PHASE", lambda o: str(o["status"].get("phase", ""))),
+        ("READY", lambda o: _cond(o, c.COND_READY)),
+        ("NODE", lambda o: o["status"].get("node_name", "")),
     ],
     "Node": [
-        ("READY", lambda o, now: str(o["status"].get("ready", ""))),
-        ("CHIPS", lambda o, now: str(o["spec"].get("tpu_chips", 0))),
-        ("CORDONED", lambda o, now: (
-            "true" if o["spec"].get("unschedulable") else "")),
+        ("READY", lambda o: "True" if o["status"].get("ready") else "False"),
+        ("CHIPS", lambda o: str(o["spec"].get("tpu_chips", 0))),
+        ("CORDONED", lambda o: (
+            "True" if o["spec"].get("unschedulable") else "")),
     ],
 }
 
@@ -377,7 +377,7 @@ def cmd_get(args: argparse.Namespace) -> int:
         for o in objs:
             rows.append((
                 o.get("meta", {}).get("name", ""),
-                *(get(o, now) for _, get in cols),
+                *(get(o) for _, get in cols),
                 _age(o.get("meta", {}).get("creation_timestamp", now),
                      now)))
         _table(rows)

@@ -95,6 +95,22 @@ def _load_locked() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32),
     ]
+    if hasattr(lib, "grove_plan_gang_grouped"):
+        lib.grove_plan_gang_grouped.restype = ctypes.c_int
+        lib.grove_plan_gang_grouped.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
     _lib = lib
     return _lib
 
@@ -114,29 +130,14 @@ def prewarm(background: bool = True) -> None:
         _load()
 
 
-def native_plan_gang(pods, hosts, pack_level: str, required: bool,
-                     prefer_slice: str, spread_penalty: dict[str, float]):
-    """Native-backed equivalent of placement.plan_gang. Returns a
-    PlacementPlan or None (infeasible), or NotImplemented when the native
-    library is unavailable (caller falls back to Python)."""
-    lib = _load_nowait()
-    if lib is None:
-        return NotImplemented
 
-    from grove_tpu.scheduler.placement import (
-        PlacementPlan,
-        _domain_of,
-        _selector_matches,
-    )
 
-    n_pods = len(pods)
+def _marshal_hosts(hosts, level: str):
+    """Shared host/domain marshalling for both planners (one copy of the
+    domain-id assignment — first-appearance order — so the two wrappers
+    can never desynchronize)."""
+    from grove_tpu.scheduler.placement import _domain_of
     n_hosts = len(hosts)
-    if n_pods == 0:
-        return PlacementPlan({}, "", 0.0)
-    if n_hosts == 0:
-        return None
-
-    level = pack_level or "slice"
     domain_names: list[str] = []
     domain_ids: dict[str, int] = {}
     host_domain = (ctypes.c_int32 * n_hosts)()
@@ -148,38 +149,140 @@ def native_plan_gang(pods, hosts, pack_level: str, required: bool,
             domain_names.append(dom)
         host_domain[h_i] = domain_ids[dom]
         host_free[h_i] = h.free_chips
+    return domain_names, domain_ids, host_domain, host_free
 
-    pod_chips = (ctypes.c_int64 * n_pods)()
-    eligible = (ctypes.c_uint8 * (n_pods * n_hosts))()
+
+def _marshal_eligibility(pods, hosts):
+    """ONE eligibility definition for all planners: the python matcher
+    owns selector + reservation-taint semantics."""
+    from grove_tpu.scheduler.placement import _selector_matches
+    n_pods, n_hosts = len(pods), len(hosts)
+    pod_chips = (ctypes.c_int64 * max(1, n_pods))()
+    eligible = (ctypes.c_uint8 * max(1, n_pods * n_hosts))()
     for p_i, p in enumerate(pods):
         pod_chips[p_i] = p.chips
         for h_i, h in enumerate(hosts):
-            # ONE eligibility definition for both planners: the python
-            # matcher owns selector + reservation-taint semantics.
             eligible[p_i * n_hosts + h_i] = \
                 1 if _selector_matches(p, h) else 0
+    return pod_chips, eligible
 
-    n_domains = len(domain_names)
-    penalty = (ctypes.c_double * n_domains)()
-    for name, p in (spread_penalty or {}).items():
+
+def _marshal_scoring(domain_names, domain_ids, spread_penalty,
+                     prefer_slice):
+    penalty = (ctypes.c_double * max(1, len(domain_names)))()
+    for name, pen in (spread_penalty or {}).items():
         if name in domain_ids:
-            penalty[domain_ids[name]] = p
+            penalty[domain_ids[name]] = pen
     prefer = domain_ids.get(prefer_slice, -1) if prefer_slice else -1
+    return penalty, prefer
 
-    out_score = ctypes.c_double()
-    out_domain = ctypes.c_int32()
-    out_assign = (ctypes.c_int32 * n_pods)()
-    rc = lib.grove_plan_gang(
-        n_pods, pod_chips, n_hosts, host_free, host_domain, eligible,
-        n_domains, penalty, prefer, 1 if required else 0,
-        ctypes.byref(out_score), ctypes.byref(out_domain), out_assign)
+
+def _decode_plan(rc, pods, hosts, domain_names, level,
+                 out_score, out_domain, out_assign):
+    from grove_tpu.scheduler.placement import PlacementPlan
     if rc < 0:
         return None
     assignment = {pods[i].name: hosts[out_assign[i]].name
-                  for i in range(n_pods)}
+                  for i in range(len(pods))}
     if rc == 1:
         dom = domain_names[out_domain.value]
         slice_name = dom if level == "slice" else ""
     else:
         slice_name = ""
     return PlacementPlan(assignment, slice_name, out_score.value)
+
+
+def native_plan_gang(pods, hosts, pack_level: str, required: bool,
+                     prefer_slice: str, spread_penalty: dict[str, float]):
+    """Native-backed equivalent of placement.plan_gang. Returns a
+    PlacementPlan or None (infeasible), or NotImplemented when the native
+    library is unavailable (caller falls back to Python)."""
+    lib = _load_nowait()
+    if lib is None:
+        return NotImplemented
+
+    from grove_tpu.scheduler.placement import PlacementPlan
+
+    n_pods = len(pods)
+    n_hosts = len(hosts)
+    if n_pods == 0:
+        return PlacementPlan({}, "", 0.0)
+    if n_hosts == 0:
+        return None
+
+    level = pack_level or "slice"
+    domain_names, domain_ids, host_domain, host_free = \
+        _marshal_hosts(hosts, level)
+    pod_chips, eligible = _marshal_eligibility(pods, hosts)
+    penalty, prefer = _marshal_scoring(domain_names, domain_ids,
+                                       spread_penalty, prefer_slice)
+
+    out_score = ctypes.c_double()
+    out_domain = ctypes.c_int32()
+    out_assign = (ctypes.c_int32 * n_pods)()
+    rc = lib.grove_plan_gang(
+        n_pods, pod_chips, n_hosts, host_free, host_domain, eligible,
+        len(domain_names), penalty, prefer, 1 if required else 0,
+        ctypes.byref(out_score), ctypes.byref(out_domain), out_assign)
+    return _decode_plan(rc, pods, hosts, domain_names, level,
+                        out_score, out_domain, out_assign)
+
+
+def native_plan_gang_grouped(groups, hosts, pack_level: str,
+                             required: bool, prefer_slice: str,
+                             spread_penalty: dict[str, float]):
+    """Native-backed equivalent of placement.plan_gang_grouped. Returns
+    a PlacementPlan or None (infeasible), or NotImplemented when the
+    native library is unavailable. No zero-pod early return: the kernel
+    reproduces the reference's scoring for empty gangs too (prefer
+    bonus / penalties still pick the slice a rolling update would
+    reuse)."""
+    lib = _load_nowait()
+    if lib is None or not hasattr(lib, "grove_plan_gang_grouped"):
+        return NotImplemented
+
+    from grove_tpu.scheduler.placement import _domain_of
+
+    pods = [p for g in groups for p in g.pods]
+    n_pods = len(pods)
+    n_hosts = len(hosts)
+    if n_hosts == 0:
+        return None
+
+    level = pack_level or "slice"
+    domain_names, domain_ids, host_domain, host_free = \
+        _marshal_hosts(hosts, level)
+    pod_chips, eligible = _marshal_eligibility(pods, hosts)
+    penalty, prefer = _marshal_scoring(domain_names, domain_ids,
+                                       spread_penalty, prefer_slice)
+
+    constrained = [g for g in groups if g.pack_level]
+    n_groups = len(constrained)
+    group_required = (ctypes.c_uint8 * max(1, n_groups))()
+    group_sub = (ctypes.c_int32 * max(1, n_groups * n_hosts))()
+    group_of = {}
+    for g_i, g in enumerate(constrained):
+        group_required[g_i] = 1 if g.required else 0
+        sub_ids: dict[str, int] = {}
+        for h_i, h in enumerate(hosts):
+            sub = _domain_of(h, g.pack_level)
+            if sub not in sub_ids:
+                sub_ids[sub] = len(sub_ids)
+            group_sub[g_i * n_hosts + h_i] = sub_ids[sub]
+        for p in g.pods:
+            group_of[p.name] = g_i
+
+    pod_group = (ctypes.c_int32 * max(1, n_pods))()
+    for p_i, p in enumerate(pods):
+        pod_group[p_i] = group_of.get(p.name, -1)
+
+    out_score = ctypes.c_double()
+    out_domain = ctypes.c_int32()
+    out_assign = (ctypes.c_int32 * max(1, n_pods))()
+    rc = lib.grove_plan_gang_grouped(
+        n_pods, pod_chips, pod_group, n_groups, group_required,
+        n_hosts, host_free, host_domain, group_sub, eligible,
+        len(domain_names), penalty, prefer, 1 if required else 0,
+        ctypes.byref(out_score), ctypes.byref(out_domain), out_assign)
+    return _decode_plan(rc, pods, hosts, domain_names, level,
+                        out_score, out_domain, out_assign)

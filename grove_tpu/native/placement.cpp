@@ -115,3 +115,183 @@ int grove_plan_gang(
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Grouped gang planning — the per-PodGroup-constraint form
+// (placement.plan_gang_grouped; reference PodGroup.TopologyConstraint,
+// scheduler api podgang.go:99-117). Semantics mirror the Python
+// reference exactly (property-tested in tests/test_native_placement.py):
+//   - candidate OUTER domains in input-id order; within one domain:
+//       constrained groups (descending total demand, stable) each pack
+//       into the best sub-domain by tightness against CURRENT free
+//       (first-appearance sub-domain order; FFD with hosts re-sorted by
+//       current free, stable); a non-required group relaxes to FFD over
+//       the whole domain; unconstrained pods fill last.
+//   - domain score = used/total_free(original) - penalty (+10 prefer);
+//     first max wins.
+//   - required=0 falls back to the same procedure across ALL hosts
+//     (score -1, no domain).
+// group_sub_domain: [n_groups * n_hosts] sub-domain id of each host at
+// each group's pack level (-1 entries are never read for unconstrained
+// groups; pod_group[i] = -1 marks unconstrained pods).
+int grove_plan_gang_grouped(
+    int32_t n_pods, const int64_t* pod_chips, const int32_t* pod_group,
+    int32_t n_groups, const uint8_t* group_required,
+    int32_t n_hosts, const int64_t* host_free, const int32_t* host_domain,
+    const int32_t* group_sub_domain,
+    const uint8_t* eligible,          // [n_pods * n_hosts] 0/1
+    int32_t n_domains, const double* domain_penalty,
+    int32_t prefer_domain,            // -1 = none
+    int32_t required,
+    double* out_score, int32_t* out_domain, int32_t* out_assignment) {
+
+  std::vector<int64_t> group_demand(n_groups, 0);
+  std::vector<std::vector<int32_t>> group_pods(n_groups);
+  std::vector<int32_t> rest_pods;
+  for (int32_t p = 0; p < n_pods; ++p) {
+    int32_t g = pod_group[p];
+    if (g >= 0) {
+      group_demand[g] += pod_chips[p];
+      group_pods[g].push_back(p);
+    } else {
+      rest_pods.push_back(p);
+    }
+  }
+  // Constrained groups by descending demand (stable on input order).
+  std::vector<int32_t> group_order;
+  for (int32_t g = 0; g < n_groups; ++g) group_order.push_back(g);
+  std::stable_sort(group_order.begin(), group_order.end(),
+                   [&](int32_t a, int32_t b) {
+                     return group_demand[a] > group_demand[b];
+                   });
+
+  std::vector<int64_t> free_work(n_hosts);
+  std::vector<int32_t> assign_work(n_pods);
+
+  // FFD of `pods` (sorted by descending chips, stable) onto `cand`
+  // hosts re-sorted by CURRENT free (stable). Mutates free_work /
+  // assign_work; returns false (and leaves partial state for the
+  // caller to discard) when any pod cannot place.
+  auto ffd_into = [&](const std::vector<int32_t>& pods,
+                      std::vector<int32_t> cand) -> bool {
+    std::stable_sort(cand.begin(), cand.end(),
+                     [&](int32_t a, int32_t b) {
+                       return free_work[a] > free_work[b];
+                     });
+    std::vector<int32_t> order(pods);
+    std::stable_sort(order.begin(), order.end(),
+                     [&](int32_t a, int32_t b) {
+                       return pod_chips[a] > pod_chips[b];
+                     });
+    for (int32_t pi : order) {
+      bool placed = false;
+      for (int32_t h : cand) {
+        if (free_work[h] < pod_chips[pi]) continue;
+        if (!eligible[(size_t)pi * n_hosts + h]) continue;
+        assign_work[pi] = h;
+        free_work[h] -= pod_chips[pi];
+        placed = true;
+        break;
+      }
+      if (!placed) return false;
+    }
+    return true;
+  };
+
+  // Plan every group + the rest into the host set `domain` (-1 = all).
+  // Returns true when everything placed.
+  auto plan_in = [&](int32_t domain) -> bool {
+    for (int32_t h = 0; h < n_hosts; ++h) free_work[h] = host_free[h];
+    for (int32_t p = 0; p < n_pods; ++p) assign_work[p] = -1;
+    std::vector<int32_t> dom_hosts;
+    for (int32_t h = 0; h < n_hosts; ++h)
+      if (domain < 0 || host_domain[h] == domain) dom_hosts.push_back(h);
+    for (int32_t g : group_order) {
+      if (group_pods[g].empty()) continue;
+      // Candidate sub-domains in first-appearance order.
+      std::vector<int32_t> subs;
+      for (int32_t h : dom_hosts) {
+        int32_t s = group_sub_domain[(size_t)g * n_hosts + h];
+        bool seen = false;
+        for (int32_t x : subs) if (x == s) { seen = true; break; }
+        if (!seen) subs.push_back(s);
+      }
+      double best_score = -1e300;
+      std::vector<int64_t> best_free;
+      std::vector<int32_t> best_assign;
+      bool found = false;
+      std::vector<int64_t> save_free(free_work);
+      std::vector<int32_t> save_assign(assign_work);
+      for (int32_t s : subs) {
+        std::vector<int32_t> cand;
+        int64_t total_free = 0;
+        for (int32_t h : dom_hosts)
+          if (group_sub_domain[(size_t)g * n_hosts + h] == s) {
+            cand.push_back(h);
+            total_free += free_work[h];
+          }
+        free_work = save_free;
+        assign_work = save_assign;
+        if (!ffd_into(group_pods[g], cand)) continue;
+        double tightness = total_free > 0
+            ? (double)group_demand[g] / (double)total_free : 1.0;
+        if (tightness > best_score) {
+          best_score = tightness;
+          best_free = free_work;
+          best_assign = assign_work;
+          found = true;
+        }
+      }
+      if (found) {
+        free_work = best_free;
+        assign_work = best_assign;
+        continue;
+      }
+      free_work = save_free;
+      assign_work = save_assign;
+      if (group_required[g]) return false;
+      if (!ffd_into(group_pods[g], dom_hosts)) return false;  // relax
+    }
+    if (!rest_pods.empty() && !ffd_into(rest_pods, dom_hosts)) return false;
+    return true;
+  };
+
+  int64_t used = 0;
+  for (int32_t p = 0; p < n_pods; ++p) used += pod_chips[p];
+
+  double best_score = -1e300;
+  int32_t best_domain = -1;
+  std::vector<int32_t> best_assign;
+  for (int32_t d = 0; d < n_domains; ++d) {
+    int64_t total_free = 0;
+    bool has_host = false;
+    for (int32_t h = 0; h < n_hosts; ++h)
+      if (host_domain[h] == d) { total_free += host_free[h]; has_host = true; }
+    if (!has_host) continue;
+    if (!plan_in(d)) continue;
+    double tightness = total_free > 0
+        ? (double)used / (double)total_free : 1.0;
+    double score = tightness - domain_penalty[d];
+    if (d == prefer_domain) score += 10.0;
+    if (score > best_score) {
+      best_score = score;
+      best_domain = d;
+      best_assign = assign_work;
+    }
+  }
+  if (best_domain >= 0) {
+    *out_score = best_score;
+    *out_domain = best_domain;
+    for (int32_t p = 0; p < n_pods; ++p) out_assignment[p] = best_assign[p];
+    return 1;
+  }
+  if (required) return -1;
+  if (!plan_in(-1)) return -1;
+  *out_score = -1.0;
+  *out_domain = -1;
+  for (int32_t p = 0; p < n_pods; ++p) out_assignment[p] = assign_work[p];
+  return 0;
+}
+
+}  // extern "C"

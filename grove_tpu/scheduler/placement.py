@@ -192,6 +192,14 @@ def plan_gang_grouped(groups: list[GroupRequest], hosts: list[HostView],
         return plan_gang(all_pods, hosts, pack_level=pack_level,
                          required=required, prefer_slice=prefer_slice,
                          spread_penalty=spread_penalty)
+    import os
+    if os.environ.get("GROVE_NATIVE_PLACEMENT", "1") != "0":
+        from grove_tpu.native.loader import native_plan_gang_grouped
+        result = native_plan_gang_grouped(groups, hosts, pack_level,
+                                          required, prefer_slice,
+                                          spread_penalty or {})
+        if result is not NotImplemented:
+            return result
     spread_penalty = spread_penalty or {}
     level = pack_level or "slice"
     by_domain: dict[str, list[HostView]] = defaultdict(list)

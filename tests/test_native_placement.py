@@ -70,3 +70,87 @@ def test_native_respects_selectors_and_capacity():
     # infeasible: both pods demand the same single host
     pods = [PodRequest("p0", 4, {"acc": "b"}), PodRequest("p1", 4, {"acc": "b"})]
     assert native_plan_gang(pods, hosts, "slice", True, "", {}) is None
+
+
+def python_plan_grouped(*args, **kwargs):
+    os.environ["GROVE_NATIVE_PLACEMENT"] = "0"
+    try:
+        return placement.plan_gang_grouped(*args, **kwargs)
+    finally:
+        os.environ.pop("GROVE_NATIVE_PLACEMENT")
+
+
+def random_grouped_case(rng):
+    from grove_tpu.scheduler.placement import GroupRequest
+    n_pools = rng.randint(1, 3)
+    hosts = []
+    for pl in range(n_pools):
+        for s in range(rng.randint(1, 3)):
+            for w in range(rng.randint(1, 4)):
+                hosts.append(HostView(
+                    name=f"p{pl}s{s}-w{w}",
+                    free_chips=rng.choice([0, 2, 4, 4, 8]),
+                    domains={"pool": f"p{pl}", "slice": f"p{pl}s{s}"},
+                    labels={"acc": rng.choice(["a", "b"])}))
+    groups = []
+    pod_i = 0
+    for g in range(rng.randint(1, 3)):
+        pods = []
+        for _ in range(rng.randint(1, 4)):
+            sel = {"acc": "a"} if rng.random() < 0.15 else {}
+            pods.append(PodRequest(f"pod{pod_i}",
+                                   rng.choice([0, 1, 2, 4]), sel))
+            pod_i += 1
+        constrained = rng.random() < 0.7
+        groups.append(GroupRequest(
+            pods=pods,
+            pack_level="slice" if constrained else "",
+            required=rng.random() < 0.7))
+    penalty = {f"p{pl}": rng.choice([0.0, 2.0]) for pl in range(n_pools)
+               if rng.random() < 0.3}
+    required = rng.random() < 0.7
+    return groups, hosts, required, penalty
+
+
+def test_native_grouped_matches_python_randomized():
+    """The grouped planner (per-PodGroup slice constraints inside a
+    pool-packed gang — the hot path every PodGang takes) must agree
+    with the Python reference on plan feasibility, scores, domains,
+    and exact assignments."""
+    rng = random.Random(7)
+    agreements = 0
+    from grove_tpu.native.loader import native_plan_gang_grouped
+    for _ in range(300):
+        groups, hosts, required, penalty = random_grouped_case(rng)
+        py = python_plan_grouped(groups, hosts, pack_level="pool",
+                                 required=required, spread_penalty=penalty)
+        nat = native_plan_gang_grouped(groups, hosts, "pool", required,
+                                       "", penalty)
+        assert nat is not NotImplemented
+        assert (py is None) == (nat is None), (groups, hosts, required)
+        if py is None:
+            continue
+        assert abs(nat.score - py.score) < 1e-9, (nat, py)
+        assert nat.assignments == py.assignments, (nat, py)
+        agreements += 1
+    assert agreements > 50
+
+
+def test_native_grouped_slice_atomicity():
+    """Each constrained group lands inside ONE slice."""
+    from grove_tpu.native.loader import native_plan_gang_grouped
+    from grove_tpu.scheduler.placement import GroupRequest
+    hosts = [HostView(f"s{s}-w{w}", 4,
+                      {"pool": "p0", "slice": f"s{s}"}, {})
+             for s in range(2) for w in range(2)]
+    groups = [GroupRequest([PodRequest(f"a{i}", 4) for i in range(2)],
+                           pack_level="slice"),
+              GroupRequest([PodRequest(f"b{i}", 4) for i in range(2)],
+                           pack_level="slice")]
+    plan = native_plan_gang_grouped(groups, hosts, "pool", True, "", {})
+    assert plan is not None and plan is not NotImplemented
+    slice_of = {h.name: h.domains["slice"] for h in hosts}
+    for prefix in ("a", "b"):
+        slices = {slice_of[plan.assignments[f"{prefix}{i}"]]
+                  for i in range(2)}
+        assert len(slices) == 1, (prefix, plan.assignments)
