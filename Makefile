@@ -27,6 +27,12 @@ soak:            ## repeated scale out/in cycles
 bench:           ## single-chip serving benchmark (real TPU)
 	$(PY) bench.py
 
+bench-sweep:     ## batch x quant evidence matrix -> bench-history/ (real TPU)
+	GROVE_BENCH_BATCH=8  GROVE_BENCH_QUANT=int8 $(PY) bench.py
+	GROVE_BENCH_BATCH=8  GROVE_BENCH_QUANT=bf16 $(PY) bench.py
+	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=int8 $(PY) bench.py
+	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=bf16 $(PY) bench.py
+
 docs:            ## regenerate the API reference from the dataclasses
 	PYTHONPATH=. $(PY) tools/gen_api_docs.py > docs/api-reference.md
 
