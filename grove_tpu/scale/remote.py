@@ -107,7 +107,13 @@ class WireNodeDriver:
 
     def _pass(self) -> None:
         pending = []
-        for pod in self.client.list(Pod, self.namespace):
+        # Server-side field filtering (fieldSelector analog): ask only
+        # for MY nodes' Pending pods — at fleet scale the server must
+        # not serialize every pod for every agent poll.
+        for pod in self.client.list(
+                Pod, self.namespace,
+                fields={"node_name": ",".join(self.nodes),
+                        "phase": PodPhase.PENDING.value}):
             if (pod.status.node_name in self.nodes
                     and pod.status.phase == PodPhase.PENDING
                     and pod.meta.deletion_timestamp is None):

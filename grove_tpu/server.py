@@ -7,7 +7,15 @@ control plane exposes its own minimal HTTP API so out-of-process clients
   GET  /healthz                       manager health (JSON)
   GET  /metrics                       Prometheus text
   GET  /api/<kind>                    list (JSON; ?namespace=, label
-                                      selectors via ?l.<key>=<value>)
+                                      selectors via ?l.<key>=<value>,
+                                      status-field selectors via
+                                      ?f.<field>=<v1,v2> — server-side
+                                      filtering BEFORE serialization, the
+                                      kube fieldSelector analog: an agent
+                                      fleet asking for its own nodes'
+                                      Pending pods must not make the
+                                      server serialize the whole fleet's
+                                      pod list per poll)
   GET  /api/<kind>/<name>             get one
   GET  /logs/<ns>/<pod>               pod logs (?tail=N; kubectl-logs analog)
   GET  /watch                         resumable long-poll event feed
@@ -347,9 +355,11 @@ class ApiServer:
                         ns = q.get("namespace", ["default"])[0]
                         selector = {k[2:]: v[0] for k, v in q.items()
                                     if k.startswith("l.")}
+                        fields = {k[2:]: v[0] for k, v in q.items()
+                                  if k.startswith("f.")}
                         objs = cluster.client.list(
                             cls, None if ns == "*" else ns,
-                            selector or None)
+                            selector or None, fields=fields or None)
                         self._send(200, [to_dict(o) for o in objs])
                     elif len(parts) == 3 and parts[0] == "api":
                         cls = self._kind(parts[1])

@@ -30,8 +30,9 @@ class Client:
         return self._store.get(kind_cls, name, namespace)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
-             selector: dict[str, str] | None = None) -> list[Any]:
-        return self._store.list(kind_cls, namespace, selector)
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
+        return self._store.list(kind_cls, namespace, selector, fields)
 
     def create(self, obj: Any) -> Any:
         return self._store.create(obj, actor=self.actor)
@@ -135,9 +136,10 @@ class FakeClient(Client):
         return super().get(kind_cls, name, namespace)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
-             selector: dict[str, str] | None = None) -> list[Any]:
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
         self._intercept("list", kind_cls.KIND, "")
-        return super().list(kind_cls, namespace, selector)
+        return super().list(kind_cls, namespace, selector, fields)
 
     def create(self, obj: Any) -> Any:
         self._intercept("create", obj.KIND, obj.meta.name)

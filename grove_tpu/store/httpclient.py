@@ -115,10 +115,17 @@ class HttpClient:
         return from_dict(kind_cls, data)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
-             selector: dict[str, str] | None = None) -> list[Any]:
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
+        """``fields`` filters on STATUS fields server-side (the kube
+        fieldSelector analog; values may be comma-separated ORs) — the
+        server filters before serializing, so an agent fleet's polls
+        don't make it serialize the whole cluster per request."""
         params = {"namespace": namespace if namespace is not None else "*"}
         for k, v in (selector or {}).items():
             params[f"l.{k}"] = v
+        for k, v in (fields or {}).items():
+            params[f"f.{k}"] = v
         data = self._request(
             "GET", f"/api/{kind_cls.KIND}?{urlencode(params)}")
         return [from_dict(kind_cls, d) for d in data]

@@ -54,6 +54,23 @@ def matches_labels(obj: Any, selector: dict[str, str] | None) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def matches_fields(obj: Any, fields: dict[str, str] | None) -> bool:
+    """Status-field selector (kube fieldSelector analog): every key must
+    match one of its comma-separated values. Enum values compare by
+    their wire value; missing fields compare as ''. ONE implementation
+    shared by the in-process list and the HTTP list handler."""
+    if not fields:
+        return True
+    st = getattr(obj, "status", None)
+    for key, want in fields.items():
+        v = getattr(st, key, "") if st is not None else ""
+        if hasattr(v, "value"):
+            v = v.value
+        if str(v) not in set(str(want).split(",")):
+            return False
+    return True
+
+
 class Watcher:
     """A subscription to store events; iterate or poll with timeout."""
 
@@ -239,12 +256,14 @@ class Store:
         return clone(obj)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
-             selector: dict[str, str] | None = None) -> list[Any]:
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
         with self._lock:
             objs = self._objects.get(kind_cls.KIND, {})
             refs = [obj for (ns, _), obj in objs.items()
                     if (namespace is None or ns == namespace)
-                    and matches_labels(obj, selector)]
+                    and matches_labels(obj, selector)
+                    and matches_fields(obj, fields)]
         out = [clone(o) for o in refs]
         out.sort(key=lambda o: o.meta.name)
         return out

@@ -245,3 +245,30 @@ def test_grovectl_cordon_drain_uncordon(server, capsys):
     assert main(["uncordon", victim, "--server", base]) == 0
     assert "uncordoned" in capsys.readouterr().out
     assert not cl.client.get(Node, victim).spec.unschedulable
+
+
+def test_field_selector_filters_server_side(server):
+    """?f.<field>=v1,v2 (fieldSelector analog): the server filters on
+    status fields BEFORE serializing — the agent-fleet poll pattern."""
+    base, cl = server
+    _req(f"{base}/apply", "POST", MANIFEST)
+    # Wait for RUNNING, not just scheduled: scheduling (node bind) and
+    # the kubelet's Pending→Running flip are separate async loops, and
+    # the phase assertions below must not race the window between them.
+    wait_for(lambda: (lambda pods: len(pods) == 2 and all(
+        p["status"]["node_name"] and p["status"]["phase"] == "Running"
+        for p in pods))(_req(f"{base}/api/Pod")[1]), desc="running")
+    _, pods = _req(f"{base}/api/Pod")
+    node0 = pods[0]["status"]["node_name"]
+    s, only0 = _req(f"{base}/api/Pod?f.node_name={node0}")
+    assert s == 200
+    assert only0 and all(p["status"]["node_name"] == node0 for p in only0)
+    # OR values + no matches
+    s, both = _req(f"{base}/api/Pod?f.node_name="
+                   f"{node0},{pods[1]['status']['node_name']}")
+    assert len(both) == len(pods)
+    s, none = _req(f"{base}/api/Pod?f.phase=Pending")
+    assert s == 200 and none == []
+    # enum field matches by wire value
+    s, running = _req(f"{base}/api/Pod?f.phase=Running")
+    assert len(running) == 2
