@@ -450,6 +450,8 @@ def _append_history(path: str, label: str, result: dict) -> None:
     with open(path, "a") as f:
         f.write(_json.dumps(entry) + "\n")
     same_scale = [p for p in prior if p.get("pods") == result["pods"]
+                  and (p.get("remote_agents", 0) or 0)
+                  == (result.get("remote_agents", 0) or 0)
                   and "deploy_pods_ready_s" in p]
     if same_scale:
         best = min(p["deploy_pods_ready_s"] for p in same_scale)

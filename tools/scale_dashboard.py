@@ -57,17 +57,24 @@ def render(runs: list[dict]) -> str:
     out = ["# Scale history", ""]
     if not runs:
         return "\n".join(out + ["_no runs recorded_", ""])
-    by_pods: dict[int, list[dict]] = {}
+    # Group by (pods, wire-mode): in-process and remote-agent runs have
+    # different cost structures (the wire adds agent processes + HTTP),
+    # so comparing a remote run against the in-process best would flag
+    # a phantom regression.
+    by_pods: dict[tuple, list[dict]] = {}
     for r in runs:
-        by_pods.setdefault(r["pods"], []).append(r)
-    for pods in sorted(by_pods, reverse=True):
-        entries = sorted(by_pods[pods], key=lambda r: r.get("ts", 0.0))
+        by_pods.setdefault((r["pods"], r.get("remote_agents", 0) or 0),
+                           []).append(r)
+    for pods, agents in sorted(by_pods, reverse=True):
+        entries = sorted(by_pods[(pods, agents)],
+                         key=lambda r: r.get("ts", 0.0))
         ready = [r["deploy_pods_ready_s"] for r in entries]
         best = min(ready)
         latest = ready[-1]
         verdict = ("REGRESSION" if latest > best * REGRESSION_FACTOR
                    else "ok")
-        out += [f"## {pods} pods — latest {latest:.1f}s ready "
+        wire = f" over the wire ({agents} agents)" if agents else ""
+        out += [f"## {pods} pods{wire} — latest {latest:.1f}s ready "
                 f"(best {best:.1f}s, {len(entries)} runs, {verdict})",
                 "",
                 f"trend: `{sparkline(ready)}`  (older → newer)",
