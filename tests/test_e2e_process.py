@@ -163,7 +163,7 @@ def test_readiness_probe_timeout_fails_pod(cluster):
                         argv=[sys.executable, "-c", never_code],
                         readiness_file="never-ready",
                         readiness_period_s=0.1,
-                        readiness_timeout_s=5.0)),
+                        readiness_timeout_s=8.0)),
                 PodCliqueTemplate(
                     name="slow", replicas=1, tpu_chips_per_pod=4,
                     container=ContainerSpec(
@@ -187,8 +187,9 @@ def test_readiness_probe_timeout_fails_pod(cluster):
     # ≥2 starts of the never-ready payload proves the ProbeTimeout →
     # FAILED → gang self-heal → relaunch cycle ran (the FAILED status
     # itself is transient: the controller replaces the pod within ms).
-    # Timeout 5s (not lower): every python child in this image takes
-    # ~2s to start (sitecustomize registers the TPU relay) — a tighter
-    # probe deadline would kill the payload before user code runs.
+    # Timeout 8s (not lower): every python child in this image takes
+    # ~2s to start (sitecustomize registers the TPU relay) and a loaded
+    # single-core box stretches that further — a tighter probe deadline
+    # would kill the payload before user code runs.
     wait_for(lambda: len(list(starts.iterdir())) >= 2, timeout=45.0,
              desc="probe-timeout pod failed and was relaunched")
