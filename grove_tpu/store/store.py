@@ -110,6 +110,15 @@ class Store:
         self._rv = itertools.count(1)
         self._watchers: list[Watcher] = []
         self._admission = None   # AdmissionChain (see grove_tpu.admission)
+        # Read-path clone cache: stored objects are immutable per
+        # resource version (writes REPLACE entries, never mutate), so
+        # the pickle-dumps half of every read clone can be computed once
+        # per version and reused by every subsequent reader — at steady
+        # state reconcilers re-read far more than controllers write
+        # (profiled: serde.clone dominated the 1000-pod no-op reconcile
+        # cost). Keyed by object identity; entries die with the object.
+        self._clone_cache: dict[tuple[str, str, str],
+                                tuple[int, bytes]] = {}
         # Event history ring for resumable (wire) watches: (seq, event).
         # seq is the rv that produced the event (deletes allocate one).
         # A watcher further behind than the ring must relist (410-Gone
@@ -247,13 +256,33 @@ class Store:
     # inside the global lock would serialise every controller thread
     # behind each large list.
 
+    def _read_clone(self, obj: Any) -> Any:
+        """Clone for the read path via the per-version bytes cache (one
+        pickle.dumps per object version; loads per reader)."""
+        import pickle
+        key = (obj.KIND, obj.meta.namespace, obj.meta.name)
+        rv = obj.meta.resource_version
+        hit = self._clone_cache.get(key)
+        if hit is not None and hit[0] == rv:
+            return pickle.loads(hit[1])
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            # Insert under the lock, re-checked against live objects:
+            # an unlocked insert could race _remove's eviction and
+            # resurrect a just-deleted entry forever (the rv compare
+            # keeps correctness either way; this keeps the cache from
+            # leaking dead names).
+            if _key(obj) in self._objects.get(obj.KIND, {}):
+                self._clone_cache[key] = (rv, data)
+        return pickle.loads(data)
+
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
         with self._lock:
             objs = self._objects.get(kind_cls.KIND, {})
             obj = objs.get((namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind_cls.KIND} {namespace}/{name} not found")
-        return clone(obj)
+        return self._read_clone(obj)
 
     def list(self, kind_cls: type, namespace: str | None = "default",
              selector: dict[str, str] | None = None,
@@ -264,7 +293,7 @@ class Store:
                     if (namespace is None or ns == namespace)
                     and matches_labels(obj, selector)
                     and matches_fields(obj, fields)]
-        out = [clone(o) for o in refs]
+        out = [self._read_clone(o) for o in refs]
         out.sort(key=lambda o: o.meta.name)
         return out
 
@@ -461,6 +490,8 @@ class Store:
     def _remove(self, obj: Any) -> None:
         """Unconditional removal + owner-reference cascade (GC analog)."""
         self._objects[obj.KIND].pop(_key(obj), None)
+        self._clone_cache.pop(
+            (obj.KIND, obj.meta.namespace, obj.meta.name), None)
         self._persist_delete(obj)
         # Deletions get their own seq (kube bumps rv on delete too) so
         # resumable watches order them after the final MODIFIED.
