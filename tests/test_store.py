@@ -130,3 +130,41 @@ def test_fake_client_error_injection():
         c.get(Pod, "a")
     assert c.get(Pod, "a").meta.name == "a"   # injected error consumed
     assert ("create", "Pod", "a") in c.calls()
+
+
+def test_read_clone_cache_isolation_and_invalidation():
+    """The per-version read-clone cache must preserve the store's two
+    load-bearing read guarantees: every reader gets an INDEPENDENT copy
+    (mutating a returned object never leaks into the store or other
+    readers), and a new version/name-reuse never serves stale bytes."""
+    from grove_tpu.api import PodCliqueSet, new_meta
+    from grove_tpu.api.podcliqueset import (PodCliqueSetSpec,
+                                            PodCliqueSetTemplate,
+                                            PodCliqueTemplate)
+    store = Store()
+
+    def pcs(name):
+        return PodCliqueSet(
+            meta=new_meta(name),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(name="w", replicas=2)])))
+
+    store.create(pcs("a"))
+    r1 = store.get(PodCliqueSet, "a")
+    r2 = store.get(PodCliqueSet, "a")
+    assert r1 is not r2
+    r1.spec.replicas = 99                       # reader-side mutation
+    assert store.get(PodCliqueSet, "a").spec.replicas == 1
+
+    # Version bump invalidates the cached bytes.
+    live = store.get(PodCliqueSet, "a")
+    live.spec.replicas = 3
+    store.update(live)
+    assert store.get(PodCliqueSet, "a").spec.replicas == 3
+
+    # Delete + recreate under the same name: fresh uid, never stale.
+    old_uid = store.get(PodCliqueSet, "a").meta.uid
+    store.delete(PodCliqueSet, "a")
+    store.create(pcs("a"))
+    fresh = store.get(PodCliqueSet, "a")
+    assert fresh.meta.uid != old_uid and fresh.spec.replicas == 1
