@@ -20,6 +20,7 @@ disaggregated prefill/decode Llama service with no external engine.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any
 
@@ -297,6 +298,105 @@ def prefill(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                         preferred_element_type=jnp.float32)
     new_cache = KVCache(k=k_all, v=v_all, lengths=lengths.astype(jnp.int32))
     return logits, new_cache
+
+
+def prefill_chunk(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                  cache: KVCache, offset: int
+                  ) -> tuple[jnp.ndarray, KVCache]:
+    """One chunked-prefill window: process tokens [b, c] at absolute
+    positions [offset, offset+c) against a cache whose first ``offset``
+    rows are already filled. ``offset`` is STATIC (one executable per
+    window position — chunked prefill compiles ceil(s/c) programs, the
+    standard trade for bounded attention reads). Attention reads only
+    cache[:offset+c], so peak activation memory is O(c · ctx) instead
+    of the full prompt's O(s²) logits block.
+
+    Returns (hidden states [b, c, d_model] after final norm, cache with
+    rows [offset, offset+c) filled) — the driver gathers per-lane
+    last-valid rows and applies the LM head once.
+
+    NOTE: driven through ``prefill_chunked``, the CALLER'S input cache
+    is DONATED to the first window's executable (bounded memory is the
+    feature's point — an undonated cache would transiently double the
+    KV footprint per window on TPU). Do not reuse a cache object after
+    passing it in; take the returned one.
+    """
+    b, s_c = tokens.shape
+    end = offset + s_c
+    positions = jnp.broadcast_to(offset + jnp.arange(s_c), (b, s_c))
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = embed(cfg, params, tokens)
+    # Same trace-time impl selection as one-shot prefill: the offset-0
+    # window is square/causal and flash-eligible; later windows are
+    # rectangular (q vs a longer prefix), which the kernel does not
+    # tile — pick_causal_attention returns None there and the XLA
+    # formulation runs (grove_tpu/ops/attention.py:51).
+    from grove_tpu.ops.attention import pick_causal_attention
+    flash = pick_causal_attention(s_c, cfg.head_dim, q_offset=offset)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        kc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(kc, k, offset)
+        vc = jax.vmap(kvcache.write_row, in_axes=(0, 0, None))(vc, v, offset)
+        if flash is not None and offset == 0 and end == s_c:
+            attn = flash(q, k, v)
+        else:
+            attn = causal_attention(q, kc[:, :end], vc[:, :end],
+                                    q_offset=offset)
+        x = _attn_out(x, attn, lp)
+        x = _mlp_block(cfg, x, lp)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x, KVCache(k=k_all, v=v_all,
+                      lengths=jnp.full((b,), end, jnp.int32))
+
+
+def prefill_chunked(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                    cache: KVCache, chunk: int,
+                    lengths: jnp.ndarray | None = None
+                    ) -> tuple[jnp.ndarray, KVCache]:
+    """Bounded-memory prefill: the prompt is processed in ``chunk``-sized
+    windows (vLLM-style chunked prefill), each a separate executable
+    whose attention reads only the live cache prefix. The input
+    ``cache`` is DONATED (see ``prefill_chunk``): use the returned
+    cache, never the argument, after this call. Matches ``prefill``
+    up to float accumulation order (XLA blocks the windowed matmuls
+    differently; greedy decode from the two caches agrees — proven by
+    tests/test_model_llama.py). Ragged batches supported: each lane's
+    logits are taken at its last VALID position (``lengths``), gathered
+    from whichever window that position falls in.
+
+    Returns (logits [b, vocab], cache with lengths set per lane)."""
+    b, s = tokens.shape
+    assert s % chunk == 0 or s < chunk, \
+        f"prompt length {s} must divide into chunks of {chunk}"
+    if lengths is None:
+        lengths = jnp.full((b,), s, jnp.int32)
+    else:
+        lengths = jnp.asarray(lengths, jnp.int32)
+    fn = _jitted_prefill_chunk(cfg)
+    x_last = jnp.zeros((b, cfg.d_model), cfg.dtype)
+    for off in range(0, s, chunk):
+        x_chunk, cache = fn(params, tokens[:, off:off + chunk], cache, off)
+        c = x_chunk.shape[1]
+        # Lanes whose last valid token lands in this window keep its row.
+        idx = jnp.clip(lengths - 1 - off, 0, c - 1)
+        rows = jnp.take_along_axis(
+            x_chunk, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        in_window = (lengths - 1 >= off) & (lengths - 1 < off + c)
+        x_last = jnp.where(in_window[:, None], rows, x_last)
+    logits = jnp.einsum("bd,dv->bv", x_last, _w(params["lm_head"]),
+                        preferred_element_type=jnp.float32)
+    return logits, cache._replace(lengths=lengths)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_prefill_chunk(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_chunk, cfg),
+                   static_argnums=(3,), donate_argnums=(2,))
 
 
 def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,

@@ -88,7 +88,8 @@ class PrefillWorker:
     def __init__(self, cfg: LlamaConfig, params, batch: int = 1,
                  max_prompt: int | None = None,
                  sampler: SamplerConfig | None = None,
-                 quant: str | None = None):
+                 quant: str | None = None,
+                 prefill_chunk: int | None = None):
         self.cfg = cfg
         self.params = params
         assert quant in (None, "int8"), f"unknown quant mode {quant!r}"
@@ -99,6 +100,14 @@ class PrefillWorker:
         self.max_prompt = max_prompt or cfg.max_seq_len
         self.sampler = sampler or SamplerConfig()
         self._rng = jax.random.PRNGKey(self.sampler.seed)
+        # Chunked prefill (llama.prefill_chunked): bounds the attention
+        # working set for long prompts — the prefill worker's whole job
+        # is long prompts, so this is its natural posture. One-shot stays
+        # the default (single executable, exact ragged-lengths logits).
+        if prefill_chunk:
+            assert self.max_prompt % prefill_chunk == 0, \
+                (self.max_prompt, prefill_chunk)
+        self.prefill_chunk = prefill_chunk
 
         def run(params, tokens, lengths, cache):
             return llama.prefill(cfg, params, tokens, cache, lengths)
@@ -116,8 +125,13 @@ class PrefillWorker:
         for i, p in enumerate(prompts):
             toks[i, :len(p)] = p
             lengths[i] = len(p)
-        logits, cache = self._prefill(self.params, jnp.asarray(toks),
-                                      jnp.asarray(lengths), self._cache)
+        if self.prefill_chunk:
+            logits, cache = llama.prefill_chunked(
+                self.cfg, self.params, jnp.asarray(toks), self._cache,
+                chunk=self.prefill_chunk, lengths=jnp.asarray(lengths))
+        else:
+            logits, cache = self._prefill(self.params, jnp.asarray(toks),
+                                          jnp.asarray(lengths), self._cache)
         self._cache = cache
         if self.sampler.temperature > 0.0:
             self._rng, sub = jax.random.split(self._rng)

@@ -129,3 +129,47 @@ def test_mesh_axes_factorisation():
         if n >= 8:
             assert plan.dp >= 2 and plan.sp >= 2 and plan.tp >= 2, plan
     assert mesh_axes_for(8, max_tp=4) == MeshPlan(dp=2, sp=2, tp=2)
+
+
+def test_chunked_prefill_matches_one_shot():
+    """Chunked prefill (bounded attention reads, one executable per
+    window) must match the one-shot prefill up to float accumulation
+    order (XLA blocks the windowed matmuls differently), and decode
+    IDENTICALLY from the resulting cache."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from grove_tpu.models import llama
+    from grove_tpu.ops.kvcache import KVCache
+
+    cfg = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                              max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+
+    def fresh_cache():
+        return KVCache.create(cfg.n_layers, 2, 64, cfg.n_kv_heads,
+                              cfg.head_dim, cfg.dtype)
+
+    want_logits, want_cache = llama.prefill(cfg, params, tokens,
+                                            fresh_cache())
+    got_logits, got_cache = llama.prefill_chunked(cfg, params, tokens,
+                                                  fresh_cache(), chunk=8)
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(want_logits), rtol=2e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_cache.k[:, :, :32]),
+                               np.asarray(want_cache.k[:, :, :32]),
+                               rtol=2e-3, atol=1e-5)
+    assert np.array_equal(np.asarray(got_cache.lengths),
+                          np.asarray(want_cache.lengths))
+    # The caches decode identically from here.
+    t_want, _ = (jnp.argmax(llama.decode_step(
+        cfg, params, jnp.argmax(want_logits, -1).astype(jnp.int32),
+        want_cache)[0], -1), None)
+    t_got, _ = (jnp.argmax(llama.decode_step(
+        cfg, params, jnp.argmax(got_logits, -1).astype(jnp.int32),
+        got_cache)[0], -1), None)
+    assert np.array_equal(np.asarray(t_want), np.asarray(t_got))
