@@ -468,13 +468,18 @@ def cmd_apply(args: argparse.Namespace) -> int:
     except OSError as e:
         print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
         return 1
-    status, out = _http(args.server, "/apply", "POST", body, ca=args.ca)
+    path = "/apply?dry_run=1" if getattr(args, "dry_run", False) else "/apply"
+    status, out = _http(args.server, path, "POST", body, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
         return 1
+    rc = 0
     for r in out:
-        print(f"{r['kind']}/{r['name']} {r['action']}")
-    return 0
+        suffix = f": {r['error']}" if r.get("error") else ""
+        print(f"{r['kind']}/{r['name']} {r['action']}{suffix}")
+        if r["action"] in ("invalid", "forbidden"):
+            rc = 1        # a dry run is a validation GATE: fail loudly
+    return rc
 
 
 def cmd_patch(args: argparse.Namespace) -> int:
@@ -700,6 +705,10 @@ def main(argv: list[str] | None = None) -> int:
 
     apply_p = sub.add_parser("apply", help="apply a manifest to a serve daemon")
     apply_p.add_argument("-f", "--file", required=True)
+    apply_p.add_argument("--dry-run", action="store_true",
+                         help="server-side dry run: full admission "
+                              "(defaulting/validation/authorization), "
+                              "nothing committed")
     apply_p.add_argument("--server", default=default_server)
     add_ca(apply_p)
     apply_p.set_defaults(fn=cmd_apply)

@@ -31,7 +31,9 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       config.profiling.enabled
   GET  /debug/stacks                  all-threads stack dump (goroutine
                                       dump analog; same gate)
-  POST /apply                         YAML/JSON manifest (create-or-update)
+  POST /apply                         YAML/JSON manifest (create-or-
+                                      update; ?dry_run=1 = admission-only
+                                      server-side dry run)
   PATCH /api/<kind>/<name>            RFC 7386 JSON merge patch on
                                       spec/labels/annotations
   PUT  /api/<kind>/<name>/status      status-subresource write (full
@@ -404,6 +406,12 @@ class ApiServer:
                 client = self._mutating_client()
                 if client is None:
                     return
+                # ?dry_run=1: run the FULL admission chain (defaulting,
+                # validation, authorization) per object and report the
+                # would-be actions, committing nothing — the kubectl
+                # apply --dry-run=server analog.
+                dry_run = parse_qs(urlparse(self.path).query).get(
+                    "dry_run", ["0"])[0].lower() in ("1", "true", "yes")
                 length = int(self.headers.get("Content-Length", "0"))
                 raw = self.rfile.read(length).decode()
                 try:
@@ -415,6 +423,9 @@ class ApiServer:
                     for obj in objs:
                         if not self._guard_secret_access(type(obj)):
                             return
+                    if dry_run:
+                        self._send(200, self._apply_dry_run(client, objs))
+                        return
                     results = []
                     forbidden = False
                     for obj in objs:
@@ -455,6 +466,30 @@ class ApiServer:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - malformed input
                     self._send(400, {"error": f"bad manifest: {e}"})
+
+            def _apply_dry_run(self, client, objs) -> list:
+                """Admission-only pass over a manifest: each object is
+                defaulted + validated + authorized against live state
+                via the store's own admission dispatch (ONE path shared
+                with real writes), committing nothing."""
+                results = []
+                for obj in objs:
+                    try:
+                        action = client.dry_run_admit(obj)
+                        results.append({"kind": obj.KIND,
+                                        "name": obj.meta.name,
+                                        "action": action})
+                    except ForbiddenError as e:
+                        results.append({"kind": obj.KIND,
+                                        "name": obj.meta.name,
+                                        "action": "forbidden",
+                                        "error": str(e)})
+                    except GroveError as e:
+                        results.append({"kind": obj.KIND,
+                                        "name": obj.meta.name,
+                                        "action": "invalid",
+                                        "error": str(e)})
+                return results
 
             def _pod_logs(self, namespace: str, pod: str, q):
                 """GET /logs/<namespace>/<pod>[?tail=N] — kubectl-logs

@@ -37,6 +37,9 @@ class Client:
     def create(self, obj: Any) -> Any:
         return self._store.create(obj, actor=self.actor)
 
+    def dry_run_admit(self, obj: Any) -> str:
+        return self._store.dry_run_admit(obj, actor=self.actor)
+
     def update(self, obj: Any) -> Any:
         return self._store.update(obj, actor=self.actor)
 

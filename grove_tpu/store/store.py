@@ -168,6 +168,24 @@ class Store:
             return obj
         return self._admission.admit(verb, obj, old, actor)
 
+    def dry_run_admit(self, obj: Any,
+                      actor: str = "system:grove-operator") -> str:
+        """Run the FULL admission chain for a would-be create-or-update
+        of ``obj`` against live state, committing nothing (the kubectl
+        --dry-run=server analog). ONE admission path: this is the same
+        _admit the real writes call, with the same create-vs-update
+        decision, under the same lock. Returns "would-create" or
+        "would-update"; raises exactly what the real write would."""
+        with self._lock:
+            live = self._objects.get(obj.KIND, {}).get(_key(obj))
+            if live is None:
+                self._admit("create", clone(obj), None, actor)
+                return "would-create"
+            updated = clone(live)
+            updated.spec = clone(obj.spec)
+            self._admit("update", updated, clone(live), actor)
+            return "would-update"
+
     # ---- watch ----
 
     def watch(self, kinds: Iterable[str] | None = None,

@@ -272,3 +272,37 @@ def test_field_selector_filters_server_side(server):
     # enum field matches by wire value
     s, running = _req(f"{base}/api/Pod?f.phase=Running")
     assert len(running) == 2
+
+
+def test_apply_dry_run_admits_without_committing(server, tmp_path, capsys):
+    """?dry_run=1 (kubectl apply --dry-run=server analog): full
+    admission runs — defaulting, validation, authorization against live
+    state — and NOTHING commits."""
+    from grove_tpu.cli import main
+    base, cl = server
+    from grove_tpu.api import PodCliqueSet
+
+    s, out = _req(f"{base}/apply?dry_run=1", "POST", MANIFEST,
+                  token=OPERATOR_TOKEN)
+    assert s == 200 and out[0]["action"] == "would-create"
+    assert cl.client.list(PodCliqueSet) == []          # nothing committed
+
+    # Validation failures surface per object.
+    bad = MANIFEST.replace("tpu_chips_per_pod: 4", "tpu_chips_per_pod: 3")
+    s, out = _req(f"{base}/apply?dry_run=1", "POST", bad,
+                  token=OPERATOR_TOKEN)
+    assert s == 200 and out[0]["action"] == "invalid"
+    assert "power of two" in out[0]["error"]
+
+    # Against a live object it reports would-update.
+    _req(f"{base}/apply", "POST", MANIFEST, token=OPERATOR_TOKEN)
+    s, out = _req(f"{base}/apply?dry_run=1", "POST", MANIFEST,
+                  token=OPERATOR_TOKEN)
+    assert out[0]["action"] == "would-update"
+
+    # grovectl --dry-run plumbs through.
+    manifest = tmp_path / "m.yaml"
+    manifest.write_text(MANIFEST)
+    assert main(["apply", "-f", str(manifest), "--dry-run",
+                 "--server", base]) == 0
+    assert "would-update" in capsys.readouterr().out
