@@ -460,6 +460,65 @@ def cmd_describe(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_rollout(args: argparse.Namespace) -> int:
+    """kubectl rollout status analog: report a PodCliqueSet's rolling
+    update progress (exit 0 = up to date, 1 = in progress) or --watch
+    until it completes."""
+    deadline = time.time() + args.timeout
+
+    def once():
+        """True=done, False=in progress, None=transient fetch error."""
+        status, obj = _http(args.server,
+                            f"/api/PodCliqueSet/{args.name}"
+                            f"?namespace={args.namespace}", ca=args.ca)
+        if status != 200:
+            print(f"error ({status}): {_err_text(obj)}", file=sys.stderr)
+            return None
+        meta = obj.get("meta", {}) or {}
+        st = obj.get("status", {}) or {}
+        spec = obj.get("spec", {}) or {}
+        ru = st.get("rolling_update")
+        total = spec.get("replicas", 0)
+        updated = st.get("updated_replicas", 0)
+        if ru:
+            mode = "pod-level" if ru.get("pod_level") else \
+                "replica-recreation"
+            cur = ru.get("current_replica")
+            print(f"rolling update in progress ({mode}, target "
+                  f"{ru.get('target_hash', '')[:12]}): "
+                  f"{len(ru.get('updated_replicas') or [])}/{total} "
+                  f"replicas updated"
+                  + (f", updating replica {cur}" if cur is not None
+                     else ""))
+            return False
+        # No in-progress update AND the controller has observed the
+        # latest spec generation (kubectl's observedGeneration guard —
+        # a watch started right after an apply must not win the race
+        # against the controller creating rolling_update).
+        if st.get("observed_generation", 0) < meta.get("generation", 0):
+            print(f"PodCliqueSet/{args.name}: waiting for the controller "
+                  f"to observe generation {meta.get('generation', 0)}")
+            return False
+        print(f"PodCliqueSet/{args.name}: up to date "
+              f"({max(updated, total)}/{total} replicas)")
+        return True
+
+    while True:
+        done = once()
+        if done is True:
+            return 0
+        if not args.watch:
+            # Exit code distinguishes in-progress (and fetch errors)
+            # from complete for scripts polling without --watch.
+            return 1 if done is not True else 0
+        if time.time() > deadline:
+            print("timed out waiting for rollout", file=sys.stderr)
+            return 1
+        # Transient fetch errors retry inside the deadline too (a serve
+        # daemon mid-restart must not abort a watch with budget left).
+        time.sleep(args.poll)
+
+
 def cmd_apply(args: argparse.Namespace) -> int:
     """Apply a manifest against a running serve daemon."""
     try:
@@ -730,6 +789,19 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("--server", default=default_server)
     add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
+
+    ro = sub.add_parser("rollout", help="rolling-update status for a "
+                        "PodCliqueSet (kubectl rollout status analog)")
+    ro.add_argument("verb", choices=["status"])
+    ro.add_argument("name")
+    ro.add_argument("--namespace", default="default")
+    ro.add_argument("--watch", action="store_true",
+                    help="poll until the rollout completes")
+    ro.add_argument("--timeout", type=float, default=300.0)
+    ro.add_argument("--poll", type=float, default=0.5)
+    ro.add_argument("--server", default=default_server)
+    add_ca(ro)
+    ro.set_defaults(fn=cmd_rollout)
 
     for verb in ("cordon", "uncordon"):
         cp = sub.add_parser(verb, help=f"{verb} a node "
