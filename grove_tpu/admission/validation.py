@@ -957,8 +957,28 @@ def validate_podcliqueset(pcs: PodCliqueSet,
     return errs
 
 
+# Label keys are ``[prefix/]name``: prefix a DNS subdomain (<= 253),
+# name alphanumeric with -_. inside (<= 63) — the k8s label-key rules
+# (reference admission/clustertopology/validation enforces qualified
+# names on topology keys the same way).
+_LABEL_NAME_RE = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9._-]{0,61}[A-Za-z0-9])?$")
+_DNS_SUBDOMAIN_RE = re.compile(
+    r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?(\.[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?)*$")
+
+
+def _label_key_problems(key: str) -> str | None:
+    if len(key) > 317:                      # 253 prefix + '/' + 63 name
+        return "too long"
+    prefix, sep, name = key.rpartition("/")
+    if sep and (len(prefix) > 253 or not _DNS_SUBDOMAIN_RE.match(prefix)):
+        return f"prefix {prefix!r} is not a DNS subdomain"
+    if len(name) > 63 or not _LABEL_NAME_RE.match(name):
+        return f"name {name!r} is not a qualified label name"
+    return None
+
+
 def validate_clustertopology(ct: ClusterTopology) -> list[str]:
-    """W5: level uniqueness + label rules."""
+    """W5: level uniqueness, domain naming, node-label key syntax."""
     errs: list[str] = []
     domains = [lvl.domain for lvl in ct.spec.levels]
     labels = [lvl.node_label for lvl in ct.spec.levels]
@@ -971,4 +991,12 @@ def validate_clustertopology(ct: ClusterTopology) -> list[str]:
     for lvl in ct.spec.levels:
         if not lvl.domain or not lvl.node_label:
             errs.append(f"level {lvl}: domain and node_label are required")
+            continue
+        if not _NAME_RE.match(lvl.domain):
+            errs.append(f"level domain {lvl.domain!r} must be "
+                        "DNS-label-like (constraints reference it)")
+        problem = _label_key_problems(lvl.node_label)
+        if problem:
+            errs.append(f"level {lvl.domain!r}: node_label "
+                        f"{lvl.node_label!r}: {problem}")
     return errs

@@ -460,6 +460,24 @@ def cmd_describe(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_scale(args: argparse.Namespace) -> int:
+    """kubectl scale analog: replica count via the same merge-patch
+    surface HPA-style controllers use (the scale subresource's job)."""
+    import json as _json
+    body = _json.dumps({"spec": {"replicas": args.replicas}}).encode()
+    status, out = _http(args.server,
+                        f"/api/{args.kind}/{args.name}"
+                        f"?namespace={args.namespace}",
+                        "PATCH", body,
+                        content_type="application/merge-patch+json",
+                        ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(out)}", file=sys.stderr)
+        return 1
+    print(f"{args.kind}/{args.name} scaled to {args.replicas}")
+    return 0
+
+
 def cmd_rollout(args: argparse.Namespace) -> int:
     """kubectl rollout status analog: report a PodCliqueSet's rolling
     update progress (exit 0 = up to date, 1 = in progress) or --watch
@@ -467,12 +485,17 @@ def cmd_rollout(args: argparse.Namespace) -> int:
     deadline = time.time() + args.timeout
 
     def once():
-        """True=done, False=in progress, None=transient fetch error."""
+        """True=done, False=in progress, None=transient fetch error.
+        Raises SystemExit(1) on a PERMANENT error (404/403/...): only a
+        connection failure (status 0, server mid-restart) is worth
+        retrying inside the watch deadline."""
         status, obj = _http(args.server,
                             f"/api/PodCliqueSet/{args.name}"
                             f"?namespace={args.namespace}", ca=args.ca)
         if status != 200:
             print(f"error ({status}): {_err_text(obj)}", file=sys.stderr)
+            if status != 0:
+                raise SystemExit(1)
             return None
         meta = obj.get("meta", {}) or {}
         st = obj.get("status", {}) or {}
@@ -510,7 +533,7 @@ def cmd_rollout(args: argparse.Namespace) -> int:
         if not args.watch:
             # Exit code distinguishes in-progress (and fetch errors)
             # from complete for scripts polling without --watch.
-            return 1 if done is not True else 0
+            return 1
         if time.time() > deadline:
             print("timed out waiting for rollout", file=sys.stderr)
             return 1
@@ -789,6 +812,18 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("--server", default=default_server)
     add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
+
+    sc = sub.add_parser("scale", help="set replicas on a PodCliqueSet / "
+                        "PodCliqueScalingGroup / PodClique (kubectl "
+                        "scale analog, via merge patch)")
+    sc.add_argument("kind", choices=["PodCliqueSet",
+                                     "PodCliqueScalingGroup", "PodClique"])
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.add_argument("--namespace", default="default")
+    sc.add_argument("--server", default=default_server)
+    add_ca(sc)
+    sc.set_defaults(fn=cmd_scale)
 
     ro = sub.add_parser("rollout", help="rolling-update status for a "
                         "PodCliqueSet (kubectl rollout status analog)")

@@ -80,3 +80,29 @@ def test_externally_managed_drift_detection(cluster):
         live = client.get(ClusterTopology, "default")
         return live.status.drift_detected
     wait_for(drifted, desc="drift detected (backend view not overwritten)")
+
+
+def test_ct_label_key_syntax_validated():
+    """W5 depth: node_label keys must be k8s-qualified ([prefix/]name);
+    domains must be DNS-label-like (constraints reference them)."""
+    from grove_tpu.admission.validation import validate_clustertopology
+    from grove_tpu.api.clustertopology import (ClusterTopologySpec,
+                                               TopologyLevel)
+    from grove_tpu.api import ClusterTopology, new_meta
+
+    def ct(levels):
+        return ClusterTopology(meta=new_meta("x"),
+                               spec=ClusterTopologySpec(levels=levels))
+
+    ok = ct([TopologyLevel("slice", "cloud.google.com/gke-tpu-topology"),
+             TopologyLevel("host", "kubernetes.io/hostname")])
+    assert not validate_clustertopology(ok)
+    bad_key = ct([TopologyLevel("slice", "Bad Prefix!/x")])
+    assert any("DNS subdomain" in e
+               for e in validate_clustertopology(bad_key))
+    bad_name = ct([TopologyLevel("slice", "example.com/bad name")])
+    assert any("qualified label name" in e
+               for e in validate_clustertopology(bad_name))
+    bad_domain = ct([TopologyLevel("Not A Domain", "example.com/ok")])
+    assert any("DNS-label-like" in e
+               for e in validate_clustertopology(bad_domain))

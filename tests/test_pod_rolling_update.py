@@ -231,8 +231,23 @@ def test_grovectl_rollout_status(capsys):
                          "--server", base]) == 0
             assert "up to date" in capsys.readouterr().out
 
-            # Deterministic in-progress branch: write the status shape
-            # the controller produces and assert the report + exit 1.
+            # Template change → rolling update → --watch sees it finish.
+            live = client.get(PodCliqueSet, "roll")
+            live.spec.template.cliques[0].container.env["V"] = "2"
+            client.update(live)
+            assert main(["rollout", "status", "roll", "--watch",
+                         "--timeout", "60", "--server", base]) == 0
+            out = capsys.readouterr().out
+            assert "up to date" in out
+
+            # Controllers STOPPED from here: the injected statuses below
+            # must stay exactly as written (a live manager would chase a
+            # fake target hash through real gang recreation and race the
+            # CLI reads).
+            cl.manager.stop()
+
+            # In-progress branch: the status shape the controller
+            # produces mid-rollout, asserted deterministically.
             from grove_tpu.api.podcliqueset import UpdateProgress
             live = client.get(PodCliqueSet, "roll")
             live.status.rolling_update = UpdateProgress(
@@ -249,24 +264,20 @@ def test_grovectl_rollout_status(capsys):
             live.status.rolling_update = None
             client.update_status(live)
 
-            # Template change → rolling update → --watch sees it finish.
-            live = client.get(PodCliqueSet, "roll")
-            live.spec.template.cliques[0].container.env["V"] = "2"
-            client.update(live)
-            assert main(["rollout", "status", "roll", "--watch",
-                         "--timeout", "60", "--server", base]) == 0
-            out = capsys.readouterr().out
-            assert "up to date" in out
-
-            # Observed-generation race guard (deterministic: controllers
-            # stopped, so nothing re-observes the bumped generation): a
-            # spec the controller has not seen is NOT "up to date".
-            cl.manager.stop()
+            # Observed-generation race guard: a spec the controller has
+            # not seen is NOT "up to date".
             live = client.get(PodCliqueSet, "roll")
             live.spec.template.cliques[0].container.env["V"] = "3"
             client.update(live)
             assert main(["rollout", "status", "roll",
                          "--server", base]) == 1
             assert "waiting for the controller" in capsys.readouterr().out
+
+            # Permanent errors fail fast even under --watch.
+            import pytest as _pytest
+            with _pytest.raises(SystemExit):
+                main(["rollout", "status", "nosuch", "--watch",
+                      "--timeout", "30", "--server", base])
+            capsys.readouterr()
         finally:
             srv.stop()

@@ -306,3 +306,24 @@ def test_apply_dry_run_admits_without_committing(server, tmp_path, capsys):
     assert main(["apply", "-f", str(manifest), "--dry-run",
                  "--server", base]) == 0
     assert "would-update" in capsys.readouterr().out
+
+
+def test_grovectl_scale_verb(server, capsys):
+    """kubectl scale analog: replicas patched over the wire, reconciled
+    to pods."""
+    import time as _t
+    from grove_tpu.api import Pod, constants as c
+    from grove_tpu.cli import main
+    base, cl = server
+    _req(f"{base}/apply", "POST", MANIFEST)
+    sel = {c.LABEL_PCS_NAME: "websvc"}
+    wait_for(lambda: len(cl.client.list(Pod, selector=sel)) == 2,
+             desc="base pods")
+    assert main(["scale", "PodCliqueSet", "websvc", "--replicas", "2",
+                 "--server", base]) == 0
+    assert "scaled to 2" in capsys.readouterr().out
+    wait_for(lambda: len(cl.client.list(Pod, selector=sel)) == 4,
+             desc="scaled out")
+    assert main(["scale", "PodCliqueSet", "ghost", "--replicas", "2",
+                 "--server", base]) == 1
+    capsys.readouterr()
