@@ -90,8 +90,14 @@ def test_delete_terminates_processes(cluster):
                           ".write(str(__import__('os').getpid()));"
                           "__import__('time').sleep(120)"]))],
         ))))
-    wait_for(lambda: (tmp / "alive.pid").exists(), timeout=15.0,
-             desc="process started")
+    def pid_written():
+        # exists() alone races the child between open() and write() —
+        # under load the empty-file window is wide enough to hit.
+        try:
+            return (tmp / "alive.pid").read_text().strip() != ""
+        except OSError:
+            return False
+    wait_for(pid_written, timeout=15.0, desc="process started")
     pid = int((tmp / "alive.pid").read_text())
     os.kill(pid, 0)  # alive
     client.delete(PodCliqueSet, "killme")
