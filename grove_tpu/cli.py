@@ -460,6 +460,58 @@ def cmd_describe(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_top(args: argparse.Namespace) -> int:
+    """kubectl-top-style capacity view: per-node chip allocation from
+    live pod placements, rolled up per slice."""
+    status, nodes = _http(args.server, "/api/Node?namespace=*", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(nodes)}", file=sys.stderr)
+        return 1
+    status, pods = _http(args.server, "/api/Pod?namespace=*", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(pods)}", file=sys.stderr)
+        return 1
+    used: dict[str, int] = {}
+    for p in pods:
+        node = p.get("status", {}).get("node_name")
+        # Mirror the scheduler's accounting exactly (build_host_views):
+        # only live (Pending/Running) pods consume chips — a completed
+        # batch pod keeps its node_name but its chips are schedulable.
+        if (node and not p.get("meta", {}).get("deletion_timestamp")
+                and p.get("status", {}).get("phase") in ("Pending",
+                                                         "Running")):
+            used[node] = used.get(node, 0) + p.get("spec", {}).get(
+                "tpu_chips", 0)
+    slice_rollup: dict[str, list[int]] = {}
+    rows = [("NODE", "SLICE", "CHIPS", "USED", "FREE", "STATE")]
+    for n in sorted(nodes, key=lambda n: n["meta"]["name"]):
+        name = n["meta"]["name"]
+        # allocatable (status) — what the scheduler can actually place
+        # on, not the spec'd hardware count: a registered-but-not-yet-
+        # heartbeating remote node allocates 0.
+        total = n.get("status", {}).get("allocatable_chips", 0)
+        u = used.get(name, 0)
+        sl = n.get("meta", {}).get("labels", {}).get(
+            c.NODE_LABEL_SLICE, "")
+        state = []
+        if not n.get("status", {}).get("ready"):
+            state.append("NotReady")
+        if n.get("spec", {}).get("unschedulable"):
+            state.append("Cordoned")
+        rows.append((name, sl, str(total), str(u), str(total - u),
+                     ",".join(state) or "Ready"))
+        agg = slice_rollup.setdefault(sl or name, [0, 0])
+        agg[0] += total
+        agg[1] += u
+    _table(rows)
+    print()
+    srows = [("SLICE", "CHIPS", "USED", "FREE")]
+    for sl, (total, u) in sorted(slice_rollup.items()):
+        srows.append((sl, str(total), str(u), str(total - u)))
+    _table(srows)
+    return 0
+
+
 def cmd_scale(args: argparse.Namespace) -> int:
     """kubectl scale analog: replica count via the same merge-patch
     surface HPA-style controllers use (the scale subresource's job)."""
@@ -812,6 +864,13 @@ def main(argv: list[str] | None = None) -> int:
     delete.add_argument("--server", default=default_server)
     add_ca(delete)
     delete.set_defaults(fn=cmd_delete)
+
+    tp = sub.add_parser("top", help="per-node/per-slice chip allocation "
+                        "from live pod placements (kubectl top analog)")
+    tp.add_argument("what", choices=["nodes"], nargs="?", default="nodes")
+    tp.add_argument("--server", default=default_server)
+    add_ca(tp)
+    tp.set_defaults(fn=cmd_top)
 
     sc = sub.add_parser("scale", help="set replicas on a PodCliqueSet / "
                         "PodCliqueScalingGroup / PodClique (kubectl "

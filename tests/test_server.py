@@ -327,3 +327,24 @@ def test_grovectl_scale_verb(server, capsys):
     assert main(["scale", "PodCliqueSet", "ghost", "--replicas", "2",
                  "--server", base]) == 1
     capsys.readouterr()
+
+
+def test_grovectl_top_nodes(server, capsys):
+    """kubectl-top-style chip allocation: per-node used/free from live
+    placements with the per-slice rollup."""
+    from grove_tpu.api import Pod, constants as c
+    from grove_tpu.cli import main
+    base, cl = server
+    _req(f"{base}/apply", "POST", MANIFEST)
+    sel = {c.LABEL_PCS_NAME: "websvc"}
+    wait_for(lambda: all(p.status.node_name for p in cl.client.list(
+        Pod, selector=sel)) and len(cl.client.list(Pod, selector=sel)) == 2,
+        desc="placed")
+    assert main(["top", "nodes", "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert out.splitlines()[0].split() == [
+        "NODE", "SLICE", "CHIPS", "USED", "FREE", "STATE"]
+    # 2 pods x 4 chips on a 16-chip slice: rollup shows 8 used, 8 free.
+    assert "SLICE" in out
+    rollup = [ln for ln in out.splitlines() if ln.startswith("pool-0-slice")]
+    assert any(ln.split()[-3:] == ["16", "8", "8"] for ln in rollup), out
