@@ -1,0 +1,61 @@
+"""In-pod serving worker: the payload a PodCliqueSet decode clique runs.
+
+Demonstrates the full integration contract end to end:
+- model + engine from the framework (DecodeEngine, chunked prefill)
+- readiness signalled THROUGH THE PROBE FILE only after weights load and
+  the decode path is compiled — the pod goes Ready when it can serve,
+  not when the process starts (container.readiness_file)
+- identity/config from the injected env (GROVE_*/TPU_*)
+
+Real deployments point this at a real config (llama-70b + tp over ICI);
+the demo serves the test-tiny config on CPU so `grovectl run --real` and
+the e2e can execute it anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import time
+
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import DecodeEngine
+
+    model = os.environ.get("GROVE_SERVE_MODEL", "test-tiny")
+    cfg = llama.CONFIGS[model]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = DecodeEngine(cfg, params, batch=2, max_len=64)
+    # Warm the compiled paths BEFORE signalling ready: a pod that goes
+    # Ready and then stalls its first request on a 30s compile would
+    # defeat the probe's purpose.
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab_size)
+    engine.admit_prompts(prompt, max_new_tokens=16)
+    engine.run(8)
+    print(f"worker {os.environ.get('GROVE_POD_NAME', '?')}: engine warm "
+          f"({model}), signalling ready", flush=True)
+
+    ready_file = os.environ.get("GROVE_READY_FILE", "ready")
+    with open(ready_file, "w") as f:
+        f.write("ok")
+
+    t0 = time.time()
+    steps = 0
+    while time.time() - t0 < float(os.environ.get("GROVE_SERVE_SECONDS",
+                                                  120)):
+        engine.run(8)
+        steps += 8
+        if not any(engine._active):
+            engine.admit_prompts(prompt, max_new_tokens=16)
+    print(f"served {steps} decode steps", flush=True)
+
+
+if __name__ == "__main__":
+    main()

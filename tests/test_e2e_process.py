@@ -14,6 +14,7 @@ import pytest
 from grove_tpu.agent.process import ProcessKubelet
 from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
 from grove_tpu.api.core import ContainerSpec, PodPhase
+from grove_tpu.api.meta import is_condition_true
 from grove_tpu.api.podcliqueset import (
     PodCliqueSetSpec,
     PodCliqueSetTemplate,
@@ -185,7 +186,6 @@ def test_readiness_probe_timeout_fails_pod(cluster):
             startup_type=StartupType.ANY_ORDER,
         ))))
     sel_slow = {c.LABEL_PCLQ_ROLE: "slow"}
-    from grove_tpu.api.meta import is_condition_true
     wait_for(lambda: any(
         is_condition_true(p.status.conditions, c.COND_READY)
         for p in client.list(Pod, selector=sel_slow)),
@@ -199,3 +199,63 @@ def test_readiness_probe_timeout_fails_pod(cluster):
     # would kill the payload before user code runs.
     wait_for(lambda: len(list(starts.iterdir())) >= 2, timeout=45.0,
              desc="probe-timeout pod failed and was relaunched")
+
+
+def test_serving_worker_ready_after_engine_warm(cluster):
+    """The full in-pod serving integration: the pod goes Ready only
+    after the worker's engine is warm (readiness file written post-
+    compile), and the worker's serving output lands in the pod log."""
+    cl, tmp = cluster
+    client = cl.client
+    worker = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "samples", "workloads", "serving_worker.py")
+    client.create(PodCliqueSet(
+        meta=new_meta("servepcs"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="decode", replicas=1, tpu_chips_per_pod=4,
+                container=ContainerSpec(
+                    argv=[sys.executable, worker],
+                    # cwd is the pod workdir, not the repo: the worker
+                    # imports grove_tpu via PYTHONPATH like any real
+                    # deployment would via its image's site-packages.
+                    env={"GROVE_SERVE_SECONDS": "60",
+                         "PYTHONPATH": os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__)))},
+                    readiness_file="ready",
+                    readiness_period_s=0.2,
+                    readiness_timeout_s=120.0))],
+        ))))
+    sel = {c.LABEL_PCS_NAME: "servepcs"}
+
+    def pod():
+        pods = client.list(Pod, selector=sel)
+        return pods[0] if pods else None
+
+    # Running (process up) strictly before Ready (engine warm). Every
+    # pod() read tolerates the None window of a self-heal replace.
+    def running():
+        live = pod()
+        return live is not None and live.status.phase == PodPhase.RUNNING
+    wait_for(running, timeout=20.0, desc="process running")
+    p = pod()
+    assert p is None or not is_condition_true(
+        p.status.conditions, c.COND_READY), "Ready before the engine warmed"
+
+    def ready():
+        live = pod()   # None during a self-heal replace window
+        return live is not None and is_condition_true(
+            live.status.conditions, c.COND_READY)
+    # Wait at least as long as the probe's own deadline: the system
+    # still considers a slower warm-up healthy until 120s.
+    wait_for(ready, timeout=130.0, desc="ready after engine warm")
+    # The worker's own output is in the pod log.
+    log_dir = tmp / "pod-logs"
+
+    def logged():
+        # One log file PER POD INCARNATION: a self-heal replace leaves a
+        # dead first log, so scan them all.
+        return any("signalling ready" in f.read_text() for f in
+                   log_dir.glob("default.servepcs-0-decode-0.*.log"))
+    wait_for(logged, timeout=10.0, desc="worker log captured")
