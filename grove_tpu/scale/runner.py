@@ -46,7 +46,11 @@ class ScaleConfig:
     # measure the reconcile ripple (count + latency percentiles) —
     # reference scale_test.go:216-240. The p95 budget is asserted.
     steady_touches: int = 50
-    steady_p95_budget_s: float = 0.25
+    # Calibrated at 300 pods: healthy p95 is ~85-130ms; a per-event
+    # pathology (the thing this bound exists to catch) lands in whole
+    # seconds. 0.5 keeps 4-6x headroom for loaded single-core runners
+    # where wall-clock includes scheduler contention, not just work.
+    steady_p95_budget_s: float = 0.5
     poll: float = 0.05
     # Per-phase sampling profiles exported here (the reference captures
     # pprof per phase and pushes to Pyroscope, scale_test.go:131).

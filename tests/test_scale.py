@@ -27,7 +27,7 @@ def test_scale_300_pods_within_budget():
     assert all(v >= 1 for v in res["steady_per_clique_reconciles"].values())
     assert res["steady_reconciles"] >= 3
     import os
-    budget_ms = float(os.environ.get("GROVE_SCALE_P95_BUDGET_S", "0.25")) * 1e3
+    budget_ms = float(os.environ.get("GROVE_SCALE_P95_BUDGET_S", "0.5")) * 1e3
     assert 0 < res["steady_p95_ms"] < budget_ms
     # Delete request returns fast; cascade completes.
     assert res["delete_request_s"] < 1.0
