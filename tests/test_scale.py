@@ -26,9 +26,10 @@ def test_scale_300_pods_within_budget():
     assert res["steady_touched_cliques"] == 3
     assert all(v >= 1 for v in res["steady_per_clique_reconciles"].values())
     assert res["steady_reconciles"] >= 3
-    import os
-    budget_ms = float(os.environ.get("GROVE_SCALE_P95_BUDGET_S", "0.5")) * 1e3
-    assert 0 < res["steady_p95_ms"] < budget_ms
+    # The p95 bound itself is asserted INSIDE run_scale_test (env-
+    # tunable, remote/pod-count scaled); here just require a sane
+    # non-zero measurement so a broken timer can't pass silently.
+    assert res["steady_p95_ms"] > 0
     # Delete request returns fast; cascade completes.
     assert res["delete_request_s"] < 1.0
     assert res["delete_cascade_s"] < 30
