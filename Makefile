@@ -20,6 +20,8 @@ dashboard:       ## render scale-history JSONL into DASHBOARD.md
 	@# rows without committed backing would make the dashboard lie
 	$(PY) tools/scale_dashboard.py scale-history/history.jsonl \
 		scale-history/ci.jsonl -o scale-history/DASHBOARD.md
+	$(PY) tools/bench_dashboard.py bench-history/history.jsonl \
+		-o bench-history/DASHBOARD.md
 
 soak:            ## repeated scale out/in cycles
 	$(PY) -m pytest tests/test_scale.py::test_soak_scale_cycles -q

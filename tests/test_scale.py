@@ -134,3 +134,29 @@ def test_scale_dashboard_renders(tmp_path):
     out = tmp_path / "d.md"
     assert scale_dashboard.main([str(hist), "-o", str(out)]) == 0
     assert out.read_text() == report
+
+
+def test_bench_dashboard_renders(tmp_path):
+    """tools/bench_dashboard.py: success table + failure timeline."""
+    import json
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import bench_dashboard
+    finally:
+        sys.path.pop(0)
+    hist = tmp_path / "b.jsonl"
+    hist.write_text("\n".join(json.dumps(r) for r in [
+        {"ts": "2026-07-29T12:00:00", "git": "abc", "value": 2107.9,
+         "metric": "llama1b_decode_tokens_per_sec_per_chip", "batch": 8,
+         "quant": "int8", "vs_baseline": 0.95, "vs_engine_bare": 1.002,
+         "hbm_util": 0.372, "prefill_tok_s": 30000.0},
+        {"ts": "2026-07-29T22:00:00", "git": "def", "value": 0.0,
+         "error": "attempt hung >230s in phase 'pre-init'"},
+    ]) + "\n")
+    report = bench_dashboard.render(bench_dashboard.load_rows([str(hist)]))
+    assert "| 2107.9 | 0.950 | 1.002 | 37.2% |" in report
+    assert "Failure timeline" in report and "pre-init" in report
+    out = tmp_path / "d.md"
+    assert bench_dashboard.main([str(hist), "-o", str(out)]) == 0
+    assert out.read_text() == report

@@ -1,0 +1,85 @@
+"""Bench-history dashboard — renders bench-history/history.jsonl
+(the committed serving-perf record) into markdown: successful runs
+grouped by (model, batch, quant) with the headline ratios, and the
+failure timeline (relay outages are evidence too).
+
+    python tools/bench_dashboard.py bench-history/history.jsonl \
+        [-o bench-history/DASHBOARD.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_rows(paths: list[str]) -> list[dict]:
+    rows = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError as e:
+            print(f"warning: {path}: {e}", file=sys.stderr)
+    return rows
+
+
+def render(rows: list[dict]) -> str:
+    out = ["# Bench history", ""]
+    ok = [r for r in rows if r.get("value", 0) > 0]
+    failed = [r for r in rows if r.get("value", 0) <= 0]
+    if ok:
+        out += ["## Successful runs", "",
+                "| when | git | model | batch | quant | tok/s/chip | "
+                "vs bare JAX | vs engine loop | HBM util | prefill tok/s |",
+                "|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(ok, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('metric', '?').split('_')[0]} "
+                f"| {r.get('batch', '?')} | {r.get('quant', '?')} "
+                f"| {r.get('value', 0):.1f} "
+                f"| {r.get('vs_baseline', 0):.3f} "
+                f"| {r.get('vs_engine_bare', r.get('vs_baseline', 0)):.3f} "
+                f"| {100 * r.get('hbm_util', 0):.1f}% "
+                f"| {r.get('prefill_tok_s', 0):.0f} |")
+        out.append("")
+    else:
+        out += ["_no successful runs recorded yet — see the failure "
+                "timeline (dev-run evidence lives in bench-stderr.log)_",
+                ""]
+    if failed:
+        out += ["## Failure timeline (relay outages)", "",
+                "| when | git | error |", "|---|---|---|"]
+        for r in sorted(failed, key=lambda r: r.get("ts", "")):
+            out.append(f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                       f"| {r.get('error', '?')} |")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench-dashboard")
+    parser.add_argument("history", nargs="+")
+    parser.add_argument("-o", "--out")
+    args = parser.parse_args(argv)
+    report = render(load_rows(args.history))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
