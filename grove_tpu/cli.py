@@ -364,7 +364,19 @@ def cmd_get(args: argparse.Namespace) -> int:
     the kind's printcolumns (the reference declares printcolumns on
     every CRD); default stays JSON for scripting."""
     import json as _json
+    from urllib.parse import urlencode
+    params = {}
+    if getattr(args, "selector", None):
+        for part in args.selector.split(","):
+            k, _, v = part.partition("=")
+            if not k or not v:
+                print(f"error: bad selector {part!r} (want key=value)",
+                      file=sys.stderr)
+                return 1
+            params[f"l.{k}"] = v
     path = f"/api/{args.kind}" + (f"/{args.name}" if args.name else "")
+    if params:
+        path += "?" + urlencode(params)
     status, body = _http(args.server, path, ca=args.ca)
     if status != 200:
         print(f"error ({status}): {_err_text(body)}", file=sys.stderr)
@@ -817,6 +829,9 @@ def main(argv: list[str] | None = None) -> int:
     get = sub.add_parser("get", help="read resources from a serve daemon")
     get.add_argument("kind")
     get.add_argument("name", nargs="?")
+    get.add_argument("-l", "--selector",
+                     help="label selector key=value[,key=value] "
+                          "(kubectl -l analog)")
     get.add_argument("-o", "--output", choices=["json", "table"],
                      default="json",
                      help="table renders the kind's printcolumns "

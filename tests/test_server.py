@@ -112,6 +112,18 @@ def test_grovectl_client_verbs(server, tmp_path, capsys):
     assert main(["get", "Pod", "-o", "table", "--server", base]) == 0
     out = capsys.readouterr().out
     assert "PHASE" in out and "NODE" in out and "websvc-0-w-0" in out
+    # -l label selector (kubectl -l analog) narrows the list.
+    assert main(["get", "Pod", "-o", "table",
+                 "-l", "grove.tpu/podcliqueset=websvc",
+                 "--server", base]) == 0
+    assert "websvc-0-w-0" in capsys.readouterr().out
+    assert main(["get", "Pod", "-o", "table",
+                 "-l", "grove.tpu/podcliqueset=nope",
+                 "--server", base]) == 0
+    out = capsys.readouterr().out
+    assert "websvc-0-w-0" not in out
+    assert main(["get", "Pod", "-l", "malformed", "--server", base]) == 1
+    capsys.readouterr()
 
     assert main(["delete", "PodCliqueSet", "websvc", "--server", base]) == 0
     assert "deleted" in capsys.readouterr().out
