@@ -367,10 +367,22 @@ def cmd_get(args: argparse.Namespace) -> int:
     from urllib.parse import urlencode
     params = {}
     if getattr(args, "selector", None):
+        if args.name:
+            # kubectl parity: a name already identifies one object; a
+            # selector on top would be silently unenforced server-side.
+            print("error: --selector cannot be combined with a resource "
+                  "name", file=sys.stderr)
+            return 1
         for part in args.selector.split(","):
             k, _, v = part.partition("=")
             if not k or not v:
                 print(f"error: bad selector {part!r} (want key=value)",
+                      file=sys.stderr)
+                return 1
+            if f"l.{k}" in params and params[f"l.{k}"] != v:
+                # Two values for one key can never both hold (AND
+                # semantics) — overwriting would silently broaden.
+                print(f"error: conflicting selector values for {k!r}",
                       file=sys.stderr)
                 return 1
             params[f"l.{k}"] = v

@@ -123,6 +123,11 @@ def test_grovectl_client_verbs(server, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "websvc-0-w-0" not in out
     assert main(["get", "Pod", "-l", "malformed", "--server", base]) == 1
+    # name+selector and conflicting values are rejected (kubectl parity)
+    assert main(["get", "Pod", "websvc-0-w-0", "-l", "a=b",
+                 "--server", base]) == 1
+    assert main(["get", "Pod", "-l", "app=web,app=db",
+                 "--server", base]) == 1
     capsys.readouterr()
 
     assert main(["delete", "PodCliqueSet", "websvc", "--server", base]) == 0
