@@ -7,8 +7,8 @@ PY ?= python
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
 
-test-fast:       ## control-plane tests only (skip model numerics)
-	$(PY) -m pytest tests/ -q -k "not model and not ring and not moe and not pallas and not serving"
+test-fast:       ## control-plane core only (deselect the slow tier)
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 scale:           ## 1000-pod deploy/steady/delete timeline (+ local history)
 	$(PY) -m grove_tpu.scale --pods 1000 \
@@ -32,8 +32,15 @@ bench:           ## single-chip serving benchmark (real TPU)
 bench-sweep:     ## batch x quant evidence matrix -> bench-history/ (real TPU)
 	GROVE_BENCH_BATCH=8  GROVE_BENCH_QUANT=int8 $(PY) bench.py
 	GROVE_BENCH_BATCH=8  GROVE_BENCH_QUANT=bf16 $(PY) bench.py
+	GROVE_BENCH_BATCH=16 GROVE_BENCH_QUANT=int8 $(PY) bench.py
 	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=int8 $(PY) bench.py
 	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=bf16 $(PY) bench.py
+
+bench-disagg:    ## PrefillWorker->DecodeEngine KV hand-off seam (real TPU)
+	@# More compiles than the headline bench (one-shot + chunked
+	@# prefill + two engines): widen the per-attempt watchdog.
+	GROVE_BENCH_MODE=disagg GROVE_BENCH_ATTEMPT_TIMEOUT=420 \
+		GROVE_BENCH_TOTAL_BUDGET=900 $(PY) bench.py
 
 docs:            ## regenerate the API reference from the dataclasses
 	PYTHONPATH=. $(PY) tools/gen_api_docs.py > docs/api-reference.md
@@ -45,9 +52,16 @@ serve:           ## run the control plane as a daemon with the HTTP API
 	$(PY) -m grove_tpu.cli serve --fleet v5e:4x4:2
 
 ci:              ## the CI gate (reference .github/workflows analog):
-	@#  lint (compile-check) → unit/e2e suite → budgeted scale point
+	@#  lint (compile-check) → tiered suite (core first with a 300s
+	@#  time-box printed+enforced from inside the session, slow tier
+	@#  after; ONE pytest run, one collection) under a 600s wall →
+	@#  budgeted scale point. Budgets are WALLS (tools/ci_budget.py +
+	@#  conftest tier plugin): a green-but-slow suite fails the gate,
+	@#  so wall time cannot silently creep past the 10-minute guidance.
 	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
-	$(PY) -m pytest tests/ -q
+	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
+		--label "test suite (core+slow tiers)" -- \
+		$(PY) -m pytest tests/ -q
 	$(PY) -m grove_tpu.scale --pods 300 \
 		--history scale-history/ci.jsonl \
 		--label "ci-$$(git rev-parse --short HEAD 2>/dev/null || echo dev)"

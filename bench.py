@@ -520,6 +520,170 @@ def run_bench(partial: dict) -> dict:
     }
 
 
+def run_bench_disagg(partial: dict) -> dict:
+    """Disaggregated-serving seam benchmark (GROVE_BENCH_MODE=disagg):
+    the PrefillWorker → DecodeEngine.insert KV hand-off on one chip.
+
+    The north star names Llama-70B DISAGG serving (BASELINE.md); this
+    measures the seam that shape lives or dies on, single-host: prefill
+    throughput through the worker (one-shot AND chunked — the long-
+    prompt posture, GREP-0003), the per-sequence cost of splicing a
+    prefilled KV slab into a free decode lane, and how much decode
+    throughput degrades when hand-offs interleave with decode blocks
+    (the prefill-pod→decode-pod pattern of samples/llama70b-disagg.yaml
+    scaled down to one chip). Runs under the same supervisor/watchdog/
+    history machinery as the headline bench."""
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import DecodeEngine, PrefillWorker
+
+    model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
+    cfg = llama.CONFIGS[model]
+    max_len = min(MAX_LEN, cfg.max_seq_len)
+    # Long-prompt posture: the prompt fills 3/4 of the cache budget.
+    prompt_len = max_len * 3 // 4
+    lanes = int(os.environ.get("GROVE_DISAGG_LANES", 8))
+    pf_batch = int(os.environ.get("GROVE_DISAGG_PF_BATCH", 4))
+    chunk = max(32, prompt_len // 4)
+    while prompt_len % chunk:
+        chunk //= 2
+    block = int(os.environ.get("GROVE_BENCH_BLOCK", 16))
+    quant = os.environ.get("GROVE_BENCH_QUANT", "int8")
+    quant = None if quant in ("bf16", "none", "0") else quant
+
+    dev = init_devices()[0]
+    partial["phase"] = "init"
+    checkpoint_partial(partial)
+    smoke_probe()
+    log(f"disagg bench device: {dev.platform} {dev.device_kind}; "
+        f"model {model}, lanes={lanes} prompt={prompt_len} "
+        f"cache={max_len} pf_batch={pf_batch} chunk={chunk}")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = DecodeEngine(cfg, params, batch=lanes, max_len=max_len,
+                       quant=quant, host_sync_interval=block)
+    params = eng.params  # quantized view shared with the prefill side
+    worker = PrefillWorker(cfg, params, batch=pf_batch,
+                           max_prompt=prompt_len)
+    worker_chunked = PrefillWorker(cfg, params, batch=pf_batch,
+                                   max_prompt=prompt_len,
+                                   prefill_chunk=chunk)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len, np.int32)
+               for _ in range(pf_batch)]
+
+    def time_prefill(w) -> tuple[float, list]:
+        results = w.prefill(prompts)              # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            results = w.prefill(prompts)
+            best = min(best, time.perf_counter() - t0)
+        return pf_batch * prompt_len / best, results
+
+    pf_tok_s, results = time_prefill(worker)
+    partial["prefill_tok_s"] = round(pf_tok_s, 1)
+    partial["phase"] = "prefill-done"
+    checkpoint_partial(partial)
+    log(f"prefill (one-shot): {pf_tok_s:.0f} tok/s")
+    pf_chunked_tok_s, _ = time_prefill(worker_chunked)
+    partial["prefill_chunked_tok_s"] = round(pf_chunked_tok_s, 1)
+    partial["phase"] = "chunked-done"
+    checkpoint_partial(partial)
+    log(f"prefill (chunked, {chunk}/step): {pf_chunked_tok_s:.0f} tok/s")
+
+    # Hand-off cost: splice a prefilled slab into each free lane. First
+    # pass warms the per-lane update executables; second pass times.
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    slab_mb = (2 * cfg.n_layers * prompt_len * cfg.n_kv_heads
+               * cfg.head_dim * itemsize) / 1e6
+    for lane in eng.free_lanes():
+        eng.insert(lane, results[lane % pf_batch])
+    eng.sync()
+    # params are already quantized via eng — quant=None here, or the
+    # weights would be double-quantized.
+    eng2 = DecodeEngine(cfg, params, batch=lanes, max_len=max_len,
+                        host_sync_interval=block)
+    t0 = time.perf_counter()
+    for lane in eng2.free_lanes():
+        eng2.insert(lane, results[lane % pf_batch])
+    eng2.sync()
+    insert_ms = (time.perf_counter() - t0) / lanes * 1e3
+    partial["insert_ms_per_seq"] = round(insert_ms, 3)
+    partial["phase"] = "handoff-done"
+    checkpoint_partial(partial)
+    log(f"KV hand-off: {insert_ms:.2f} ms/seq ({slab_mb:.1f} MB slab)")
+
+    # Decode disturbance: clean blocks vs blocks interleaved with one
+    # retire+hand-off per block (the steady disagg serving pattern).
+    # Step count stays within the cache budget per hand-off cycle
+    # (tiny configs have only max_len/4 decode room after the 3/4
+    # prompt).
+    steps = min(block * 4,
+                max(block, (max_len - prompt_len) // block * block))
+
+    def clean():
+        eng2.run(steps)
+
+    clean()                                        # block path warm
+    best = float("inf")
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        clean()
+        best = min(best, time.perf_counter() - t0)
+    decode_clean = lanes * steps / best
+    partial["decode_clean_tok_s"] = round(decode_clean, 1)
+    partial["phase"] = "decode-clean-done"
+    checkpoint_partial(partial)
+    log(f"decode (no hand-offs): {decode_clean:.1f} tok/s")
+
+    def disturbed():
+        for i in range(steps // block):
+            eng2.run(block)
+            lane = i % lanes
+            # Retire + hand off into the freed lane: the bench drives
+            # lane turnover directly (completion bookkeeping is the
+            # headline bench's subject; here the subject is the splice
+            # cost landing mid-decode).
+            eng2._active[lane] = False
+            eng2.insert(lane, results[lane % pf_batch])
+        eng2.sync()
+
+    disturbed()                                    # warm the pattern
+    best = float("inf")
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        disturbed()
+        best = min(best, time.perf_counter() - t0)
+    decode_hand = lanes * steps / best
+    partial["value"] = round(decode_hand, 1)
+    partial["phase"] = "decode-handoff-done"
+    checkpoint_partial(partial)
+    disturb = 1.0 - decode_hand / decode_clean
+    log(f"decode with 1 hand-off/block: {decode_hand:.1f} tok/s "
+        f"(disturbance {disturb * 100:.1f}%)")
+
+    return {
+        "metric": f"{model.replace('-', '')}"
+                  "_disagg_decode_with_handoff_tok_s",
+        "value": round(decode_hand, 1),
+        "unit": "tok/s/chip",
+        # Ratio of disturbed to clean decode: the cost of living with
+        # continuous hand-offs, the disagg analog of vs_baseline.
+        "vs_baseline": round(decode_hand / decode_clean, 4),
+        "decode_clean_tok_s": round(decode_clean, 1),
+        "insert_ms_per_seq": round(insert_ms, 3),
+        "kv_slab_mb_per_seq": round(slab_mb, 1),
+        "prefill_tok_s": round(pf_tok_s, 1),
+        "prefill_chunked_tok_s": round(pf_chunked_tok_s, 1),
+        "prefill_chunk": chunk,
+        "lanes": lanes,
+        "prompt_len": prompt_len,
+        "block": block,
+        "quant": quant or "bf16",
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "mode": "disagg",
+    }
+
+
 def append_history(record: dict) -> None:
     """Append the run to bench-history/history.jsonl (the committed perf
     record, mirroring scale-history/): git label + timestamp + knobs, so
@@ -550,6 +714,9 @@ def append_history(record: dict) -> None:
 
 def _metric_name() -> str:
     model = os.environ.get("GROVE_BENCH_MODEL", "llama-1b")
+    if os.environ.get("GROVE_BENCH_MODE") == "disagg":
+        return (f"{model.replace('-', '')}"
+                "_disagg_decode_with_handoff_tok_s")
     return f"{model.replace('-', '')}_decode_tokens_per_sec_per_chip"
 
 
@@ -571,7 +738,10 @@ def child_main() -> None:
     failure-with-partials) on stdout. The supervisor owns retries."""
     partial: dict = {}
     try:
-        result = run_bench(partial)
+        if os.environ.get("GROVE_BENCH_MODE") == "disagg":
+            result = run_bench_disagg(partial)
+        else:
+            result = run_bench(partial)
     except Exception as e:  # noqa: BLE001 — emit a parseable failure line
         import traceback
         traceback.print_exc(file=sys.stderr)

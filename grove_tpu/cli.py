@@ -515,6 +515,12 @@ def cmd_top(args: argparse.Namespace) -> int:
         # heartbeating remote node allocates 0.
         total = n.get("status", {}).get("allocatable_chips", 0)
         u = used.get(name, 0)
+        # A node that drops NotReady (allocatable 0) while its pods are
+        # still live would print negative FREE and skew the slice
+        # rollup; fall back to the spec'd hardware count so the
+        # maintenance view stays readable during node loss.
+        if u > total:
+            total = max(u, n.get("spec", {}).get("tpu_chips", 0))
         sl = n.get("meta", {}).get("labels", {}).get(
             c.NODE_LABEL_SLICE, "")
         state = []
@@ -598,8 +604,12 @@ def cmd_rollout(args: argparse.Namespace) -> int:
             print(f"PodCliqueSet/{args.name}: waiting for the controller "
                   f"to observe generation {meta.get('generation', 0)}")
             return False
+        # Print the REAL updated counter: max(updated, total) would
+        # fabricate "2/2" when updated_replicas is 0 (a PCS that never
+        # rolled) or lags — the observed_generation guard above already
+        # makes the up-to-date verdict itself safe.
         print(f"PodCliqueSet/{args.name}: up to date "
-              f"({max(updated, total)}/{total} replicas)")
+              f"({updated}/{total} replicas updated)")
         return True
 
     while True:

@@ -9,6 +9,7 @@ agents instead of (or alongside) the fake kubelet pool.
 from __future__ import annotations
 
 import dataclasses
+import weakref
 
 from grove_tpu.agent.node import FakeKubeletPool
 from grove_tpu.api.config import OperatorConfiguration
@@ -20,7 +21,20 @@ from grove_tpu.store.store import Store
 from grove_tpu.topology.fleet import FleetSpec, create_fleet
 
 
-@dataclasses.dataclass
+# Live started clusters, weakly held: diagnostics collectors (the e2e
+# on-failure bundle, tests/diagnostics.py — reference
+# e2e/diagnostics/collector.go analog) enumerate these to dump state
+# without the test having to thread its cluster to the hook.
+_LIVE: "weakref.WeakSet[Cluster]" = weakref.WeakSet()
+
+
+def live_clusters() -> "list[Cluster]":
+    return list(_LIVE)
+
+
+# eq=False keeps identity hashing (dataclass __eq__ would drop __hash__,
+# and the live-cluster WeakSet needs hashable entries).
+@dataclasses.dataclass(eq=False)
 class Cluster:
     manager: Manager
     scheduler_registry: Registry
@@ -32,8 +46,10 @@ class Cluster:
 
     def start(self) -> None:
         self.manager.start()
+        _LIVE.add(self)
 
     def stop(self) -> None:
+        _LIVE.discard(self)
         self.manager.stop()
 
     def __enter__(self) -> "Cluster":

@@ -49,13 +49,18 @@ class _DelayQueue:
     duplicated; an item re-added while being processed is re-queued after
     processing (the k8s workqueue 'dirty' semantics)."""
 
-    def __init__(self) -> None:
+    def __init__(self, name: str = "") -> None:
+        self.name = name  # metric label (owning controller)
         self._lock = threading.Condition()
         self._heap: list[tuple[float, int, Request]] = []
         self._seq = itertools.count()
         self._pending: set[Request] = set()
         self._processing: set[Request] = set()
         self._dirty: set[Request] = set()
+        # Earliest READY time per pending request: queue-wait is
+        # measured from readiness (a backoff delay is intentional
+        # latency, not queue congestion) to worker pickup.
+        self._ready: dict[Request, float] = {}
         self._shutdown = False
 
     def add(self, req: Request, delay: float = 0.0) -> None:
@@ -70,13 +75,18 @@ class _DelayQueue:
             # _pending set makes delivery once-only — after the earliest
             # entry pops, stale heap entries are skipped by get().
             self._pending.add(req)
-            heapq.heappush(self._heap, (time.time() + delay, next(self._seq), req))
+            ready = time.time() + delay
+            prev = self._ready.get(req)
+            if prev is None or ready < prev:
+                self._ready[req] = ready
+            heapq.heappush(self._heap, (ready, next(self._seq), req))
             self._lock.notify()
 
     def get(self, timeout: float = 0.2) -> Request | None:
+        req, queued_for = None, 0.0
         with self._lock:
             deadline = time.time() + timeout
-            while True:
+            while req is None:
                 if self._shutdown:
                     return None
                 now = time.time()
@@ -86,13 +96,21 @@ class _DelayQueue:
                     _, _, req = heapq.heappop(self._heap)
                     self._pending.discard(req)
                     self._processing.add(req)
-                    return req
+                    queued_for = max(0.0, now - self._ready.pop(req, now))
+                    break
                 wait = min(
                     self._heap[0][0] - now if self._heap else timeout,
                     deadline - now)
                 if wait <= 0:
                     return None
                 self._lock.wait(wait)
+        # Observed OUTSIDE the queue Condition: the metrics hub has one
+        # global lock, and render() (every /metrics scrape) holds it
+        # while formatting — observing under the Condition would stall
+        # every enqueue on this queue behind each scrape.
+        GLOBAL_METRICS.observe("grove_workqueue_wait_seconds", queued_for,
+                               controller=self.name)
+        return req
 
     def done(self, req: Request) -> None:
         with self._lock:
@@ -100,7 +118,9 @@ class _DelayQueue:
             if req in self._dirty:
                 self._dirty.discard(req)
                 self._pending.add(req)
-                heapq.heappush(self._heap, (time.time(), next(self._seq), req))
+                now = time.time()
+                self._ready[req] = now
+                heapq.heappush(self._heap, (now, next(self._seq), req))
                 self._lock.notify()
 
     def shutdown(self) -> None:
@@ -127,7 +147,7 @@ class Controller:
         self.workers = workers
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
-        self.queue = _DelayQueue()
+        self.queue = _DelayQueue(name)
         self.log = get_logger(f"controller.{name}")
         self._failures: dict[Request, int] = {}
         self._watch_specs: list[tuple[list[str] | None,
@@ -240,7 +260,10 @@ class Controller:
             try:
                 result = self.reconcile(req) or StepResult.finished()
             finally:
-                self.durations.append(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self.durations.append(dt)
+                GLOBAL_METRICS.observe("grove_reconcile_duration_seconds",
+                                       dt, controller=self.name)
         except Exception as e:  # noqa: BLE001 - reconcile panic barrier
             self.error_count += 1
             self.log.warning("reconcile %s panicked: %s", req.key, e,

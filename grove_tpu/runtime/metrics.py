@@ -1,15 +1,38 @@
 """Prometheus-style metrics for the control plane.
 
 Role parity with the reference's controller-runtime metrics server
-(config types.go:202-212): counters/gauges with labels, rendered in the
-Prometheus text exposition format by ``render``. The manager exposes
-``Manager.metrics_text()``; a real deployment serves it over HTTP.
+(config types.go:202-212): counters/gauges/histograms with labels,
+rendered in the Prometheus text exposition format by ``render``. The
+manager exposes ``Manager.metrics_text()``; a real deployment serves it
+over HTTP.
+
+Histograms are fixed-bucket (the controller-runtime reconcile-time /
+workqueue-duration shape): cumulative ``_bucket{le=...}`` samples plus
+``_sum``/``_count``, so a deployed control plane can alert on the same
+p95 the scale harness asserts (``histogram_quantile`` over the exposed
+buckets — see ``parse_histograms`` / ``quantile_from_buckets``).
 """
 
 from __future__ import annotations
 
+import math
+import re
 import threading
 from collections import defaultdict
+
+# Prometheus default duration buckets — what controller-runtime uses
+# for reconcile time; upper bounds in seconds.
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+class _Hist:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int) -> None:
+        self.counts = [0] * (n_buckets + 1)  # +1 for +Inf
+        self.sum = 0.0
+        self.count = 0
 
 
 class MetricsHub:
@@ -17,10 +40,18 @@ class MetricsHub:
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
+        self._hists: dict[tuple[str, tuple], _Hist] = {}
+        self._buckets: dict[str, tuple[float, ...]] = {}
         self._help: dict[str, str] = {}
 
     def describe(self, name: str, help_text: str) -> None:
         self._help[name] = help_text
+
+    def describe_histogram(self, name: str, help_text: str,
+                           buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                           ) -> None:
+        self._help[name] = help_text
+        self._buckets[name] = tuple(sorted(buckets))
 
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -32,12 +63,45 @@ class MetricsHub:
         with self._lock:
             self._gauges[key] = value
 
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one observation into the fixed-bucket histogram
+        ``name`` (buckets from ``describe_histogram``, defaulting to the
+        Prometheus duration buckets)."""
+        key = (name, tuple(sorted(labels.items())))
+        buckets = self._buckets.get(name, DEFAULT_BUCKETS)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist(len(buckets))
+            for i, ub in enumerate(buckets):
+                if value <= ub:
+                    h.counts[i] += 1
+                    break
+            else:
+                h.counts[-1] += 1  # +Inf
+            h.sum += value
+            h.count += 1
+
     @staticmethod
     def _fmt(name: str, labels: tuple, value: float) -> str:
         if labels:
             lbl = ",".join(f'{k}="{v}"' for k, v in labels)
             return f"{name}{{{lbl}}} {value}"
         return f"{name} {value}"
+
+    def _render_hist(self, name: str, labels: tuple, h: _Hist) -> list[str]:
+        buckets = self._buckets.get(name, DEFAULT_BUCKETS)
+        out, cum = [], 0
+        for ub, n in zip(buckets, h.counts):
+            cum += n
+            out.append(self._fmt(f"{name}_bucket",
+                                 labels + (("le", repr(float(ub))),), cum))
+        cum += h.counts[-1]
+        out.append(self._fmt(f"{name}_bucket",
+                             labels + (("le", "+Inf"),), cum))
+        out.append(self._fmt(f"{name}_sum", labels, round(h.sum, 6)))
+        out.append(self._fmt(f"{name}_count", labels, h.count))
+        return out
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -48,11 +112,76 @@ class MetricsHub:
                 by_name[name].append(self._fmt(name, labels, v))
             for (name, labels), v in sorted(self._gauges.items()):
                 by_name[name].append(self._fmt(name, labels, v))
+            hist_names = set()
+            for (name, labels), h in sorted(self._hists.items()):
+                hist_names.add(name)
+                by_name[name].extend(self._render_hist(name, labels, h))
         for name, samples in sorted(by_name.items()):
             if name in self._help:
                 lines.append(f"# HELP {name} {self._help[name]}")
+            if name in hist_names:
+                lines.append(f"# TYPE {name} histogram")
             lines.extend(samples)
         return "\n".join(lines) + "\n"
+
+
+_BUCKET_RE = re.compile(
+    r'^(?P<name>\w+)_bucket\{(?P<labels>[^}]*)\} (?P<value>\S+)$')
+
+
+def parse_histograms(text: str, name: str,
+                     ) -> dict[tuple, dict[float, float]]:
+    """Parse a histogram's cumulative ``_bucket`` samples back out of
+    the rendered exposition text: {labels-without-le: {le: cum_count}}.
+    This is how the scale harness asserts its latency budget — from the
+    same surface a deployed Prometheus would scrape, not from private
+    runner state."""
+    out: dict[tuple, dict[float, float]] = {}
+    for line in text.splitlines():
+        m = _BUCKET_RE.match(line)
+        if not m or m.group("name") != name:
+            continue
+        labels, le = [], math.inf
+        for part in m.group("labels").split(","):
+            k, _, v = part.partition("=")
+            v = v.strip('"')
+            if k == "le":
+                le = math.inf if v == "+Inf" else float(v)
+            else:
+                labels.append((k, v))
+        out.setdefault(tuple(sorted(labels)), {})[le] = float(
+            m.group("value"))
+    return out
+
+
+def quantile_from_buckets(q: float, cum: dict[float, float]) -> float:
+    """Prometheus ``histogram_quantile``: locate the bucket covering
+    quantile ``q`` and interpolate linearly inside it (same estimate a
+    deployed alert computes, so budget assertions here and alerts in
+    production fire on the same number). Observations in the +Inf
+    bucket return the largest finite upper bound, as Prometheus does."""
+    total = cum.get(math.inf, 0.0)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_ub, prev_cum = 0.0, 0.0
+    finite = [ub for ub in sorted(cum) if ub != math.inf]
+    for ub in finite:
+        c = cum[ub]
+        if c >= target:
+            if c == prev_cum:
+                return ub
+            return prev_ub + (ub - prev_ub) * (target - prev_cum) / (
+                c - prev_cum)
+        prev_ub, prev_cum = ub, c
+    return finite[-1] if finite else math.inf
+
+
+def subtract_buckets(after: dict[float, float], before: dict[float, float],
+                     ) -> dict[float, float]:
+    """Windowed view of a cumulative histogram: bucket-wise delta of two
+    snapshots (what ``rate()`` does for a deployed alert)."""
+    return {ub: after[ub] - before.get(ub, 0.0) for ub in after}
 
 
 GLOBAL_METRICS = MetricsHub()
@@ -66,3 +195,11 @@ GLOBAL_METRICS.describe("grove_gang_placements_total",
                         "Gangs placed by the scheduler")
 GLOBAL_METRICS.describe("grove_store_objects",
                         "Objects in the store per kind")
+GLOBAL_METRICS.describe_histogram(
+    "grove_reconcile_duration_seconds",
+    "Reconcile wall time per controller (controller-runtime "
+    "controller_runtime_reconcile_time_seconds analog)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_workqueue_wait_seconds",
+    "Time a request spends queued past its ready time before a worker "
+    "picks it up (workqueue_queue_duration_seconds analog)")

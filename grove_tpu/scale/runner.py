@@ -153,7 +153,8 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         # nothing; r2's dashboard proved it, every row 0.0). Touch N
         # cliques, then measure how many reconciles the ripple costs and
         # what each one takes (p50/p95 from the controllers' duration
-        # rings).
+        # rings, with the budget asserted from the exposed
+        # reconcile-duration histogram).
         profiler.begin_phase("steady-state")
         cluster.manager.wait_idle(timeout=30.0, settle=0.3)
         before = {name: v["reconciles"] for name, v in
@@ -163,6 +164,15 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         keys_before = pclq_ctrl.snapshot_key_counts()
         for ctrl in cluster.manager.controllers:
             ctrl.durations.clear()
+        # Snapshot the EXPOSED reconcile-duration histogram at window
+        # start: the p95 budget below is asserted from the metrics
+        # endpoint (bucket delta over the window — what a deployed
+        # `histogram_quantile(rate(...))` alert computes), so the test
+        # guards the same surface operators alert on.
+        from grove_tpu.runtime import metrics as _m
+        hist_before = _m.parse_histograms(
+            cluster.manager.metrics_text(),
+            "grove_reconcile_duration_seconds")
         tracker.record("steady-state", "window-start")
         t_win = time.time()
         # Round-robin the touches over the cliques: a naive list PREFIX
@@ -202,6 +212,23 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
                 return 0.0
             return durations[min(len(durations) - 1,
                                  int(p * len(durations)))]
+
+        # Windowed histogram from the exposed metric: sum the bucket
+        # deltas across controllers, then take the quantile. The budget
+        # is asserted against THIS (bucket upper edge — conservative);
+        # the ring-based _pct stays as the exact-value companion the
+        # dashboard reports.
+        hist_after = _m.parse_histograms(
+            cluster.manager.metrics_text(),
+            "grove_reconcile_duration_seconds")
+        window_cum: dict[float, float] = {}
+        for lbls, after_b in hist_after.items():
+            delta = _m.subtract_buckets(after_b, hist_before.get(lbls, {}))
+            for ub, n in delta.items():
+                window_cum[ub] = window_cum.get(ub, 0.0) + n
+
+        def _pct_metric(p: float) -> float:
+            return _m.quantile_from_buckets(p, window_cum)
 
         # Budget: the stimulus must actually produce reconciles (≥ one
         # per touch), and a no-op-ish reconcile at scale must stay
@@ -248,9 +275,15 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
             f"stimulus produced {steady_reconciles} reconciles for "
             f"{touched} touches over {len(touched_cliques)} cliques")
         assert durations, "no reconcile durations captured in the window"
-        assert _pct(0.95) < budget, (
-            f"steady-state reconcile p95 {_pct(0.95) * 1e3:.1f}ms over "
-            f"budget {budget * 1e3:.0f}ms")
+        assert window_cum.get(float("inf"), 0) > 0, (
+            "exposed reconcile-duration histogram recorded nothing in "
+            "the steady window — the metric a deployed alert would "
+            "watch is not being fed")
+        assert _pct_metric(0.95) < budget, (
+            f"steady-state reconcile p95 bucket "
+            f"{_pct_metric(0.95) * 1e3:.1f}ms (exposed histogram) over "
+            f"budget {budget * 1e3:.0f}ms; exact-ring p95 "
+            f"{_pct(0.95) * 1e3:.1f}ms")
 
         # Soak: scale-out/in cycles with full convergence each way
         # (reference e2e/tests/scale/soak_test.go; here optionally over
@@ -314,6 +347,9 @@ def run_scale_test(cfg: ScaleConfig) -> dict:
         "steady_reconciles_per_s": steady_reconciles / steady_window_s,
         "steady_p50_ms": round(_pct(0.50) * 1e3, 3),
         "steady_p95_ms": round(_pct(0.95) * 1e3, 3),
+        # Same window, computed from the exposed histogram (what a
+        # deployed histogram_quantile alert would report).
+        "steady_p95_metric_ms": round(_pct_metric(0.95) * 1e3, 3),
         "delete_request_s": delete_request_s,
         "delete_cascade_s": tracker.duration(
             "delete", "request-returned", "children-gone"),

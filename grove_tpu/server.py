@@ -359,6 +359,24 @@ class ApiServer:
                                     if k.startswith("l.")}
                         fields = {k[2:]: v[0] for k, v in q.items()
                                   if k.startswith("f.")}
+                        # Unknown status-field names fail LOUDLY (400,
+                        # kube's "field selector not supported" analog):
+                        # matches_fields treats a missing attr as '', so
+                        # a typo'd key would otherwise silently match
+                        # nothing and an agent would quietly stop seeing
+                        # all its pods.
+                        import dataclasses as _dc
+                        st = getattr(cls(), "status", None) \
+                            if fields else None
+                        known = ({f.name for f in _dc.fields(type(st))}
+                                 if _dc.is_dataclass(st) else set())
+                        bad = sorted(set(fields) - known)
+                        if bad:
+                            self._send(400, {"error":
+                                f"unsupported status field selector(s) "
+                                f"{', '.join(bad)} for {cls.KIND}; "
+                                f"known: {', '.join(sorted(known))}"})
+                            return
                         objs = cluster.client.list(
                             cls, None if ns == "*" else ns,
                             selector or None, fields=fields or None)

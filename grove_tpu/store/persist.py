@@ -149,10 +149,49 @@ def _maybe_fence_wedged_holder(state_dir: str, lock_fd: int) -> None:
     # an unrelated (possibly recycled) pid.
     if holder_pid != pid or pid <= 1 or pid == os.getpid():
         return
+    # TOCTOU guard: between the stamp check above and the signal, the
+    # holder can exit and the OS can recycle the pid onto an unrelated
+    # process. pidfd_open pins THIS incarnation of the pid; the flock
+    # probe afterwards proves the pinned process is still the holder
+    # (a holder that exited releases the flock — then there is nothing
+    # to kill), and the stamp re-read catches a new holder that
+    # acquired in between. Only then is the signal sent — to the
+    # pidfd, which cannot retarget a recycled pid.
+    import fcntl
+    pidfd = -1
+    if hasattr(os, "pidfd_open"):
+        try:
+            pidfd = os.pidfd_open(pid)
+        except ProcessLookupError:
+            return                            # gone already: flock will free
+        except OSError:
+            pidfd = -1    # fd pressure/EPERM etc.: the fence must still
+            #               happen — fall back to the narrowed os.kill
     try:
-        os.kill(pid, signal.SIGKILL)          # works on stopped processes
-    except (ProcessLookupError, PermissionError):
-        pass                                  # gone already / not ours
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            pass                              # still held: proceed to verify
+        else:
+            return      # holder exited; caller's loop now owns the lock
+        try:
+            os.lseek(lock_fd, 0, os.SEEK_SET)
+            holder2 = os.read(lock_fd, 256).decode(errors="replace")
+            pid2 = int(holder2.strip().split("pid=")[1].split()[0])
+        except (OSError, IndexError, ValueError):
+            return
+        if pid2 != pid:
+            return                            # a new holder took over
+        try:
+            if pidfd >= 0:
+                signal.pidfd_send_signal(pidfd, signal.SIGKILL)
+            else:       # non-pidfd platforms keep the narrowed os.kill
+                os.kill(pid, signal.SIGKILL)  # works on stopped processes
+        except (ProcessLookupError, PermissionError, OSError):
+            pass                              # gone already / not ours
+    finally:
+        if pidfd >= 0:
+            os.close(pidfd)
 
 
 def _acquire_state_lock(state_dir: str, wait: bool) -> None:

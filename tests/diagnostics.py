@@ -1,0 +1,159 @@
+"""On-failure diagnostics bundle for the e2e tiers.
+
+Reference analog: ``operator/e2e/diagnostics/collector.go`` — on test
+failure the reference dumps operator logs, every Grove resource, pod
+details, and recent events so a flaky e2e run leaves enough evidence to
+diagnose without a re-run. Here, when a test in any ``test_e2e_*``
+module fails, the ``pytest_runtest_makereport`` hook dumps every LIVE
+in-process cluster (``grove_tpu.cluster.live_clusters()``) to an
+artifact directory:
+
+  objects/<Kind>.json   every stored object of every registered kind
+  events.txt            human-readable event timeline (sorted)
+  healthz.json          manager health incl. per-controller counters
+  metrics.txt           Prometheus exposition (incl. histograms)
+  pod-logs/             tail of each in-pod runtime log file found
+  manifest.json         collection summary (counts, timestamp, test)
+
+The hook wires into every e2e module automatically via conftest —
+module-name based, no per-module opt-in. Env knobs mirror the
+reference's: ``GROVE_E2E_DIAG_DIR`` (default ``./test-diagnostics``)
+and ``GROVE_E2E_DIAG_MODE`` = ``file`` (default) | ``stdout`` |
+``both``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+DIR_ENV = "GROVE_E2E_DIAG_DIR"
+MODE_ENV = "GROVE_E2E_DIAG_MODE"
+LOG_TAIL_BYTES = 64 * 1024  # per log file, like the reference's buffer
+
+
+def _tail(path: str, n: int = LOG_TAIL_BYTES) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - n))
+        return f.read()
+
+
+def collect_cluster(cluster, outdir: str, test_name: str = "") -> dict:
+    """Dump one live cluster's full observable state to ``outdir``.
+    Returns per-kind object counts. Each section is best-effort: a
+    failing section records its error and the rest still collect."""
+    from grove_tpu.api.serde import to_dict
+    from grove_tpu.manifest import KIND_REGISTRY
+
+    objdir = os.path.join(outdir, "objects")
+    os.makedirs(objdir, exist_ok=True)
+    counts: dict[str, int] = {}
+    errors: dict[str, str] = {}
+    events = []
+    for kind, cls in sorted(KIND_REGISTRY.items()):
+        try:
+            objs = cluster.client.list(cls, namespace=None)
+        except Exception as e:  # noqa: BLE001 — keep collecting
+            errors[kind] = f"{type(e).__name__}: {e}"
+            continue
+        counts[kind] = len(objs)
+        if kind == "Event":
+            events = objs
+        with open(os.path.join(objdir, f"{kind}.json"), "w") as f:
+            json.dump([{"kind": kind, **to_dict(o)} for o in objs],
+                      f, indent=2, default=str)
+
+    # Event timeline, newest last — the first thing a human reads.
+    try:
+        with open(os.path.join(outdir, "events.txt"), "w") as f:
+            for ev in sorted(events, key=lambda e: e.last_seen):
+                f.write(f"{time.strftime('%H:%M:%S', time.localtime(ev.last_seen))}"
+                        f" {ev.type:7s} {ev.involved_kind}/{ev.involved_name}"
+                        f" {ev.reason}: {ev.message}"
+                        + (f" (x{ev.count})" if ev.count > 1 else "")
+                        + "\n")
+    except Exception as e:  # noqa: BLE001
+        errors["events.txt"] = f"{type(e).__name__}: {e}"
+
+    for name, produce in (("healthz.json",
+                           lambda: json.dumps(cluster.manager.healthz(),
+                                              indent=2, default=str)),
+                          ("metrics.txt",
+                           cluster.manager.metrics_text)):
+        try:
+            with open(os.path.join(outdir, name), "w") as f:
+                f.write(produce())
+        except Exception as e:  # noqa: BLE001
+            errors[name] = f"{type(e).__name__}: {e}"
+
+    # In-pod runtime logs (agent/process.py writes <workdir>/pod-logs/):
+    # tail whatever the test's working directory accumulated.
+    logs_src = os.path.join(os.getcwd(), "pod-logs")
+    n_logs = 0
+    if os.path.isdir(logs_src):
+        logs_dst = os.path.join(outdir, "pod-logs")
+        os.makedirs(logs_dst, exist_ok=True)
+        for fn in sorted(os.listdir(logs_src)):
+            src = os.path.join(logs_src, fn)
+            if not os.path.isfile(src):
+                continue
+            try:
+                with open(os.path.join(logs_dst, fn), "wb") as f:
+                    f.write(_tail(src))
+                n_logs += 1
+            except OSError as e:
+                errors[f"pod-logs/{fn}"] = str(e)
+
+    with open(os.path.join(outdir, "manifest.json"), "w") as f:
+        json.dump({"test": test_name,
+                   "collected_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                   "object_counts": counts,
+                   "pod_log_files": n_logs,
+                   "errors": errors}, f, indent=2)
+    return counts
+
+
+def _safe(nodeid: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.\-]+", "_", nodeid)[-120:]
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    if not os.path.basename(str(item.fspath)).startswith("test_e2e"):
+        return
+    try:
+        from grove_tpu.cluster import live_clusters
+        live = live_clusters()
+    except Exception:  # noqa: BLE001 — diagnostics must never mask
+        return
+    if not live:
+        return
+    base = os.environ.get(DIR_ENV,
+                          os.path.join(os.getcwd(), "test-diagnostics"))
+    mode = os.environ.get(MODE_ENV, "file")
+    for i, cl in enumerate(live):
+        outdir = os.path.join(base, _safe(item.nodeid))
+        if len(live) > 1:
+            outdir = os.path.join(outdir, f"cluster-{i}")
+        try:
+            counts = collect_cluster(cl, outdir, test_name=item.nodeid)
+        except Exception as e:  # noqa: BLE001
+            rep.sections.append(("grove e2e diagnostics",
+                                 f"collection failed: {e}"))
+            continue
+        summary = (f"cluster state dumped to {outdir} — "
+                   + ", ".join(f"{k}={v}" for k, v in sorted(counts.items())
+                               if v))
+        rep.sections.append(("grove e2e diagnostics", summary))
+        if mode in ("stdout", "both"):
+            print(f"\n[grove-e2e-diagnostics] {summary}")

@@ -1,0 +1,92 @@
+"""On-failure e2e diagnostics bundle (diagnostics.py; reference
+``operator/e2e/diagnostics/collector.go`` analog): the collector dumps
+a live cluster's full state, and the pytest hook fires it automatically
+for any failing test in a ``test_e2e_*`` module."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from grove_tpu.cluster import live_clusters, new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from diagnostics import collect_cluster
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_collector_dumps_full_state(tmp_path):
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=1)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        assert cl in live_clusters()  # registry feeds the failure hook
+        out = str(tmp_path / "diag")
+        counts = collect_cluster(cl, out, test_name="demo")
+    assert cl not in live_clusters()  # stop() deregisters
+
+    assert counts["Node"] == 4  # 4x4 v5e slice = 4 hosts
+    nodes = json.loads((tmp_path / "diag/objects/Node.json").read_text())
+    assert len(nodes) == 4 and nodes[0]["kind"] == "Node"
+    # Every registered kind gets a file (empty kinds dump []).
+    from grove_tpu.manifest import KIND_REGISTRY
+    for kind in KIND_REGISTRY:
+        assert (tmp_path / f"diag/objects/{kind}.json").exists(), kind
+    assert (tmp_path / "diag/events.txt").exists()
+    health = json.loads((tmp_path / "diag/healthz.json").read_text())
+    assert "controllers" in health
+    metrics = (tmp_path / "diag/metrics.txt").read_text()
+    assert "grove_store_objects" in metrics
+    manifest = json.loads((tmp_path / "diag/manifest.json").read_text())
+    assert manifest["test"] == "demo"
+    assert manifest["errors"] == {}
+    assert manifest["object_counts"]["Node"] == 4
+
+
+def test_forced_e2e_failure_produces_bundle(tmp_path):
+    """Forced-failure demo: a failing test in a test_e2e_* module run
+    under the diagnostics plugin leaves the artifact bundle and the
+    failure report names it — the wiring every real e2e tier gets via
+    conftest."""
+    demo = tmp_path / "test_e2e_diag_demo.py"
+    # The cluster lives in a FIXTURE (the real e2e tiers' shape): the
+    # call-phase report hook runs before fixture teardown, so the
+    # collector sees the still-live cluster.
+    demo.write_text(textwrap.dedent("""\
+        import pytest
+        from grove_tpu.cluster import new_cluster
+        from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+        @pytest.fixture
+        def cluster():
+            fleet = FleetSpec(slices=[SliceSpec(
+                generation="v5e", topology="2x2", count=1)])
+            with new_cluster(fleet=fleet) as cl:
+                yield cl
+
+        def test_forced_failure(cluster):
+            assert False, "forced failure for the diagnostics demo"
+    """))
+    diag_dir = tmp_path / "artifacts"
+    env = dict(os.environ,
+               PYTHONPATH=os.pathsep.join([REPO, HERE]),
+               JAX_PLATFORMS="cpu",
+               GROVE_E2E_DIAG_DIR=str(diag_dir),
+               GROVE_E2E_DIAG_MODE="both")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", str(demo), "-q",
+         "-p", "diagnostics", "-p", "no:cacheprovider"],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "grove e2e diagnostics" in proc.stdout  # report section
+    assert "[grove-e2e-diagnostics]" in proc.stdout  # stdout mode
+    bundles = list(diag_dir.iterdir())
+    assert len(bundles) == 1 and "test_forced_failure" in bundles[0].name
+    nodes = json.loads(
+        (bundles[0] / "objects/Node.json").read_text())
+    assert len(nodes) == 1  # 2x2 slice = 1 host
+    assert (bundles[0] / "manifest.json").exists()

@@ -14,13 +14,25 @@ pytestmark = pytest.mark.skipif(
     not native_available(), reason="no C++ toolchain for native build")
 
 
-def python_plan(*args, **kwargs):
-    """Run the pure-Python path regardless of the native dispatch."""
+def _forced_python(fn, *args, **kwargs):
+    """Run ``fn`` with the native dispatch disabled, restoring whatever
+    GROVE_NATIVE_PLACEMENT value the environment had before (an
+    unconditional pop would leak the override removal into later tests
+    in the same process)."""
+    prev = os.environ.get("GROVE_NATIVE_PLACEMENT")
     os.environ["GROVE_NATIVE_PLACEMENT"] = "0"
     try:
-        return placement.plan_gang(*args, **kwargs)
+        return fn(*args, **kwargs)
     finally:
-        os.environ.pop("GROVE_NATIVE_PLACEMENT")
+        if prev is None:
+            os.environ.pop("GROVE_NATIVE_PLACEMENT", None)
+        else:
+            os.environ["GROVE_NATIVE_PLACEMENT"] = prev
+
+
+def python_plan(*args, **kwargs):
+    """Run the pure-Python path regardless of the native dispatch."""
+    return _forced_python(placement.plan_gang, *args, **kwargs)
 
 
 def random_case(rng):
@@ -73,11 +85,7 @@ def test_native_respects_selectors_and_capacity():
 
 
 def python_plan_grouped(*args, **kwargs):
-    os.environ["GROVE_NATIVE_PLACEMENT"] = "0"
-    try:
-        return placement.plan_gang_grouped(*args, **kwargs)
-    finally:
-        os.environ.pop("GROVE_NATIVE_PLACEMENT")
+    return _forced_python(placement.plan_gang_grouped, *args, **kwargs)
 
 
 def random_grouped_case(rng):

@@ -36,6 +36,53 @@ def test_gang_placed_event_and_metrics(cluster):
     assert "grove_gang_placements_total" in text
     assert 'grove_store_objects{kind="Pod"} 3' in text
 
+    # Reconcile latency + queue wait are exposed as real Prometheus
+    # histograms (controller-runtime reconcile-time / workqueue-duration
+    # analog): cumulative _bucket series a deployed alert can
+    # histogram_quantile over — not just post-processed runner state.
+    from grove_tpu.runtime import metrics as m
+    assert "# TYPE grove_reconcile_duration_seconds histogram" in text
+    hist = m.parse_histograms(text, "grove_reconcile_duration_seconds")
+    pcs_buckets = hist[(("controller", "podcliqueset"),)]
+    assert pcs_buckets[float("inf")] >= 1  # at least one observation
+    p95 = m.quantile_from_buckets(0.95, pcs_buckets)
+    assert 0 < p95 <= 10.0
+    waits = m.parse_histograms(text, "grove_workqueue_wait_seconds")
+    assert any(b[float("inf")] >= 1 for b in waits.values())
+    assert "grove_reconcile_duration_seconds_sum" in text
+    assert "grove_reconcile_duration_seconds_count" in text
+
+
+def test_histogram_render_parse_quantile_roundtrip():
+    """MetricsHub histograms render in the exposition format and parse
+    back to the same quantiles Prometheus would compute (linear
+    interpolation inside the covering bucket; +Inf observations clamp
+    to the largest finite bound)."""
+    from grove_tpu.runtime.metrics import (MetricsHub, parse_histograms,
+                                           quantile_from_buckets,
+                                           subtract_buckets)
+    hub = MetricsHub()
+    hub.describe_histogram("x_seconds", "test hist", buckets=(0.1, 1.0))
+    for v in [0.05] * 5 + [0.5] * 4 + [5.0]:
+        hub.observe("x_seconds", v, controller="c")
+    text = hub.render()
+    assert "# TYPE x_seconds histogram" in text
+    assert 'x_seconds_bucket{controller="c",le="+Inf"} 10' in text
+    cum = parse_histograms(text, "x_seconds")[(("controller", "c"),)]
+    assert cum == {0.1: 5, 1.0: 9, float("inf"): 10}
+    # p50: target 5 lands exactly on bucket 0.1's cumulative count —
+    # interpolates to the bucket's upper edge.
+    assert abs(quantile_from_buckets(0.5, cum) - 0.1) < 1e-9
+    # p95: target 9.5 is past the last finite bucket → clamps to 1.0.
+    assert quantile_from_buckets(0.95, cum) == 1.0
+    # Windowed delta: a snapshot pair isolates new observations.
+    before = dict(cum)
+    hub.observe("x_seconds", 0.05, controller="c")
+    after = parse_histograms(hub.render(),
+                             "x_seconds")[(("controller", "c"),)]
+    delta = subtract_buckets(after, before)
+    assert delta == {0.1: 1, 1.0: 1, float("inf"): 1}
+
 
 def test_unschedulable_event(cluster):
     client = cluster.client

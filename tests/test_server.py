@@ -289,6 +289,16 @@ def test_field_selector_filters_server_side(server):
     # enum field matches by wire value
     s, running = _req(f"{base}/api/Pod?f.phase=Running")
     assert len(running) == 2
+    # Unknown/typo'd field names fail loudly (kube's "field selector
+    # not supported" analog) — matches_fields compares a missing attr
+    # as '', so silently returning [] would make an agent with a
+    # misspelled selector quietly stop seeing all its pods.
+    s, err = _req(f"{base}/api/Pod?f.nodename={node0}")
+    assert s == 400
+    assert "nodename" in err["error"] and "node_name" in err["error"]
+    # Kinds without a status reject any field selector the same way.
+    s, err = _req(f"{base}/api/Service?f.phase=Running")
+    assert s == 400
 
 
 def test_apply_dry_run_admits_without_committing(server, tmp_path, capsys):
@@ -365,3 +375,20 @@ def test_grovectl_top_nodes(server, capsys):
     assert "SLICE" in out
     rollup = [ln for ln in out.splitlines() if ln.startswith("pool-0-slice")]
     assert any(ln.split()[-3:] == ["16", "8", "8"] for ln in rollup), out
+
+    # A node that goes NotReady (allocatable 0) while its pods are still
+    # live must not print negative FREE or skew the slice rollup — the
+    # maintenance view falls back to the spec'd hardware count.
+    from grove_tpu.api import Node
+    victim = next(p.status.node_name
+                  for p in cl.client.list(Pod, selector=sel))
+    node = cl.client.get(Node, victim)
+    node.status.ready = False
+    node.status.allocatable_chips = 0
+    cl.client.update_status(node)
+    assert main(["top", "nodes", "--server", base]) == 0
+    out = capsys.readouterr().out
+    victim_row = next(ln for ln in out.splitlines()
+                      if ln.startswith(victim))
+    assert "NotReady" in victim_row
+    assert not any(f.startswith("-") for f in victim_row.split()), out

@@ -34,8 +34,10 @@ def load_rows(paths: list[str]) -> list[dict]:
 
 def render(rows: list[dict]) -> str:
     out = ["# Bench history", ""]
-    ok = [r for r in rows if r.get("value", 0) > 0]
+    ok_all = [r for r in rows if r.get("value", 0) > 0]
     failed = [r for r in rows if r.get("value", 0) <= 0]
+    disagg = [r for r in ok_all if r.get("mode") == "disagg"]
+    ok = [r for r in ok_all if r.get("mode") != "disagg"]
     if ok:
         out += ["## Successful runs", "",
                 "| when | git | model | batch | quant | tok/s/chip | "
@@ -56,6 +58,25 @@ def render(rows: list[dict]) -> str:
         out += ["_no successful runs recorded yet — see the failure "
                 "timeline (dev-run evidence lives in bench-stderr.log)_",
                 ""]
+    if disagg:
+        out += ["## Disaggregated hand-off seam "
+                "(PrefillWorker → DecodeEngine.insert)", "",
+                "| when | git | model | lanes | quant | tok/s w/ "
+                "hand-offs | vs clean decode | insert ms/seq | "
+                "slab MB/seq | prefill tok/s | chunked tok/s |",
+                "|---|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(disagg, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('metric', '?').split('_')[0]} "
+                f"| {r.get('lanes', '?')} | {r.get('quant', '?')} "
+                f"| {r.get('value', 0):.1f} "
+                f"| {r.get('vs_baseline', 0):.3f} "
+                f"| {r.get('insert_ms_per_seq', 0):.2f} "
+                f"| {r.get('kv_slab_mb_per_seq', 0):.1f} "
+                f"| {r.get('prefill_tok_s', 0):.0f} "
+                f"| {r.get('prefill_chunked_tok_s', 0):.0f} |")
+        out.append("")
     if failed:
         out += ["## Failure timeline (relay outages)", "",
                 "| when | git | error |", "|---|---|---|"]

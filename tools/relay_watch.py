@@ -92,12 +92,15 @@ def main() -> None:
             if once:
                 sys.exit(0)
             # The window is open NOW: headline bench first (the single
-            # most important artifact), then the sweep matrix. Each
-            # bench invocation appends its own history row.
+            # most important artifact), then the sweep matrix, then the
+            # disagg hand-off seam. Each bench invocation appends its
+            # own history row.
             rc = run(["make", "bench"], BENCH_TIMEOUT_S)
             rc2 = run(["make", "bench-sweep"], SWEEP_TIMEOUT_S)
-            log(f"window harvested (bench rc={rc}, sweep rc={rc2}); "
-                "exiting — commit bench-history/ and run follow-ups")
+            rc3 = run(["make", "bench-disagg"], 950)
+            log(f"window harvested (bench rc={rc}, sweep rc={rc2}, "
+                f"disagg rc={rc3}); exiting — commit bench-history/ "
+                "and refresh perf.md")
             sys.exit(0 if rc == 0 else 2)
         if once:
             sys.exit(1)
