@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench docs native lint clean ci render-deploy
+.PHONY: test test-fast scale soak bench bench-sched docs native lint clean ci render-deploy
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -35,6 +35,12 @@ bench-sweep:     ## batch x quant evidence matrix -> bench-history/ (real TPU)
 	GROVE_BENCH_BATCH=16 GROVE_BENCH_QUANT=int8 $(PY) bench.py
 	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=int8 $(PY) bench.py
 	GROVE_BENCH_BATCH=32 GROVE_BENCH_QUANT=bf16 $(PY) bench.py
+
+bench-sched:     ## PodGang schedule p50/p99, 1->256-chip fleets (CPU only)
+	@# The BASELINE's second metric, measured without the TPU relay:
+	@# synthetic fake fleets through the real GangBackend pass.
+	@# Appends rows to bench-history/history.jsonl.
+	$(PY) tools/bench_sched.py --compare
 
 bench-disagg:    ## PrefillWorker->DecodeEngine KV hand-off seam (real TPU)
 	@# More compiles than the headline bench (one-shot + chunked

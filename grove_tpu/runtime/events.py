@@ -39,13 +39,18 @@ class EventRecorder:
         self.min_interval = min_interval
 
     def event(self, obj, etype: str, reason: str, message: str,
-              key: str = "") -> None:
+              key: str = "") -> int:
         """Record (or bump) an event for ``obj``. Never raises.
 
         ``key`` disambiguates parallel subjects under one reason (e.g.
         per-replica gang terminations) so their histories don't overwrite
         each other. Rate limiting applies regardless of message content —
         varying messages must not bypass write-storm suppression.
+
+        Returns the number of store writes performed (0 when suppressed
+        or failed, 1 otherwise) — callers that track their own
+        resource-version footprint (the placement snapshot) need an
+        exact count of the rv bumps they caused.
         """
         name = f"{obj.meta.name}.{reason.lower()}"
         if key:
@@ -56,7 +61,7 @@ class EventRecorder:
             try:
                 cur = self.client.get(Event, name, ns)
                 if now - cur.last_seen < self.min_interval:
-                    return
+                    return 0
                 cur.count += 1
                 cur.last_seen = now
                 cur.message = message
@@ -69,8 +74,9 @@ class EventRecorder:
                     type=etype, reason=reason, message=message,
                     first_seen=now, last_seen=now)
                 self.client.create(ev)
+            return 1
         except (ConflictError, GroveError):
-            pass  # events are best-effort
+            return 0  # events are best-effort
 
 
 def events_for(client, kind: str, name: str,

@@ -27,10 +27,15 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 
 
 class _Hist:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("buckets", "counts", "sum", "count")
 
-    def __init__(self, n_buckets: int) -> None:
-        self.counts = [0] * (n_buckets + 1)  # +1 for +Inf
+    def __init__(self, buckets: tuple[float, ...]) -> None:
+        # The bucket tuple is pinned at creation and rendering reads it
+        # from here — re-describing a histogram with different buckets
+        # after observations exist cannot silently misattribute counts
+        # (describe_histogram raises instead).
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1 for +Inf
         self.sum = 0.0
         self.count = 0
 
@@ -50,8 +55,16 @@ class MetricsHub:
     def describe_histogram(self, name: str, help_text: str,
                            buckets: tuple[float, ...] = DEFAULT_BUCKETS,
                            ) -> None:
-        self._help[name] = help_text
-        self._buckets[name] = tuple(sorted(buckets))
+        b = tuple(sorted(buckets))
+        with self._lock:
+            for (hname, _), h in self._hists.items():
+                if hname == name and h.buckets != b:
+                    raise ValueError(
+                        f"histogram {name!r} already has observations "
+                        f"with {len(h.buckets)} buckets; re-describing "
+                        f"with {len(b)} would misattribute counts")
+            self._help[name] = help_text
+            self._buckets[name] = b
 
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -68,11 +81,12 @@ class MetricsHub:
         ``name`` (buckets from ``describe_histogram``, defaulting to the
         Prometheus duration buckets)."""
         key = (name, tuple(sorted(labels.items())))
-        buckets = self._buckets.get(name, DEFAULT_BUCKETS)
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = self._hists[key] = _Hist(len(buckets))
+                h = self._hists[key] = _Hist(
+                    self._buckets.get(name, DEFAULT_BUCKETS))
+            buckets = h.buckets  # pinned at creation
             for i, ub in enumerate(buckets):
                 if value <= ub:
                     h.counts[i] += 1
@@ -83,14 +97,22 @@ class MetricsHub:
             h.count += 1
 
     @staticmethod
+    def _escape_label(value) -> str:
+        """Prometheus text-format label value escaping: backslash,
+        double quote, and newline must be escaped inside the quotes."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
     def _fmt(name: str, labels: tuple, value: float) -> str:
         if labels:
-            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lbl = ",".join(f'{k}="{MetricsHub._escape_label(v)}"'
+                           for k, v in labels)
             return f"{name}{{{lbl}}} {value}"
         return f"{name} {value}"
 
     def _render_hist(self, name: str, labels: tuple, h: _Hist) -> list[str]:
-        buckets = self._buckets.get(name, DEFAULT_BUCKETS)
+        buckets = h.buckets  # pinned at creation, not the current registry
         out, cum = [], 0
         for ub, n in zip(buckets, h.counts):
             cum += n
@@ -126,7 +148,17 @@ class MetricsHub:
 
 
 _BUCKET_RE = re.compile(
-    r'^(?P<name>\w+)_bucket\{(?P<labels>[^}]*)\} (?P<value>\S+)$')
+    r'^(?P<name>\w+)_bucket\{(?P<labels>.*)\} (?P<value>\S+)$')
+# One label pair: quoted value, honoring \\ \" \n escapes (a comma or
+# brace INSIDE the quotes must not split the pair — naive ','.split
+# mis-parsed exactly the values render now escapes).
+_LABEL_PAIR_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(value: str) -> str:
+    return re.sub(r"\\(.)",
+                  lambda m: {"n": "\n"}.get(m.group(1), m.group(1)),
+                  value)
 
 
 def parse_histograms(text: str, name: str,
@@ -142,9 +174,8 @@ def parse_histograms(text: str, name: str,
         if not m or m.group("name") != name:
             continue
         labels, le = [], math.inf
-        for part in m.group("labels").split(","):
-            k, _, v = part.partition("=")
-            v = v.strip('"')
+        for k, v in _LABEL_PAIR_RE.findall(m.group("labels")):
+            v = _unescape_label(v)
             if k == "le":
                 le = math.inf if v == "+Inf" else float(v)
             else:
@@ -203,3 +234,11 @@ GLOBAL_METRICS.describe_histogram(
     "grove_workqueue_wait_seconds",
     "Time a request spends queued past its ready time before a worker "
     "picks it up (workqueue_queue_duration_seconds analog)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_sched_place_pass_seconds",
+    "Wall time of one scheduler placement pass per backend (the "
+    "PodGang-schedule-latency surface the BASELINE metric reads)")
+GLOBAL_METRICS.describe(
+    "grove_sched_snapshot_rebuilds_total",
+    "Placement-snapshot full rebuilds forced by outside writers "
+    "mid-pass (incremental accounting covered every other bind)")

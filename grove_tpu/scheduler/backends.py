@@ -15,6 +15,7 @@ re-based on TPU-native placement:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import defaultdict
@@ -24,9 +25,11 @@ from grove_tpu.api import Node, Pod, PodGang, constants as c, namegen
 from grove_tpu.api.meta import Condition, is_condition_true, set_condition
 from grove_tpu.api.podcliqueset import PodCliqueSet
 from grove_tpu.api.podgang import PodGangPhase
+from grove_tpu.api.serde import clone
 from grove_tpu.runtime.errors import ConflictError, NotFoundError
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.scheduler.placement import (
+    DomainIndex,
     GroupRequest,
     HostView,
     PodRequest,
@@ -43,18 +46,16 @@ DEFAULT_LEVEL_LABELS: dict[str, str] = {
     lvl.domain: lvl.node_label for lvl in DEFAULT_TPU_LEVELS}
 
 
-def build_host_views(client: Client, namespace: str | None = None,
-                     level_labels: dict[str, str] | None = None
-                     ) -> list[HostView]:
-    """Snapshot free capacity per ready TPU host, resolving topology
-    domains from node labels via the (possibly CT-synced) level map."""
-    level_labels = level_labels or DEFAULT_LEVEL_LABELS
+def _host_views_from(pods: list[Pod], nodes: list[Node],
+                     level_labels: dict[str, str]) -> list[HostView]:
+    """HostViews from already-listed pods+nodes (shared by the snapshot
+    and the plain build_host_views read)."""
     used: dict[str, int] = defaultdict(int)
-    for pod in client.list(Pod, namespace):
+    for pod in pods:
         if pod.status.node_name and pod.status.phase.value in ("Pending", "Running"):
             used[pod.status.node_name] += pod.spec.tpu_chips
     views = []
-    for node in client.list(Node, namespace):
+    for node in nodes:
         if not node.status.ready or node.spec.unschedulable:
             continue
         labels = node.meta.labels
@@ -67,6 +68,166 @@ def build_host_views(client: Client, namespace: str | None = None,
             total_chips=node.status.allocatable_chips,
         ))
     return views
+
+
+def build_host_views(client: Client, namespace: str | None = None,
+                     level_labels: dict[str, str] | None = None
+                     ) -> list[HostView]:
+    """Snapshot free capacity per ready TPU host, resolving topology
+    domains from node labels via the (possibly CT-synced) level map."""
+    level_labels = level_labels or DEFAULT_LEVEL_LABELS
+    return _host_views_from(client.list(Pod, namespace),
+                            client.list(Node, namespace), level_labels)
+
+
+def _incremental_enabled() -> bool:
+    return os.environ.get("GROVE_SCHED_INCREMENTAL", "1") != "0"
+
+
+class PlacementSnapshot:
+    """One placement pass's world view — built once, mutated in place.
+
+    Replaces the naive pass shape (full ``list(Pod)`` + ``list(Node)``
+    rebuilt after every placed gang, plus a per-gang selector list) with
+    one snapshot per pass:
+
+    - pods and nodes come from the store's shared-clone snapshot path
+      (``Client.list_snapshot``): no per-reader ``pickle.loads``;
+    - a gang-name -> pods index (one scan over LABEL_PODGANG_NAME)
+      replaces every per-gang selector list;
+    - a DomainIndex (level -> domain -> hosts with free-chip totals)
+      lets the planners prune candidate domains without rescanning
+      every host per pod.
+
+    After a successful bind the snapshot is mutated in place — chips
+    deducted from the assigned hosts, bound pods swapped into the gang
+    index — instead of re-listing the store. Every write the scheduler
+    itself performs is counted (``note_own_writes``); after each placed
+    gang the pass compares ``client.current_rv()`` against the
+    snapshot's rv + its own write count and falls back to a full
+    rebuild only when OUTSIDE writers moved the world. The rebuild is
+    itself cheap: unchanged objects come straight from the store's
+    snapshot cache.
+
+    Read-only contract: pods/nodes here may be shared with other store
+    readers — never mutate them (the bind path clones before writing).
+
+    ``incremental=False`` reproduces the pre-snapshot cost shape
+    (per-gang selector lists, full re-list after every placed gang) for
+    apples-to-apples benchmarking — tools/bench_sched.py and the
+    GROVE_SCHED_INCREMENTAL=0 escape hatch.
+    """
+
+    def __init__(self, client: Client, namespace: str | None,
+                 level_labels: dict[str, str],
+                 incremental: bool | None = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.level_labels = dict(level_labels)
+        self.incremental = (_incremental_enabled()
+                            if incremental is None else incremental)
+        self.rebuilds = 0
+        self._own_writes = 0
+        self.rv = -1
+        # Pass-lifetime gang index (index_gangs): owned by the pass,
+        # NOT reset by _build — a mid-pass rebuild refreshes pods and
+        # hosts, but the pass keeps iterating (and mutating) the gang
+        # objects it listed at pass start, and spread penalties must
+        # keep seeing them.
+        self._gangs_by_pcs: dict[tuple[str, str], list[PodGang]] = {}
+        self._build()
+
+    # ---- build / freshness ----
+
+    def _build(self) -> None:
+        client = self.client
+        if self.incremental and hasattr(client, "list_snapshot"):
+            # rv is sampled under the same lock as the Pod refs: any
+            # write after it — including one racing the Node list below
+            # — shows up as a version skew and triggers a rebuild, so
+            # the check is conservative, never blind.
+            self.rv, pods = client.list_snapshot(Pod, self.namespace)
+            _, nodes = client.list_snapshot(Node, self.namespace)
+        else:
+            # Clients without the shared-clone path (e.g. a wire
+            # HttpClient) still get rv-based freshness when they expose
+            # current_rv: sampled BEFORE the lists, so any interleaved
+            # write shows as a skew and forces a rebuild (conservative).
+            rv = (client.current_rv()
+                  if self.incremental and hasattr(client, "current_rv")
+                  else -1)
+            pods = client.list(Pod, self.namespace)
+            nodes = client.list(Node, self.namespace)
+            self.rv = rv
+        self._own_writes = 0
+        self.pods = pods
+        self.nodes = nodes
+        self.hosts = _host_views_from(pods, nodes, self.level_labels)
+        self.host_by_name = {h.name: h for h in self.hosts}
+        self.index = DomainIndex(self.hosts,
+                                 list(self.level_labels) + ["host"])
+        self._by_gang: dict[tuple[str, str], dict[str, Pod]] = \
+            defaultdict(dict)
+        for pod in pods:
+            gname = pod.meta.labels.get(c.LABEL_PODGANG_NAME)
+            if gname:
+                self._by_gang[(pod.meta.namespace, gname)][
+                    pod.meta.name] = pod
+
+    def index_gangs(self, gangs: list[PodGang]) -> None:
+        """Index the pass's gang list by PCS label (spread penalties
+        consult siblings per gang; one scan replaces G selector lists).
+        The listed gang objects are the SAME objects the pass mutates as
+        it places, so in-pass placements are visible to later penalties
+        exactly as the per-gang re-list used to see them."""
+        by_pcs: dict[tuple[str, str], list[PodGang]] = defaultdict(list)
+        for g in gangs:
+            pcs = g.meta.labels.get(c.LABEL_PCS_NAME, "")
+            if pcs:
+                by_pcs[(g.meta.namespace, pcs)].append(g)
+        self._gangs_by_pcs = by_pcs
+
+    def pcs_siblings(self, namespace: str, pcs: str) -> list[PodGang]:
+        if not self.incremental:
+            return self.client.list(PodGang, namespace,
+                                    selector={c.LABEL_PCS_NAME: pcs})
+        return self._gangs_by_pcs.get((namespace, pcs), [])
+
+    def gang_pods(self, gang: PodGang) -> list[Pod]:
+        """All existing pods labeled for ``gang`` (read-only objects)."""
+        if not self.incremental:
+            return self.client.list(
+                Pod, gang.meta.namespace,
+                selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+        pods = self._by_gang.get((gang.meta.namespace, gang.meta.name))
+        if not pods:
+            return []
+        return sorted(pods.values(), key=lambda p: p.meta.name)
+
+    def note_own_writes(self, n: int) -> None:
+        self._own_writes += n
+
+    def note_bound(self, pod: Pod) -> None:
+        """Account a successfully written bind in place: swap the bound
+        clone into the gang index and deduct its chips from its host
+        (and every enclosing domain's free total)."""
+        gname = pod.meta.labels.get(c.LABEL_PODGANG_NAME)
+        if gname:
+            self._by_gang[(pod.meta.namespace, gname)][pod.meta.name] = pod
+        host = self.host_by_name.get(pod.status.node_name)
+        if host is not None:
+            self.index.deduct(host, pod.spec.tpu_chips)
+
+    def refresh_if_moved(self) -> None:
+        """Keep the in-place-mutated snapshot iff nothing but the
+        scheduler's own (counted) writes advanced the store's resource
+        version; rebuild otherwise. Non-incremental mode rebuilds
+        unconditionally — the pre-snapshot behavior."""
+        if (not self.incremental or self.rv < 0
+                or not hasattr(self.client, "current_rv")
+                or self.client.current_rv() != self.rv + self._own_writes):
+            self._build()
+            self.rebuilds += 1
 
 
 def _schedulable(pod: Pod) -> bool:
@@ -183,8 +344,10 @@ class GangBackend:
     def _place_pass(self) -> None:
         client = self.client
         assert client is not None
-        hosts = build_host_views(client, self.namespace, self._level_labels)
+        t0 = time.perf_counter()
+        snap = PlacementSnapshot(client, self.namespace, self._level_labels)
         gangs = client.list(PodGang, self.namespace)
+        snap.index_gangs(gangs)
         scheduled_by_name = {
             (g.meta.namespace, g.meta.name):
                 is_condition_true(g.status.conditions, c.COND_SCHEDULED)
@@ -193,27 +356,39 @@ class GangBackend:
         # time (stable).
         gangs.sort(key=lambda g: (-g.spec.priority, bool(g.spec.base_gang),
                                   g.meta.creation_timestamp))
-        for gang in gangs:
-            if gang.spec.scheduler_name not in ("", self.name):
-                continue
-            if gang.spec.base_gang and not scheduled_by_name.get(
-                    (gang.meta.namespace, gang.spec.base_gang), False):
-                continue  # scaled capacity never blocks/preempts base gangs
-            placed, preempted = self._sync_gang(gang, hosts)
-            if preempted:
-                # Stop the pass: freed capacity must go to the preemptor
-                # on the next pass (which re-sorts by priority), not to a
-                # lower-priority gang later in THIS pass.
-                break
-            if placed:
-                hosts = build_host_views(client, self.namespace,
-                                         self._level_labels)
+        try:
+            for gang in gangs:
+                if gang.spec.scheduler_name not in ("", self.name):
+                    continue
+                if gang.spec.base_gang and not scheduled_by_name.get(
+                        (gang.meta.namespace, gang.spec.base_gang), False):
+                    continue  # scaled capacity never blocks/preempts base
+                placed, preempted = self._sync_gang(gang, snap)
+                if preempted:
+                    # Stop the pass: freed capacity must go to the
+                    # preemptor on the next pass (which re-sorts by
+                    # priority), not to a lower-priority gang later in
+                    # THIS pass.
+                    break
+                if placed:
+                    # The bind already mutated the snapshot in place;
+                    # a full rebuild happens only when outside writers
+                    # moved the store past our own counted writes.
+                    snap.refresh_if_moved()
+        finally:
+            from grove_tpu.runtime.metrics import GLOBAL_METRICS
+            GLOBAL_METRICS.observe("grove_sched_place_pass_seconds",
+                                   time.perf_counter() - t0, backend="gang")
+            if snap.rebuilds and snap.incremental:
+                # Legacy mode rebuilds unconditionally — counting those
+                # would attribute phantom outside writers.
+                GLOBAL_METRICS.inc("grove_sched_snapshot_rebuilds_total",
+                                   snap.rebuilds, backend="gang")
 
-    def _gang_pods(self, gang: PodGang) -> tuple[list[Pod], int, int]:
+    def _gang_pods(self, gang: PodGang,
+                   snap: PlacementSnapshot) -> tuple[list[Pod], int, int]:
         """(existing pods of the gang, total expected, min required)."""
-        client = self.client
-        pods = client.list(Pod, gang.meta.namespace,
-                           selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+        pods = snap.gang_pods(gang)
         by_name = {p.meta.name: p for p in pods}
         existing: list[Pod] = []
         expected = 0
@@ -226,9 +401,11 @@ class GangBackend:
                     existing.append(by_name[pn])
         return existing, expected, min_required
 
-    def _sync_gang(self, gang: PodGang, hosts: list[HostView]) -> bool:
-        client = self.client
-        existing, expected, min_required = self._gang_pods(gang)
+    def _sync_gang(self, gang: PodGang,
+                   snap: PlacementSnapshot) -> tuple[bool, bool]:
+        """Returns (placed_any, preempted)."""
+        hosts = snap.hosts
+        existing, expected, min_required = self._gang_pods(gang, snap)
         initialized = expected > 0 and len(existing) == expected
 
         bindable = [p for p in existing if _schedulable(p)]
@@ -252,7 +429,7 @@ class GangBackend:
             topo = gang.spec.topology
             pack_level = topo.pack_level if topo else "slice"
             required = topo.required if topo else True
-            spread = self._spread_penalties(gang)
+            spread = self._spread_penalties(gang, snap)
 
             def req(p: Pod) -> PodRequest:
                 return PodRequest(p.meta.name, p.spec.tpu_chips,
@@ -280,19 +457,19 @@ class GangBackend:
                              if p.meta.name not in grouped_names]
                     if stray:
                         greqs.append(GroupRequest(stray))
-                    return lambda hv: plan_gang_grouped(
+                    return lambda hv, idx=None: plan_gang_grouped(
                         greqs, hv, pack_level=pack_level, required=required,
                         prefer_slice=self._reuse_slice(gang),
-                        spread_penalty=spread)
+                        spread_penalty=spread, domain_index=idx)
                 requests = [req(p) for p in pods]
-                return lambda hv: plan_gang(
+                return lambda hv, idx=None: plan_gang(
                     requests, hv, pack_level=pack_level, required=required,
                     prefer_slice=self._reuse_slice(gang),
-                    spread_penalty=spread)
+                    spread_penalty=spread, domain_index=idx)
 
             plan_fn = make_plan_fn(bindable)
             to_bind = bindable
-            plan = plan_fn(hosts)
+            plan = plan_fn(hosts, snap.index)
             if plan is None and not self._try_preempt_for(gang, plan_fn,
                                                           hosts):
                 # Min-floor fallback (reference GS5 semantics), tried
@@ -306,34 +483,34 @@ class GangBackend:
                 floor = self._floor_subset(gang, bindable)
                 if floor is not None and len(floor) < len(bindable):
                     full_hosts = self._full_headroom_hosts(
-                        gang, bindable, hosts)
+                        gang, bindable, snap)
                     floor_plan = make_plan_fn(floor)(full_hosts)
                     if floor_plan is not None:
                         plan, to_bind = floor_plan, floor
             elif plan is None:
                 preempted = True
             if plan is not None:
-                self._bind(to_bind, plan.assignments)
+                self._bind(to_bind, plan.assignments, snap)
                 gang.status.assigned_slice = plan.slice_name
                 gang.status.placement_score = plan.score
                 placed_any = True
                 from grove_tpu.runtime.metrics import GLOBAL_METRICS
                 GLOBAL_METRICS.inc("grove_gang_placements_total")
-                self.recorder.event(
+                snap.note_own_writes(self.recorder.event(
                     gang, "Normal", "GangPlaced",
                     f"{len(to_bind)} pods onto "
                     f"{plan.slice_name or 'multiple domains'} "
                     f"(score {plan.score:.2f})"
                     + (f"; {len(bindable) - len(to_bind)} surplus pending"
-                       if len(to_bind) < len(bindable) else ""))
+                       if len(to_bind) < len(bindable) else "")))
             else:
                 # Preemption was already attempted above (one victim per
                 # pass); nothing fit and no floor was possible.
-                self.recorder.event(
+                snap.note_own_writes(self.recorder.event(
                     gang, "Warning", "GangUnschedulable",
                     f"no {pack_level or 'slice'} domain fits "
                     f"{len(bindable)} pods "
-                    f"({sum(p.spec.tpu_chips for p in bindable)} chips)")
+                    f"({sum(p.spec.tpu_chips for p in bindable)} chips)"))
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate with their siblings,
@@ -342,19 +519,17 @@ class GangBackend:
             # better an unschedulable pod than a gang whose ICI
             # collectives can never re-form.
             bound_domains = self._bound_domains(gang, existing, hosts)
-            by_name = {h.name: h for h in hosts}
             for p in bindable:
-                pool = self._straggler_pool(gang, p, hosts, bound_domains)
+                pool = self._straggler_pool(gang, p, snap, bound_domains)
                 host = plan_single(
                     PodRequest(p.meta.name, p.spec.tpu_chips,
                                dict(p.spec.node_selector)),
                     pool, prefer_slice=gang.status.assigned_slice)
                 if host is not None:
-                    self._bind([p], {p.meta.name: host})
-                    by_name[host].free_chips -= p.spec.tpu_chips
+                    self._bind([p], {p.meta.name: host}, snap)
                     placed_any = True
 
-        self._update_status(gang, initialized, placed_any)
+        self._update_status(gang, initialized, placed_any, snap)
         return placed_any, preempted
 
     def _floor_subset(self, gang: PodGang,
@@ -382,11 +557,12 @@ class GangBackend:
         return subset
 
     def _full_headroom_hosts(self, gang: PodGang, bindable: list[Pod],
-                             hosts: list[HostView]) -> list[HostView]:
+                             snap: PlacementSnapshot) -> list[HostView]:
         """Hosts whose pack-level domain could hold the FULL gang by
         total capacity. Only meaningful under a required pack (which
         anchors later stragglers to the floor's domain); otherwise all
         hosts qualify."""
+        hosts = snap.hosts
         topo = gang.spec.topology
         if topo is None or not topo.required or not topo.pack_level:
             return hosts
@@ -397,8 +573,9 @@ class GangBackend:
         # Physical capacity: ALL nodes count, including cordoned or
         # not-ready ones — they are temporarily out, not absent, and the
         # question is whether the domain could EVER hold the full gang.
+        # The snapshot's raw node list carries exactly that view.
         total_by_domain: dict[str, int] = defaultdict(int)
-        for node in self.client.list(Node, self.namespace):
+        for node in snap.nodes:
             total_by_domain[node.meta.labels.get(level_label, "")] += \
                 node.status.allocatable_chips
         return [h for h in hosts
@@ -511,12 +688,13 @@ class GangBackend:
         return out
 
     def _straggler_pool(self, gang: PodGang, pod: Pod,
-                        hosts: list[HostView],
+                        snap: PlacementSnapshot,
                         bound_domains: dict[str, dict[str, str]]
                         ) -> list[HostView]:
         """Hosts a late pod may bind to: every *required* pack constraint
         (gang-level and its group's) restricts to the domain its bound
-        siblings occupy."""
+        siblings occupy. The first constraint resolves through the
+        snapshot's domain index (no full-fleet scan per straggler)."""
         constraints: list[tuple[str, str]] = []  # (level, domain value)
         gang_topo = gang.spec.topology
         gang_level = gang_topo.pack_level if gang_topo else "slice"
@@ -536,10 +714,16 @@ class GangBackend:
                 lvl = my_group.topology.pack_level
                 constraints.append(
                     (lvl, bound_domains[my_group.name].get(lvl, "")))
-        pool = hosts
+        pool = snap.hosts
+        first = True
         for level, value in constraints:
-            if value:
+            if not value:
+                continue
+            if first and snap.index.domains(level) is not None:
+                pool = snap.index.hosts_in(level, value)
+            else:
                 pool = [h for h in pool if h.domains.get(level) == value]
+            first = False
         return pool
 
     def _reuse_slice(self, gang: PodGang) -> str:
@@ -558,29 +742,36 @@ class GangBackend:
         except NotFoundError:
             return ""
 
-    def _spread_penalties(self, gang: PodGang) -> dict[str, float]:
+    def _spread_penalties(self, gang: PodGang,
+                          snap: PlacementSnapshot) -> dict[str, float]:
         """Penalise slices already hosting sibling gangs of the same PCS
-        (DCN multislice spread of PCS replicas)."""
+        (DCN multislice spread of PCS replicas). Siblings come from the
+        pass's gang index (one scan per pass, not one selector list per
+        gang); in-pass placements are visible because the index holds
+        the very objects the pass mutates."""
         pcs = gang.meta.labels.get(c.LABEL_PCS_NAME, "")
         if not pcs:
             return {}
         penalties: dict[str, float] = defaultdict(float)
-        for other in self.client.list(PodGang, gang.meta.namespace,
-                                      selector={c.LABEL_PCS_NAME: pcs}):
+        for other in snap.pcs_siblings(gang.meta.namespace, pcs):
             if other.meta.name != gang.meta.name and other.status.assigned_slice:
                 # Must dominate bin-pack tightness (<= 1.0) so multislice
                 # replicas spread before they pack.
                 penalties[other.status.assigned_slice] += 2.0
         return dict(penalties)
 
-    def _bind(self, pods: list[Pod], assignment: dict[str, str]) -> None:
+    def _bind(self, pods: list[Pod], assignment: dict[str, str],
+              snap: PlacementSnapshot) -> None:
         to_write = []
         for pod in pods:
             host = assignment.get(pod.meta.name)
             if host is None:
                 continue
-            pod.status.node_name = host
-            to_write.append(pod)
+            # Snapshot pods are SHARED read-only objects — clone before
+            # stamping the binding (the write payload is ours alone).
+            bound = clone(pod) if snap.incremental else pod
+            bound.status.node_name = host
+            to_write.append(bound)
         # One batched store transaction: per-pod locking would serialise a
         # large gang bind against every reader. Individual failures (pod
         # vanished / changed under us in a scale-in race) are skipped; the
@@ -590,11 +781,14 @@ class GangBackend:
                             self.client.update_status_many(to_write)):
             if err is not None:
                 self.log.debug("bind %s skipped: %s", pod.meta.name, err)
+                continue
+            snap.note_own_writes(1)
+            snap.note_bound(pod)
 
     def _update_status(self, gang: PodGang, initialized: bool,
-                       placed_now: bool) -> None:
+                       placed_now: bool, snap: PlacementSnapshot) -> None:
         client = self.client
-        existing, expected, _ = self._gang_pods(gang)
+        existing, expected, _ = self._gang_pods(gang, snap)
         bound = sum(1 for p in existing if p.status.node_name)
         ready = sum(1 for p in existing
                     if is_condition_true(p.status.conditions, c.COND_READY))
@@ -618,10 +812,31 @@ class GangBackend:
             gang.status.phase = PodGangPhase.STARTING
         else:
             gang.status.phase = PodGangPhase.PENDING
+        def write(g: PodGang) -> None:
+            updated = client.update_status(g)  # no-op writes suppressed
+            if updated.meta.resource_version != g.meta.resource_version:
+                snap.note_own_writes(1)
+
         try:
-            client.update_status(gang)  # store suppresses no-op writes
-        except (ConflictError, NotFoundError):
-            pass  # next pass recomputes from live state
+            write(gang)
+        except ConflictError:
+            # The podgang controller races this write (our own bind
+            # events wake it mid-pass). Reapply on a fresh read so
+            # Scheduled/assigned_slice land THIS pass instead of
+            # waiting out a full extra pass; a second conflict defers
+            # to the next pass as before.
+            try:
+                fresh = client.get(PodGang, gang.meta.name,
+                                   gang.meta.namespace)
+                fresh.status.conditions = gang.status.conditions
+                fresh.status.phase = gang.status.phase
+                fresh.status.assigned_slice = gang.status.assigned_slice
+                fresh.status.placement_score = gang.status.placement_score
+                write(fresh)
+            except (ConflictError, NotFoundError):
+                pass  # next pass recomputes from live state
+        except NotFoundError:
+            pass  # gang deleted under us; nothing to record
 
 
 class SimpleBackend:
@@ -654,7 +869,9 @@ class SimpleBackend:
 
     def _place_pass(self) -> None:
         client = self.client
+        t0 = time.perf_counter()
         hosts = build_host_views(client, self.namespace)
+        by_name = {h.name: h for h in hosts}
         for pod in client.list(Pod, self.namespace):
             if pod.spec.scheduler_name not in ("", self.name):
                 continue
@@ -666,7 +883,12 @@ class SimpleBackend:
             if host is not None:
                 pod.status.node_name = host
                 client.update_status(pod)
-                hosts = build_host_views(client, self.namespace)
+                # In-place deduction replaces the full per-bind re-list
+                # (the same accounting the rebuild would arrive at).
+                by_name[host].free_chips -= pod.spec.tpu_chips
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        GLOBAL_METRICS.observe("grove_sched_place_pass_seconds",
+                               time.perf_counter() - t0, backend="simple")
 
 
 class ExternalBackend:

@@ -76,6 +76,58 @@ def _domain_of(host: HostView, level: str) -> str:
     return host.domains.get(level, "")
 
 
+class DomainIndex:
+    """Per-level host indexes: level -> domain value -> hosts, with a
+    running free-chip total per domain.
+
+    Built once per placement snapshot and mutated in place as binds
+    land (``deduct``), so the planners can (a) skip the per-call
+    group-hosts-by-domain scan and (b) prune candidate domains whose
+    total free capacity cannot hold the gang — without rescanning every
+    host per pod. The index holds REFERENCES to the caller's HostViews:
+    a ``deduct`` updates both the host and every level's running total,
+    keeping index and views coherent by construction.
+    """
+
+    def __init__(self, hosts: list[HostView],
+                 levels: "list[str] | tuple[str, ...]" = ()) -> None:
+        self.levels = list(dict.fromkeys(levels)) or ["slice"]
+        if "host" not in self.levels:
+            self.levels.append("host")
+        self._hosts_by: dict[str, dict[str, list[HostView]]] = {
+            lvl: defaultdict(list) for lvl in self.levels}
+        self._free_by: dict[str, dict[str, int]] = {
+            lvl: defaultdict(int) for lvl in self.levels}
+        for h in hosts:
+            self.add(h)
+
+    def add(self, host: HostView) -> None:
+        for lvl in self.levels:
+            d = _domain_of(host, lvl)
+            self._hosts_by[lvl][d].append(host)
+            self._free_by[lvl][d] += host.free_chips
+
+    def deduct(self, host: HostView, chips: int) -> None:
+        """Account a bind: the host loses ``chips`` and every enclosing
+        domain's free total drops with it."""
+        host.free_chips -= chips
+        for lvl in self.levels:
+            self._free_by[lvl][_domain_of(host, lvl)] -= chips
+
+    def domains(self, level: str) -> dict[str, list[HostView]] | None:
+        """The precomputed domain -> hosts map for ``level`` (None when
+        the level is not indexed — callers fall back to a scan)."""
+        return self._hosts_by.get(level)
+
+    def hosts_in(self, level: str, domain: str) -> list[HostView]:
+        by = self._hosts_by.get(level)
+        return list(by.get(domain, ())) if by is not None else []
+
+    def free_in(self, level: str, domain: str) -> int:
+        by = self._free_by.get(level)
+        return by.get(domain, 0) if by is not None else 0
+
+
 def _fit_in_hosts(pods: list[PodRequest], hosts: list[HostView]
                   ) -> dict[str, str] | None:
     """First-fit-decreasing of pods onto hosts. Returns assignment or None."""
@@ -98,12 +150,18 @@ def _fit_in_hosts(pods: list[PodRequest], hosts: list[HostView]
 def plan_gang(pods: list[PodRequest], hosts: list[HostView],
               pack_level: str = "slice", required: bool = True,
               prefer_slice: str = "",
-              spread_penalty: dict[str, float] | None = None
+              spread_penalty: dict[str, float] | None = None,
+              domain_index: DomainIndex | None = None
               ) -> PlacementPlan | None:
     """Plan placement for all ``pods`` together (gang semantics).
 
     ``spread_penalty`` maps domain value (at the caller's spread level,
     pre-resolved to slice names) -> penalty subtracted from the score.
+
+    ``domain_index`` (optional) is a DomainIndex built over exactly
+    ``hosts``: when it covers ``pack_level`` the per-call domain
+    grouping scan is skipped. Decisions are identical with or without
+    it.
 
     Dispatches to the native C++ core (grove_tpu/native/placement.cpp)
     when available; this Python body is the reference semantics and the
@@ -111,6 +169,12 @@ def plan_gang(pods: list[PodRequest], hosts: list[HostView],
     """
     if not pods:
         return PlacementPlan({}, "", 0.0)
+    level = pack_level or "slice"
+    used_chips = sum(p.chips for p in pods)
+    by_domain, hosts = _prune_candidates(domain_index, level, required,
+                                         used_chips, hosts)
+    if not hosts:
+        return None
     import os
     if os.environ.get("GROVE_NATIVE_PLACEMENT", "1") != "0":
         from grove_tpu.native.loader import native_plan_gang
@@ -120,18 +184,42 @@ def plan_gang(pods: list[PodRequest], hosts: list[HostView],
             return result
     spread_penalty = spread_penalty or {}
 
-    by_domain: dict[str, list[HostView]] = defaultdict(list)
-    level = pack_level or "slice"
-    for h in hosts:
-        by_domain[_domain_of(h, level)].append(h)
+    if by_domain is None:
+        by_domain = defaultdict(list)
+        for h in hosts:
+            by_domain[_domain_of(h, level)].append(h)
 
     return _best_domain_plan(by_domain, hosts, _fit_in_hosts_of(pods),
-                             sum(p.chips for p in pods), level, required,
+                             used_chips, level, required,
                              prefer_slice, spread_penalty)
 
 
 def _fit_in_hosts_of(pods: list[PodRequest]):
     return lambda domain_hosts: _fit_in_hosts(pods, domain_hosts)
+
+
+def _prune_candidates(domain_index: DomainIndex | None, level: str,
+                      required: bool, used_chips: int,
+                      hosts: list[HostView]
+                      ) -> tuple[dict[str, list[HostView]] | None,
+                                 list[HostView]]:
+    """Candidate pruning via the index's free totals, shared by the
+    flat and grouped planners: under a REQUIRED pack every feasible
+    plan lives inside one domain, so domains whose total free chips
+    fall short of the gang can be dropped before the planner (native
+    or Python) scans their hosts per pod. Decision-identical — only
+    certainly-infeasible domains are removed. Returns (by_domain,
+    hosts); by_domain is None when the index doesn't cover ``level``
+    (callers fall back to a scan), hosts shrinks only when pruning
+    applied (an empty result means no domain can fit the gang)."""
+    if domain_index is None:
+        return None, hosts
+    by_domain = domain_index.domains(level)
+    if by_domain is None or not required:
+        return by_domain, hosts
+    by_domain = {d: hs for d, hs in by_domain.items()
+                 if domain_index.free_in(level, d) >= used_chips}
+    return by_domain, [h for hs in by_domain.values() for h in hs]
 
 
 def _best_domain_plan(by_domain, all_hosts, fit_fn, used_chips, level,
@@ -142,10 +230,16 @@ def _best_domain_plan(by_domain, all_hosts, fit_fn, used_chips, level,
     flat and per-group planners so scoring semantics cannot diverge."""
     candidates: list[PlacementPlan] = []
     for domain, domain_hosts in by_domain.items():
+        total_free = sum(h.free_chips for h in domain_hosts)
+        if total_free < used_chips:
+            # Capacity prune: no assignment can exist when the domain's
+            # total free chips fall short of the gang's demand — skip
+            # the per-pod fitting entirely. Decision-identical (fit_fn
+            # would return None) but O(hosts) instead of O(pods*hosts).
+            continue
         assignment = fit_fn(domain_hosts)
         if assignment is None:
             continue
-        total_free = sum(h.free_chips for h in domain_hosts)
         tightness = used_chips / total_free if total_free else 1.0
         score = tightness - spread_penalty.get(domain, 0.0)
         if prefer_slice and domain == prefer_slice:
@@ -176,7 +270,8 @@ class GroupRequest:
 def plan_gang_grouped(groups: list[GroupRequest], hosts: list[HostView],
                       pack_level: str = "slice", required: bool = True,
                       prefer_slice: str = "",
-                      spread_penalty: dict[str, float] | None = None
+                      spread_penalty: dict[str, float] | None = None,
+                      domain_index: DomainIndex | None = None
                       ) -> PlacementPlan | None:
     """Gang planning with per-group pack constraints (reference
     PodGroup.TopologyConstraint, scheduler api podgang.go:99-117).
@@ -191,7 +286,14 @@ def plan_gang_grouped(groups: list[GroupRequest], hosts: list[HostView],
     if not any(g.pack_level for g in groups):
         return plan_gang(all_pods, hosts, pack_level=pack_level,
                          required=required, prefer_slice=prefer_slice,
-                         spread_penalty=spread_penalty)
+                         spread_penalty=spread_penalty,
+                         domain_index=domain_index)
+    level = pack_level or "slice"
+    used_chips = sum(p.chips for p in all_pods)
+    by_domain, hosts = _prune_candidates(domain_index, level, required,
+                                         used_chips, hosts)
+    if not hosts:
+        return None
     import os
     if os.environ.get("GROVE_NATIVE_PLACEMENT", "1") != "0":
         from grove_tpu.native.loader import native_plan_gang_grouped
@@ -201,10 +303,10 @@ def plan_gang_grouped(groups: list[GroupRequest], hosts: list[HostView],
         if result is not NotImplemented:
             return result
     spread_penalty = spread_penalty or {}
-    level = pack_level or "slice"
-    by_domain: dict[str, list[HostView]] = defaultdict(list)
-    for h in hosts:
-        by_domain[_domain_of(h, level)].append(h)
+    if by_domain is None:
+        by_domain = defaultdict(list)
+        for h in hosts:
+            by_domain[_domain_of(h, level)].append(h)
 
     def plan_in_domain(domain_hosts: list[HostView]) -> dict[str, str] | None:
         free = {h.name: h.free_chips for h in domain_hosts}

@@ -339,6 +339,37 @@ class DecodeEngine:
     def free_lanes(self) -> list[int]:
         return [i for i in range(self.batch) if not self._active[i]]
 
+    def release_lane(self, lane: int,
+                     zero_kv: bool = True) -> "Request | None":
+        """Retire a lane's occupant and free the lane (public API for
+        callers that drive lane turnover themselves — the disagg bench,
+        an external router doing its own completion policy). The KV
+        length is zeroed so the lane's next occupant starts from a
+        clean cache row, exactly as completion bookkeeping does;
+        ``zero_kv=False`` skips that device write for the retire-then-
+        immediately-insert hand-off pattern, where insert() stamps the
+        lane's length anyway. Returns the retired Request (marked done)
+        or None for an untracked/empty lane. Idempotent on free lanes."""
+        occupant = self._requests[lane]
+        if occupant is not None:
+            # Tokens this lane already decoded belong to the retiring
+            # request: drain pending windows first, exactly as the
+            # completion path does — otherwise up to interval-1 decoded
+            # tokens would vanish from the returned Request.
+            self._drain()
+        req = self._requests[lane]  # the drain may have completed it
+        if req is not None:
+            req.done = True
+            self.completed.append(req)
+            self._requests[lane] = None
+        if self._active[lane]:
+            self._active[lane] = False
+            if zero_kv:
+                lengths = self.cache.lengths.at[lane].set(0)
+                self.cache = self.cache._replace(lengths=lengths)
+            self._report_metric()
+        return occupant
+
     def insert(self, lane: int, result: PrefillResult,
                request: Request | None = None) -> None:
         """Splice a prefilled sequence into a free lane (KV handoff)."""

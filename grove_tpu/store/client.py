@@ -34,6 +34,20 @@ class Client:
              fields: dict[str, str] | None = None) -> list[Any]:
         return self._store.list(kind_cls, namespace, selector, fields)
 
+    def list_snapshot(self, kind_cls: type,
+                      namespace: str | None = "default",
+                      selector: dict[str, str] | None = None
+                      ) -> tuple[int, list[Any]]:
+        """Read-only shared-object list + the store rv it was taken at
+        (see Store.list_snapshot for the no-mutation contract)."""
+        return self._store.list_snapshot(kind_cls, namespace, selector)
+
+    def current_rv(self) -> int:
+        """Highest resource version the store has issued — lets a
+        read-mostly consumer (the placement snapshot) cheaply detect
+        whether the world moved since its last read."""
+        return self._store.current_rv()
+
     def create(self, obj: Any) -> Any:
         return self._store.create(obj, actor=self.actor)
 
@@ -143,6 +157,16 @@ class FakeClient(Client):
              fields: dict[str, str] | None = None) -> list[Any]:
         self._intercept("list", kind_cls.KIND, "")
         return super().list(kind_cls, namespace, selector, fields)
+
+    def list_snapshot(self, kind_cls: type,
+                      namespace: str | None = "default",
+                      selector: dict[str, str] | None = None
+                      ) -> tuple[int, list[Any]]:
+        # Recorded (and poisoned) as "list": the snapshot path is a
+        # list-shaped read, and scripted list failures should exercise
+        # consumers regardless of which read path they take.
+        self._intercept("list", kind_cls.KIND, "")
+        return super().list_snapshot(kind_cls, namespace, selector)
 
     def create(self, obj: Any) -> Any:
         self._intercept("create", obj.KIND, obj.meta.name)

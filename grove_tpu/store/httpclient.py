@@ -130,6 +130,15 @@ class HttpClient:
             "GET", f"/api/{kind_cls.KIND}?{urlencode(params)}")
         return [from_dict(kind_cls, d) for d in data]
 
+    def current_rv(self) -> int:
+        """The server's highest resource version (one GET /watch
+        bootstrap round trip) — the wire twin of Client.current_rv, so
+        read-mostly consumers can run the same is-my-snapshot-fresh
+        check against a remote control plane. There is no wire
+        list_snapshot: HTTP readers deserialize per request anyway, so
+        the shared-clone optimisation has nothing to share."""
+        return int(self._request("GET", "/watch")["rv"])
+
     def create(self, obj: Any) -> Any:
         doc = {"kind": obj.KIND,
                "metadata": {"name": obj.meta.name,

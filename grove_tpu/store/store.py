@@ -119,6 +119,13 @@ class Store:
         # cost). Keyed by object identity; entries die with the object.
         self._clone_cache: dict[tuple[str, str, str],
                                 tuple[int, bytes]] = {}
+        # Snapshot read path (list_snapshot): per-version MATERIALIZED
+        # clones, shared across readers that promise not to mutate —
+        # skips even the pickle.loads half for read-mostly consumers
+        # (the scheduler's placement snapshot). Invalidation is by
+        # resource version, eviction with the object (_remove).
+        self._snapshot_cache: dict[tuple[str, str, str],
+                                   tuple[int, Any]] = {}
         # Event history ring for resumable (wire) watches: (seq, event).
         # seq is the rv that produced the event (deletes allocate one).
         # A watcher further behind than the ring must relist (410-Gone
@@ -294,6 +301,51 @@ class Store:
                 self._clone_cache[key] = (rv, data)
         return pickle.loads(data)
 
+    def _shared_clone(self, obj: Any) -> Any:
+        """A per-version cached clone SHARED across snapshot readers.
+        One pickle.dumps+loads per object version total (vs. one loads
+        per reader in _read_clone); callers must honor the read-only
+        contract of list_snapshot."""
+        key = (obj.KIND, obj.meta.namespace, obj.meta.name)
+        rv = obj.meta.resource_version
+        hit = self._snapshot_cache.get(key)
+        if hit is not None and hit[0] == rv:
+            return hit[1]
+        out = self._read_clone(obj)
+        with self._lock:
+            # Same eviction race discipline as _read_clone: only cache
+            # names that are still live, so deleted objects cannot be
+            # resurrected into the cache forever.
+            if _key(obj) in self._objects.get(obj.KIND, {}):
+                self._snapshot_cache[key] = (rv, out)
+        return out
+
+    def list_snapshot(self, kind_cls: type,
+                      namespace: str | None = "default",
+                      selector: dict[str, str] | None = None
+                      ) -> tuple[int, list[Any]]:
+        """Cheap list for read-mostly consumers: ``(rv, objects)`` where
+        ``rv`` is the store's resource version at snapshot time and the
+        objects are per-version cached clones SHARED with every other
+        ``list_snapshot`` caller.
+
+        Contract: callers MUST NOT mutate the returned objects (clone()
+        before editing — the scheduler's bind path does exactly that).
+        In exchange, a steady-state list costs one dict scan plus cache
+        lookups: no per-reader ``pickle.loads`` (the cost profiled to
+        dominate the naive O(gangs x pods) placement pass). The rv lets
+        the consumer detect outside writes (``current_rv() != rv``) and
+        decide when its derived state needs a rebuild."""
+        with self._lock:
+            rv = self._peek_rv()
+            objs = self._objects.get(kind_cls.KIND, {})
+            refs = [obj for (ns, _), obj in objs.items()
+                    if (namespace is None or ns == namespace)
+                    and matches_labels(obj, selector)]
+        out = [self._shared_clone(o) for o in refs]
+        out.sort(key=lambda o: o.meta.name)
+        return rv, out
+
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
         with self._lock:
             objs = self._objects.get(kind_cls.KIND, {})
@@ -384,13 +436,21 @@ class Store:
         singular and batched paths). Caller holds the lock."""
         live = self._get_live(obj)
         # Status is a privileged surface (node binding, breach conditions,
-        # gang placement) — same authorization as spec.
-        self._admit("update_status", clone(obj), clone(live), actor)
+        # gang placement) — same authorization as spec. The defensive
+        # clones exist only for the chain's benefit: skip them when no
+        # chain is installed (they dominated the gang-bind write path).
+        if self._admission is not None:
+            self._admit("update_status", clone(obj), clone(live), actor)
         if obj.meta.resource_version != live.meta.resource_version:
             raise ConflictError(
                 f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
                 f"resource_version (status)")
-        if to_dict(obj.status) == to_dict(live.status):
+        # Dataclass equality, not to_dict round-trips: statuses are
+        # plain dataclasses (strs/numbers/lists/dicts/enums), where
+        # field-wise __eq__ decides the same no-op question at a
+        # fraction of the cost — this comparison runs on EVERY status
+        # write, including each pod of a gang bind.
+        if obj.status == live.status:
             return live
         stored = clone(live)
         stored.status = clone(obj.status)
@@ -423,8 +483,9 @@ class Store:
                 f"{kind_cls.KIND} {namespace}/{name} not found")
         updated = clone(live)
         updated.status = merge_status(live.status, patch)
-        self._admit("update_status", clone(updated), clone(live), actor)
-        if to_dict(updated.status) == to_dict(live.status):
+        if self._admission is not None:
+            self._admit("update_status", clone(updated), clone(live), actor)
+        if updated.status == live.status:
             return live                     # no-op suppression, as PUT
         updated.meta.resource_version = next(self._rv)
         self._objects[kind_cls.KIND][(namespace, name)] = updated
@@ -509,6 +570,8 @@ class Store:
         """Unconditional removal + owner-reference cascade (GC analog)."""
         self._objects[obj.KIND].pop(_key(obj), None)
         self._clone_cache.pop(
+            (obj.KIND, obj.meta.namespace, obj.meta.name), None)
+        self._snapshot_cache.pop(
             (obj.KIND, obj.meta.namespace, obj.meta.name), None)
         self._persist_delete(obj)
         # Deletions get their own seq (kube bumps rv on delete too) so

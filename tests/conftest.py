@@ -86,7 +86,8 @@ def pytest_collection_modifyitems(config, items):
 # exceeded its time-box, even with every test green — wall time is the
 # regression. tools/ci_budget.py walls the whole suite the same way.
 _TIER_ENV = "GROVE_CI_TIERS"
-_tier = {"t0": 0.0, "core_done": False, "over": False}
+_tier = {"t0": 0.0, "core_done": False, "over": False,
+         "wall": 0.0, "budget": 0.0}
 
 
 def _is_slow(item) -> bool:
@@ -113,15 +114,50 @@ def pytest_runtest_protocol(item, nextitem):
         budget = (float(os.environ.get("GROVE_CI_CORE_BUDGET", 300))
                   * float(os.environ.get("GROVE_CI_BUDGET_SCALE", 1)))
         _tier["over"] = wall > budget
+        _tier["wall"], _tier["budget"] = wall, budget
         print(f"\n[ci-budget] control-plane core tier: {wall:.0f}s of "
               f"{budget:.0f}s budget"
               + (" — OVER BUDGET (will fail the session)"
                  if _tier["over"] else ""), flush=True)
+        if _tier["over"] and not item.session.config.getvalue("maxfail"):
+            # Fail the session through pytest's documented accounting:
+            # bumping Session.testsfailed makes wrap_session compute
+            # ExitCode.TESTS_FAILED itself (no reliance on when
+            # session.exitstatus is read relative to the sessionfinish
+            # hook). The bump happens here, mid-run, BEFORE the exit
+            # status is derived; the banner below explains the red.
+            # Skipped under -x/--maxfail, where the bump would consume
+            # a real-failure slot and abort the slow tier early — the
+            # sessionfinish fallback below covers that case.
+            item.session.testsfailed += 1
 
 
 def pytest_sessionfinish(session, exitstatus):
+    # Fallback for --maxfail sessions (no testsfailed bump, see above):
+    # assigning session.exitstatus works because wrap_session re-reads
+    # it after this hook before returning — true for every pytest 7/8
+    # release (pinned assumption; the bump path above is the primary,
+    # documented mechanism).
     if _tier["over"] and exitstatus == 0:
         session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter):
+    """Make the budget overrun impossible to miss: an all-green run
+    that exits red with one buried print line reads like a harness bug
+    — this prints a prominent banner in the summary block instead."""
+    if not _tier["over"]:
+        return
+    terminalreporter.write_sep(
+        "=", "GROVE CI CORE-TIER BUDGET EXCEEDED", red=True, bold=True)
+    terminalreporter.write_line(
+        f"control-plane core tier took {_tier['wall']:.0f}s of its "
+        f"{_tier['budget']:.0f}s budget — wall time IS the regression; "
+        "the session is failed even though every test passed.")
+    terminalreporter.write_line(
+        "Knobs: GROVE_CI_CORE_BUDGET (seconds), GROVE_CI_BUDGET_SCALE "
+        "(machine factor), or move newly-heavy modules into "
+        "SLOW_MODULES (tests/conftest.py).")
 
 # On-failure diagnostics bundle for every test_e2e_* module (reference
 # e2e/diagnostics/collector.go analog; see diagnostics.py).

@@ -4,9 +4,11 @@ Reference analog: ``operator/e2e/diagnostics/collector.go`` — on test
 failure the reference dumps operator logs, every Grove resource, pod
 details, and recent events so a flaky e2e run leaves enough evidence to
 diagnose without a re-run. Here, when a test in any ``test_e2e_*``
-module fails, the ``pytest_runtest_makereport`` hook dumps every LIVE
-in-process cluster (``grove_tpu.cluster.live_clusters()``) to an
-artifact directory:
+module fails, the ``pytest_runtest_makereport`` hook dumps the live
+clusters reachable from the failing test's own fixtures
+(``item.funcargs``), falling back to every live in-process cluster
+(``grove_tpu.cluster.live_clusters()``) only when the fixtures
+reference none, to an artifact directory:
 
   objects/<Kind>.json   every stored object of every registered kind
   events.txt            human-readable event timeline (sorted)
@@ -123,6 +125,37 @@ def _safe(nodeid: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.\-]+", "_", nodeid)[-120:]
 
 
+def _clusters_for(item, live: list) -> list:
+    """The clusters a failing test's bundle should cover: those
+    reachable from ITS fixtures (item.funcargs — directly, or one
+    level inside list/tuple/dict fixture values), so a failing e2e
+    test doesn't bundle state from unrelated still-running clusters
+    (other fixtures, parallel threads). Falls back to the whole live
+    set only when the test's fixtures reference none — identity
+    membership keeps dead/foreign objects out."""
+    from grove_tpu.cluster import Cluster
+
+    live_ids = {id(cl) for cl in live}
+    scoped, seen = [], set()
+
+    def visit(value, depth: int = 0) -> None:
+        if isinstance(value, Cluster):
+            if id(value) in live_ids and id(value) not in seen:
+                seen.add(id(value))
+                scoped.append(value)
+        elif depth < 2:
+            if isinstance(value, (list, tuple, set)):
+                for v in value:
+                    visit(v, depth + 1)
+            elif isinstance(value, dict):
+                for v in value.values():
+                    visit(v, depth + 1)
+
+    for value in getattr(item, "funcargs", {}).values():
+        visit(value)
+    return scoped or live
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     outcome = yield
@@ -141,9 +174,10 @@ def pytest_runtest_makereport(item, call):
     base = os.environ.get(DIR_ENV,
                           os.path.join(os.getcwd(), "test-diagnostics"))
     mode = os.environ.get(MODE_ENV, "file")
-    for i, cl in enumerate(live):
+    targets = _clusters_for(item, live)
+    for i, cl in enumerate(targets):
         outdir = os.path.join(base, _safe(item.nodeid))
-        if len(live) > 1:
+        if len(targets) > 1:
             outdir = os.path.join(outdir, f"cluster-{i}")
         try:
             counts = collect_cluster(cl, outdir, test_name=item.nodeid)

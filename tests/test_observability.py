@@ -84,6 +84,42 @@ def test_histogram_render_parse_quantile_roundtrip():
     assert delta == {0.1: 1, 1.0: 1, float("inf"): 1}
 
 
+def test_label_values_escape_and_parse_back():
+    """Label values carrying commas, quotes, backslashes, or newlines
+    render as valid exposition text (escaped per the Prometheus format)
+    and parse back verbatim — ','.split label parsing mangled exactly
+    these."""
+    from grove_tpu.runtime.metrics import MetricsHub, parse_histograms
+    hub = MetricsHub()
+    nasty = 'a,b="c"\\d\ne'
+    hub.observe("y_seconds", 0.05, src=nasty, plain="ok")
+    text = hub.render()
+    assert '\\n' in text and '\\"' in text  # escaped, not raw
+    parsed = parse_histograms(text, "y_seconds")
+    (labels,) = parsed.keys()
+    assert dict(labels) == {"src": nasty, "plain": "ok"}
+    assert parsed[labels][float("inf")] == 1
+
+
+def test_histogram_buckets_pinned_at_first_observation():
+    """A histogram's bucket tuple is pinned on its series at creation:
+    rendering uses the pinned tuple, and re-describing with a different
+    bucket count after observations exist raises instead of silently
+    zip-truncating the +Inf slot."""
+    import pytest
+
+    from grove_tpu.runtime.metrics import MetricsHub, parse_histograms
+    hub = MetricsHub()
+    hub.describe_histogram("z_seconds", "h", buckets=(0.1, 1.0))
+    hub.observe("z_seconds", 0.5)
+    with pytest.raises(ValueError):
+        hub.describe_histogram("z_seconds", "h", buckets=(0.1, 0.5, 1.0))
+    # Same buckets re-described: fine (idempotent registration).
+    hub.describe_histogram("z_seconds", "h", buckets=(1.0, 0.1))
+    cum = parse_histograms(hub.render(), "z_seconds")[()]
+    assert cum == {0.1: 0, 1.0: 1, float("inf"): 1}
+
+
 def test_unschedulable_event(cluster):
     client = cluster.client
     client.create(simple_pcs(name="big", pods=5, chips=4))  # can't fit

@@ -1,0 +1,237 @@
+"""Placement-snapshot machinery: the store's shared-clone read path,
+the per-pass PlacementSnapshot (gang index, in-place bind accounting,
+rv-based invalidation), domain-index pruning equivalence, and the
+headline benchmark — the snapshot pass must beat the pre-snapshot
+per-gang-rebuild pass by >=5x on a synthetic 256-chip / 64-gang fleet
+(CPU, deterministic seeds; tools/bench_sched.py is the same harness)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grove_tpu.api import Node, Pod, PodGang, constants as c, new_meta
+from grove_tpu.api.core import PodSpec
+from grove_tpu.scheduler.backends import PlacementSnapshot
+from grove_tpu.scheduler.placement import (
+    DomainIndex,
+    HostView,
+    PodRequest,
+    plan_gang,
+)
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+
+from tools.bench_sched import build_fleet, make_workload, run_once
+
+
+# ---- store shared-clone snapshot path ----
+
+def _pod(name, gang="", chips=4):
+    labels = {c.LABEL_PODGANG_NAME: gang} if gang else {}
+    return Pod(meta=new_meta(name, labels=labels),
+               spec=PodSpec(tpu_chips=chips))
+
+
+def test_list_snapshot_shares_objects_per_version():
+    store = Store()
+    client = Client(store)
+    client.create(_pod("p0"))
+    rv1, first = client.list_snapshot(Pod)
+    rv2, second = client.list_snapshot(Pod)
+    assert rv1 == rv2 == store.current_rv()
+    # Same materialized object until the version moves...
+    assert first[0] is second[0]
+    p = client.get(Pod, "p0")
+    p.status.node_name = "h0"
+    client.update_status(p)
+    rv3, third = client.list_snapshot(Pod)
+    # ...then a fresh clone at the new version, and a moved rv.
+    assert rv3 > rv1
+    assert third[0] is not first[0]
+    assert third[0].status.node_name == "h0"
+    # The superseded object is untouched (snapshot holders are safe).
+    assert first[0].status.node_name == ""
+
+
+def test_list_snapshot_evicts_deleted_objects():
+    client = Client(Store())
+    client.create(_pod("p0"))
+    client.list_snapshot(Pod)
+    client.delete(Pod, "p0")
+    _, pods = client.list_snapshot(Pod)
+    assert pods == []
+
+
+# ---- PlacementSnapshot ----
+
+def _fleet_client(chips=64):
+    client = Client(Store())
+    build_fleet(client, chips)
+    return client
+
+
+def test_snapshot_gang_index_matches_selector_list():
+    client = _fleet_client()
+    make_workload(client, 64, seed=1)
+    snap = PlacementSnapshot(client, None, {"slice": c.NODE_LABEL_SLICE},
+                             incremental=True)
+    for gang in client.list(PodGang):
+        want = [p.meta.name for p in client.list(
+            Pod, selector={c.LABEL_PODGANG_NAME: gang.meta.name})]
+        got = [p.meta.name for p in snap.gang_pods(gang)]
+        assert got == want
+
+
+def test_snapshot_survives_own_writes_rebuilds_on_outside_write():
+    client = _fleet_client()
+    client.create(_pod("w0", gang="g0"))
+    snap = PlacementSnapshot(client, None, {"slice": c.NODE_LABEL_SLICE},
+                             incremental=True)
+    host = snap.hosts[0]
+    free0 = host.free_chips
+
+    # An "own" write: bind the pod, account it, count it.
+    from grove_tpu.api.serde import clone
+    bound = clone(snap.gang_pods(
+        PodGang(meta=new_meta("g0")))[0])
+    bound.status.node_name = host.name
+    assert client.update_status_many([bound]) == [None]
+    snap.note_own_writes(1)
+    snap.note_bound(bound)
+    assert host.free_chips == free0 - 4
+    assert snap.index.free_in("host", host.name) == free0 - 4
+
+    snap.refresh_if_moved()
+    assert snap.rebuilds == 0, "own counted writes must not force rebuild"
+    # The in-place view already matches the store.
+    assert snap.gang_pods(PodGang(meta=new_meta("g0")))[0] \
+        .status.node_name == host.name
+
+    # An outside write moves the world -> full rebuild.
+    client.create(_pod("intruder"))
+    snap.refresh_if_moved()
+    assert snap.rebuilds == 1
+    assert any(p.meta.name == "intruder" for p in snap.pods)
+
+
+def test_gang_index_survives_mid_pass_rebuild():
+    """The pass-lifetime gang index must NOT be wiped by a mid-pass
+    rebuild: spread penalties going blind for the rest of the pass was
+    exactly how PCS replicas ended up stacked on one slice."""
+    client = _fleet_client()
+    snap = PlacementSnapshot(client, None, {"slice": c.NODE_LABEL_SLICE},
+                             incremental=True)
+    gang = PodGang(meta=new_meta("g0", labels={c.LABEL_PCS_NAME: "svc"}))
+    snap.index_gangs([gang])
+    client.create(_pod("outside"))  # outside write -> rebuild
+    snap.refresh_if_moved()
+    assert snap.rebuilds == 1
+    assert snap.pcs_siblings("default", "svc") == [gang]
+
+
+def test_non_incremental_mode_always_rebuilds():
+    client = _fleet_client()
+    snap = PlacementSnapshot(client, None, {"slice": c.NODE_LABEL_SLICE},
+                             incremental=False)
+    snap.refresh_if_moved()
+    snap.refresh_if_moved()
+    assert snap.rebuilds == 2
+
+
+# ---- DomainIndex / planner equivalence ----
+
+def _rand_hosts(rng, n_slices=4, workers=3):
+    return [HostView(f"s{s}-w{w}", rng.choice([0, 2, 4, 8]),
+                     {"slice": f"s{s}", "pool": "p0"},
+                     {"acc": rng.choice(["a", "b"])})
+            for s in range(n_slices) for w in range(workers)]
+
+
+def test_plan_gang_identical_with_and_without_domain_index():
+    import random
+    rng = random.Random(3)
+    prev = os.environ.get("GROVE_NATIVE_PLACEMENT")
+    os.environ["GROVE_NATIVE_PLACEMENT"] = "0"  # exercise the Python body
+    try:
+        for _ in range(200):
+            hosts = _rand_hosts(rng)
+            pods = [PodRequest(f"p{i}", rng.choice([0, 1, 2, 4]),
+                               {"acc": "a"} if rng.random() < 0.2 else {})
+                    for i in range(rng.randint(1, 8))]
+            required = rng.random() < 0.7
+            penalty = {f"s{s}": 2.0 for s in range(4)
+                       if rng.random() < 0.3}
+            idx = DomainIndex(hosts, ["pool", "slice"])
+            plain = plan_gang(pods, hosts, required=required,
+                              spread_penalty=penalty)
+            indexed = plan_gang(pods, hosts, required=required,
+                                spread_penalty=penalty, domain_index=idx)
+            assert (plain is None) == (indexed is None)
+            if plain is not None:
+                assert indexed.assignments == plain.assignments
+                assert indexed.score == plain.score
+                assert indexed.slice_name == plain.slice_name
+    finally:
+        if prev is None:
+            os.environ.pop("GROVE_NATIVE_PLACEMENT", None)
+        else:
+            os.environ["GROVE_NATIVE_PLACEMENT"] = prev
+
+
+def test_domain_index_deduct_keeps_totals_coherent():
+    hosts = [HostView(f"h{i}", 4, {"slice": "s0"}) for i in range(3)]
+    idx = DomainIndex(hosts, ["slice"])
+    assert idx.free_in("slice", "s0") == 12
+    idx.deduct(hosts[1], 3)
+    assert hosts[1].free_chips == 1
+    assert idx.free_in("slice", "s0") == 9
+    assert idx.free_in("host", "h1") == 1
+
+
+# ---- the headline: snapshot pass vs per-gang rebuild ----
+
+def test_snapshot_pass_beats_per_gang_rebuild_5x():
+    """256-chip fleet, 64 slice-atomic gangs of 4 one-chip pods
+    (deterministic seeds): the snapshot pass must place the whole
+    workload >=5x faster wall-clock than the pre-snapshot shape
+    (per-gang selector lists + full host-view rebuild after every
+    placed gang, the GROVE_SCHED_INCREMENTAL=0 path). Best-of-3 per
+    mode to shrug off CI noise; both modes place every pod."""
+    # Interleave the modes so a machine-load spike lands on both, and
+    # take best-of-N per mode.
+    def measure(reps):
+        walls = {True: [], False: []}
+        for seed in range(reps):
+            for incremental in (True, False):
+                r = run_once(256, seed, incremental, uniform=4,
+                             chips_per_pod=1)
+                assert r["unplaced_pods"] == 0, r
+                assert r["gangs"] == 64, r
+                walls[incremental].append(r["wall_s"])
+        fast, slow = min(walls[True]), min(walls[False])
+        assert fast > 0
+        return slow / fast, fast, slow
+
+    speedup, fast, slow = measure(3)
+    if speedup < 5.0:
+        # One retry with more reps: a loaded CI host can land a pause
+        # in every run of a short first batch; a genuine regression
+        # stays below the bar either way.
+        speedup, fast, slow = measure(5)
+    assert speedup >= 5.0, (
+        f"snapshot pass only {speedup:.1f}x faster "
+        f"({fast * 1e3:.1f} ms vs {slow * 1e3:.1f} ms)")
+
+
+def test_bench_sched_emits_nonzero_rows(tmp_path, monkeypatch):
+    """The bench tool's row for a small fleet is well-formed and
+    nonzero — the first real numbers for the BASELINE's schedule-p50
+    metric, independent of the TPU relay."""
+    from tools import bench_sched
+    row = bench_sched.bench_fleet(16, reps=2)
+    assert row["metric"] == "podgang_schedule_p50_ms"
+    assert row["value"] > 0
+    assert row["p99_ms"] >= row["value"]
+    assert row["unplaced_pods"] == 0
+    assert row["chips"] == 16

@@ -94,6 +94,41 @@ def test_continuous_batching_recycles_lanes():
     assert all(len(r.generated) == 4 for r in eng.completed)
 
 
+def test_release_lane_frees_and_reuses_lane():
+    """The public retire API (what the disagg bench drives turnover
+    with): retiring a tracked lane completes its request, zeroes the
+    KV row, and the lane accepts a fresh insert; releasing a free lane
+    is a no-op."""
+    params = _params()
+    pw = PrefillWorker(CFG, params, batch=2, max_prompt=16)
+    eng = DecodeEngine(CFG, params, batch=2)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, CFG.vocab_size, size=5) for _ in range(2)]
+    results = pw.prefill(prompts)
+    for lane, res in enumerate(results):
+        eng.insert(lane, res)
+    eng.run(3)
+    req = eng.release_lane(0)
+    assert req is None  # untracked lane: nothing to complete
+    assert eng.free_lanes() == [0]
+    assert int(np.asarray(eng.cache.lengths)[0]) == 0
+    eng.insert(0, results[0])  # freed lane accepts a fresh splice
+    assert eng.free_lanes() == []
+    # Tracked lane: the retired Request is returned, marked done.
+    eng2 = DecodeEngine(CFG, params, batch=1, host_sync_interval=8)
+    rid = eng2.submit(prompts[0], max_new_tokens=32)
+    eng2.admit_from_queue(pw)
+    for _ in range(3):
+        eng2.step()  # 3 windows pending, not yet drained
+    req = eng2.release_lane(0)
+    assert req is not None and req.done and req.rid == rid
+    assert eng2.completed and eng2.completed[-1] is req
+    # Pending windows drained into the retiring request (prefill token
+    # + 3 decoded) — retirement must not lose already-decoded tokens.
+    assert len(req.generated) == 4, req.generated
+    assert eng2.release_lane(0) is None  # idempotent on a free lane
+
+
 def test_admit_prompts_tracked_requests_complete():
     """admit_prompts(max_new_tokens=...) runs real bookkeeping: windowed
     drains record tokens and complete lanes at the budget."""

@@ -1,0 +1,250 @@
+"""CPU-only PodGang schedule benchmark — the BASELINE's second metric.
+
+``BASELINE.json`` names "PodGang schedule p50 1->256 chips" as half the
+north-star, but every relay-driven bench row so far is 0.0 (the TPU
+relay is flaky and the schedule path never needed a chip anyway: it is
+pure control plane). This tool measures it directly: synthetic fake
+fleets at 1/16/64/256 chips, mixed gang sizes with slice-atomic +
+spread constraints, driven through the REAL ``GangBackend._place_pass``
+against a real in-process Store — no relay, no JAX, deterministic.
+
+Per fleet size it reports schedule latency per gang (pass wall time /
+gangs placed) as p50/p99 over repeated runs, and appends one JSON row
+per fleet to ``bench-history/history.jsonl`` (GROVE_BENCH_HISTORY=0
+disables), the same committed perf record bench.py feeds.
+
+``--compare`` additionally times the pre-snapshot pass shape (per-gang
+selector lists + full re-list after every placed gang — the
+GROVE_SCHED_INCREMENTAL=0 path) and prints the speedup.
+
+Usage:
+    python tools/bench_sched.py            # all fleets, append history
+    python tools/bench_sched.py --chips 256 --compare --no-history
+    make bench-sched
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grove_tpu.api import Pod, PodGang, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodSpec
+from grove_tpu.api.podcliqueset import TopologyConstraint
+from grove_tpu.api.podgang import PodGangSpec, PodGroup
+from grove_tpu.scheduler.backends import GangBackend, PlacementSnapshot
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec, create_fleet
+
+CHIPS_PER_HOST = 4  # v5e host
+
+
+def build_fleet(client: Client, chips: int) -> None:
+    """Fake v5e fleet totalling ``chips`` chips (4/host, 4 hosts/slice
+    above one slice's worth; a 1-chip fleet is one 1-chip host)."""
+    if chips < CHIPS_PER_HOST:
+        # Sub-host fleet: one host with the odd chip count (the 1-chip
+        # point of the 1->256 sweep). create_fleet only speaks whole
+        # topologies, so build the node directly.
+        from grove_tpu.topology.fleet import build_node
+        node = build_node("v5e", "1x1", "pool-0-slice-0", 0)
+        node.spec.tpu_chips = chips
+        node.status.allocatable_chips = chips
+        client.create(node)
+        return
+    hosts = chips // CHIPS_PER_HOST
+    hosts_per_slice = min(4, hosts)
+    topology = {1: "2x2", 2: "2x4", 4: "4x4"}[hosts_per_slice]
+    create_fleet(client, FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology=topology,
+                  count=hosts // hosts_per_slice)]))
+
+
+def make_workload(client: Client, chips: int, seed: int = 0,
+                  uniform: int | None = None,
+                  chips_per_pod: int | None = None) -> tuple[int, int]:
+    """Gangs + pods filling the fleet: mixed sizes (1/2/4-pod gangs,
+    slice-atomic), every 4th gang carrying a PCS spread label.
+    Deterministic in ``seed``. ``uniform`` forces every gang to that
+    many pods; ``chips_per_pod`` overrides the default host-sized pod
+    (the 256-chip/64-gang acceptance shape is uniform=4 gangs of 4
+    one-chip pods — multi-pod gangs are the representative case, and
+    pod count is the N in the O(gangs x pods) cost the snapshot
+    removes).
+
+    Gangs are created largest-first: with demand == capacity and
+    tightest-fit scoring that order is always fully placeable (4s take
+    empty slices, 2s pair up, 1s fill), so the timed passes measure
+    scheduling, not fragmentation stalls.
+
+    Returns (gangs, pods)."""
+    import random
+    rng = random.Random(seed)
+    chips_per_pod = chips_per_pod or min(chips, CHIPS_PER_HOST)
+    total_pods = max(1, chips // chips_per_pod)
+    if uniform:
+        sizes = [uniform] * (total_pods // uniform)
+    else:
+        sizes = []
+        left = total_pods
+        while left:
+            n = min(left, rng.choice([1, 1, 2, 4]))
+            sizes.append(n)
+            left -= n
+        sizes.sort(reverse=True)
+    n_gangs = len(sizes)
+    for gi, n_pods in enumerate(sizes):
+        gname = f"bench-gang-{gi}"
+        pod_names = [f"{gname}-p-{i}" for i in range(n_pods)]
+        labels = {}
+        if gi % 4 == 0 and n_gangs > 2:
+            labels[c.LABEL_PCS_NAME] = f"bench-pcs-{gi % 8}"
+        client.create(PodGang(
+            meta=new_meta(gname, labels=labels),
+            spec=PodGangSpec(
+                groups=[PodGroup(name="g0", pod_names=pod_names,
+                                 min_replicas=n_pods)],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True))))
+        for pn in pod_names:
+            client.create(Pod(
+                meta=new_meta(pn, labels={c.LABEL_PODGANG_NAME: gname,
+                                          **labels}),
+                spec=PodSpec(tpu_chips=chips_per_pod,
+                             container=ContainerSpec(argv=["x"]))))
+    return n_gangs, total_pods
+
+
+def new_backend(client: Client) -> GangBackend:
+    backend = GangBackend()
+    backend.init(client, {})
+    return backend
+
+
+def run_once(chips: int, seed: int, incremental: bool,
+             uniform: int | None = None,
+             chips_per_pod: int | None = None) -> dict:
+    """One timed schedule of a fresh fleet+workload. Creation, backend
+    init, and the placed-yet checks are outside the timed region; the
+    timed region is the place passes themselves (steady state: one)."""
+    prev = os.environ.get("GROVE_SCHED_INCREMENTAL")
+    os.environ["GROVE_SCHED_INCREMENTAL"] = "1" if incremental else "0"
+    try:
+        client = Client(Store())
+        build_fleet(client, chips)
+        n_gangs, n_pods = make_workload(client, chips, seed, uniform,
+                                        chips_per_pod)
+        backend = new_backend(client)
+        wall = 0.0
+        passes = 0
+        while passes < 5:
+            t0 = time.perf_counter()
+            backend._place_pass()
+            wall += time.perf_counter() - t0
+            passes += 1
+            if all(p.status.node_name for p in client.list(Pod)):
+                break
+        unplaced = sum(1 for p in client.list(Pod)
+                       if not p.status.node_name)
+    finally:
+        if prev is None:
+            os.environ.pop("GROVE_SCHED_INCREMENTAL", None)
+        else:
+            os.environ["GROVE_SCHED_INCREMENTAL"] = prev
+    return {"wall_s": wall, "gangs": n_gangs, "pods": n_pods,
+            "passes": passes, "unplaced_pods": unplaced,
+            "per_gang_ms": wall / n_gangs * 1e3}
+
+
+def bench_fleet(chips: int, reps: int, incremental: bool = True) -> dict:
+    samples = [run_once(chips, seed, incremental) for seed in range(reps)]
+    per_gang = sorted(s["per_gang_ms"] for s in samples)
+    q = statistics.quantiles(per_gang, n=100, method="inclusive") \
+        if len(per_gang) > 1 else per_gang * 2
+    row = {
+        "metric": "podgang_schedule_p50_ms",
+        "value": round(statistics.median(per_gang), 4),
+        "unit": "ms/gang",
+        "chips": chips,
+        "gangs": samples[0]["gangs"],
+        "pods": samples[0]["pods"],
+        "p99_ms": round(q[98] if len(per_gang) > 1 else per_gang[0], 4),
+        "pass_wall_ms": round(statistics.median(
+            s["wall_s"] for s in samples) * 1e3, 3),
+        "reps": reps,
+        "unplaced_pods": samples[0]["unplaced_pods"],
+        "incremental": incremental,
+        "mode": "sched-cpu",
+    }
+    return row
+
+
+def append_history(record: dict) -> None:
+    """Append to bench-history/history.jsonl with git label + timestamp
+    (mirrors bench.py's committed perf record; GROVE_BENCH_HISTORY=0
+    disables)."""
+    if os.environ.get("GROVE_BENCH_HISTORY", "1") == "0":
+        return
+    import subprocess
+    from datetime import datetime, timezone
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        git = subprocess.run(
+            ["git", "-C", root, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        git = "unknown"
+    row = {"ts": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+           "git": git or "unknown", **record}
+    path = os.path.join(root, "bench-history")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "history.jsonl"), "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--chips", type=int, nargs="*",
+                    default=[1, 16, 64, 256],
+                    help="fleet sizes in chips (default: 1 16 64 256)")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="timed repetitions per fleet (fresh store each)")
+    ap.add_argument("--compare", action="store_true",
+                    help="also time the pre-snapshot (per-gang rebuild) "
+                         "pass and print the speedup")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append to bench-history/")
+    args = ap.parse_args()
+    if args.no_history:
+        os.environ["GROVE_BENCH_HISTORY"] = "0"
+
+    for chips in args.chips:
+        row = bench_fleet(chips, args.reps, incremental=True)
+        line = (f"chips={chips:4d} gangs={row['gangs']:3d} "
+                f"p50={row['value']:.3f} ms/gang "
+                f"p99={row['p99_ms']:.3f} ms/gang "
+                f"pass={row['pass_wall_ms']:.1f} ms")
+        if args.compare:
+            legacy = bench_fleet(chips, args.reps, incremental=False)
+            row["legacy_p50_ms"] = legacy["value"]
+            row["speedup"] = round(
+                legacy["value"] / row["value"], 2) if row["value"] else 0.0
+            line += (f"  legacy_p50={legacy['value']:.3f} "
+                     f"speedup={row['speedup']:.1f}x")
+        print(line, flush=True)
+        if row["unplaced_pods"]:
+            print(f"  WARNING: {row['unplaced_pods']} pods unplaced",
+                  flush=True)
+        append_history(row)
+
+
+if __name__ == "__main__":
+    main()
