@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched docs native lint clean ci render-deploy
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile docs native lint clean ci render-deploy
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -42,6 +42,12 @@ bench-sched:     ## PodGang schedule p50/p99, 1->256-chip fleets (CPU only)
 	@# Appends rows to bench-history/history.jsonl.
 	$(PY) tools/bench_sched.py --compare
 
+bench-reconcile: ## controller reconcile p50/p99 + store-scan counts (CPU only)
+	@# The informer layer's proof: 1->256-pod fleets driven through the
+	@# real reconcilers, informer cache vs GROVE_INFORMER=0 direct reads.
+	@# Appends reconcile_p50_ms rows to bench-history/history.jsonl.
+	$(PY) tools/bench_reconcile.py --compare
+
 bench-disagg:    ## PrefillWorker->DecodeEngine KV hand-off seam (real TPU)
 	@# More compiles than the headline bench (one-shot + chunked
 	@# prefill + two engines): widen the per-attempt watchdog.
@@ -65,6 +71,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@#  conftest tier plugin): a green-but-slow suite fails the gate,
 	@#  so wall time cannot silently creep past the 10-minute guidance.
 	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
+	@# bench-reconcile harness smoke (1-pod shape, no history): catches
+	@# harness rot without paying the full sweep; the informer tests
+	@# themselves run in the core tier below.
+	$(PY) tools/bench_reconcile.py --pods 1 --reps 1 --no-history
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

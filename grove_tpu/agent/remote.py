@@ -91,23 +91,21 @@ class RemoteAgent:
 
     def _watch_loop(self) -> None:
         """Consume the wire event feed; any Pod/PodClique event wakes the
-        kubelet (it re-lists, so coarse filtering is enough). On gaps or
-        transport errors, back off and bootstrap a fresh watch — the
-        kubelet's fallback tick covers the blind window."""
-        from grove_tpu.store.httpclient import WatchGoneError
-        while not self._stop.is_set():
-            try:
-                for _seq, _etype, _obj in self.client.watch_events(
-                        kinds=["Pod", "PodClique"], namespace=None,
-                        poll_timeout=20.0):
-                    self._wake.set()
-                    if self._stop.is_set():
-                        return
-            except WatchGoneError:
-                self._wake.set()  # force a prompt re-list pass
-            except GroveError as e:
-                self.log.warning("watch feed error: %s; retrying", e)
-            self._stop.wait(1.0)
+        kubelet (it re-lists, so coarse filtering is enough). Gaps and
+        transport errors are absorbed by the shared relist-and-resume
+        helper — a history-ring gap forces a prompt re-list pass (the
+        kubelet IS this consumer's cache) instead of crashing the
+        agent; the fallback tick covers any blind window."""
+        from grove_tpu.store.httpclient import resumable_watch_events
+        for _seq, _etype, _obj in resumable_watch_events(
+                self.client, kinds=["Pod", "PodClique"], namespace=None,
+                poll_timeout=20.0, stop=self._stop,
+                on_gap=self._wake.set,
+                on_error=lambda e: self.log.warning(
+                    "watch feed error: %s; retrying", e)):
+            self._wake.set()
+            if self._stop.is_set():
+                return
 
     def ensure_node(self) -> None:
         try:

@@ -290,8 +290,17 @@ def _http(server: str, path: str, method: str = "GET",
             return resp.status, decode(resp.read(),
                                        resp.headers.get("Content-Type", ""))
     except urllib.error.HTTPError as e:
-        # Error bodies may be non-JSON (proxy, wrong service on the port).
-        return e.code, decode(e.read(),
+        # Error bodies may be non-JSON (proxy, wrong service on the port),
+        # and a loaded server can reset (ConnectionResetError) or
+        # close the socket short of Content-Length (IncompleteRead, an
+        # HTTPException) mid-body — the status code is already in hand
+        # either way.
+        import http.client as _hc
+        try:
+            raw = e.read()
+        except (OSError, _hc.HTTPException):
+            raw = b""
+        return e.code, decode(raw,
                               e.headers.get("Content-Type", "") or "json")
     except urllib.error.URLError as e:
         return 0, {"error": f"cannot reach {server}: {e.reason}"}

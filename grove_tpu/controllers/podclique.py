@@ -358,34 +358,49 @@ class PodCliqueReconciler:
 
     # ---- gate removal (reference syncflow.go:254-427) ----
 
+    def _gang_shared(self, name: str, namespace: str) -> PodGang | None:
+        """Read-only gang lookup through the shared informer cache when
+        the client carries one (gate checks only inspect conditions —
+        no reason to pay a clone per reconcile); direct get otherwise."""
+        lister = getattr(self.client, "lister", None)
+        if lister is not None:
+            lst = lister(PodGang)
+            if lst is not None:
+                return lst.get(name, namespace)
+        try:
+            return self.client.get(PodGang, name, namespace)
+        except NotFoundError:
+            return None
+
     def _remove_gates_if_unblocked(self, pclq: PodClique, pods: list[Pod],
                                    gang_name: str) -> None:
         gated = [p for p in pods if c.GATE_PODGANG_PENDING in
                  p.spec.scheduling_gates]
         if not gated:
             return
-        try:
-            gang = self.client.get(PodGang, gang_name, pclq.meta.namespace)
-        except NotFoundError:
+        gang = self._gang_shared(gang_name, pclq.meta.namespace)
+        if gang is None:
             return  # gang not created yet: stay gated
         if not is_condition_true(gang.status.conditions, c.COND_INITIALIZED):
             return  # not all gang pods exist yet
         if gang.spec.base_gang:
             # scaled gang: wait for the base gang to be placed first so
             # scaled capacity can never starve the base gang
-            try:
-                base = self.client.get(PodGang, gang.spec.base_gang,
-                                       pclq.meta.namespace)
-            except NotFoundError:
+            base = self._gang_shared(gang.spec.base_gang,
+                                     pclq.meta.namespace)
+            if base is None:
                 return
             if not is_condition_true(base.status.conditions, c.COND_SCHEDULED):
                 return
         for pod in gated:
-            pod.spec.scheduling_gates = [
-                g for g in pod.spec.scheduling_gates
+            # Listed objects are shared informer-cache state: clone
+            # before editing (the list_snapshot contract).
+            ungated = clone(pod)
+            ungated.spec.scheduling_gates = [
+                g for g in ungated.spec.scheduling_gates
                 if g != c.GATE_PODGANG_PENDING]
             try:
-                self.client.update(pod)
+                self.client.update(ungated)
             except GroveError:
                 pass  # retried on next event
 

@@ -21,7 +21,7 @@ from grove_tpu.api import (
 from grove_tpu.api import constants as c
 from grove_tpu.api.core import Service
 from grove_tpu.api.meta import Condition, is_condition_true, set_condition
-from grove_tpu.api.serde import to_dict
+from grove_tpu.api.serde import clone as serde_clone
 from grove_tpu.controllers import expected as exp
 from grove_tpu.controllers import replica_lifecycle as lifecycle
 from grove_tpu.runtime.concurrent import run_concurrently
@@ -266,9 +266,16 @@ class PodCliqueSetReconciler:
                         # replicas are owned by the autoscaler once the
                         # child exists; never stomp them from the template
                         obj.spec.replicas = cur.spec.replicas
-                    if to_dict(cur.spec) != to_dict(obj.spec):
-                        cur.spec = obj.spec
-                        self.client.update(cur)
+                    # Dataclass equality, not to_dict round-trips: the
+                    # same drift decision at a fraction of the cost (the
+                    # update_status no-op check's argument) — this
+                    # comparison runs for EVERY child on EVERY sync.
+                    if cur.spec != obj.spec:
+                        # cur is shared informer-cache state: clone
+                        # before grafting the expected spec onto it.
+                        fresh = serde_clone(cur)
+                        fresh.spec = obj.spec
+                        self.client.update(fresh)
             except GroveError as e:
                 errors.append(e)
         # prune: children no longer in the expected set (scale-in, template
@@ -299,10 +306,12 @@ class PodCliqueSetReconciler:
                 or _ready(p.status.conditions, c.COND_READY))
             publish = hs.publish_not_ready_addresses
             if eps != svc.endpoints or svc.publish_not_ready != publish:
-                svc.endpoints = eps
-                svc.publish_not_ready = publish  # follow template edits
+                # svc is shared informer-cache state: clone before edit.
+                fresh = serde_clone(svc)
+                fresh.endpoints = eps
+                fresh.publish_not_ready = publish  # follow template edits
                 try:
-                    self.client.update(svc)
+                    self.client.update(fresh)
                 except GroveError:
                     pass
 
@@ -317,13 +326,22 @@ class PodCliqueSetReconciler:
         pclqs = self.client.list(PodClique, pcs.meta.namespace, selector)
         pcsgs = self.client.list(PodCliqueScalingGroup, pcs.meta.namespace,
                                  selector)
+        # Group children by replica once: the per-replica listcomp shape
+        # was O(replicas x children) — a measurable quadratic term in
+        # every status sync at fleet scale (64 replicas x 64+ cliques).
+        pclqs_by_r: dict[str, list] = {}
+        for q in pclqs:
+            if not q.spec.pcsg_name:
+                pclqs_by_r.setdefault(
+                    q.meta.labels.get(c.LABEL_PCS_REPLICA, ""), []).append(q)
+        pcsgs_by_r: dict[str, list] = {}
+        for g in pcsgs:
+            pcsgs_by_r.setdefault(
+                g.meta.labels.get(c.LABEL_PCS_REPLICA, ""), []).append(g)
         available = 0
         for r in range(pcs.spec.replicas):
-            replica_pclqs = [q for q in pclqs
-                             if q.meta.labels.get(c.LABEL_PCS_REPLICA) == str(r)
-                             and not q.spec.pcsg_name]
-            replica_pcsgs = [g for g in pcsgs
-                             if g.meta.labels.get(c.LABEL_PCS_REPLICA) == str(r)]
+            replica_pclqs = pclqs_by_r.get(str(r), [])
+            replica_pcsgs = pcsgs_by_r.get(str(r), [])
             breached = any(
                 is_condition_true(q.status.conditions,
                                   c.COND_MIN_AVAILABLE_BREACHED)

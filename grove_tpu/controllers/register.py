@@ -35,10 +35,19 @@ def _label_requests(label: str):
 
 def register_controllers(mgr: Manager) -> Registry:
     cfg = mgr.config
+    # Schedulers keep the direct client: their read path is the
+    # placement snapshot (PR 1), which shares the same per-version
+    # clones the informer caches do.
     registry = build_registry(cfg, mgr.client)
+    # Controllers and their event mappers read through the shared
+    # informer caches: list-shaped reads become indexed lookups over
+    # shared objects instead of per-call store scans. Writes (and point
+    # gets) stay on the direct path. GROVE_INFORMER=0 restores direct
+    # lists without rewiring anything.
+    client = mgr.cached_client
 
-    pcs = PodCliqueSetReconciler(mgr.client)
-    pcs_ctrl = Controller("podcliqueset", mgr.client, pcs.reconcile,
+    pcs = PodCliqueSetReconciler(client)
+    pcs_ctrl = Controller("podcliqueset", client, pcs.reconcile,
                           workers=cfg.concurrency.podcliqueset,
                           backoff_base=cfg.requeue_base_seconds,
                           backoff_max=cfg.requeue_max_seconds)
@@ -47,8 +56,8 @@ def register_controllers(mgr: Manager) -> Registry:
                       "Service"], _label_requests(c.LABEL_PCS_NAME))
     mgr.add_controller(pcs_ctrl)
 
-    pclq = PodCliqueReconciler(mgr.client, registry)
-    pclq_ctrl = Controller("podclique", mgr.client, pclq.reconcile,
+    pclq = PodCliqueReconciler(client, registry)
+    pclq_ctrl = Controller("podclique", client, pclq.reconcile,
                            workers=cfg.concurrency.podclique,
                            backoff_base=cfg.requeue_base_seconds,
                            backoff_max=cfg.requeue_max_seconds)
@@ -63,14 +72,14 @@ def register_controllers(mgr: Manager) -> Registry:
         if not pcs_name:
             return []
         from grove_tpu.api import PodClique
-        return [Request(ns, q.meta.name) for q in mgr.client.list(
+        return [Request(ns, q.meta.name) for q in client.list(
             PodClique, ns, selector={c.LABEL_PCS_NAME: pcs_name})]
 
     pclq_ctrl.watches(["PodGang"], gang_to_pclqs)
     mgr.add_controller(pclq_ctrl)
 
-    pcsg = ScalingGroupReconciler(mgr.client)
-    pcsg_ctrl = Controller("podcliquescalinggroup", mgr.client, pcsg.reconcile,
+    pcsg = ScalingGroupReconciler(client)
+    pcsg_ctrl = Controller("podcliquescalinggroup", client, pcsg.reconcile,
                            workers=cfg.concurrency.podcliquescalinggroup,
                            backoff_base=cfg.requeue_base_seconds,
                            backoff_max=cfg.requeue_max_seconds)
@@ -78,8 +87,8 @@ def register_controllers(mgr: Manager) -> Registry:
     pcsg_ctrl.watches(["PodClique"], _label_requests(c.LABEL_PCSG_NAME))
     mgr.add_controller(pcsg_ctrl)
 
-    gang = PodGangReconciler(mgr.client, registry)
-    gang_ctrl = Controller("podgang", mgr.client, gang.reconcile,
+    gang = PodGangReconciler(client, registry)
+    gang_ctrl = Controller("podgang", client, gang.reconcile,
                            workers=cfg.concurrency.podgang,
                            backoff_base=cfg.requeue_base_seconds,
                            backoff_max=cfg.requeue_max_seconds)
@@ -87,8 +96,8 @@ def register_controllers(mgr: Manager) -> Registry:
     mgr.add_controller(gang_ctrl)
 
     from grove_tpu.controllers.reservation import SliceReservationReconciler
-    rsv = SliceReservationReconciler(mgr.client)
-    rsv_ctrl = Controller("slicereservation", mgr.client, rsv.reconcile,
+    rsv = SliceReservationReconciler(client)
+    rsv_ctrl = Controller("slicereservation", client, rsv.reconcile,
                           workers=1,
                           backoff_base=cfg.requeue_base_seconds,
                           backoff_max=cfg.requeue_max_seconds)
@@ -112,7 +121,7 @@ def register_controllers(mgr: Manager) -> Registry:
             if node_shape.get(key) == shape:
                 return []                      # heartbeat-only churn
             node_shape[key] = shape
-        reqs = [Request(ns, r.meta.name) for r in mgr.client.list(
+        reqs = [Request(ns, r.meta.name) for r in client.list(
             SliceReservation, ns)]
         if reqs:
             return reqs
@@ -134,8 +143,8 @@ def register_controllers(mgr: Manager) -> Registry:
             ensure_default_topology,
         )
         ensure_default_topology(mgr.client)  # startup pre-sync
-        ct = ClusterTopologyReconciler(mgr.client, registry)
-        ct_ctrl = Controller("clustertopology", mgr.client, ct.reconcile,
+        ct = ClusterTopologyReconciler(client, registry)
+        ct_ctrl = Controller("clustertopology", client, ct.reconcile,
                              workers=cfg.concurrency.clustertopology,
                              backoff_base=cfg.requeue_base_seconds,
                              backoff_max=cfg.requeue_max_seconds)

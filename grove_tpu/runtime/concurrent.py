@@ -4,27 +4,78 @@ Role parity with reference internal/utils/concurrent.go:70-104
 (RunConcurrently[WithSlowStart|WithBounds]): component sync fans out many
 store mutations; batches double in size (1, 2, 4, ...) so one systemic
 failure surfaces after O(log n) attempts instead of n.
+
+Tasks run on ONE process-wide executor instead of a fresh
+ThreadPoolExecutor per call: reconcile-path profiling showed executor
+construction/teardown (thread spawn + join per batch) dominating pod
+fan-out at fleet scale — hundreds of OS threads created and destroyed
+per deploy for tasks that are store mutations serialized by the store
+lock anyway. The pool is lazy, daemon-threaded, and bounded; a single
+task (or a task already running ON the pool — nesting must never wait
+on its own workers) runs inline.
 """
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
+
+# Sized for the worst in-tree fan-in: one cluster runs ~12 reconcile
+# workers that may each park a pod-creation batch here; tasks are
+# GIL-bound store mutations, so extra threads cost memory, not cores.
+_POOL_WORKERS = 32
+_pool_lock = threading.Lock()
+_pool: ThreadPoolExecutor | None = None
+_in_pool = threading.local()
+
+
+def _shared_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=_POOL_WORKERS,
+                                       thread_name_prefix="grove-sync")
+        return _pool
 
 
 def run_concurrently(tasks: Sequence[Callable[[], None]],
                      max_workers: int = 8) -> list[Exception]:
-    """Run all tasks; return the list of raised exceptions (empty == ok)."""
+    """Run all tasks; return the list of raised exceptions (empty == ok).
+
+    ``max_workers`` is kept for signature parity; concurrency is bounded
+    by the shared pool (``_POOL_WORKERS``) across ALL callers, which is
+    the global bound that matters.
+    """
     errors: list[Exception] = []
     if not tasks:
         return errors
-    with ThreadPoolExecutor(max_workers=min(max_workers, len(tasks))) as ex:
-        futures = [ex.submit(t) for t in tasks]
-        for f in futures:
+    if len(tasks) <= 2 or getattr(_in_pool, "active", False):
+        # Inline: a 1-2 task fan-out (component-sync pairs, the first
+        # slow-start batches) gains nothing from a pool hop — the store
+        # lock serializes the mutations anyway — and a task already on
+        # the pool must not block waiting for pool capacity it may
+        # itself be occupying (the nested-submit deadlock).
+        for t in tasks:
             try:
-                f.result()
+                t()
             except Exception as e:  # noqa: BLE001 - collected, not swallowed
                 errors.append(e)
+        return errors
+
+    def wrapped(task: Callable[[], None]) -> None:
+        _in_pool.active = True
+        try:
+            task()
+        finally:
+            _in_pool.active = False
+
+    futures = [_shared_pool().submit(wrapped, t) for t in tasks]
+    for f in futures:
+        try:
+            f.result()
+        except Exception as e:  # noqa: BLE001 - collected, not swallowed
+            errors.append(e)
     return errors
 
 

@@ -5,6 +5,13 @@ Role parity with controller-runtime as used by the reference (SURVEY.md
 events through mapper functions; N worker threads pop requests and call
 the reconcile function; failures requeue with exponential backoff; a
 StepResult can ask for a delayed requeue.
+
+Read path: the ``client`` a controller is registered with is the
+manager's ``CachedClient`` (runtime/informer.py), so both the startup
+``_resync`` list and every list a reconciler issues inside ``_process``
+are indexed lookups over the shared per-kind informer caches instead of
+store scans; ``GROVE_INFORMER=0`` restores direct reads. Listed objects
+are shared cache state — reconcilers clone before mutating them.
 """
 
 from __future__ import annotations
@@ -218,6 +225,9 @@ class Controller:
             if kind_cls is None:
                 continue
             try:
+                # Through the shared informer cache when the client is
+                # the manager's CachedClient: the warm-up list seeds the
+                # kind's informer once; later resyncs are index reads.
                 objs = self.client.list(kind_cls, namespace=None,
                                         selector=selector)
             except Exception:  # noqa: BLE001 - best-effort warm-up

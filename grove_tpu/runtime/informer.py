@@ -1,0 +1,546 @@
+"""Informer layer: shared watch-driven caches + indexed listers.
+
+Role parity with client-go's SharedInformerFactory as the reference
+operator uses it (SURVEY.md §1 L2): the apiserver is LISTed once per
+kind, a reflector applies the watch stream to an in-memory cache, and
+every controller read is an indexed cache lookup — reconcile never
+re-LISTs the store on the hot path.
+
+This framework's twist is that the store is (usually) in-process, so
+the reflector can be *pull-on-read*: every cached read first drains the
+store's event ring from the informer's cursor (``Store.replay`` — the
+same resumable machinery the wire watch uses), which makes the cache
+exactly as fresh as the store at read time. Read-your-own-write is
+therefore structural: a reconcile that just wrote pulls its own event
+before the next read, no barrier dance required. Over the wire there is
+no synchronous pull; a ``Reflector`` thread pushes events from
+``HttpClient.watch_events`` (with the shared relist-and-resume helper)
+and readers that need the barrier call ``Informer.wait_for_rv``.
+
+Cache objects are SHARED, like ``Store.list_snapshot`` output (they are
+the same per-version clones, plus the event-ring clones): callers must
+not mutate them — ``clone()`` before editing, exactly the scheduler
+snapshot's contract. A history-ring gap (local overflow or wire
+``WatchGoneError``) re-seeds the cache with a full relist instead of
+failing the consumer.
+
+``GROVE_INFORMER=0`` restores direct store reads in ``CachedClient``
+(the escape hatch; see docs/design/informer-cache.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import (
+    EventType,
+    Store,
+    matches_fields,
+    matches_labels,
+)
+
+INFORMER_ENV = "GROVE_INFORMER"
+
+# Kinds that never enter the shared cache. Secrets carry credentials:
+# the store's authorization chain decides per-actor visibility on every
+# read, and a shared cache would be a side channel around it.
+UNCACHED_KINDS = frozenset({"Secret"})
+
+
+def informer_enabled() -> bool:
+    """Read the escape hatch per call: flipping GROVE_INFORMER=0 at any
+    point (tests, incident mitigation) restores direct-list reads
+    without rebuilding clients."""
+    return os.environ.get(INFORMER_ENV, "1") != "0"
+
+
+class LocalStoreSource:
+    """Pull transport over the in-process store: the event history ring
+    IS the watch stream (same seqs, same 410-gone semantics as the wire
+    long-poll), and a relist is one shared-clone ``list_snapshot``."""
+
+    can_pull = True
+
+    def __init__(self, store: Store):
+        self._store = store
+
+    def relist(self, kind_cls: type) -> tuple[int, list[Any]]:
+        return self._store.list_snapshot(kind_cls, namespace=None)
+
+    def pull(self, kind: str, since: int):
+        return self._store.replay(since, kinds={kind})
+
+    def tip(self) -> int:
+        """Highest seq currently in the event ring, read WITHOUT the
+        store lock (deque append is atomic; a racing write is caught by
+        the caller's next sync — and never by a reader that issued the
+        write itself, since emit precedes the write's return). Lets the
+        every-read sync skip the locked replay when nothing happened."""
+        h = self._store._history
+        return h[-1][0] if h else 0
+
+
+class WireSource:
+    """Relist transport over HTTP for push-fed informers. The rv is
+    fetched BEFORE the list: any write landing between the two is
+    replayed by the resuming watch and deduped by the per-object rv
+    guard in ``Informer._apply_locked`` (listing first would instead
+    lose writes that land between list and rv fetch)."""
+
+    can_pull = False
+
+    def __init__(self, http: Any):
+        self._http = http
+
+    def relist(self, kind_cls: type) -> tuple[int, list[Any]]:
+        rv = self._http.current_rv()
+        return rv, self._http.list(kind_cls, namespace=None)
+
+
+class Lister:
+    """Indexed read views over one informer's cache.
+
+    Every method syncs the informer first (free for push-fed informers)
+    and returns SHARED objects — the ``list_snapshot`` contract: do not
+    mutate; ``clone()`` before editing.
+    """
+
+    def __init__(self, informer: "Informer"):
+        self._inf = informer
+
+    def get(self, name: str, namespace: str = "default") -> Any | None:
+        self._inf.sync()
+        with self._inf._lock:
+            return self._inf._objects.get((namespace, name))
+
+    def list(self, namespace: str | None = None,
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
+        """Store-list semantics (namespace/label/field filters, sorted
+        by name) served from the cache; a label selector resolves
+        through the label index instead of scanning every object."""
+        self._inf.sync()
+        with self._inf._lock:
+            if selector:
+                refs = self._inf._label_candidates(selector)
+                # A single-pair selector IS the index key: the posting
+                # list already guarantees the match (the hottest list
+                # shape — pods of one clique — skips re-verification).
+                verify = len(selector) > 1
+            else:
+                refs = self._inf._objects.values()
+                verify = False
+            out = [o for o in refs
+                   if (namespace is None or o.meta.namespace == namespace)
+                   and (not verify or matches_labels(o, selector))
+                   and (fields is None or matches_fields(o, fields))]
+        out.sort(key=lambda o: o.meta.name)
+        return out
+
+    def by_label(self, selector: dict[str, str],
+                 namespace: str | None = None) -> list[Any]:
+        return self.list(namespace, selector)
+
+    def by_owner(self, namespace: str, owner_ref: Any) -> list[Any]:
+        """Objects whose ``meta.owner_references`` include the given
+        owner (an OwnerReference, or a ``(kind, name)`` pair) in
+        ``namespace`` — the controller-owned-children lookup, without
+        the linear scan."""
+        kind = getattr(owner_ref, "kind", None)
+        name = getattr(owner_ref, "name", None)
+        if kind is None:
+            kind, name = owner_ref
+        self._inf.sync()
+        with self._inf._lock:
+            keys = self._inf._by_owner.get((namespace, kind, name), ())
+            out = [self._inf._objects[k] for k in keys
+                   if k in self._inf._objects]
+        out.sort(key=lambda o: o.meta.name)
+        return out
+
+
+class Informer:
+    """One kind's watch cache: seeded by a relist at a resource version,
+    kept current by the event stream, indexed by label pair and owner
+    reference. Shared by every controller in a manager (one per kind)."""
+
+    def __init__(self, kind_cls: type, source: Any):
+        self.kind_cls = kind_cls
+        self.KIND: str = kind_cls.KIND
+        self._source = source
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._objects: dict[tuple[str, str], Any] = {}
+        # (label_key, label_value) -> object keys; (ns, kind, name) of
+        # an owner reference -> object keys. Maintained incrementally
+        # per event — a lookup never rescans the cache.
+        self._by_label: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        self._by_owner: dict[tuple[str, str, str], set[tuple[str, str]]] = {}
+        self.rv = 0            # last seq observed (seed rv or event seq)
+        self.relists = 0
+        self.events_applied = 0
+        self._seeded = False
+        self._lister = Lister(self)   # one shared view; Lister is stateless
+        self.log = get_logger(f"informer.{self.KIND}")
+
+    # ---- freshness ----
+
+    def sync(self) -> None:
+        """Drain pending events from a pull source (no-op for push-fed
+        informers — their Reflector thread is the writer). Seeds on
+        first use; a cursor that fell off the history ring relists."""
+        if self._seeded and (not self._source.can_pull
+                             or self._source.tip() <= self.rv):
+            return
+        lags: list[float] = []
+        count = None
+        with self._lock:
+            if not self._seeded:
+                self._relist_locked("seed")
+                count = len(self._objects)
+            if self._source.can_pull:
+                events, ok, scanned = self._source.pull(self.KIND, self.rv)
+                if not ok:
+                    self._relist_locked("gap")
+                    count = len(self._objects)
+                else:
+                    for _seq, ev in events:
+                        self._apply_locked(ev.type, ev.obj, ev.ts, lags)
+                    if scanned > self.rv:
+                        self.rv = scanned
+                    if events:
+                        count = len(self._objects)
+        self._export(lags, count)
+
+    def apply_event(self, seq: int, etype: Any, obj: Any,
+                    ts: float = 0.0) -> None:
+        """Push one watch event into the cache (the wire Reflector's
+        entry point). Stale seqs after a reseed are absorbed by the
+        per-object rv guard; the cursor never moves backwards."""
+        if isinstance(etype, str):
+            etype = EventType(etype)
+        lags: list[float] = []
+        with self._cond:
+            self._apply_locked(etype, obj, ts, lags)
+            if seq > self.rv:
+                self.rv = seq
+            count = len(self._objects)
+            self._cond.notify_all()
+        self._export(lags, count)
+
+    def relist_now(self, reason: str = "gap") -> int:
+        """Force a full reseed (the wire gap path: missed events are
+        unrecoverable, so derived state must be rebuilt from a list).
+        Returns the reseed's rv — the Reflector resumes its watch there
+        so the reseed-to-resume window is replayed, not skipped."""
+        with self._cond:
+            self._relist_locked(reason)
+            count = len(self._objects)
+            rv = self.rv
+            self._cond.notify_all()
+        self._export([], count)
+        return rv
+
+    def wait_for_rv(self, rv: int, timeout: float = 5.0) -> bool:
+        """Read-your-own-write barrier: block until the cache observed
+        events through ``rv``. Pull-fed informers satisfy it
+        synchronously (sync() drains to the store's current rv)."""
+        if self._source.can_pull:
+            self.sync()
+            return self.rv >= rv
+        with self._cond:
+            return self._cond.wait_for(lambda: self.rv >= rv, timeout)
+
+    # ---- cache mutation (callers hold the lock) ----
+
+    def _relist_locked(self, reason: str) -> None:
+        rv, objs = self._source.relist(self.kind_cls)
+        self._objects = {(o.meta.namespace, o.meta.name): o for o in objs}
+        self._by_label = {}
+        self._by_owner = {}
+        for key, obj in self._objects.items():
+            self._index_locked(key, obj)
+        if rv > self.rv:
+            self.rv = rv
+        self._seeded = True
+        self.relists += 1
+        GLOBAL_METRICS.inc("grove_informer_relists_total",
+                           kind=self.KIND, reason=reason)
+
+    def _apply_locked(self, etype: EventType, obj: Any, ts: float,
+                      lags: list[float]) -> None:
+        key = (obj.meta.namespace, obj.meta.name)
+        old = self._objects.get(key)
+        if etype is EventType.DELETED:
+            if old is not None:
+                self._unindex_locked(key, old)
+                del self._objects[key]
+        else:
+            # rv guard: a relist may have seeded a newer version than a
+            # still-in-flight (or replay-overlapped) event carries.
+            if old is not None and \
+                    old.meta.resource_version >= obj.meta.resource_version:
+                return
+            if old is not None:
+                self._unindex_locked(key, old)
+            self._objects[key] = obj
+            self._index_locked(key, obj)
+        self.events_applied += 1
+        if ts > 0.0:
+            lags.append(max(0.0, time.time() - ts))
+
+    def _index_locked(self, key: tuple[str, str], obj: Any) -> None:
+        for pair in obj.meta.labels.items():
+            self._by_label.setdefault(pair, set()).add(key)
+        for ref in obj.meta.owner_references:
+            self._by_owner.setdefault(
+                (obj.meta.namespace, ref.kind, ref.name), set()).add(key)
+
+    def _unindex_locked(self, key: tuple[str, str], obj: Any) -> None:
+        for pair in obj.meta.labels.items():
+            keys = self._by_label.get(pair)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_label[pair]
+        for ref in obj.meta.owner_references:
+            okey = (obj.meta.namespace, ref.kind, ref.name)
+            keys = self._by_owner.get(okey)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_owner[okey]
+
+    def _label_candidates(self, selector: dict[str, str]) -> list[Any]:
+        """Smallest posting list among the selector's pairs (full match
+        is re-verified by the caller — intersection for free)."""
+        best: set[tuple[str, str]] | None = None
+        for pair in selector.items():
+            keys = self._by_label.get(pair)
+            if keys is None:
+                return []
+            if best is None or len(keys) < len(best):
+                best = keys
+        return [self._objects[k] for k in (best or ())]
+
+    # ---- observability ----
+
+    def _export(self, lags: list[float], count: int | None) -> None:
+        # Outside the informer lock: the metrics hub's global lock is
+        # held across every /metrics render (see _DelayQueue.get).
+        for lag in lags:
+            GLOBAL_METRICS.observe("grove_informer_event_lag_seconds",
+                                   lag, kind=self.KIND)
+        if count is not None:
+            GLOBAL_METRICS.set("grove_informer_cache_objects", count,
+                               kind=self.KIND)
+
+    def lister(self) -> Lister:
+        return self._lister
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class InformerSet:
+    """Per-kind informers over one source, created lazily and shared by
+    every consumer in the manager (the SharedInformerFactory role)."""
+
+    def __init__(self, store: Store | None = None, source: Any = None):
+        assert (store is None) != (source is None), \
+            "pass exactly one of store/source"
+        self._source = source if source is not None \
+            else LocalStoreSource(store)
+        self._lock = threading.Lock()
+        self._informers: dict[str, Informer] = {}
+
+    def ensure(self, kind_cls: type) -> Informer:
+        with self._lock:
+            inf = self._informers.get(kind_cls.KIND)
+            if inf is None:
+                inf = self._informers[kind_cls.KIND] = \
+                    Informer(kind_cls, self._source)
+            return inf
+
+    def for_read(self, kind_cls: type) -> Informer | None:
+        """The informer serving cached reads for ``kind_cls`` — None for
+        kinds that must stay on the direct (per-read authorized) path."""
+        if kind_cls.KIND in UNCACHED_KINDS:
+            return None
+        return self.ensure(kind_cls)
+
+    def get(self, kind: str) -> Informer | None:
+        with self._lock:
+            return self._informers.get(kind)
+
+    def lister(self, kind_cls: type) -> Lister | None:
+        inf = self.for_read(kind_cls)
+        return inf.lister() if inf is not None else None
+
+    def informers(self) -> list[Informer]:
+        with self._lock:
+            return list(self._informers.values())
+
+
+class CachedClient(Client):
+    """A ``Client`` whose list-shaped reads come from the shared
+    informer caches: one indexed lookup over shared objects instead of
+    a per-call store scan with per-object deserialization.
+
+    Contract changes vs ``Client``:
+    - ``list`` returns SHARED objects (the ``list_snapshot`` contract):
+      callers must ``clone()`` before mutating. Reconcilers that edit
+      a listed object clone first (see controllers/*).
+    - ``get`` and every write stay on the direct store path — a point
+      get is already O(1) through the store's per-version bytes cache,
+      and writes must see first-writer-wins conflicts immediately.
+
+    Staleness guard: every write records its resource version in a
+    client-wide barrier; a later cached read first waits for the
+    informer to observe events through that rv
+    (``Informer.wait_for_rv``). The barrier is shared, not per-thread:
+    reconcilers fan writes out through the shared task pool
+    (run_with_slow_start), so the thread that wrote is routinely not
+    the thread that re-reads. Pull-fed informers satisfy the barrier
+    synchronously — the read's own sync drains the ring past the write
+    — so the wait only ever blocks on push-fed (wire) caches; a barrier
+    that times out there is logged loudly rather than silently serving
+    a stale read.
+
+    With ``GROVE_INFORMER=0`` every read falls back to the direct
+    store path (bit-identical behavior, measured by the reconcile
+    equivalence test).
+    """
+
+    def __init__(self, inner: Client, informers: InformerSet):
+        super().__init__(inner._store, inner.actor)
+        self.informers = informers
+        self._barrier_lock = threading.Lock()
+        self._barrier_rv = 0
+        self.log = get_logger("cachedclient")
+
+    # ---- rv barrier ----
+
+    def _record_write(self, obj: Any) -> Any:
+        with self._barrier_lock:
+            if obj.meta.resource_version > self._barrier_rv:
+                self._barrier_rv = obj.meta.resource_version
+        return obj
+
+    def create(self, obj: Any) -> Any:
+        return self._record_write(super().create(obj))
+
+    def update(self, obj: Any) -> Any:
+        return self._record_write(super().update(obj))
+
+    def update_status(self, obj: Any) -> Any:
+        return self._record_write(super().update_status(obj))
+
+    def patch_status(self, kind_cls: type, name: str, patch: dict,
+                     namespace: str = "default") -> Any:
+        return self._record_write(
+            super().patch_status(kind_cls, name, patch, namespace))
+
+    def delete(self, kind_cls: type, name: str,
+               namespace: str = "default") -> None:
+        super().delete(kind_cls, name, namespace)
+        # delete returns nothing; the store's current rv bounds the
+        # cascade's seqs, so it is a safe (if generous) barrier.
+        rv = self._store.current_rv()
+        with self._barrier_lock:
+            if rv > self._barrier_rv:
+                self._barrier_rv = rv
+
+    # ---- reads ----
+
+    def list(self, kind_cls: type, namespace: str | None = "default",
+             selector: dict[str, str] | None = None,
+             fields: dict[str, str] | None = None) -> list[Any]:
+        inf = self.informers.for_read(kind_cls) if informer_enabled() \
+            else None
+        if inf is None:
+            return super().list(kind_cls, namespace, selector, fields)
+        GLOBAL_METRICS.inc("grove_informer_cache_reads_total",
+                           kind=kind_cls.KIND)
+        if not inf._source.can_pull:
+            # Push-fed cache: block until it observed our writes. A
+            # pull-fed cache satisfies the barrier inside the read's
+            # own sync (it drains the ring past every prior write).
+            if not inf.wait_for_rv(self._barrier_rv):
+                # Proceeding on a stale cache is sometimes the right
+                # availability call (kube informers are eventually
+                # consistent too) but never a silent one.
+                self.log.warning(
+                    "informer %s missed rv barrier %d (cache at %d); "
+                    "serving a possibly-stale list", kind_cls.KIND,
+                    self._barrier_rv, inf.rv)
+        return inf.lister().list(namespace, selector, fields)
+
+    def lister(self, kind_cls: type) -> Lister | None:
+        """Direct index access (``by_owner``/``by_label``) for consumers
+        that want more than list semantics; None when the informer path
+        is disabled so callers can fall back explicitly."""
+        if not informer_enabled():
+            return None
+        return self.informers.lister(kind_cls)
+
+    def impersonate(self, actor: str) -> "CachedClient":
+        out = CachedClient(Client(self._store, actor), self.informers)
+        return out
+
+
+class Reflector:
+    """Push driver for one wire-fed informer: seeds it with a relist,
+    then applies ``HttpClient.watch_events`` through the shared
+    relist-and-resume helper — a history-ring gap (410 Gone) re-seeds
+    the cache instead of killing the thread."""
+
+    def __init__(self, informer: Informer, http: Any,
+                 poll_timeout: float = 10.0):
+        self.informer = informer
+        self.http = http
+        self.poll_timeout = poll_timeout
+        self.log = get_logger(f"reflector.{informer.KIND}")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._seed_rv: int | None = None  # set by start()'s relist
+
+    def start(self) -> None:
+        # Anchor the first watch at the seed's rv: writes landing
+        # between the seed list and the watch connecting are replayed,
+        # not silently skipped (the same contract the gap path honors).
+        self._seed_rv = self.informer.relist_now("seed")
+        self._thread = threading.Thread(
+            target=self._run, name=f"reflector-{self.informer.KIND}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # The thread blocks in a long poll; it is a daemon and the
+        # server unblocks it at poll timeout.
+
+    def _run(self) -> None:
+        from grove_tpu.store.httpclient import resumable_watch_events
+        for seq, etype, obj, ts in resumable_watch_events(
+                self.http, kinds=[self.informer.KIND], namespace=None,
+                poll_timeout=self.poll_timeout, stop=self._stop,
+                on_gap=lambda: self.informer.relist_now("gap"),
+                on_error=lambda e: self.log.warning(
+                    "watch feed error: %s; retrying", e),
+                with_ts=True, since=self._seed_rv):
+            self.informer.apply_event(seq, etype, obj, ts)
+
+
+def wire_informer(http: Any, kind_cls: type,
+                  poll_timeout: float = 10.0) -> tuple[Informer, Reflector]:
+    """Convenience: a wire-fed informer + its reflector (not started)."""
+    inf = Informer(kind_cls, WireSource(http))
+    return inf, Reflector(inf, http, poll_timeout)

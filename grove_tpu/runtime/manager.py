@@ -16,6 +16,7 @@ from typing import Any
 
 from grove_tpu.api.config import OperatorConfiguration, validate_config
 from grove_tpu.runtime.controller import Controller
+from grove_tpu.runtime.informer import CachedClient, InformerSet
 from grove_tpu.runtime.logger import get_logger, setup_logging
 from grove_tpu.store.client import Client
 from grove_tpu.store.store import Store
@@ -31,6 +32,14 @@ class Manager:
         setup_logging(self.config.log.level, self.config.log.format)
         self.store = store or Store()
         self.client = client or Client(self.store)
+        # Shared informer layer (one watch cache per kind, shared by
+        # every controller in this manager — the SharedInformerFactory
+        # role); controllers read through cached_client, everything
+        # else (agents, schedulers, user surfaces) keeps the direct
+        # client. GROVE_INFORMER=0 routes cached reads back to the
+        # store per call.
+        self.informers = InformerSet(store=self.store)
+        self.cached_client = CachedClient(self.client, self.informers)
         self.log = get_logger("manager")
         self.controllers: list[Controller] = []
         self.runnables: list[Any] = []   # agents etc. with start()/stop()

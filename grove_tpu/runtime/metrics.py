@@ -242,3 +242,25 @@ GLOBAL_METRICS.describe(
     "grove_sched_snapshot_rebuilds_total",
     "Placement-snapshot full rebuilds forced by outside writers "
     "mid-pass (incremental accounting covered every other bind)")
+GLOBAL_METRICS.describe(
+    "grove_informer_cache_objects",
+    "Objects in the shared informer cache per kind")
+GLOBAL_METRICS.describe(
+    "grove_informer_cache_reads_total",
+    "List reads served from the informer cache per kind (the direct "
+    "store path is the complement: grove_informer_relists_total plus "
+    "whatever GROVE_INFORMER=0 sends around the cache)")
+GLOBAL_METRICS.describe(
+    "grove_informer_relists_total",
+    "Full cache reseeds per kind and reason (seed=first use, "
+    "gap=history ring no longer covered the cursor)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_informer_event_lag_seconds",
+    "Delay from event emission to informer cache application "
+    "(pull-fed informers apply at read time, so this is also the "
+    "staleness a cached read repaired)",
+    # Pinned sub-millisecond-to-seconds buckets: informer lag at
+    # steady state is micro-to-milliseconds; the default duration
+    # buckets would flatten everything into the first bucket.
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0, 2.5))

@@ -589,9 +589,12 @@ class ApiServer:
                     # soon as unrelated churn wraps the ring.
                     since = scanned
                     if events or _time.time() >= deadline:
+                        # ts: emission wall time (Event.ts) — lets wire
+                        # consumers compute event lag the same way the
+                        # local informers do.
                         frags = (
                             f'{{"seq": {seq}, "type": "{ev.type.value}", '
-                            f'"kind": "{ev.obj.KIND}", '
+                            f'"kind": "{ev.obj.KIND}", "ts": {ev.ts!r}, '
                             f'"object": {render_event_obj(ev.obj)}}}'
                             for seq, ev in events)
                         raw = (f'{{"rv": {since}, "events": '

@@ -201,11 +201,15 @@ class HttpClient:
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,
                      since: int | None = None,
-                     poll_timeout: float = 25.0):
+                     poll_timeout: float = 25.0,
+                     with_ts: bool = False):
         """Blocking generator of (seq, type_str, obj) from the server's
-        event feed. ``since=None`` bootstraps at the current rv (only
-        NEW events flow). Raises WatchGoneError when the server's
-        history no longer covers the resume point."""
+        event feed — (seq, type_str, obj, emit_ts) with ``with_ts``
+        (wire informers feed the event-lag histogram from it; emit_ts
+        is 0.0 against servers that predate the field). ``since=None``
+        bootstraps at the current rv (only NEW events flow). Raises
+        WatchGoneError when the server's history no longer covers the
+        resume point."""
         from grove_tpu.manifest import KIND_REGISTRY
 
         if since is None:
@@ -226,5 +230,86 @@ class HttpClient:
                 cls = KIND_REGISTRY.get(ev["kind"])
                 if cls is None:
                     continue
-                yield ev["seq"], ev["type"], from_dict(cls, ev["object"])
+                obj = from_dict(cls, ev["object"])
+                if with_ts:
+                    yield (ev["seq"], ev["type"], obj,
+                           float(ev.get("ts", 0.0)))
+                else:
+                    yield ev["seq"], ev["type"], obj
             since = resp["rv"]
+
+
+def resumable_watch_events(client: HttpClient,
+                           kinds: list[str] | None = None,
+                           namespace: str | None = None,
+                           selector: dict[str, str] | None = None,
+                           poll_timeout: float = 25.0,
+                           on_gap=None,
+                           on_error=None,
+                           stop=None,
+                           retry_wait: float = 1.0,
+                           with_ts: bool = False,
+                           since: int | None = None):
+    """``watch_events`` that never dies: the shared relist-and-resume
+    loop every wire watch consumer needs (remote agents, wire
+    informers, the relay).
+
+    - A history-ring gap (``WatchGoneError``) calls ``on_gap()`` — the
+      consumer must re-seed whatever it derives from the stream (re-list
+      a cache, wake a re-listing kubelet) because the missed events are
+      unrecoverable. If ``on_gap`` returns an int, the watch resumes
+      from that seq (return the re-list's rv and the reseed-to-resume
+      window is covered by replay — no blind gap); otherwise it
+      re-bootstraps at the server's current rv.
+    - Transport errors call ``on_error(exc)`` (log it there) and retry
+      after ``retry_wait`` seconds.
+    - ``stop`` (a threading.Event) ends the generator; it is also used
+      for interruptible retry sleeps, so a stopping consumer never
+      blocks on the backoff.
+
+    ``since`` anchors the FIRST watch (pass the seed list's rv so
+    writes landing between that list and the watch connecting are
+    replayed, not skipped — the same no-blind-window contract the gap
+    path honors); None bootstraps at the server's current rv.
+
+    Yields exactly what ``watch_events`` does — (seq, type_str, obj),
+    or with the emit timestamp appended under ``with_ts``.
+    """
+    import time as _time
+
+    while stop is None or not stop.is_set():
+        try:
+            for item in client.watch_events(
+                    kinds, namespace, selector, since=since,
+                    poll_timeout=poll_timeout, with_ts=with_ts):
+                yield item
+                since = item[0]
+                if stop is not None and stop.is_set():
+                    return
+            return  # watch_events only returns on its own when exhausted
+        except WatchGoneError:
+            # The resume point predates the server's ring: events were
+            # lost for good. Re-seed derived state; a reseed that
+            # reports its rv anchors the resume there (covering the
+            # reseed-to-resume window), else restart at the current rv
+            # (since=None bootstraps).
+            since = None
+            if on_gap is not None:
+                resumed = on_gap()
+                if isinstance(resumed, int):
+                    since = resumed
+            # A persistent gap (churn outruns the server's ring every
+            # round trip) must not spin full relists at line rate
+            # against an already-loaded server: pace the resume like
+            # any other retry.
+            if stop is not None:
+                stop.wait(retry_wait)
+            else:
+                _time.sleep(retry_wait)
+        except GroveError as e:
+            if on_error is not None:
+                on_error(e)
+            if stop is not None:
+                stop.wait(retry_wait)
+            else:
+                _time.sleep(retry_wait)

@@ -41,6 +41,10 @@ class EventType(str, enum.Enum):
 class Event(NamedTuple):
     type: EventType
     obj: Any
+    # Emission wall time, stamped by Store._emit. Informers observe
+    # apply-time minus this as grove_informer_event_lag_seconds; 0.0
+    # means "unknown" (synthetic events built by tests/resync mappers).
+    ts: float = 0.0
 
 
 def _key(obj: Any) -> tuple[str, str]:
@@ -126,6 +130,11 @@ class Store:
         # resource version, eviction with the object (_remove).
         self._snapshot_cache: dict[tuple[str, str, str],
                                    tuple[int, Any]] = {}
+        # Read-path observability: every list-shaped read that scans a
+        # kind's object dict counts here (list + list_snapshot). The
+        # reconcile bench asserts the informer path's scan reduction
+        # from this counter, not from private controller state.
+        self.list_scans = 0
         # Event history ring for resumable (wire) watches: (seq, event).
         # seq is the rv that produced the event (deletes allocate one).
         # A watcher further behind than the ring must relist (410-Gone
@@ -207,7 +216,7 @@ class Store:
         # payloads are read-only by convention (mappers extract
         # names/labels; reconcilers re-read through the client, never
         # mutate event objects).
-        shared = Event(etype, clone(obj))
+        shared = Event(etype, clone(obj), time.time())
         self._history.append(
             (obj.meta.resource_version if seq is None else seq, shared))
         for w in self._watchers:
@@ -248,6 +257,11 @@ class Store:
         (e.g. a persistent store freshly rebooted)."""
         with self._lock:
             if self._history:
+                # Fast path for caught-up cursors: informers sync on
+                # every cached read, so "nothing new" must not pay the
+                # islice skip-walk over the whole ring.
+                if self._history[-1][0] <= since:
+                    return [], True, since
                 if since + 1 < self._history[0][0]:
                     return [], False, since
             elif since < self._peek_rv():
@@ -337,6 +351,7 @@ class Store:
         the consumer detect outside writes (``current_rv() != rv``) and
         decide when its derived state needs a rebuild."""
         with self._lock:
+            self.list_scans += 1
             rv = self._peek_rv()
             objs = self._objects.get(kind_cls.KIND, {})
             refs = [obj for (ns, _), obj in objs.items()
@@ -358,6 +373,7 @@ class Store:
              selector: dict[str, str] | None = None,
              fields: dict[str, str] | None = None) -> list[Any]:
         with self._lock:
+            self.list_scans += 1
             objs = self._objects.get(kind_cls.KIND, {})
             refs = [obj for (ns, _), obj in objs.items()
                     if (namespace is None or ns == namespace)
@@ -377,6 +393,22 @@ class Store:
             if key in objs:
                 raise AlreadyExistsError(f"{kind} {key[0]}/{key[1]} exists")
             stored = self._admit("create", clone(obj), None, actor)
+            # Liveness check for controller owners: a create that races
+            # its parent's cascade delete (reconciler read the parent,
+            # cascade removed it, create lands after) would otherwise
+            # insert a permanent orphan — nothing GCs an object whose
+            # owner uid no longer exists. Creates and cascades both run
+            # under this lock, so the check is exact, not best-effort.
+            for ref in stored.meta.owner_references:
+                if not ref.controller or not ref.uid:
+                    continue
+                owner = self._objects.get(ref.kind, {}).get(
+                    (stored.meta.namespace, ref.name))
+                if owner is None or owner.meta.uid != ref.uid:
+                    raise NotFoundError(
+                        f"owner {ref.kind} {stored.meta.namespace}/"
+                        f"{ref.name} (uid {ref.uid}) is gone; refusing "
+                        f"to create orphan {kind} {key[1]}")
             if not stored.meta.uid:
                 stored.meta.uid = str(uuid.uuid4())
             if not stored.meta.creation_timestamp:
@@ -429,7 +461,13 @@ class Store:
         at steady state.
         """
         with self._lock:
-            return clone(self._update_status_locked(obj, actor))
+            stored = self._update_status_locked(obj, actor)
+        # Return through the per-version bytes cache instead of a fresh
+        # dumps+loads: every reconcile ends in a status write, and at
+        # steady state the write is a suppressed no-op whose return
+        # clone dominated the call (for real writes this also pre-warms
+        # the new version's bytes for every subsequent reader).
+        return self._read_clone(stored)
 
     def _update_status_locked(self, obj: Any, actor: str) -> Any:
         """Single source of truth for status-write semantics (shared by the
@@ -471,8 +509,9 @@ class Store:
         what keeps a fleet of wire agents from conflict-looping against
         controllers that also write the same objects' status."""
         with self._lock:
-            return clone(self._patch_status_locked(kind_cls, name, patch,
-                                                   namespace, actor))
+            stored = self._patch_status_locked(kind_cls, name, patch,
+                                               namespace, actor)
+        return self._read_clone(stored)  # as update_status: cached bytes
 
     def _patch_status_locked(self, kind_cls: type, name: str, patch: dict,
                              namespace: str, actor: str) -> Any:

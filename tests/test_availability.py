@@ -250,9 +250,18 @@ def test_rolling_update(cluster):
     old_hash = client.get(PodCliqueSet, "roll").status.generation_hash
     old_slice = client.get(PodGang, "roll-0").status.assigned_slice
 
-    live = client.get(PodCliqueSet, "roll")
-    live.spec.template.cliques[0].container.env["VERSION"] = "v2"
-    client.update(live)
+    # Conflict-retried spec edit: the PCS controller writes status on
+    # its own cadence, so a bare get-mutate-update races it (the same
+    # optimistic-concurrency dance client.patch automates).
+    from grove_tpu.runtime.errors import ConflictError
+    for _ in range(10):
+        live = client.get(PodCliqueSet, "roll")
+        live.spec.template.cliques[0].container.env["VERSION"] = "v2"
+        try:
+            client.update(live)
+            break
+        except ConflictError:
+            continue
 
     def updated():
         s = client.get(PodCliqueSet, "roll")

@@ -1,0 +1,456 @@
+"""Informer layer: the shared watch cache and its indexed listers, the
+CachedClient read path (equivalence with direct store lists, escape
+hatch, rv barrier), relist-and-resume on history-ring gaps, _DelayQueue
+workqueue semantics, and the headline benchmark — the informer-backed
+reconcile path must issue >=10x fewer store scans and sweep a converged
+256-pod/64-gang fleet >=3x faster than GROVE_INFORMER=0
+(tools/bench_reconcile.py is the same harness)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from grove_tpu.api import Pod, PodClique, constants as c, new_meta
+from grove_tpu.api.core import PodPhase, PodSpec
+from grove_tpu.api.meta import Condition, OwnerReference, set_condition
+from grove_tpu.api.serde import to_dict
+from grove_tpu.runtime.controller import Request, _DelayQueue
+from grove_tpu.runtime.informer import (
+    CachedClient,
+    Informer,
+    InformerSet,
+    LocalStoreSource,
+)
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+
+from tools.bench_reconcile import run_once
+
+
+@pytest.fixture
+def cached():
+    store = Store()
+    client = CachedClient(Client(store), InformerSet(store=store))
+    return store, client
+
+
+def _pod(name, ns="default", labels=None, owner=None, phase=None):
+    p = Pod(meta=new_meta(name, namespace=ns, labels=labels),
+            spec=PodSpec(tpu_chips=1))
+    if owner:
+        p.meta.owner_references = [OwnerReference(
+            kind=owner[0], name=owner[1], uid=owner[2] if len(owner) > 2
+            else "u-" + owner[1])]
+    if phase is not None:
+        p.status.phase = phase
+    return p
+
+
+# ---- cache tracking + list equivalence ---------------------------------
+
+def test_informer_tracks_store_mutations(cached):
+    store, client = cached
+    client.create(_pod("a", labels={"role": "w"}))
+    assert [p.meta.name for p in client.list(Pod)] == ["a"]
+    live = client.get(Pod, "a")
+    live.status.node_name = "h0"
+    client.update_status(live)
+    assert client.list(Pod)[0].status.node_name == "h0"
+    client.delete(Pod, "a")
+    assert client.list(Pod) == []
+    inf = client.informers.get("Pod")
+    assert inf.rv == store.current_rv()
+
+
+def test_cached_list_matches_direct_list(cached):
+    store, client = cached
+    direct = Client(store)
+    for i in range(12):
+        client.create(_pod(
+            f"p{i}", ns="default" if i % 3 else "other",
+            labels={"g": str(i % 2), "b": str(i % 4)},
+            phase=PodPhase.RUNNING if i % 2 else PodPhase.PENDING))
+    cases = [
+        dict(namespace=None),
+        dict(namespace="default"),
+        dict(namespace="other"),
+        dict(namespace="default", selector={"g": "1"}),
+        dict(namespace=None, selector={"g": "0", "b": "2"}),
+        dict(namespace=None, selector={"g": "0", "b": "1"}),
+        dict(namespace=None, selector={"missing": "x"}),
+        dict(namespace=None, fields={"phase": "Running"}),
+        dict(namespace="default", selector={"g": "1"},
+             fields={"phase": "Running,Pending"}),
+    ]
+    for kw in cases:
+        want = [(o.meta.namespace, o.meta.name, o.meta.resource_version)
+                for o in direct.list(Pod, **kw)]
+        got = [(o.meta.namespace, o.meta.name, o.meta.resource_version)
+               for o in client.list(Pod, **kw)]
+        assert got == want, kw
+
+
+def test_by_owner_and_by_label_indexes(cached):
+    store, client = cached
+    owners = {f"q{j}": client.create(PodClique(meta=new_meta(f"q{j}")))
+              for j in range(2)}
+    for i in range(6):
+        parent = owners[f"q{i % 2}"]
+        client.create(_pod(f"p{i}", labels={c.LABEL_PCLQ_NAME: f"q{i % 2}"},
+                           owner=("PodClique", f"q{i % 2}",
+                                  parent.meta.uid)))
+    lister = client.lister(Pod)
+    owned = lister.by_owner("default", ("PodClique", "q1"))
+    assert [p.meta.name for p in owned] == ["p1", "p3", "p5"]
+    ref = OwnerReference(kind="PodClique", name="q0")
+    assert [p.meta.name for p in lister.by_owner("default", ref)] == \
+        ["p0", "p2", "p4"]
+    assert lister.by_owner("other", ("PodClique", "q0")) == []
+    # by_label mirrors the selector list; the index follows deletes.
+    assert [p.meta.name
+            for p in lister.by_label({c.LABEL_PCLQ_NAME: "q0"})] == \
+        ["p0", "p2", "p4"]
+    client.delete(Pod, "p3")
+    assert [p.meta.name
+            for p in lister.by_owner("default", ("PodClique", "q1"))] == \
+        ["p1", "p5"]
+
+
+def test_cached_objects_are_shared_until_version_moves(cached):
+    store, client = cached
+    client.create(_pod("a"))
+    first = client.list(Pod)[0]
+    assert client.list(Pod)[0] is first  # shared, zero-copy reads
+    live = client.get(Pod, "a")
+    live.status.node_name = "h1"
+    client.update_status(live)
+    third = client.list(Pod)[0]
+    assert third is not first
+    assert first.status.node_name == ""  # old snapshot untouched
+
+
+# ---- relist-and-resume + escape hatch ----------------------------------
+
+def test_relist_on_history_ring_gap(cached):
+    store, client = cached
+    client.create(_pod("keeper", labels={"g": "0"}))
+    client.list(Pod)  # seed
+    inf = client.informers.get("Pod")
+    relists0 = inf.relists
+    store._history = type(store._history)(maxlen=4)  # shrink the ring
+    for i in range(8):  # churn far past the ring
+        client.create(_pod(f"n{i}", labels={"g": "1"}))
+    names = [p.meta.name for p in client.list(Pod)]
+    assert names == sorted(["keeper"] + [f"n{i}" for i in range(8)])
+    assert inf.relists == relists0 + 1  # gap -> one reseed, not a crash
+    # Indexes rebuilt by the relist, not left stale.
+    assert len(client.list(Pod, selector={"g": "1"})) == 8
+
+
+def test_informer_escape_hatch_restores_direct_reads(cached):
+    store, client = cached
+    client.create(_pod("a"))
+    client.list(Pod)
+    scans0 = store.list_scans
+    client.list(Pod)
+    assert store.list_scans == scans0  # cached: no store scan
+    os.environ["GROVE_INFORMER"] = "0"
+    try:
+        assert [p.meta.name for p in client.list(Pod)] == ["a"]
+        assert store.list_scans == scans0 + 1  # direct scan again
+    finally:
+        os.environ.pop("GROVE_INFORMER", None)
+
+
+def test_push_fed_informer_rv_barrier():
+    """wait_for_rv blocks until a pushed event lands (the wire-informer
+    read-your-own-write barrier)."""
+
+    class PushOnly:
+        can_pull = False
+
+        def relist(self, kind_cls):
+            return 0, []
+
+    inf = Informer(Pod, PushOnly())
+    inf.relist_now("seed")
+    assert not inf.wait_for_rv(5, timeout=0.05)
+    t = threading.Timer(0.05, lambda: inf.apply_event(
+        5, "ADDED", _pod("late")))
+    t.start()
+    try:
+        assert inf.wait_for_rv(5, timeout=2.0)
+        assert inf.lister().get("late") is not None
+    finally:
+        t.cancel()
+
+
+def test_informer_metrics_exported(cached):
+    store, client = cached
+    client.create(_pod("a"))
+    client.list(Pod)
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS
+    text = GLOBAL_METRICS.render()
+    assert 'grove_informer_cache_objects{kind="Pod"}' in text
+    assert 'grove_informer_relists_total{kind="Pod",reason="seed"}' in text
+    assert 'grove_informer_cache_reads_total{kind="Pod"}' in text
+    assert "grove_informer_event_lag_seconds_bucket" in text
+
+
+def test_create_refuses_orphan_of_deleted_owner(cached):
+    """The cascade-race guard: a create landing after its controller
+    owner's cascade delete is rejected (under the same store lock the
+    cascade ran under) instead of leaking a permanently unowned
+    object."""
+    from grove_tpu.runtime.errors import NotFoundError
+
+    store, client = cached
+    pclq = client.create(PodClique(meta=new_meta("q")))
+    client.delete(PodClique, "q")
+    with pytest.raises(NotFoundError):
+        client.create(_pod("q-0", owner=("PodClique", "q",
+                                         pclq.meta.uid)))
+    # Same name, different incarnation: the stale uid is equally gone.
+    client.create(PodClique(meta=new_meta("q")))
+    with pytest.raises(NotFoundError):
+        client.create(_pod("q-0", owner=("PodClique", "q",
+                                         pclq.meta.uid)))
+    assert client.list(Pod) == []
+
+
+# ---- _DelayQueue workqueue semantics -----------------------------------
+
+def test_delay_queue_duplicate_enqueue_collapses():
+    q = _DelayQueue("t")
+    r = Request("default", "x")
+    q.add(r)
+    q.add(r)
+    q.add(r)
+    assert q.get(timeout=0.5) == r
+    assert q.get(timeout=0.05) is None  # delivered once
+    q.done(r)
+    assert q.get(timeout=0.05) is None  # not re-armed: never marked dirty
+
+
+def test_delay_queue_dirty_rearm_via_done():
+    q = _DelayQueue("t")
+    r = Request("default", "x")
+    q.add(r)
+    assert q.get(timeout=0.5) == r
+    q.add(r)  # re-added WHILE processing -> dirty
+    assert q.get(timeout=0.05) is None  # not delivered until done()
+    q.done(r)
+    assert q.get(timeout=0.5) == r  # re-armed exactly once
+    q.done(r)
+    assert q.get(timeout=0.05) is None
+
+
+def test_delay_queue_backoff_delay_honored():
+    q = _DelayQueue("t")
+    r = Request("default", "x")
+    t0 = time.time()
+    q.add(r, delay=0.25)
+    assert q.get(timeout=0.05) is None  # still serving its backoff
+    got = q.get(timeout=2.0)
+    assert got == r
+    assert time.time() - t0 >= 0.24
+
+
+def test_delay_queue_watch_event_accelerates_backoff():
+    q = _DelayQueue("t")
+    r = Request("default", "x")
+    q.add(r, delay=30.0)  # deep backoff
+    q.add(r)              # watch event: ready now
+    t0 = time.time()
+    assert q.get(timeout=1.0) == r
+    assert time.time() - t0 < 0.5
+
+
+# ---- reconcile equivalence + the pinned benchmark ----------------------
+
+_VOLATILE_KEYS = {"uid", "resource_version", "creation_timestamp",
+                  "deletion_timestamp", "last_transition_time",
+                  "heartbeat_time", "first_seen", "last_seen", "count",
+                  "message"}
+
+
+def _scrub(x):
+    if isinstance(x, dict):
+        return {k: _scrub(v) for k, v in x.items()
+                if k not in _VOLATILE_KEYS}
+    if isinstance(x, list):
+        return [_scrub(v) for v in x]
+    return x
+
+
+def _dump_store(store: Store) -> dict:
+    out = {}
+    for kind, objs in store._objects.items():
+        for (ns, name), obj in objs.items():
+            entry = {
+                "labels": dict(obj.meta.labels),
+                "finalizers": list(obj.meta.finalizers),
+                "owners": sorted((r.kind, r.name)
+                                 for r in obj.meta.owner_references),
+            }
+            if kind == "Secret":
+                entry["data_keys"] = sorted(obj.data)  # token is random
+            else:
+                if hasattr(obj, "spec"):
+                    entry["spec"] = _scrub(to_dict(obj.spec))
+                if hasattr(obj, "status"):
+                    entry["status"] = _scrub(to_dict(obj.status))
+            out[f"{kind}/{ns}/{name}"] = entry
+    return _scrub(out)
+
+
+def _drive_sequence(informer: bool) -> dict:
+    """One deterministic event sequence through the real reconcilers
+    (single-threaded driver, no kubelet/scheduler): deploy, readiness,
+    pod loss + self-heal, template edit + pod-level rolling update.
+    Returns the scrubbed final store state."""
+    from grove_tpu.api import PodCliqueSet
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+    )
+    from grove_tpu.controllers.podclique import PodCliqueReconciler
+    from grove_tpu.controllers.podcliqueset import PodCliqueSetReconciler
+    from grove_tpu.controllers.podgang import PodGangReconciler
+    from grove_tpu.controllers.scalinggroup import ScalingGroupReconciler
+    from grove_tpu.scheduler.registry import build_registry
+    from tools.bench_reconcile import drive_until_settled
+
+    prev = os.environ.get("GROVE_INFORMER")
+    os.environ["GROVE_INFORMER"] = "1" if informer else "0"
+    try:
+        store = Store()
+        base = Client(store)
+        client = CachedClient(base, InformerSet(store=store))
+        registry = build_registry(OperatorConfiguration(), base)
+        recs = {
+            "PodCliqueSet": PodCliqueSetReconciler(client),
+            "PodCliqueScalingGroup": ScalingGroupReconciler(client),
+            "PodClique": PodCliqueReconciler(client, registry),
+            "PodGang": PodGangReconciler(client, registry),
+        }
+        sink: list[float] = []
+
+        def settle():
+            drive_until_settled(store, recs, sink)
+
+        def mark_all_ready():
+            for pod in base.list(Pod, namespace=None):
+                live = base.get(Pod, pod.meta.name, pod.meta.namespace)
+                live.status.phase = PodPhase.RUNNING
+                live.status.conditions = set_condition(
+                    live.status.conditions,
+                    Condition(type=c.COND_READY, status="True",
+                              reason="test"))
+                base.update_status(live)
+
+        base.create(PodCliqueSet(
+            meta=new_meta("eq"),
+            spec=PodCliqueSetSpec(
+                replicas=2,
+                template=PodCliqueSetTemplate(cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, min_available=1,
+                    tpu_chips_per_pod=1,
+                    container=ContainerSpec(argv=["x"]))]))))
+        settle()
+        mark_all_ready()
+        settle()
+        # Pod loss: self-heal recreates the index.
+        victim = sorted(o.meta.name
+                        for o in base.list(Pod, namespace=None))[0]
+        base.delete(Pod, victim)
+        settle()
+        mark_all_ready()
+        settle()
+        # Template edit -> pod-level rolling update; drive it to the end
+        # by granting readiness between rounds (no kubelet here).
+        live = base.get(PodCliqueSet, "eq")
+        live.spec.template.cliques[0].container.argv = ["y"]
+        base.update(live)
+        for _ in range(24):
+            settle()
+            mark_all_ready()
+            target = base.get(PodCliqueSet, "eq").status.generation_hash
+            pods = base.list(Pod, namespace=None)
+            if pods and all(
+                    p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) == target
+                    for p in pods) \
+                    and base.get(PodCliqueSet,
+                                 "eq").status.rolling_update is None:
+                break
+        settle()
+        return _dump_store(store)
+    finally:
+        if prev is None:
+            os.environ.pop("GROVE_INFORMER", None)
+        else:
+            os.environ["GROVE_INFORMER"] = prev
+
+
+def test_reconcile_outcomes_identical_between_read_paths():
+    """The property the informer must hold: the same event sequence
+    through the cached and direct read paths converges to the same
+    final store state (modulo uids/rvs/timestamps)."""
+    with_informer = _drive_sequence(informer=True)
+    direct = _drive_sequence(informer=False)
+    assert with_informer == direct
+
+
+def test_informer_reconcile_256_pinned():
+    """The acceptance benchmark: on a 256-pod / 64-gang fleet the
+    informer-backed path issues >=10x fewer Store.list scans over the
+    whole run and sweeps the converged fleet >=3x faster end-to-end
+    than GROVE_INFORMER=0 (steady-state reconcile is the recurring
+    cost at fleet scale; bench_reconcile is the same harness).
+    Best-of-N per mode to shrug off CI noise."""
+
+    def measure(reps):
+        steady = {True: [], False: []}
+        scans = {}
+        for _ in range(reps):
+            for informer in (True, False):
+                r = run_once(256, informer)
+                assert r["pods"] == 256 and r["gangs"] == 64, r
+                steady[informer].append(r["steady_wall_s"])
+                scans[informer] = r["list_scans"]
+        fast, slow = min(steady[True]), min(steady[False])
+        assert fast > 0
+        return slow / fast, scans
+
+    speedup, scans = measure(2)
+    if speedup < 3.0:
+        # One retry with more reps: a loaded CI host can land a pause
+        # in every run of a short first batch; a genuine regression
+        # stays below the bar either way.
+        speedup, scans = measure(4)
+    assert scans[False] >= 10 * scans[True], scans
+    assert speedup >= 3.0, f"steady sweep only {speedup:.1f}x faster"
+
+
+def test_bench_reconcile_emits_nonzero_rows():
+    """The bench tool's row is well-formed and nonzero — the first real
+    numbers for the reconcile-p50 metric (make bench-reconcile appends
+    these to bench-history/)."""
+    from tools import bench_reconcile
+    row = bench_reconcile.bench_fleet(16, reps=1)
+    assert row["metric"] == "reconcile_p50_ms"
+    assert row["value"] > 0
+    assert row["p99_ms"] >= row["value"]
+    assert row["steady_wall_ms"] > 0
+    assert row["store_list_scans"] > 0
+    assert row["pods"] == 16

@@ -169,6 +169,108 @@ def test_http_watch_gone_maps_to_error(wired):
         next(http.watch_events(since=1, poll_timeout=2.0))
 
 
+def test_resumable_watch_events_recovers_from_gap(wired):
+    """The shared relist-and-resume helper: a history-ring gap calls
+    on_gap (the consumer reseeds) and the watch re-bootstraps at the
+    current rv instead of dying — events after recovery flow again."""
+    from grove_tpu.store.httpclient import resumable_watch_events
+
+    cl, base = wired
+    http = HttpClient(base, token="tok-op")
+    gaps: list[int] = []
+    gen = resumable_watch_events(http, kinds=["PodCliqueSet"],
+                                 poll_timeout=2.0,
+                                 on_gap=lambda: gaps.append(1))
+    # The first next() bootstraps at the CURRENT rv — only events after
+    # it flow, so consumption must start before the create.
+    first: list = []
+    t0 = threading.Thread(target=lambda: first.append(next(gen)),
+                          daemon=True)
+    t0.start()
+    time.sleep(0.3)  # let the bootstrap + first long poll settle
+    cl.client.create(pcs("g0"))
+    t0.join(10.0)
+    assert not t0.is_alive()
+    _, etype, obj = first[0]
+    assert etype == "ADDED" and obj.meta.name == "g0"
+    # While the consumer is paused, churn far past a shrunken ring so
+    # its resume point predates the history — the next poll 410s.
+    cl.manager.store._history = type(cl.manager.store._history)(maxlen=2)
+    for i in range(1, 6):
+        cl.client.create(pcs(f"g{i}"))
+    # Restore a production-size ring before expecting recovery: with a
+    # 2-entry ring under continued controller churn, every re-bootstrap
+    # would 410 again by construction (> 2 events per round trip).
+    cl.manager.store._history = type(cl.manager.store._history)(
+        maxlen=4096)
+    got: list = []
+    done = threading.Event()
+
+    def consume():
+        # The first reply's batch may hold further already-fetched
+        # events (controller status writes); the generator drains them
+        # without an HTTP round trip. Keep consuming until an event
+        # from AFTER the gap arrives — the next real request is the one
+        # that 410s and resumes.
+        for ev in gen:
+            got.append(ev)
+            if ev[2].meta.name.startswith("after"):
+                done.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    # The re-bootstrap starts at the CURRENT rv (the gap's events are
+    # unrecoverable; on_gap is where a cache would relist) — keep
+    # creating fresh objects until one lands after the bootstrap. The
+    # window is generous: on a loaded CI host the 410 + re-bootstrap
+    # round trips can take several poll cycles.
+    fresh = []
+    for i in range(40):
+        if done.wait(0.75):
+            break
+        name = f"after{i}"
+        fresh.append(name)
+        cl.client.create(pcs(name))
+    t.join(15.0)
+    assert gaps, "on_gap never invoked"
+    assert not t.is_alive(), "no post-gap event arrived"
+    assert got and got[-1][2].meta.name in fresh
+
+
+def test_wire_informer_reseeds_after_gap(wired):
+    """A wire-fed informer (Reflector over watch_events) recovers from
+    WatchGoneError by relisting: the cache stays correct and current
+    instead of the agent crashing or serving a hole."""
+    from grove_tpu.runtime.informer import wire_informer
+
+    cl, base = wired
+    http = HttpClient(base, token="tok-op")
+    real = http.watch_events
+    state = {"raised": False}
+
+    def flaky(*a, **kw):
+        if not state["raised"]:
+            state["raised"] = True
+            raise WatchGoneError("history gone")
+        return real(*a, **kw)
+
+    http.watch_events = flaky
+    cl.client.create(pcs("w0"))
+    inf, refl = wire_informer(http, PodCliqueSet, poll_timeout=2.0)
+    refl.start()  # seed relist sees w0; first watch attempt 410s
+    try:
+        wait_for(lambda: state["raised"] and inf.relists >= 2,
+                 timeout=10.0, desc="gap reseed happened")
+        assert inf.lister().get("w0") is not None
+        cl.client.create(pcs("w1"))  # flows through the resumed watch
+        wait_for(lambda: inf.lister().get("w1") is not None,
+                 timeout=10.0, desc="post-gap event applied")
+        assert len(inf) == 2
+    finally:
+        refl.stop()
+
+
 def test_watch_driven_remote_agent(wired, tmp_path):
     """The agent consumes the event feed: a pod bound to its node starts
     promptly even though the kubelet's polling fallback is slow."""

@@ -1,0 +1,230 @@
+"""CPU-only controller reconcile benchmark — the informer layer's proof.
+
+The controllers' hot path is reads: pre-informer, one reconcile cycle
+issued ~24 ``client.list`` calls, each a full store scan with per-object
+deserialization. This tool measures what that costs end-to-end, without
+threads or a TPU: a synthetic PodCliqueSet fleet (R gangs of
+``gang_size`` one-chip pods) is deployed by driving the REAL reconcilers
+(PodCliqueSet → ScalingGroup → PodClique → PodGang) round-robin,
+single-threaded, until the store's resource version stops moving — the
+same deterministic harness shape as ``tools/bench_sched.py`` driving
+``_place_pass``.
+
+Per fleet size it reports reconcile latency p50/p99 over every reconcile
+invocation, end-to-end convergence wall time, and the number of
+``Store.list``-shaped scans the run issued (``Store.list_scans`` counts
+``list`` + ``list_snapshot``), and appends one JSON row per fleet to
+``bench-history/history.jsonl`` (GROVE_BENCH_HISTORY=0 disables).
+
+``--compare`` additionally runs the direct-read path
+(``GROVE_INFORMER=0`` — every list a store scan) and prints the speedup
+and the scan ratio. No nodes are created: gangs stay Pending by design —
+this benchmarks the controller read path, not placement (bench_sched
+owns that).
+
+Usage:
+    python tools/bench_reconcile.py            # all fleets, append history
+    python tools/bench_reconcile.py --pods 256 --compare --no-history
+    python tools/bench_reconcile.py --pods 1 --reps 1 --no-history  # CI smoke
+    make bench-reconcile
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grove_tpu.api import PodCliqueSet, new_meta
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.controllers.podclique import PodCliqueReconciler
+from grove_tpu.controllers.podcliqueset import PodCliqueSetReconciler
+from grove_tpu.controllers.podgang import PodGangReconciler
+from grove_tpu.controllers.scalinggroup import ScalingGroupReconciler
+from grove_tpu.runtime.controller import Request
+from grove_tpu.runtime.informer import CachedClient, InformerSet
+from grove_tpu.scheduler.registry import build_registry
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+from tools.bench_sched import append_history
+
+
+def build_workload(client: Client, pods: int, gang_size: int = 4) -> int:
+    """One PCS of R replicas × one ``gang_size``-pod clique — R base
+    gangs totalling ``pods`` pods (the 256-pod point is 64 gangs of 4).
+    Returns the gang (replica) count."""
+    gang_size = min(gang_size, pods)
+    replicas = max(1, pods // gang_size)
+    client.create(PodCliqueSet(
+        meta=new_meta("bench"),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplate(cliques=[PodCliqueTemplate(
+                name="w", replicas=gang_size, tpu_chips_per_pod=1,
+                container=ContainerSpec(argv=["x"]))]))))
+    return replicas
+
+
+def sweep(store: Store, reconcilers: dict,
+          durations: list[float]) -> None:
+    """One full round: every object through its real reconciler
+    (single-threaded; the workqueue's coalescing is irrelevant to
+    read-path cost). Object enumeration reads the store dict directly —
+    NOT through a client — so the harness's own bookkeeping never
+    pollutes the scan counts being measured."""
+    for kind in ("PodCliqueSet", "PodCliqueScalingGroup", "PodClique",
+                 "PodGang"):
+        rec = reconcilers[kind]
+        for ns, name in sorted(store._objects.get(kind, {})):
+            t0 = time.perf_counter()
+            rec.reconcile(Request(ns, name))
+            durations.append(time.perf_counter() - t0)
+
+
+def drive_until_settled(store: Store, reconcilers: dict,
+                        durations: list[float],
+                        rounds_cap: int = 64) -> int:
+    """Sweep until a full round moves no resource version. Returns the
+    number of rounds."""
+    rounds = 0
+    while rounds < rounds_cap:
+        rounds += 1
+        rv0 = store.current_rv()
+        sweep(store, reconcilers, durations)
+        if store.current_rv() == rv0:
+            break
+    return rounds
+
+
+def run_once(pods: int, informer: bool, gang_size: int = 4) -> dict:
+    """One timed deploy-to-convergence of a fresh fleet. Store/client
+    construction and workload creation are outside the timed region;
+    the timed region is the reconcile rounds themselves."""
+    prev = os.environ.get("GROVE_INFORMER")
+    os.environ["GROVE_INFORMER"] = "1" if informer else "0"
+    try:
+        store = Store()
+        base = Client(store)
+        client = CachedClient(base, InformerSet(store=store))
+        registry = build_registry(OperatorConfiguration(), base)
+        gangs = build_workload(base, pods, gang_size)
+        reconcilers = {
+            "PodCliqueSet": PodCliqueSetReconciler(client),
+            "PodCliqueScalingGroup": ScalingGroupReconciler(client),
+            "PodClique": PodCliqueReconciler(client, registry),
+            "PodGang": PodGangReconciler(client, registry),
+        }
+        scans0 = store.list_scans
+        durations: list[float] = []
+        t0 = time.perf_counter()
+        rounds = drive_until_settled(store, reconcilers, durations)
+        wall = time.perf_counter() - t0
+        # Steady state: the converged fleet swept once more end-to-end.
+        # No writes happen, so this isolates the reconcile READ path —
+        # the cost that recurs for every resync/event at scale, and the
+        # cost the informer cache exists to remove (the reference
+        # profiles its no-op reconcile the same way, scale_test.go).
+        steady: list[float] = []
+        steady_scans0 = store.list_scans
+        t1 = time.perf_counter()
+        sweep(store, reconcilers, steady)
+        steady_wall = time.perf_counter() - t1
+        steady_scans = store.list_scans - steady_scans0
+        scans = store.list_scans - scans0
+        n_pods = len(store._objects.get("Pod", {}))
+    finally:
+        if prev is None:
+            os.environ.pop("GROVE_INFORMER", None)
+        else:
+            os.environ["GROVE_INFORMER"] = prev
+    assert n_pods == pods, (n_pods, pods)
+    return {"wall_s": wall, "gangs": gangs, "pods": n_pods,
+            "rounds": rounds, "list_scans": scans,
+            "steady_wall_s": steady_wall, "steady_scans": steady_scans,
+            "durations": durations, "steady_durations": steady}
+
+
+def bench_fleet(pods: int, reps: int, informer: bool = True) -> dict:
+    samples = [run_once(pods, informer) for _ in range(reps)]
+    pooled = sorted(d * 1e3 for s in samples
+                    for d in s["durations"] + s["steady_durations"])
+    q = statistics.quantiles(pooled, n=100, method="inclusive") \
+        if len(pooled) > 1 else pooled * 2
+    return {
+        "metric": "reconcile_p50_ms",
+        "value": round(statistics.median(pooled), 4),
+        "unit": "ms/reconcile",
+        "pods": pods,
+        "gangs": samples[0]["gangs"],
+        "p99_ms": round(q[98] if len(pooled) > 1 else pooled[0], 4),
+        "deploy_wall_ms": round(statistics.median(
+            s["wall_s"] for s in samples) * 1e3, 3),
+        "steady_wall_ms": round(min(
+            s["steady_wall_s"] for s in samples) * 1e3, 3),
+        "rounds": samples[0]["rounds"],
+        "store_list_scans": samples[0]["list_scans"],
+        "steady_scans": samples[0]["steady_scans"],
+        "reconciles": len(samples[0]["durations"]),
+        "reps": reps,
+        "informer": informer,
+        "mode": "reconcile-cpu",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pods", type=int, nargs="*",
+                    default=[1, 16, 64, 256],
+                    help="fleet sizes in pods (default: 1 16 64 256)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="repetitions per fleet (fresh store each)")
+    ap.add_argument("--compare", action="store_true",
+                    help="also run the GROVE_INFORMER=0 direct-read "
+                         "path and print speedup + scan ratio")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append to bench-history/")
+    args = ap.parse_args()
+    if args.no_history:
+        os.environ["GROVE_BENCH_HISTORY"] = "0"
+
+    for pods in args.pods:
+        row = bench_fleet(pods, args.reps, informer=True)
+        line = (f"pods={pods:4d} gangs={row['gangs']:3d} "
+                f"p50={row['value']:.3f} ms p99={row['p99_ms']:.3f} ms "
+                f"deploy={row['deploy_wall_ms']:.1f} ms "
+                f"steady={row['steady_wall_ms']:.2f} ms "
+                f"scans={row['store_list_scans']}")
+        if args.compare:
+            legacy = bench_fleet(pods, args.reps, informer=False)
+            row["legacy_p50_ms"] = legacy["value"]
+            row["legacy_deploy_wall_ms"] = legacy["deploy_wall_ms"]
+            row["legacy_steady_wall_ms"] = legacy["steady_wall_ms"]
+            row["legacy_list_scans"] = legacy["store_list_scans"]
+            row["deploy_speedup"] = round(
+                legacy["deploy_wall_ms"] / row["deploy_wall_ms"], 2) \
+                if row["deploy_wall_ms"] else 0.0
+            row["steady_speedup"] = round(
+                legacy["steady_wall_ms"] / row["steady_wall_ms"], 2) \
+                if row["steady_wall_ms"] else 0.0
+            row["scan_ratio"] = round(
+                legacy["store_list_scans"] /
+                max(1, row["store_list_scans"]), 1)
+            line += (f"  deploy_speedup={row['deploy_speedup']:.1f}x "
+                     f"steady_speedup={row['steady_speedup']:.1f}x "
+                     f"scan_ratio={row['scan_ratio']:.0f}x")
+        print(line, flush=True)
+        append_history(row)
+
+
+if __name__ == "__main__":
+    main()
