@@ -75,6 +75,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# harness rot without paying the full sweep; the informer tests
 	@# themselves run in the core tier below.
 	$(PY) tools/bench_reconcile.py --pods 1 --reps 1 --no-history
+	@# trace-enabled 1-gang smoke: create → ready with a span-tree
+	@# assertion (lifecycle tracing's CI gate; --history plots
+	@# time-to-ready percentiles on the bench dashboard).
+	$(PY) tools/trace_smoke.py --reps 1
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

@@ -14,10 +14,11 @@ import time
 
 from grove_tpu.api import Node, Pod, constants as c
 from grove_tpu.api.core import PodPhase
-from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.meta import Condition, set_condition, trace_id_of
 from grove_tpu.agent.barrier import barrier_satisfied
 from grove_tpu.runtime.errors import GroveError
 from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.store.client import Client
 
 
@@ -34,6 +35,11 @@ class FakeKubeletPool:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._nodes_cache: tuple[float, set[str]] = (0.0, set())
+        # First-blocked timestamp per pod held at its startup barrier:
+        # when the barrier finally clears, the whole wait becomes one
+        # agent.barrier_wait span (pruned each pass against the live
+        # pending set, so deleted pods cannot leak entries).
+        self._blocked_since: dict[tuple[str, str], float] = {}
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="fake-kubelet",
@@ -75,14 +81,19 @@ class FakeKubeletPool:
         # Field-filtered list: at steady state there are no Pending
         # pods, so the tick clones NOTHING instead of the whole fleet.
         flipped = []
+        pending_keys: set[tuple[str, str]] = set()
         for pod in self.client.list(
                 Pod, self.namespace,
                 fields={"phase": PodPhase.PENDING.value}):
             if (pod.status.node_name in fake_nodes
                     and pod.meta.deletion_timestamp is None):
+                key = (pod.meta.namespace, pod.meta.name)
+                pending_keys.add(key)
                 if not barrier_satisfied(self.client, pod.spec.startup_barrier,
                                          pod.meta.namespace):
+                    self._blocked_since.setdefault(key, time.time())
                     continue
+                t_start = time.time()
                 if self.startup_latency:
                     time.sleep(self.startup_latency)
                 pod.status.phase = PodPhase.RUNNING
@@ -92,7 +103,7 @@ class FakeKubeletPool:
                     pod.status.conditions,
                     Condition(type=c.COND_READY, status="True",
                               reason="FakeNodeReady"))
-                flipped.append(pod)
+                flipped.append((pod, t_start, key))
         if flipped:
             # One locked batch (KWOK flips whole fleets at once):
             # controllers coalesce the burst instead of N wake-ups;
@@ -101,14 +112,54 @@ class FakeKubeletPool:
             # batch (store semantics: systemic failures are loud) — fall
             # back to per-pod writes so one poison pod can't block the
             # pods sorted after it forever.
+            pods = [pod for pod, _, _ in flipped]
             try:
-                self.client.update_status_many(flipped)
+                results = self.client.update_status_many(pods)
             except GroveError:
-                for pod in flipped:
+                results = []
+                for pod in pods:
                     try:
                         self.client.update_status(pod)
-                    except GroveError:
-                        pass  # isolated; retried next pass
+                        results.append(None)
+                    except GroveError as e:
+                        results.append(e)  # isolated; retried next pass
+            # Spans + the gang 'started' milestone only for COMMITTED
+            # starts: a conflict-dropped write means the pod is still
+            # Pending — recording would pin a false milestone and lose
+            # the barrier-wait span for the retry.
+            for (pod, t_start, key), err in zip(flipped, results):
+                if err is None:
+                    record_pod_start_spans(
+                        pod, t_start, self._blocked_since.pop(key, None))
+        # Only pods still pending can be waiting at a barrier.
+        self._blocked_since = {k: v for k, v in self._blocked_since.items()
+                               if k in pending_keys}
+
+
+def record_pod_start_spans(pod, t_start: float,
+                           blocked_since: float | None) -> None:
+    """Trace the agent-start phase of a pod's lifecycle: an
+    ``agent.start`` span for the start action itself, an
+    ``agent.barrier_wait`` span covering the whole time the pod sat at
+    its startup-ordering barrier, and the gang's ``started`` milestone
+    (first pod start wins). Shared by the fake kubelet pool and the
+    process kubelet — one span vocabulary for both agent shapes."""
+    trace_id = trace_id_of(pod)
+    if not trace_id:
+        return
+    now = time.time()
+    if blocked_since is not None:
+        GLOBAL_TRACER.record_span(
+            "agent.barrier_wait", trace_id, blocked_since, t_start,
+            attrs={"pod": pod.meta.name})
+    GLOBAL_TRACER.record_span(
+        "agent.start", trace_id, t_start, now,
+        attrs={"pod": pod.meta.name,
+               "node": pod.status.node_name or ""})
+    gang = pod.meta.labels.get(c.LABEL_PODGANG_NAME, "")
+    if gang:
+        GLOBAL_TRACER.milestone(
+            trace_id, f"{pod.meta.namespace}/{gang}", "started", ts=now)
 
 
 def fail_pod(client: Client, name: str, namespace: str = "default",

@@ -60,6 +60,9 @@ class ProcessKubelet:
         # the key keeps same-named pods in different namespaces apart.
         self._procs: dict[tuple[str, str], tuple[str, subprocess.Popen]] = {}
         self._last_probe: dict[tuple[str, str], float] = {}
+        # First-blocked ts per pod held at its startup barrier — the
+        # agent.barrier_wait trace span (see agent/node.py).
+        self._blocked_since: dict[tuple[str, str], float] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -151,6 +154,7 @@ class ProcessKubelet:
                                  "failing for self-heal", *key)
 
         # Launch: bound pending pods whose barrier cleared.
+        from grove_tpu.agent.node import record_pod_start_spans
         for key, pod in live_pods.items():
             if (pod.status.phase != PodPhase.PENDING
                     or key in self._procs
@@ -158,8 +162,17 @@ class ProcessKubelet:
                 continue
             if not barrier_satisfied(self.client, pod.spec.startup_barrier,
                                      pod.meta.namespace):
+                self._blocked_since.setdefault(key, time.time())
                 continue
+            t_start = time.time()
             self._launch(pod, nodes[pod.status.node_name])
+            record_pod_start_spans(pod, t_start,
+                                   self._blocked_since.pop(key, None))
+        # Only pending pods can be barrier-blocked; prune the rest.
+        self._blocked_since = {
+            k: v for k, v in self._blocked_since.items()
+            if k in live_pods
+            and live_pods[k].status.phase == PodPhase.PENDING}
 
     def _inject_workload_token(self, pod: Pod, env: dict[str, str]) -> bool:
         """GROVE_API_TOKEN = the pod's PCS workload identity token

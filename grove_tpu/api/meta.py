@@ -68,6 +68,15 @@ def new_meta(name: str, namespace: str = "default",
                       creation_timestamp=time.time())
 
 
+def trace_id_of(obj) -> str:
+    """The object's lifecycle trace id ('' when untraced). Stamped into
+    ``meta.annotations`` by the store at create (runtime/trace.py):
+    children copy their parent's id, so one trace follows a
+    PodCliqueSet's whole tree from create to Ready."""
+    from grove_tpu.runtime.trace import ANNOTATION_TRACE_ID
+    return obj.meta.annotations.get(ANNOTATION_TRACE_ID, "")
+
+
 def set_condition(conditions: list[Condition], cond: Condition) -> list[Condition]:
     """Upsert a condition by type, bumping last_transition_time on change."""
     out = []

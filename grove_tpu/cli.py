@@ -780,6 +780,91 @@ def cmd_events(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Render an object's end-to-end lifecycle trace: the span tree
+    across controllers → scheduler → agent with per-phase durations,
+    milestones, and the critical path — the "why did this gang take 4s
+    to come up?" view. Needs ``profiling.enabled`` on the serve daemon
+    (the /debug/traces gate)."""
+    from grove_tpu.runtime.trace import ANNOTATION_TRACE_ID, critical_path
+    if "/" not in args.target:
+        print("error: target must be <kind>/<name> "
+              "(e.g. PodCliqueSet/simple1)", file=sys.stderr)
+        return 1
+    kind, name = args.target.split("/", 1)
+    status, obj = _http(args.server, f"/api/{kind}/{name}"
+                        f"?namespace={args.namespace}", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(obj)}", file=sys.stderr)
+        return 1
+    tid = ((obj.get("meta", {}) or {}).get("annotations") or {}).get(
+        ANNOTATION_TRACE_ID, "")
+    if not tid:
+        print(f"error: {kind}/{name} carries no {ANNOTATION_TRACE_ID} "
+              "annotation (created before tracing, or GROVE_TRACE=0)",
+              file=sys.stderr)
+        return 1
+    status, data = _http(args.server, f"/debug/traces?trace_id={tid}",
+                         ca=args.ca)
+    if status != 200:
+        hint = (" (enable config profiling.enabled on the serve daemon)"
+                if status == 404 else "")
+        print(f"error ({status}): {_err_text(data)}{hint}",
+              file=sys.stderr)
+        return 1
+    spans = data.get("spans", [])
+    milestones = data.get("milestones", [])
+    t0 = data.get("starts", {}).get(tid)
+    if t0 is None:
+        t0 = min((s["start"] for s in spans), default=time.time())
+    print(f"trace {tid}  {kind}/{name}  "
+          f"(started {_age(t0, time.time())} ago)")
+
+    def ms(dt: float) -> str:
+        return f"{dt * 1e3:.1f}ms"
+
+    # Per-gang milestone timeline + phase durations.
+    for m in milestones:
+        ph = m.get("phases", {})
+        parts = [f"{phase} +{ms(ph[phase] - t0)}"
+                 for phase in ("gang_created", "scheduled", "started",
+                               "ready") if phase in ph]
+        print(f"  gang {m['subject']}: " + "  ".join(parts))
+        if "ready" in ph:
+            print(f"    time-to-scheduled "
+                  f"{ms(ph.get('scheduled', ph['ready']) - t0)}  "
+                  f"time-to-ready {ms(ph['ready'] - t0)}")
+    if not spans:
+        print("  (no spans retained — the flight-recorder ring may "
+              "have wrapped)")
+        return 0
+
+    # Span tree, critical path starred.
+    crit = set(critical_path(spans))
+    by_parent: dict = {}
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        parent = s["parent_id"] if s["parent_id"] in by_id else ""
+        by_parent.setdefault(parent, []).append(s)
+    print(f"  spans ({len(spans)}; * = critical path):")
+
+    def render(span: dict, depth: int) -> None:
+        mark = "*" if span["span_id"] in crit else " "
+        attrs = " ".join(f"{k}={v}"
+                         for k, v in sorted(span["attrs"].items()))
+        err = f"  ERROR: {span['error']}" if span.get("error") else ""
+        print(f"  {mark} {'  ' * depth}{span['name']}  "
+              f"+{ms(span['start'] - t0)}  {ms(span['end'] - span['start'])}"
+              + (f"  {attrs}" if attrs else "") + err)
+        for child in sorted(by_parent.get(span["span_id"], []),
+                            key=lambda s: s["start"]):
+            render(child, depth + 1)
+
+    for root in sorted(by_parent.get("", []), key=lambda s: s["start"]):
+        render(root, 0)
+    return 0
+
+
 def cmd_agent(args: argparse.Namespace) -> int:
     """Per-host node agent against a remote control plane (HTTP)."""
     import os
@@ -965,6 +1050,18 @@ def main(argv: list[str] | None = None) -> int:
     logs_p.add_argument("--server", default=default_server)
     add_ca(logs_p)
     logs_p.set_defaults(fn=cmd_logs)
+
+    tr = sub.add_parser(
+        "trace", help="render an object's end-to-end lifecycle trace: "
+                      "span tree across controllers/scheduler/agent, "
+                      "per-phase durations, critical path (needs "
+                      "profiling.enabled on the serve daemon)")
+    tr.add_argument("target", help="<kind>/<name>, e.g. "
+                                   "PodCliqueSet/simple1")
+    tr.add_argument("--namespace", default="default")
+    tr.add_argument("--server", default=default_server)
+    add_ca(tr)
+    tr.set_defaults(fn=cmd_trace)
 
     events_p = sub.add_parser("events", help="list cluster events "
                                              "(kubectl get events analog)")

@@ -317,6 +317,14 @@ def expected_services(pcs: PodCliqueSet) -> list[Service]:
 def _meta(pcs: PodCliqueSet, name: str, labels: dict[str, str]) -> ObjectMeta:
     meta = new_meta(name, namespace=pcs.meta.namespace, labels=labels)
     meta.owner_references = [owner_ref(pcs)]
+    # Lifecycle trace: children carry their PCS's trace id so one trace
+    # follows the whole tree (runtime/trace.py). Deterministic (not
+    # context-dependent): child creates may run on pool threads where
+    # the reconcile span's context is not ambient.
+    from grove_tpu.runtime.trace import ANNOTATION_TRACE_ID
+    tid = pcs.meta.annotations.get(ANNOTATION_TRACE_ID, "")
+    if tid:
+        meta.annotations[ANNOTATION_TRACE_ID] = tid
     return meta
 
 

@@ -308,6 +308,15 @@ class PodCliqueReconciler:
         )
         pod.meta.owner_references = [OwnerReference(
             kind=PodClique.KIND, name=pclq.meta.name, uid=pclq.meta.uid)]
+        # Trace propagation: the pod joins its PCLQ's lifecycle trace
+        # (which carries the root PCS's id) — also correct for
+        # self-healed replacements, whose startup belongs to the same
+        # story. Explicit because creates fan out through the shared
+        # task pool, where the reconcile span's context is not ambient.
+        from grove_tpu.runtime.trace import ANNOTATION_TRACE_ID
+        tid = pclq.meta.annotations.get(ANNOTATION_TRACE_ID, "")
+        if tid:
+            pod.meta.annotations[ANNOTATION_TRACE_ID] = tid
         self._add_env(pod, pclq, index)
         if spec.starts_after:
             pod.spec.startup_barrier = StartupBarrier(

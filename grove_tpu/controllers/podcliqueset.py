@@ -217,6 +217,10 @@ class PodCliqueSetReconciler:
             }),
             data={"token": pysecrets.token_urlsafe(24)})
         sec.meta.owner_references = [exp.owner_ref(pcs)]
+        from grove_tpu.runtime.trace import ANNOTATION_TRACE_ID
+        tid = pcs.meta.annotations.get(ANNOTATION_TRACE_ID, "")
+        if tid:
+            sec.meta.annotations[ANNOTATION_TRACE_ID] = tid
         try:
             self.client.create(sec)
         except AlreadyExistsError:

@@ -10,10 +10,12 @@ emitting an external scheduler's CRD) would do its work.
 from __future__ import annotations
 
 from grove_tpu.api import PodGang
+from grove_tpu.api.meta import trace_id_of
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.errors import NotFoundError
 from grove_tpu.runtime.flow import StepResult
 from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.scheduler.framework import Registry
 from grove_tpu.store.client import Client
 
@@ -35,5 +37,13 @@ class PodGangReconciler:
             backend = self.schedulers.get(gang.spec.scheduler_name or None)
         except KeyError as e:
             return StepResult.fail(e)
-        backend.sync_podgang(gang)
+        # Child span under reconcile.podgang: native backends no-op
+        # here, but a translating backend's CRD emission is exactly the
+        # kind of cross-system hop a trace must not lose.
+        with GLOBAL_TRACER.span(
+                "podgang.sync",
+                trace_id=trace_id_of(gang) or None,
+                attrs={"gang": gang.meta.name,
+                       "backend": backend.name}):
+            backend.sync_podgang(gang)
         return StepResult.finished()

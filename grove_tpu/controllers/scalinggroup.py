@@ -99,6 +99,16 @@ class ScalingGroupReconciler:
                         pclq.meta.owner_references = [OwnerReference(
                             kind=PodCliqueScalingGroup.KIND,
                             name=pcsg.meta.name, uid=pcsg.meta.uid)]
+                        # _meta stamped the PCS's trace id; a PCSG
+                        # created outside a PCS still passes its own
+                        # trace down to the member it fans out.
+                        from grove_tpu.runtime.trace import \
+                            ANNOTATION_TRACE_ID
+                        tid = pcsg.meta.annotations.get(
+                            ANNOTATION_TRACE_ID, "")
+                        if tid:
+                            pclq.meta.annotations.setdefault(
+                                ANNOTATION_TRACE_ID, tid)
                         self.client.create(pclq)
                     # Dataclass equality: same drift decision as the
                     # to_dict round-trip at a fraction of the per-sync

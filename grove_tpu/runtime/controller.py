@@ -26,6 +26,8 @@ from typing import Any, Callable, NamedTuple
 from grove_tpu.runtime.flow import StepResult
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.api.meta import trace_id_of
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.store.store import Event
 from grove_tpu.store.client import Client
 
@@ -68,12 +70,20 @@ class _DelayQueue:
         # measured from readiness (a backoff delay is intentional
         # latency, not queue congestion) to worker pickup.
         self._ready: dict[Request, float] = {}
+        # Lifecycle-trace hint per request: the trace id of the event
+        # object that (most recently) enqueued it. Dedup keeps the
+        # latest hint; _process pops it to bind the reconcile span to
+        # the trace that woke the request.
+        self._trace: dict[Request, str] = {}
         self._shutdown = False
 
-    def add(self, req: Request, delay: float = 0.0) -> None:
+    def add(self, req: Request, delay: float = 0.0,
+            trace_id: str = "") -> None:
         with self._lock:
             if self._shutdown:
                 return
+            if trace_id:
+                self._trace[req] = trace_id
             if req in self._processing:
                 self._dirty.add(req)
                 return
@@ -118,6 +128,13 @@ class _DelayQueue:
         GLOBAL_METRICS.observe("grove_workqueue_wait_seconds", queued_for,
                                controller=self.name)
         return req
+
+    def pop_trace(self, req: Request) -> str:
+        """Take the trace hint for a request this worker just popped
+        ('' when it arrived untraced). Safe without further
+        coordination: dedup guarantees one worker holds ``req``."""
+        with self._lock:
+            return self._trace.pop(req, "")
 
     def done(self, req: Request) -> None:
         with self._lock:
@@ -234,8 +251,9 @@ class Controller:
                 continue
             for obj in objs:
                 try:
+                    tid = trace_id_of(obj)
                     for req in mapper(Event(EventType.ADDED, obj)):
-                        self.queue.add(req)
+                        self.queue.add(req, trace_id=tid)
                 except Exception:  # noqa: BLE001
                     self.log.exception("resync mapper panic")
 
@@ -245,8 +263,12 @@ class Controller:
             if event is None:
                 continue
             try:
+                # Trace propagation through the workqueue: the event
+                # object's trace id rides along as a hint so the
+                # reconcile it triggers lands in the same trace.
+                tid = trace_id_of(event.obj)
                 for req in mapper(event):
-                    self.queue.add(req)
+                    self.queue.add(req, trace_id=tid)
             except Exception:  # noqa: BLE001
                 self.log.exception("watch mapper panic (event dropped)")
 
@@ -265,36 +287,54 @@ class Controller:
             self.reconcile_count += 1
             self.key_counts[req.key] += 1
         GLOBAL_METRICS.inc("grove_reconcile_total", controller=self.name)
+        # Reconcile span: bound to the trace that enqueued this request
+        # (no-op for untraced requests). The span context is ambient
+        # for the reconcile body, so objects it creates and nested
+        # spans it opens land in the same trace.
+        trace_hint = self.queue.pop_trace(req)
         t0 = time.perf_counter()
-        try:
+        with GLOBAL_TRACER.span(f"reconcile.{self.name}",
+                                trace_id=trace_hint or None,
+                                attrs={"key": req.key}) as span:
             try:
-                result = self.reconcile(req) or StepResult.finished()
-            finally:
-                dt = time.perf_counter() - t0
-                self.durations.append(dt)
-                GLOBAL_METRICS.observe("grove_reconcile_duration_seconds",
-                                       dt, controller=self.name)
-        except Exception as e:  # noqa: BLE001 - reconcile panic barrier
-            self.error_count += 1
-            self.log.warning("reconcile %s panicked: %s", req.key, e,
-                             exc_info=True)
-            self._requeue_with_backoff(req)
-            return
-        if result.error is not None:
-            self.error_count += 1
-            GLOBAL_METRICS.inc("grove_reconcile_errors_total",
-                               controller=self.name)
-            self.log.debug("reconcile %s error: %s", req.key, result.error)
-            self._requeue_with_backoff(req, result.requeue_after)
-            return
-        self._failures.pop(req, None)
-        if result.requeue_after is not None:
-            self.queue.add(req, result.requeue_after)
+                try:
+                    result = self.reconcile(req) or StepResult.finished()
+                finally:
+                    dt = time.perf_counter() - t0
+                    self.durations.append(dt)
+                    GLOBAL_METRICS.observe(
+                        "grove_reconcile_duration_seconds",
+                        dt, controller=self.name)
+            except Exception as e:  # noqa: BLE001 - reconcile panic barrier
+                self.error_count += 1
+                span.set_error(e)
+                self.log.warning("reconcile %s panicked: %s", req.key, e,
+                                 exc_info=True)
+                self._requeue_with_backoff(req, trace_id=trace_hint)
+                return
+            if result.error is not None:
+                self.error_count += 1
+                span.set_error(result.error)
+                GLOBAL_METRICS.inc("grove_reconcile_errors_total",
+                                   controller=self.name)
+                self.log.debug("reconcile %s error: %s", req.key,
+                               result.error)
+                self._requeue_with_backoff(req, result.requeue_after,
+                                           trace_id=trace_hint)
+                return
+            self._failures.pop(req, None)
+            if result.requeue_after is not None:
+                self.queue.add(req, result.requeue_after,
+                               trace_id=trace_hint)
 
     def _requeue_with_backoff(self, req: Request,
-                              override: float | None = None) -> None:
+                              override: float | None = None,
+                              trace_id: str = "") -> None:
+        # The trace hint rides through the retry: error-and-backoff
+        # reconciles are exactly the ones a slow-bring-up trace must
+        # show, not lose.
         n = self._failures.get(req, 0) + 1
         self._failures[req] = n
         delay = override if override is not None else min(
             self.backoff_base * (2 ** (n - 1)), self.backoff_max)
-        self.queue.add(req, delay)
+        self.queue.add(req, delay, trace_id=trace_id)

@@ -65,6 +65,11 @@ class EventRecorder:
                 cur.count += 1
                 cur.last_seen = now
                 cur.message = message
+                # Carry the CURRENT type through: a condition that
+                # escalates Normal → Warning under the same reason must
+                # surface as Warning on the bump, not keep the stale
+                # type forever.
+                cur.type = etype
                 self.client.update(cur)
             except NotFoundError:
                 ev = Event(

@@ -22,16 +22,23 @@ class _JsonFormatter(logging.Formatter):
 
 
 def setup_logging(level: str = "info", fmt: str = "text") -> None:
+    """Idempotent-but-live configuration: a repeat call (a second
+    Manager in one process, a config reload) updates the level and
+    formatter on the existing handlers instead of silently keeping the
+    first call's configuration — only handler *creation* is once-only."""
     root = logging.getLogger("grove")
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    if fmt == "json":
+        formatter: logging.Formatter = _JsonFormatter()
+    else:
+        formatter = logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(name)s %(message)s")
     if root.handlers:
+        for handler in root.handlers:
+            handler.setFormatter(formatter)
         return
     handler = logging.StreamHandler(sys.stderr)
-    if fmt == "json":
-        handler.setFormatter(_JsonFormatter())
-    else:
-        handler.setFormatter(logging.Formatter(
-            "%(asctime)s %(levelname)-7s %(name)s %(message)s"))
+    handler.setFormatter(formatter)
     root.addHandler(handler)
     root.propagate = False
 

@@ -18,6 +18,7 @@ from grove_tpu.api.config import OperatorConfiguration, validate_config
 from grove_tpu.runtime.controller import Controller
 from grove_tpu.runtime.informer import CachedClient, InformerSet
 from grove_tpu.runtime.logger import get_logger, setup_logging
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.store.client import Client
 from grove_tpu.store.store import Store
 
@@ -40,6 +41,10 @@ class Manager:
         # store per call.
         self.informers = InformerSet(store=self.store)
         self.cached_client = CachedClient(self.client, self.informers)
+        # Lifecycle tracer handle (the flight recorder every pipeline
+        # stage appends spans to); the server serves it at
+        # /debug/traces through this handle, not the global.
+        self.tracer = GLOBAL_TRACER
         self.log = get_logger("manager")
         self.controllers: list[Controller] = []
         self.runnables: list[Any] = []   # agents etc. with start()/stop()

@@ -25,6 +25,14 @@ from collections import defaultdict
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0)
 
+# Pinned buckets for gang lifecycle SLOs (time-to-scheduled /
+# time-to-ready and the per-phase histogram): a CPU test cluster lands
+# in the sub-second bands, a production fleet under contention can take
+# minutes — the default duration buckets top out at 10s and would
+# flatten every slow bring-up into +Inf.
+LIFECYCLE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0, 120.0, 300.0)
+
 
 class _Hist:
     __slots__ = ("buckets", "counts", "sum", "count")
@@ -264,3 +272,23 @@ GLOBAL_METRICS.describe_histogram(
     # buckets would flatten everything into the first bucket.
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
              0.1, 0.25, 0.5, 1.0, 2.5))
+# Gang lifecycle SLO surface, derived from trace milestones
+# (runtime/trace.py): one observation per gang per milestone, measured
+# from the trace's mint (the root object's create).
+GLOBAL_METRICS.describe_histogram(
+    "grove_gang_time_to_scheduled_seconds",
+    "Create-to-Scheduled latency per gang (trace mint to the "
+    "scheduler's Scheduled condition flip, from lifecycle trace "
+    "milestones)",
+    buckets=LIFECYCLE_BUCKETS)
+GLOBAL_METRICS.describe_histogram(
+    "grove_gang_time_to_ready_seconds",
+    "Create-to-Ready latency per gang (trace mint to every gang pod "
+    "reporting Ready — the time-to-ready SLO the scale harness "
+    "asserts)",
+    buckets=LIFECYCLE_BUCKETS)
+GLOBAL_METRICS.describe_histogram(
+    "grove_lifecycle_phase_seconds",
+    "Per-phase gang lifecycle durations (phase=create_to_gang|"
+    "gang_to_scheduled|scheduled_to_started|started_to_ready)",
+    buckets=LIFECYCLE_BUCKETS)

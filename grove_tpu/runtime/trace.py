@@ -1,0 +1,315 @@
+"""End-to-end gang lifecycle tracing — an in-process flight recorder.
+
+Dapper-style distributed tracing (Sigelman et al., 2010) with
+OpenTelemetry-shaped span semantics, scoped to what a self-contained
+control plane actually needs: no external collector, no wire protocol —
+a bounded ring of finished spans plus per-trace lifecycle milestones,
+good enough to answer "why did this gang take 4s to come up?" from a
+live cluster.
+
+How a trace forms:
+
+- ``Store.create`` stamps every new object with a trace id annotation
+  (``ANNOTATION_TRACE_ID``): inherited from the object's pre-stamped
+  annotation (controllers copy parent → child, so the whole
+  PodCliqueSet tree shares the root's id), else from the creating
+  span's context (an EventRecorder write inside a reconcile), else
+  minted fresh.
+- Watch events carry the id into controller workqueues
+  (``_DelayQueue`` trace hints); each reconcile runs inside a
+  ``reconcile.<controller>`` span.
+- The gang scheduler wraps planning + binding in ``sched.place`` /
+  ``sched.bind`` spans; node agents record ``agent.start`` and
+  ``agent.barrier_wait`` spans per pod.
+- Lifecycle milestones (gang_created → scheduled → started → ready)
+  feed the SLO histograms in runtime/metrics.py:
+  ``grove_gang_time_to_scheduled_seconds``,
+  ``grove_gang_time_to_ready_seconds``, and the per-phase
+  ``grove_lifecycle_phase_seconds{phase=...}``.
+
+Surfaces: ``GET /debug/traces`` (server.py, gated like
+``/debug/profile``) and ``grovectl trace <kind>/<name>`` render the
+span tree with per-phase durations and the critical path.
+
+``GROVE_TRACE=0`` disables recording (ids are still stamped — they are
+inert annotations and keep wire/persisted state shape-stable).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import dataclasses
+import os
+import random
+import threading
+import time
+
+# The ObjectMeta annotation carrying an object's trace id. Defined here
+# (not api/constants.py) so the tracer stays importable from the store
+# without touching the api package; api.meta.trace_id_of re-reads it.
+ANNOTATION_TRACE_ID = "grove.tpu/trace-id"
+
+# Ambient span context per thread/task: (trace_id, span_id). Workers
+# set it for the duration of a reconcile so nested spans parent
+# correctly and objects created inside inherit the trace.
+_SPAN_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "grove_trace_span", default=None)
+
+# Private RNG (same reasoning as api.meta's uid rng): ids are identity
+# handles, not secrets, and tests reseeding the global random module
+# must not repeat trace ids.
+_id_rng = random.Random(random.SystemRandom().getrandbits(64))
+
+
+def _new_id() -> str:
+    return f"{_id_rng.getrandbits(64):016x}"
+
+
+@dataclasses.dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start: float
+    end: float
+    attrs: dict[str, str]
+    error: str = ""
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = str(value)
+
+    def set_error(self, message) -> None:
+        self.error = str(message)
+
+
+class _NullSpan:
+    """No-op span handle for untraced/disabled paths (hot loops pay one
+    falsy check, not a dataclass + ring append)."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+    def set_error(self, message) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+# Lifecycle milestone phases in pipeline order. "created" is implicit
+# (the trace start, recorded when the root object's id is minted).
+MILESTONE_PHASES = ("gang_created", "scheduled", "started", "ready")
+
+
+class Tracer:
+    """Bounded in-process tracer: finished-span ring + trace starts +
+    per-(trace, subject) lifecycle milestones. Thread-safe; all maps
+    are capped so a long-lived control plane cannot leak."""
+
+    SPAN_CAPACITY = 8192
+    TRACE_CAPACITY = 4096
+
+    def __init__(self, capacity: int = SPAN_CAPACITY) -> None:
+        self._lock = threading.Lock()
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self._trace_start: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+        # (trace_id, subject) -> {phase: ts}; subject is "<ns>/<gang>".
+        self._milestones: "collections.OrderedDict[tuple[str, str], dict[str, float]]" = \
+            collections.OrderedDict()
+        self.enabled = os.environ.get("GROVE_TRACE", "1") != "0"
+
+    # ---- trace identity ----
+
+    def mint(self, ts: float | None = None) -> str:
+        """New trace id; records the trace's start time (the anchor the
+        time-to-* milestones measure from)."""
+        tid = _new_id()
+        with self._lock:
+            self._trace_start[tid] = time.time() if ts is None else ts
+            while len(self._trace_start) > self.TRACE_CAPACITY:
+                self._trace_start.popitem(last=False)
+        return tid
+
+    def ensure(self, meta) -> str:
+        """Stamp ``meta`` with a trace id if it has none: the object's
+        own annotation wins (parent → child copies), then the creating
+        span's ambient context, then a fresh mint. Called by
+        Store.create for every object."""
+        tid = meta.annotations.get(ANNOTATION_TRACE_ID, "")
+        if tid:
+            # Pre-stamped (child of a traced parent, or a wire create
+            # carrying its id across a server restart): make sure a
+            # start anchor exists without displacing the parent's.
+            with self._lock:
+                self._trace_start.setdefault(
+                    tid, meta.creation_timestamp or time.time())
+            return tid
+        ctx = _SPAN_CTX.get()
+        if ctx is not None:
+            tid = ctx[0]
+        else:
+            tid = self.mint(ts=meta.creation_timestamp or None)
+        meta.annotations[ANNOTATION_TRACE_ID] = tid
+        return tid
+
+    @staticmethod
+    def current() -> tuple[str, str] | None:
+        """(trace_id, span_id) of the ambient span, or None."""
+        return _SPAN_CTX.get()
+
+    # ---- spans ----
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str | None = None,
+             attrs: dict[str, str] | None = None):
+        """Record a span around the with-block. ``trace_id`` binds the
+        span to a trace explicitly (workqueue hints, object
+        annotations); without one the ambient context's trace is used,
+        and with neither the span is a no-op — untraced work must not
+        fill the ring with orphans."""
+        ctx = _SPAN_CTX.get()
+        tid = trace_id or (ctx[0] if ctx is not None else "")
+        if not self.enabled or not tid:
+            yield _NULL_SPAN
+            return
+        parent = ctx[1] if (ctx is not None and ctx[0] == tid) else ""
+        sp = Span(trace_id=tid, span_id=_new_id(), parent_id=parent,
+                  name=name, start=time.time(), end=0.0,
+                  attrs={k: str(v) for k, v in (attrs or {}).items()})
+        token = _SPAN_CTX.set((tid, sp.span_id))
+        try:
+            yield sp
+        except BaseException as e:
+            sp.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            _SPAN_CTX.reset(token)
+            sp.end = time.time()
+            with self._lock:
+                self._spans.append(sp)
+
+    def record_span(self, name: str, trace_id: str, start: float,
+                    end: float, attrs: dict[str, str] | None = None,
+                    parent_id: str = "") -> None:
+        """Record a span measured out-of-band (e.g. a barrier wait whose
+        start was observed passes ago)."""
+        if not self.enabled or not trace_id:
+            return
+        sp = Span(trace_id=trace_id, span_id=_new_id(),
+                  parent_id=parent_id, name=name, start=start, end=end,
+                  attrs={k: str(v) for k, v in (attrs or {}).items()})
+        with self._lock:
+            self._spans.append(sp)
+
+    # ---- lifecycle milestones → SLO histograms ----
+
+    def note_created(self, obj) -> None:
+        """Milestone hook for Store.create: gang creation is the first
+        per-gang milestone (the root object's create is the trace
+        start, recorded by ensure/mint)."""
+        if obj.KIND != "PodGang":
+            return
+        tid = obj.meta.annotations.get(ANNOTATION_TRACE_ID, "")
+        self.milestone(tid, f"{obj.meta.namespace}/{obj.meta.name}",
+                       "gang_created", ts=obj.meta.creation_timestamp)
+
+    def milestone(self, trace_id: str, subject: str, phase: str,
+                  ts: float | None = None) -> None:
+        """First-write-wins milestone for (trace, subject). Reaching a
+        milestone observes the SLO histograms for the phase it closes;
+        repeats (condition flapping, re-reconciles) are ignored so each
+        gang contributes exactly one observation per phase."""
+        if not self.enabled or not trace_id:
+            return
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            key = (trace_id, subject)
+            m = self._milestones.get(key)
+            if m is None:
+                m = self._milestones[key] = {}
+                while len(self._milestones) > self.TRACE_CAPACITY:
+                    self._milestones.popitem(last=False)
+            if phase in m:
+                return
+            m[phase] = ts
+            # Anchor: trace mint time; a trace whose start was lost
+            # (ring eviction, restart) falls back to its first
+            # milestone so phase deltas stay right even when the
+            # absolute time-to-* is unmeasurable.
+            t0 = self._trace_start.get(trace_id,
+                                       m.get("gang_created", ts))
+            snapshot = dict(m)
+        self._observe(phase, snapshot, t0, ts)
+
+    @staticmethod
+    def _observe(phase: str, m: dict[str, float], t0: float,
+                 ts: float) -> None:
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+        def phase_obs(name: str, since: float) -> None:
+            GLOBAL_METRICS.observe("grove_lifecycle_phase_seconds",
+                                   max(0.0, ts - since), phase=name)
+
+        if phase == "gang_created":
+            phase_obs("create_to_gang", t0)
+        elif phase == "scheduled":
+            phase_obs("gang_to_scheduled", m.get("gang_created", t0))
+            GLOBAL_METRICS.observe("grove_gang_time_to_scheduled_seconds",
+                                   max(0.0, ts - t0))
+        elif phase == "started":
+            phase_obs("scheduled_to_started", m.get("scheduled", t0))
+        elif phase == "ready":
+            phase_obs("started_to_ready",
+                      m.get("started", m.get("scheduled", t0)))
+            GLOBAL_METRICS.observe("grove_gang_time_to_ready_seconds",
+                                   max(0.0, ts - t0))
+
+    # ---- export / inspection ----
+
+    def export(self, trace_id: str | None = None) -> dict:
+        """JSON-shaped dump for /debug/traces: spans (oldest first),
+        milestones, and trace start anchors — optionally filtered to
+        one trace."""
+        with self._lock:
+            spans = [dataclasses.asdict(s) for s in self._spans
+                     if trace_id is None or s.trace_id == trace_id]
+            milestones = [
+                {"trace_id": tid, "subject": subject,
+                 "phases": dict(phases)}
+                for (tid, subject), phases in self._milestones.items()
+                if trace_id is None or tid == trace_id]
+            starts = {tid: ts for tid, ts in self._trace_start.items()
+                      if trace_id is None or tid == trace_id}
+        return {"spans": spans, "milestones": milestones,
+                "starts": starts}
+
+    def reset(self) -> None:
+        """Drop all recorded state (test isolation)."""
+        with self._lock:
+            self._spans.clear()
+            self._trace_start.clear()
+            self._milestones.clear()
+
+
+def critical_path(spans: list[dict]) -> list[str]:
+    """Span ids on the chain from a root to the latest-finishing span —
+    the path that bounded the trace's wall time. Operates on the
+    dict shape ``Tracer.export`` (and the wire endpoint) returns."""
+    if not spans:
+        return []
+    by_id = {s["span_id"]: s for s in spans}
+    cur = max(spans, key=lambda s: s["end"])
+    path: list[str] = []
+    while cur is not None and cur["span_id"] not in path:
+        path.append(cur["span_id"])
+        cur = by_id.get(cur["parent_id"])
+    return list(reversed(path))
+
+
+GLOBAL_TRACER = Tracer()

@@ -22,12 +22,18 @@ from collections import defaultdict
 from typing import Optional
 
 from grove_tpu.api import Node, Pod, PodGang, constants as c, namegen
-from grove_tpu.api.meta import Condition, is_condition_true, set_condition
+from grove_tpu.api.meta import (
+    Condition,
+    is_condition_true,
+    set_condition,
+    trace_id_of,
+)
 from grove_tpu.api.podcliqueset import PodCliqueSet
 from grove_tpu.api.podgang import PodGangPhase
 from grove_tpu.api.serde import clone
 from grove_tpu.runtime.errors import ConflictError, NotFoundError
 from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.scheduler.placement import (
     DomainIndex,
     GroupRequest,
@@ -423,94 +429,19 @@ class GangBackend:
 
         placed_any = False
         preempted = False
+        trace_id = trace_id_of(gang)
 
         if not already_bound and group_ok and bindable:
             # First placement: gang-atomic plan over all present pods.
-            topo = gang.spec.topology
-            pack_level = topo.pack_level if topo else "slice"
-            required = topo.required if topo else True
-            spread = self._spread_penalties(gang, snap)
-
-            def req(p: Pod) -> PodRequest:
-                return PodRequest(p.meta.name, p.spec.tpu_chips,
-                                  dict(p.spec.node_selector))
-
-            grouped = any(grp.topology is not None and grp.topology.pack_level
-                          for grp in gang.spec.groups)
-
-            def make_plan_fn(pods: list[Pod]):
-                if grouped:
-                    # Per-group constraints: hierarchical planning (each
-                    # constrained group packed into its own sub-domain).
-                    by_pod = {p.meta.name: p for p in pods}
-                    greqs = []
-                    grouped_names: set[str] = set()
-                    for grp in gang.spec.groups:
-                        pods_in = [by_pod[n] for n in grp.pod_names
-                                   if n in by_pod]
-                        grouped_names.update(p.meta.name for p in pods_in)
-                        greqs.append(GroupRequest(
-                            [req(p) for p in pods_in],
-                            grp.topology.pack_level if grp.topology else "",
-                            grp.topology.required if grp.topology else True))
-                    stray = [req(p) for p in pods
-                             if p.meta.name not in grouped_names]
-                    if stray:
-                        greqs.append(GroupRequest(stray))
-                    return lambda hv, idx=None: plan_gang_grouped(
-                        greqs, hv, pack_level=pack_level, required=required,
-                        prefer_slice=self._reuse_slice(gang),
-                        spread_penalty=spread, domain_index=idx)
-                requests = [req(p) for p in pods]
-                return lambda hv, idx=None: plan_gang(
-                    requests, hv, pack_level=pack_level, required=required,
-                    prefer_slice=self._reuse_slice(gang),
-                    spread_penalty=spread, domain_index=idx)
-
-            plan_fn = make_plan_fn(bindable)
-            to_bind = bindable
-            plan = plan_fn(hosts, snap.index)
-            if plan is None and not self._try_preempt_for(gang, plan_fn,
-                                                          hosts):
-                # Min-floor fallback (reference GS5 semantics), tried
-                # only when preemption cannot seat the FULL gang: start
-                # with min_replicas per group; surplus pods stay pending
-                # and join via the straggler path when capacity appears.
-                # Candidate domains are restricted to those whose TOTAL
-                # capacity could hold the full gang — a required pack
-                # anchors stragglers to the floor's domain, and binding
-                # into an undersized one would cap the gang forever.
-                floor = self._floor_subset(gang, bindable)
-                if floor is not None and len(floor) < len(bindable):
-                    full_hosts = self._full_headroom_hosts(
-                        gang, bindable, snap)
-                    floor_plan = make_plan_fn(floor)(full_hosts)
-                    if floor_plan is not None:
-                        plan, to_bind = floor_plan, floor
-            elif plan is None:
-                preempted = True
-            if plan is not None:
-                self._bind(to_bind, plan.assignments, snap)
-                gang.status.assigned_slice = plan.slice_name
-                gang.status.placement_score = plan.score
-                placed_any = True
-                from grove_tpu.runtime.metrics import GLOBAL_METRICS
-                GLOBAL_METRICS.inc("grove_gang_placements_total")
-                snap.note_own_writes(self.recorder.event(
-                    gang, "Normal", "GangPlaced",
-                    f"{len(to_bind)} pods onto "
-                    f"{plan.slice_name or 'multiple domains'} "
-                    f"(score {plan.score:.2f})"
-                    + (f"; {len(bindable) - len(to_bind)} surplus pending"
-                       if len(to_bind) < len(bindable) else "")))
-            else:
-                # Preemption was already attempted above (one victim per
-                # pass); nothing fit and no floor was possible.
-                snap.note_own_writes(self.recorder.event(
-                    gang, "Warning", "GangUnschedulable",
-                    f"no {pack_level or 'slice'} domain fits "
-                    f"{len(bindable)} pods "
-                    f"({sum(p.spec.tpu_chips for p in bindable)} chips)"))
+            # The span covers plan + preempt + bind — the
+            # scheduler-placement phase of the gang's lifecycle trace
+            # (steady-state passes with nothing bindable record none).
+            with GLOBAL_TRACER.span(
+                    "sched.place", trace_id=trace_id or None,
+                    attrs={"gang": gang.meta.name,
+                           "pods": len(bindable)}) as span:
+                placed_any, preempted = self._place_initial(
+                    gang, snap, bindable, span)
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate with their siblings,
@@ -518,18 +449,121 @@ class GangBackend:
             # packs (gang-level AND group-level) are hard constraints —
             # better an unschedulable pod than a gang whose ICI
             # collectives can never re-form.
-            bound_domains = self._bound_domains(gang, existing, hosts)
-            for p in bindable:
-                pool = self._straggler_pool(gang, p, snap, bound_domains)
-                host = plan_single(
-                    PodRequest(p.meta.name, p.spec.tpu_chips,
-                               dict(p.spec.node_selector)),
-                    pool, prefer_slice=gang.status.assigned_slice)
-                if host is not None:
-                    self._bind([p], {p.meta.name: host}, snap)
-                    placed_any = True
+            with GLOBAL_TRACER.span(
+                    "sched.place", trace_id=trace_id or None,
+                    attrs={"gang": gang.meta.name, "straggler": "true",
+                           "pods": len(bindable)}):
+                bound_domains = self._bound_domains(gang, existing,
+                                                    snap.hosts)
+                for p in bindable:
+                    pool = self._straggler_pool(gang, p, snap,
+                                                bound_domains)
+                    host = plan_single(
+                        PodRequest(p.meta.name, p.spec.tpu_chips,
+                                   dict(p.spec.node_selector)),
+                        pool, prefer_slice=gang.status.assigned_slice)
+                    if host is not None:
+                        self._bind([p], {p.meta.name: host}, snap)
+                        placed_any = True
 
         self._update_status(gang, initialized, placed_any, snap)
+        return placed_any, preempted
+
+    def _place_initial(self, gang: PodGang, snap: PlacementSnapshot,
+                       bindable: list[Pod], span) -> tuple[bool, bool]:
+        """First gang-atomic placement (plan → preempt → min-floor
+        fallback → bind). Returns (placed_any, preempted)."""
+        hosts = snap.hosts
+        placed_any = False
+        preempted = False
+        topo = gang.spec.topology
+        pack_level = topo.pack_level if topo else "slice"
+        required = topo.required if topo else True
+        spread = self._spread_penalties(gang, snap)
+
+        def req(p: Pod) -> PodRequest:
+            return PodRequest(p.meta.name, p.spec.tpu_chips,
+                              dict(p.spec.node_selector))
+
+        grouped = any(grp.topology is not None and grp.topology.pack_level
+                      for grp in gang.spec.groups)
+
+        def make_plan_fn(pods: list[Pod]):
+            if grouped:
+                # Per-group constraints: hierarchical planning (each
+                # constrained group packed into its own sub-domain).
+                by_pod = {p.meta.name: p for p in pods}
+                greqs = []
+                grouped_names: set[str] = set()
+                for grp in gang.spec.groups:
+                    pods_in = [by_pod[n] for n in grp.pod_names
+                               if n in by_pod]
+                    grouped_names.update(p.meta.name for p in pods_in)
+                    greqs.append(GroupRequest(
+                        [req(p) for p in pods_in],
+                        grp.topology.pack_level if grp.topology else "",
+                        grp.topology.required if grp.topology else True))
+                stray = [req(p) for p in pods
+                         if p.meta.name not in grouped_names]
+                if stray:
+                    greqs.append(GroupRequest(stray))
+                return lambda hv, idx=None: plan_gang_grouped(
+                    greqs, hv, pack_level=pack_level, required=required,
+                    prefer_slice=self._reuse_slice(gang),
+                    spread_penalty=spread, domain_index=idx)
+            requests = [req(p) for p in pods]
+            return lambda hv, idx=None: plan_gang(
+                requests, hv, pack_level=pack_level, required=required,
+                prefer_slice=self._reuse_slice(gang),
+                spread_penalty=spread, domain_index=idx)
+
+        plan_fn = make_plan_fn(bindable)
+        to_bind = bindable
+        plan = plan_fn(hosts, snap.index)
+        if plan is None and not self._try_preempt_for(gang, plan_fn,
+                                                      hosts):
+            # Min-floor fallback (reference GS5 semantics), tried
+            # only when preemption cannot seat the FULL gang: start
+            # with min_replicas per group; surplus pods stay pending
+            # and join via the straggler path when capacity appears.
+            # Candidate domains are restricted to those whose TOTAL
+            # capacity could hold the full gang — a required pack
+            # anchors stragglers to the floor's domain, and binding
+            # into an undersized one would cap the gang forever.
+            floor = self._floor_subset(gang, bindable)
+            if floor is not None and len(floor) < len(bindable):
+                full_hosts = self._full_headroom_hosts(
+                    gang, bindable, snap)
+                floor_plan = make_plan_fn(floor)(full_hosts)
+                if floor_plan is not None:
+                    plan, to_bind = floor_plan, floor
+        elif plan is None:
+            preempted = True
+        if plan is not None:
+            self._bind(to_bind, plan.assignments, snap)
+            gang.status.assigned_slice = plan.slice_name
+            gang.status.placement_score = plan.score
+            placed_any = True
+            span.set_attr("slice", plan.slice_name or "multi-domain")
+            from grove_tpu.runtime.metrics import GLOBAL_METRICS
+            GLOBAL_METRICS.inc("grove_gang_placements_total")
+            snap.note_own_writes(self.recorder.event(
+                gang, "Normal", "GangPlaced",
+                f"{len(to_bind)} pods onto "
+                f"{plan.slice_name or 'multiple domains'} "
+                f"(score {plan.score:.2f})"
+                + (f"; {len(bindable) - len(to_bind)} surplus pending"
+                   if len(to_bind) < len(bindable) else "")))
+        else:
+            # Preemption was already attempted above (one victim per
+            # pass); nothing fit and no floor was possible.
+            span.set_error("unschedulable" if not preempted
+                           else "preempting")
+            snap.note_own_writes(self.recorder.event(
+                gang, "Warning", "GangUnschedulable",
+                f"no {pack_level or 'slice'} domain fits "
+                f"{len(bindable)} pods "
+                f"({sum(p.spec.tpu_chips for p in bindable)} chips)"))
         return placed_any, preempted
 
     def _floor_subset(self, gang: PodGang,
@@ -762,6 +796,13 @@ class GangBackend:
 
     def _bind(self, pods: list[Pod], assignment: dict[str, str],
               snap: PlacementSnapshot) -> None:
+        trace_id = trace_id_of(pods[0]) if pods else ""
+        with GLOBAL_TRACER.span("sched.bind", trace_id=trace_id or None,
+                                attrs={"pods": len(pods)}):
+            self._bind_traced(pods, assignment, snap)
+
+    def _bind_traced(self, pods: list[Pod], assignment: dict[str, str],
+                     snap: PlacementSnapshot) -> None:
         to_write = []
         for pod in pods:
             host = assignment.get(pod.meta.name)
@@ -794,6 +835,19 @@ class GangBackend:
                     if is_condition_true(p.status.conditions, c.COND_READY))
         scheduled = expected > 0 and bound >= sum(
             g.min_replicas for g in gang.spec.groups)
+        all_ready = bool(expected) and ready == expected
+        # Lifecycle milestones for the SLO histograms: recorded on the
+        # condition's first flip (the tracer dedups repeats, so the
+        # prior-state checks only save the call at steady state).
+        trace_id = trace_id_of(gang)
+        if trace_id:
+            subject = f"{gang.meta.namespace}/{gang.meta.name}"
+            if scheduled and not is_condition_true(gang.status.conditions,
+                                                   c.COND_SCHEDULED):
+                GLOBAL_TRACER.milestone(trace_id, subject, "scheduled")
+            if all_ready and not is_condition_true(gang.status.conditions,
+                                                   c.COND_READY):
+                GLOBAL_TRACER.milestone(trace_id, subject, "ready")
         conds = gang.status.conditions
         conds = set_condition(conds, Condition(
             type=c.COND_INITIALIZED, status="True" if initialized else "False",
@@ -803,10 +857,10 @@ class GangBackend:
             reason="GangPlaced" if scheduled else "AwaitingPlacement"))
         conds = set_condition(conds, Condition(
             type=c.COND_READY,
-            status="True" if (expected and ready == expected) else "False",
+            status="True" if all_ready else "False",
             reason=f"{ready}/{expected} ready"))
         gang.status.conditions = conds
-        if expected and ready == expected:
+        if all_ready:
             gang.status.phase = PodGangPhase.RUNNING
         elif scheduled:
             gang.status.phase = PodGangPhase.STARTING

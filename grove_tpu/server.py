@@ -31,6 +31,11 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       config.profiling.enabled
   GET  /debug/stacks                  all-threads stack dump (goroutine
                                       dump analog; same gate)
+  GET  /debug/traces                  gang-lifecycle flight recorder:
+                                      raw spans + milestones
+                                      (?trace_id= filters one trace;
+                                      grovectl trace renders it; same
+                                      gate)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -400,6 +405,8 @@ class ApiServer:
                         self._debug_profile(parse_qs(url.query))
                     elif url.path == "/debug/stacks":
                         self._debug_stacks()
+                    elif url.path == "/debug/traces":
+                        self._debug_traces(parse_qs(url.query))
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -661,6 +668,16 @@ class ApiServer:
                 if self._profiling_config() is None:
                     return
                 self._send(200, dump_stacks(), content_type="text/plain")
+
+            def _debug_traces(self, q):
+                """GET /debug/traces[?trace_id=] — the lifecycle
+                flight recorder's raw spans + milestones (grovectl
+                trace renders them). Same gate as /debug/profile:
+                traces expose object names and timings."""
+                if self._profiling_config() is None:
+                    return
+                tid = q.get("trace_id", [None])[0]
+                self._send(200, cluster.manager.tracer.export(tid))
 
             def _workload_owns(self, actor: str, payload: dict) -> bool:
                 """A workload actor (system:workload:<ns>:<pcs>) may only

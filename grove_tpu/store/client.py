@@ -100,6 +100,13 @@ class Client:
               selector: dict[str, str] | None = None) -> Watcher:
         return self._store.watch(kinds, selector)
 
+    def debug_traces(self, trace_id: str | None = None) -> dict:
+        """Raw lifecycle-trace dump ({"spans", "milestones", "starts"})
+        — the in-process twin of ``GET /debug/traces``, so tests and
+        tooling read one shape against either client surface."""
+        from grove_tpu.runtime.trace import GLOBAL_TRACER
+        return GLOBAL_TRACER.export(trace_id)
+
 
 @dataclasses.dataclass
 class _InjectedError:

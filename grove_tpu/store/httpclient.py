@@ -197,6 +197,15 @@ class HttpClient:
         self._request("DELETE", f"/api/{kind_cls.KIND}/{quote(name)}"
                                 f"?{urlencode({'namespace': namespace})}")
 
+    def debug_traces(self, trace_id: str | None = None) -> dict:
+        """Lifecycle-trace dump from ``GET /debug/traces`` (the wire
+        twin of ``Client.debug_traces``; requires profiling.enabled on
+        the server — 404 maps to NotFoundError)."""
+        path = "/debug/traces"
+        if trace_id:
+            path += f"?{urlencode({'trace_id': trace_id})}"
+        return self._request("GET", path)
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

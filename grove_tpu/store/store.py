@@ -24,6 +24,7 @@ import uuid
 from typing import Any, Callable, Iterable, NamedTuple
 
 from grove_tpu.api.serde import clone, to_dict
+from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.runtime.errors import (
     AlreadyExistsError,
     ConflictError,
@@ -413,10 +414,17 @@ class Store:
                 stored.meta.uid = str(uuid.uuid4())
             if not stored.meta.creation_timestamp:
                 stored.meta.creation_timestamp = time.time()
+            # Lifecycle trace id: inherited from the object's own
+            # annotation (controllers pre-stamp children with their
+            # parent's id) or the creating span's context, minted fresh
+            # otherwise — the Dapper-style root of the create→ready
+            # trace every later pipeline stage appends spans to.
+            GLOBAL_TRACER.ensure(stored.meta)
             stored.meta.resource_version = next(self._rv)
             stored.meta.generation = 1
             objs[key] = stored
             self._persist_put(stored)
+            GLOBAL_TRACER.note_created(stored)
             self._emit(EventType.ADDED, stored)
             return clone(stored)
 

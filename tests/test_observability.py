@@ -137,6 +137,56 @@ def test_unschedulable_event(cluster):
     assert evs2[0].count - evs1[0].count <= 1
 
 
+def test_event_type_escalates_on_dedup_bump():
+    """The dedup bump must carry the CURRENT event type: a condition
+    escalating Normal → Warning under one reason has to surface as
+    Warning, not keep the stale type forever."""
+    from grove_tpu.api import PodGang
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.runtime.events import EventRecorder
+    from grove_tpu.store.client import Client
+    from grove_tpu.store.store import Store
+
+    client = Client(Store())
+    gang = client.create(PodGang(meta=new_meta("g1")))
+    rec = EventRecorder(client, "test", min_interval=0.0)
+    assert rec.event(gang, "Normal", "CapacityLow", "tight") == 1
+    assert rec.event(gang, "Warning", "CapacityLow", "exhausted") == 1
+    evs = events_for(client, "PodGang", "g1")
+    assert len(evs) == 1
+    assert evs[0].type == "Warning" and evs[0].count == 2
+    assert evs[0].message == "exhausted"
+
+
+def test_setup_logging_repeat_call_updates_level_and_format():
+    """A second setup_logging call with a different level/format must
+    update the existing handlers, not silently keep the first
+    configuration."""
+    import logging
+
+    from grove_tpu.runtime.logger import _JsonFormatter, setup_logging
+
+    root = logging.getLogger("grove")
+    saved = (root.level, [(h, h.formatter) for h in root.handlers])
+    try:
+        setup_logging("info", "text")
+        assert root.level == logging.INFO
+        n_handlers = len(root.handlers)
+        setup_logging("debug", "json")
+        assert root.level == logging.DEBUG
+        assert len(root.handlers) == n_handlers  # no duplicates
+        assert all(isinstance(h.formatter, _JsonFormatter)
+                   for h in root.handlers)
+        setup_logging("warning", "text")
+        assert root.level == logging.WARNING
+        assert not any(isinstance(h.formatter, _JsonFormatter)
+                       for h in root.handlers)
+    finally:
+        root.setLevel(saved[0])
+        for h, fmt in saved[1]:
+            h.setFormatter(fmt)
+
+
 def test_service_endpoints_published(cluster):
     client = cluster.client
     client.create(simple_pcs(name="disco"))

@@ -35,6 +35,24 @@ def cluster():
         yield cl
 
 
+def _edit_spec(cl, name, mutate):
+    """Conflict-retried spec edit: the PCS controller writes the object
+    on its own cadence (finalizer, status), so a bare get-mutate-update
+    races it — the same optimistic-concurrency dance client.patch
+    automates (and test_availability's rollout edit already does).
+    Returns the updated object (for generation_hash on the new spec)."""
+    from grove_tpu.runtime.errors import ConflictError
+    for _ in range(10):
+        live = cl.client.get(PodCliqueSet, name)
+        mutate(live)
+        try:
+            cl.client.update(live)
+            return live
+        except ConflictError:
+            continue
+    raise AssertionError(f"spec edit on {name} kept conflicting")
+
+
 def _pcs(name="pcs", replicas=4, min_available=3, image="v1"):
     return PodCliqueSet(
         meta=new_meta(name),
@@ -106,10 +124,9 @@ def test_image_tweak_rolls_pods_without_gang_teardown(cluster):
             floor_violations.append(n)
         return n
 
-    live = cl.client.get(PodCliqueSet, "pcs")
-    live.spec.template.cliques[0].container = ContainerSpec(
-        argv=["serve", "v2"])
-    cl.client.update(live)
+    live = _edit_spec(cl, "pcs", lambda o: setattr(
+        o.spec.template.cliques[0], "container",
+        ContainerSpec(argv=["serve", "v2"])))
     new_hash = generation_hash(live)
     assert new_hash != old_hash
 
@@ -139,9 +156,8 @@ def test_structural_change_still_recreates_replica(cluster):
 
     # A chip resize is structural: gangs must be re-planned, so the
     # replica-recreation rollout engages.
-    live = cl.client.get(PodCliqueSet, "pcs")
-    live.spec.template.cliques[0].tpu_chips_per_pod = 4
-    cl.client.update(live)
+    live = _edit_spec(cl, "pcs", lambda o: setattr(
+        o.spec.template.cliques[0], "tpu_chips_per_pod", 4))
 
     new_hash = generation_hash(live)
     wait_for(lambda: _all_ready_at(cl, new_hash, 4), timeout=30.0,
@@ -160,9 +176,8 @@ def test_scale_out_does_not_roll_pods(cluster):
     wait_for(lambda: _all_ready_at(cl, h, 4), timeout=15.0, desc="up")
     before = {p.meta.name: p.meta.uid for p in _pods(cl)}
 
-    live = cl.client.get(PodCliqueSet, "pcs")
-    live.spec.template.cliques[0].replicas = 5
-    cl.client.update(live)
+    _edit_spec(cl, "pcs", lambda o: setattr(
+        o.spec.template.cliques[0], "replicas", 5))
     wait_for(lambda: _all_ready_at(cl, h, 5), timeout=20.0,
              desc="scaled to 5 at the SAME hash")
     after = {p.meta.name: p.meta.uid for p in _pods(cl)}
@@ -189,10 +204,9 @@ def test_rolling_update_in_scaling_group_keeps_scaled_gangs(cluster):
     gang_uids = {g.meta.name: g.meta.uid for g in cl.client.list(PodGang)}
     assert len(gang_uids) == 2  # base + one scaled
 
-    live = cl.client.get(PodCliqueSet, "sgpcs")
-    live.spec.template.cliques[0].container = ContainerSpec(
-        argv=["serve", "v2"])
-    cl.client.update(live)
+    live = _edit_spec(cl, "sgpcs", lambda o: setattr(
+        o.spec.template.cliques[0], "container",
+        ContainerSpec(argv=["serve", "v2"])))
     new_hash = generation_hash(live)
     wait_for(lambda: _all_ready_at(cl, new_hash, 4, name="sgpcs"),
              timeout=30.0, desc="sg rollout complete")

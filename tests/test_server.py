@@ -227,6 +227,62 @@ def test_health_metrics_and_errors(server):
                        bad.replace("websvc", "broken"))
     assert status == 400 and "min_available" in err["error"]
 
+def test_debug_endpoints_profiling_gate_and_auth():
+    """/debug/profile, /debug/stacks, and /debug/traces share one gate:
+    404 while profiling is disabled (the endpoints 'don't exist',
+    pprof-style), served when enabled — and behind the reads-token auth
+    when the config requires it."""
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x2",
+                                        count=1)])
+    paths = ("/debug/profile?seconds=0.05", "/debug/stacks",
+             "/debug/traces")
+
+    # Default config: profiling disabled → every surface 404s.
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for path in paths:
+                s, err = _req(f"{base}{path}", token="")
+                assert s == 404, path
+                assert "profiling" in err["error"], path
+        finally:
+            srv.stop()
+
+    # Enabled + reads requiring a token: anonymous 401, authed 200.
+    cfg = OperatorConfiguration()
+    cfg.profiling.enabled = True
+    cfg.server_auth.tokens[OPERATOR_TOKEN] = OPERATOR_ACTOR
+    cfg.server_auth.require_token_for_reads = True
+    cl = new_cluster(config=cfg, fleet=fleet)
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for path in paths:
+                s, _ = _req(f"{base}{path}", token="")
+                assert s == 401, path
+            s, prof = _req(f"{base}/debug/profile?seconds=0.05&format=top",
+                           token=OPERATOR_TOKEN)
+            assert s == 200 and "top" in prof
+            s, stacks = _req(f"{base}/debug/stacks", token=OPERATOR_TOKEN)
+            assert s == 200 and "thread" in stacks
+            s, traces = _req(f"{base}/debug/traces", token=OPERATOR_TOKEN)
+            assert s == 200
+            assert set(traces) == {"spans", "milestones", "starts"}
+            # ?trace_id= filters server-side.
+            s, none = _req(f"{base}/debug/traces?trace_id=deadbeef",
+                           token=OPERATOR_TOKEN)
+            assert s == 200 and none["spans"] == []
+        finally:
+            srv.stop()
+
+
 def test_grovectl_cordon_drain_uncordon(server, capsys):
     """kubectl node-ops parity over the wire: cordon marks the node
     unschedulable, --drain fails its pods (gang self-heal reschedules

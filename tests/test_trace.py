@@ -1,0 +1,281 @@
+"""End-to-end gang lifecycle tracing: trace-id propagation across the
+object tree, the span tree spanning controllers → scheduler → agent,
+time-to-ready SLO histograms, and ``grovectl trace``."""
+
+import math
+
+import pytest
+
+from grove_tpu.api import Pod, PodCliqueSet, PodGang, constants as c
+from grove_tpu.api.meta import trace_id_of
+from grove_tpu.cluster import new_cluster
+from grove_tpu.runtime.trace import (
+    ANNOTATION_TRACE_ID,
+    GLOBAL_TRACER,
+    Tracer,
+    critical_path,
+)
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def _ready_pcs(cluster, name):
+    client = cluster.client
+    client.create(simple_pcs(name=name))
+    wait_for(lambda: client.get(
+        PodCliqueSet, name).status.available_replicas == 1, desc="up")
+    return trace_id_of(client.get(PodCliqueSet, name))
+
+
+def test_trace_id_minted_and_propagated(cluster):
+    """One trace id, minted at the PCS create, reaches every object of
+    the tree — PodGang and Pods included — via annotation stamping."""
+    client = cluster.client
+    tid = _ready_pcs(cluster, "tr1")
+    assert tid and len(tid) == 16
+    gang = client.get(PodGang, "tr1-0")
+    assert gang.meta.annotations[ANNOTATION_TRACE_ID] == tid
+    pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "tr1"})
+    assert len(pods) == 3
+    assert all(p.meta.annotations[ANNOTATION_TRACE_ID] == tid
+               for p in pods)
+    # A second PCS gets its own trace.
+    tid2 = _ready_pcs(cluster, "tr1b")
+    assert tid2 != tid
+
+
+def test_span_tree_covers_pipeline(cluster):
+    """The acceptance-criterion trace: one trace from create to ready
+    whose spans cover at least controller-reconcile,
+    scheduler-placement, and agent-start."""
+    tid = _ready_pcs(cluster, "tr2")
+    data = cluster.client.debug_traces(tid)
+    spans = data["spans"]
+    assert spans and all(s["trace_id"] == tid for s in spans)
+    names = {s["name"] for s in spans}
+    assert "reconcile.podcliqueset" in names
+    assert "reconcile.podclique" in names
+    assert "sched.place" in names and "sched.bind" in names
+    assert "agent.start" in names
+    # sched.bind parents under sched.place (same-thread context).
+    bind = next(s for s in spans if s["name"] == "sched.bind")
+    place = next(s for s in spans if s["name"] == "sched.place")
+    assert bind["parent_id"] == place["span_id"]
+    # Spans carry wall-clock windows.
+    assert all(s["end"] >= s["start"] > 0 for s in spans)
+    # Critical path: non-empty, ends at the latest-finishing span.
+    cp = critical_path(spans)
+    assert cp
+    by_id = {s["span_id"]: s for s in spans}
+    assert by_id[cp[-1]]["end"] == max(s["end"] for s in spans)
+
+    # Milestones: the full create → ready ladder for the gang.
+    miles = {m["subject"]: m["phases"] for m in data["milestones"]}
+    phases = miles["default/tr2-0"]
+    assert {"gang_created", "scheduled", "started", "ready"} <= set(phases)
+    t0 = data["starts"][tid]
+    assert t0 <= phases["gang_created"] <= phases["scheduled"]
+    assert phases["scheduled"] <= phases["ready"]
+
+
+def test_slo_histograms_render_with_pinned_buckets(cluster):
+    """grove_gang_time_to_{scheduled,ready}_seconds and the per-phase
+    histogram render in /metrics with the pinned LIFECYCLE_BUCKETS."""
+    from grove_tpu.runtime import metrics as m
+    _ready_pcs(cluster, "tr3")
+    text = cluster.manager.metrics_text()
+    want = set(m.LIFECYCLE_BUCKETS) | {math.inf}
+    for name in ("grove_gang_time_to_scheduled_seconds",
+                 "grove_gang_time_to_ready_seconds"):
+        assert f"# TYPE {name} histogram" in text
+        hist = m.parse_histograms(text, name)
+        cum = next(iter(hist.values()))
+        assert set(cum) == want, name
+        assert cum[math.inf] >= 1, name
+    ph = m.parse_histograms(text, "grove_lifecycle_phase_seconds")
+    phases = {dict(labels).get("phase") for labels in ph}
+    assert {"create_to_gang", "gang_to_scheduled",
+            "scheduled_to_started", "started_to_ready"} <= phases
+    # Sanity: a CPU-cluster bring-up is sub-10s, so the ready quantile
+    # must interpolate inside the finite buckets.
+    cum = next(iter(m.parse_histograms(
+        text, "grove_gang_time_to_ready_seconds").values()))
+    assert 0 < m.quantile_from_buckets(0.5, cum) <= 10.0
+
+
+def test_barrier_wait_span_recorded_for_ordered_startup(cluster):
+    """A pod held at its startup-ordering barrier gets one
+    agent.barrier_wait span covering the whole wait, ending where its
+    agent.start begins."""
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+    )
+    client = cluster.client
+    pcs = PodCliqueSet(
+        meta=new_meta("ord"),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[
+                PodCliqueTemplate(name="a", replicas=1,
+                                  container=ContainerSpec(
+                                      argv=["sleep", "inf"]),
+                                  tpu_chips_per_pod=4),
+                PodCliqueTemplate(name="b", replicas=1,
+                                  starts_after=["a"],
+                                  container=ContainerSpec(
+                                      argv=["sleep", "inf"]),
+                                  tpu_chips_per_pod=4),
+            ])))
+    client.create(pcs)
+    wait_for(lambda: client.get(
+        PodCliqueSet, "ord").status.available_replicas == 1, desc="up")
+    tid = trace_id_of(client.get(PodCliqueSet, "ord"))
+    spans = cluster.client.debug_traces(tid)["spans"]
+    waits = [s for s in spans if s["name"] == "agent.barrier_wait"]
+    starts = {s["attrs"]["pod"]: s for s in spans
+              if s["name"] == "agent.start"}
+    assert any(w["attrs"]["pod"].startswith("ord-0-b-")
+               for w in waits), [s["name"] for s in spans]
+    w = next(w for w in waits if w["attrs"]["pod"].startswith("ord-0-b-"))
+    assert w["end"] >= w["start"]
+    # The wait ends where the start begins (same t_start sample).
+    assert w["end"] == starts[w["attrs"]["pod"]]["start"]
+
+
+def test_milestones_dedup_one_observation_per_gang():
+    """A gang contributes exactly one observation per phase no matter
+    how often conditions re-flip (first-write-wins)."""
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS, parse_histograms
+    tracer = Tracer()
+    tid = tracer.mint(ts=100.0)
+    before = parse_histograms(
+        GLOBAL_METRICS.render(),
+        "grove_gang_time_to_ready_seconds")
+    n_before = next(iter(before.values()), {}).get(math.inf, 0)
+    for _ in range(5):
+        tracer.milestone(tid, "ns/g", "gang_created", ts=100.5)
+        tracer.milestone(tid, "ns/g", "scheduled", ts=101.0)
+        tracer.milestone(tid, "ns/g", "ready", ts=102.0)
+    after = parse_histograms(
+        GLOBAL_METRICS.render(),
+        "grove_gang_time_to_ready_seconds")
+    n_after = next(iter(after.values()))[math.inf]
+    assert n_after == n_before + 1
+
+
+def test_span_context_nesting_and_noop_paths():
+    """Nested spans parent correctly; spans without any trace are
+    no-ops (no ring entry); disabled tracers record nothing."""
+    tracer = Tracer()
+    tid = tracer.mint()
+    with tracer.span("outer", trace_id=tid) as outer:
+        with tracer.span("inner") as inner:  # inherits via context
+            inner.set_attr("k", "v")
+    spans = tracer.export(tid)["spans"]
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner_d = spans[0]
+    outer_d = spans[1]
+    assert inner_d["parent_id"] == outer_d["span_id"]
+    assert inner_d["attrs"] == {"k": "v"}
+    # No ambient trace, no explicit id → nothing recorded.
+    with tracer.span("orphan"):
+        pass
+    assert len(tracer.export()["spans"]) == 2
+    # Errors mark the span and propagate.
+    with pytest.raises(ValueError):
+        with tracer.span("boom", trace_id=tid):
+            raise ValueError("nope")
+    boom = tracer.export(tid)["spans"][-1]
+    assert boom["name"] == "boom" and "nope" in boom["error"]
+    # Disabled: ids still mintable, spans dropped.
+    off = Tracer()
+    off.enabled = False
+    with off.span("x", trace_id="abc"):
+        pass
+    assert off.export()["spans"] == []
+
+
+def test_grovectl_trace_renders_span_tree(capsys):
+    """grovectl trace <kind>/<name> reconstructs the lifecycle from a
+    serve daemon: milestones, per-phase durations, span tree, critical
+    path (the acceptance-criterion CLI surface)."""
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.cli import main
+    from grove_tpu.server import ApiServer
+
+    cfg = OperatorConfiguration()
+    cfg.profiling.enabled = True  # the /debug/traces gate
+    cl = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            cl.client.create(simple_pcs(name="trc"))
+            wait_for(lambda: cl.client.get(
+                PodCliqueSet, "trc").status.available_replicas == 1,
+                desc="up")
+            assert main(["trace", "PodCliqueSet/trc",
+                         "--server", base]) == 0
+            out = capsys.readouterr().out
+            assert "trace " in out and "gang default/trc-0" in out
+            assert "time-to-ready" in out and "time-to-scheduled" in out
+            for name in ("reconcile.podcliqueset", "reconcile.podclique",
+                         "sched.place", "agent.start"):
+                assert name in out, out
+            assert "* " in out  # critical path starred
+            # PodGang/Pod entry points resolve the SAME trace.
+            assert main(["trace", "PodGang/trc-0", "--server", base]) == 0
+            assert "gang default/trc-0" in capsys.readouterr().out
+            # Error paths: unknown object, malformed target.
+            assert main(["trace", "PodCliqueSet/ghost",
+                         "--server", base]) == 1
+            assert main(["trace", "notaslash", "--server", base]) == 1
+            capsys.readouterr()
+        finally:
+            srv.stop()
+
+
+def test_debug_traces_endpoint_wire_shape():
+    """HttpClient.debug_traces mirrors Client.debug_traces (one shape
+    for in-process and wire consumers); filtering by trace id works."""
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.server import ApiServer
+    from grove_tpu.store.httpclient import HttpClient
+
+    cfg = OperatorConfiguration()
+    cfg.profiling.enabled = True
+    cl = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        try:
+            cl.client.create(simple_pcs(name="wire", pods=2, chips=4))
+            wait_for(lambda: cl.client.get(
+                PodCliqueSet, "wire").status.available_replicas == 1,
+                desc="up")
+            tid = trace_id_of(cl.client.get(PodCliqueSet, "wire"))
+            hc = HttpClient(f"http://127.0.0.1:{srv.port}")
+            wire = hc.debug_traces(tid)
+            local = cl.client.debug_traces(tid)
+            assert set(wire) == {"spans", "milestones", "starts"}
+            assert {s["name"] for s in wire["spans"]} == \
+                {s["name"] for s in local["spans"]}
+            assert all(s["trace_id"] == tid for s in wire["spans"])
+        finally:
+            srv.stop()

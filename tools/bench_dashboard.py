@@ -34,10 +34,33 @@ def load_rows(paths: list[str]) -> list[dict]:
 
 def render(rows: list[dict]) -> str:
     out = ["# Bench history", ""]
-    ok_all = [r for r in rows if r.get("value", 0) > 0]
+    # Control-plane rows (CPU-measured: scheduler/reconcile latency,
+    # gang time-to-ready) get their own sections — they are ms-scale
+    # latencies, not tok/s, and would render as nonsense in the
+    # serving table.
+    ready = [r for r in rows if r.get("metric") == "gang_time_to_ready_ms"
+             and r.get("value", 0) > 0]
+    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu"}
+    ok_all = [r for r in rows if r.get("value", 0) > 0
+              and r.get("mode") not in cp_modes]
     failed = [r for r in rows if r.get("value", 0) <= 0]
     disagg = [r for r in ok_all if r.get("mode") == "disagg"]
     ok = [r for r in ok_all if r.get("mode") != "disagg"]
+    if ready:
+        out += ["## Gang time-to-ready (lifecycle trace, CPU control "
+                "plane)", "",
+                "| when | git | gangs | pods | p50 ms | p95 ms | "
+                "scheduled p50 ms | reps |",
+                "|---|---|---|---|---|---|---|---|"]
+        for r in sorted(ready, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('gangs', '?')} | {r.get('pods', '?')} "
+                f"| {r.get('value', 0):.1f} "
+                f"| {r.get('p95_ms', 0):.1f} "
+                f"| {r.get('scheduled_p50_ms', 0):.1f} "
+                f"| {r.get('reps', '?')} |")
+        out.append("")
     if ok:
         out += ["## Successful runs", "",
                 "| when | git | model | batch | quant | tok/s/chip | "

@@ -1,0 +1,158 @@
+"""Trace-enabled 1-gang smoke: create → ready with a span-tree assertion.
+
+The lifecycle-tracing layer's CI gate (wired into ``make ci``): brings
+up an in-process cluster with a fake v5e slice, creates a single-gang
+PodCliqueSet, waits for Ready, and asserts that
+
+- the trace id propagated PCS → PodGang → Pods,
+- the span tree covers controller-reconcile, scheduler-placement, and
+  agent-start,
+- all four lifecycle milestones landed, and
+- ``grove_gang_time_to_ready_seconds`` rendered in /metrics with its
+  pinned buckets.
+
+With ``--history`` it also appends a ``gang_time_to_ready_ms`` row
+(p50/p95 over ``--reps`` create→ready cycles) to
+``bench-history/history.jsonl`` — the rows tools/bench_dashboard.py
+plots as time-to-ready percentiles.
+
+    python tools/trace_smoke.py [--reps 3] [--history] [--timeout 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_SPANS = ("reconcile.podcliqueset", "reconcile.podclique",
+                  "sched.place", "agent.start")
+REQUIRED_MILESTONES = ("gang_created", "scheduled", "started", "ready")
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def one_cycle(cluster, name: str, timeout: float) -> dict:
+    """Create a 1-gang PCS, wait for Ready, return its milestone dict;
+    deletes the PCS afterwards so cycles don't contend."""
+    from grove_tpu.api import PodCliqueSet
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import new_meta, trace_id_of
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+
+    client = cluster.client
+    pcs = PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(
+            replicas=1,
+            template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, min_available=2,
+                    container=ContainerSpec(argv=["sleep", "inf"]),
+                    tpu_chips_per_pod=4)],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True))))
+    client.create(pcs)
+    wait_for(lambda: client.get(PodCliqueSet, name)
+             .status.available_replicas == 1, timeout, f"{name} ready")
+    tid = trace_id_of(client.get(PodCliqueSet, name))
+    assert tid, "PCS carries no trace id"
+    data = client.debug_traces(tid)
+    miles = {m["subject"]: m["phases"] for m in data["milestones"]}
+    phases = miles.get(f"default/{name}-0", {})
+    missing = [p for p in REQUIRED_MILESTONES if p not in phases]
+    assert not missing, f"milestones missing {missing}: {phases}"
+    t0 = data["starts"].get(tid, phases["gang_created"])
+    result = {
+        "trace_id": tid,
+        "spans": data["spans"],
+        "time_to_scheduled_s": phases["scheduled"] - t0,
+        "time_to_ready_s": phases["ready"] - t0,
+    }
+    client.delete(PodCliqueSet, name)
+    wait_for(lambda: not client.list(PodCliqueSet), timeout,
+             f"{name} deleted")
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="trace-smoke")
+    parser.add_argument("--reps", type=int, default=3,
+                        help="create→ready cycles (percentile source)")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--history", action="store_true",
+                        help="append a gang_time_to_ready_ms row to "
+                             "bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cluster = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    cycles = []
+    with cluster:
+        for i in range(max(1, args.reps)):
+            cycles.append(one_cycle(cluster, f"smoke{i}", args.timeout))
+
+    # Span-tree assertion on the first cycle's trace.
+    names = {s["name"] for s in cycles[0]["spans"]}
+    missing = [n for n in REQUIRED_SPANS if n not in names]
+    assert not missing, f"span tree missing {missing}; got {sorted(names)}"
+
+    # The SLO surface rendered with its pinned buckets.
+    from grove_tpu.runtime import metrics as m
+    text = cluster.manager.metrics_text()
+    assert "# TYPE grove_gang_time_to_ready_seconds histogram" in text
+    hist = m.parse_histograms(text, "grove_gang_time_to_ready_seconds")
+    cum = next(iter(hist.values()))
+    want = set(m.LIFECYCLE_BUCKETS) | {float("inf")}
+    assert set(cum) == want, f"buckets drifted: {sorted(cum)}"
+    assert cum[float("inf")] >= len(cycles)
+
+    ttr = sorted(c["time_to_ready_s"] for c in cycles)
+    tts = sorted(c["time_to_scheduled_s"] for c in cycles)
+    p50 = statistics.median(ttr)
+    p95 = ttr[min(len(ttr) - 1, int(0.95 * len(ttr)))]
+    print(f"trace smoke OK: {len(cycles)} cycles, "
+          f"time-to-ready p50={p50 * 1e3:.1f}ms p95={p95 * 1e3:.1f}ms, "
+          f"time-to-scheduled p50={statistics.median(tts) * 1e3:.1f}ms, "
+          f"spans={sorted(names)}")
+
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "gang_time_to_ready_ms",
+            "value": round(p50 * 1e3, 3),
+            "unit": "ms",
+            "p95_ms": round(p95 * 1e3, 3),
+            "scheduled_p50_ms": round(statistics.median(tts) * 1e3, 3),
+            "gangs": 1,
+            "pods": 2,
+            "reps": len(cycles),
+            "mode": "trace-cpu",
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
