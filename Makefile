@@ -79,6 +79,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# assertion (lifecycle tracing's CI gate; --history plots
 	@# time-to-ready percentiles on the bench dashboard).
 	$(PY) tools/trace_smoke.py --reps 1
+	@# explainability smoke: an oversized gang must produce a
+	@# chip-shortfall diagnosis that grovectl explain names (and the
+	@# PENDING-REASON column + unschedulable gauge render).
+	$(PY) tools/explain_smoke.py
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

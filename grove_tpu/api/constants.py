@@ -77,6 +77,9 @@ COND_UNHEALTHY = "Unhealthy"
 COND_DISRUPTION_TARGET = "DisruptionTarget"
 COND_MIN_AVAILABLE_BREACHED = "MinAvailableBreached"
 COND_PCLQ_SCHEDULED = "PodCliqueScheduled"
+# Placement explainability: carries the scheduler's diagnosis headline
+# (PodGangStatus.last_diagnosis.reason) while a gang cannot be placed.
+COND_UNSCHEDULABLE = "Unschedulable"
 
 # ---- defaults ----
 DEFAULT_TERMINATION_DELAY_SECONDS = 4 * 3600.0  # reference default 4h

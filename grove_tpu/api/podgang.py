@@ -52,12 +52,69 @@ class PodGangSpec:
 
 
 @dataclasses.dataclass
+class DomainDiagnosis:
+    """One candidate domain's verdict in a failed placement attempt."""
+
+    domain: str = ""
+    level: str = ""              # topology level the domain lives at
+    free_chips: int = 0
+    total_chips: int = 0
+    verdict: str = ""            # chip-shortfall | fragmented | selector-mismatch
+    detail: str = ""
+    spread_penalty: float = 0.0
+    closest: bool = False        # the closest-fit candidate (CLI stars it)
+
+
+@dataclasses.dataclass
+class PreemptionDiagnosis:
+    """Why preemption did (not) free capacity for the gang."""
+
+    verdict: str = ""            # not-eligible | no-victims | victims-insufficient
+    victims_considered: int = 0
+    victim_chips: int = 0
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class PlacementDiagnosis:
+    """Structured "why is this gang pending" record, built by the gang
+    scheduler on FAILED placement attempts only (the happy path never
+    pays for it; GROVE_EXPLAIN=0 disables it entirely). Bounded: at
+    most top-K candidate domains are retained (``domains_total`` keeps
+    the full candidate count honest)."""
+
+    reason: str = ""             # ChipShortfall | TopologyPruned | Fragmented |
+                                 # SelectorMismatch | PreemptionRejected |
+                                 # StragglerUnplaced
+    message: str = ""
+    attempts: int = 0            # recorded failed attempts (refresh-throttled)
+    first_failure_time: float = 0.0
+    last_attempt_time: float = 0.0
+    pods: int = 0
+    requested_chips: int = 0
+    pack_level: str = ""
+    required: bool = True
+    domains: list[DomainDiagnosis] = dataclasses.field(default_factory=list)
+    domains_total: int = 0       # candidates before the top-K bound
+    preemption: PreemptionDiagnosis | None = None
+    # Capacity withheld by NotReady/cordoned nodes at attempt time —
+    # the node-loss answer to "this fit yesterday". The name list is
+    # bounded (top-K); count and chips cover every lost node.
+    lost_nodes: list[str] = dataclasses.field(default_factory=list)
+    lost_nodes_total: int = 0
+    lost_chips: int = 0
+
+
+@dataclasses.dataclass
 class PodGangStatus:
     phase: PodGangPhase = PodGangPhase.PENDING
     conditions: list[Condition] = dataclasses.field(default_factory=list)
     placement_score: float = 0.0
     # chosen placement: slice name per group pod, filled by the scheduler
     assigned_slice: str = ""
+    # Placement explainability: present while the gang is unschedulable
+    # (scheduler clears it on successful schedule).
+    last_diagnosis: PlacementDiagnosis | None = None
 
 
 @dataclasses.dataclass

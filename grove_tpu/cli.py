@@ -328,6 +328,17 @@ def _cond(obj: dict, ctype: str) -> str:
     return ""
 
 
+def _pending_reason(obj: dict) -> str:
+    """The Unschedulable condition's reason while it holds (empty once
+    scheduled) — the PENDING-REASON printcolumn and the one-word answer
+    `grovectl explain` expands on."""
+    for cd in (obj.get("status", {}) or {}).get("conditions") or []:
+        if cd.get("type") == c.COND_UNSCHEDULABLE \
+                and cd.get("status") == "True":
+            return cd.get("reason", "")
+    return ""
+
+
 _PRINT_COLUMNS: dict = {
     "PodCliqueSet": [
         ("REPLICAS", lambda o: str(o["spec"].get("replicas", 0))),
@@ -353,6 +364,7 @@ _PRINT_COLUMNS: dict = {
         ("PHASE", lambda o: str(o["status"].get("phase", ""))),
         ("SCHEDULED", lambda o: _cond(o, c.COND_SCHEDULED)),
         ("READY", lambda o: _cond(o, c.COND_READY)),
+        ("PENDING-REASON", _pending_reason),
     ],
     "Pod": [
         ("PHASE", lambda o: str(o["status"].get("phase", ""))),
@@ -865,6 +877,62 @@ def cmd_trace(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_explain(args: argparse.Namespace) -> int:
+    """Render a gang's (or a PodCliqueSet's member gangs') placement
+    diagnosis: why the scheduler could not seat it — per-candidate-
+    domain verdicts with the closest fit starred, the preemption
+    outcome, and node-loss capacity. The kube-scheduler
+    per-plugin-failure-message analog for 'why is my gang pending'."""
+    from grove_tpu.scheduler.explain import payload_from_obj, \
+        render_explain
+    if "/" not in args.target:
+        print("error: target must be <kind>/<name> "
+              "(e.g. podgang/simple1-0 or podcliqueset/simple1)",
+              file=sys.stderr)
+        return 1
+    kind, name = args.target.split("/", 1)
+    kind_l = kind.lower()
+    now = time.time()
+    if kind_l in ("podgang", "pg"):
+        status, data = _http(
+            args.server, f"/debug/placement/{args.namespace}/{name}",
+            ca=args.ca)
+        if status != 200:
+            print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+            return 1
+        for line in render_explain(data, now):
+            print(line)
+        return 0
+    if kind_l in ("podcliqueset", "pcs"):
+        from urllib.parse import urlencode
+        status, gangs = _http(
+            args.server,
+            "/api/PodGang?" + urlencode(
+                {"namespace": args.namespace,
+                 f"l.{c.LABEL_PCS_NAME}": name}),
+            ca=args.ca)
+        if status != 200:
+            print(f"error ({status}): {_err_text(gangs)}",
+                  file=sys.stderr)
+            return 1
+        if not gangs:
+            print(f"error: PodCliqueSet/{name} has no PodGangs "
+                  f"in namespace {args.namespace!r}", file=sys.stderr)
+            return 1
+        payloads = [payload_from_obj(g) for g in
+                    sorted(gangs, key=lambda g: g["meta"]["name"])]
+        pending = sum(1 for p in payloads if p["diagnosis"] is not None)
+        print(f"PodCliqueSet/{name}: {len(payloads)} gang(s), "
+              f"{pending} with a pending diagnosis")
+        for p in payloads:
+            for line in render_explain(p, now):
+                print(line)
+        return 0
+    print(f"error: explain supports podgang/<name> and "
+          f"podcliqueset/<name>, not {kind!r}", file=sys.stderr)
+    return 1
+
+
 def cmd_agent(args: argparse.Namespace) -> int:
     """Per-host node agent against a remote control plane (HTTP)."""
     import os
@@ -1062,6 +1130,18 @@ def main(argv: list[str] | None = None) -> int:
     tr.add_argument("--server", default=default_server)
     add_ca(tr)
     tr.set_defaults(fn=cmd_trace)
+
+    ex = sub.add_parser(
+        "explain", help="why is this gang pending: render the "
+                        "scheduler's placement diagnosis (candidate "
+                        "domains, preemption outcome, node loss) for a "
+                        "podgang or a podcliqueset's member gangs")
+    ex.add_argument("target", help="podgang/<name> or "
+                                   "podcliqueset/<name>")
+    ex.add_argument("--namespace", default="default")
+    ex.add_argument("--server", default=default_server)
+    add_ca(ex)
+    ex.set_defaults(fn=cmd_explain)
 
     events_p = sub.add_parser("events", help="list cluster events "
                                              "(kubectl get events analog)")

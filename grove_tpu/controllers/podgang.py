@@ -39,11 +39,15 @@ class PodGangReconciler:
             return StepResult.fail(e)
         # Child span under reconcile.podgang: native backends no-op
         # here, but a translating backend's CRD emission is exactly the
-        # kind of cross-system hop a trace must not lose.
+        # kind of cross-system hop a trace must not lose. A pending
+        # diagnosis rides along as an attr so a trace of a stuck gang
+        # names its reason without a second lookup.
+        attrs = {"gang": gang.meta.name, "backend": backend.name}
+        if gang.status.last_diagnosis is not None:
+            attrs["pending_reason"] = gang.status.last_diagnosis.reason
         with GLOBAL_TRACER.span(
                 "podgang.sync",
                 trace_id=trace_id_of(gang) or None,
-                attrs={"gang": gang.meta.name,
-                       "backend": backend.name}):
+                attrs=attrs):
             backend.sync_podgang(gang)
         return StepResult.finished()

@@ -88,7 +88,35 @@ class Manager:
                                    kind=kind)
             except Exception:  # noqa: BLE001 - best-effort gauge
                 pass
+        self._export_state_objects()
         return GLOBAL_METRICS.render()
+
+    def _export_state_objects(self) -> None:
+        """kube-state-metrics-style ``grove_state_objects{kind,phase}``
+        gauges, fed from the shared informer caches (one indexed cache
+        read per kind, not a store scan per scrape; kinds the informer
+        layer refuses to cache — Secrets — are skipped). The
+        gauge-family setter zeroes phases that drained since the last
+        scrape so alerts clear."""
+        from grove_tpu.manifest import KIND_REGISTRY
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        series: list[tuple[dict, float]] = []
+        for kind, cls in KIND_REGISTRY.items():
+            lister = self.informers.lister(cls)
+            if lister is None:
+                continue
+            try:
+                counts: dict[str, int] = {}
+                for obj in lister.list(namespace=None):
+                    phase = getattr(getattr(obj, "status", None),
+                                    "phase", "")
+                    phase = getattr(phase, "value", phase) or ""
+                    counts[phase] = counts.get(phase, 0) + 1
+            except Exception:  # noqa: BLE001 - best-effort gauge
+                continue
+            series.extend(({"kind": kind, "phase": phase}, float(n))
+                          for phase, n in counts.items())
+        GLOBAL_METRICS.set_gauge_family("grove_state_objects", series)
 
     def healthz(self) -> dict:
         return {

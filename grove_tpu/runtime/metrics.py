@@ -33,6 +33,14 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 LIFECYCLE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
                      30.0, 60.0, 120.0, 300.0)
 
+# Pinned buckets for the gang pending-time histogram (first failed
+# placement attempt -> successful schedule, observed once at schedule):
+# a stuck gang is a minutes-to-hours phenomenon — capacity arriving,
+# preemption, node recovery — so the tail extends to an hour where the
+# lifecycle buckets stop at five minutes.
+PENDING_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                   1800.0, 3600.0)
+
 
 class _Hist:
     __slots__ = ("buckets", "counts", "sum", "count")
@@ -83,6 +91,22 @@ class MetricsHub:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
+
+    def set_gauge_family(self, name: str, series) -> None:
+        """Replace gauge ``name``'s exported series wholesale: set
+        every (labels_dict, value) pair in ``series`` and zero
+        previously-exported label-sets missing from this update — a
+        drained series must clear, not linger at its last value (the
+        kube-state-metrics contract; callers don't each hand-roll
+        last-exported-set bookkeeping)."""
+        new = {tuple(sorted(labels.items())): float(v)
+               for labels, v in series}
+        with self._lock:
+            for key in self._gauges:
+                if key[0] == name and key[1] not in new:
+                    self._gauges[key] = 0.0
+            for labels, v in new.items():
+                self._gauges[(name, labels)] = v
 
     def observe(self, name: str, value: float, **labels) -> None:
         """Record one observation into the fixed-bucket histogram
@@ -287,6 +311,25 @@ GLOBAL_METRICS.describe_histogram(
     "reporting Ready — the time-to-ready SLO the scale harness "
     "asserts)",
     buckets=LIFECYCLE_BUCKETS)
+# Placement explainability surface (docs/design/placement-explain.md):
+# why-is-my-gang-pending as metrics, alertable without log-diving.
+GLOBAL_METRICS.describe(
+    "grove_gang_unschedulable",
+    "Currently-unschedulable gangs per diagnosis reason "
+    "(ChipShortfall|TopologyPruned|Fragmented|SelectorMismatch|"
+    "PreemptionRejected|StragglerUnplaced; reasons zero when they "
+    "drain)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_gang_pending_seconds",
+    "Time from a gang's first failed placement attempt to its "
+    "successful schedule (observed once at schedule; the diagnosis is "
+    "cleared at the same moment)",
+    buckets=PENDING_BUCKETS)
+GLOBAL_METRICS.describe(
+    "grove_state_objects",
+    "Objects per kind and status phase, fed from the shared informer "
+    "caches (kube-state-metrics analog; phase empty for kinds without "
+    "one)")
 GLOBAL_METRICS.describe_histogram(
     "grove_lifecycle_phase_seconds",
     "Per-phase gang lifecycle durations (phase=create_to_gang|"

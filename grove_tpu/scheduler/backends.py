@@ -29,8 +29,14 @@ from grove_tpu.api.meta import (
     trace_id_of,
 )
 from grove_tpu.api.podcliqueset import PodCliqueSet
-from grove_tpu.api.podgang import PodGangPhase
+from grove_tpu.api.podgang import PodGangPhase, PreemptionDiagnosis
 from grove_tpu.api.serde import clone
+from grove_tpu.scheduler.explain import (
+    build_gang_diagnosis,
+    build_straggler_diagnosis,
+    explain_enabled,
+    merge_diagnosis,
+)
 from grove_tpu.runtime.errors import ConflictError, NotFoundError
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.runtime.trace import GLOBAL_TRACER
@@ -385,6 +391,17 @@ class GangBackend:
             from grove_tpu.runtime.metrics import GLOBAL_METRICS
             GLOBAL_METRICS.observe("grove_sched_place_pass_seconds",
                                    time.perf_counter() - t0, backend="gang")
+            # Object-state gauges: currently-unschedulable gangs per
+            # diagnosis reason (kube-state-metrics style; reasons that
+            # drained are zeroed so alerts clear).
+            reasons: dict[str, int] = {}
+            for g in gangs:
+                d = g.status.last_diagnosis
+                if d is not None and d.reason:
+                    reasons[d.reason] = reasons.get(d.reason, 0) + 1
+            GLOBAL_METRICS.set_gauge_family(
+                "grove_gang_unschedulable",
+                [({"reason": r}, n) for r, n in reasons.items()])
             if snap.rebuilds and snap.incremental:
                 # Legacy mode rebuilds unconditionally — counting those
                 # would attribute phantom outside writers.
@@ -429,6 +446,7 @@ class GangBackend:
 
         placed_any = False
         preempted = False
+        diag = None
         trace_id = trace_id_of(gang)
 
         if not already_bound and group_ok and bindable:
@@ -440,7 +458,7 @@ class GangBackend:
                     "sched.place", trace_id=trace_id or None,
                     attrs={"gang": gang.meta.name,
                            "pods": len(bindable)}) as span:
-                placed_any, preempted = self._place_initial(
+                placed_any, preempted, diag = self._place_initial(
                     gang, snap, bindable, span)
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
@@ -455,6 +473,7 @@ class GangBackend:
                            "pods": len(bindable)}):
                 bound_domains = self._bound_domains(gang, existing,
                                                     snap.hosts)
+                unplaced: list[tuple[Pod, list[HostView]]] = []
                 for p in bindable:
                     pool = self._straggler_pool(gang, p, snap,
                                                 bound_domains)
@@ -465,14 +484,31 @@ class GangBackend:
                     if host is not None:
                         self._bind([p], {p.meta.name: host}, snap)
                         placed_any = True
+                    else:
+                        unplaced.append((p, pool))
+                if unplaced and explain_enabled():
+                    topo = gang.spec.topology
+                    lvl = (topo.pack_level if topo else "slice") or "slice"
+                    anchor = ""
+                    if bound_domains:
+                        anchor = next(
+                            iter(bound_domains.values())).get(lvl, "")
+                    diag = build_straggler_diagnosis(
+                        gang, unplaced, lvl,
+                        anchor or gang.status.assigned_slice, snap=snap)
 
+        if diag is not None:
+            gang.status.last_diagnosis = merge_diagnosis(
+                gang.status.last_diagnosis, diag)
         self._update_status(gang, initialized, placed_any, snap)
         return placed_any, preempted
 
     def _place_initial(self, gang: PodGang, snap: PlacementSnapshot,
-                       bindable: list[Pod], span) -> tuple[bool, bool]:
+                       bindable: list[Pod], span) -> tuple[bool, bool, object]:
         """First gang-atomic placement (plan → preempt → min-floor
-        fallback → bind). Returns (placed_any, preempted)."""
+        fallback → bind). Returns (placed_any, preempted, diagnosis) —
+        diagnosis is a PlacementDiagnosis when the gang stayed fully
+        unplaced and explain is enabled, else None."""
         hosts = snap.hosts
         placed_any = False
         preempted = False
@@ -519,9 +555,27 @@ class GangBackend:
 
         plan_fn = make_plan_fn(bindable)
         to_bind = bindable
+        diag = None
+        pre_out: PreemptionDiagnosis | None = None
         plan = plan_fn(hosts, snap.index)
-        if plan is None and not self._try_preempt_for(gang, plan_fn,
-                                                      hosts):
+        if plan is None:
+            preempted, pre_out = self._try_preempt_for(gang, plan_fn,
+                                                       hosts)
+        if plan is None and not preempted:
+            if pre_out is not None and \
+                    pre_out.verdict == "victims-insufficient":
+                # The silent preemption give-up was exactly the on-call
+                # blind spot: surface the victim-count shortfall as its
+                # own Warning (the generic GangUnschedulable still
+                # follows below if nothing else seats the gang).
+                snap.note_own_writes(self.recorder.event(
+                    gang, "Warning", "PreemptionRejected",
+                    f"preemption rejected: {pre_out.victims_considered} "
+                    f"elastic victim gang(s) holding "
+                    f"{pre_out.victim_chips} chips cannot seat "
+                    f"{len(bindable)} pods "
+                    f"({sum(p.spec.tpu_chips for p in bindable)} chips); "
+                    f"{pre_out.detail}"))
             # Min-floor fallback (reference GS5 semantics), tried
             # only when preemption cannot seat the FULL gang: start
             # with min_replicas per group; surplus pods stay pending
@@ -537,8 +591,6 @@ class GangBackend:
                 floor_plan = make_plan_fn(floor)(full_hosts)
                 if floor_plan is not None:
                     plan, to_bind = floor_plan, floor
-        elif plan is None:
-            preempted = True
         if plan is not None:
             self._bind(to_bind, plan.assignments, snap)
             gang.status.assigned_slice = plan.slice_name
@@ -559,12 +611,20 @@ class GangBackend:
             # pass); nothing fit and no floor was possible.
             span.set_error("unschedulable" if not preempted
                            else "preempting")
+            if not preempted and explain_enabled():
+                # Failed-attempt-only cost: diagnose against the pass
+                # snapshot (bounded to the top-K candidate domains).
+                diag = build_gang_diagnosis(
+                    gang, [req(p) for p in bindable], snap,
+                    (pack_level or "slice"), required, spread, pre_out)
+            msg = (f"no {pack_level or 'slice'} domain fits "
+                   f"{len(bindable)} pods "
+                   f"({sum(p.spec.tpu_chips for p in bindable)} chips)")
+            if diag is not None:
+                msg += f" [{diag.reason}]"
             snap.note_own_writes(self.recorder.event(
-                gang, "Warning", "GangUnschedulable",
-                f"no {pack_level or 'slice'} domain fits "
-                f"{len(bindable)} pods "
-                f"({sum(p.spec.tpu_chips for p in bindable)} chips)"))
-        return placed_any, preempted
+                gang, "Warning", "GangUnschedulable", msg))
+        return placed_any, preempted, diag
 
     def _floor_subset(self, gang: PodGang,
                       bindable: list[Pod]) -> list[Pod] | None:
@@ -617,9 +677,13 @@ class GangBackend:
                 >= need]
 
     def _try_preempt_for(self, gang: PodGang, plan_fn,
-                         hosts: list[HostView]) -> bool:
+                         hosts: list[HostView]
+                         ) -> tuple[bool, PreemptionDiagnosis]:
         """Free capacity for a starved BASE gang by evicting one scaled
-        (elastic) gang of equal-or-lower priority.
+        (elastic) gang of equal-or-lower priority. Returns
+        (preempted, outcome) — the outcome records WHY preemption was
+        rejected (not-eligible / no-victims / victims-insufficient) for
+        the placement diagnosis and the PreemptionRejected event.
 
         Elastic capacity is best-effort by definition — the base-gang
         guarantee ('scaled capacity never starves the base', reference
@@ -632,7 +696,10 @@ class GangBackend:
         preemptor. One victim per pass keeps eviction minimal.
         """
         if gang.spec.base_gang:
-            return False  # only base gangs preempt
+            # only base gangs preempt
+            return False, PreemptionDiagnosis(
+                verdict="not-eligible",
+                detail="scaled (elastic) gangs never preempt")
         client = self.client
         victims = []
         # Victims cluster-wide: capacity is one pool, so preemption must
@@ -654,7 +721,18 @@ class GangBackend:
                 continue
             victims.append((sum(p.spec.tpu_chips for p in pods), other, pods))
         if not victims:
-            return False
+            return False, PreemptionDiagnosis(
+                verdict="no-victims",
+                detail="no elastic gang at equal-or-lower priority "
+                       "holds capacity")
+        total_victim_chips = sum(chips for chips, _, _ in victims)
+        insufficient = PreemptionDiagnosis(
+            verdict="victims-insufficient",
+            victims_considered=len(victims),
+            victim_chips=total_victim_chips,
+            detail=f"evicting all {len(victims)} elastic gang(s) "
+                   f"({total_victim_chips} chips) still cannot seat "
+                   "the gang")
 
         def feasible_with(victim_pods) -> bool:
             reclaim: dict[str, int] = defaultdict(int)
@@ -674,7 +752,7 @@ class GangBackend:
             # chosen hosts (never an irrelevant one).
             all_pods = [p for _, _, pods in victims for p in pods]
             if not feasible_with(all_pods):
-                return False
+                return False, insufficient
             reclaim_all: dict[str, int] = defaultdict(int)
             for p in all_pods:
                 reclaim_all[p.status.node_name] += p.spec.tpu_chips
@@ -686,7 +764,11 @@ class GangBackend:
             viable = [(chips, v, pods) for chips, v, pods in victims
                       if any(p.status.node_name in used_hosts for p in pods)]
             if not viable:
-                return False
+                insufficient.detail = (
+                    f"{len(victims)} elastic gang(s) hold "
+                    f"{total_victim_chips} chips but none intersects "
+                    "the feasible plan's hosts")
+                return False, insufficient
         _, victim, pods = min(viable, key=lambda v: (v[1].spec.priority, v[0]))
         self.log.info("preempting scaled gang %s (priority %d) for base "
                       "gang %s (priority %d)", victim.meta.name,
@@ -701,7 +783,10 @@ class GangBackend:
                 client.delete(Pod, p.meta.name, p.meta.namespace)
             except (NotFoundError, ConflictError):
                 pass
-        return True
+        return True, PreemptionDiagnosis(
+            verdict="preempted", victims_considered=len(victims),
+            victim_chips=sum(p.spec.tpu_chips for p in pods),
+            detail=f"evicted {victim.meta.name}")
 
     def _bound_domains(self, gang: PodGang, existing: list[Pod],
                        hosts: list[HostView]) -> dict[str, dict[str, str]]:
@@ -859,6 +944,31 @@ class GangBackend:
             type=c.COND_READY,
             status="True" if all_ready else "False",
             reason=f"{ready}/{expected} ready"))
+        # Placement explainability: mirror the diagnosis headline into
+        # an Unschedulable condition; on schedule, observe how long the
+        # gang sat pending and clear the diagnosis (it answered its
+        # question). An unchanged diagnosis re-sets an identical
+        # condition — a suppressed no-op write.
+        diag = gang.status.last_diagnosis
+        if diag is not None:
+            # A straggler diagnosis coexists with Scheduled=True (the
+            # floor is placed; the surplus is stuck): it clears only
+            # when every expected pod is bound, not at the min floor.
+            resolved = scheduled and (diag.reason != "StragglerUnplaced"
+                                      or bound >= expected)
+            if resolved:
+                from grove_tpu.runtime.metrics import GLOBAL_METRICS
+                GLOBAL_METRICS.observe(
+                    "grove_gang_pending_seconds",
+                    max(0.0, time.time() - diag.first_failure_time))
+                gang.status.last_diagnosis = None
+                conds = set_condition(conds, Condition(
+                    type=c.COND_UNSCHEDULABLE, status="False",
+                    reason="Scheduled"))
+            else:
+                conds = set_condition(conds, Condition(
+                    type=c.COND_UNSCHEDULABLE, status="True",
+                    reason=diag.reason, message=diag.message[:240]))
         gang.status.conditions = conds
         if all_ready:
             gang.status.phase = PodGangPhase.RUNNING
@@ -886,6 +996,7 @@ class GangBackend:
                 fresh.status.phase = gang.status.phase
                 fresh.status.assigned_slice = gang.status.assigned_slice
                 fresh.status.placement_score = gang.status.placement_score
+                fresh.status.last_diagnosis = gang.status.last_diagnosis
                 write(fresh)
             except (ConflictError, NotFoundError):
                 pass  # next pass recomputes from live state

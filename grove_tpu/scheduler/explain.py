@@ -1,0 +1,335 @@
+"""Placement explainability — the "why is my gang pending" layer.
+
+PR 3's lifecycle tracing answers "why was this gang *slow*"; this
+module answers "why is this gang *stuck*" — the kube-scheduler
+per-plugin-failure-message analog for Grove's gang placement. The gang
+scheduler calls the builders here on FAILED placement attempts only
+(``GangBackend._place_initial`` / the straggler path), producing a
+``PlacementDiagnosis`` that is
+
+- persisted on ``PodGang.status.last_diagnosis`` (refresh-throttled so
+  a stuck gang does not turn the 0.2s placement tick into a status
+  write storm),
+- copied into an ``Unschedulable`` condition reason,
+- served raw at ``GET /debug/placement/<ns>/<name>`` and rendered by
+  ``grovectl explain``.
+
+Cost contract: nothing here runs when placement succeeds; candidate
+domains are bounded to ``EXPLAIN_TOP_K``; ``GROVE_EXPLAIN=0`` disables
+the whole layer (status stays untouched, exactly the pre-explain
+shape).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podgang import (
+    DomainDiagnosis,
+    PlacementDiagnosis,
+    PreemptionDiagnosis,
+    PodGang,
+)
+from grove_tpu.scheduler.placement import (
+    HostView,
+    PodRequest,
+    classify_fit_failure,
+)
+
+EXPLAIN_ENV = "GROVE_EXPLAIN"
+REFRESH_ENV = "GROVE_EXPLAIN_REFRESH"
+# Candidate-domain bound: the operator needs the closest fits, not a
+# 4000-domain dump on every stuck gang's status.
+EXPLAIN_TOP_K = 8
+# Minimum seconds between persisted diagnosis refreshes for an
+# unchanged failure (the placement tick is 0.2s; re-writing status per
+# tick would wake every watching controller for no new information).
+DEFAULT_REFRESH_SECONDS = 5.0
+
+
+def explain_enabled() -> bool:
+    """Read per call (tests and incident mitigation flip it live)."""
+    return os.environ.get(EXPLAIN_ENV, "1") != "0"
+
+
+def refresh_seconds() -> float:
+    try:
+        return float(os.environ.get(REFRESH_ENV, DEFAULT_REFRESH_SECONDS))
+    except ValueError:
+        return DEFAULT_REFRESH_SECONDS
+
+
+def _lost_capacity(nodes) -> tuple[list[str], int, int]:
+    """Nodes currently withholding capacity (NotReady or cordoned) and
+    the chips they hold — the node-loss half of "this fit yesterday".
+    Returns (first-K names, total count, total chips): the name list is
+    bounded for the persisted status block, the count and chips cover
+    every lost node so the two never disagree."""
+    lost_nodes: list[str] = []
+    lost_chips = 0
+    for node in nodes:
+        if node.status.ready and not node.spec.unschedulable:
+            continue
+        lost_nodes.append(node.meta.name)
+        lost_chips += max(node.status.allocatable_chips,
+                          node.spec.tpu_chips)
+    lost_nodes.sort()
+    return lost_nodes[:EXPLAIN_TOP_K], len(lost_nodes), lost_chips
+
+
+def build_gang_diagnosis(gang: PodGang, requests: list[PodRequest],
+                         snap, level: str, required: bool,
+                         spread: dict[str, float],
+                         preemption: PreemptionDiagnosis | None,
+                         now: float | None = None) -> PlacementDiagnosis:
+    """Diagnose one failed gang-atomic placement attempt against the
+    pass snapshot: per-candidate-domain verdicts (bounded to the top-K
+    closest fits), the preemption outcome, and lost-node capacity.
+    Failure path only — never called when a plan exists."""
+    now = time.time() if now is None else now
+    requested = sum(r.chips for r in requests)
+    by_domain = snap.index.domains(level)
+    indexed = by_domain is not None
+    if by_domain is None:
+        by_domain = {}
+        for h in snap.hosts:
+            by_domain.setdefault(
+                h.name if level == "host" else h.domains.get(level, ""),
+                []).append(h)
+    # Rank candidates by free capacity (closest fit first), bound to
+    # top-K, and only then pay for per-domain fit classification.
+    ranked = sorted(
+        ((snap.index.free_in(level, d) if indexed
+          else sum(h.free_chips for h in hs), d, hs)
+         for d, hs in by_domain.items()),
+        key=lambda t: (-t[0], t[1]))
+    entries: list[DomainDiagnosis] = []
+    for free, domain, dhosts in ranked[:EXPLAIN_TOP_K]:
+        total = sum(h.total_chips or h.free_chips for h in dhosts)
+        if free < requested:
+            verdict = "chip-shortfall"
+            detail = f"{requested - free} chips short"
+        else:
+            verdict, detail = classify_fit_failure(requests, dhosts)
+        entries.append(DomainDiagnosis(
+            domain=domain, level=level, free_chips=free,
+            total_chips=total, verdict=verdict, detail=detail,
+            spread_penalty=spread.get(domain, 0.0)))
+    if entries:
+        entries[0].closest = True
+
+    lost_nodes, lost_total, lost_chips = _lost_capacity(snap.nodes)
+    cluster_free = sum(h.free_chips for h in snap.hosts)
+
+    if preemption is not None and \
+            preemption.verdict == "victims-insufficient":
+        reason = "PreemptionRejected"
+    elif not entries or all(e.verdict == "chip-shortfall"
+                            for e in entries):
+        # Every candidate is short on chips: if the cluster as a whole
+        # could hold the gang, the pack constraint is what blocks it.
+        reason = ("TopologyPruned"
+                  if required and cluster_free >= requested
+                  else "ChipShortfall")
+    elif all(e.verdict == "selector-mismatch" for e in entries):
+        reason = "SelectorMismatch"
+    else:
+        reason = "Fragmented"
+
+    closest = entries[0] if entries else None
+    msg = (f"no {level} domain fits {len(requests)} pods "
+           f"({requested} chips)")
+    if closest is not None:
+        msg += (f"; closest {level} {closest.domain!r} has "
+                f"{closest.free_chips} free chips ({closest.verdict}"
+                + (f": {closest.detail}" if closest.detail else "") + ")")
+    if preemption is not None and preemption.verdict != "preempted":
+        msg += f"; preemption {preemption.verdict}"
+        if preemption.detail:
+            msg += f" ({preemption.detail})"
+    if lost_nodes:
+        msg += (f"; {lost_total} node(s) NotReady/cordoned "
+                f"withholding {lost_chips} chips (node loss)")
+
+    return PlacementDiagnosis(
+        reason=reason, message=msg, pods=len(requests),
+        requested_chips=requested, pack_level=level, required=required,
+        domains=entries, domains_total=len(by_domain),
+        preemption=preemption, lost_nodes=lost_nodes,
+        lost_nodes_total=lost_total, lost_chips=lost_chips,
+        last_attempt_time=now)
+
+
+def build_straggler_diagnosis(gang: PodGang, unplaced: list,
+                              level: str, anchor: str,
+                              snap=None,
+                              now: float | None = None
+                              ) -> PlacementDiagnosis:
+    """Diagnose late pods (gang scale-up / recreated pods) that could
+    not rejoin their bound siblings: the anchor domain every required
+    pack constraint pins them to lacks room. ``unplaced`` is a list of
+    (pod, pool) pairs — pools can differ per pod (group constraints,
+    selectors), so the reported numbers come from the TIGHTEST pool (a
+    roomier sibling pool must not make a stuck pod look placeable)."""
+    now = time.time() if now is None else now
+    pods = [p for p, _ in unplaced]
+    requested = sum(p.spec.tpu_chips for p in pods)
+    pod, pool = min(unplaced,
+                    key=lambda pp: sum(h.free_chips for h in pp[1]))
+    free = sum(h.free_chips for h in pool)
+    total = sum(h.total_chips or h.free_chips for h in pool)
+    names = ", ".join(sorted(p.meta.name for p in pods)[:4])
+    entry = DomainDiagnosis(
+        domain=anchor, level=level, free_chips=free, total_chips=total,
+        verdict=("chip-shortfall" if free < pod.spec.tpu_chips
+                 else "fragmented"),
+        detail=f"pod {pod.meta.name}'s anchor pool: {len(pool)} "
+               f"host(s), {free} free chips for its "
+               f"{pod.spec.tpu_chips}-chip request", closest=True)
+    lost_nodes, lost_total, lost_chips = ([], 0, 0) if snap is None \
+        else _lost_capacity(snap.nodes)
+    msg = (f"{len(pods)} late pod(s) ({names}) cannot rejoin the "
+           f"gang: anchor {level} {anchor!r} has {free} free chips, "
+           f"{requested} needed")
+    if lost_nodes:
+        msg += (f"; {lost_total} node(s) NotReady/cordoned "
+                f"withholding {lost_chips} chips (node loss)")
+    return PlacementDiagnosis(
+        reason="StragglerUnplaced", message=msg, pods=len(pods),
+        requested_chips=requested, pack_level=level, required=True,
+        domains=[entry], domains_total=1, lost_nodes=lost_nodes,
+        lost_nodes_total=lost_total, lost_chips=lost_chips,
+        last_attempt_time=now)
+
+
+def merge_diagnosis(prev: PlacementDiagnosis | None,
+                    fresh: PlacementDiagnosis,
+                    now: float | None = None) -> PlacementDiagnosis:
+    """Fold a fresh attempt into the persisted history: carry attempt
+    count and first-failure time forward, and — when nothing material
+    changed inside the refresh window — return ``prev`` unchanged so
+    the status write is a suppressed no-op (the store's byte-identical
+    guard) instead of a per-tick rv bump."""
+    now = time.time() if now is None else now
+    if prev is not None:
+        unchanged = (prev.reason == fresh.reason
+                     and prev.message == fresh.message)
+        if unchanged and now - prev.last_attempt_time < refresh_seconds():
+            return prev
+        fresh.attempts = prev.attempts + 1
+        fresh.first_failure_time = prev.first_failure_time or now
+    else:
+        fresh.attempts = 1
+        fresh.first_failure_time = now
+    fresh.last_attempt_time = now
+    return fresh
+
+
+# ---- wire payload + CLI rendering (shared by server, clients, CLI) ----
+
+
+def placement_payload(gang: PodGang) -> dict:
+    """The raw-diagnosis wire shape served by GET /debug/placement and
+    both clients' ``debug_placement`` — one shape everywhere."""
+    from grove_tpu.api import constants as c
+    from grove_tpu.api.serde import to_dict
+    return {
+        "kind": "PodGang",
+        "name": gang.meta.name,
+        "namespace": gang.meta.namespace,
+        "phase": gang.status.phase.value,
+        "scheduled": is_condition_true(gang.status.conditions,
+                                       c.COND_SCHEDULED),
+        "assigned_slice": gang.status.assigned_slice,
+        "conditions": [to_dict(cd) for cd in gang.status.conditions],
+        "diagnosis": (to_dict(gang.status.last_diagnosis)
+                      if gang.status.last_diagnosis is not None else None),
+    }
+
+
+def payload_from_obj(obj: dict) -> dict:
+    """``placement_payload`` shape from a plain ``/api/PodGang`` object
+    dict (the PCS aggregation path lists gangs once instead of one
+    debug round trip per member)."""
+    from grove_tpu.api import constants as c
+    st = obj.get("status", {}) or {}
+    scheduled = any(cd.get("type") == c.COND_SCHEDULED
+                    and cd.get("status") == "True"
+                    for cd in st.get("conditions") or [])
+    return {
+        "kind": "PodGang",
+        "name": (obj.get("meta", {}) or {}).get("name", ""),
+        "namespace": (obj.get("meta", {}) or {}).get("namespace",
+                                                     "default"),
+        "phase": st.get("phase", ""),
+        "scheduled": scheduled,
+        "assigned_slice": st.get("assigned_slice", ""),
+        "conditions": st.get("conditions") or [],
+        "diagnosis": st.get("last_diagnosis"),
+    }
+
+
+def render_explain(payload: dict, now: float | None = None) -> list[str]:
+    """Human-readable reason tree for one gang's placement payload —
+    what ``grovectl explain`` prints. Works on the wire dict so the CLI
+    renders identically from the debug endpoint and from listed
+    objects."""
+    now = time.time() if now is None else now
+    name = f"PodGang/{payload.get('name', '')}"
+    diag = payload.get("diagnosis")
+    lines: list[str] = []
+    if diag is None:
+        where = payload.get("assigned_slice") or "multiple domains"
+        state = ("scheduled onto " + where if payload.get("scheduled")
+                 else f"phase {payload.get('phase', '?')}, no placement "
+                      "diagnosis recorded")
+        lines.append(f"{name}: {state}")
+        return lines
+    pending = max(0.0, now - diag.get("first_failure_time", now))
+    # A diagnosis can coexist with Scheduled=True (min-floor placed,
+    # surplus stragglers stuck): say both, never hide the reason tree.
+    state = ("SCHEDULED AT FLOOR" if payload.get("scheduled")
+             else "UNSCHEDULABLE")
+    lines.append(
+        f"{name}: {state} — {diag.get('reason', '?')} "
+        f"(attempt {diag.get('attempts', 0)}, "
+        f"pending {pending:.0f}s)")
+    lines.append(f"  {diag.get('message', '')}")
+    lines.append(
+        f"  requested: {diag.get('requested_chips', 0)} chips across "
+        f"{diag.get('pods', 0)} pods "
+        f"(pack {diag.get('pack_level', '?')}, "
+        f"{'required' if diag.get('required', True) else 'preferred'})")
+    domains = diag.get("domains") or []
+    if domains:
+        total = diag.get("domains_total", len(domains))
+        bound = (f"top {len(domains)} of {total}" if total > len(domains)
+                 else str(len(domains)))
+        lines.append(f"  candidate domains ({bound}; * = closest fit):")
+        for d in domains:
+            star = "*" if d.get("closest") else " "
+            pen = (f", spread penalty {d.get('spread_penalty', 0.0):.1f}"
+                   if d.get("spread_penalty") else "")
+            detail = f" ({d['detail']})" if d.get("detail") else ""
+            lines.append(
+                f"  {star} {d.get('level', '?')} {d.get('domain', '?')!r}: "
+                f"{d.get('free_chips', 0)}/{d.get('total_chips', 0)} "
+                f"chips free — {d.get('verdict', '?')}{detail}{pen}")
+    pre = diag.get("preemption")
+    if pre:
+        detail = f" — {pre['detail']}" if pre.get("detail") else ""
+        lines.append(
+            f"  preemption: {pre.get('verdict', '?')}"
+            f" ({pre.get('victims_considered', 0)} victim(s), "
+            f"{pre.get('victim_chips', 0)} chips){detail}")
+    if diag.get("lost_nodes"):
+        shown = diag["lost_nodes"]
+        total = diag.get("lost_nodes_total", len(shown))
+        more = f" (+{total - len(shown)} more)" if total > len(shown) \
+            else ""
+        lines.append(
+            f"  node loss: {', '.join(shown)}{more} "
+            f"withholding {diag.get('lost_chips', 0)} chips")
+    return lines

@@ -147,6 +147,33 @@ def _fit_in_hosts(pods: list[PodRequest], hosts: list[HostView]
     return assignment
 
 
+def classify_fit_failure(pods: list[PodRequest], hosts: list[HostView]
+                         ) -> tuple[str, str]:
+    """Why no assignment exists for ``pods`` on ``hosts`` even though
+    the total free chips may cover the request — the explainability
+    companion to ``_fit_in_hosts`` (failure paths only; never on the
+    placement hot path). Returns (verdict, detail):
+
+    - ``selector-mismatch``: some pod's node_selector (or a reservation
+      fence) excludes every host;
+    - ``fragmented``: every host a pod may land on lacks a free block
+      its size, or the pods fit individually but not together.
+    """
+    for pod in sorted(pods, key=lambda p: -p.chips):
+        eligible = [h for h in hosts if _selector_matches(pod, h)]
+        if not eligible:
+            sel = ",".join(f"{k}={v}" for k, v in
+                           sorted(pod.node_selector.items())) or "<none>"
+            return ("selector-mismatch",
+                    f"pod {pod.name} matches no host (selector {sel})")
+        biggest = max(h.free_chips for h in eligible)
+        if biggest < pod.chips:
+            return ("fragmented",
+                    f"pod {pod.name} needs {pod.chips} chips but the "
+                    f"largest free block is {biggest}")
+    return ("fragmented", "pods fit individually but not together")
+
+
 def plan_gang(pods: list[PodRequest], hosts: list[HostView],
               pack_level: str = "slice", required: bool = True,
               prefer_slice: str = "",

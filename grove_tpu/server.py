@@ -36,6 +36,11 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       (?trace_id= filters one trace;
                                       grovectl trace renders it; same
                                       gate)
+  GET  /debug/placement/<ns>/<name>   raw placement diagnosis for one
+                                      PodGang (status.last_diagnosis +
+                                      conditions; grovectl explain
+                                      renders it; plain status data, so
+                                      read-gated, not profiling-gated)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -407,6 +412,9 @@ class ApiServer:
                         self._debug_stacks()
                     elif url.path == "/debug/traces":
                         self._debug_traces(parse_qs(url.query))
+                    elif len(parts) == 4 and parts[0] == "debug" \
+                            and parts[1] == "placement":
+                        self._debug_placement(parts[2], parts[3])
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -678,6 +686,17 @@ class ApiServer:
                     return
                 tid = q.get("trace_id", [None])[0]
                 self._send(200, cluster.manager.tracer.export(tid))
+
+            def _debug_placement(self, namespace: str, name: str):
+                """GET /debug/placement/<ns>/<name> — the raw placement
+                diagnosis for one PodGang (``grovectl explain`` renders
+                it). Plain status data (the same block a GET of the
+                gang returns), so it shares the read gate, not the
+                profiling gate."""
+                from grove_tpu.api import PodGang
+                from grove_tpu.scheduler.explain import placement_payload
+                gang = cluster.client.get(PodGang, name, namespace)
+                self._send(200, placement_payload(gang))
 
             def _workload_owns(self, actor: str, payload: dict) -> bool:
                 """A workload actor (system:workload:<ns>:<pcs>) may only

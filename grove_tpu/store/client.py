@@ -107,6 +107,15 @@ class Client:
         from grove_tpu.runtime.trace import GLOBAL_TRACER
         return GLOBAL_TRACER.export(trace_id)
 
+    def debug_placement(self, name: str,
+                        namespace: str = "default") -> dict:
+        """One PodGang's raw placement diagnosis — the in-process twin
+        of ``GET /debug/placement/<ns>/<name>`` (same payload shape;
+        grovectl explain renders either)."""
+        from grove_tpu.api import PodGang
+        from grove_tpu.scheduler.explain import placement_payload
+        return placement_payload(self.get(PodGang, name, namespace))
+
 
 @dataclasses.dataclass
 class _InjectedError:

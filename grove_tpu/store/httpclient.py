@@ -206,6 +206,14 @@ class HttpClient:
             path += f"?{urlencode({'trace_id': trace_id})}"
         return self._request("GET", path)
 
+    def debug_placement(self, name: str,
+                        namespace: str = "default") -> dict:
+        """One PodGang's raw placement diagnosis from
+        ``GET /debug/placement/<ns>/<name>`` (the wire twin of
+        ``Client.debug_placement``; 404 maps to NotFoundError)."""
+        return self._request(
+            "GET", f"/debug/placement/{quote(namespace)}/{quote(name)}")
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

@@ -123,6 +123,50 @@ def test_node_loss_triggers_gang_self_heal():
             ctl.stop()
 
 
+def test_node_loss_surfaces_in_placement_diagnosis():
+    """Diagnosis interplay: after this controller marks a node lost
+    (and fails its pods), a gang that cannot re-place must name the
+    node loss in PodGang.status.last_diagnosis — the "this fit
+    yesterday" answer."""
+    from grove_tpu.api.podcliqueset import TopologyConstraint
+    from grove_tpu.api.podgang import PodGang, PodGangSpec, PodGroup
+    from grove_tpu.api.core import ContainerSpec, PodSpec
+    from tools.bench_sched import new_backend
+
+    client = FakeClient()
+    survivor = build_node("v5e", "2x2", "s0", 0)          # 4 chips
+    client.create(survivor)
+    lost = build_node("v5e", "2x2", "s1", 0, fake=False)  # 4 chips
+    lost.status.heartbeat_time = time.time() - 100.0
+    client.create(lost)
+
+    # A gang whose pods ran on the lost node: the controller fails
+    # them, the recreated pods need a whole 8-chip slice that no longer
+    # exists.
+    pods = ["lossgang-p-0", "lossgang-p-1"]
+    client.create(PodGang(
+        meta=new_meta("lossgang"),
+        spec=PodGangSpec(
+            groups=[PodGroup(name="g", pod_names=pods, min_replicas=2)],
+            topology=TopologyConstraint(pack_level="slice",
+                                        required=True))))
+    for pn in pods:
+        client.create(Pod(
+            meta=new_meta(pn, labels={c.LABEL_PODGANG_NAME: "lossgang"}),
+            spec=PodSpec(tpu_chips=4,
+                         container=ContainerSpec(argv=["x"]))))
+
+    NodeLifecycleController(client, grace_seconds=10.0)._pass()
+    assert client.get(Node, lost.meta.name).status.ready is False
+
+    new_backend(client)._place_pass()       # next failed attempt
+    diag = client.get(PodGang, "lossgang").status.last_diagnosis
+    assert diag is not None
+    assert lost.meta.name in diag.lost_nodes
+    assert diag.lost_chips >= 4
+    assert "node loss" in diag.message
+
+
 def test_config_validation():
     from grove_tpu.api.config import OperatorConfiguration, validate_config
     cfg = OperatorConfiguration()

@@ -120,6 +120,29 @@ def test_histogram_buckets_pinned_at_first_observation():
     assert cum == {0.1: 0, 1.0: 1, float("inf"): 1}
 
 
+def test_state_objects_gauges_from_informer_cache(cluster):
+    """kube-state-metrics-style grove_state_objects{kind,phase} gauges
+    render from the shared informer caches, and a drained phase zeroes
+    on the next scrape instead of lingering at its last value."""
+    client = cluster.client
+    client.create(simple_pcs(name="stateobs"))
+    wait_for(lambda: client.get(
+        PodCliqueSet, "stateobs").status.available_replicas == 1,
+        desc="up")
+    text = cluster.manager.metrics_text()
+    assert 'grove_state_objects{kind="Pod",phase="Running"} 3.0' in text
+    assert 'grove_state_objects{kind="PodGang",phase="Running"} 1.0' \
+        in text
+    assert 'grove_state_objects{kind="Node",phase=""} 8.0' in text
+
+    client.delete(PodCliqueSet, "stateobs")
+    wait_for(lambda: not client.list(PodCliqueSet), desc="deleted")
+    text = cluster.manager.metrics_text()
+    assert 'grove_state_objects{kind="Pod",phase="Running"} 0.0' in text
+    assert 'grove_state_objects{kind="PodGang",phase="Running"} 0.0' \
+        in text
+
+
 def test_unschedulable_event(cluster):
     client = cluster.client
     client.create(simple_pcs(name="big", pods=5, chips=4))  # can't fit

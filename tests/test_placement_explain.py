@@ -1,0 +1,290 @@
+"""Placement explainability: the structured "why is my gang pending"
+diagnosis the gang scheduler records on failed attempts
+(PodGang.status.last_diagnosis + the Unschedulable condition), its
+bounding and lifecycle (top-K domains, cleared on schedule,
+GROVE_EXPLAIN=0 off switch), the grove_gang_unschedulable /
+grove_gang_pending_seconds metric surface, and the grovectl-explain
+render."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from grove_tpu.api import Pod, PodGang, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec, PodSpec
+from grove_tpu.api.meta import get_condition, is_condition_true
+from grove_tpu.api.podcliqueset import TopologyConstraint
+from grove_tpu.api.podgang import PodGangSpec, PodGroup
+from grove_tpu.runtime import metrics as m
+from grove_tpu.runtime.events import events_for
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.scheduler.explain import (
+    EXPLAIN_ENV,
+    EXPLAIN_TOP_K,
+    REFRESH_ENV,
+    payload_from_obj,
+    render_explain,
+)
+from grove_tpu.store.client import Client
+from grove_tpu.store.store import Store
+from grove_tpu.topology.fleet import build_node
+
+from tools.bench_sched import build_fleet, new_backend
+
+
+def _gang(client, name, n_pods, chips=4, priority=0, base_gang="",
+          labels=None, selector=None, create_pods=None):
+    """A slice-atomic gang of ``n_pods`` pods x ``chips`` chips (pods
+    created bindable: ungated, Pending). ``create_pods`` limits how
+    many of the named pods actually exist (straggler setups)."""
+    pods = [f"{name}-p-{i}" for i in range(n_pods)]
+    client.create(PodGang(
+        meta=new_meta(name, labels=dict(labels or {})),
+        spec=PodGangSpec(
+            groups=[PodGroup(name="g", pod_names=pods,
+                             min_replicas=(create_pods
+                                           if create_pods is not None
+                                           else n_pods))],
+            topology=TopologyConstraint(pack_level="slice",
+                                        required=True),
+            priority=priority, base_gang=base_gang)))
+    for pn in pods[:create_pods if create_pods is not None else n_pods]:
+        client.create(Pod(
+            meta=new_meta(pn, labels={c.LABEL_PODGANG_NAME: name,
+                                      **(labels or {})}),
+            spec=PodSpec(tpu_chips=chips,
+                         container=ContainerSpec(argv=["x"]),
+                         node_selector=dict(selector or {}))))
+    return pods
+
+
+def _fleet(chips):
+    client = Client(Store())
+    build_fleet(client, chips)
+    return client
+
+
+def _diag(client, name):
+    return client.get(PodGang, name).status.last_diagnosis
+
+
+# ---- diagnosis variants ----
+
+def test_chip_shortfall_diagnosis(monkeypatch):
+    monkeypatch.setenv(REFRESH_ENV, "0")
+    client = _fleet(16)                      # one 16-chip slice
+    _gang(client, "g0", 8, chips=4)          # wants 32
+    backend = new_backend(client)
+    backend._place_pass()
+
+    d = _diag(client, "g0")
+    assert d is not None
+    assert d.reason == "ChipShortfall"
+    assert d.requested_chips == 32 and d.pods == 8
+    assert d.attempts == 1 and d.first_failure_time > 0
+    assert d.domains and d.domains[0].closest
+    assert d.domains[0].verdict == "chip-shortfall"
+    assert d.domains[0].free_chips == 16
+    assert d.preemption is not None and d.preemption.verdict == "no-victims"
+
+    cond = get_condition(client.get(PodGang, "g0").status.conditions,
+                         c.COND_UNSCHEDULABLE)
+    assert cond is not None and cond.status == "True"
+    assert cond.reason == "ChipShortfall"
+    assert "32 chips" in cond.message
+
+    # The generic warning now carries the diagnosis headline.
+    evs = events_for(client, "PodGang", "g0")
+    assert any(e.reason == "GangUnschedulable"
+               and "[ChipShortfall]" in e.message for e in evs)
+
+    # A second failed attempt (refresh window zeroed) bumps the count.
+    backend._place_pass()
+    assert _diag(client, "g0").attempts == 2
+
+
+def test_refresh_throttle_suppresses_status_churn(monkeypatch):
+    """Within the refresh window an unchanged failure must not bump
+    the gang's resource version every 0.2s tick — the diagnosis write
+    is a suppressed no-op."""
+    monkeypatch.setenv(REFRESH_ENV, "60")
+    client = _fleet(16)
+    _gang(client, "g0", 8, chips=4)
+    backend = new_backend(client)
+    backend._place_pass()
+    rv1 = client.get(PodGang, "g0").meta.resource_version
+    backend._place_pass()
+    g = client.get(PodGang, "g0")
+    assert g.meta.resource_version == rv1
+    assert g.status.last_diagnosis.attempts == 1
+
+
+def test_topology_prune_diagnosis():
+    client = _fleet(32)                      # two 16-chip slices
+    _gang(client, "g0", 5, chips=4)          # 20 chips: fits nowhere whole
+    new_backend(client)._place_pass()
+    d = _diag(client, "g0")
+    assert d is not None
+    assert d.reason == "TopologyPruned"
+    assert all(e.verdict == "chip-shortfall" for e in d.domains)
+    assert d.domains_total == 2
+
+
+def test_preemption_rejected_diagnosis_and_event():
+    client = _fleet(8)                       # one 8-chip slice
+    backend = new_backend(client)
+    _gang(client, "base-a", 1, chips=4)
+    backend._place_pass()                    # base-a placed
+    _gang(client, "scaled-b", 1, chips=4, base_gang="base-a")
+    backend._place_pass()                    # scaled-b placed
+    _gang(client, "base-c", 4, chips=4)      # 16 chips: hopeless
+    backend._place_pass()
+
+    d = _diag(client, "base-c")
+    assert d is not None
+    assert d.reason == "PreemptionRejected"
+    assert d.preemption.verdict == "victims-insufficient"
+    assert d.preemption.victims_considered == 1
+    assert d.preemption.victim_chips == 4
+    evs = events_for(client, "PodGang", "base-c")
+    rejected = [e for e in evs if e.reason == "PreemptionRejected"]
+    assert rejected and "4 chips" in rejected[0].message
+    # The victim was NOT evicted (eviction cannot seat the gang).
+    assert client.get(Pod, "scaled-b-p-0").status.node_name
+
+
+def test_domains_bounded_top_k():
+    client = Client(Store())
+    for i in range(EXPLAIN_TOP_K + 4):       # 12 single-host slices
+        client.create(build_node("v5e", "2x2", f"s{i:02d}", 0))
+    _gang(client, "g0", 6, chips=4)          # 24 chips: nowhere
+    new_backend(client)._place_pass()
+    d = _diag(client, "g0")
+    assert d is not None
+    assert len(d.domains) == EXPLAIN_TOP_K
+    assert d.domains_total == EXPLAIN_TOP_K + 4
+    assert sum(1 for e in d.domains if e.closest) == 1
+
+
+def test_straggler_diagnosis_coexists_with_scheduled():
+    client = Client(Store())
+    client.create(build_node("v5e", "2x2", "s0", 0))   # 4 chips
+    # Gang names 3 pods but only 2 exist (min 2): the floor places,
+    # the late third pod cannot rejoin the full anchor slice.
+    _gang(client, "g0", 3, chips=2, create_pods=2)
+    backend = new_backend(client)
+    backend._place_pass()
+    g = client.get(PodGang, "g0")
+    assert is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+    client.create(Pod(
+        meta=new_meta("g0-p-2", labels={c.LABEL_PODGANG_NAME: "g0"}),
+        spec=PodSpec(tpu_chips=2, container=ContainerSpec(argv=["x"]))))
+    backend._place_pass()
+    d = _diag(client, "g0")
+    assert d is not None and d.reason == "StragglerUnplaced"
+    assert "g0-p-2" in d.message
+    g = client.get(PodGang, "g0")
+    assert is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+    assert is_condition_true(g.status.conditions, c.COND_UNSCHEDULABLE)
+    # The render must NOT hide the reason tree behind Scheduled=True:
+    # the operator asking why the surplus pod is stuck sees it.
+    text = "\n".join(render_explain(client.debug_placement("g0")))
+    assert "SCHEDULED AT FLOOR — StragglerUnplaced" in text
+    assert "g0-p-2" in text
+
+
+# ---- lifecycle: cleared on schedule + metrics surface ----
+
+def test_cleared_on_schedule_observes_pending_histogram():
+    client = Client(Store())
+    client.create(build_node("v5e", "2x2", "s0", 0))   # 4 chips
+    _gang(client, "g0", 2, chips=4)                    # wants 8
+    backend = new_backend(client)
+    backend._place_pass()
+    assert _diag(client, "g0") is not None
+    hist_before = m.parse_histograms(
+        GLOBAL_METRICS.render(), "grove_gang_pending_seconds")
+    before = (hist_before.get((), {}) or {}).get(float("inf"), 0.0)
+
+    # Capacity arrives in the SAME slice: the gang seats, the
+    # diagnosis clears, the pending time lands in the histogram.
+    client.create(build_node("v5e", "2x4", "s0", 1))
+    backend._place_pass()
+    g = client.get(PodGang, "g0")
+    assert g.status.last_diagnosis is None
+    assert is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+    cond = get_condition(g.status.conditions, c.COND_UNSCHEDULABLE)
+    assert cond is not None and cond.status == "False"
+
+    text = GLOBAL_METRICS.render()
+    hist = m.parse_histograms(text, "grove_gang_pending_seconds")
+    cum = hist[()]
+    assert set(cum) == set(m.PENDING_BUCKETS) | {float("inf")}, \
+        f"pending buckets drifted: {sorted(cum)}"
+    assert cum[float("inf")] >= before + 1
+    # The per-reason gauge drained back to zero.
+    assert 'grove_gang_unschedulable{reason="ChipShortfall"} 0.0' in text
+
+
+def test_unschedulable_gauge_tracks_reasons():
+    client = _fleet(16)
+    _gang(client, "g0", 8, chips=4)
+    backend = new_backend(client)
+    backend._place_pass()
+    text = GLOBAL_METRICS.render()
+    assert 'grove_gang_unschedulable{reason="ChipShortfall"} 1.0' in text
+
+
+# ---- off switch ----
+
+def test_explain_disabled_leaves_status_untouched(monkeypatch):
+    monkeypatch.setenv(EXPLAIN_ENV, "0")
+    client = _fleet(16)
+    _gang(client, "g0", 8, chips=4)
+    new_backend(client)._place_pass()
+    g = client.get(PodGang, "g0")
+    assert g.status.last_diagnosis is None
+    assert get_condition(g.status.conditions, c.COND_UNSCHEDULABLE) is None
+    # The pre-explain surfaces still work.
+    assert not is_condition_true(g.status.conditions, c.COND_SCHEDULED)
+    assert any(e.reason == "GangUnschedulable"
+               for e in events_for(client, "PodGang", "g0"))
+
+
+# ---- render + wire payload ----
+
+def test_debug_placement_payload_and_cli_render():
+    client = _fleet(16)
+    _gang(client, "g0", 8, chips=4)
+    new_backend(client)._place_pass()
+
+    payload = client.debug_placement("g0")
+    assert payload["name"] == "g0" and payload["scheduled"] is False
+    assert payload["diagnosis"]["reason"] == "ChipShortfall"
+
+    lines = render_explain(payload, now=time.time())
+    text = "\n".join(lines)
+    assert "UNSCHEDULABLE — ChipShortfall" in text
+    assert "* slice" in text          # closest-fit star
+    assert "32 chips across 8 pods" in text
+    assert "preemption: no-victims" in text
+
+    # The /api object dict renders identically (the PCS aggregation
+    # path in grovectl explain).
+    from grove_tpu.api.serde import to_dict
+    obj = to_dict(client.get(PodGang, "g0"))
+    assert render_explain(payload_from_obj(obj),
+                          now=time.time())[0] == lines[0]
+
+
+def test_render_scheduled_gang_has_no_reason_tree():
+    client = _fleet(16)
+    _gang(client, "g0", 2, chips=4)
+    new_backend(client)._place_pass()
+    payload = client.debug_placement("g0")
+    lines = render_explain(payload)
+    assert len(lines) == 1 and "scheduled onto" in lines[0]

@@ -227,6 +227,46 @@ def test_health_metrics_and_errors(server):
                        bad.replace("websvc", "broken"))
     assert status == 400 and "min_available" in err["error"]
 
+def test_debug_placement_endpoint(server):
+    """GET /debug/placement/<ns>/<name> serves the raw diagnosis (and
+    the HttpClient twin decodes it); an unknown gang is 404. Unlike
+    /debug/traces this is plain status data — no profiling gate."""
+    base, cl = server
+    from grove_tpu.api import Pod, PodGang, constants as c
+    from grove_tpu.api.core import ContainerSpec, PodSpec
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.api.podcliqueset import TopologyConstraint
+    from grove_tpu.api.podgang import PodGangSpec, PodGroup
+    from grove_tpu.store.httpclient import HttpClient
+    pods = ["stuck-p-0", "stuck-p-1"]
+    # 8 chips/pod: no 16-chip slice host set can seat 2x8 on 4-chip
+    # hosts -> permanent diagnosis.
+    cl.client.create(PodGang(
+        meta=new_meta("stuck"),
+        spec=PodGangSpec(
+            groups=[PodGroup(name="g", pod_names=pods, min_replicas=2)],
+            topology=TopologyConstraint(pack_level="slice",
+                                        required=True))))
+    for pn in pods:
+        cl.client.create(Pod(
+            meta=new_meta(pn, labels={c.LABEL_PODGANG_NAME: "stuck"}),
+            spec=PodSpec(tpu_chips=8,
+                         container=ContainerSpec(argv=["x"]))))
+    wait_for(lambda: cl.client.get(
+        PodGang, "stuck").status.last_diagnosis is not None,
+        desc="diagnosis recorded")
+    status, data = _req(f"{base}/debug/placement/default/stuck")
+    assert status == 200
+    assert data["name"] == "stuck" and data["scheduled"] is False
+    assert data["diagnosis"]["reason"]
+    assert data["diagnosis"]["domains"]
+    # Wire twin returns the identical shape.
+    http = HttpClient(base, token=OPERATOR_TOKEN)
+    assert http.debug_placement("stuck") == data
+    status, _ = _req(f"{base}/debug/placement/default/ghost")
+    assert status == 404
+
+
 def test_debug_endpoints_profiling_gate_and_auth():
     """/debug/profile, /debug/stacks, and /debug/traces share one gate:
     404 while profiling is disabled (the endpoints 'don't exist',

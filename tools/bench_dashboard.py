@@ -40,7 +40,9 @@ def render(rows: list[dict]) -> str:
     # serving table.
     ready = [r for r in rows if r.get("metric") == "gang_time_to_ready_ms"
              and r.get("value", 0) > 0]
-    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu"}
+    pending = [r for r in rows
+               if r.get("metric") == "gang_pending_reasons"]
+    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes]
     failed = [r for r in rows if r.get("value", 0) <= 0]
@@ -60,6 +62,20 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('p95_ms', 0):.1f} "
                 f"| {r.get('scheduled_p50_ms', 0):.1f} "
                 f"| {r.get('reps', '?')} |")
+        out.append("")
+    if pending:
+        out += ["## Pending gangs by reason (placement explainability "
+                "smoke)", "",
+                "| when | git | pending gangs | reasons | observed "
+                "pending s |", "|---|---|---|---|---|"]
+        for r in sorted(pending, key=lambda r: r.get("ts", "")):
+            reasons = ", ".join(
+                f"{k}={v}" for k, v in
+                sorted((r.get("reasons") or {}).items())) or "-"
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('value', 0):.0f} | {reasons} "
+                f"| {r.get('pending_s', 0):.1f} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

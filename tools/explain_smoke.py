@@ -1,0 +1,164 @@
+"""Placement-explainability smoke: a deliberately oversized gang must
+produce a chip-shortfall diagnosis that ``grovectl explain`` names.
+
+The explain layer's CI gate (wired into ``make ci``): brings up an
+in-process cluster with ONE fake v5e 4x4 slice (16 chips), creates a
+PodCliqueSet demanding 32 chips slice-atomically, waits for the
+scheduler's ``Unschedulable`` condition, then asserts
+
+- ``PodGang.status.last_diagnosis`` carries reason ``ChipShortfall``
+  with the closest-fit domain flagged,
+- ``grovectl explain podgang/<name>`` (over a real HTTP ApiServer)
+  prints the shortfall and the starred closest fit,
+- ``grovectl get PodGang -o table`` shows the PENDING-REASON column,
+- ``grove_gang_unschedulable{reason="ChipShortfall"}`` is 1 in
+  /metrics.
+
+With ``--history`` it appends a ``gang_pending_reasons`` row to
+``bench-history/history.jsonl`` — rendered by tools/bench_dashboard.py
+as the pending-gangs-by-reason section.
+
+    python tools/explain_smoke.py [--timeout 30] [--history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="explain-smoke")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--history", action="store_true",
+                        help="append a gang_pending_reasons row to "
+                             "bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu import cli
+    from grove_tpu.api import PodCliqueSet, PodGang, constants as c
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import get_condition, new_meta
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cluster = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))  # 16 chips
+    with cluster:
+        client = cluster.client
+        client.create(PodCliqueSet(
+            meta=new_meta("oversize"),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplate(
+                    cliques=[PodCliqueTemplate(
+                        name="w", replicas=8, min_available=8,
+                        container=ContainerSpec(argv=["sleep", "inf"]),
+                        tpu_chips_per_pod=4)],          # 32 > 16
+                    topology=TopologyConstraint(pack_level="slice",
+                                                required=True)))))
+        gang_name = "oversize-0"
+
+        def diagnosed():
+            try:
+                g = client.get(PodGang, gang_name)
+            except Exception:  # noqa: BLE001 — gang not created yet
+                return False
+            return g.status.last_diagnosis is not None
+        wait_for(diagnosed, args.timeout, "placement diagnosis recorded")
+
+        gang = client.get(PodGang, gang_name)
+        diag = gang.status.last_diagnosis
+        assert diag.reason == "ChipShortfall", diag
+        assert diag.requested_chips == 32, diag
+        assert any(e.closest for e in diag.domains), diag
+        cond = get_condition(gang.status.conditions, c.COND_UNSCHEDULABLE)
+        assert cond is not None and cond.status == "True" \
+            and cond.reason == "ChipShortfall", cond
+
+        # The CLI path over a real HTTP server: grovectl explain must
+        # name the shortfall with the closest fit starred.
+        server = ApiServer(cluster, port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli.main(["explain", f"podgang/{gang_name}",
+                               "--server", url])
+            text = out.getvalue()
+            assert rc == 0, text
+            assert "ChipShortfall" in text, text
+            assert "chip-shortfall" in text and "* slice" in text, text
+
+            # PCS aggregation: one list, every member gang rendered.
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli.main(["explain", "podcliqueset/oversize",
+                               "--server", url])
+            agg = out.getvalue()
+            assert rc == 0, agg
+            assert "1 with a pending diagnosis" in agg, agg
+            assert "ChipShortfall" in agg, agg
+
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli.main(["get", "PodGang", "-o", "table",
+                               "--server", url])
+            table = out.getvalue()
+            assert rc == 0 and "PENDING-REASON" in table, table
+            assert "ChipShortfall" in table, table
+        finally:
+            server.stop()
+
+        metrics = cluster.manager.metrics_text()
+        assert 'grove_gang_unschedulable{reason="ChipShortfall"} 1.0' \
+            in metrics
+
+        reasons = {diag.reason: 1}
+        pending_s = time.time() - diag.first_failure_time
+
+    print(f"explain smoke OK: {gang_name} diagnosed {diag.reason} "
+          f"({diag.requested_chips} chips over "
+          f"{diag.domains[0].free_chips} free), CLI render + "
+          f"PENDING-REASON column + unschedulable gauge verified")
+
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "gang_pending_reasons",
+            "value": float(sum(reasons.values())),
+            "unit": "gangs",
+            "reasons": reasons,
+            "pending_s": round(pending_s, 3),
+            "mode": "explain-cpu",
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
