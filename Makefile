@@ -42,10 +42,13 @@ bench-sched:     ## PodGang schedule p50/p99, 1->256-chip fleets (CPU only)
 	@# Appends rows to bench-history/history.jsonl.
 	$(PY) tools/bench_sched.py --compare
 
-bench-reconcile: ## controller reconcile p50/p99 + store-scan counts (CPU only)
-	@# The informer layer's proof: 1->256-pod fleets driven through the
-	@# real reconcilers, informer cache vs GROVE_INFORMER=0 direct reads.
-	@# Appends reconcile_p50_ms rows to bench-history/history.jsonl.
+bench-reconcile: ## controller reconcile p50/p99 + store-scan/write counts (CPU only)
+	@# The informer layer's proof AND the deploy write-path baseline:
+	@# 1->1024-pod fleets driven through the real reconcilers, informer
+	@# cache vs GROVE_INFORMER=0 direct reads. Appends reconcile_p50_ms
+	@# rows (deploy_wall_ms + store_writes_per_pod included; the
+	@# 1024-pod point pins the 1000-pod deploy budget) to
+	@# bench-history/history.jsonl.
 	$(PY) tools/bench_reconcile.py --compare
 
 bench-disagg:    ## PrefillWorker->DecodeEngine KV hand-off seam (real TPU)
@@ -83,6 +86,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# chip-shortfall diagnosis that grovectl explain names (and the
 	@# PENDING-REASON column + unschedulable gauge render).
 	$(PY) tools/explain_smoke.py
+	@# deploy-observatory smoke: 1-gang create -> Available with a
+	@# write-amplification assertion (store writes per pod deployed
+	@# bounded) and writer-attribution + deploy-histogram checks.
+	$(PY) tools/deploy_smoke.py
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

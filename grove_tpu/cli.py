@@ -649,6 +649,27 @@ def cmd_rollout(args: argparse.Namespace) -> int:
         time.sleep(args.poll)
 
 
+def cmd_deploy_status(args: argparse.Namespace) -> int:
+    """Render a PodCliqueSet's deploy-progress record from the serve
+    daemon's deploy observatory: pods per lifecycle stage, milestone
+    offsets, write amplification (store writes per pod deployed), and
+    the control plane's queue-wait vs reconcile-work split — the
+    write-path companion to `grovectl rollout status` (which tracks
+    spec rollouts; this tracks the deploy's cost). Exit 0 once the PCS
+    reached Available, 1 while in progress (scripts poll it like
+    rollout status)."""
+    from grove_tpu.runtime.deploywatch import render_deploy_status
+    status, data = _http(args.server,
+                         f"/debug/deploy/{args.namespace}/{args.name}",
+                         ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    for line in render_deploy_status(data, time.time()):
+        print(line)
+    return 0 if data.get("available_at") else 1
+
+
 def cmd_apply(args: argparse.Namespace) -> int:
     """Apply a manifest against a running serve daemon."""
     try:
@@ -1095,6 +1116,19 @@ def main(argv: list[str] | None = None) -> int:
     ro.add_argument("--server", default=default_server)
     add_ca(ro)
     ro.set_defaults(fn=cmd_rollout)
+
+    ds = sub.add_parser(
+        "deploy-status",
+        help="deploy observatory view of a PodCliqueSet: pods per "
+             "lifecycle stage, milestones, store writes per pod "
+             "deployed, queue-wait vs work split (exit 0 = Available, "
+             "1 = in progress; the write-path companion to rollout "
+             "status)")
+    ds.add_argument("name")
+    ds.add_argument("--namespace", default="default")
+    ds.add_argument("--server", default=default_server)
+    add_ca(ds)
+    ds.set_defaults(fn=cmd_deploy_status)
 
     for verb in ("cordon", "uncordon"):
         cp = sub.add_parser(verb, help=f"{verb} a node "

@@ -17,6 +17,7 @@ on its own workers) runs inline.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
@@ -63,14 +64,24 @@ def run_concurrently(tasks: Sequence[Callable[[], None]],
                 errors.append(e)
         return errors
 
-    def wrapped(task: Callable[[], None]) -> None:
+    def wrapped(task: Callable[[], None],
+                ctx: contextvars.Context) -> None:
         _in_pool.active = True
         try:
-            task()
+            # Run under the SUBMITTER's contextvars: pool threads have
+            # their own (empty) context, which would silently drop
+            # context-scoped attribution — e.g. the store write
+            # telemetry's writer label set per reconcile
+            # (store/writeobs.py) must follow a pod-creation burst onto
+            # these threads, or the deploy's dominant write class reads
+            # writer="direct".
+            ctx.run(task)
         finally:
             _in_pool.active = False
 
-    futures = [_shared_pool().submit(wrapped, t) for t in tasks]
+    futures = [_shared_pool().submit(wrapped, t,
+                                     contextvars.copy_context())
+               for t in tasks]
     for f in futures:
         try:
             f.result()

@@ -28,6 +28,7 @@ from grove_tpu.runtime.logger import get_logger
 from grove_tpu.runtime.metrics import GLOBAL_METRICS
 from grove_tpu.api.meta import trace_id_of
 from grove_tpu.runtime.trace import GLOBAL_TRACER
+from grove_tpu.store import writeobs
 from grove_tpu.store.store import Event
 from grove_tpu.store.client import Client
 
@@ -277,10 +278,19 @@ class Controller:
             req = self.queue.get(timeout=0.2)
             if req is None:
                 continue
+            t0 = time.perf_counter()
             try:
                 self._process(req)
             finally:
                 self.queue.done(req)
+                # Work duration, pickup → done (the workqueue_work_
+                # duration_seconds analog): with the queue-wait
+                # histogram this is the congestion split the deploy
+                # observatory reports — time spent waiting for a worker
+                # vs time spent being worked on.
+                GLOBAL_METRICS.observe("grove_workqueue_work_seconds",
+                                       time.perf_counter() - t0,
+                                       controller=self.name)
 
     def _process(self, req: Request) -> None:
         with self._count_lock:
@@ -293,43 +303,57 @@ class Controller:
         # spans it opens land in the same trace.
         trace_hint = self.queue.pop_trace(req)
         t0 = time.perf_counter()
-        with GLOBAL_TRACER.span(f"reconcile.{self.name}",
-                                trace_id=trace_hint or None,
-                                attrs={"key": req.key}) as span:
-            try:
+        # Writer attribution for store write telemetry: every write the
+        # reconcile body issues — however deep, including fan-out
+        # through helpers on this thread — is labeled with this
+        # controller's name (grove_store_writes_total{writer=...}).
+        writer_token = writeobs.set_writer(self.name)
+        try:
+            with GLOBAL_TRACER.span(f"reconcile.{self.name}",
+                                    trace_id=trace_hint or None,
+                                    attrs={"key": req.key}) as span:
                 try:
-                    result = self.reconcile(req) or StepResult.finished()
-                finally:
-                    dt = time.perf_counter() - t0
-                    self.durations.append(dt)
-                    GLOBAL_METRICS.observe(
-                        "grove_reconcile_duration_seconds",
-                        dt, controller=self.name)
-            except Exception as e:  # noqa: BLE001 - reconcile panic barrier
-                self.error_count += 1
-                span.set_error(e)
-                self.log.warning("reconcile %s panicked: %s", req.key, e,
-                                 exc_info=True)
-                self._requeue_with_backoff(req, trace_id=trace_hint)
-                return
-            if result.error is not None:
-                self.error_count += 1
-                span.set_error(result.error)
-                GLOBAL_METRICS.inc("grove_reconcile_errors_total",
-                                   controller=self.name)
-                self.log.debug("reconcile %s error: %s", req.key,
-                               result.error)
-                self._requeue_with_backoff(req, result.requeue_after,
-                                           trace_id=trace_hint)
-                return
-            self._failures.pop(req, None)
-            if result.requeue_after is not None:
-                self.queue.add(req, result.requeue_after,
-                               trace_id=trace_hint)
+                    try:
+                        result = self.reconcile(req) or \
+                            StepResult.finished()
+                    finally:
+                        dt = time.perf_counter() - t0
+                        self.durations.append(dt)
+                        GLOBAL_METRICS.observe(
+                            "grove_reconcile_duration_seconds",
+                            dt, controller=self.name)
+                except Exception as e:  # noqa: BLE001 - panic barrier
+                    self.error_count += 1
+                    span.set_error(e)
+                    self.log.warning("reconcile %s panicked: %s", req.key,
+                                     e, exc_info=True)
+                    self._requeue_with_backoff(req, trace_id=trace_hint,
+                                               reason="panic")
+                    return
+                if result.error is not None:
+                    self.error_count += 1
+                    span.set_error(result.error)
+                    GLOBAL_METRICS.inc("grove_reconcile_errors_total",
+                                       controller=self.name)
+                    self.log.debug("reconcile %s error: %s", req.key,
+                                   result.error)
+                    self._requeue_with_backoff(req, result.requeue_after,
+                                               trace_id=trace_hint)
+                    return
+                self._failures.pop(req, None)
+                if result.requeue_after is not None:
+                    GLOBAL_METRICS.inc("grove_reconcile_requeues_total",
+                                       controller=self.name,
+                                       reason="requeue_after")
+                    self.queue.add(req, result.requeue_after,
+                                   trace_id=trace_hint)
+        finally:
+            writeobs.reset_writer(writer_token)
 
     def _requeue_with_backoff(self, req: Request,
                               override: float | None = None,
-                              trace_id: str = "") -> None:
+                              trace_id: str = "",
+                              reason: str | None = None) -> None:
         # The trace hint rides through the retry: error-and-backoff
         # reconciles are exactly the ones a slow-bring-up trace must
         # show, not lose.
@@ -337,4 +361,8 @@ class Controller:
         self._failures[req] = n
         delay = override if override is not None else min(
             self.backoff_base * (2 ** (n - 1)), self.backoff_max)
+        GLOBAL_METRICS.inc(
+            "grove_reconcile_requeues_total", controller=self.name,
+            reason=reason or ("requeue_after" if override is not None
+                              else "backoff"))
         self.queue.add(req, delay, trace_id=trace_id)

@@ -1,0 +1,417 @@
+"""Deploy observatory — per-PodCliqueSet rollout progress recording.
+
+The reference's scale gate is a 1000-pod PCS deploy reaching Available
+inside a 10-minute budget (SURVEY.md §6, scale_test.go). The lifecycle
+tracer (runtime/trace.py) answers that question per GANG; this module
+answers it per DEPLOY: one record per PodCliqueSet tracking how many
+pods have been created/scheduled/started/become-ready over time, how
+many store writes and conflicts the deploy consumed (write
+amplification: writes per pod deployed), and how the control plane's
+time split between queue waiting and reconcile work.
+
+Feed: a store watch over PodCliqueSet/Pod/PodGang events, applied by a
+dedicated observer thread (the same event stream the informer caches
+consume — a deploy storm outruns the bounded replay ring between
+scrapes, so the recorder must be push-fed, not pull-on-read). Pods map
+to their PCS through the standard ``LABEL_PCS_NAME`` label.
+
+When a PCS reaches Available, its milestone ladder is frozen and each
+phase observed ONCE into ``grove_deploy_duration_seconds{phase}``
+(first_pod → pods_created → scheduled → started → ready → available,
+all measured from the PCS create) — the deploy-budget histogram a
+deployed alert watches, pinned to the same LIFECYCLE_BUCKETS the gang
+SLOs use.
+
+Surfaces:
+- ``GET /debug/deploy/<ns>/<name>`` (server.py; plain status-shaped
+  data, so read-gated like /debug/placement, not profiling-gated);
+- ``Client.debug_deploy`` / ``HttpClient.debug_deploy`` twins (one
+  payload shape in-process and over the wire);
+- ``grovectl deploy-status <name>`` renders it (render_deploy_status).
+
+Write/conflict accounting reads the write-path telemetry counters
+(store/writeobs.py) as whole-hub snapshots at deploy start vs
+Available — store-global, so overlapping deploys share the delta; with
+``GROVE_WRITE_OBS=0`` the write columns read zero. Records are bounded
+(RECORD_CAPACITY, oldest evicted) and survive PCS deletion so a
+completed deploy stays inspectable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Any
+
+from grove_tpu.api import constants as c
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+# Stages a pod moves through during a deploy, in pipeline order.
+POD_STAGES = ("created", "scheduled", "started", "ready")
+
+# Milestone phases observed into grove_deploy_duration_seconds.
+DEPLOY_PHASES = ("first_pod", "pods_created", "scheduled", "started",
+                 "ready", "available")
+
+# store (weakly) -> its observer, so the in-process Client can resolve
+# debug_deploy without holding a manager reference.
+_OBSERVERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def observer_for(store) -> "DeployObserver | None":
+    return _OBSERVERS.get(store)
+
+
+class _HubSnapshot:
+    """Point-in-time totals of the write/queue series a deploy
+    consumes; two of these subtract into the deploy's consumption."""
+
+    __slots__ = ("writes", "conflicts", "noops", "wait_s", "work_s")
+
+    def __init__(self) -> None:
+        self.writes = GLOBAL_METRICS.counter_total(
+            "grove_store_writes_total")
+        self.conflicts = GLOBAL_METRICS.counter_total(
+            "grove_store_conflicts_total")
+        self.noops = GLOBAL_METRICS.counter_total(
+            "grove_store_noop_writes_total")
+        self.wait_s = GLOBAL_METRICS.hist_totals(
+            "grove_workqueue_wait_seconds")[0]
+        self.work_s = GLOBAL_METRICS.hist_totals(
+            "grove_workqueue_work_seconds")[0]
+
+    def delta(self, since: "_HubSnapshot") -> dict:
+        return {
+            "writes": round(self.writes - since.writes),
+            "conflicts": round(self.conflicts - since.conflicts),
+            "noop_writes": round(self.noops - since.noops),
+            "queue_wait_s": round(self.wait_s - since.wait_s, 6),
+            "work_s": round(self.work_s - since.work_s, 6),
+        }
+
+
+class DeployRecord:
+    """One PodCliqueSet's deploy, from create to Available."""
+
+    __slots__ = ("namespace", "name", "created_at", "available_at",
+                 "deleted", "pods", "gangs", "start_snapshot",
+                 "final_usage", "milestones")
+
+    def __init__(self, namespace: str, name: str, created_at: float,
+                 snapshot: _HubSnapshot):
+        self.namespace = namespace
+        self.name = name
+        self.created_at = created_at
+        self.available_at: float | None = None
+        self.deleted = False
+        # pod name -> {stage: first-reach ts}
+        self.pods: dict[str, dict[str, float]] = {}
+        # gang name -> scheduled?
+        self.gangs: dict[str, bool] = {}
+        # Built by the caller OUTSIDE the observer lock (hub-lock work
+        # must not run under it — see DeployObserver._apply).
+        self.start_snapshot = snapshot
+        self.final_usage: dict | None = None   # frozen at Available
+        self.milestones: dict[str, float] = {}
+
+
+class DeployObserver:
+    """Watch-fed per-PCS deploy recorder (a manager runnable)."""
+
+    RECORD_CAPACITY = 64
+
+    def __init__(self, store) -> None:
+        # Weak store ref: _OBSERVERS is weakly KEYED by the store, and
+        # a WeakKeyDictionary strongly references its VALUES — a strong
+        # store ref here would keep the key alive through the value and
+        # leak every discarded Manager's store + records for process
+        # lifetime (the weakref-doc caveat).
+        self._store_ref = weakref.ref(store)
+        self._lock = threading.Lock()
+        self._records: "collections.OrderedDict[tuple[str, str], DeployRecord]" = \
+            collections.OrderedDict()
+        # Keys of records that can still finalize (not yet Available,
+        # not deleted, not evicted). Read by _apply BEFORE the observer
+        # lock to decide whether a PCS event needs a hub snapshot —
+        # only the event thread touches it, so no extra locking.
+        self._pending: set[tuple[str, str]] = set()
+        self._watcher = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.log = get_logger("deploywatch")
+
+    # ---- lifecycle (manager runnable contract) ----
+
+    def start(self) -> None:
+        store = self._store_ref()
+        if store is None:
+            return
+        # Registration happens on START, not construction: a second
+        # Manager merely CONSTRUCTED over the same store (never
+        # started) must not shadow the running observer's records —
+        # observer_for resolves to whoever actually watches. The entry
+        # survives stop() so completed deploys stay inspectable.
+        _OBSERVERS[store] = self
+        self._stop.clear()
+        self._watcher = store.watch(
+            kinds={"PodCliqueSet", "Pod", "PodGang"})
+        self._thread = threading.Thread(target=self._loop,
+                                        name="deploy-observer", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.close()
+        # Join before a possible restart: _apply's unlocked _pending
+        # read assumes ONE event thread; a stop->start inside the old
+        # thread's poll window would otherwise leave two running.
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            event = self._watcher.poll(timeout=0.2)
+            if event is None:
+                continue
+            try:
+                self._apply(event)
+            except Exception:  # noqa: BLE001 - observer must not die
+                self.log.exception("deploy observer dropped an event")
+
+    # ---- event application ----
+
+    def _apply(self, event) -> None:
+        obj = event.obj
+        ts = event.ts or time.time()
+        kind = obj.KIND
+        etype = event.type.value
+        # Lock-ordering discipline (same as payload()): hub-locked work
+        # never runs under the observer lock — a /metrics render holds
+        # the hub lock across the full exposition, and blocking the
+        # event thread on it would back events up in the watcher queue.
+        # So the snapshot a PCS event might need is built BEFORE the
+        # lock, and finalize observations are emitted AFTER it. Only
+        # the two consuming transitions pay for one (ADDED seeds a
+        # record; a MODIFIED that can actually finalize a still-pending
+        # record satisfies the availability predicate) — NOT every
+        # status write in the event stream of a PCS that is already
+        # Available, where the predicate stays true forever.
+        snap = None
+        if kind == "PodCliqueSet":
+            key = (obj.meta.namespace, obj.meta.name)
+            if etype == "ADDED" or (
+                    etype == "MODIFIED" and key in self._pending
+                    and obj.spec.replicas > 0
+                    and obj.status.available_replicas
+                    >= obj.spec.replicas):
+                snap = _HubSnapshot()
+        observations: list[tuple[str, float]] = []
+        with self._lock:
+            if kind == "PodCliqueSet":
+                self._apply_pcs(etype, obj, ts, snap, observations)
+            elif kind == "Pod":
+                self._apply_pod(event.type.value, obj, ts)
+            elif kind == "PodGang":
+                self._apply_gang(event.type.value, obj, ts)
+        for phase, seconds in observations:
+            GLOBAL_METRICS.observe("grove_deploy_duration_seconds",
+                                   seconds, phase=phase)
+
+    def _apply_pcs(self, etype: str, obj: Any, ts: float,
+                   snap: "_HubSnapshot | None",
+                   observations: list[tuple[str, float]]) -> None:
+        # ``snap`` is non-None exactly on the paths that consume it
+        # (ADDED, and a MODIFIED passing the availability predicate) —
+        # _apply's pre-lock gate mirrors the conditions here.
+        key = (obj.meta.namespace, obj.meta.name)
+        if etype == "ADDED":
+            # A re-created PCS starts a fresh deploy record.
+            self._records[key] = DeployRecord(
+                obj.meta.namespace, obj.meta.name,
+                obj.meta.creation_timestamp or ts, snap)
+            self._records.move_to_end(key)
+            self._pending.add(key)
+            while len(self._records) > self.RECORD_CAPACITY:
+                evicted, _ = self._records.popitem(last=False)
+                self._pending.discard(evicted)
+            return
+        rec = self._records.get(key)
+        if rec is None:
+            return
+        if etype == "DELETED":
+            # A deleted PCS emits no further events, so an unfinalized
+            # record can never finalize — stop paying for snapshots.
+            rec.deleted = True
+            self._pending.discard(key)
+            return
+        if rec.available_at is None and obj.spec.replicas > 0 \
+                and obj.status.available_replicas >= obj.spec.replicas:
+            self._pending.discard(key)
+            self._finalize(rec, ts, snap, observations)
+
+    def _record_for(self, obj: Any) -> DeployRecord | None:
+        pcs = obj.meta.labels.get(c.LABEL_PCS_NAME)
+        if not pcs:
+            return None
+        return self._records.get((obj.meta.namespace, pcs))
+
+    def _apply_pod(self, etype: str, obj: Any, ts: float) -> None:
+        rec = self._record_for(obj)
+        if rec is None or etype == "DELETED":
+            return
+        stages = rec.pods.setdefault(obj.meta.name, {})
+        # First-write-wins per stage: re-reconciles and condition
+        # flapping must not move a milestone backwards (or forwards).
+        if "created" not in stages:
+            stages["created"] = obj.meta.creation_timestamp or ts
+        st = obj.status
+        if st.node_name and "scheduled" not in stages:
+            stages["scheduled"] = ts
+        phase = getattr(st.phase, "value", st.phase)
+        if phase in ("Running", "Succeeded") and "started" not in stages:
+            stages["started"] = ts
+        if "ready" not in stages and is_condition_true(st.conditions,
+                                                       c.COND_READY):
+            stages["ready"] = ts
+
+    def _apply_gang(self, etype: str, obj: Any, ts: float) -> None:
+        rec = self._record_for(obj)
+        if rec is None or etype == "DELETED":
+            return
+        scheduled = is_condition_true(obj.status.conditions,
+                                      c.COND_SCHEDULED)
+        rec.gangs[obj.meta.name] = rec.gangs.get(obj.meta.name, False) \
+            or scheduled
+
+    def _finalize(self, rec: DeployRecord, ts: float,
+                  snap: _HubSnapshot,
+                  observations: list[tuple[str, float]]) -> None:
+        """Freeze the deploy at Available: milestone ladder collected
+        into ``observations`` (the caller observes them into the phase
+        histogram outside the observer lock), write/queue consumption
+        pinned from the pre-lock snapshot."""
+        rec.available_at = ts
+        rec.final_usage = snap.delta(rec.start_snapshot)
+        t0 = rec.created_at
+        created = [s["created"] for s in rec.pods.values()
+                   if "created" in s]
+        if created:
+            rec.milestones["first_pod"] = min(created)
+            rec.milestones["pods_created"] = max(created)
+        for stage, phase in (("scheduled", "scheduled"),
+                             ("started", "started"), ("ready", "ready")):
+            hit = [s[stage] for s in rec.pods.values() if stage in s]
+            if hit:
+                rec.milestones[phase] = max(hit)
+        rec.milestones["available"] = ts
+        for phase in DEPLOY_PHASES:
+            if phase in rec.milestones:
+                observations.append(
+                    (phase, max(0.0, rec.milestones[phase] - t0)))
+
+    # ---- read surface ----
+
+    def payload(self, namespace: str, name: str) -> dict | None:
+        """The /debug/deploy payload for one PCS, or None when no
+        record exists (PCS created before the observer started, or
+        evicted). In-progress deploys report live consumption deltas;
+        completed ones report the frozen numbers."""
+        # Hub-snapshot discipline, poller flavor: (a) only an
+        # IN-PROGRESS record needs a live snapshot — a finalized one
+        # serves its frozen usage and a missing one serves nothing, so
+        # polling a completed deploy must not pay five whole-hub scans
+        # per request; (b) when one is needed it is built BETWEEN lock
+        # round trips, never under the observer lock, which the event-
+        # apply thread needs (events back up in the watcher queue
+        # otherwise). Slightly stale against the record is fine —
+        # in-progress numbers are a moving estimate.
+        with self._lock:
+            rec = self._records.get((namespace, name))
+            need_live = rec is not None and rec.final_usage is None
+        if rec is None:
+            return None
+        live = _HubSnapshot() if need_live else None
+        with self._lock:
+            # final_usage may have been frozen between the two lock
+            # sections (one wasted snapshot); it is never un-frozen.
+            usage = rec.final_usage if rec.final_usage is not None \
+                else live.delta(rec.start_snapshot)
+            counts = {stage: sum(1 for s in rec.pods.values()
+                                 if stage in s)
+                      for stage in POD_STAGES}
+            pods_created = counts["created"]
+            return {
+                "kind": "PodCliqueSet",
+                "namespace": rec.namespace,
+                "name": rec.name,
+                # Server-side clock for "in progress for Ns": created_at
+                # is a server stamp, so a remote grovectl must not
+                # subtract it from its own (possibly skewed) clock.
+                "now": time.time(),
+                "created_at": rec.created_at,
+                "available_at": rec.available_at,
+                "deleted": rec.deleted,
+                "pods": counts,
+                "gangs": {"total": len(rec.gangs),
+                          "scheduled": sum(
+                              1 for v in rec.gangs.values() if v)},
+                "milestones": dict(rec.milestones),
+                "writes": {
+                    **usage,
+                    "writes_per_pod": round(
+                        usage["writes"] / pods_created, 2)
+                    if pods_created else 0.0,
+                },
+            }
+
+
+def render_deploy_status(payload: dict, now: float) -> list[str]:
+    """Human rendering of a /debug/deploy payload — the `grovectl
+    deploy-status` body (kept beside the recorder so the CLI and tests
+    share one renderer, the render_explain precedent)."""
+    t0 = payload.get("created_at", now)
+    # Prefer the server's clock for the in-progress age: created_at is
+    # a server stamp, and a skewed client clock would render negative
+    # (or inflated) durations. `now` stays the fallback for payloads
+    # from older servers.
+    now = payload.get("now", now)
+    avail = payload.get("available_at")
+    name = f"{payload.get('kind', 'PodCliqueSet')}/{payload.get('name')}"
+    out = []
+    if avail:
+        head = f"{name}: AVAILABLE after {avail - t0:.2f}s"
+    else:
+        head = f"{name}: deploy IN PROGRESS for {now - t0:.1f}s"
+    if payload.get("deleted"):
+        head += "  (object since deleted)"
+    out.append(head)
+    pods = payload.get("pods", {})
+    out.append("  pods:  " + "  ".join(
+        f"{stage} {pods.get(stage, 0)}" for stage in POD_STAGES))
+    gangs = payload.get("gangs", {})
+    out.append(f"  gangs: {gangs.get('scheduled', 0)}"
+               f"/{gangs.get('total', 0)} scheduled")
+    miles = payload.get("milestones", {})
+    if miles:
+        out.append("  milestones: " + "  ".join(
+            f"{phase} +{miles[phase] - t0:.2f}s"
+            for phase in DEPLOY_PHASES if phase in miles))
+    w = payload.get("writes", {})
+    out.append(
+        f"  writes: {w.get('writes', 0)} committed, "
+        f"{w.get('conflicts', 0)} conflicts, "
+        f"{w.get('noop_writes', 0)} suppressed no-ops"
+        f"  ->  {w.get('writes_per_pod', 0.0):.1f} writes/pod")
+    wait, work = w.get("queue_wait_s", 0.0), w.get("work_s", 0.0)
+    total = wait + work
+    out.append(
+        f"  queue: {wait:.2f}s waiting vs {work:.2f}s reconciling"
+        + (f"  ({100 * wait / total:.0f}% wait)" if total > 0 else ""))
+    return out

@@ -48,6 +48,13 @@ class Manager:
         self.log = get_logger("manager")
         self.controllers: list[Controller] = []
         self.runnables: list[Any] = []   # agents etc. with start()/stop()
+        # Deploy observatory: per-PCS rollout progress fed by the store
+        # event stream (served at /debug/deploy and by grovectl
+        # deploy-status). A runnable so it starts/stops with the
+        # manager's control loops.
+        from grove_tpu.runtime.deploywatch import DeployObserver
+        self.deploy_observer = DeployObserver(self.store)
+        self.runnables.append(self.deploy_observer)
         self._started = False
 
     def add_controller(self, controller: Controller) -> None:
@@ -78,9 +85,14 @@ class Manager:
         """Prometheus text exposition (the metrics-server analog)."""
         from grove_tpu.manifest import KIND_REGISTRY
         from grove_tpu.runtime.metrics import GLOBAL_METRICS
-        for c in self.controllers:
-            GLOBAL_METRICS.set("grove_workqueue_depth", len(c.queue),
-                               controller=c.name)
+        # Gauge-family semantics for the point-sampled queue depths: a
+        # controller that stopped (or drained out of this manager)
+        # must zero its series on the next scrape, not linger at the
+        # last sampled depth forever.
+        GLOBAL_METRICS.set_gauge_family(
+            "grove_workqueue_depth",
+            [({"controller": c.name}, float(len(c.queue)))
+             for c in self.controllers])
         for kind, cls in KIND_REGISTRY.items():
             try:
                 GLOBAL_METRICS.set("grove_store_objects",

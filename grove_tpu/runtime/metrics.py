@@ -41,6 +41,14 @@ LIFECYCLE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 PENDING_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
                    1800.0, 3600.0)
 
+# Pinned buckets for the store-lock wait/hold histograms: a healthy
+# write's critical section is microseconds, contention under a deploy
+# storm is milliseconds, and anything past 100ms means the global lock
+# is the bottleneck — the default duration buckets (5ms floor) would
+# flatten the entire healthy band into their first bucket.
+LOCK_BUCKETS = (5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1)
+
 
 class _Hist:
     __slots__ = ("buckets", "counts", "sum", "count")
@@ -114,19 +122,55 @@ class MetricsHub:
         Prometheus duration buckets)."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            h = self._hists.get(key)
-            if h is None:
-                h = self._hists[key] = _Hist(
-                    self._buckets.get(name, DEFAULT_BUCKETS))
-            buckets = h.buckets  # pinned at creation
-            for i, ub in enumerate(buckets):
-                if value <= ub:
-                    h.counts[i] += 1
-                    break
-            else:
-                h.counts[-1] += 1  # +Inf
-            h.sum += value
-            h.count += 1
+            self._observe_locked(key, value)
+
+    def _observe_locked(self, key: tuple[str, tuple],
+                        value: float) -> None:
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = _Hist(
+                self._buckets.get(key[0], DEFAULT_BUCKETS))
+        buckets = h.buckets  # pinned at creation
+        for i, ub in enumerate(buckets):
+            if value <= ub:
+                h.counts[i] += 1
+                break
+        else:
+            h.counts[-1] += 1  # +Inf
+        h.sum += value
+        h.count += 1
+
+    def bulk(self, incs=(), observations=()) -> None:
+        """Apply counter increments and histogram observations under ONE
+        lock acquisition. Items are ``(name, labels_tuple, value)`` with
+        ``labels_tuple`` already in sorted-pairs form — the store's
+        write-telemetry flush uses this so a write verb pays one hub
+        lock round trip, not one per sample (the hub lock is also held
+        across every /metrics render)."""
+        with self._lock:
+            for name, labels, v in incs:
+                self._counters[(name, labels)] += v
+            for name, labels, v in observations:
+                self._observe_locked((name, labels), v)
+
+    # ---- programmatic reads (the deploy observatory's snapshots) ----
+
+    def counter_total(self, name: str) -> float:
+        """Sum of counter ``name`` across every label set."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
+    def hist_totals(self, name: str) -> tuple[float, float]:
+        """(sum, count) of histogram ``name`` across every label set —
+        the windowed wait-vs-work split is a delta of two of these."""
+        with self._lock:
+            s = c = 0.0
+            for (n, _), h in self._hists.items():
+                if n == name:
+                    s += h.sum
+                    c += h.count
+            return s, c
 
     @staticmethod
     def _escape_label(value) -> str:
@@ -214,6 +258,29 @@ def parse_histograms(text: str, name: str,
                 labels.append((k, v))
         out.setdefault(tuple(sorted(labels)), {})[le] = float(
             m.group("value"))
+    return out
+
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>\w+?)(?:\{(?P<labels>.*)\})? (?P<value>\S+)$')
+
+
+def parse_counters(text: str, name: str) -> dict[tuple, float]:
+    """Parse counter/gauge samples named exactly ``name`` back out of
+    rendered exposition text: {labels: value}. The benches read their
+    scan/write counts through this — the same surface a deployed
+    Prometheus scrapes — instead of poking store attributes."""
+    out: dict[tuple, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m or m.group("name") != name:
+            continue
+        labels = tuple(sorted(
+            (k, _unescape_label(v))
+            for k, v in _LABEL_PAIR_RE.findall(m.group("labels") or "")))
+        out[labels] = float(m.group("value"))
     return out
 
 
@@ -334,4 +401,64 @@ GLOBAL_METRICS.describe_histogram(
     "grove_lifecycle_phase_seconds",
     "Per-phase gang lifecycle durations (phase=create_to_gang|"
     "gang_to_scheduled|scheduled_to_started|started_to_ready)",
+    buckets=LIFECYCLE_BUCKETS)
+# Write-path observability surface (docs/design/
+# write-path-observability.md): every store write attributed to kind,
+# verb, and writer; GROVE_WRITE_OBS=0 disables the collection.
+GLOBAL_METRICS.describe(
+    "grove_store_writes_total",
+    "Committed store mutations per kind, verb (create|update|"
+    "update_status|patch_status|delete) and writer (the reconciling "
+    "controller, or 'direct' for unattributed clients); cascade "
+    "deletes count one delete per removed object")
+GLOBAL_METRICS.describe(
+    "grove_store_conflicts_total",
+    "Optimistic-concurrency rejections (stale resource_version) per "
+    "kind, verb, and writer — sustained conflicts mean two writers "
+    "fight over one object")
+GLOBAL_METRICS.describe(
+    "grove_store_noop_writes_total",
+    "Status writes suppressed as byte-identical no-ops per kind and "
+    "writer (the steady-state self-trigger guard; a high rate is "
+    "wasted reconcile work, not wasted store writes)")
+GLOBAL_METRICS.describe(
+    "grove_store_events_total",
+    "Event-ring appends per kind and event type — the watch fan-out "
+    "cost every committed write pays")
+GLOBAL_METRICS.describe(
+    "grove_store_list_scans_total",
+    "List-shaped store scans per kind (list + list_snapshot; the "
+    "metric twin of Store.list_scans — benches and dashboards read "
+    "this text, not store internals)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_store_lock_wait_seconds",
+    "Time a write verb waited to acquire the store lock (writer "
+    "contention; per public verb)",
+    buckets=LOCK_BUCKETS)
+GLOBAL_METRICS.describe_histogram(
+    "grove_store_lock_hold_seconds",
+    "Time a write verb held the store lock (critical-section length — "
+    "what every other store caller waited behind; per public verb)",
+    buckets=LOCK_BUCKETS)
+# Per-controller write-path attribution: work duration (the
+# workqueue_work_duration_seconds analog) and requeue/retry counters
+# complement grove_workqueue_wait_seconds.
+GLOBAL_METRICS.describe_histogram(
+    "grove_workqueue_work_seconds",
+    "Time a worker spends on one dequeued request, pickup to done "
+    "(workqueue_work_duration_seconds analog; queue-wait vs work-time "
+    "is the deploy observatory's congestion split)")
+GLOBAL_METRICS.describe(
+    "grove_reconcile_requeues_total",
+    "Requeues per controller and reason (backoff=error retry with "
+    "exponential delay, requeue_after=explicit delayed requeue, "
+    "panic=reconcile raised)")
+# Deploy observatory (runtime/deploywatch.py): per-PCS deploy
+# milestones, observed once per deploy when the PCS reaches Available.
+GLOBAL_METRICS.describe_histogram(
+    "grove_deploy_duration_seconds",
+    "PodCliqueSet create-to-milestone durations per phase "
+    "(first_pod|pods_created|scheduled|started|ready|available), "
+    "observed once per deploy at Available — the 1000-pod "
+    "deploy-budget surface (SURVEY.md §6)",
     buckets=LIFECYCLE_BUCKETS)

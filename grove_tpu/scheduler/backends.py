@@ -287,6 +287,11 @@ class _PlacementLoop:
         self._wake.set()
 
     def _run(self) -> None:
+        # Writer attribution for store write telemetry: the loop thread
+        # is the scheduler's only writer (binds, diagnosis status), so
+        # one context set covers every pass.
+        from grove_tpu.store import writeobs
+        writeobs.set_writer(f"scheduler.{self.name}")
         while not self._stop.is_set():
             self._wake.wait(self.tick)
             self._wake.clear()

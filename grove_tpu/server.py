@@ -41,6 +41,12 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       conditions; grovectl explain
                                       renders it; plain status data, so
                                       read-gated, not profiling-gated)
+  GET  /debug/deploy/<ns>/<name>      deploy-progress record for one
+                                      PodCliqueSet (pods per stage,
+                                      milestones, write amplification,
+                                      queue-wait vs work split; grovectl
+                                      deploy-status renders it; same
+                                      read gate as /debug/placement)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -415,6 +421,9 @@ class ApiServer:
                     elif len(parts) == 4 and parts[0] == "debug" \
                             and parts[1] == "placement":
                         self._debug_placement(parts[2], parts[3])
+                    elif len(parts) == 4 and parts[0] == "debug" \
+                            and parts[1] == "deploy":
+                        self._debug_deploy(parts[2], parts[3])
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -697,6 +706,16 @@ class ApiServer:
                 from grove_tpu.scheduler.explain import placement_payload
                 gang = cluster.client.get(PodGang, name, namespace)
                 self._send(200, placement_payload(gang))
+
+            def _debug_deploy(self, namespace: str, name: str):
+                """GET /debug/deploy/<ns>/<name> — one PodCliqueSet's
+                deploy-progress record (``grovectl deploy-status``
+                renders it). Aggregate progress/consumption data, so it
+                shares the read gate like /debug/placement, not the
+                profiling gate. NotFoundError from the twin maps to 404
+                in do_GET's handler."""
+                self._send(200, cluster.client.debug_deploy(
+                    name, namespace))
 
             def _workload_owns(self, actor: str, payload: dict) -> bool:
                 """A workload actor (system:workload:<ns>:<pcs>) may only

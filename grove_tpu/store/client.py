@@ -116,6 +116,25 @@ class Client:
         from grove_tpu.scheduler.explain import placement_payload
         return placement_payload(self.get(PodGang, name, namespace))
 
+    def debug_deploy(self, name: str, namespace: str = "default") -> dict:
+        """One PodCliqueSet's deploy-progress record — the in-process
+        twin of ``GET /debug/deploy/<ns>/<name>`` (same payload shape;
+        grovectl deploy-status renders either). Raises NotFoundError
+        when no observatory runs on this store or the PCS predates it."""
+        from grove_tpu.runtime.deploywatch import observer_for
+        from grove_tpu.runtime.errors import NotFoundError
+        obs = observer_for(self._store)
+        if obs is None:
+            raise NotFoundError(
+                "deploy observatory is not running for this store "
+                "(no started Manager owns it)")
+        payload = obs.payload(namespace, name)
+        if payload is None:
+            raise NotFoundError(
+                f"no deploy record for PodCliqueSet {namespace}/{name} "
+                "(created before the observatory started, or evicted)")
+        return payload
+
 
 @dataclasses.dataclass
 class _InjectedError:

@@ -214,6 +214,13 @@ class HttpClient:
         return self._request(
             "GET", f"/debug/placement/{quote(namespace)}/{quote(name)}")
 
+    def debug_deploy(self, name: str, namespace: str = "default") -> dict:
+        """One PodCliqueSet's deploy-progress record from
+        ``GET /debug/deploy/<ns>/<name>`` (the wire twin of
+        ``Client.debug_deploy``; 404 maps to NotFoundError)."""
+        return self._request(
+            "GET", f"/debug/deploy/{quote(namespace)}/{quote(name)}")
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

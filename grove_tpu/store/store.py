@@ -31,6 +31,7 @@ from grove_tpu.runtime.errors import (
     NotFoundError,
     ValidationError,
 )
+from grove_tpu.store import writeobs
 
 
 class EventType(str, enum.Enum):
@@ -104,6 +105,37 @@ class Watcher:
         self.closed = True
 
 
+class _WriteGuard:
+    """Context guard for one instrumented store write verb (see
+    ``Store._locked_write``): times lock wait/hold around the store
+    lock and flushes the thread's write record after release. Slotted
+    and hand-rolled for per-write cost — this is the hottest object on
+    the write path."""
+
+    __slots__ = ("_store", "_rec", "_t1")
+
+    def __init__(self, store: "Store", verb: str) -> None:
+        self._store = store
+        self._rec = writeobs.begin(verb)
+
+    def __enter__(self) -> None:
+        if self._rec is None:
+            self._store._lock.acquire()
+            return
+        t0 = time.perf_counter()
+        self._store._lock.acquire()
+        self._t1 = time.perf_counter()
+        self._rec.wait_s = self._t1 - t0
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._store._lock.release()
+        rec = self._rec
+        if rec is not None:
+            rec.hold_s = time.perf_counter() - self._t1
+            writeobs.flush(rec)
+        return False
+
+
 class Store:
     def __init__(self, state_dir: str | None = None,
                  takeover_wait: bool = False) -> None:
@@ -153,6 +185,21 @@ class Store:
             for obj in objects:
                 self._objects.setdefault(obj.KIND, {})[_key(obj)] = obj
             self._rv = itertools.count(max_rv + 1)
+
+    def _locked_write(self, verb: str) -> "_WriteGuard":
+        """The store lock, instrumented for the write path: opens a
+        per-thread telemetry record (writer attribution, commit/noop/
+        conflict/event notes from the locked internals), times lock
+        wait and hold, and flushes everything to the metrics hub in one
+        batch AFTER release — per-sample hub incs under this lock would
+        stall every writer behind each /metrics render. With
+        ``GROVE_WRITE_OBS=0`` this degrades to the bare lock. A slotted
+        guard class, not a @contextmanager: generator-based context
+        managers cost ~2µs per use, and this wraps EVERY store write —
+        including the no-op status write every steady-state reconcile
+        ends in, where that overhead erodes the PR 2 informer
+        steady-sweep ratio."""
+        return _WriteGuard(self, verb)
 
     def _persist_put(self, obj: Any) -> None:
         if self._persister is not None:
@@ -223,6 +270,7 @@ class Store:
         for w in self._watchers:
             w._offer(shared)
         self._event_cond.notify_all()
+        writeobs.note_event(obj.KIND, etype.value)
 
     def current_rv(self) -> int:
         """The highest resource version issued so far (watch bootstrap)."""
@@ -358,9 +406,17 @@ class Store:
             refs = [obj for (ns, _), obj in objs.items()
                     if (namespace is None or ns == namespace)
                     and matches_labels(obj, selector)]
+        self._count_scan(kind_cls.KIND)
         out = [self._shared_clone(o) for o in refs]
         out.sort(key=lambda o: o.meta.name)
         return rv, out
+
+    @staticmethod
+    def _count_scan(kind: str) -> None:
+        """Metric twin of the ``list_scans`` attribute, counted OUTSIDE
+        the store lock (the hub lock is held across /metrics renders)
+        and gated with the write-path telemetry."""
+        writeobs.count_scan(kind)
 
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
         with self._lock:
@@ -380,6 +436,7 @@ class Store:
                     if (namespace is None or ns == namespace)
                     and matches_labels(obj, selector)
                     and matches_fields(obj, fields)]
+        self._count_scan(kind_cls.KIND)
         out = [self._read_clone(o) for o in refs]
         out.sort(key=lambda o: o.meta.name)
         return out
@@ -387,7 +444,7 @@ class Store:
     # ---- writes ----
 
     def create(self, obj: Any, actor: str = "system:grove-operator") -> Any:
-        with self._lock:
+        with self._locked_write("create"):
             kind = obj.KIND
             objs = self._objects.setdefault(kind, {})
             key = _key(obj)
@@ -423,6 +480,7 @@ class Store:
             stored.meta.resource_version = next(self._rv)
             stored.meta.generation = 1
             objs[key] = stored
+            writeobs.note_commit(kind, "create")
             self._persist_put(stored)
             GLOBAL_TRACER.note_created(stored)
             self._emit(EventType.ADDED, stored)
@@ -438,9 +496,10 @@ class Store:
 
     def update(self, obj: Any, actor: str = "system:grove-operator") -> Any:
         """Full update (spec+meta). Bumps generation when spec changed."""
-        with self._lock:
+        with self._locked_write("update"):
             live = self._get_live(obj)
             if obj.meta.resource_version != live.meta.resource_version:
+                writeobs.note_conflict(obj.KIND, "update")
                 raise ConflictError(
                     f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
                     f"resource_version {obj.meta.resource_version} != "
@@ -453,6 +512,7 @@ class Store:
                 stored.meta.generation += 1
             stored.meta.resource_version = next(self._rv)
             self._objects[obj.KIND][_key(obj)] = stored
+            writeobs.note_commit(obj.KIND, "update")
             self._persist_put(stored)
             self._emit(EventType.MODIFIED, stored)
             if stored.meta.deletion_timestamp and not stored.meta.finalizers:
@@ -468,7 +528,7 @@ class Store:
         un-suppressed no-op writes would self-trigger a reconcile hot loop
         at steady state.
         """
-        with self._lock:
+        with self._locked_write("update_status"):
             stored = self._update_status_locked(obj, actor)
         # Return through the per-version bytes cache instead of a fresh
         # dumps+loads: every reconcile ends in a status write, and at
@@ -488,6 +548,7 @@ class Store:
         if self._admission is not None:
             self._admit("update_status", clone(obj), clone(live), actor)
         if obj.meta.resource_version != live.meta.resource_version:
+            writeobs.note_conflict(obj.KIND, "update_status")
             raise ConflictError(
                 f"{obj.KIND} {obj.meta.namespace}/{obj.meta.name}: stale "
                 f"resource_version (status)")
@@ -497,11 +558,13 @@ class Store:
         # fraction of the cost — this comparison runs on EVERY status
         # write, including each pod of a gang bind.
         if obj.status == live.status:
+            writeobs.note_noop(obj.KIND)
             return live
         stored = clone(live)
         stored.status = clone(obj.status)
         stored.meta.resource_version = next(self._rv)
         self._objects[obj.KIND][_key(obj)] = stored
+        writeobs.note_commit(obj.KIND, "update_status")
         self._persist_put(stored)
         self._emit(EventType.MODIFIED, stored)
         return stored
@@ -516,7 +579,7 @@ class Store:
         optimistic-concurrency dance approximates from outside. This is
         what keeps a fleet of wire agents from conflict-looping against
         controllers that also write the same objects' status."""
-        with self._lock:
+        with self._locked_write("patch_status"):
             stored = self._patch_status_locked(kind_cls, name, patch,
                                                namespace, actor)
         return self._read_clone(stored)  # as update_status: cached bytes
@@ -533,9 +596,11 @@ class Store:
         if self._admission is not None:
             self._admit("update_status", clone(updated), clone(live), actor)
         if updated.status == live.status:
+            writeobs.note_noop(kind_cls.KIND)
             return live                     # no-op suppression, as PUT
         updated.meta.resource_version = next(self._rv)
         self._objects[kind_cls.KIND][(namespace, name)] = updated
+        writeobs.note_commit(kind_cls.KIND, "patch_status")
         self._persist_put(updated)
         self._emit(EventType.MODIFIED, updated)
         return updated
@@ -558,7 +623,7 @@ class Store:
         which items landed."""
         from grove_tpu.runtime.errors import ForbiddenError
         results: list[Exception | None] = []
-        with self._lock:
+        with self._locked_write("patch_status"):
             for name, patch in items:
                 try:
                     self._patch_status_locked(kind_cls, name, patch,
@@ -582,7 +647,7 @@ class Store:
         a systemic failure into a silent forever-pending gang.
         """
         results: list[Exception | None] = []
-        with self._lock:
+        with self._locked_write("update_status"):
             for obj in objs:
                 try:
                     self._update_status_locked(obj, actor)
@@ -595,7 +660,7 @@ class Store:
                actor: str = "system:grove-operator") -> None:
         """Finalizer-aware delete: marks for deletion if finalizers remain,
         removes (and cascades to owned objects) otherwise."""
-        with self._lock:
+        with self._locked_write("delete"):
             objs = self._objects.get(kind_cls.KIND, {})
             obj = objs.get((namespace, name))
             if obj is None:
@@ -608,6 +673,7 @@ class Store:
                     marked.meta.deletion_timestamp = time.time()
                     marked.meta.resource_version = next(self._rv)
                     self._objects[kind_cls.KIND][(namespace, name)] = marked
+                    writeobs.note_commit(kind_cls.KIND, "delete")
                     self._persist_put(marked)
                     self._emit(EventType.MODIFIED, marked)
                 return
@@ -620,6 +686,7 @@ class Store:
             (obj.KIND, obj.meta.namespace, obj.meta.name), None)
         self._snapshot_cache.pop(
             (obj.KIND, obj.meta.namespace, obj.meta.name), None)
+        writeobs.note_commit(obj.KIND, "delete")
         self._persist_delete(obj)
         # Deletions get their own seq (kube bumps rv on delete too) so
         # resumable watches order them after the final MODIFIED.
@@ -638,6 +705,7 @@ class Store:
                     marked.meta.deletion_timestamp = time.time()
                     marked.meta.resource_version = next(self._rv)
                     self._objects[dep.KIND][_key(dep)] = marked
+                    writeobs.note_commit(dep.KIND, "delete")
                     self._persist_put(marked)
                     self._emit(EventType.MODIFIED, marked)
             else:

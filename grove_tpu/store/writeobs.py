@@ -1,0 +1,238 @@
+"""Write-path telemetry for the store — the deploy path's eyes.
+
+The read side has had instrumentation since the informer layer
+(`grove_informer_*`, `Store.list_scans`); this module gives every store
+WRITE the same treatment, because the 1000-pod deploy path is
+write-bound (ROADMAP item 1): before batching or sharding the write
+path we need to see who writes what, how often a write conflicts or
+no-ops, and how long writers wait on (and hold) the store's global
+RLock.
+
+Exported series (rendered by the shared MetricsHub):
+
+- ``grove_store_writes_total{kind,verb,writer}`` — committed mutations
+  (a cascade delete counts one ``delete`` per removed object; a status
+  write suppressed as a no-op counts under ``_noop_``, not here).
+- ``grove_store_conflicts_total{kind,verb,writer}`` — optimistic-
+  concurrency rejections (stale resource_version).
+- ``grove_store_noop_writes_total{kind,writer}`` — suppressed
+  byte-identical status writes (the steady-state self-trigger guard).
+- ``grove_store_events_total{kind,type}`` — event-ring appends (the
+  fan-out cost every write pays: each append wakes every watcher).
+- ``grove_store_lock_wait_seconds{verb}`` /
+  ``grove_store_lock_hold_seconds{verb}`` — pinned-bucket histograms
+  around the store RLock per public write verb (wait = acquisition
+  queueing, i.e. writer contention; hold = critical-section length,
+  i.e. what everyone else waited for). Observed only for records that
+  committed, conflicted, or emitted — a PURE no-op status write (the
+  steady-state self-trigger guard firing, i.e. every reconcile of a
+  converged fleet) counts only its no-op counter, because per-write
+  histogram bookkeeping on that path measurably erodes the PR 2
+  informer steady-sweep ratio the issue requires to hold.
+- ``grove_store_list_scans_total{kind}`` — the metric twin of
+  ``Store.list_scans`` so benches and dashboards read exposition text
+  instead of poking store internals.
+
+Writer attribution rides a contextvar: the controller runtime sets it
+to the controller's name for the duration of each reconcile
+(``runtime/controller.py``), so a write deep inside a reconcile is
+labeled ``writer="podclique"`` without threading a parameter through
+every call. Unattributed writes (user clients, agents, tests) label
+``writer="direct"``.
+
+Overhead discipline: the store's write verbs buffer their telemetry in
+a per-thread record while the store lock is held and flush it to the
+hub in ONE lock acquisition after release (``MetricsHub.bulk``) — the
+hub's lock is held across every /metrics render, and per-counter incs
+under the store lock would stall all writers behind each scrape. The
+PR 1/2 benchmarks must hold: ``GROVE_WRITE_OBS=0`` is the escape hatch
+(per-call check, flippable at runtime), and the on-path cost is bounded
+by tests/test_observability.py's overhead benchmark.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+WRITE_OBS_ENV = "GROVE_WRITE_OBS"
+
+# Label for writes outside any attributed context (user clients, node
+# agents, scheduler runnables, tests).
+DIRECT_WRITER = "direct"
+
+_writer_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "grove_store_writer", default=DIRECT_WRITER)
+
+# The write record being accumulated by this thread's in-flight store
+# write verb (the store lock serializes writers, but records are
+# per-thread so concurrent verbs on different stores never mix).
+_active = threading.local()
+
+
+def enabled() -> bool:
+    """Read the escape hatch per call (the GROVE_INFORMER idiom):
+    flipping ``GROVE_WRITE_OBS=0`` mid-process — incident mitigation,
+    the overhead benchmark's baseline — takes effect on the next
+    write, no store rebuild."""
+    return os.environ.get(WRITE_OBS_ENV, "1") != "0"
+
+
+def set_writer(name: str):
+    """Attribute subsequent writes on this context to ``name`` (the
+    controller runtime calls this per reconcile). Returns a token for
+    ``reset_writer``."""
+    return _writer_ctx.set(name)
+
+
+def reset_writer(token) -> None:
+    _writer_ctx.reset(token)
+
+
+def current_writer() -> str:
+    return _writer_ctx.get()
+
+
+class WriteRecord:
+    """Telemetry buffered across one public store write verb."""
+
+    __slots__ = ("verb", "writer", "commits", "noops", "conflicts",
+                 "events", "wait_s", "hold_s")
+
+    def __init__(self, verb: str, writer: str) -> None:
+        self.verb = verb
+        self.writer = writer
+        self.commits: list[tuple[str, str]] = []    # (kind, verb)
+        self.noops: list[str] = []                  # kind
+        self.conflicts: list[tuple[str, str]] = []  # (kind, verb)
+        self.events: list[tuple[str, str]] = []     # (kind, type)
+        self.wait_s = 0.0
+        self.hold_s = 0.0
+
+
+def begin(verb: str) -> WriteRecord | None:
+    """Open a record for a public write verb (None when disabled).
+    The caller must ``flush`` it after releasing the store lock."""
+    if not enabled():
+        return None
+    rec = WriteRecord(verb, _writer_ctx.get())
+    _active.rec = rec
+    return rec
+
+
+# ---- in-flight notes (called under the store lock; list appends only,
+# ---- never the metrics hub) ----
+
+def _rec() -> WriteRecord | None:
+    return getattr(_active, "rec", None)
+
+
+def note_commit(kind: str, verb: str) -> None:
+    rec = _rec()
+    if rec is not None:
+        rec.commits.append((kind, verb))
+
+
+def note_noop(kind: str) -> None:
+    rec = _rec()
+    if rec is not None:
+        rec.noops.append(kind)
+
+
+def note_conflict(kind: str, verb: str) -> None:
+    rec = _rec()
+    if rec is not None:
+        rec.conflicts.append((kind, verb))
+
+
+def note_event(kind: str, etype: str) -> None:
+    rec = _rec()
+    if rec is not None:
+        rec.events.append((kind, etype))
+
+
+# Cached (name, labels, 1.0) inc triples and label tuples, keyed by
+# their label values. Label tuples are hand-ordered alphabetically (the
+# hub's sorted-items key). Cardinality is kinds x verbs x writers —
+# small and bounded — and caching spares the hot path a fresh nest of
+# tuples per sample: a reconcile sweep of a converged 256-pod fleet is
+# ~400 no-op status writes, and per-write allocation cost there erodes
+# the PR 2 informer steady-sweep ratio.
+_WRITE_INC: dict[tuple, tuple] = {}
+_NOOP_INC: dict[tuple, tuple] = {}
+_CONFLICT_INC: dict[tuple, tuple] = {}
+_EVENT_INC: dict[tuple, tuple] = {}
+_VERB_LABELS: dict[str, tuple] = {}
+
+
+def _cached(cache: dict, key: tuple, name: str, labels: tuple) -> tuple:
+    inc = cache.get(key)
+    if inc is None:
+        inc = cache[key] = (name, labels, 1.0)
+    return inc
+
+
+_SCAN_INC: dict[str, tuple] = {}
+
+
+def count_scan(kind: str) -> None:
+    """One list-shaped scan of ``kind`` into
+    ``grove_store_list_scans_total`` (cached key; called outside the
+    store lock on every Store.list/list_snapshot — the direct-read
+    escape hatch path pays this thousands of times per sweep)."""
+    if not enabled():
+        return
+    inc = _SCAN_INC.get(kind)
+    if inc is None:
+        inc = _SCAN_INC[kind] = (
+            "grove_store_list_scans_total", (("kind", kind),), 1.0)
+    GLOBAL_METRICS.bulk(incs=(inc,))
+
+
+def flush(rec: WriteRecord) -> None:
+    """Fold the record into the global hub under ONE hub-lock
+    acquisition. Runs after the store lock is released. A pure no-op
+    record (suppressed status write, nothing committed) takes a minimal
+    path — one cached-key counter inc, no lock histograms — because it
+    IS the steady state: every reconcile of a converged fleet ends in
+    exactly one of these."""
+    _active.rec = None
+    w = rec.writer
+    if not rec.commits and not rec.conflicts and not rec.events:
+        if rec.noops:
+            GLOBAL_METRICS.bulk(incs=[
+                _cached(_NOOP_INC, (kind, w),
+                        "grove_store_noop_writes_total",
+                        (("kind", kind), ("writer", w)))
+                for kind in rec.noops])
+        return
+    incs: list[tuple[str, tuple, float]] = []
+    for kind, verb in rec.commits:
+        incs.append(_cached(
+            _WRITE_INC, (kind, verb, w), "grove_store_writes_total",
+            (("kind", kind), ("verb", verb), ("writer", w))))
+    for kind in rec.noops:
+        incs.append(_cached(
+            _NOOP_INC, (kind, w), "grove_store_noop_writes_total",
+            (("kind", kind), ("writer", w))))
+    for kind, verb in rec.conflicts:
+        incs.append(_cached(
+            _CONFLICT_INC, (kind, verb, w),
+            "grove_store_conflicts_total",
+            (("kind", kind), ("verb", verb), ("writer", w))))
+    for kind, etype in rec.events:
+        incs.append(_cached(
+            _EVENT_INC, (kind, etype), "grove_store_events_total",
+            (("kind", kind), ("type", etype))))
+    verb_labels = _VERB_LABELS.get(rec.verb)
+    if verb_labels is None:
+        verb_labels = _VERB_LABELS[rec.verb] = (("verb", rec.verb),)
+    GLOBAL_METRICS.bulk(
+        incs=incs,
+        observations=(
+            ("grove_store_lock_wait_seconds", verb_labels, rec.wait_s),
+            ("grove_store_lock_hold_seconds", verb_labels, rec.hold_s),
+        ))

@@ -1,0 +1,158 @@
+"""Deploy observatory: per-PCS rollout progress records, the
+/debug/deploy surface with its Client/HttpClient twins, the
+grove_deploy_duration_seconds milestone histogram, and the
+``grovectl deploy-status`` render."""
+
+import math
+import time
+
+import pytest
+
+from grove_tpu.api import PodCliqueSet
+from grove_tpu.cluster import new_cluster
+from grove_tpu.runtime.errors import NotFoundError
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import simple_pcs, wait_for
+
+
+@pytest.fixture
+def cluster():
+    fleet = FleetSpec(slices=[SliceSpec(generation="v5e", topology="4x4",
+                                        count=2)])
+    cl = new_cluster(fleet=fleet)
+    with cl:
+        yield cl
+
+
+def _wait_available_record(client, name):
+    wait_for(lambda: client.get(
+        PodCliqueSet, name).status.available_replicas == 1, desc="up")
+
+    # The observer applies events asynchronously; the finalize lands
+    # within a poll tick of the Available status flip — and on a slow
+    # box the record itself may trail the status (no record yet is a
+    # poll-again, not a crash).
+    def finalized():
+        try:
+            return client.debug_deploy(name).get("available_at") \
+                is not None
+        except NotFoundError:
+            return False
+
+    wait_for(finalized, desc="deploy record finalized")
+    return client.debug_deploy(name)
+
+
+def test_deploy_record_full_ladder(cluster):
+    """A deploy to Available records every pod through the
+    created→scheduled→started→ready ladder, the gang count, the frozen
+    milestone set, and a positive write-amplification number."""
+    cluster.client.create(simple_pcs(name="dep1"))
+    payload = _wait_available_record(cluster.client, "dep1")
+    assert payload["pods"] == {"created": 3, "scheduled": 3,
+                               "started": 3, "ready": 3}
+    assert payload["gangs"] == {"total": 1, "scheduled": 1}
+    miles = payload["milestones"]
+    assert {"first_pod", "pods_created", "scheduled", "started",
+            "ready", "available"} <= set(miles)
+    t0 = payload["created_at"]
+    assert t0 <= miles["first_pod"] <= miles["pods_created"]
+    assert miles["scheduled"] <= miles["ready"] <= miles["available"]
+    w = payload["writes"]
+    assert w["writes"] > 0 and w["writes_per_pod"] > 0
+    assert w["conflicts"] >= 0 and w["noop_writes"] >= 0
+    assert w["queue_wait_s"] >= 0 and w["work_s"] > 0
+
+    # The milestone histogram rendered once per phase with the pinned
+    # lifecycle buckets.
+    from grove_tpu.runtime import metrics as m
+    text = cluster.manager.metrics_text()
+    assert "# TYPE grove_deploy_duration_seconds histogram" in text
+    hist = m.parse_histograms(text, "grove_deploy_duration_seconds")
+    phases = {dict(labels).get("phase") for labels in hist}
+    assert {"first_pod", "pods_created", "scheduled", "started",
+            "ready", "available"} <= phases
+    cum = hist[(("phase", "available"),)]
+    assert set(cum) == set(m.LIFECYCLE_BUCKETS) | {math.inf}
+    assert cum[math.inf] >= 1
+
+
+def test_deploy_record_in_progress_and_unknown(cluster):
+    """A deploy that cannot complete reports an in-progress record
+    (available_at None, pods created but not scheduled); an unknown
+    name raises NotFoundError on the in-process twin."""
+    client = cluster.client
+    client.create(simple_pcs(name="stuck", pods=5, chips=4))  # can't fit
+    wait_for(lambda: (client.debug_deploy("stuck")["pods"]["created"]
+                      if _has_record(client, "stuck") else 0) == 5,
+             desc="pods recorded")
+    payload = client.debug_deploy("stuck")
+    assert payload["available_at"] is None
+    assert payload["pods"]["created"] == 5
+    assert payload["pods"]["ready"] == 0
+    assert payload["milestones"] == {}          # frozen only at Available
+    assert payload["writes"]["writes"] > 0      # live consumption delta
+    with pytest.raises(NotFoundError):
+        client.debug_deploy("no-such-pcs")
+
+
+def _has_record(client, name) -> bool:
+    try:
+        client.debug_deploy(name)
+        return True
+    except NotFoundError:
+        return False
+
+
+def test_deploy_record_survives_deletion(cluster):
+    """A completed deploy's record outlives its PCS (marked deleted,
+    numbers frozen) so post-mortem inspection works."""
+    client = cluster.client
+    client.create(simple_pcs(name="gone"))
+    done = _wait_available_record(client, "gone")
+    client.delete(PodCliqueSet, "gone")
+    wait_for(lambda: not client.list(PodCliqueSet), desc="deleted")
+    wait_for(lambda: client.debug_deploy("gone")["deleted"],
+             desc="record marked deleted")
+    after = client.debug_deploy("gone")
+    assert after["available_at"] == done["available_at"]
+    assert after["writes"] == done["writes"]    # frozen, not live
+
+
+def test_deploy_status_endpoint_wire_twin_and_cli(capsys):
+    """GET /debug/deploy serves the same payload shape as the
+    in-process twin, and ``grovectl deploy-status`` renders it with
+    rollout-status-style exit codes (0 = Available, 1 = unknown)."""
+    from grove_tpu.cli import main
+    from grove_tpu.server import ApiServer
+    from grove_tpu.store.httpclient import HttpClient
+
+    cl = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=2)]))
+    with cl:
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            cl.client.create(simple_pcs(name="depcli"))
+            local = _wait_available_record(cl.client, "depcli")
+            wire = HttpClient(base).debug_deploy("depcli")
+            assert set(wire) == set(local)
+            assert wire["pods"] == local["pods"]
+            assert wire["milestones"].keys() == local["milestones"].keys()
+
+            assert main(["deploy-status", "depcli",
+                         "--server", base]) == 0
+            out = capsys.readouterr().out
+            assert "AVAILABLE after" in out
+            assert "writes/pod" in out
+            assert "created 3" in out and "ready 3" in out
+            assert "1/1 scheduled" in out
+            assert "% wait" in out
+            # Unknown PCS: error to stderr, exit 1.
+            assert main(["deploy-status", "ghost",
+                         "--server", base]) == 1
+            assert "error (404)" in capsys.readouterr().err
+        finally:
+            srv.stop()

@@ -210,6 +210,301 @@ def test_setup_logging_repeat_call_updates_level_and_format():
             h.setFormatter(fmt)
 
 
+# ---- write-path observability (docs/design/
+# ---- write-path-observability.md) ----
+
+
+def _counters(name):
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS, parse_counters
+    return parse_counters(GLOBAL_METRICS.render(), name)
+
+
+def test_store_write_telemetry_attributes_writers(cluster):
+    """Every store write renders into grove_store_writes_total with
+    kind/verb/writer labels; writes issued inside a reconcile carry the
+    controller's name, scheduler-loop writes its backend name, and
+    unattributed client writes 'direct'."""
+    client = cluster.client
+    client.create(simple_pcs(name="wobs"))
+    wait_for(lambda: client.get(
+        PodCliqueSet, "wobs").status.available_replicas == 1, desc="up")
+    writes = _counters("grove_store_writes_total")
+    by = {}
+    for labels, v in writes.items():
+        d = dict(labels)
+        by.setdefault(d["writer"], {}).setdefault(d["verb"], set()).add(
+            d["kind"])
+    # The test client's own create is unattributed.
+    assert "PodCliqueSet" in by["direct"]["create"]
+    # The PCS reconciler created children under its own name.
+    assert "PodClique" in by["podcliqueset"]["create"]
+    assert "PodGang" in by["podcliqueset"]["create"]
+    # The scheduler loop bound the gang (status writes under its name).
+    sched = [w for w in by if w.startswith("scheduler.")]
+    assert sched, sorted(by)
+    assert any("update_status" in by[w] or "patch_status" in by[w]
+               for w in sched), {w: sorted(by[w]) for w in sched}
+    # Event-ring appends counted per kind/type.
+    events = _counters("grove_store_events_total")
+    kinds = {dict(labels)["kind"] for labels in events}
+    assert {"Pod", "PodGang"} <= kinds, kinds
+
+
+def test_writer_attribution_survives_pool_fanout():
+    """Writer attribution rides a contextvar, and pool threads have
+    their own (empty) context — run_concurrently must copy the
+    submitter's context into each task, or every pod-creation burst big
+    enough to leave the inline path (>2 tasks per batch) would count
+    the deploy's dominant write class under writer="direct"."""
+    from grove_tpu.runtime.concurrent import (
+        run_concurrently,
+        run_with_slow_start,
+    )
+    from grove_tpu.store import writeobs
+
+    token = writeobs.set_writer("fanoutctl")
+    try:
+        seen: list[str] = []
+        errors = run_concurrently(
+            [(lambda: seen.append(writeobs.current_writer()))
+             for _ in range(6)])
+        assert not errors and set(seen) == {"fanoutctl"}, seen
+        seen.clear()
+        done, errors = run_with_slow_start(
+            [(lambda: seen.append(writeobs.current_writer()))
+             for _ in range(8)])
+        assert done == 8 and not errors
+        assert set(seen) == {"fanoutctl"}, seen
+    finally:
+        writeobs.reset_writer(token)
+
+
+def test_store_conflict_and_noop_counters():
+    """A stale-rv status write counts one conflict; a byte-identical
+    status write counts one suppressed no-op and NO committed write."""
+    from grove_tpu.api import PodGang
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.runtime.errors import ConflictError
+    from grove_tpu.store.store import Store
+
+    key_w = (("kind", "PodGang"), ("verb", "update_status"),
+             ("writer", "direct"))
+    key_c = key_w
+    key_n = (("kind", "PodGang"), ("writer", "direct"))
+    w0 = _counters("grove_store_writes_total").get(key_w, 0)
+    c0 = _counters("grove_store_conflicts_total").get(key_c, 0)
+    n0 = _counters("grove_store_noop_writes_total").get(key_n, 0)
+
+    store = Store()
+    gang = store.create(PodGang(meta=new_meta("cfl")))
+    store.update_status(gang)                     # no-op: identical
+    stale = store.get(PodGang, "cfl")
+    stale.meta.resource_version = 10**9
+    with pytest.raises(ConflictError):
+        store.update_status(stale)
+    fresh = store.get(PodGang, "cfl")
+    fresh.status.phase = type(fresh.status.phase)("Running")
+    store.update_status(fresh)                    # a real commit
+
+    assert _counters("grove_store_noop_writes_total")[key_n] == n0 + 1
+    assert _counters("grove_store_conflicts_total")[key_c] == c0 + 1
+    assert _counters("grove_store_writes_total")[key_w] == w0 + 1
+
+
+def test_store_lock_histograms_render_with_pinned_buckets():
+    """The lock wait/hold histograms render per write verb with the
+    pinned LOCK_BUCKETS (sub-millisecond resolution — the default
+    duration buckets would flatten healthy writes into one bucket)."""
+    import math
+
+    from grove_tpu.api import PodGang
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.runtime import metrics as m
+    from grove_tpu.store.store import Store
+
+    store = Store()
+    store.create(PodGang(meta=new_meta("lk")))
+    store.delete(PodGang, "lk")
+    text = m.GLOBAL_METRICS.render()
+    want = set(m.LOCK_BUCKETS) | {math.inf}
+    for name in ("grove_store_lock_wait_seconds",
+                 "grove_store_lock_hold_seconds"):
+        assert f"# TYPE {name} histogram" in text
+        hist = m.parse_histograms(text, name)
+        verbs = {dict(labels).get("verb") for labels in hist}
+        assert {"create", "delete"} <= verbs, (name, verbs)
+        cum = hist[(("verb", "create"),)]
+        assert set(cum) == want, name
+        assert cum[math.inf] >= 1, name
+
+
+def test_write_obs_off_switch(monkeypatch):
+    """GROVE_WRITE_OBS=0 freezes the write-path counters (flippable at
+    runtime — no store rebuild) while the store itself keeps working,
+    and the list-scan metric twin freezes with it."""
+    from grove_tpu.api import PodGang
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.store.store import Store
+
+    monkeypatch.setenv("GROVE_WRITE_OBS", "0")
+    before_w = _counters("grove_store_writes_total")
+    before_s = _counters("grove_store_list_scans_total")
+    store = Store()
+    gang = store.create(PodGang(meta=new_meta("off")))
+    store.update_status(gang)
+    store.list(PodGang)
+    assert store.list_scans == 1          # the attribute still counts
+    store.delete(PodGang, "off")
+    assert _counters("grove_store_writes_total") == before_w
+    assert _counters("grove_store_list_scans_total") == before_s
+    # Flipping back on resumes counting on the next write.
+    monkeypatch.setenv("GROVE_WRITE_OBS", "1")
+    store.create(PodGang(meta=new_meta("off2")))
+    assert _counters("grove_store_writes_total") != before_w
+
+
+def test_list_scans_metric_twin_matches_attribute():
+    """grove_store_list_scans_total moves in lockstep with the
+    Store.list_scans attribute (benches read the metric text)."""
+    from grove_tpu.api import PodGang
+    from grove_tpu.store.store import Store
+
+    key = (("kind", "PodGang"),)
+    m0 = _counters("grove_store_list_scans_total").get(key, 0)
+    store = Store()
+    store.list(PodGang)
+    store.list_snapshot(PodGang)
+    assert store.list_scans == 2
+    assert _counters("grove_store_list_scans_total")[key] == m0 + 2
+
+
+def test_workqueue_depth_zeroes_when_controller_drains():
+    """grove_workqueue_depth goes through the gauge-family setter: a
+    controller no longer scraped (stopped manager, drained set) zeroes
+    its series on the next scrape instead of lingering at the last
+    point-sampled depth."""
+    from grove_tpu.runtime.controller import Controller, Request
+    from grove_tpu.runtime.manager import Manager
+
+    from grove_tpu.runtime.metrics import parse_counters
+
+    def depth(text):
+        return {dict(labels)["controller"]: v for labels, v in
+                parse_counters(text, "grove_workqueue_depth").items()}
+
+    mgr = Manager()
+    ctrl = Controller("depthtest", mgr.client, lambda req: None)
+    mgr.add_controller(ctrl)
+    ctrl.queue.add(Request("default", "x"), delay=60.0)  # parked depth 1
+    assert depth(mgr.metrics_text())["depthtest"] == 1.0
+    mgr.controllers.remove(ctrl)
+    assert depth(mgr.metrics_text())["depthtest"] == 0.0
+    ctrl.queue.shutdown()
+
+
+def test_write_obs_overhead_within_bound():
+    """The write-path telemetry's cost on the 256-pod deploy sweep is
+    bounded: instrumentation on must stay within 5% of
+    GROVE_WRITE_OBS=0 wall time (the acceptance bound; the PR 1
+    snapshot-benchmark shape, hardened for a 5% margin: interleaved
+    pairs, and a regression verdict only when BOTH the best-case and
+    the median ratio clear the bar — a load spike inflates one
+    estimator or the other, a genuine systematic overhead inflates
+    both at every ladder step)."""
+    import os
+    import statistics
+
+    from tools.bench_reconcile import run_once
+
+    def measure(pairs):
+        walls = {True: [], False: []}
+        prev = os.environ.get("GROVE_WRITE_OBS")
+        try:
+            for i in range(pairs):
+                # Alternate in-pair order so warm-up/load drift cancels.
+                order = (True, False) if i % 2 == 0 else (False, True)
+                for obs in order:
+                    os.environ["GROVE_WRITE_OBS"] = "1" if obs else "0"
+                    walls[obs].append(run_once(256, informer=True)["wall_s"])
+        finally:
+            if prev is None:
+                os.environ.pop("GROVE_WRITE_OBS", None)
+            else:
+                os.environ["GROVE_WRITE_OBS"] = prev
+        base_min, base_med = min(walls[False]), statistics.median(
+            walls[False])
+        assert base_min > 0
+        return (min(walls[True]) / base_min,
+                statistics.median(walls[True]) / base_med)
+
+    min_r, med_r = measure(4)
+    for pairs in (6, 8):
+        if min_r <= 1.05 or med_r <= 1.05:
+            break
+        min_r, med_r = measure(pairs)
+    assert min_r <= 1.05 or med_r <= 1.05, (
+        f"write-path telemetry costs {100 * (min_r - 1):.1f}% best-case "
+        f"/ {100 * (med_r - 1):.1f}% median on the 256-pod deploy sweep "
+        f"(bound: 5%)")
+
+
+def test_write_obs_per_write_overhead_microbench():
+    """The per-write cost of the telemetry, measured where it actually
+    accrues: a tight loop of status writes with GROVE_WRITE_OBS on vs
+    off. Each sample averages over thousands of writes, so machine
+    noise divides out — this is the near-deterministic pin behind the
+    5% sweep bound (the sweep spends most wall in reads and reconcile
+    logic the telemetry never touches, so per-write overhead bounds
+    sweep overhead from above). Budget: 25µs/write absolute OR half the
+    measured baseline, whichever is larger — measured ~3-6µs against a
+    ~30-60µs baseline on an idle box, but a loaded CI runner inflates
+    the baseline (and the overhead with it) several-fold, so a fixed
+    absolute bound alone flakes; a hub-lock-per-sample regression costs
+    a multiple of the baseline and blows the relative bound anywhere."""
+    import os
+    import time
+
+    from grove_tpu.api import PodGang
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.store.store import Store
+
+    n = 2000
+
+    def loop_once() -> float:
+        store = Store()
+        gang = store.create(PodGang(meta=new_meta("ub")))
+        phases = [type(gang.status.phase)("Running"),
+                  type(gang.status.phase)("Pending")]
+        t0 = time.perf_counter()
+        for i in range(n):
+            gang.status.phase = phases[i % 2]   # never a no-op
+            gang = store.update_status(gang)
+        return (time.perf_counter() - t0) / n
+
+    prev = os.environ.get("GROVE_WRITE_OBS")
+    try:
+        samples = {True: [], False: []}
+        # Interleave the modes so a machine-load window inflates both
+        # mins, not just one.
+        for i in range(6):
+            order = (True, False) if i % 2 == 0 else (False, True)
+            for obs in order:
+                os.environ["GROVE_WRITE_OBS"] = "1" if obs else "0"
+                samples[obs].append(loop_once())
+        best = {obs: min(s) for obs, s in samples.items()}
+    finally:
+        if prev is None:
+            os.environ.pop("GROVE_WRITE_OBS", None)
+        else:
+            os.environ["GROVE_WRITE_OBS"] = prev
+    overhead = best[True] - best[False]
+    budget = max(25e-6, 0.5 * best[False])
+    assert overhead <= budget, (
+        f"write telemetry adds {overhead * 1e6:.1f}µs per status write "
+        f"(bound {budget * 1e6:.1f}µs; "
+        f"baseline {best[False] * 1e6:.1f}µs)")
+
+
 def test_service_endpoints_published(cluster):
     client = cluster.client
     client.create(simple_pcs(name="disco"))

@@ -42,6 +42,8 @@ def render(rows: list[dict]) -> str:
              and r.get("value", 0) > 0]
     pending = [r for r in rows
                if r.get("metric") == "gang_pending_reasons"]
+    deploys = [r for r in rows if r.get("metric") == "reconcile_p50_ms"
+               and r.get("deploy_wall_ms", 0) > 0]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes]
@@ -62,6 +64,27 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('p95_ms', 0):.1f} "
                 f"| {r.get('scheduled_p50_ms', 0):.1f} "
                 f"| {r.get('reps', '?')} |")
+        out.append("")
+    if deploys:
+        out += ["## Deploy wall & write amplification (reconcile bench, "
+                "CPU control plane)", "",
+                "_the 1024-pod row is the pinned baseline for the "
+                "1000-pod deploy budget (ROADMAP item 1)_", "",
+                "| when | git | pods | gangs | deploy ms | writes/pod | "
+                "steady ms | scans | deploy speedup | steady speedup |",
+                "|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(deploys, key=lambda r: (r.get("ts", ""),
+                                                r.get("pods", 0))):
+            wpp = r.get("store_writes_per_pod")
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('pods', '?')} | {r.get('gangs', '?')} "
+                f"| {r.get('deploy_wall_ms', 0):.1f} "
+                f"| {wpp if wpp is not None else '-'} "
+                f"| {r.get('steady_wall_ms', 0):.2f} "
+                f"| {r.get('store_list_scans', '?')} "
+                f"| {r.get('deploy_speedup', '-')} "
+                f"| {r.get('steady_speedup', '-')} |")
         out.append("")
     if pending:
         out += ["## Pending gangs by reason (placement explainability "

@@ -11,10 +11,18 @@ same deterministic harness shape as ``tools/bench_sched.py`` driving
 ``_place_pass``.
 
 Per fleet size it reports reconcile latency p50/p99 over every reconcile
-invocation, end-to-end convergence wall time, and the number of
-``Store.list``-shaped scans the run issued (``Store.list_scans`` counts
-``list`` + ``list_snapshot``), and appends one JSON row per fleet to
-``bench-history/history.jsonl`` (GROVE_BENCH_HISTORY=0 disables).
+invocation, end-to-end convergence wall time, the number of
+``Store.list``-shaped scans the run issued, and the store writes the
+deploy consumed per pod (``store_writes_per_pod`` — the write-
+amplification number ROADMAP item 1's batched-write work is measured
+against). Scan and write counts are read from the rendered /metrics
+text (``grove_store_list_scans_total`` / ``grove_store_writes_total``,
+write-path telemetry from store/writeobs.py), not from store
+internals; with ``GROVE_WRITE_OBS=0`` both read zero and only the wall
+times are meaningful (how the overhead-bound test uses this harness).
+One JSON row per fleet is appended to ``bench-history/history.jsonl``
+(GROVE_BENCH_HISTORY=0 disables). The 1024-pod point is the pinned
+deploy baseline for the 1000-pod scale gate (SURVEY.md §6).
 
 ``--compare`` additionally runs the direct-read path
 (``GROVE_INFORMER=0`` — every list a store scan) and prints the speedup
@@ -53,10 +61,18 @@ from grove_tpu.controllers.podgang import PodGangReconciler
 from grove_tpu.controllers.scalinggroup import ScalingGroupReconciler
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.informer import CachedClient, InformerSet
+from grove_tpu.runtime.metrics import GLOBAL_METRICS, parse_counters
 from grove_tpu.scheduler.registry import build_registry
 from grove_tpu.store.client import Client
 from grove_tpu.store.store import Store
 from tools.bench_sched import append_history
+
+
+def counter_total(name: str) -> float:
+    """Total of one counter family read from the rendered exposition
+    text — the same surface a deployed Prometheus scrapes, so the bench
+    measures what operators would see, not private store state."""
+    return sum(parse_counters(GLOBAL_METRICS.render(), name).values())
 
 
 def build_workload(client: Client, pods: int, gang_size: int = 4) -> int:
@@ -124,23 +140,30 @@ def run_once(pods: int, informer: bool, gang_size: int = 4) -> dict:
             "PodClique": PodCliqueReconciler(client, registry),
             "PodGang": PodGangReconciler(client, registry),
         }
-        scans0 = store.list_scans
+        scans0 = counter_total("grove_store_list_scans_total")
+        writes0 = counter_total("grove_store_writes_total")
         durations: list[float] = []
         t0 = time.perf_counter()
         rounds = drive_until_settled(store, reconcilers, durations)
         wall = time.perf_counter() - t0
+        writes = counter_total("grove_store_writes_total") - writes0
         # Steady state: the converged fleet swept once more end-to-end.
         # No writes happen, so this isolates the reconcile READ path —
         # the cost that recurs for every resync/event at scale, and the
         # cost the informer cache exists to remove (the reference
         # profiles its no-op reconcile the same way, scale_test.go).
         steady: list[float] = []
-        steady_scans0 = store.list_scans
+        steady_scans0 = counter_total("grove_store_list_scans_total")
         t1 = time.perf_counter()
         sweep(store, reconcilers, steady)
         steady_wall = time.perf_counter() - t1
-        steady_scans = store.list_scans - steady_scans0
-        scans = store.list_scans - scans0
+        steady_scans = counter_total("grove_store_list_scans_total") \
+            - steady_scans0
+        # Whole-run scans (deploy + steady sweep), preserving the
+        # semantics of the pre-metric-twin rows already in
+        # bench-history — trend comparisons must not see a phantom
+        # drop from a bookkeeping change.
+        scans = (steady_scans0 + steady_scans) - scans0
         n_pods = len(store._objects.get("Pod", {}))
     finally:
         if prev is None:
@@ -149,8 +172,10 @@ def run_once(pods: int, informer: bool, gang_size: int = 4) -> dict:
             os.environ["GROVE_INFORMER"] = prev
     assert n_pods == pods, (n_pods, pods)
     return {"wall_s": wall, "gangs": gangs, "pods": n_pods,
-            "rounds": rounds, "list_scans": scans,
-            "steady_wall_s": steady_wall, "steady_scans": steady_scans,
+            "rounds": rounds, "list_scans": int(scans),
+            "store_writes": int(writes),
+            "steady_wall_s": steady_wall,
+            "steady_scans": int(steady_scans),
             "durations": durations, "steady_durations": steady}
 
 
@@ -173,6 +198,9 @@ def bench_fleet(pods: int, reps: int, informer: bool = True) -> dict:
             s["steady_wall_s"] for s in samples) * 1e3, 3),
         "rounds": samples[0]["rounds"],
         "store_list_scans": samples[0]["list_scans"],
+        "store_writes_total": samples[0]["store_writes"],
+        "store_writes_per_pod": round(
+            samples[0]["store_writes"] / max(1, pods), 2),
         "steady_scans": samples[0]["steady_scans"],
         "reconciles": len(samples[0]["durations"]),
         "reps": reps,
@@ -184,8 +212,10 @@ def bench_fleet(pods: int, reps: int, informer: bool = True) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pods", type=int, nargs="*",
-                    default=[1, 16, 64, 256],
-                    help="fleet sizes in pods (default: 1 16 64 256)")
+                    default=[1, 16, 64, 256, 1024],
+                    help="fleet sizes in pods "
+                         "(default: 1 16 64 256 1024 — the 1024 point "
+                         "is the pinned 1000-pod deploy baseline)")
     ap.add_argument("--reps", type=int, default=3,
                     help="repetitions per fleet (fresh store each)")
     ap.add_argument("--compare", action="store_true",
@@ -203,7 +233,8 @@ def main() -> None:
                 f"p50={row['value']:.3f} ms p99={row['p99_ms']:.3f} ms "
                 f"deploy={row['deploy_wall_ms']:.1f} ms "
                 f"steady={row['steady_wall_ms']:.2f} ms "
-                f"scans={row['store_list_scans']}")
+                f"scans={row['store_list_scans']} "
+                f"writes/pod={row['store_writes_per_pod']:.1f}")
         if args.compare:
             legacy = bench_fleet(pods, args.reps, informer=False)
             row["legacy_p50_ms"] = legacy["value"]

@@ -1,0 +1,145 @@
+"""Deploy-observatory smoke: 1-gang create → Available with a
+write-amplification assertion — the write-path telemetry's CI gate
+(wired into ``make ci``, the trace_smoke/explain_smoke sibling).
+
+Brings up an in-process cluster with a fake v5e slice, creates a
+single-gang PodCliqueSet, waits for Available, and asserts that
+
+- the deploy observatory recorded the full pod ladder (created =
+  scheduled = started = ready = the gang size) and the ``available``
+  milestone,
+- store write telemetry attributed writes to the controllers
+  (``grove_store_writes_total{writer=...}`` carries controller names,
+  not just ``direct``),
+- write amplification is sane: > 0 and under WRITE_AMP_CEILING writes
+  per pod deployed (a regression that starts writing per-pod status in
+  a hot loop blows this budget loudly),
+- ``grove_deploy_duration_seconds`` rendered with its pinned phase
+  labels, and
+- ``grovectl deploy-status`` renders the record (via the same payload
+  the wire endpoint serves).
+
+    python tools/deploy_smoke.py [--timeout 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Writes per pod deployed, measured ~10 on the 3-pod smoke shape (pod
+# create + gang bind + status ladder + parent bookkeeping). 4x headroom
+# for scheduling jitter; a write-amplification regression lands well
+# above it.
+WRITE_AMP_CEILING = 40.0
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="deploy-smoke")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu.api import PodCliqueSet
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+    )
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.runtime import metrics as m
+    from grove_tpu.runtime.deploywatch import render_deploy_status
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cluster = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cluster:
+        client = cluster.client
+        pods = 3
+        client.create(PodCliqueSet(
+            meta=new_meta("deploysmoke"),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplate(cliques=[PodCliqueTemplate(
+                    name="w", replicas=pods, min_available=pods,
+                    container=ContainerSpec(argv=["sleep", "inf"]),
+                    tpu_chips_per_pod=4)]))))
+        wait_for(lambda: client.get(PodCliqueSet, "deploysmoke")
+                 .status.available_replicas == 1, args.timeout,
+                 "deploysmoke available")
+        # The observer applies events asynchronously; the available
+        # milestone lands within a poll tick of the status flip — and
+        # on a loaded box the record itself may trail the status read
+        # above, so "no record yet" is a poll-again, not a crash.
+        from grove_tpu.runtime.errors import NotFoundError
+
+        def _finalized() -> bool:
+            try:
+                return client.debug_deploy("deploysmoke") \
+                    .get("available_at") is not None
+            except NotFoundError:
+                return False
+
+        wait_for(_finalized, args.timeout, "deploy record finalized")
+        payload = client.debug_deploy("deploysmoke")
+        text = cluster.manager.metrics_text()
+
+    counts = payload["pods"]
+    assert counts == {"created": pods, "scheduled": pods,
+                      "started": pods, "ready": pods}, counts
+    assert payload["gangs"] == {"total": 1, "scheduled": 1}, \
+        payload["gangs"]
+    miles = payload["milestones"]
+    missing = [p for p in ("first_pod", "pods_created", "scheduled",
+                           "started", "ready", "available")
+               if p not in miles]
+    assert not missing, f"milestones missing {missing}: {miles}"
+
+    w = payload["writes"]
+    amp = w["writes_per_pod"]
+    assert w["writes"] > 0, w
+    assert 0 < amp <= WRITE_AMP_CEILING, (
+        f"write amplification {amp:.1f} writes/pod outside "
+        f"(0, {WRITE_AMP_CEILING}] — the deploy write path regressed "
+        f"(or telemetry broke): {w}")
+
+    # Writer attribution reached the controllers.
+    writers = {dict(labels).get("writer") for labels in
+               m.parse_counters(text, "grove_store_writes_total")}
+    assert "podcliqueset" in writers, writers
+
+    # The deploy-phase histogram rendered with its pinned buckets.
+    assert "# TYPE grove_deploy_duration_seconds histogram" in text
+    hist = m.parse_histograms(text, "grove_deploy_duration_seconds")
+    phases = {dict(labels).get("phase") for labels in hist}
+    assert {"first_pod", "ready", "available"} <= phases, phases
+    want = set(m.LIFECYCLE_BUCKETS) | {float("inf")}
+    assert set(next(iter(hist.values()))) == want, "buckets drifted"
+
+    lines = render_deploy_status(payload, time.time())
+    assert any("writes/pod" in ln for ln in lines), lines
+    print("\n".join(lines))
+    print(f"deploy smoke OK: {pods} pods, {w['writes']} writes "
+          f"({amp:.1f}/pod), {w['conflicts']} conflicts, "
+          f"available after "
+          f"{miles['available'] - payload['created_at']:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
