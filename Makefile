@@ -51,6 +51,13 @@ bench-reconcile: ## controller reconcile p50/p99 + store-scan/write counts (CPU 
 	@# bench-history/history.jsonl.
 	$(PY) tools/bench_reconcile.py --compare
 
+bench-serving:   ## SLO-driven autoscaling under a 4x traffic ramp (CPU only)
+	@# The serving telemetry plane's proof: open-loop Poisson load
+	@# (tools/loadgen.py) against the tiny CPU engine, TTFT p99 breach
+	@# during the ramp, autoscaler scale-out on the latency signal.
+	@# Appends serving_ttft_p99_ms rows to bench-history/history.jsonl.
+	$(PY) tools/bench_serving.py
+
 bench-disagg:    ## PrefillWorker->DecodeEngine KV hand-off seam (real TPU)
 	@# More compiles than the headline bench (one-shot + chunked
 	@# prefill + two engines): widen the per-attempt watchdog.
@@ -90,6 +97,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# write-amplification assertion (store writes per pod deployed
 	@# bounded) and writer-attribution + deploy-histogram checks.
 	$(PY) tools/deploy_smoke.py
+	@# serving-SLO smoke: tiny engine -> TTFT/TPOT histograms -> one
+	@# batched /metrics/push -> ServingObserver -> /debug/serving
+	@# renders with the SLO judged against the autoscaling target.
+	$(PY) tools/serving_smoke.py
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

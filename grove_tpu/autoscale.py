@@ -26,9 +26,30 @@ import threading
 import time
 
 from grove_tpu.api import PodClique, PodCliqueScalingGroup, PodCliqueSet
-from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.errors import ConflictError, GroveError
 from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
 from grove_tpu.store.client import Client
+
+
+# Metric-name hints for the default aggregation mode. Load signals
+# (queue depth, rps, token counts) SUM across reporters — the total
+# drives scaling. Latency-style signals must NOT: a 2-replica PCSG
+# summing its engines' TTFT would double its apparent latency, so
+# worst-case percentiles take the MAX and utilization-style fractions
+# AVERAGE. An explicit per-sample ``agg`` (the batched push carries
+# one) always wins over the name hint.
+_LATENCY_HINTS = ("ttft", "tpot", "latency")
+
+
+def default_agg(metric: str) -> str:
+    m = metric.lower()
+    if "util" in m:
+        return "avg"
+    if (any(h in m for h in _LATENCY_HINTS) or m.endswith("_ms")
+            or m.endswith("_seconds")):
+        return "max"
+    return "sum"
 
 
 class MetricsRegistry:
@@ -36,28 +57,74 @@ class MetricsRegistry:
     analog.
 
     Multi-reporter aware: each reporting pod/engine contributes its own
-    sample and ``get`` returns the SUM of fresh samples (queue-depth-style
-    metrics represent per-reporter load; the total drives scaling).
-    Last-write-wins across reporters would flap the autoscaler whenever
-    load is heterogeneous. Samples expire after ``sample_ttl`` so dead
-    reporters stop counting.
+    sample, and ``get`` combines fresh samples per the metric's
+    aggregation mode — SUM for load signals (queue depth: per-reporter
+    load, the total drives scaling), MAX for worst-case latencies (a
+    2-replica PCSG's p99 TTFT is its worst replica's, never the sum),
+    AVG for utilization fractions. Last-write-wins across reporters
+    would flap the autoscaler whenever load is heterogeneous. Samples
+    expire after ``sample_ttl`` so dead reporters stop counting.
     """
 
     def __init__(self, sample_ttl: float = 10.0) -> None:
         self._lock = threading.Lock()
         self.sample_ttl = sample_ttl
+        # key -> reporter -> (value, ts, agg-mode-at-set-time)
         self._samples: dict[tuple[str, str, str, str],
-                            dict[str, tuple[float, float]]] = {}
+                            dict[str, tuple[float, float, str]]] = {}
 
     def set(self, kind: str, name: str, metric: str, value: float,
-            namespace: str = "default", reporter: str = "_default") -> None:
+            namespace: str = "default", reporter: str = "_default",
+            agg: str | None = None) -> None:
+        """``agg`` (sum|max|avg) pins how this metric combines across
+        reporters; None infers from the metric name (default_agg)."""
         import time as _time
+        if agg not in (None, "sum", "max", "avg"):
+            raise ValueError(f"unknown aggregation mode {agg!r}")
         key = (kind, namespace, name, metric)
         with self._lock:
-            self._samples.setdefault(key, {})[reporter] = (value, _time.time())
+            self._samples.setdefault(key, {})[reporter] = (
+                value, _time.time(), agg or default_agg(metric))
+
+    @staticmethod
+    def _combine(values: list[float], agg: str) -> float:
+        if agg == "max":
+            return max(values)
+        if agg == "avg":
+            return sum(values) / len(values)
+        return sum(values)
+
+    @staticmethod
+    def _aggregate_locked(samples: dict, cutoff: float,
+                          ) -> tuple[float, str, int] | None:
+        """Expire stale reporters in place, then combine what's fresh:
+        (value, agg mode, reporter count), or None when nothing is
+        fresh. The ONE implementation of multi-reporter aggregation —
+        get_with_mode (the Autoscaler's read) and all_fresh (the
+        ServingObserver's scrape) must never disagree on a series.
+        Caller holds the registry lock. The newest sample's mode wins
+        (reporters agree in practice; a rolling update changing the
+        mode converges as old samples expire)."""
+        for reporter in [r for r, (_, ts, _a) in samples.items()
+                         if ts < cutoff]:
+            del samples[reporter]
+        if not samples:
+            return None
+        agg = max(samples.values(), key=lambda s: s[1])[2]
+        return (MetricsRegistry._combine(
+            [v for v, _, _a in samples.values()], agg), agg, len(samples))
 
     def get(self, kind: str, name: str, metric: str,
             namespace: str = "default") -> float | None:
+        got = self.get_with_mode(kind, name, metric, namespace)
+        return None if got is None else got[0]
+
+    def get_with_mode(self, kind: str, name: str, metric: str,
+                      namespace: str = "default",
+                      ) -> tuple[float, str, int] | None:
+        """(aggregated value, mode, fresh reporter count) — the
+        autoscaler picks its scaling law off the mode (a max/avg signal
+        is a latency target, not a per-reporter load to divide)."""
         import time as _time
         key = (kind, namespace, name, metric)
         cutoff = _time.time() - self.sample_ttl
@@ -65,18 +132,59 @@ class MetricsRegistry:
             samples = self._samples.get(key)
             if not samples:
                 return None
-            for reporter in [r for r, (_, ts) in samples.items()
-                             if ts < cutoff]:
-                del samples[reporter]
-            if not samples:
-                return None
-            return sum(v for v, _ in samples.values())
+            return self._aggregate_locked(samples, cutoff)
+
+    def all_fresh(self) -> list[tuple[str, str, str, str, float, str, int]]:
+        """Every fresh series: (kind, namespace, name, metric, value,
+        agg, reporters). The ServingObserver's scrape surface — one
+        locked pass, expiring stale reporters as it goes."""
+        import time as _time
+        cutoff = _time.time() - self.sample_ttl
+        out = []
+        with self._lock:
+            for key in list(self._samples):
+                got = self._aggregate_locked(self._samples[key], cutoff)
+                if got is None:
+                    del self._samples[key]
+                    continue
+                kind, namespace, name, metric = key
+                out.append((kind, namespace, name, metric, *got))
+        return out
 
 
 def desired_replicas(value: float, target: float, lo: int, hi: int) -> int:
     if target <= 0:
         return lo
     return max(lo, min(hi, math.ceil(value / target)))
+
+
+# A latency signal well under target means capacity to spare: decay one
+# replica only when the aggregated signal sits below this fraction of
+# the target (hysteresis — a p99 hovering AT target must neither grow
+# nor shrink the fleet).
+LATENCY_DECAY_FRACTION = 0.5
+
+
+def desired_replicas_latency(value: float, target: float, current: int,
+                             lo: int, hi: int) -> int:
+    """Step controller for latency-target metrics (p99 TTFT et al).
+
+    The HPA ratio formula assumes the signal divides across replicas —
+    true for queue depth, false for a percentile (2x replicas does not
+    halve p99 TTFT, and ceil(ttft/target) would jump straight to the
+    ratio). Latency scaling is therefore incremental: breach → one step
+    out (next pass breaches again if one step wasn't enough), well
+    under target → one step in (downscale stabilization still applies
+    on top)."""
+    if target <= 0:
+        return max(lo, min(hi, current))
+    if value > target:
+        want = current + 1
+    elif value < target * LATENCY_DECAY_FRACTION:
+        want = current - 1
+    else:
+        want = current
+    return max(lo, min(hi, want))
 
 
 class Autoscaler:
@@ -99,6 +207,10 @@ class Autoscaler:
         self.sync_period = sync_period
         self.scale_down_stabilization = scale_down_stabilization
         self.log = get_logger("autoscaler")
+        # Decision events (ScaledUp/ScaledDown with signal vs target):
+        # the kubectl-describe trail for "why did my fleet grow".
+        from grove_tpu.runtime.events import EventRecorder
+        self.events = EventRecorder(client, "autoscaler")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # (kind, namespace, name) -> [(timestamp, desired)] recent history
@@ -123,31 +235,84 @@ class Autoscaler:
 
     def _pass(self) -> None:
         live_keys: set[tuple[str, str, str]] = set()
+        desired_series: list[tuple[dict, float]] = []
         for kind_cls in (PodClique, PodCliqueScalingGroup, PodCliqueSet):
             for obj in self.client.list(kind_cls, self.namespace):
                 a = obj.spec.auto_scaling
                 if a is None or obj.meta.deletion_timestamp is not None:
                     continue
                 live_keys.add((obj.KIND, obj.meta.namespace, obj.meta.name))
-                value = self.metrics.get(obj.KIND, obj.meta.name, a.metric,
-                                         namespace=obj.meta.namespace)
-                if value is None:
+                got = self.metrics.get_with_mode(
+                    obj.KIND, obj.meta.name, a.metric,
+                    namespace=obj.meta.namespace)
+                if got is None:
+                    desired_series.append(
+                        ({"kind": obj.KIND,
+                          "namespace": obj.meta.namespace,
+                          "name": obj.meta.name},
+                         float(obj.spec.replicas)))
                     continue
+                value, agg, _reporters = got
                 # min_replicas is filled by defaulting admission for
                 # template-declared configs; an un-admitted object
                 # (direct construction) floors at 1.
-                want = desired_replicas(value, a.target_value,
-                                        a.min_replicas or 1, a.max_replicas)
+                lo, hi = a.min_replicas or 1, a.max_replicas
+                if agg in ("max", "avg"):
+                    # Latency-target signal (p99 TTFT vs an SLO): step
+                    # scaling, not the ratio formula — see
+                    # desired_replicas_latency.
+                    want = desired_replicas_latency(
+                        value, a.target_value, obj.spec.replicas, lo, hi)
+                else:
+                    want = desired_replicas(value, a.target_value, lo, hi)
                 want = self._stabilized(obj, want)
+                desired_series.append(
+                    ({"kind": obj.KIND,
+                      "namespace": obj.meta.namespace,
+                      "name": obj.meta.name},
+                     float(want)))
                 if want != obj.spec.replicas:
+                    old = obj.spec.replicas
                     self.log.info("scaling %s/%s %d -> %d (%s=%.2f)",
-                                  obj.KIND, obj.meta.name, obj.spec.replicas,
+                                  obj.KIND, obj.meta.name, old,
                                   want, a.metric, value)
                     obj.spec.replicas = want
                     try:
                         self.client.update(obj)
-                    except GroveError:
-                        pass  # conflict: next pass retries on fresh state
+                    except ConflictError:
+                        # Raced another writer: the next pass retries on
+                        # fresh state. Counted, not swallowed — a
+                        # sustained rate means something else fights
+                        # the autoscaler over replicas.
+                        GLOBAL_METRICS.inc(
+                            "grove_autoscaler_conflicts_total",
+                            kind=obj.KIND,
+                            namespace=obj.meta.namespace,
+                            name=obj.meta.name)
+                        continue
+                    except GroveError as e:
+                        GLOBAL_METRICS.inc(
+                            "grove_autoscaler_conflicts_total",
+                            kind=obj.KIND,
+                            namespace=obj.meta.namespace,
+                            name=obj.meta.name)
+                        self.log.warning("scale %s/%s rejected: %s",
+                                         obj.KIND, obj.meta.name, e)
+                        continue
+                    GLOBAL_METRICS.inc(
+                        "grove_autoscaler_decisions_total",
+                        kind=obj.KIND,
+                        direction="up" if want > old else "down")
+                    self.events.event(
+                        obj, "Normal",
+                        "ScaledUp" if want > old else "ScaledDown",
+                        f"{a.metric}={value:.2f} ({agg}) vs target "
+                        f"{a.target_value:g}: replicas {old} -> {want}")
+        # Gauge-family semantics: desired replicas per autoscaled
+        # object, zeroed when the object drains (a deleted PCSG must
+        # not report its last desired count forever).
+        GLOBAL_METRICS.set_gauge_family("grove_autoscaler_desired_replicas",
+                                        desired_series)
         # Evict history of deleted objects: unbounded growth under churn,
         # and a recreated same-name object must not inherit a dead
         # object's spike window.

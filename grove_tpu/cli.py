@@ -670,6 +670,27 @@ def cmd_deploy_status(args: argparse.Namespace) -> int:
     return 0 if data.get("available_at") else 1
 
 
+def cmd_serving_status(args: argparse.Namespace) -> int:
+    """Render a scaling scope's serving SLO state from the serve
+    daemon's serving observatory: engine-pushed signals (queue depth,
+    KV utilization, TTFT/TPOT percentiles) aggregated per the
+    registry's modes, judged against the scope's autoscaling target —
+    the serving companion to `grovectl deploy-status`. Exit 0 while no
+    watched SLO is breached, 1 on a breach (scripts alert on it)."""
+    from grove_tpu.runtime.servingwatch import render_serving_status
+    status, data = _http(args.server,
+                         f"/debug/serving/{args.namespace}/{args.name}",
+                         ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    for line in render_serving_status(data):
+        print(line)
+    breached = any((s.get("slo") or {}).get("breached")
+                   for s in data.get("scopes", []))
+    return 1 if breached else 0
+
+
 def cmd_apply(args: argparse.Namespace) -> int:
     """Apply a manifest against a running serve daemon."""
     try:
@@ -1129,6 +1150,19 @@ def main(argv: list[str] | None = None) -> int:
     ds.add_argument("--server", default=default_server)
     add_ca(ds)
     ds.set_defaults(fn=cmd_deploy_status)
+
+    ss = sub.add_parser(
+        "serving-status",
+        help="serving observatory view of a scaling scope: engine SLO "
+             "signals (queue depth, KV utilization, TTFT/TPOT "
+             "percentiles) vs the autoscaling target (exit 0 = ok, "
+             "1 = SLO breached; the serving companion to "
+             "deploy-status)")
+    ss.add_argument("name")
+    ss.add_argument("--namespace", default="default")
+    ss.add_argument("--server", default=default_server)
+    add_ca(ss)
+    ss.set_defaults(fn=cmd_serving_status)
 
     for verb in ("cordon", "uncordon"):
         cp = sub.add_parser(verb, help=f"{verb} a node "

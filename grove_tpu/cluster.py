@@ -94,12 +94,22 @@ def new_cluster(config: OperatorConfiguration | None = None,
     metrics = None
     if mgr.config.autoscaler.enabled:
         from grove_tpu.autoscale import Autoscaler, MetricsRegistry
+        from grove_tpu.runtime.servingwatch import ServingObserver
         metrics = MetricsRegistry()
         mgr.add_runnable(Autoscaler(
             mgr.client, metrics,
             sync_period=mgr.config.autoscaler.sync_period_seconds,
             scale_down_stabilization=mgr.config.autoscaler
             .scale_down_stabilization_seconds))
+        # Serving observatory: aggregates the registry's engine-pushed
+        # SLO signals into grove_serving_* gauges and /debug/serving
+        # (rides the autoscaler flag — both consume the same registry).
+        # Swept at the autoscaler's own cadence: each sweep lists three
+        # kinds off the store, and the signals it judges only move when
+        # engines push, so out-sweeping the consumer buys no freshness.
+        mgr.add_runnable(ServingObserver(
+            mgr.client, metrics, mgr.store,
+            tick=mgr.config.autoscaler.sync_period_seconds))
     if mgr.config.node_lifecycle.enabled:
         from grove_tpu.controllers.nodelifecycle import (
             NodeLifecycleController,

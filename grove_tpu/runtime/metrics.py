@@ -90,12 +90,15 @@ class MetricsHub:
             self._help[name] = help_text
             self._buckets[name] = b
 
-    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+    # name/value are positional-only so "name" stays a legal LABEL key
+    # (grove_autoscaler_conflicts_total{kind,name} — without the /,
+    # a name= label kwarg collides with the metric-name parameter).
+    def inc(self, name: str, value: float = 1.0, /, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] += value
 
-    def set(self, name: str, value: float, **labels) -> None:
+    def set(self, name: str, value: float, /, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._gauges[key] = value
@@ -462,3 +465,36 @@ GLOBAL_METRICS.describe_histogram(
     "observed once per deploy at Available — the 1000-pod "
     "deploy-budget surface (SURVEY.md §6)",
     buckets=LIFECYCLE_BUCKETS)
+# Serving observatory (runtime/servingwatch.py, docs/design/
+# serving-slo.md): engine-pushed SLO signals aggregated per scaling
+# scope, plus the autoscaler decisions acting on them.
+GLOBAL_METRICS.describe(
+    "grove_serving_signal",
+    "Aggregated engine serving signal per scaling scope and metric "
+    "(queue depth summed, KV utilization averaged, TTFT/TPOT "
+    "percentiles maxed across reporters per the registry's "
+    "aggregation modes; scopes zero when their samples expire)")
+GLOBAL_METRICS.describe(
+    "grove_serving_reporters",
+    "Live engine reporters per scaling scope (fresh samples inside "
+    "the registry TTL; fewer reporters than replicas is a liveness "
+    "finding, not a latency one)")
+GLOBAL_METRICS.describe(
+    "grove_serving_slo_breached",
+    "1 while a scope's autoscaling target metric exceeds its target "
+    "value (the alertable twin of the autoscaler's scale-out trigger)")
+GLOBAL_METRICS.describe(
+    "grove_autoscaler_desired_replicas",
+    "Autoscaler-desired replicas per scalable object (post-"
+    "stabilization; spec.replicas while the signal is absent; zeroed "
+    "when the object drains)")
+GLOBAL_METRICS.describe(
+    "grove_autoscaler_decisions_total",
+    "Applied scaling decisions per object and direction (up|down) — "
+    "each has a matching ScaledUp/ScaledDown event with signal vs "
+    "target")
+GLOBAL_METRICS.describe(
+    "grove_autoscaler_conflicts_total",
+    "Scale writes rejected by the store (conflict or validation) per "
+    "object — a sustained rate means something else fights the "
+    "autoscaler over replicas")

@@ -1,0 +1,232 @@
+"""Serving observatory — control-plane aggregation of engine SLO state.
+
+The third leg of the observability tripod: PR 3 traced gang LIFECYCLE,
+PR 6 watched the deploy WRITE path, this watches the SERVING loop. The
+engines inside pods stamp every request (serving/slo.py) and push
+percentile digests to ``/metrics/push`` (serving/metrics_push.py,
+batched); the ``ServingObserver`` runnable sweeps the MetricsRegistry
+on a timer and turns the per-reporter soup into per-scope answers:
+
+- ``grove_serving_signal{kind,name,metric}`` — every fresh aggregated
+  series (queue depth summed, KV utilization averaged, p99 TTFT maxed
+  — the registry's per-metric aggregation modes applied),
+- ``grove_serving_reporters{kind,name}`` — live reporter count (a
+  2-replica PCSG reporting from one engine is a liveness finding, not
+  a latency one),
+- ``grove_serving_slo_breached{kind,name}`` — 1 while the scope's
+  autoscaling target metric exceeds its target (the alertable twin of
+  the Autoscaler's scale-out trigger),
+
+all exported through ``set_gauge_family`` so a drained scope zeroes
+instead of lingering at its last value.
+
+Surfaces (the deploy-observatory pattern):
+- ``GET /debug/serving/<ns>/<name>`` (server.py; read-gated),
+- ``Client.debug_serving`` / ``HttpClient.debug_serving`` twins,
+- ``grovectl serving-status <name>`` renders it
+  (render_serving_status).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from grove_tpu.api import PodClique, PodCliqueScalingGroup, PodCliqueSet
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+# store (weakly) -> its serving observer, so the in-process Client can
+# resolve debug_serving without a manager reference (the deploywatch
+# _OBSERVERS precedent).
+_OBSERVERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def serving_observer_for(store) -> "ServingObserver | None":
+    return _OBSERVERS.get(store)
+
+
+class ServingObserver:
+    """Registry-sweeping SLO aggregator (a manager runnable)."""
+
+    def __init__(self, client, metrics, store, tick: float = 0.5) -> None:
+        self.client = client
+        self.metrics = metrics
+        # Weak store ref (deploywatch precedent: _OBSERVERS strongly
+        # references its values, so a strong ref here would leak every
+        # discarded Manager's store for process lifetime).
+        self._store_ref = weakref.ref(store)
+        self.tick = tick
+        self.log = get_logger("servingwatch")
+        self._lock = threading.Lock()
+        # (namespace, name) -> list of per-kind scope dicts (payload()).
+        self._state: dict[tuple[str, str], list[dict]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle (manager runnable contract) ----
+
+    def start(self) -> None:
+        store = self._store_ref()
+        if store is None:
+            return
+        # Registered on START so a constructed-but-unstarted Manager
+        # can't shadow the running observer; survives stop() so the
+        # last state stays inspectable.
+        _OBSERVERS[store] = self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-observer",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 - observer must not die
+                self.log.exception("serving sweep panicked")
+            self._stop.wait(self.tick)
+
+    # ---- the sweep ----
+
+    def _autoscaled(self) -> dict[tuple[str, str, str], dict]:
+        """(kind, namespace, name) -> {target metric, target value,
+        replicas, ready} for every scalable object — the SLO targets
+        the signals are judged against."""
+        out: dict[tuple[str, str, str], dict] = {}
+        for kind_cls in (PodClique, PodCliqueScalingGroup, PodCliqueSet):
+            try:
+                objs = self.client.list(kind_cls, None)
+            except Exception:  # noqa: BLE001 - sweep survives a bad list
+                continue
+            for obj in objs:
+                a = obj.spec.auto_scaling
+                st = obj.status
+                ready = getattr(st, "ready_replicas",
+                                getattr(st, "available_replicas", 0))
+                out[(obj.KIND, obj.meta.namespace, obj.meta.name)] = {
+                    "metric": a.metric if a else None,
+                    "target": a.target_value if a else None,
+                    "replicas": obj.spec.replicas,
+                    "ready_replicas": ready,
+                }
+        return out
+
+    def sweep(self) -> None:
+        """One aggregation pass: registry → gauges + payload state.
+        Public so smokes/benches can force a scrape without waiting a
+        tick."""
+        fresh = self.metrics.all_fresh()
+        targets = self._autoscaled()
+        # (kind, ns, name) -> {metric: {value, agg, reporters}}
+        scopes: dict[tuple[str, str, str], dict[str, dict]] = {}
+        for kind, ns, name, metric, value, agg, reporters in fresh:
+            scopes.setdefault((kind, ns, name), {})[metric] = {
+                "value": value, "agg": agg, "reporters": reporters}
+        signal_series: list[tuple[dict, float]] = []
+        reporter_series: list[tuple[dict, float]] = []
+        breach_series: list[tuple[dict, float]] = []
+        state: dict[tuple[str, str], list[dict]] = {}
+        for (kind, ns, name), metrics_map in sorted(scopes.items()):
+            # Labels carry the namespace: same-named scopes in two
+            # namespaces are distinct series, not a last-writer-wins
+            # collision (a healthy ns/b must never mask a breached
+            # ns/a on the alertable gauge).
+            scope_labels = {"kind": kind, "namespace": ns, "name": name}
+            for metric, entry in metrics_map.items():
+                signal_series.append(
+                    (dict(scope_labels, metric=metric), entry["value"]))
+            reporter_series.append(
+                (scope_labels,
+                 float(max(e["reporters"] for e in metrics_map.values()))))
+            tgt = targets.get((kind, ns, name))
+            slo = None
+            if tgt and tgt["metric"] and tgt["metric"] in metrics_map \
+                    and tgt["target"]:
+                current = metrics_map[tgt["metric"]]["value"]
+                breached = current > tgt["target"]
+                slo = {"metric": tgt["metric"], "target": tgt["target"],
+                       "current": current, "breached": breached}
+                breach_series.append((scope_labels,
+                                      1.0 if breached else 0.0))
+            state.setdefault((ns, name), []).append({
+                "kind": kind,
+                "metrics": metrics_map,
+                "slo": slo,
+                "replicas": tgt["replicas"] if tgt else None,
+                "ready_replicas": tgt["ready_replicas"] if tgt else None,
+            })
+        GLOBAL_METRICS.set_gauge_family("grove_serving_signal",
+                                        signal_series)
+        GLOBAL_METRICS.set_gauge_family("grove_serving_reporters",
+                                        reporter_series)
+        GLOBAL_METRICS.set_gauge_family("grove_serving_slo_breached",
+                                        breach_series)
+        with self._lock:
+            self._state = state
+
+    # ---- read surface ----
+
+    def payload(self, namespace: str, name: str) -> dict | None:
+        """The /debug/serving payload for one scope name, or None when
+        no engine has reported fresh samples for it. ``kv_headroom`` is
+        derived (1 - utilization) so the renderer and alerts share one
+        definition."""
+        with self._lock:
+            scopes = self._state.get((namespace, name))
+            if scopes is None:
+                return None
+            scopes = [dict(s, metrics=dict(s["metrics"])) for s in scopes]
+        for s in scopes:
+            util = s["metrics"].get("kv_utilization")
+            s["kv_headroom"] = (round(1.0 - util["value"], 4)
+                                if util else None)
+        return {
+            "namespace": namespace,
+            "name": name,
+            "now": time.time(),
+            "sample_ttl": self.metrics.sample_ttl,
+            "scopes": scopes,
+        }
+
+
+def render_serving_status(payload: dict) -> list[str]:
+    """Human rendering of a /debug/serving payload — the ``grovectl
+    serving-status`` body (kept beside the observer so the CLI and
+    tests share one renderer; the render_deploy_status precedent)."""
+    out = []
+    name = payload.get("name", "?")
+    for scope in payload.get("scopes", []):
+        kind = scope.get("kind", "?")
+        head = f"{kind}/{name}"
+        reps = scope.get("replicas")
+        if reps is not None:
+            head += (f": {scope.get('ready_replicas', 0)}/{reps} "
+                     "replicas ready")
+        slo = scope.get("slo")
+        if slo:
+            verdict = "BREACHED" if slo["breached"] else "ok"
+            head += (f"  SLO {slo['metric']} {slo['current']:.1f} "
+                     f"vs target {slo['target']:g} [{verdict}]")
+        out.append(head)
+        metrics = scope.get("metrics", {})
+        for metric in sorted(metrics):
+            e = metrics[metric]
+            out.append(f"  {metric:<22} {e['value']:>10.2f}  "
+                       f"({e['agg']} over {e['reporters']} reporter"
+                       f"{'s' if e['reporters'] != 1 else ''})")
+        if scope.get("kv_headroom") is not None:
+            out.append(f"  {'kv_headroom':<22} "
+                       f"{scope['kv_headroom']:>10.2f}  (derived)")
+    if not out:
+        out.append(f"{name}: no fresh serving samples")
+    return out

@@ -47,6 +47,12 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       queue-wait vs work split; grovectl
                                       deploy-status renders it; same
                                       read gate as /debug/placement)
+  GET  /debug/serving/<ns>/<name>     serving SLO state for one scaling
+                                      scope (TTFT/TPOT percentiles vs
+                                      target, queue depth, KV headroom,
+                                      reporter liveness; grovectl
+                                      serving-status renders it; same
+                                      read gate as /debug/placement)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -424,6 +430,9 @@ class ApiServer:
                     elif len(parts) == 4 and parts[0] == "debug" \
                             and parts[1] == "deploy":
                         self._debug_deploy(parts[2], parts[3])
+                    elif len(parts) == 4 and parts[0] == "debug" \
+                            and parts[1] == "serving":
+                        self._debug_serving(parts[2], parts[3])
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -717,6 +726,16 @@ class ApiServer:
                 self._send(200, cluster.client.debug_deploy(
                     name, namespace))
 
+            def _debug_serving(self, namespace: str, name: str):
+                """GET /debug/serving/<ns>/<name> — one serving scope's
+                SLO state (``grovectl serving-status`` renders it).
+                Aggregate latency/load data like /debug/deploy, so it
+                shares the read gate, not the profiling gate.
+                NotFoundError from the twin maps to 404 in do_GET's
+                handler."""
+                self._send(200, cluster.client.debug_serving(
+                    name, namespace))
+
             def _workload_owns(self, actor: str, payload: dict) -> bool:
                 """A workload actor (system:workload:<ns>:<pcs>) may only
                 report scaling signals for objects its own PCS owns —
@@ -742,8 +761,17 @@ class ApiServer:
 
             def _metrics_push(self):
                 """Workload→control-plane metric ingestion: engines inside
-                pods report autoscaling signals (queue depth, rps) here;
-                the Autoscaler consumes them from the MetricsRegistry."""
+                pods report autoscaling signals here; the Autoscaler and
+                ServingObserver consume them from the MetricsRegistry.
+
+                Two payload shapes, one scope check: the legacy single
+                sample (``{"kind","name","metric","value"}``) and the
+                batched form (``{"kind","name","samples":[{"metric",
+                "value","agg"?}, ...]}`` — one POST per reporting tick
+                carrying an engine's whole SLO digest, each sample
+                naming how the registry combines it across reporters).
+                All-or-nothing: a malformed sample rejects the batch
+                before anything is recorded."""
                 if cluster.metrics is None:
                     self._send(503, {"error": "autoscaler disabled"})
                     return
@@ -764,15 +792,38 @@ class ApiServer:
                                          "only report metrics for its own "
                                          "PodCliqueSet's components"})
                         return
-                    cluster.metrics.set(
-                        payload["kind"], payload["name"], payload["metric"],
-                        float(payload["value"]),
-                        namespace=payload.get("namespace", "default"),
-                        reporter=payload.get("reporter", "_default"))
-                    self._send(200, {"ok": True})
+                    if "samples" in payload:
+                        samples = []
+                        for s in payload["samples"]:
+                            if not isinstance(s, dict):
+                                # A str here would .get() its way to an
+                                # AttributeError past the 400 handler.
+                                raise ValueError(
+                                    f"sample must be an object, got "
+                                    f"{type(s).__name__}")
+                            agg = s.get("agg")
+                            if agg not in (None, "sum", "max", "avg"):
+                                raise ValueError(
+                                    f"unknown agg {agg!r} for "
+                                    f"{s.get('metric')!r}")
+                            samples.append((str(s["metric"]),
+                                            float(s["value"]), agg))
+                    else:
+                        samples = [(payload["metric"],
+                                    float(payload["value"]), None)]
+                    for metric, value, agg in samples:
+                        cluster.metrics.set(
+                            payload["kind"], payload["name"], metric,
+                            value,
+                            namespace=payload.get("namespace", "default"),
+                            reporter=payload.get("reporter", "_default"),
+                            agg=agg)
+                    self._send(200, {"ok": True,
+                                     "accepted": len(samples)})
                 except (KeyError, TypeError, ValueError) as e:
                     self._send(400, {"error": f"bad metric payload: {e}; "
-                                     "need kind/name/metric/value"})
+                                     "need kind/name and metric/value or "
+                                     "samples[]"})
 
             def _status_batch(self, kind: str):
                 """POST /batch/<kind>/status — batched status merge

@@ -17,6 +17,7 @@ and TPU-shaped:
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Callable
@@ -64,6 +65,13 @@ class Request:
     # do all cache-capacity math on the host: after g generated tokens
     # the lane's next write lands at prompt_len + g - 1.
     prompt_len: int = -1
+    # SLO stamps (serving/slo.py): host wall-clock, 0.0 = never reached.
+    # enqueue/admit/first-token are exact; done is observed at window
+    # drain, so it can trail the true completion by interval-1 steps.
+    enqueue_ts: float = 0.0
+    admit_ts: float = 0.0
+    first_token_ts: float = 0.0
+    done_ts: float = 0.0
 
     def __post_init__(self):
         if self.prompt_len < 0:
@@ -160,7 +168,8 @@ class DecodeEngine:
                  metric_hook: Callable[[int], None] | None = None,
                  host_sync_interval: int = 8,
                  sampler: SamplerConfig | None = None,
-                 quant: str | None = None):
+                 quant: str | None = None,
+                 telemetry=None):
         self.cfg = cfg
         # Init-only: the sampled step closes over this config at compile
         # time, so later mutation cannot take effect (and is rejected).
@@ -179,6 +188,10 @@ class DecodeEngine:
         self.batch = batch
         self.max_len = max_len or cfg.max_seq_len
         self.metric_hook = metric_hook
+        # Optional serving/slo.EngineTelemetry: request-lifecycle stamps
+        # and latency histograms, all host-side (None = zero overhead;
+        # the JIT path is identical either way).
+        self.telemetry = telemetry
         # Completion bookkeeping needs sampled tokens on the host; fetching
         # every step would serialise dispatch behind a device→host sync.
         # Tokens accumulate on device and drain every ``host_sync_interval``
@@ -278,7 +291,8 @@ class DecodeEngine:
 
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32) -> int:
         req = Request(rid=self._next_rid, prompt=np.asarray(prompt, np.int32),
-                      max_new_tokens=max_new_tokens)
+                      max_new_tokens=max_new_tokens,
+                      enqueue_ts=time.time())
         self._next_rid += 1
         self._queue.append(req)
         self._report_metric()
@@ -288,9 +302,40 @@ class DecodeEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def kv_lane_utilization(self) -> float:
+        """Fraction of decode lanes occupied — the KV-headroom signal
+        (1.0 = no free lane to admit into)."""
+        return float(np.count_nonzero(self._active)) / self.batch
+
     def _report_metric(self) -> None:
         if self.metric_hook is not None:
             self.metric_hook(len(self._queue))
+        if self.telemetry is not None:
+            self.telemetry.sample_gauges(len(self._queue),
+                                         self.kv_lane_utilization)
+
+    def _stamp_admit(self, req: Request, now: float) -> None:
+        """Admission stamps: the prefill's sampled token IS the first
+        token, so admit and first-token coincide (a request that never
+        went through submit() gets enqueue = admit: zero queue wait).
+        Both admission paths append that token right after stamping, so
+        it is counted here — the drain only sees decode-step tokens."""
+        req.admit_ts = now
+        if not req.enqueue_ts:
+            req.enqueue_ts = now
+        req.first_token_ts = now
+        if self.telemetry is not None:
+            self.telemetry.add_tokens(1)
+
+    def _complete(self, req: Request) -> None:
+        """Shared completion bookkeeping (window drain + lane retire):
+        stamp done, record, and fold the request into the telemetry."""
+        req.done = True
+        req.done_ts = time.time()
+        self.completed.append(req)
+        if self.telemetry is not None:
+            self.telemetry.observe_request(req)
 
     # ---- standalone mode (bench path) ----
 
@@ -324,15 +369,18 @@ class DecodeEngine:
             lengths_np = np.asarray(lengths)
             first = np.asarray(self._tokens)
             self._lane_window_start[:] = len(self._pending_tokens)
+            now = time.time()
             for i in range(b):
                 req = Request(rid=self._next_rid, prompt=prompts_np[i],
                               max_new_tokens=max_new_tokens,
                               prompt_len=int(lengths_np[i]))
                 self._next_rid += 1
                 self._requests[i] = req
+                self._stamp_admit(req, now)
                 # Count the prefill-sampled token like insert() does —
                 # both admission paths account tokens identically.
                 req.generated.append(int(first[i]))
+            self._report_metric()
 
     # ---- disaggregated mode ----
 
@@ -359,8 +407,7 @@ class DecodeEngine:
             self._drain()
         req = self._requests[lane]  # the drain may have completed it
         if req is not None:
-            req.done = True
-            self.completed.append(req)
+            self._complete(req)
             self._requests[lane] = None
         if self._active[lane]:
             self._active[lane] = False
@@ -385,6 +432,7 @@ class DecodeEngine:
         self._lane_window_start[lane] = len(self._pending_tokens)
         if request is not None:
             request.prompt_len = result.length
+            self._stamp_admit(request, time.time())
             request.generated.append(result.next_token)
 
     def admit_from_queue(self, prefiller: PrefillWorker) -> int:
@@ -442,23 +490,26 @@ class DecodeEngine:
         ``offsets[i]`` = rows belonging to lane i's previous occupant
         (single-step path; block windows never contain them)."""
         freed = False
+        appended = 0
         for i, req in enumerate(self._requests):
             if req is None or not self._active[i]:
                 continue
             start = int(offsets[i]) if offsets is not None else 0
             for t in toks[start:, i]:
                 req.generated.append(int(t))
+                appended += 1
                 if len(req.generated) >= req.max_new_tokens:
                     break
             if len(req.generated) >= req.max_new_tokens or \
                     not self._lane_has_room(req, self.host_sync_interval):
-                req.done = True
-                self.completed.append(req)
+                self._complete(req)
                 self._requests[i] = None
                 self._active[i] = False
                 freed = True
                 lengths = self.cache.lengths.at[i].set(0)
                 self.cache = self.cache._replace(lengths=lengths)
+        if self.telemetry is not None:
+            self.telemetry.add_tokens(appended)
         if freed:
             self._report_metric()
 

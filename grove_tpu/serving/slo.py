@@ -1,0 +1,204 @@
+"""Serving SLO telemetry — the request-lifecycle signals the control
+plane scales on (docs/design/serving-slo.md).
+
+The paper's serving target (Llama-70B disaggregated on v5e-256 at ≥90%
+of bare JAX) is a LATENCY story as much as a throughput one: the
+autoscaler must see time-to-first-token breach its SLO before users do.
+Until this module, the data plane was blind — ``DecodeEngine`` exposed
+one raw queue-depth hook and the autoscaler scaled on it statically.
+
+``EngineTelemetry`` is the engine-side half: every tracked ``Request``
+is stamped at enqueue / admit / first-token / completion (host-side
+wall-clock stamps only — NOTHING on the JIT path; the decode step's
+dispatch chain never sees a callback), and completions derive
+
+- queue-wait      (enqueue → admit: how long the request sat queued),
+- TTFT            (enqueue → first sampled token; the user-facing SLO),
+- TPOT            (inter-token time over the decode phase),
+- e2e latency     (enqueue → done),
+
+into fixed-bucket histograms with pinned buckets (the same shape the
+control plane's metrics hub renders, so ``quantile_from_buckets`` gives
+the estimate a deployed alert would compute). Completion bookkeeping is
+windowed (``host_sync_interval``), so completion-side stamps are
+observed at drain time — up to interval-1 steps late by design; the
+enqueue/admit stamps are exact.
+
+``snapshot()`` compresses the histograms into the percentile digest the
+batched push ships (serving/metrics_push.push_samples): per-metric
+value + aggregation mode, so the control plane's MetricsRegistry knows
+summing a p99 across reporters is wrong (max/avg instead — see
+MetricsRegistry aggregation modes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from grove_tpu.runtime.metrics import _Hist, quantile_from_buckets
+
+# Pinned buckets (seconds). A tiny CPU test engine lands in the
+# sub-100ms bands; a loaded production engine under a traffic ramp can
+# queue for tens of seconds — the default duration buckets would
+# flatten one end or the other.
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                2.5, 5.0, 10.0, 30.0, 60.0)
+# Inter-token time: decode steps are ms-scale on real chips,
+# sub-ms-to-ms on the CPU test mesh.
+TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0)
+QUEUE_WAIT_BUCKETS = TTFT_BUCKETS
+E2E_BUCKETS = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+               30.0, 60.0, 120.0)
+
+# Histogram name -> pinned buckets (the engine-side metric catalog;
+# serving_smoke asserts these render populated).
+HISTOGRAMS = {
+    "queue_wait_seconds": QUEUE_WAIT_BUCKETS,
+    "ttft_seconds": TTFT_BUCKETS,
+    "tpot_seconds": TPOT_BUCKETS,
+    "e2e_latency_seconds": E2E_BUCKETS,
+}
+
+
+class EngineTelemetry:
+    """Host-side request-lifecycle accounting for one serving engine.
+
+    Thread-safe (the push pump reads snapshots while the decode loop
+    observes completions), but every observation is a few dict/list
+    ops — the <5% tokens/sec overhead pin in tests/test_serving.py
+    holds because nothing here touches a device or a lock on the
+    per-token path (tokens are counted once per drained window)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hists = {name: _Hist(buckets)
+                       for name, buckets in HISTOGRAMS.items()}
+        self.requests_completed = 0
+        self.tokens_total = 0
+        # Point-sampled gauges (latest value wins, like any gauge).
+        self.queue_depth = 0
+        self.kv_utilization = 0.0
+
+    # ---- engine-side hooks ----
+
+    def sample_gauges(self, queue_depth: int,
+                      kv_utilization: float) -> None:
+        self.queue_depth = queue_depth
+        self.kv_utilization = kv_utilization
+
+    def add_tokens(self, n: int) -> None:
+        """Decoded-token counter, bumped once per drained window (NOT
+        per token — the drain already walks the window)."""
+        if n > 0:
+            with self._lock:
+                self.tokens_total += n
+
+    def observe_request(self, req) -> None:
+        """Fold one completed request's stamps into the histograms.
+        ``req`` needs enqueue_ts/admit_ts/first_token_ts/done_ts floats
+        (0.0 = never stamped) and a ``generated`` list."""
+        done = req.done_ts or time.time()
+        enq = req.enqueue_ts or req.admit_ts or done
+        admit = req.admit_ts or enq
+        first = req.first_token_ts or admit
+        n_gen = len(req.generated)
+        with self._lock:
+            self.requests_completed += 1
+            self._observe("queue_wait_seconds", max(0.0, admit - enq))
+            self._observe("ttft_seconds", max(0.0, first - enq))
+            self._observe("e2e_latency_seconds", max(0.0, done - enq))
+            if n_gen > 1:
+                # The first token is the prefill's; the remaining
+                # n_gen-1 are decode steps — TPOT is their mean pace.
+                self._observe("tpot_seconds",
+                              max(0.0, done - first) / (n_gen - 1))
+
+    def _observe(self, name: str, value: float) -> None:
+        h = self._hists[name]
+        for i, ub in enumerate(h.buckets):
+            if value <= ub:
+                h.counts[i] += 1
+                break
+        else:
+            h.counts[-1] += 1
+        h.sum += value
+        h.count += 1
+
+    # ---- read surface ----
+
+    def hist_count(self, name: str) -> int:
+        with self._lock:
+            return self._hists[name].count
+
+    def quantile(self, name: str, q: float) -> float:
+        """Bucket-interpolated quantile estimate (the same
+        histogram_quantile a deployed Prometheus computes)."""
+        with self._lock:
+            h = self._hists[name]
+            cum, c = {}, 0
+            for ub, n in zip(h.buckets, h.counts):
+                c += n
+                cum[ub] = float(c)
+            cum[float("inf")] = float(c + h.counts[-1])
+        return quantile_from_buckets(q, cum)
+
+    def snapshot(self) -> dict:
+        """Percentile digest + gauges — the payload ``samples_for_push``
+        turns into one batched push."""
+        with self._lock:
+            counts = {n: h.count for n, h in self._hists.items()}
+            means = {n: (h.sum / h.count if h.count else 0.0)
+                     for n, h in self._hists.items()}
+            completed = self.requests_completed
+            tokens = self.tokens_total
+        return {
+            "queue_depth": self.queue_depth,
+            "kv_utilization": self.kv_utilization,
+            "requests_completed": completed,
+            "tokens_total": tokens,
+            "ttft_p50_s": self.quantile("ttft_seconds", 0.5),
+            "ttft_p99_s": self.quantile("ttft_seconds", 0.99),
+            "tpot_p50_s": self.quantile("tpot_seconds", 0.5),
+            "tpot_p99_s": self.quantile("tpot_seconds", 0.99),
+            "queue_wait_p99_s": self.quantile("queue_wait_seconds", 0.99),
+            "e2e_p99_s": self.quantile("e2e_latency_seconds", 0.99),
+            "counts": counts,
+            "means": means,
+        }
+
+
+def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
+    """The batched-push sample list for one engine's current state.
+
+    Aggregation modes ride along with each sample so the registry
+    combines multi-reporter values correctly WITHOUT name-sniffing:
+    load signals sum (total queue depth drives scaling), utilizations
+    average, worst-case latencies max (a 2-replica PCSG's p99 TTFT is
+    its worst replica's, not their sum — the bug this plane fixes).
+    """
+    s = telemetry.snapshot()
+    ms = 1000.0
+    return [
+        {"metric": "queue_depth", "value": float(s["queue_depth"]),
+         "agg": "sum"},
+        {"metric": "kv_utilization", "value": float(s["kv_utilization"]),
+         "agg": "avg"},
+        {"metric": "ttft_p50_ms", "value": s["ttft_p50_s"] * ms,
+         "agg": "avg"},
+        {"metric": "ttft_p99_ms", "value": s["ttft_p99_s"] * ms,
+         "agg": "max"},
+        {"metric": "tpot_p50_ms", "value": s["tpot_p50_s"] * ms,
+         "agg": "avg"},
+        {"metric": "tpot_p99_ms", "value": s["tpot_p99_s"] * ms,
+         "agg": "max"},
+        {"metric": "queue_wait_p99_ms",
+         "value": s["queue_wait_p99_s"] * ms, "agg": "max"},
+        {"metric": "e2e_p99_ms", "value": s["e2e_p99_s"] * ms,
+         "agg": "max"},
+        {"metric": "requests_completed",
+         "value": float(s["requests_completed"]), "agg": "sum"},
+        {"metric": "tokens_total", "value": float(s["tokens_total"]),
+         "agg": "sum"},
+    ]

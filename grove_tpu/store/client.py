@@ -135,6 +135,27 @@ class Client:
                 "(created before the observatory started, or evicted)")
         return payload
 
+    def debug_serving(self, name: str, namespace: str = "default") -> dict:
+        """One serving scope's SLO state — the in-process twin of
+        ``GET /debug/serving/<ns>/<name>`` (same payload shape;
+        grovectl serving-status renders either). Raises NotFoundError
+        when no serving observatory runs on this store or no engine
+        has reported fresh samples for the scope."""
+        from grove_tpu.runtime.errors import NotFoundError
+        from grove_tpu.runtime.servingwatch import serving_observer_for
+        obs = serving_observer_for(self._store)
+        if obs is None:
+            raise NotFoundError(
+                "serving observatory is not running for this store "
+                "(no started Manager owns it, or the autoscaler is "
+                "disabled)")
+        payload = obs.payload(namespace, name)
+        if payload is None:
+            raise NotFoundError(
+                f"no fresh serving samples for {namespace}/{name} "
+                "(no engine reported inside the sample TTL)")
+        return payload
+
 
 @dataclasses.dataclass
 class _InjectedError:

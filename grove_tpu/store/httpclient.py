@@ -221,6 +221,13 @@ class HttpClient:
         return self._request(
             "GET", f"/debug/deploy/{quote(namespace)}/{quote(name)}")
 
+    def debug_serving(self, name: str, namespace: str = "default") -> dict:
+        """One serving scope's SLO state from
+        ``GET /debug/serving/<ns>/<name>`` (the wire twin of
+        ``Client.debug_serving``; 404 maps to NotFoundError)."""
+        return self._request(
+            "GET", f"/debug/serving/{quote(namespace)}/{quote(name)}")
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

@@ -29,14 +29,22 @@ from grove_tpu.api.podcliqueset import (
 from grove_tpu.cluster import new_cluster
 from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
+from timing import TIME_SCALE
+
 
 def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
-    deadline = time.time() + timeout
+    """Poll ``predicate`` until true or ``timeout * TIME_SCALE`` wall
+    seconds pass. Deadlines here are flake guards, not latency
+    assertions — scaling them (tests/timing.py) costs nothing on a
+    fast box and stops a CPU-share-throttled one from failing tests
+    whose condition was still honestly on its way."""
+    deadline = time.time() + timeout * TIME_SCALE
     while time.time() < deadline:
         if predicate():
             return
         time.sleep(interval)
-    raise AssertionError(f"timed out waiting for {desc}")
+    raise AssertionError(f"timed out waiting for {desc} "
+                         f"(deadline {timeout}s x{TIME_SCALE:g})")
 
 
 def simple_pcs(name="simple1", replicas=1, pods=3, chips=4):

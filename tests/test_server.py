@@ -488,3 +488,156 @@ def test_grovectl_top_nodes(server, capsys):
                       if ln.startswith(victim))
     assert "NotReady" in victim_row
     assert not any(f.startswith("-") for f in victim_row.split()), out
+
+
+def test_metrics_push_batched_samples(server):
+    """POST /metrics/push with a samples[] batch: one POST carries the
+    whole engine SLO digest, each sample naming its aggregation mode;
+    malformed batches reject atomically."""
+    import json
+
+    base, cl = server
+    body = json.dumps({
+        "kind": "PodCliqueScalingGroup", "name": "sg",
+        "reporter": "engine-0",
+        "samples": [
+            {"metric": "queue_depth", "value": 4.0, "agg": "sum"},
+            {"metric": "ttft_p99_ms", "value": 350.0, "agg": "max"},
+            {"metric": "kv_utilization", "value": 0.5, "agg": "avg"},
+        ]})
+    status, out = _req(f"{base}/metrics/push", "POST", body,
+                       content_type="application/json")
+    assert status == 200 and out["accepted"] == 3
+    assert cl.metrics.get("PodCliqueScalingGroup", "sg",
+                          "ttft_p99_ms") == 350.0
+    # A second reporter: latency maxes, load sums.
+    body2 = body.replace("engine-0", "engine-1").replace("350.0", "250.0")
+    status, _ = _req(f"{base}/metrics/push", "POST", body2,
+                     content_type="application/json")
+    assert status == 200
+    assert cl.metrics.get("PodCliqueScalingGroup", "sg",
+                          "ttft_p99_ms") == 350.0
+    assert cl.metrics.get("PodCliqueScalingGroup", "sg",
+                          "queue_depth") == 8.0
+    # Bad agg mode: 400, and NOTHING from the batch lands (atomic).
+    bad = json.dumps({
+        "kind": "PodCliqueScalingGroup", "name": "sg",
+        "reporter": "engine-2",
+        "samples": [
+            {"metric": "queue_depth", "value": 9.0},
+            {"metric": "ttft_p99_ms", "value": 1.0, "agg": "median"},
+        ]})
+    status, err = _req(f"{base}/metrics/push", "POST", bad,
+                       content_type="application/json")
+    assert status == 400 and "median" in err["error"]
+    # Non-dict samples (a bare string iterates characterwise) must be
+    # a clean 400, not an AttributeError escaping the handler.
+    for bad_samples in (["oops"], "abc"):
+        status, err = _req(
+            f"{base}/metrics/push", "POST",
+            json.dumps({"kind": "PodCliqueScalingGroup", "name": "sg",
+                        "samples": bad_samples}),
+            content_type="application/json")
+        assert status == 400, bad_samples
+        assert "sample must be an object" in err["error"]
+    assert cl.metrics.get("PodCliqueScalingGroup", "sg",
+                          "queue_depth") == 8.0  # unchanged
+    # The legacy single-sample shape still works.
+    single = json.dumps({"kind": "PodCliqueScalingGroup", "name": "sg",
+                         "metric": "queue_depth", "value": 2.0,
+                         "reporter": "engine-0"})
+    status, out = _req(f"{base}/metrics/push", "POST", single,
+                       content_type="application/json")
+    assert status == 200 and out["accepted"] == 1
+
+
+def test_debug_serving_endpoint(server):
+    """GET /debug/serving/<ns>/<name>: the ServingObserver's aggregated
+    SLO state for one scope, with the HttpClient twin decoding the
+    identical payload; unknown scopes 404."""
+    import json
+
+    from grove_tpu.api import PodCliqueScalingGroup, new_meta
+    from grove_tpu.api.podcliqueset import AutoScalingConfig
+    from grove_tpu.api.scalinggroup import PodCliqueScalingGroupSpec
+    from grove_tpu.runtime.servingwatch import serving_observer_for
+    from grove_tpu.store.httpclient import HttpClient
+
+    base, cl = server
+    cl.client.create(PodCliqueScalingGroup(
+        meta=new_meta("websg"),
+        spec=PodCliqueScalingGroupSpec(
+            clique_names=["w"], replicas=1, min_available=1,
+            auto_scaling=AutoScalingConfig(
+                min_replicas=1, max_replicas=3,
+                metric="ttft_p99_ms", target_value=300.0))))
+    body = json.dumps({
+        "kind": "PodCliqueScalingGroup", "name": "websg",
+        "reporter": "engine-0",
+        "samples": [{"metric": "ttft_p99_ms", "value": 450.0,
+                     "agg": "max"},
+                    {"metric": "kv_utilization", "value": 0.25,
+                     "agg": "avg"}]})
+    status, _ = _req(f"{base}/metrics/push", "POST", body,
+                     content_type="application/json")
+    assert status == 200
+    obs = serving_observer_for(cl.manager.store)
+    assert obs is not None
+    obs.sweep()
+    status, data = _req(f"{base}/debug/serving/default/websg")
+    assert status == 200
+    scope = data["scopes"][0]
+    assert scope["metrics"]["ttft_p99_ms"]["value"] == 450.0
+    assert scope["slo"]["breached"] is True
+    assert scope["kv_headroom"] == 0.75
+    # Wire twin returns the identical shape (modulo the render clock).
+    http = HttpClient(base, token=OPERATOR_TOKEN)
+    twin = http.debug_serving("websg")
+    assert twin["scopes"] == data["scopes"]
+    status, _ = _req(f"{base}/debug/serving/default/ghost")
+    assert status == 404
+
+
+def test_grovectl_serving_status(server, capsys):
+    """`grovectl serving-status` renders the scope and exits 1 on an
+    SLO breach, 0 once the signal is healthy (scripts alert on it)."""
+    import json
+
+    from grove_tpu.api import PodCliqueScalingGroup, new_meta
+    from grove_tpu.api.podcliqueset import AutoScalingConfig
+    from grove_tpu.api.scalinggroup import PodCliqueScalingGroupSpec
+    from grove_tpu.cli import main
+    from grove_tpu.runtime.servingwatch import serving_observer_for
+
+    base, cl = server
+    cl.client.create(PodCliqueScalingGroup(
+        meta=new_meta("clisg"),
+        spec=PodCliqueScalingGroupSpec(
+            clique_names=["w"], replicas=1, min_available=1,
+            auto_scaling=AutoScalingConfig(
+                min_replicas=1, max_replicas=3,
+                metric="ttft_p99_ms", target_value=300.0))))
+
+    def push(ttft):
+        body = json.dumps({
+            "kind": "PodCliqueScalingGroup", "name": "clisg",
+            "reporter": "engine-0",
+            "samples": [{"metric": "ttft_p99_ms", "value": ttft,
+                         "agg": "max"}]})
+        status, _ = _req(f"{base}/metrics/push", "POST", body,
+                         content_type="application/json")
+        assert status == 200
+
+    obs = serving_observer_for(cl.manager.store)
+    push(450.0)
+    obs.sweep()
+    assert main(["serving-status", "clisg", "--server", base]) == 1
+    out = capsys.readouterr().out
+    assert "BREACHED" in out and "ttft_p99_ms" in out
+    push(100.0)
+    obs.sweep()
+    assert main(["serving-status", "clisg", "--server", base]) == 0
+    assert "[ok]" in capsys.readouterr().out
+    # Unknown scope: error on stderr, exit 1.
+    assert main(["serving-status", "nope", "--server", base]) == 1
+    assert "error" in capsys.readouterr().err

@@ -44,7 +44,12 @@ def render(rows: list[dict]) -> str:
                if r.get("metric") == "gang_pending_reasons"]
     deploys = [r for r in rows if r.get("metric") == "reconcile_p50_ms"
                and r.get("deploy_wall_ms", 0) > 0]
-    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu"}
+    serving = [r for r in rows
+               if r.get("metric") == "serving_ttft_p99_ms"]
+    serving_tok = [r for r in rows
+                   if r.get("metric") == "serving_tokens_per_sec"]
+    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
+                "serving-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes]
     failed = [r for r in rows if r.get("value", 0) <= 0]
@@ -99,6 +104,48 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
                 f"| {r.get('value', 0):.0f} | {reasons} "
                 f"| {r.get('pending_s', 0):.1f} |")
+        out.append("")
+    if serving:
+        out += ["## Serving SLO loop (load-gen ramp, CPU engine)", "",
+                "_open-loop Poisson arrivals ramping 4x against one "
+                "tiny engine; the autoscaler scales the PCSG out when "
+                "p99 TTFT breaches the target (docs/design/"
+                "serving-slo.md)_", "",
+                "| when | git | base→peak req/s | baseline p99 ms | "
+                "target ms | ramp p99 ms | breach→scale s | replicas | "
+                "tok/s |",
+                "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(serving, key=lambda r: r.get("ts", "")):
+            scaled = (f"{r.get('scaled_from', '?')}→"
+                      f"{r.get('scaled_to', '?')}"
+                      if r.get("scaled_to", 0) > r.get("scaled_from", 1)
+                      else "no scale-up")
+            b2s = r.get("breach_to_scale_s", -1.0)
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('base_rate', 0):.1f}→"
+                f"{r.get('peak_rate', 0):.1f} "
+                f"| {r.get('baseline_p99_ms', 0):.0f} "
+                f"| {r.get('target_ms', 0):.0f} "
+                f"| {r.get('value', 0):.0f} "
+                f"| {b2s if b2s >= 0 else '-'} "
+                f"| {scaled} "
+                f"| {r.get('tokens_per_sec', 0):.0f} |")
+        out.append("")
+    if serving_tok:
+        out += ["## Engine telemetry overhead (decode bench, CPU)", "",
+                "_tokens/sec with EngineTelemetry attached; the min and "
+                "median ratios vs telemetry-off must not BOTH exceed "
+                "1.05 (the <5% pin)_", "",
+                "| when | git | tok/s | min ratio | median ratio | "
+                "within pin |", "|---|---|---|---|---|---|"]
+        for r in sorted(serving_tok, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('value', 0):.0f} "
+                f"| {r.get('overhead_min_ratio', 0):.3f} "
+                f"| {r.get('overhead_median_ratio', 0):.3f} "
+                f"| {'yes' if r.get('within_bound') else 'NO'} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

@@ -1,0 +1,317 @@
+"""Serving-SLO bench: autoscaler scale-out on a p99-TTFT breach under
+a 4x open-loop traffic ramp — the serving telemetry plane's proof
+(ROADMAP item 3, docs/design/serving-slo.md).
+
+Two measurements, two bench-history rows:
+
+1. **Telemetry overhead** (``serving_tokens_per_sec``): the decode
+   bench with ``EngineTelemetry`` attached vs detached, interleaved
+   reps, dual estimator (min AND median ratios must both exceed the bar
+   to count as a regression — the test_observability.py precedent).
+   The plane's promise is host-side stamps only, nothing on the JIT
+   path; the pin is <5% tokens/sec.
+
+2. **The closed loop** (``serving_ttft_p99_ms``): tools/loadgen.py
+   offers open-loop Poisson arrivals with heavy-tail prompt lengths
+   against ONE tiny CPU engine while the arrival rate ramps 4x. The
+   engine's telemetry digest is pushed into the control plane's
+   MetricsRegistry each tick (the batched-push payload, aggregation
+   modes and all); a PodCliqueScalingGroup autoscales on
+   ``ttft_p99_ms`` vs a target calibrated off the pre-ramp baseline.
+   The bench asserts the target was breached and the Autoscaler scaled
+   the PCSG out on the latency signal, records breach→scale-up
+   reaction time, and flags (``breach_in_ramp``) whether the breach
+   fell inside the ramp window — on a CPU-share-throttled box a
+   transient stall can trip the cumulative p99 before the ramp, and
+   the row says so rather than pretending the ramp did it.
+
+    python tools/bench_serving.py                 # append history rows
+    python tools/bench_serving.py --no-history    # dev run
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_sched import append_history  # noqa: E402
+from tools.loadgen import ArrivalSchedule, LoadProfile, build_tiny_engine, \
+    run_load  # noqa: E402
+
+OVERHEAD_BAR = 1.05  # <5% tokens/sec — the plane's headline promise
+
+
+def bench_overhead(reps: int = 6, steps: int = 48, rounds: int = 4) -> dict:
+    """Decode tokens/sec with telemetry attached vs detached.
+
+    Interleaved timed windows over the SAME engine pair (compile cost
+    paid once, outside the timed region); dual estimator like the
+    write-obs bench: a load spike inflates min or median, a real
+    systematic cost inflates both. Windows are long enough (~100ms+)
+    that one scheduler stall cannot dominate a window, and the
+    within-rep measurement order alternates so a machine that slows
+    monotonically across the bench (CPU-share throttling) does not
+    systematically bias whichever variant runs second. Each window
+    times ``rounds`` full admit→decode-to-completion cycles (48 new
+    tokens each fills the 64-slot KV cache from an 8-token prompt), so
+    lanes stay active — and the telemetry's admission stamps and
+    completion observes are inside the timed region, like production."""
+    import jax
+
+    from grove_tpu.serving.slo import EngineTelemetry
+
+    walls: dict[bool, list[float]] = {False: [], True: []}
+    engines = {}
+    for with_tel in (False, True):
+        tel = EngineTelemetry() if with_tel else None
+        eng, _pw = build_tiny_engine(batch=2, telemetry=tel)
+        prompts = jax.numpy.asarray(
+            np.random.default_rng(0).integers(0, 256, size=(2, 8)))
+        eng.admit_prompts(prompts, max_new_tokens=steps)
+        eng.step()
+        eng.sync()  # compile before timing
+        for _ in range(steps):  # retire the warmup occupants
+            eng.step()
+        eng.sync()
+        engines[with_tel] = (eng, prompts)
+    for rep in range(reps):
+        order = (False, True) if rep % 2 == 0 else (True, False)
+        for with_tel in order:
+            eng, prompts = engines[with_tel]
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                eng.admit_prompts(prompts, max_new_tokens=steps)
+                for _ in range(steps):
+                    eng.step()
+            eng.sync()
+            walls[with_tel].append(time.perf_counter() - t0)
+    base_min, base_med = min(walls[False]), statistics.median(walls[False])
+    min_r = min(walls[True]) / base_min
+    med_r = statistics.median(walls[True]) / base_med
+    tok_s = 2 * steps * rounds / min(walls[True])
+    return {"tokens_per_sec": round(tok_s, 1),
+            "overhead_min_ratio": round(min_r, 4),
+            "overhead_median_ratio": round(med_r, 4),
+            "within_bound": min_r <= OVERHEAD_BAR or med_r <= OVERHEAD_BAR}
+
+
+def bench_ramp(duration: float, base_rate: float | None,
+               seed: int = 0) -> dict:
+    """The closed loop: ramped load → TTFT breach → scale-out."""
+    from grove_tpu.api import PodCliqueScalingGroup, new_meta
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.podcliqueset import AutoScalingConfig
+    from grove_tpu.api.scalinggroup import PodCliqueScalingGroupSpec
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.serving.slo import EngineTelemetry, samples_for_push
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    tel = EngineTelemetry()
+    eng, pw = build_tiny_engine(batch=2, telemetry=tel)
+
+    # Calibrate offered load to THIS machine: measure the engine's
+    # service rate under full load, set the base rate at ~35% of it —
+    # low enough that Poisson bursts against 2 lanes keep the pre-ramp
+    # p99 TTFT comfortably healthy, while the 4x ramp lands at ~1.4x
+    # the service rate: genuinely oversubscribed, queue grows without
+    # bound, TTFT breaches DURING the ramp.
+    if base_rate is None:
+        cal = ArrivalSchedule.build(
+            LoadProfile(duration_s=2.0, base_rate=50.0, ramp_factor=1.0),
+            seed=seed + 1)
+        stats = run_load(eng, pw, cal, drain_s=60.0)
+        service_rate = stats.completed / stats.wall_s
+        base_rate = max(0.5, 0.35 * service_rate)
+
+    tel_run = EngineTelemetry()
+    cfg = OperatorConfiguration()
+    cfg.autoscaler.sync_period_seconds = 0.25
+    cfg.autoscaler.scale_down_stabilization_seconds = 300.0
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    marks: dict[str, float] = {}
+    with cluster:
+        # Let the manager's startup burst (topology sync, first
+        # reconciles) finish before measuring anything: on a
+        # CPU-throttled box those threads stall the engine loop for
+        # seconds, and a startup stall reads as a hot baseline.
+        time.sleep(2.0)
+        # The scaled object exists BEFORE the baseline phase so its
+        # deploy burst (gang create -> schedule -> pods) is over by the
+        # time anything is measured; the target starts at a placeholder
+        # no signal can trip and is patched once calibrated.
+        cluster.client.create(PodCliqueScalingGroup(
+            meta=new_meta("serve-sg"),
+            spec=PodCliqueScalingGroupSpec(
+                clique_names=["decode"], replicas=1, min_available=1,
+                auto_scaling=AutoScalingConfig(
+                    min_replicas=1, max_replicas=4,
+                    metric="ttft_p99_ms", target_value=1e12))))
+        time.sleep(1.0)
+        # Baseline TTFT at the base rate, measured INSIDE the running
+        # cluster so control-plane threads contend with the engine loop
+        # exactly as they will during the ramp — a baseline taken before
+        # the manager starts under-reads this machine and yields a
+        # target the contended pre-ramp phase trips on its own.
+        tel_base = EngineTelemetry()
+        eng.telemetry = tel_base
+        warm = ArrivalSchedule.build(
+            LoadProfile(duration_s=3.0, base_rate=base_rate,
+                        ramp_factor=1.0),
+            seed=seed + 2)
+        run_load(eng, pw, warm, drain_s=60.0)
+        baseline_p99_ms = tel_base.snapshot()["ttft_p99_s"] * 1e3
+        # Target sits well above the healthy baseline (10x — and the
+        # floor absorbs a stray sub-2s scheduler stall, which on a
+        # CPU-share-throttled box the cumulative digest would otherwise
+        # carry as the p99 until ~100 requests dilute it) and well
+        # below a saturated queue's runaway TTFT (which grows without
+        # bound as the open-loop backlog accumulates; clamped under the
+        # top TTFT histogram bucket so the runaway can always cross
+        # it) — so the breach lands DURING the ramp, which is the story
+        # this bench exists to prove.
+        target_ms = min(max(10.0 * baseline_p99_ms, 2000.0), 30000.0)
+        sg = cluster.client.get(PodCliqueScalingGroup, "serve-sg")
+        sg.spec.auto_scaling.target_value = target_ms
+        cluster.client.update(sg)
+
+        eng.telemetry = tel_run
+
+        last_push = [0.0]
+
+        def on_tick(now: float) -> None:
+            if now - last_push[0] < 0.25:
+                return
+            last_push[0] = now
+            for s in samples_for_push(tel_run):
+                cluster.metrics.set(
+                    "PodCliqueScalingGroup", "serve-sg", s["metric"],
+                    s["value"], reporter="engine-0", agg=s.get("agg"))
+            if "breach" not in marks \
+                    and tel_run.snapshot()["ttft_p99_s"] * 1e3 > target_ms:
+                marks["breach"] = now
+            if "scaled" not in marks:
+                sg = cluster.client.get(PodCliqueScalingGroup, "serve-sg")
+                if sg.spec.replicas > 1:
+                    marks["scaled"] = now
+                    marks["scaled_to"] = sg.spec.replicas
+
+        # Short pre-ramp (15-35% of the run): enough healthy baseline
+        # to prove the target isn't trivially breached, most of the
+        # run spent where the story is — ramp and saturation.
+        profile = LoadProfile(duration_s=duration, base_rate=base_rate,
+                              ramp_factor=4.0, ramp_start=0.15,
+                              ramp_end=0.35)
+        schedule = ArrivalSchedule.build(profile, seed=seed)
+        stats = run_load(eng, pw, schedule, telemetry=tel_run,
+                         on_tick=on_tick, drain_s=120.0)
+        final = cluster.client.get(PodCliqueScalingGroup, "serve-sg")
+        scaled_to = final.spec.replicas
+
+    digest = tel_run.snapshot()
+    return {
+        "metric": "serving_ttft_p99_ms",
+        "value": round(digest["ttft_p99_s"] * 1e3, 1),
+        "unit": "ms",
+        "mode": "serving-cpu",
+        "target_ms": round(target_ms, 1),
+        "baseline_p99_ms": round(baseline_p99_ms, 1),
+        "base_rate": round(base_rate, 2),
+        "peak_rate": round(base_rate * 4.0, 2),
+        "ramp_factor": 4.0,
+        "offered": stats.offered,
+        "completed": stats.completed,
+        "tokens_per_sec": round(stats.tokens_per_sec, 1),
+        "tpot_p50_ms": round(digest["tpot_p50_s"] * 1e3, 2),
+        "queue_wait_p99_ms": round(digest["queue_wait_p99_s"] * 1e3, 1),
+        "ramp_start_s": round(profile.ramp_start * duration, 2),
+        "breached": "breach" in marks,
+        # True only when the breach fell inside the ramp window — a
+        # pre-ramp breach means the base calibration was already hot
+        # for this run (on a CPU-share-throttled box a transient stall
+        # can trip the cumulative p99 early; the row says so honestly
+        # instead of the bench pretending the ramp did it).
+        "breach_in_ramp": marks.get("breach", -1.0)
+        >= profile.ramp_start * duration,
+        "breach_at_s": round(marks.get("breach", -1.0), 2),
+        "scaled_at_s": round(marks.get("scaled", -1.0), 2),
+        "breach_to_scale_s": round(marks["scaled"] - marks["breach"], 2)
+        if "breach" in marks and "scaled" in marks else -1.0,
+        "scaled_from": 1,
+        "scaled_to": int(scaled_to),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="ramp-phase wall seconds (load doubles ~4x "
+                    "across it)")
+    ap.add_argument("--base-rate", type=float, default=None,
+                    help="req/s before the ramp (default: calibrated "
+                    "to ~35%% of this machine's service rate, so the "
+                    "4x ramp lands ~1.4x over it)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append to bench-history/")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.no_history:
+        os.environ["GROVE_BENCH_HISTORY"] = "0"
+
+    over = bench_overhead()
+    print(f"telemetry overhead: min x{over['overhead_min_ratio']:.3f} "
+          f"median x{over['overhead_median_ratio']:.3f} "
+          f"({over['tokens_per_sec']:.0f} tok/s) "
+          f"{'OK' if over['within_bound'] else 'OVER the 5% pin'}",
+          flush=True)
+    append_history({"metric": "serving_tokens_per_sec",
+                    "value": over["tokens_per_sec"], "unit": "tok/s",
+                    "mode": "serving-cpu", **{k: over[k] for k in
+                    ("overhead_min_ratio", "overhead_median_ratio",
+                     "within_bound")}})
+
+    row = bench_ramp(args.duration, args.base_rate, seed=args.seed)
+    print(f"ramp: {row['base_rate']:.1f} -> {row['peak_rate']:.1f} req/s "
+          f"over {args.duration:.0f}s, TTFT p99 "
+          f"{row['baseline_p99_ms']:.0f} ms -> {row['value']:.0f} ms "
+          f"(target {row['target_ms']:.0f} ms)", flush=True)
+    if row["breached"] and row["scaled_to"] > row["scaled_from"]:
+        print(f"scale-out: breach at {row['breach_at_s']:.1f}s, "
+              f"replicas {row['scaled_from']} -> {row['scaled_to']} "
+              f"at {row['scaled_at_s']:.1f}s "
+              f"({row['breach_to_scale_s']:.1f}s reaction)")
+    if row["breached"] and not row["breach_in_ramp"]:
+        print(f"note: breach landed at {row['breach_at_s']:.1f}s, "
+              f"BEFORE the ramp window ({row['ramp_start_s']:.1f}s) — "
+              "base load was already hot for this run (wall-clock "
+              "throttling or a low target); the scale-out is still on "
+              "the latency signal, but not attributable to the ramp",
+              file=sys.stderr)
+    append_history(row)
+    if not over["within_bound"]:
+        print("FAIL: telemetry overhead exceeds the 5% tokens/sec pin",
+              file=sys.stderr)
+        return 1
+    if not row["breached"]:
+        print("FAIL: the 4x ramp never breached the TTFT target — "
+              "offered load too low for this machine (rerun with a "
+              "higher --base-rate)", file=sys.stderr)
+        return 1
+    if row["scaled_to"] <= row["scaled_from"]:
+        print("FAIL: TTFT breached but the autoscaler never scaled the "
+              "PCSG out", file=sys.stderr)
+        return 1
+    print("bench-serving OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,241 @@
+"""Open-loop serving load generator — synthetic million-user traffic
+shrunk to a laptop (ROADMAP item 3's arrival model, bench_serving's
+driver).
+
+Three properties make the traffic honest:
+
+- **Open loop.** Arrivals fire on the wall clock from a pre-drawn
+  Poisson schedule, never gated on completions — a slow engine cannot
+  slow its own offered load down (the closed-loop fallacy that hides
+  queueing collapse). When the engine falls behind, the queue grows and
+  TTFT blows up, exactly like production.
+- **Heavy-tail prompt lengths.** Bounded Pareto: most prompts short, a
+  fat tail of long ones (real chat traffic), so prefill cost varies per
+  request instead of being a constant the engine can amortize away.
+- **Ramp profile.** Arrival rate holds at a base rate, then climbs
+  linearly to ``ramp_factor``x and holds — the 4x traffic ramp the
+  serving-SLO bench breaches its TTFT target under.
+
+Everything is seeded: two runs with one seed offer byte-identical
+schedules (the ramp comparison in bench_serving is apples-to-apples).
+
+Library use (bench_serving, serving_smoke):
+
+    schedule = ArrivalSchedule.build(profile, seed=0)
+    stats = run_load(engine, prefiller, schedule, telemetry=tel)
+
+Standalone (tiny CPU engine, prints the TTFT/TPOT digest):
+
+    python tools/loadgen.py --duration 10 --base-rate 2 --ramp 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class LoadProfile:
+    """Offered-load shape: ``base_rate`` req/s for the first
+    ``ramp_start`` fraction of the run, a linear climb to
+    ``base_rate * ramp_factor`` by the ``ramp_end`` fraction, held to
+    the end. ``ramp_factor=1`` is a flat run."""
+
+    duration_s: float = 10.0
+    base_rate: float = 2.0
+    ramp_factor: float = 4.0
+    ramp_start: float = 0.4
+    ramp_end: float = 0.6
+    # Bounded-Pareto prompt lengths: alpha≈1.2 gives the heavy tail
+    # (p50 near min_len, rare prompts at max_len).
+    min_prompt: int = 4
+    max_prompt: int = 24
+    tail_alpha: float = 1.2
+    max_new_tokens: int = 16
+
+    def rate_at(self, t: float) -> float:
+        frac = t / self.duration_s if self.duration_s > 0 else 1.0
+        if frac <= self.ramp_start:
+            return self.base_rate
+        if frac >= self.ramp_end:
+            return self.base_rate * self.ramp_factor
+        span = self.ramp_end - self.ramp_start
+        return self.base_rate * (
+            1.0 + (self.ramp_factor - 1.0) * (frac - self.ramp_start) / span)
+
+
+@dataclasses.dataclass
+class ArrivalSchedule:
+    """A pre-drawn request schedule: arrival offsets (seconds from
+    start, sorted) and the matching prompt-token arrays."""
+
+    profile: LoadProfile
+    offsets: list[float]
+    prompts: list[np.ndarray]
+
+    @classmethod
+    def build(cls, profile: LoadProfile, seed: int = 0,
+              vocab_size: int = 256) -> "ArrivalSchedule":
+        """Draw the whole run up front. Non-homogeneous Poisson via
+        per-gap exponentials at the instantaneous rate — exact enough
+        for a ramp that changes slowly against the mean gap."""
+        rng = np.random.default_rng(seed)
+        offsets: list[float] = []
+        t = 0.0
+        while True:
+            rate = profile.rate_at(t)
+            t += float(rng.exponential(1.0 / rate)) if rate > 0 else 0.05
+            if t >= profile.duration_s:
+                break
+            offsets.append(t)
+        lengths = cls._pareto_lengths(rng, len(offsets), profile)
+        prompts = [rng.integers(0, vocab_size, size=int(n)).astype(np.int32)
+                   for n in lengths]
+        return cls(profile=profile, offsets=offsets, prompts=prompts)
+
+    @staticmethod
+    def _pareto_lengths(rng, n: int, p: LoadProfile) -> np.ndarray:
+        draws = p.min_prompt * (1.0 + rng.pareto(p.tail_alpha, size=n))
+        return np.clip(draws, p.min_prompt, p.max_prompt).astype(np.int64)
+
+
+@dataclasses.dataclass
+class LoadStats:
+    """What one run offered and what the engine delivered."""
+
+    offered: int = 0
+    submitted: int = 0
+    completed: int = 0
+    tokens: int = 0
+    wall_s: float = 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.tokens / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def run_load(engine, prefiller, schedule: ArrivalSchedule, *,
+             telemetry=None, on_tick=None, drain_s: float = 30.0,
+             ) -> LoadStats:
+    """Replay ``schedule`` against a DecodeEngine on the wall clock.
+
+    One thread runs both halves: due arrivals are submitted (open loop
+    — submission never waits on a free lane), then the serve side
+    admits from the queue and steps every active lane. After the last
+    arrival the engine drains (bounded by ``drain_s`` so a wedged
+    engine fails loudly instead of hanging the bench).
+
+    ``on_tick(now_s)``, when given, runs roughly every step — the
+    bench's hook for pushing telemetry digests and polling the
+    autoscaler mid-run.
+    """
+    stats = LoadStats(offered=len(schedule.offsets))
+    # The engine may be warm from a calibration run: count only THIS
+    # run's completions/tokens (deltas, not lifetime totals).
+    completed0 = len(engine.completed)
+    tokens0 = sum(len(r.generated) for r in engine.completed)
+    start = time.time()
+    i = 0
+    deadline = start + schedule.profile.duration_s + drain_s
+    while True:
+        now = time.time() - start
+        while i < len(schedule.offsets) and schedule.offsets[i] <= now:
+            engine.submit(schedule.prompts[i],
+                          max_new_tokens=schedule.profile.max_new_tokens)
+            stats.submitted += 1
+            i += 1
+        engine.admit_from_queue(prefiller)
+        active = bool(np.count_nonzero(engine._active))
+        if active:
+            engine.step()
+        if on_tick is not None:
+            on_tick(now)
+        if i >= len(schedule.offsets) and not active \
+                and engine.queue_depth == 0:
+            break
+        if time.time() > deadline:
+            break
+        if not active:
+            # Idle between arrivals: sleep to the next due arrival (or
+            # a short poll) instead of spinning the GIL away.
+            if i < len(schedule.offsets):
+                time.sleep(min(0.005, max(0.0,
+                               schedule.offsets[i] - (time.time() - start))))
+            else:
+                time.sleep(0.002)
+    stats.wall_s = time.time() - start
+    stats.completed = len(engine.completed) - completed0
+    stats.tokens = sum(len(r.generated)
+                       for r in engine.completed) - tokens0
+    if telemetry is not None:
+        # The engine already folded completions in; just refresh gauges
+        # so a final snapshot reflects the drained state.
+        telemetry.sample_gauges(engine.queue_depth,
+                                engine.kv_lane_utilization)
+    return stats
+
+
+def build_tiny_engine(batch: int = 2, telemetry=None):
+    """The CPU test-config engine + prefiller pair every serving tool
+    drives (one place to keep the shape honest across smoke/bench)."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import DecodeEngine, PrefillWorker
+
+    cfg = dc.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                     max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    pw = PrefillWorker(cfg, params, batch=batch, max_prompt=32)
+    eng = DecodeEngine(cfg, params, batch=batch, host_sync_interval=4,
+                       telemetry=telemetry)
+    return eng, pw
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="loadgen")
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--base-rate", type=float, default=2.0)
+    parser.add_argument("--ramp", type=float, default=4.0,
+                        help="peak rate as a multiple of --base-rate")
+    parser.add_argument("--batch", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu.serving.slo import EngineTelemetry
+
+    tel = EngineTelemetry()
+    eng, pw = build_tiny_engine(batch=args.batch, telemetry=tel)
+    profile = LoadProfile(duration_s=args.duration,
+                          base_rate=args.base_rate,
+                          ramp_factor=args.ramp)
+    schedule = ArrivalSchedule.build(profile, seed=args.seed)
+    print(f"offering {len(schedule.offsets)} requests over "
+          f"{args.duration:.0f}s ({args.base_rate:.1f} -> "
+          f"{args.base_rate * args.ramp:.1f} req/s)")
+    stats = run_load(eng, pw, schedule, telemetry=tel)
+    s = tel.snapshot()
+    print(f"completed {stats.completed}/{stats.offered} "
+          f"({stats.tokens} tokens, {stats.tokens_per_sec:.1f} tok/s)")
+    print(f"TTFT p50/p99: {s['ttft_p50_s'] * 1e3:.1f}/"
+          f"{s['ttft_p99_s'] * 1e3:.1f} ms   "
+          f"TPOT p50/p99: {s['tpot_p50_s'] * 1e3:.2f}/"
+          f"{s['tpot_p99_s'] * 1e3:.2f} ms   "
+          f"queue-wait p99: {s['queue_wait_p99_s'] * 1e3:.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
