@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile docs native lint clean ci render-deploy
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -25,6 +25,20 @@ dashboard:       ## render scale-history JSONL into DASHBOARD.md
 
 soak:            ## repeated scale out/in cycles
 	$(PY) -m pytest tests/test_scale.py::test_soak_scale_cycles -q
+
+chaos-smoke:     ## short seeded chaos mix (the make-ci gate): 2 cycles,
+	@# >=4 fault types each, every gang invariant swept between them
+	@# (docs/design/chaos-harness.md). Fixed seed = reproducible abuse.
+	$(PY) tools/chaos_soak.py --mix --seed 7 --cycles 2
+
+chaos-soak:      ## long randomized soak + the leader-kill failover bench
+	@# 8 compressed mix cycles with bench-history chaos rows, then
+	@# SIGKILL-the-manager mid-300-pod-deploy with takeover (ROADMAP
+	@# item 4's acceptance: no orphans/duplicates, reconcile resumed
+	@# under budget). Vary SEED to explore; a failure's seed is its
+	@# repro command.
+	$(PY) tools/chaos_soak.py --mix --seed $${SEED:-7} --cycles 8 --history
+	$(PY) tools/chaos_soak.py --scenario leader-kill --pods 300 --history
 
 bench:           ## single-chip serving benchmark (real TPU)
 	$(PY) bench.py
@@ -101,6 +115,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# batched /metrics/push -> ServingObserver -> /debug/serving
 	@# renders with the SLO judged against the autoscaling target.
 	$(PY) tools/serving_smoke.py
+	@# chaos smoke: 2 fixed-seed mix cycles (>=4 fault types each) with
+	@# the full gang-invariant sweep between cycles — the regression net
+	@# that lets the control plane refactor aggressively (ROADMAP 5).
+	$(PY) tools/chaos_soak.py --mix --seed 7 --cycles 2
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

@@ -1,0 +1,30 @@
+"""Chaos/soak harness — fault-injection scenarios with gang-invariant
+checking (ROADMAP item 5; the reference's GS1-GS10 gang-correctness e2e
+plus soak_test.go's repeated scale up/down, SURVEY.md §6).
+
+Three layers (docs/design/chaos-harness.md):
+
+- ``faults``      — composable injectors driven through public surfaces
+- ``scenario``    — seeded runner composing fault schedules with
+                    workload actions into named scenarios + a random mix
+- ``invariants``  — the checker that sweeps the store and every debug
+                    surface between cycles
+
+``tools/chaos_soak.py`` fronts the harness; ``make chaos-smoke`` is the
+CI gate, ``make chaos-soak`` the long run.
+"""
+
+from grove_tpu.chaos.faults import (  # noqa: F401
+    FAULT_REGISTRY,
+    ChaosContext,
+    Fault,
+)
+from grove_tpu.chaos.invariants import (  # noqa: F401
+    InvariantChecker,
+    Violation,
+)
+from grove_tpu.chaos.scenario import (  # noqa: F401
+    SCENARIOS,
+    ScenarioRunner,
+    run_leader_kill,
+)

@@ -1,0 +1,363 @@
+"""Composable fault injectors — every fault drives a PUBLIC surface.
+
+The rule that keeps the harness honest: a fault may only do what the
+real world can do to the control plane — write API objects (node
+heartbeats going stale, node objects vanishing), create workloads
+(preemption storms are just high-priority gangs), push metrics
+(autoscale flapping is what a noisy engine fleet does), kill processes
+(agents, the leader), or trip the sanctioned wire fault hook
+(httpclient.arm_watch_gap — the injected form of a history-ring 410).
+No store internals, no controller privates: if a fault needs a back
+door, the production surface is what's missing.
+
+Each fault is ``inject(ctx)`` / ``heal(ctx)``; both are safe to call
+repeatedly (flapping = inject/heal in a loop). The scenario runner
+composes them from a seeded RNG so every run is reproducible from its
+seed (docs/design/chaos-harness.md).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any
+
+from grove_tpu.api import Node, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+
+
+class ChaosContext:
+    """Shared handles the faults act through: the cluster under test,
+    the seeded RNG, and (when the runner wires them) the HTTP surface
+    for wire-path faults. Faults must treat everything here as the
+    outside world does — ``client`` is the API, ``http`` is the wire."""
+
+    def __init__(self, cluster, rng: random.Random,
+                 namespace: str = "default",
+                 base_url: str = "", http: Any = None,
+                 wire_informers: dict | None = None,
+                 workload_pcs: str = "", workload_pcsg: str = "",
+                 autoscale_metric: str = "queue_depth",
+                 autoscale_target: float = 10.0):
+        self.cluster = cluster
+        self.client = cluster.client
+        self.rng = rng
+        self.namespace = namespace
+        self.base_url = base_url
+        self.http = http                      # HttpClient for wire faults
+        self.wire_informers = wire_informers or {}
+        self.workload_pcs = workload_pcs
+        self.workload_pcsg = workload_pcsg    # autoscaled PCSG full name
+        self.autoscale_metric = autoscale_metric
+        self.autoscale_target = autoscale_target
+        self.log = get_logger("chaos")
+
+    # -- world helpers ----------------------------------------------------
+
+    def nodes(self) -> list[Node]:
+        return self.client.list(Node, self.namespace)
+
+    def slices(self) -> list[str]:
+        return sorted({n.meta.labels.get(c.NODE_LABEL_SLICE, "")
+                       for n in self.nodes()} - {""})
+
+    def nodes_of_slice(self, slice_name: str) -> list[Node]:
+        return [n for n in self.nodes()
+                if n.meta.labels.get(c.NODE_LABEL_SLICE) == slice_name]
+
+    def find_kubelet(self):
+        from grove_tpu.agent.node import FakeKubeletPool
+        for r in self.cluster.manager.runnables:
+            if isinstance(r, FakeKubeletPool):
+                return r
+        return None
+
+    def push_metric(self, value: float, metric: str | None = None,
+                    reporter: str = "chaos") -> bool:
+        """Autoscaling signal through the wire surface the engines use
+        (POST /metrics/push) — never the in-process registry. The POST
+        is built directly (not via serving.metrics_push, which derives
+        the reporter from GROVE_POD_NAME) because chaos needs DISTINCT
+        reporters: the traffic pump and the flap fault must aggregate
+        as two engines, not last-write-wins under one id."""
+        if self.http is None or not self.workload_pcsg:
+            return False
+        try:
+            self.http._request("POST", "/metrics/push", {
+                "kind": "PodCliqueScalingGroup",
+                "name": self.workload_pcsg,
+                "namespace": self.namespace,
+                "metric": metric or self.autoscale_metric,
+                "value": value,
+                "reporter": reporter,
+            })
+            return True
+        except GroveError:
+            return False   # advisory, like every metrics path
+
+
+class Fault:
+    """One injectable failure mode. ``inject`` breaks something through
+    a public surface and returns truthy iff the fault actually FIRED
+    (a no-op — no candidate node, no wire surface — returns False so
+    the runner's fault-coverage accounting stays honest); ``heal``
+    restores the precondition (the world healing — host repaired,
+    traffic calming, process restarted). Both must tolerate being
+    called when the fault is already (in)active."""
+
+    name = "fault"
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        raise NotImplementedError
+
+    def heal(self, ctx: ChaosContext) -> None:
+        raise NotImplementedError
+
+
+class NodeHeartbeatLossFault(Fault):
+    """A host's agent stops heartbeating (feeds
+    controllers/nodelifecycle.py): the node is handed to the 'remote
+    agent' world (spec.fake=False) with its last heartbeat already
+    stale, so the node-lifecycle controller marks it NotReady and fails
+    its pods for self-heal. Heal returns it to the fake-kubelet pool
+    ready and heartbeat-exempt — the repaired-host analog. Calling
+    inject/heal in a loop is heartbeat FLAPPING."""
+
+    name = "node-heartbeat-loss"
+
+    def __init__(self) -> None:
+        self._lost: list[str] = []
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        candidates = [n for n in ctx.nodes()
+                      if n.spec.fake
+                      and not n.meta.labels.get(c.LABEL_RESERVATION)]
+        if not candidates:
+            return False
+        node = ctx.rng.choice(candidates)
+        grace = ctx.cluster.manager.config.node_lifecycle.grace_seconds
+        try:
+            live = ctx.client.get(Node, node.meta.name, ctx.namespace)
+            live.spec.fake = False
+            live = ctx.client.update(live)
+            # Recorded as soon as the FIRST write lands: if the status
+            # write below conflicts, the node is already half-injected
+            # (non-fake, no agent will ever heartbeat it) and heal()
+            # must still restore it — otherwise the fleet silently
+            # loses a node for the rest of the soak.
+            self._lost.append(node.meta.name)
+            live.status.heartbeat_time = time.time() - 2.0 * grace
+            live.status.ready = True
+            ctx.client.update_status(live)
+        except (NotFoundError, GroveError) as e:
+            ctx.log.warning("heartbeat-loss inject on %s failed: %s",
+                            node.meta.name, e)
+            return False
+        ctx.log.info("chaos: node %s heartbeat gone stale", node.meta.name)
+        return True
+
+    def heal(self, ctx: ChaosContext) -> None:
+        for name in self._lost:
+            try:
+                live = ctx.client.get(Node, name, ctx.namespace)
+                live.spec.fake = True
+                live = ctx.client.update(live)
+                live.status.ready = True
+                live.status.heartbeat_time = 0.0   # exempt again
+                live.status.message = ""
+                ctx.client.update_status(live)
+            except (NotFoundError, GroveError):
+                continue
+        self._lost.clear()
+
+
+class NodeDeleteFault(Fault):
+    """A whole slice's node OBJECTS vanish (fleet shrink / hard host
+    loss): the node-lifecycle orphan sweep fails their pods, gangs
+    breach and self-heal elsewhere. Heal re-registers identical nodes
+    (host repaired and re-joined)."""
+
+    name = "node-delete"
+
+    def __init__(self) -> None:
+        # (name, generation, topology, slice, worker, pool)
+        self._deleted: list[tuple[str, str, str, str, int, str]] = []
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        slices = ctx.slices()
+        if len(slices) < 2:
+            return False  # never delete the last slice: nothing heals to
+        victim = ctx.rng.choice(slices)
+        for n in ctx.nodes_of_slice(victim):
+            gen = n.meta.labels.get(
+                c.NODE_LABEL_TPU_ACCELERATOR, "tpu-v5e").removeprefix("tpu-")
+            self._deleted.append((
+                n.meta.name, gen,
+                n.meta.labels.get(c.NODE_LABEL_TPU_TOPOLOGY, "2x2"),
+                victim, int(n.meta.labels.get(c.NODE_LABEL_SLICE_WORKER, 0)),
+                n.meta.labels.get(c.NODE_LABEL_POOL, "pool-0")))
+            try:
+                ctx.client.delete(Node, n.meta.name, n.meta.namespace)
+            except (NotFoundError, GroveError):
+                continue
+        ctx.log.info("chaos: slice %s nodes deleted", victim)
+        return bool(self._deleted)
+
+    def heal(self, ctx: ChaosContext) -> None:
+        from grove_tpu.topology.fleet import build_node
+        for _name, gen, topo, slice_name, worker, pool in self._deleted:
+            fresh = build_node(gen, topo, slice_name, worker, pool=pool,
+                               namespace=ctx.namespace)
+            try:
+                ctx.client.create(fresh)
+            except GroveError:
+                continue  # already re-registered
+        self._deleted.clear()
+
+
+class PreemptionStormFault(Fault):
+    """A burst of high-priority single-slice gangs lands on a full
+    fleet: the gang scheduler preempts the workload's elastic scaled
+    gangs to make room (scheduler/backends._try_preempt_for). Heal
+    deletes the storm; preempted capacity re-expands."""
+
+    name = "preemption-storm"
+
+    def __init__(self, burst: int = 2, pods: int = 2, priority: int = 100,
+                 chips_per_pod: int = 4) -> None:
+        """Each storm gang is ``pods`` x ``chips_per_pod`` chips
+        slice-packed — sized so a burst fills the fleet's free
+        headroom; composed with node loss it overflows into actual
+        preemption of the workload's elastic scaled gangs."""
+        self.burst = burst
+        self.pods = pods
+        self.priority = priority
+        self.chips_per_pod = chips_per_pod
+        self._names: list[str] = []
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        for i in range(self.burst):
+            name = f"storm-{ctx.rng.randrange(1 << 30):08x}-{i}"
+            pcs = PodCliqueSet(
+                meta=new_meta(name, namespace=ctx.namespace),
+                spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                    priority=self.priority,
+                    topology=TopologyConstraint(pack_level="slice",
+                                                required=True),
+                    cliques=[PodCliqueTemplate(
+                        name="burst", replicas=self.pods,
+                        min_available=self.pods,
+                        tpu_chips_per_pod=self.chips_per_pod,
+                        container=ContainerSpec(argv=["sleep", "inf"]))])))
+            try:
+                ctx.client.create(pcs)
+                self._names.append(name)
+            except GroveError as e:
+                ctx.log.warning("storm gang %s rejected: %s", name, e)
+        ctx.log.info("chaos: preemption storm of %d high-priority gangs",
+                     len(self._names))
+        return bool(self._names)
+
+    def heal(self, ctx: ChaosContext) -> None:
+        for name in self._names:
+            try:
+                ctx.client.delete(PodCliqueSet, name, ctx.namespace)
+            except (NotFoundError, GroveError):
+                continue
+        self._names.clear()
+
+
+class WatchGapFault(Fault):
+    """The wire watch's history-ring gap (410 Gone), injected through
+    the sanctioned hook (httpclient.arm_watch_gap, env-gated on
+    GROVE_FAULT_INJECT): every armed consumer must relist-and-resume
+    (informer reseed) rather than die or serve a hole. The invariant
+    checker then proves the wire caches reconverged with the store."""
+
+    name = "watch-gap"
+
+    def __init__(self, gaps: int = 1) -> None:
+        self.gaps = gaps
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        from grove_tpu.store.httpclient import arm_watch_gap
+        if ctx.http is None:
+            return False
+        arm_watch_gap(ctx.http, self.gaps)
+        ctx.log.info("chaos: armed %d watch gap(s)", self.gaps)
+        return True
+
+    def heal(self, ctx: ChaosContext) -> None:
+        pass  # one-shot: consumed by the next watch poll(s)
+
+
+class AutoscaleFlapFault(Fault):
+    """A noisy engine fleet: the scaling signal spikes far above target
+    (scale-out — new gangs) then collapses (scale-in after
+    stabilization), pushed through POST /metrics/push exactly as
+    serving engines report. Gang creates/destroys under churn are the
+    point — the invariants must hold through both."""
+
+    name = "autoscale-flap"
+
+    def __init__(self, spike_factor: float = 3.0) -> None:
+        self.spike_factor = spike_factor
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        pushed = ctx.push_metric(ctx.autoscale_target * self.spike_factor)
+        if pushed:
+            ctx.log.info("chaos: autoscale signal spiked x%.1f",
+                         self.spike_factor)
+        return pushed
+
+    def heal(self, ctx: ChaosContext) -> None:
+        ctx.push_metric(ctx.autoscale_target * 0.1)
+
+
+class AgentKillFault(Fault):
+    """The node-agent process dies (kubelet crash): pods stop
+    transitioning to Running/Ready until a replacement agent starts.
+    Kill is ``stop()`` on the live FakeKubeletPool (exactly what
+    process death does to its loops); heal starts a FRESH pool — an
+    agent restart, not a resurrection."""
+
+    name = "agent-kill"
+
+    def __init__(self) -> None:
+        self._killed = False
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        pool = ctx.find_kubelet()
+        if pool is None:
+            return False
+        pool.stop()
+        ctx.cluster.manager.runnables.remove(pool)
+        self._killed = True
+        ctx.log.info("chaos: node agent killed")
+        return True
+
+    def heal(self, ctx: ChaosContext) -> None:
+        if not self._killed:
+            return
+        from grove_tpu.agent.node import FakeKubeletPool
+        fresh = FakeKubeletPool(ctx.cluster.manager.client)
+        fresh.start()
+        ctx.cluster.manager.runnables.append(fresh)
+        self._killed = False
+        ctx.log.info("chaos: node agent restarted")
+
+
+# name -> factory; the scenario runner samples these from its seed.
+FAULT_REGISTRY: dict[str, type[Fault]] = {
+    f.name: f for f in (NodeHeartbeatLossFault, NodeDeleteFault,
+                        PreemptionStormFault, WatchGapFault,
+                        AutoscaleFlapFault, AgentKillFault)
+}

@@ -1,0 +1,377 @@
+"""Gang-invariant checker — what must stay true no matter the abuse.
+
+Swept between chaos cycles (and usable standalone against any live
+cluster), reading only the surfaces an operator has: the object store
+through the client, the PR 3 trace milestones, the PR 5 explain
+diagnosis, the PR 6 deploy observatory, and the rendered /metrics
+text. Each invariant polls with a TIME_SCALE-scaled grace before
+declaring a violation — the control plane is eventually consistent and
+chaos leaves transients in flight; only a state that REFUSES to
+converge is a bug.
+
+The invariants (ISSUE 8 / reference GS1-GS10 analog):
+
+- **gang-binding**     no gang partially bound beyond a deadline
+                       (gang atomicity: all pods placed or none)
+- **live-owner**       no object whose controller owner is gone
+                       (cascade/expectations correctness)
+- **pending-diagnosis** every pending gang carries a CURRENT
+                       PlacementDiagnosis (explain never goes stale)
+- **no-duplicates**    no duplicate pods per expectation key (the
+                       SURVEY §7 double-create hazard's direct check)
+- **gauge-consistency** grove_state_objects gauges match store counts
+                       (the observability plane never lies)
+- **wire-convergence** wire informer caches match the store after
+                       gap injection (410 recovery is complete)
+- **ttr-stability**    time-to-ready p99 stays within a drift factor
+                       of the first cycle's (no degradation across
+                       cycles — the soak signal)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+)
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.timescale import scaled
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    subject: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.invariant}] {self.subject}: {self.detail}"
+
+
+def _poll_until_empty(probe: Callable[[], list[Violation]],
+                      deadline_s: float,
+                      interval: float = 0.1) -> list[Violation]:
+    """Re-run ``probe`` until it reports nothing or the (already
+    scaled) deadline passes; transients get the grace, a stuck state
+    does not."""
+    deadline = time.time() + deadline_s
+    found = probe()
+    while found and time.time() < deadline:
+        time.sleep(interval)
+        found = probe()
+    return found
+
+
+class InvariantChecker:
+    def __init__(self, cluster, namespace: str | None = None,
+                 bind_deadline_s: float = 10.0,
+                 owner_deadline_s: float = 8.0,
+                 diagnosis_grace_s: float = 5.0,
+                 diagnosis_staleness_s: float = 30.0,
+                 gauge_deadline_s: float = 8.0,
+                 ttr_drift_factor: float = 10.0,
+                 ttr_drift_floor_s: float = 3.0):
+        """Deadlines are pre-scale seconds (each is multiplied by
+        TIME_SCALE). ``ttr_drift_factor`` is deliberately loose: this
+        container's CPU share swings wildly between minutes (CHANGES.md
+        PR 7), so the drift check catches collapse, not jitter — and
+        ``ttr_drift_floor_s`` (scaled) keeps a fast-but-ratio-noisy
+        sample (80ms -> 900ms) from counting as degradation: a drift
+        violation needs the last cycle to be both RELATIVELY and
+        ABSOLUTELY slow."""
+        self.cluster = cluster
+        self.client = cluster.client
+        self.namespace = namespace
+        self.bind_deadline = scaled(bind_deadline_s)
+        self.owner_deadline = scaled(owner_deadline_s)
+        self.diagnosis_grace = scaled(diagnosis_grace_s)
+        self.diagnosis_staleness = scaled(diagnosis_staleness_s)
+        self.gauge_deadline = scaled(gauge_deadline_s)
+        self.ttr_drift_factor = ttr_drift_factor
+        self.ttr_drift_floor = scaled(ttr_drift_floor_s)
+        self.log = get_logger("chaos.invariants")
+        # Per-cycle time-to-ready samples (seconds), appended by the
+        # scenario runner via record_cycle_ttr.
+        self.ttr_cycles: list[list[float]] = []
+
+    # ---- individual invariants ------------------------------------------
+
+    def check_gang_binding(self) -> list[Violation]:
+        """Gang atomicity: a gang whose pods are part-bound must
+        converge to fully bound (or fully unbound, e.g. preempted) —
+        a partial bind that persists past the deadline is exactly the
+        state gang scheduling exists to prevent."""
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            pods = [p for p in self.client.list(Pod, self.namespace)
+                    if p.meta.deletion_timestamp is None]
+            by_gang: dict[str, list[Pod]] = {}
+            for p in pods:
+                gang = p.meta.labels.get(c.LABEL_PODGANG_NAME, "")
+                if gang:
+                    by_gang.setdefault(
+                        f"{p.meta.namespace}/{gang}", []).append(p)
+            for key, members in by_gang.items():
+                bound = [bool(p.status.node_name) for p in members]
+                if any(bound) and not all(bound):
+                    out.append(Violation(
+                        "gang-binding", key,
+                        f"partially bound: {sum(bound)}/{len(bound)} "
+                        "pods placed"))
+            return out
+
+        return _poll_until_empty(probe, self.bind_deadline)
+
+    def check_live_owner(self) -> list[Violation]:
+        """No orphan survives: every managed object's controller owner
+        must exist with a matching uid. A pod outliving its clique (or
+        a clique its PCS) past the deadline means cascade deletion or
+        the expectations barrier leaked."""
+        kinds = {"PodClique": PodClique, "PodCliqueSet": PodCliqueSet,
+                 "PodCliqueScalingGroup": PodCliqueScalingGroup,
+                 "PodGang": PodGang}
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            live_uids: dict[tuple[str, str, str], str] = {}
+            for kind, cls in kinds.items():
+                for obj in self.client.list(cls, self.namespace):
+                    if obj.meta.deletion_timestamp is None:
+                        live_uids[(kind, obj.meta.namespace,
+                                   obj.meta.name)] = obj.meta.uid
+            objs = [(f"Pod {p.meta.namespace}/{p.meta.name}", p)
+                    for p in self.client.list(Pod, self.namespace)]
+            for kind, cls in kinds.items():
+                if kind == "PodCliqueSet":
+                    continue  # PCSes are roots
+                objs.extend((f"{kind} {o.meta.namespace}/{o.meta.name}", o)
+                            for o in self.client.list(cls, self.namespace))
+            for label, obj in objs:
+                if obj.meta.deletion_timestamp is not None:
+                    continue
+                refs = [r for r in obj.meta.owner_references
+                        if r.kind in kinds]
+                if not refs:
+                    out.append(Violation("live-owner", label,
+                                         "no controller owner reference"))
+                    continue
+                for ref in refs:
+                    uid = live_uids.get(
+                        (ref.kind, obj.meta.namespace, ref.name))
+                    if uid is None:
+                        out.append(Violation(
+                            "live-owner", label,
+                            f"owner {ref.kind}/{ref.name} is gone"))
+                    elif ref.uid and uid != ref.uid:
+                        out.append(Violation(
+                            "live-owner", label,
+                            f"owner {ref.kind}/{ref.name} uid changed "
+                            f"(stale generation: {ref.uid} != {uid})"))
+            return out
+
+        return _poll_until_empty(probe, self.owner_deadline)
+
+    def check_pending_diagnosis(self) -> list[Violation]:
+        """Explainability never rots: a gang that has been pending
+        longer than the grace must carry a PlacementDiagnosis whose
+        last attempt is recent — 'my gang is stuck and nothing says
+        why' is itself an incident (PR 5's contract)."""
+        import os
+        if os.environ.get("GROVE_EXPLAIN", "1") == "0":
+            return []
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            now = time.time()
+            for gang in self.client.list(PodGang, self.namespace):
+                if gang.meta.deletion_timestamp is not None:
+                    continue
+                if is_condition_true(gang.status.conditions,
+                                     c.COND_SCHEDULED):
+                    continue
+                age = now - (gang.meta.creation_timestamp or now)
+                if age < self.diagnosis_grace:
+                    continue
+                key = f"{gang.meta.namespace}/{gang.meta.name}"
+                diag = gang.status.last_diagnosis
+                if diag is None:
+                    out.append(Violation(
+                        "pending-diagnosis", key,
+                        f"pending {age:.1f}s with no diagnosis"))
+                elif now - diag.last_attempt_time > self.diagnosis_staleness:
+                    out.append(Violation(
+                        "pending-diagnosis", key,
+                        f"diagnosis stale: last attempt "
+                        f"{now - diag.last_attempt_time:.1f}s ago "
+                        f"(> {self.diagnosis_staleness:.1f}s)"))
+            return out
+
+        # Pending gangs re-attempt on scheduler sweeps; give one sweep
+        # of grace before calling the diagnosis stale.
+        return _poll_until_empty(probe, self.diagnosis_grace)
+
+    def check_no_duplicates(self) -> list[Violation]:
+        """The expectations hazard, checked directly: within one
+        PodClique no two live pods may share a pod index, and the pod
+        count must not exceed the clique's spec — more pods than asked
+        for is a double-create that slipped the barrier."""
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            cliques = {(q.meta.namespace, q.meta.name): q
+                       for q in self.client.list(PodClique, self.namespace)}
+            by_clique: dict[tuple[str, str], list[Pod]] = {}
+            for p in self.client.list(Pod, self.namespace):
+                if p.meta.deletion_timestamp is not None:
+                    continue
+                pclq = p.meta.labels.get(c.LABEL_PCLQ_NAME, "")
+                if pclq:
+                    by_clique.setdefault(
+                        (p.meta.namespace, pclq), []).append(p)
+            for key, pods in by_clique.items():
+                seen: dict[str, str] = {}
+                for p in pods:
+                    idx = p.meta.labels.get(c.LABEL_POD_INDEX, "")
+                    if idx in seen:
+                        out.append(Violation(
+                            "no-duplicates", f"PodClique {key[0]}/{key[1]}",
+                            f"pods {seen[idx]} and {p.meta.name} share "
+                            f"index {idx} (double-create)"))
+                    seen[idx] = p.meta.name
+                q = cliques.get(key)
+                if q is not None and len(pods) > q.spec.replicas:
+                    out.append(Violation(
+                        "no-duplicates", f"PodClique {key[0]}/{key[1]}",
+                        f"{len(pods)} live pods exceed spec.replicas="
+                        f"{q.spec.replicas}"))
+            return out
+
+        return _poll_until_empty(probe, self.owner_deadline)
+
+    def check_gauge_consistency(self) -> list[Violation]:
+        """The observability plane must agree with the store: per-kind
+        totals of grove_state_objects (fed from informer caches) match
+        a direct store list. A persistent mismatch means the caches —
+        which every controller reads — have diverged."""
+        from grove_tpu.runtime.metrics import parse_counters
+
+        kinds = {"Pod": Pod, "PodGang": PodGang, "PodClique": PodClique,
+                 "PodCliqueSet": PodCliqueSet, "Node": Node}
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            text = self.cluster.manager.metrics_text()
+            gauges = parse_counters(text, "grove_state_objects")
+            per_kind: dict[str, float] = {}
+            for labels, value in gauges.items():
+                kind = dict(labels).get("kind", "")
+                per_kind[kind] = per_kind.get(kind, 0.0) + value
+            for kind, cls in kinds.items():
+                want = len(self.client.list(cls, namespace=None))
+                got = per_kind.get(kind, 0.0)
+                if int(got) != want:
+                    out.append(Violation(
+                        "gauge-consistency", kind,
+                        f"grove_state_objects sums to {got:.0f}, store "
+                        f"holds {want}"))
+            return out
+
+        return _poll_until_empty(probe, self.gauge_deadline)
+
+    def check_wire_convergence(
+            self, wire_informers: dict | None) -> list[Violation]:
+        """After watch-gap injection the wire informers must hold
+        exactly the store's objects again — a cache that lost events
+        and never reseeded serves holes to every consumer."""
+        if not wire_informers:
+            return []
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            for cls, (inf, _refl) in wire_informers.items():
+                store_names = {(o.meta.namespace, o.meta.name)
+                               for o in self.client.list(cls, namespace=None)}
+                try:
+                    cached = {(o.meta.namespace, o.meta.name)
+                              for o in inf.lister().list(namespace=None)}
+                except (GroveError, NotFoundError):
+                    cached = set()
+                if cached != store_names:
+                    missing = store_names - cached
+                    extra = cached - store_names
+                    out.append(Violation(
+                        "wire-convergence", cls.KIND,
+                        f"cache diverged: missing={sorted(missing)[:3]} "
+                        f"extra={sorted(extra)[:3]} "
+                        f"({len(cached)} cached vs {len(store_names)})"))
+            return out
+
+        return _poll_until_empty(probe, self.gauge_deadline)
+
+    # ---- time-to-ready stability ----------------------------------------
+
+    def record_cycle_ttr(self, samples: list[float]) -> None:
+        self.ttr_cycles.append(list(samples))
+
+    @staticmethod
+    def _p99(samples: list[float]) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return s[min(len(s) - 1, math.ceil(0.99 * len(s)) - 1)]
+
+    def ttr_drift(self) -> float:
+        """Latest cycle's p99 over the first cycle's (1.0 = flat)."""
+        cycles = [cyc for cyc in self.ttr_cycles if cyc]
+        if len(cycles) < 2:
+            return 1.0
+        base = self._p99(cycles[0])
+        if base <= 0:
+            return 1.0
+        return self._p99(cycles[-1]) / base
+
+    def check_ttr_stability(self) -> list[Violation]:
+        drift = self.ttr_drift()
+        cycles = [cyc for cyc in self.ttr_cycles if cyc]
+        last_p99 = self._p99(cycles[-1]) if cycles else 0.0
+        if drift > self.ttr_drift_factor and last_p99 > self.ttr_drift_floor:
+            return [Violation(
+                "ttr-stability", "gang time-to-ready",
+                f"p99 drifted x{drift:.1f} from cycle 1 to "
+                f"{last_p99:.2f}s (> x{self.ttr_drift_factor:g} and > "
+                f"{self.ttr_drift_floor:.1f}s floor) — the control "
+                "plane is degrading across cycles")]
+        return []
+
+    # ---- the sweep -------------------------------------------------------
+
+    def sweep(self, wire_informers: dict | None = None,
+              include_ttr: bool = True) -> list[Violation]:
+        """Run every invariant; returns all violations (empty = green).
+        Ordered cheap-transient-tolerant first so the polling graces
+        overlap the cluster settling."""
+        out: list[Violation] = []
+        out += self.check_gang_binding()
+        out += self.check_live_owner()
+        out += self.check_no_duplicates()
+        out += self.check_pending_diagnosis()
+        out += self.check_gauge_consistency()
+        out += self.check_wire_convergence(wire_informers)
+        if include_ttr:
+            out += self.check_ttr_stability()
+        for v in out:
+            self.log.error("invariant violated: %s", v)
+        return out

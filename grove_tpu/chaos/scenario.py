@@ -1,0 +1,656 @@
+"""Seeded scenario runner — fault schedules composed with workload
+actions into named scenarios, plus the randomized ``mix`` soak.
+
+Reproducibility contract: every random choice (which fault, which
+node/slice, stagger timing, workload actions) flows from ONE
+``random.Random(seed)``; the same seed against the same code replays
+the same schedule, which is what makes a chaos failure debuggable
+(``tools/chaos_soak.py --mix --seed N`` is a repro command, not a dice
+roll). Wall-clock nondeterminism (thread interleaving) still varies —
+the seed pins the ABUSE, not the weather.
+
+A cycle is the compressed-time soak unit (soak_test.go's repeated
+scale up/down analog):
+
+  deploy probe gang -> inject faults (staggered) -> workload action
+  (rolling update / PCSG scale pressure) -> hold the fault window ->
+  heal -> wait recovery (probe Ready, standing workload Ready) ->
+  delete probe -> settle -> invariant sweep
+
+Between cycles the InvariantChecker sweeps the store and every debug
+surface; the probe's time-to-ready (from PR 3 trace milestones) feeds
+the cross-cycle p99-stability invariant.
+
+``run_leader_kill`` is the separate HA acceptance scenario (ROADMAP
+item 4 / proposal 0002): a child process runs the whole control plane
+against a persistent state dir, is SIGKILLed mid-deploy, and THIS
+process takes over as the standby (flock + lease takeover,
+store/persist.py), proving no orphaned/duplicated pods and
+reconcile resumed under a pinned budget.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import statistics
+import time
+
+from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true, trace_id_of
+from grove_tpu.api.podcliqueset import (
+    AutoScalingConfig,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    ScalingGroupConfig,
+    StartupType,
+    TopologyConstraint,
+)
+from grove_tpu.chaos.faults import FAULT_REGISTRY, ChaosContext
+from grove_tpu.chaos.invariants import InvariantChecker, Violation
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.timescale import TIME_SCALE, scaled
+
+SLICE = TopologyConstraint(pack_level="slice", required=True)
+POOL = TopologyConstraint(pack_level="pool", required=True)
+
+# Named scenarios: which fault types every cycle composes. "mix" is
+# special-cased (a seeded sample of MIX_FAULTS_PER_CYCLE types per
+# cycle); "leader-kill" is the subprocess scenario (run_leader_kill).
+SCENARIOS: dict[str, list[str]] = {
+    "node-flap": ["node-heartbeat-loss", "node-delete"],
+    "preemption-storm": ["preemption-storm"],
+    "watch-gap": ["watch-gap"],
+    "autoscale-flap": ["autoscale-flap"],
+    "agent-restart": ["agent-kill"],
+}
+MIX_FAULTS_PER_CYCLE = 4
+
+
+def _wait(predicate, timeout_s: float, desc: str,
+          interval: float = 0.05) -> None:
+    """Poll until true or ``timeout_s * TIME_SCALE`` passes."""
+    deadline = time.time() + scaled(timeout_s)
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"chaos: timed out waiting for {desc} "
+                         f"({timeout_s}s x{TIME_SCALE:g})")
+
+
+def _workload_pcs(name: str, autoscale_metric: str,
+                  autoscale_target: float) -> PodCliqueSet:
+    """The standing workload every scenario abuses: a steady standalone
+    clique plus an elastic autoscaled scaling group (so preemption has
+    scaled-gang victims and autoscale flapping has something to flap)."""
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            topology=POOL,
+            startup_type=StartupType.ANY_ORDER,
+            cliques=[
+                # PREFERRED slice pack (required=False), not the hard
+                # constraint: this clique rolls pod-by-pod under chaos,
+                # and a hard pack can wedge forever when another gang's
+                # replacement lands in the freed slot mid-roll — the
+                # harness found exactly that (StragglerUnplaced deadlock;
+                # the defragmenter that would fix it is ROADMAP item 2,
+                # see docs/design/chaos-harness.md). The probe gangs
+                # keep required=True: they deploy and delete atomically.
+                PodCliqueTemplate(name="steady", replicas=2,
+                                  min_available=1, tpu_chips_per_pod=4,
+                                  topology=TopologyConstraint(
+                                      pack_level="slice", required=False),
+                                  container=ContainerSpec(
+                                      argv=["sleep", "inf"])),
+                PodCliqueTemplate(name="elastic", replicas=1,
+                                  min_available=1, tpu_chips_per_pod=4,
+                                  topology=SLICE,
+                                  container=ContainerSpec(
+                                      argv=["sleep", "inf"])),
+            ],
+            # replicas=2 with min_available=1: instance 1 is a SCALED
+            # (elastic) gang from the start — the preemption storm
+            # needs a victim and scale-in needs something to prune.
+            scaling_groups=[ScalingGroupConfig(
+                name="inst", clique_names=["elastic"], replicas=2,
+                min_available=1,
+                auto_scaling=AutoScalingConfig(
+                    min_replicas=1, max_replicas=3,
+                    metric=autoscale_metric,
+                    target_value=autoscale_target))],
+        )))
+
+
+def _probe_pcs(name: str) -> PodCliqueSet:
+    """Per-cycle probe: one fresh 2-pod gang whose create->Ready time
+    (trace milestones) is the cross-cycle stability signal."""
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            topology=POOL,
+            startup_type=StartupType.ANY_ORDER,
+            cliques=[PodCliqueTemplate(
+                name="probe", replicas=2, min_available=2,
+                tpu_chips_per_pod=4, topology=SLICE,
+                container=ContainerSpec(argv=["sleep", "inf"]))])))
+
+
+class ScenarioRunner:
+    """Owns the cluster under chaos, the fault set, and the checker."""
+
+    def __init__(self, scenario: str = "mix", seed: int = 0,
+                 cycles: int = 5, slices: int = 6,
+                 autoscale_target: float = 10.0,
+                 ttr_drift_factor: float = 10.0,
+                 ttr_drift_floor_s: float = 3.0,
+                 rolling_every: int = 2,
+                 dump_fn=None):
+        if scenario != "mix" and scenario not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {scenario!r}; choose from "
+                f"{sorted(SCENARIOS)} or 'mix' (leader-kill runs via "
+                "run_leader_kill)")
+        self.scenario = scenario
+        self.seed = seed
+        self.cycles = cycles
+        self.slices = slices
+        self.autoscale_target = autoscale_target
+        self.ttr_drift_factor = ttr_drift_factor
+        self.ttr_drift_floor_s = ttr_drift_floor_s
+        self.rolling_every = rolling_every
+        self.dump_fn = dump_fn
+        self.rng = random.Random(seed)
+        self.log = get_logger("chaos.scenario")
+        self.cluster = None
+        self.server = None
+        self.ctx: ChaosContext | None = None
+        self.checker: InvariantChecker | None = None
+        self.wire_informers: dict = {}
+        self._pump_stop = None
+        self._roll_generation = 0
+        self.fault_types_used: set[str] = set()
+        # Mid-chaos probe recovery times (ms) per cycle — reported, but
+        # excluded from the drift invariant (they measure the faults).
+        self.chaos_ttr_ms: list[float] = []
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def setup(self) -> None:
+        from grove_tpu.api.config import OperatorConfiguration
+        from grove_tpu.cluster import new_cluster
+        from grove_tpu.runtime.informer import wire_informer
+        from grove_tpu.server import ApiServer
+        from grove_tpu.store.httpclient import FAULT_INJECT_ENV, HttpClient
+        from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+        # The chaos opt-in, restored by teardown(): the env gate must
+        # not stay open for whatever else runs in this process after.
+        self._prev_fault_env = os.environ.get(FAULT_INJECT_ENV)
+        self._fault_env = FAULT_INJECT_ENV
+        os.environ[FAULT_INJECT_ENV] = "1"
+        cfg = OperatorConfiguration()
+        # Compressed time: tight detection/decision cadences so a cycle
+        # is seconds, not the production-tuned minutes.
+        cfg.node_lifecycle.grace_seconds = 1.0
+        cfg.node_lifecycle.sync_period_seconds = 0.2
+        cfg.autoscaler.sync_period_seconds = 0.3
+        cfg.autoscaler.scale_down_stabilization_seconds = 1.5
+        # 2x4 slices: 2 hosts / 8 chips each — one probe or steady gang
+        # packs a slice; the elastic instance takes half of one.
+        self.cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+            SliceSpec(generation="v5e", topology="2x4",
+                      count=self.slices)]))
+        self.cluster.start()
+        self.server = ApiServer(self.cluster, port=0)
+        self.server.start()
+        base = f"http://127.0.0.1:{self.server.port}"
+        http = HttpClient(base)
+        # Wire informer: a watch-fed consumer whose 410-gap recovery the
+        # watch-gap fault exercises and the convergence invariant proves.
+        inf, refl = wire_informer(http, PodCliqueSet, poll_timeout=2.0)
+        refl.start()
+        self.wire_informers = {PodCliqueSet: (inf, refl)}
+        self._reflector = refl
+        self.ctx = ChaosContext(
+            self.cluster, self.rng, base_url=base, http=http,
+            wire_informers=self.wire_informers,
+            workload_pcs="soak",
+            workload_pcsg="soak-0-inst",
+            autoscale_target=self.autoscale_target)
+        self.checker = InvariantChecker(
+            self.cluster, ttr_drift_factor=self.ttr_drift_factor,
+            ttr_drift_floor_s=self.ttr_drift_floor_s)
+
+        client = self.cluster.client
+        client.create(_workload_pcs("soak", self.ctx.autoscale_metric,
+                                    self.autoscale_target))
+        _wait(lambda: self._workload_ready(), 30.0,
+              "standing workload up")
+        self._start_traffic_pump()
+
+    def teardown(self) -> None:
+        if self._pump_stop is not None:
+            self._pump_stop.set()
+        if getattr(self, "_reflector", None) is not None:
+            self._reflector.stop()
+        if self.server is not None:
+            self.server.stop()
+        if self.cluster is not None:
+            self.cluster.stop()
+        if getattr(self, "_fault_env", None):
+            if self._prev_fault_env is None:
+                os.environ.pop(self._fault_env, None)
+            else:
+                os.environ[self._fault_env] = self._prev_fault_env
+
+    # ---- workload actions (the soak's scale up/down analog) -------------
+
+    def _workload_ready(self) -> bool:
+        client = self.cluster.client
+        try:
+            pcs = client.get(PodCliqueSet, "soak")
+        except NotFoundError:
+            return False
+        if pcs.status.available_replicas < 1:
+            return False
+        pods = [p for p in client.list(
+            Pod, selector={c.LABEL_PCS_NAME: "soak"})
+            if p.meta.deletion_timestamp is None]
+        return bool(pods) and all(
+            is_condition_true(p.status.conditions, c.COND_READY)
+            for p in pods)
+
+    def _start_traffic_pump(self) -> None:
+        """Sustained loadgen traffic: a background reporter pushing a
+        seeded noisy-but-steady scaling signal through /metrics/push at
+        engine cadence — the registry must never go stale mid-soak, and
+        the autoscaler always has live signal to act on."""
+        import threading
+        self._pump_stop = threading.Event()
+        stop = self._pump_stop
+        ctx = self.ctx
+        pump_rng = random.Random(self.seed ^ 0x5EED)
+        # 1.5x target sustains desired=2 instances (ceil(15/10)), so
+        # the standing scaled gang survives quiet cycles; the flap
+        # fault's spike (x3 target) pushes the sum to desired=3+.
+        base = self.autoscale_target * 1.5
+
+        def pump() -> None:
+            while not stop.is_set():
+                value = max(0.0, pump_rng.gauss(base, base * 0.1))
+                ctx.push_metric(value, reporter="chaos-pump")
+                stop.wait(0.4)
+
+        threading.Thread(target=pump, name="chaos-traffic",
+                         daemon=True).start()
+
+    def _rolling_update(self) -> None:
+        """Template edit on the standing workload: every pod rolls in
+        place (pod-level rolling update) while faults fire around it."""
+        client = self.cluster.client
+        self._roll_generation += 1
+        for _ in range(5):
+            try:
+                pcs = client.get(PodCliqueSet, "soak")
+                for t in pcs.spec.template.cliques:
+                    t.container.env["CHAOS_ROLL"] = str(
+                        self._roll_generation)
+                client.update(pcs)
+                self.log.info("chaos: rolling update -> generation %d",
+                              self._roll_generation)
+                return
+            except GroveError:
+                time.sleep(0.05)   # conflict: re-read and retry
+        self.log.warning("chaos: rolling update generation %d never "
+                         "landed (5 conflicts) — this cycle rolls "
+                         "nothing", self._roll_generation)
+
+    # ---- the cycle -------------------------------------------------------
+
+    def _cycle_faults(self) -> list:
+        if self.scenario == "mix":
+            names = self.rng.sample(sorted(FAULT_REGISTRY),
+                                    k=MIX_FAULTS_PER_CYCLE)
+        else:
+            names = list(SCENARIOS[self.scenario])
+        # fault_types_used is recorded at successful INJECTION (in
+        # run_cycle), not here: a fault that no-opped or raised must
+        # not count toward the ">=4 types mixed" acceptance.
+        return [FAULT_REGISTRY[n]() for n in names]
+
+    def run_cycle(self, i: int) -> list[Violation]:
+        client = self.cluster.client
+        ctx = self.ctx
+        faults = self._cycle_faults()
+        probe = f"probe-c{i}"
+        self.log.info("chaos cycle %d: faults=%s",
+                      i, [f.name for f in faults])
+
+        t_deploy = time.time()
+        client.create(_probe_pcs(probe))
+
+        injected = []
+        for f in faults:
+            # Appended BEFORE inject: heal is repeat-safe even for an
+            # unfired fault, and an inject that raises after partially
+            # mutating the cluster must still be healed.
+            injected.append(f)
+            try:
+                fired = f.inject(ctx)
+                if fired:
+                    self.fault_types_used.add(f.name)
+                else:
+                    self.log.warning("fault %s did not fire (no-op "
+                                     "inject); not counted", f.name)
+            except Exception as e:  # noqa: BLE001 — an unfirable fault
+                self.log.warning("fault %s inject failed: %s", f.name, e)
+                # must not kill the soak; the cycle runs short one fault
+
+            time.sleep(self.rng.uniform(0.0, 0.2))
+
+        # Every rolling_every-th cycle (1 = every cycle; the modulus
+        # comparison is against rolling_every-1 so 1 actually fires —
+        # "i % 1 == 1" never would).
+        if self.rolling_every and \
+                i % self.rolling_every == self.rolling_every - 1:
+            self._rolling_update()
+
+        # Hold the fault window (compressed): long enough for detection
+        # cadences (grace 1s) to fire, short enough to soak many cycles.
+        time.sleep(scaled(self.rng.uniform(1.2, 2.0)))
+
+        for f in reversed(injected):
+            try:
+                f.heal(ctx)
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("fault %s heal failed: %s", f.name, e)
+
+        # Recovery: the probe reaches Ready despite everything above.
+        _wait(lambda: client.get(PodCliqueSet, probe)
+              .status.available_replicas >= 1, 40.0,
+              f"{probe} available after chaos")
+        chaos_ttr = self._probe_ttr(probe, t_deploy)
+        self.chaos_ttr_ms.append(round(chaos_ttr * 1e3, 1))
+        _wait(self._workload_ready, 40.0, "standing workload recovered")
+
+        # Drop the probe (the scale-down half of the soak cycle).
+        client.delete(PodCliqueSet, probe)
+        _wait(lambda: not client.list(
+            Pod, selector={c.LABEL_PCS_NAME: probe}), 20.0,
+            f"{probe} pods pruned")
+
+        # Pulse probe: a CLEAN post-heal deploy every cycle — same
+        # conditions each time, so its create->Ready is the cross-cycle
+        # stability signal. (The chaos probe's time measures the fault
+        # window it deployed into — a per-cycle random quantity that
+        # cannot feed a drift ratio.)
+        pulse = f"pulse-c{i}"
+        t_pulse = time.time()
+        client.create(_probe_pcs(pulse))
+        _wait(lambda: client.get(PodCliqueSet, pulse)
+              .status.available_replicas >= 1, 30.0,
+              f"{pulse} available on a healed fleet")
+        pulse_ttr = self._probe_ttr(pulse, t_pulse)
+        client.delete(PodCliqueSet, pulse)
+        _wait(lambda: not client.list(
+            Pod, selector={c.LABEL_PCS_NAME: pulse}), 20.0,
+            f"{pulse} pods pruned")
+        self.cluster.manager.wait_idle(timeout=scaled(10.0), settle=0.2)
+
+        self.checker.record_cycle_ttr([pulse_ttr])
+        return self.checker.sweep(wire_informers=self.wire_informers)
+
+    def _probe_ttr(self, name: str, t_deploy: float) -> float:
+        """Create->Ready seconds from the PR 3 trace milestones; falls
+        back to the measured wall window when the milestone is missing
+        (which the trace smoke, not this harness, guards)."""
+        try:
+            tid = trace_id_of(self.cluster.client.get(PodCliqueSet, name))
+            data = self.cluster.client.debug_traces(tid)
+            miles = {m["subject"]: m["phases"]
+                     for m in data["milestones"]}
+            phases = miles.get(f"default/{name}-0", {})
+            t0 = data["starts"].get(tid, phases.get("gang_created"))
+            if t0 is not None and "ready" in phases:
+                return phases["ready"] - t0
+        except (GroveError, NotFoundError, KeyError, TypeError):
+            pass
+        return time.time() - t_deploy
+
+    def run(self) -> dict:
+        """Full scenario run; returns the report dict (see
+        tools/chaos_soak.py). Violations stop the run at the failing
+        cycle — the cluster is left to the dump hook, then torn down."""
+        violations: list[Violation] = []
+        cycles_ok = 0
+
+        def dump() -> None:
+            if self.dump_fn is not None and self.cluster is not None:
+                try:
+                    self.dump_fn(self.cluster)
+                except Exception:  # noqa: BLE001 — diagnostics must
+                    self.log.exception("diagnostics dump failed")
+
+        try:
+            self.setup()   # inside the try: a half-built cluster (e.g.
+            # the workload-up wait timing out on a throttled box) must
+            # still tear its threads/server down, not leak them into
+            # the rest of the process.
+            for i in range(self.cycles):
+                violations = self.run_cycle(i)
+                if violations:
+                    dump()
+                    break
+                cycles_ok += 1
+        except BaseException:
+            # A recovery-wait timeout is evidence too: dump the live
+            # cluster before teardown destroys the stuck state.
+            dump()
+            raise
+        finally:
+            self.teardown()
+        ttrs = [t for cyc in self.checker.ttr_cycles for t in cyc]
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "cycles": self.cycles,
+            "cycles_ok": cycles_ok,
+            "fault_types_used": sorted(self.fault_types_used),
+            "violations": [str(v) for v in violations],
+            "chaos_ttr_ms": list(self.chaos_ttr_ms),
+            "ttr_ms": [round(t * 1e3, 1) for t in ttrs],
+            "ttr_p50_ms": round(statistics.median(ttrs) * 1e3, 1)
+            if ttrs else 0.0,
+            "ttr_p99_ms": round(
+                InvariantChecker._p99(ttrs) * 1e3, 1) if ttrs else 0.0,
+            "ttr_p99_drift": round(self.checker.ttr_drift(), 3),
+        }
+
+
+# ---- leader-kill: the HA failover acceptance bench ----------------------
+
+_LEADER_CHILD = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("GROVE_TEST_TIME_SCALE", "1.0")
+from grove_tpu.api import Pod, PodCliqueSet, constants as c
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import new_meta
+from grove_tpu.api.podcliqueset import (PodCliqueSetSpec,
+    PodCliqueSetTemplate, PodCliqueTemplate, StartupType)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+state_dir = {state_dir!r}
+progress = {progress!r}
+pods_per_gang = {pods_per_gang}
+gangs = {gangs}
+
+hosts = max(4, (pods_per_gang * gangs) // 64)
+cl = new_cluster(state_dir=state_dir, fleet=FleetSpec(slices=[
+    SliceSpec(generation="v5e", topology="4x4",
+              count=max(1, hosts // 4))]))
+with cl:
+    cl.client.create(PodCliqueSet(
+        meta=new_meta("ha-deploy"),
+        spec=PodCliqueSetSpec(replicas=gangs,
+                              template=PodCliqueSetTemplate(
+            startup_type=StartupType.ANY_ORDER,
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=pods_per_gang,
+                min_available=pods_per_gang, tpu_chips_per_pod=0,
+                container=ContainerSpec(argv=["sleep", "inf"]))]))))
+    while True:
+        n = len(cl.client.list(Pod,
+                               selector={{c.LABEL_PCS_NAME: "ha-deploy"}}))
+        tmp = progress + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(n))
+        os.replace(tmp, progress)
+        time.sleep(0.05)
+"""
+
+
+def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
+                    state_dir: str | None = None,
+                    kill_fraction: float = 0.2,
+                    resume_budget_s: float = 30.0,
+                    deploy_timeout_s: float = 120.0) -> dict:
+    """SIGKILL the manager mid-deploy; the standby fences and takes
+    over (flock + lease, store/persist.py — proposal 0002's acceptance
+    bench). Asserts: no orphaned pods, no duplicated pods, the deploy
+    COMPLETES under the new leader, and reconcile observably resumed
+    (first post-takeover pod create) within ``resume_budget_s``
+    (TIME_SCALE-scaled).
+
+    The leader is a real child process running the full control plane
+    against ``state_dir``; this process plays the standby — a different
+    pid, so the flock/lease takeover path is the genuine article."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.store.store import Store
+
+    gangs = pods // pods_per_gang
+    assert gangs * pods_per_gang == pods, \
+        f"pods={pods} must divide by pods_per_gang={pods_per_gang}"
+    log = get_logger("chaos.leader-kill")
+    workdir = tempfile.mkdtemp(prefix="chaos-leader-")
+    log.info("leader-kill workdir (state dir + leader log): %s", workdir)
+    state_dir = state_dir or os.path.join(workdir, "state")
+    progress = os.path.join(workdir, "progress")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    child_code = textwrap.dedent(_LEADER_CHILD).format(
+        state_dir=state_dir, progress=progress,
+        pods_per_gang=pods_per_gang, gangs=gangs)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    # Child output goes to a FILE, not pipes: the control plane logs
+    # freely to stderr, and an undrained pipe buffer filling up would
+    # block the child mid-deploy — a hang indistinguishable from the
+    # failover regression this bench exists to catch. The file is also
+    # the evidence to read when the child dies early.
+    child_log_path = os.path.join(workdir, "leader.log")
+    child_log = open(child_log_path, "wb")
+    leader = subprocess.Popen([sys.executable, "-c", child_code], env=env,
+                              stdout=child_log, stderr=child_log)
+    threshold = max(1, int(pods * kill_fraction))
+    try:
+        def progress_count() -> int:
+            try:
+                with open(progress) as f:
+                    return int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                return 0
+
+        _wait(lambda: leader.poll() is not None
+              or progress_count() >= threshold,
+              deploy_timeout_s, f"leader to create >= {threshold} pods",
+              interval=0.005)   # tight: the kill should land mid-burst
+        if leader.poll() is not None:
+            child_log.flush()
+            with open(child_log_path, "rb") as f:
+                tail = f.read()[-2000:]
+            raise AssertionError(
+                f"leader died before the kill point: "
+                f"{tail.decode(errors='replace')}")
+        pods_at_kill = progress_count()
+        leader.send_signal(signal.SIGKILL)
+        t_kill = time.time()
+        leader.wait(timeout=10)
+        log.info("leader SIGKILLed at %d/%d pods", pods_at_kill, pods)
+    except BaseException:
+        if leader.poll() is None:
+            leader.kill()
+        raise
+    finally:
+        child_log.close()
+
+    # Standby takeover: the kernel released the dead leader's flock;
+    # load snapshot+WAL and resume reconciling from loaded state.
+    store = Store(state_dir=state_dir, takeover_wait=True)
+    standby = new_cluster(store=store)
+    client = standby.client
+    sel = {c.LABEL_PCS_NAME: "ha-deploy"}
+    loaded_pods = len(client.list(Pod, selector=sel))
+    report: dict = {
+        "pods": pods, "gangs": gangs,
+        "pods_at_kill": pods_at_kill,
+        "pods_loaded": loaded_pods,
+    }
+    with standby:
+        # Resumed = the new leader makes PROGRESS, not just loads: the
+        # first post-takeover pod create proves controllers recomputed
+        # expectations from live state and continued the deploy. When
+        # the kill raced deploy completion (every pod already created),
+        # progress means the PCS going fully Available instead.
+        if loaded_pods < pods:
+            _wait(lambda: len(client.list(Pod, selector=sel)) > loaded_pods,
+                  resume_budget_s, "post-takeover reconcile progress")
+        else:
+            _wait(lambda: client.get(PodCliqueSet, "ha-deploy")
+                  .status.available_replicas >= gangs,
+                  resume_budget_s, "post-takeover availability")
+        t_resumed = time.time()
+        report["time_to_resumed_s"] = round(t_resumed - t_kill, 3)
+        assert t_resumed - t_kill <= scaled(resume_budget_s), \
+            (f"reconcile resumed in {t_resumed - t_kill:.1f}s, budget "
+             f"{resume_budget_s}s x{TIME_SCALE:g}")
+
+        _wait(lambda: client.get(PodCliqueSet, "ha-deploy")
+              .status.available_replicas >= gangs, deploy_timeout_s,
+              "deploy completes under the new leader")
+        final = [p for p in client.list(Pod, selector=sel)
+                 if p.meta.deletion_timestamp is None]
+        assert len(final) == pods, \
+            f"{len(final)} pods after failover, expected exactly {pods}"
+
+        checker = InvariantChecker(standby)
+        violations = (checker.check_live_owner()
+                      + checker.check_no_duplicates()
+                      + checker.check_gang_binding())
+        report["violations"] = [str(v) for v in violations]
+        assert not violations, \
+            "invariants violated after failover:\n  " + "\n  ".join(
+                str(v) for v in violations)
+    report["ok"] = True
+    log.info("leader-kill OK: resumed in %.2fs, %d pods, 0 violations",
+             report["time_to_resumed_s"], pods)
+    # A green run's state dir (full WAL+snapshot of a 300-pod deploy)
+    # is just disk; a FAILED run's is evidence, so only success cleans
+    # up — on failure the assertions above raise past this point and
+    # the kept dir's path was logged at startup.
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)
+    return report

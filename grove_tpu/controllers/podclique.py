@@ -52,12 +52,40 @@ class PodCliqueReconciler:
     def __init__(self, client: Client, scheduler_registry: Registry):
         self.client = client
         self.schedulers = scheduler_registry
-        self.expectations = ExpectationsStore()
+        # Named store => grove_expectations_pending{controller="podclique"}
+        # gauge; TTL expiry (a watch event was lost — the double-create
+        # hazard's precursor, SURVEY.md §7) surfaces as a Warning event
+        # on the clique instead of staying invisible until the chaos
+        # checker trips on its consequences.
+        self.expectations = ExpectationsStore(
+            controller="podclique", on_expired=self._expectation_expired)
+        from grove_tpu.runtime.events import EventRecorder
+        self.recorder = EventRecorder(client, "podclique")
         self.log = get_logger("podclique")
         # (namespace, pod name) -> (consecutive failures, not-before
         # timestamp): the CrashLoopBackOff analog — an instantly-failing
         # workload must not respawn at full agent tick rate.
         self._crash_backoff: dict[tuple[str, str], tuple[int, float]] = {}
+
+    def _expectation_expired(self, key: str, creates: int,
+                             deletes: int) -> None:
+        """TTL-expired expectation: ``creates``/``deletes`` UIDs were
+        never observed — a lost watch event or an event lag beyond the
+        TTL. Warn on the clique so the leak is attributable before its
+        consequences (duplicate/over-deleted pods) surface."""
+        ns, _, name = key.partition("/")
+        self.log.warning("%s: expectation expired unobserved "
+                         "(creates=%d deletes=%d)", key, creates, deletes)
+        try:
+            pclq = self.client.get(PodClique, name, ns)
+        except (NotFoundError, GroveError):
+            return  # clique gone: nothing to attach the warning to
+        self.recorder.event(
+            pclq, "Warning", "ExpectationExpired",
+            f"sync expectation expired with {creates} create(s) and "
+            f"{deletes} delete(s) unobserved; a watch event was lost or "
+            "lagged past the TTL — the next sync recomputes from live "
+            "state")
 
     def reconcile(self, req: Request) -> StepResult:
         try:

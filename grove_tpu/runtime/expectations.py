@@ -5,12 +5,24 @@ reconciler issues creates/deletes, the watch cache may not reflect them on
 the next sync; acting on the stale view would double-create or over-delete.
 The reconciler records expected UIDs here and skips mutating sync passes
 until observed events have cleared them (or they time out).
+
+Observability (SURVEY.md §7 names the double-create hazard; the chaos
+harness checks its *consequences*, this surfaces the *cause*): the store
+exports ``grove_expectations_pending{controller}`` — outstanding
+unobserved create/delete UIDs — and counts TTL expiries in
+``grove_expectations_expired_total{controller}``. An expectation that
+expires instead of being observed means a watch event was lost (or the
+TTL is too tight for the fleet's event lag); before these, a leaked
+expectation was invisible until the chaos checker tripped on duplicate
+pods. The ``on_expired`` callback lets the owning reconciler attach a
+Warning event to the object whose sync window leaked.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from typing import Callable, Optional
 
 
 class _Expectation:
@@ -23,51 +35,88 @@ class _Expectation:
 
 
 class ExpectationsStore:
-    def __init__(self, ttl_seconds: float = 30.0):
+    def __init__(self, ttl_seconds: float = 30.0, controller: str = "",
+                 on_expired: Optional[Callable[[str, int, int], None]] = None):
+        """``controller`` labels the pending gauge / expiry counter;
+        ``on_expired(key, leaked_creates, leaked_deletes)`` fires (outside
+        the lock) when an expectation times out with UIDs still
+        unobserved — the hook for a Warning event on the object."""
         self._lock = threading.Lock()
         self._by_key: dict[str, _Expectation] = {}
         self._ttl = ttl_seconds
+        self.controller = controller
+        self.on_expired = on_expired
+
+    def _export_pending_locked(self) -> None:
+        if not self.controller:
+            return
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        pending = sum(len(e.creates) + len(e.deletes)
+                      for e in self._by_key.values())
+        GLOBAL_METRICS.set("grove_expectations_pending", float(pending),
+                           controller=self.controller)
 
     def expect_creates(self, key: str, uids: list[str]) -> None:
         with self._lock:
             exp = self._by_key.setdefault(key, _Expectation())
             exp.creates.update(uids)
             exp.stamp = time.time()
+            self._export_pending_locked()
 
     def expect_deletes(self, key: str, uids: list[str]) -> None:
         with self._lock:
             exp = self._by_key.setdefault(key, _Expectation())
             exp.deletes.update(uids)
             exp.stamp = time.time()
+            self._export_pending_locked()
 
     def observe_create(self, key: str, uid: str) -> None:
         with self._lock:
             exp = self._by_key.get(key)
             if exp:
                 exp.creates.discard(uid)
+                self._export_pending_locked()
 
     def observe_delete(self, key: str, uid: str) -> None:
         with self._lock:
             exp = self._by_key.get(key)
             if exp:
                 exp.deletes.discard(uid)
+                self._export_pending_locked()
 
     def satisfied(self, key: str) -> bool:
         """True when all expected events have been observed (or expired —
         expired expectations are dropped so a lost event can't wedge the
-        controller forever; the next sync recomputes from live state)."""
+        controller forever; the next sync recomputes from live state).
+        Expiry with UIDs still outstanding is the leak signal: counted,
+        and reported through ``on_expired``."""
+        leaked: tuple[int, int] | None = None
         with self._lock:
             exp = self._by_key.get(key)
             if exp is None:
                 return True
             if not exp.creates and not exp.deletes:
                 del self._by_key[key]
+                self._export_pending_locked()
                 return True
             if time.time() - exp.stamp > self._ttl:
+                leaked = (len(exp.creates), len(exp.deletes))
                 del self._by_key[key]
-                return True
-            return False
+                self._export_pending_locked()
+                if self.controller:
+                    from grove_tpu.runtime.metrics import GLOBAL_METRICS
+                    GLOBAL_METRICS.inc("grove_expectations_expired_total",
+                                       controller=self.controller)
+        if leaked is not None:
+            if self.on_expired is not None:
+                try:
+                    self.on_expired(key, *leaked)
+                except Exception:  # noqa: BLE001 — observability must
+                    pass           # never break the sync path
+            return True
+        return False
 
     def forget(self, key: str) -> None:
         with self._lock:
             self._by_key.pop(key, None)
+            self._export_pending_locked()

@@ -400,6 +400,20 @@ GLOBAL_METRICS.describe(
     "Objects per kind and status phase, fed from the shared informer "
     "caches (kube-state-metrics analog; phase empty for kinds without "
     "one)")
+# Expectation-store observability (runtime/expectations.py): the
+# informer-staleness barrier's leak detector — a pending count that
+# never drains, or any expiry, means watch events are being lost
+# (the double-create hazard's precursor, SURVEY.md §7).
+GLOBAL_METRICS.describe(
+    "grove_expectations_pending",
+    "Outstanding unobserved create/delete expectation UIDs per "
+    "controller (the informer-staleness barrier; should drain to 0 "
+    "within an event round trip)")
+GLOBAL_METRICS.describe(
+    "grove_expectations_expired_total",
+    "Expectations that expired by TTL instead of being observed per "
+    "controller — each one is a lost or badly lagged watch event "
+    "(also surfaced as an ExpectationExpired Warning event)")
 GLOBAL_METRICS.describe_histogram(
     "grove_lifecycle_phase_seconds",
     "Per-phase gang lifecycle durations (phase=create_to_gang|"

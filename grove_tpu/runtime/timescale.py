@@ -1,0 +1,34 @@
+"""The single source of timing truth for wall-clock-sensitive waits.
+
+The container's CPU shares are throttled unpredictably: identical code
+has swung the full suite 155s -> 259s (CHANGES.md PR 6), and on the
+slow-wall runs the tightest polling deadlines flaked — each passes in
+isolation; only the deadline was wrong, not the code.
+
+Every polling deadline therefore scales through ``TIME_SCALE`` at one
+chokepoint per consumer (``test_e2e_simple.wait_for`` for the test
+suite, ``chaos.invariants``/``chaos.scenario`` for the chaos harness),
+instead of each call site hand-picking a number that is right on a
+fast box and wrong on a throttled one. A scaled deadline costs nothing
+when the condition arrives early — the waiters poll, they never sleep
+the deadline out — so the default is generous.
+
+This lives in the package (not under tests/) because the chaos harness
+ships as ``grove_tpu.chaos`` and must scale its invariant deadlines
+with the same knob the tests use; ``tests/timing.py`` re-exports it so
+the test suite's import surface is unchanged.
+
+``GROVE_TEST_TIME_SCALE`` overrides it: crank it up on a known-slow
+runner, set it to 1 to reproduce a deadline-tightness flake locally.
+"""
+
+from __future__ import annotations
+
+import os
+
+TIME_SCALE = max(0.1, float(os.environ.get("GROVE_TEST_TIME_SCALE", "3.0")))
+
+
+def scaled(seconds: float) -> float:
+    """A wall-clock deadline adjusted for this machine's slowness."""
+    return seconds * TIME_SCALE

@@ -20,6 +20,7 @@ a gap raises ``WatchGoneError`` — relist and restart, kube semantics).
 from __future__ import annotations
 
 import json
+import os
 from typing import Any
 from urllib.parse import quote, urlencode
 
@@ -37,6 +38,44 @@ class WatchGoneError(GroveError):
     relist and start a fresh watch."""
 
 
+# ---- fault injection (chaos harness + tests) ---------------------------
+#
+# The 410 gap path is the hardest watch code to reach organically: the
+# server's history ring must wrap past a paused consumer's cursor. Both
+# the chaos harness (chaos/faults.py WatchGapFault) and the wire tests
+# need to force it deterministically; before this hook each did its own
+# monkeypatching of ``watch_events``. ``arm_watch_gap`` is the ONE
+# sanctioned injection point: the next N ``watch_events`` calls on the
+# armed client raise WatchGoneError exactly where a real ring gap
+# surfaces, so every consumer downstream (resumable_watch_events,
+# Reflector, remote agents) exercises its genuine recovery path.
+#
+# Env-gated: arming is a no-op raise unless GROVE_FAULT_INJECT=1, so
+# production code paths cannot trip it by accident — the flag is the
+# explicit "this process runs chaos" opt-in.
+
+FAULT_INJECT_ENV = "GROVE_FAULT_INJECT"
+
+
+def fault_injection_enabled() -> bool:
+    return os.environ.get(FAULT_INJECT_ENV, "") == "1"
+
+
+def arm_watch_gap(client: "HttpClient", gaps: int = 1) -> None:
+    """Arm ``client`` so its next ``gaps`` watch polls raise
+    WatchGoneError (the injected history-ring gap). Requires
+    GROVE_FAULT_INJECT=1 — refuses loudly otherwise so a stray call in
+    a production process cannot silently degrade its watches."""
+    if not fault_injection_enabled():
+        raise RuntimeError(
+            f"watch-gap injection requires {FAULT_INJECT_ENV}=1 "
+            "(the chaos harness opt-in); refusing to arm")
+    if gaps < 1:
+        raise ValueError(f"gaps must be >= 1, got {gaps}")
+    with client._gap_lock:
+        client._armed_gaps += gaps
+
+
 class HttpClient:
     def __init__(self, server: str, token: str = "", timeout: float = 10.0,
                  ca_file: str = ""):
@@ -49,6 +88,14 @@ class HttpClient:
         self.timeout = timeout
         self.ca_file = ca_file
         self._ssl_ctx = None
+        # Armed fault-injection gaps (see arm_watch_gap): each
+        # watch_events call consumes one and raises WatchGoneError.
+        # Lock because arming (chaos thread) races consumption (the
+        # watch consumer's thread) — an unsynchronized read-modify-
+        # write could silently lose armed gaps.
+        import threading
+        self._gap_lock = threading.Lock()
+        self._armed_gaps = 0
 
     # -- plumbing ---------------------------------------------------------
 
@@ -253,6 +300,17 @@ class HttpClient:
         for k, v in (selector or {}).items():
             params[f"l.{k}"] = v
         while True:
+            # Injected history-ring gap (arm_watch_gap), checked PER
+            # POLL: a long-lived consumer (the Reflector holds one
+            # generator for its whole life) must see a gap armed
+            # mid-stream on its next poll round — exactly where a real
+            # server 410 surfaces — not only at generator creation.
+            with self._gap_lock:
+                fire = self._armed_gaps > 0
+                if fire:
+                    self._armed_gaps -= 1
+            if fire:
+                raise WatchGoneError("injected watch gap (fault hook)")
             params["since"] = str(since)
             resp = self._request(
                 "GET", f"/watch?{urlencode(params)}",

@@ -1,0 +1,152 @@
+"""Chaos harness: fault registry/scenario sanity, seeded
+reproducibility, the expectations observability satellite, and the
+end-to-end seeded runs (slow tier — the same shapes make chaos-smoke
+and make chaos-soak gate on).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.chaos import FAULT_REGISTRY, SCENARIOS, ScenarioRunner
+from grove_tpu.runtime.expectations import ExpectationsStore
+from grove_tpu.runtime.metrics import GLOBAL_METRICS, parse_counters
+
+
+# ---- registry / scenario wiring ----------------------------------------
+
+def test_scenarios_reference_registered_faults():
+    for name, fault_names in SCENARIOS.items():
+        unknown = [f for f in fault_names if f not in FAULT_REGISTRY]
+        assert not unknown, f"scenario {name} names unknown {unknown}"
+    assert len(FAULT_REGISTRY) >= 6   # the ISSUE's fault catalog floor
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        ScenarioRunner(scenario="does-not-exist")
+
+
+def test_mix_fault_choice_is_seed_deterministic():
+    """The repro contract: the same seed replays the same fault
+    schedule (which fault types, in which order, every cycle)."""
+    def schedule(seed: int) -> list[list[str]]:
+        r = ScenarioRunner(scenario="mix", seed=seed, cycles=3)
+        return [[f.name for f in r._cycle_faults()] for _ in range(3)]
+
+    assert schedule(11) == schedule(11)
+    assert schedule(11) != schedule(12)   # and the seed actually matters
+
+
+def test_mix_cycle_draws_at_least_four_distinct_fault_types():
+    r = ScenarioRunner(scenario="mix", seed=3, cycles=1)
+    names = [f.name for f in r._cycle_faults()]
+    assert len(set(names)) >= 4
+
+
+# ---- expectations observability (satellite) -----------------------------
+
+def _pending_gauge(controller: str) -> float:
+    got = parse_counters(GLOBAL_METRICS.render(),
+                         "grove_expectations_pending")
+    return got.get((("controller", controller),), 0.0)
+
+
+def _expired_counter(controller: str) -> float:
+    got = parse_counters(GLOBAL_METRICS.render(),
+                         "grove_expectations_expired_total")
+    return got.get((("controller", controller),), 0.0)
+
+
+def test_expectations_pending_gauge_tracks_outstanding_uids():
+    store = ExpectationsStore(ttl_seconds=30.0, controller="gaugetest")
+    store.expect_creates("ns/a", ["u1", "u2"])
+    store.expect_deletes("ns/a", ["u3"])
+    assert _pending_gauge("gaugetest") == 3.0
+    store.observe_create("ns/a", "u1")
+    assert _pending_gauge("gaugetest") == 2.0
+    store.observe_create("ns/a", "u2")
+    store.observe_delete("ns/a", "u3")
+    assert store.satisfied("ns/a")
+    assert _pending_gauge("gaugetest") == 0.0
+
+
+def test_expectation_ttl_expiry_counts_and_calls_back():
+    """A TTL expiry is a LOST watch event, not housekeeping: the
+    counter moves, the owner's callback fires with what leaked, and
+    the store unblocks the controller (satisfied -> True)."""
+    leaks: list[tuple] = []
+    store = ExpectationsStore(ttl_seconds=0.05, controller="leaktest",
+                              on_expired=lambda k, cr, de:
+                              leaks.append((k, cr, de)))
+    store.expect_creates("ns/b", ["u1", "u2"])
+    store.observe_create("ns/b", "u1")
+    assert not store.satisfied("ns/b")
+    before = _expired_counter("leaktest")
+    time.sleep(0.1)
+    assert store.satisfied("ns/b")          # expired clears the barrier
+    assert leaks == [("ns/b", 1, 0)]        # exactly what leaked
+    assert _expired_counter("leaktest") == before + 1.0
+    assert _pending_gauge("leaktest") == 0.0
+    # Observed-clean keys never fire the leak path.
+    store.expect_creates("ns/c", ["u9"])
+    store.observe_create("ns/c", "u9")
+    assert store.satisfied("ns/c")
+    assert leaks == [("ns/b", 1, 0)]
+
+
+def test_podclique_reconciler_warns_on_expired_expectation():
+    """The wired path: the podclique reconciler's expiry callback lands
+    an ExpectationExpired Warning event on the clique."""
+    from grove_tpu.api import PodClique, new_meta
+    from grove_tpu.controllers.podclique import PodCliqueReconciler
+    from grove_tpu.runtime.events import events_for
+    from grove_tpu.store.client import Client
+    from grove_tpu.store.store import Store
+
+    client = Client(Store())
+    clique = client.create(PodClique(meta=new_meta("leaky")))
+    rec = PodCliqueReconciler(client, scheduler_registry=None)
+    assert rec.expectations.controller == "podclique"
+    rec._expectation_expired("default/leaky", 2, 1)
+    evs = events_for(client, "PodClique", "leaky")
+    assert len(evs) == 1
+    assert evs[0].type == "Warning"
+    assert evs[0].reason == "ExpectationExpired"
+    assert "2 create(s)" in evs[0].message
+    assert clique.meta.uid  # the event attached to the live object
+
+
+# ---- end-to-end seeded runs (slow tier) ---------------------------------
+
+@pytest.mark.slow
+@pytest.mark.timeout(500)
+def test_mix_soak_two_cycles_all_invariants_green():
+    """The make-chaos-smoke shape: 2 seeded mix cycles, >=4 fault
+    types each, every invariant green between cycles."""
+    runner = ScenarioRunner(scenario="mix", seed=7, cycles=2)
+    report = runner.run()
+    assert report["violations"] == [], report
+    assert report["cycles_ok"] == 2
+    assert len(report["fault_types_used"]) >= 4
+    assert len(report["ttr_ms"]) == 2 and all(
+        t > 0 for t in report["ttr_ms"])
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(400)
+def test_leader_kill_failover_small():
+    """The item-4 acceptance shape at test size: SIGKILL the leader
+    mid-deploy, the standby takes over via flock+lease, no orphaned or
+    duplicated pods, reconcile resumed under the (scaled) budget. The
+    300-pod version runs in make chaos-soak."""
+    from grove_tpu.chaos.scenario import run_leader_kill
+
+    report = run_leader_kill(pods=48, pods_per_gang=12,
+                             resume_budget_s=30.0)
+    assert report["ok"]
+    assert report["violations"] == []
+    assert report["pods_loaded"] <= report["pods"]
+    assert report["time_to_resumed_s"] > 0

@@ -1,0 +1,292 @@
+"""The invariant checker's own regression net: synthetic VIOLATED
+states proving each invariant can actually fail. A harness whose
+checks cannot fire rots into always-green — every invariant here is
+driven to a red verdict on a hand-built bad state, and the matching
+green state stays silent.
+
+The clusters are constructed but NOT started (no controllers run), so
+the synthetic states stay exactly as built — a running control plane
+would immediately heal most of them, which is the point of the chaos
+harness but the enemy of these tests. Admission is off for the same
+reason: some bad states (an unowned pod) are only reachable past it.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Pod,
+    PodClique,
+    PodCliqueSet,
+    PodGang,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.meta import Condition, OwnerReference, set_condition
+from grove_tpu.api.podclique import PodCliqueSpec
+from grove_tpu.api.podgang import PlacementDiagnosis
+from grove_tpu.chaos.invariants import InvariantChecker
+from grove_tpu.cluster import new_cluster
+
+
+@pytest.fixture
+def quiet_cluster():
+    """Unstarted, admission-free cluster: a store the test owns."""
+    return new_cluster(admission=False, fake_kubelet=False)
+
+
+def make_checker(cluster, **kw) -> InvariantChecker:
+    """Tight deadlines: these tests WANT the red verdict fast."""
+    defaults = dict(bind_deadline_s=0.1, owner_deadline_s=0.1,
+                    diagnosis_grace_s=0.05, diagnosis_staleness_s=0.5,
+                    gauge_deadline_s=0.1)
+    defaults.update(kw)
+    return InvariantChecker(cluster, **defaults)
+
+
+def make_pod(name: str, gang: str = "", pclq: str = "", index: str = "",
+             node: str = "", owners: list[OwnerReference] | None = None,
+             ready: bool = False) -> Pod:
+    labels = {}
+    if gang:
+        labels[c.LABEL_PODGANG_NAME] = gang
+    if pclq:
+        labels[c.LABEL_PCLQ_NAME] = pclq
+    if index:
+        labels[c.LABEL_POD_INDEX] = index
+    pod = Pod(meta=new_meta(name, labels=labels))
+    if owners:
+        pod.meta.owner_references = owners
+    if node:
+        pod.status.node_name = node
+    if ready:
+        pod.status.conditions = set_condition(
+            pod.status.conditions,
+            Condition(type=c.COND_READY, status="True"))
+    return pod
+
+
+# ---- gang-binding -------------------------------------------------------
+
+def test_forever_partial_gang_fires(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(make_pod("g-pod-0", gang="g", node="somewhere"))
+    client.create(make_pod("g-pod-1", gang="g"))   # never bound
+    found = make_checker(quiet_cluster).check_gang_binding()
+    assert len(found) == 1
+    assert found[0].invariant == "gang-binding"
+    assert "default/g" in found[0].subject
+    assert "1/2" in found[0].detail
+
+
+def test_fully_bound_and_fully_unbound_gangs_are_green(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(make_pod("a-0", gang="a", node="n1"))
+    client.create(make_pod("a-1", gang="a", node="n2"))
+    client.create(make_pod("b-0", gang="b"))
+    client.create(make_pod("b-1", gang="b"))
+    assert make_checker(quiet_cluster).check_gang_binding() == []
+
+
+# ---- live-owner ---------------------------------------------------------
+
+def test_orphan_pod_fires(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(make_pod("lost-pod"))    # no owner reference at all
+    found = make_checker(quiet_cluster).check_live_owner()
+    assert [v.invariant for v in found] == ["live-owner"]
+    assert "no controller owner" in found[0].detail
+
+
+def test_stale_owner_uid_fires(quiet_cluster):
+    """A pod whose owner NAME still exists but whose uid belongs to a
+    dead generation is an orphan wearing a mask — self-heal/cascade
+    must key on uid, and so does the invariant."""
+    client = quiet_cluster.client
+    clique = client.create(PodClique(meta=new_meta("q")))
+    pod = make_pod("q-0", owners=[OwnerReference(
+        kind="PodClique", name="q", uid=clique.meta.uid)])
+    client.create(pod)
+    # (The bare clique itself is flagged as unowned — expected; only
+    # the POD's verdict is under test here.)
+    assert [v for v in make_checker(quiet_cluster).check_live_owner()
+            if "q-0" in v.subject] == []
+    # Deleting the clique would cascade the pod away (correctly), so
+    # the leaked state is synthesized directly: the pod's owner ref
+    # decays to a dead generation's uid while a same-name clique lives.
+    live = client.get(Pod, "q-0")
+    live.meta.owner_references[0].uid = "uid-of-a-dead-generation"
+    client.update(live)
+    found = [v for v in make_checker(quiet_cluster).check_live_owner()
+             if "q-0" in v.subject]
+    assert found and "uid changed" in found[0].detail
+
+
+# ---- pending-diagnosis --------------------------------------------------
+
+def test_pending_gang_without_diagnosis_fires(quiet_cluster):
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta("stuck"))
+    gang.meta.owner_references = []     # owner check not under test
+    client.create(gang)
+    time.sleep(0.2)                     # age past the tiny grace
+    found = make_checker(quiet_cluster).check_pending_diagnosis()
+    assert [v.invariant for v in found] == ["pending-diagnosis"]
+    assert "no diagnosis" in found[0].detail
+
+
+def test_stale_diagnosis_fires_and_fresh_is_green(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(PodGang(meta=new_meta("stale")))
+    live = client.get(PodGang, "stale")
+    live.status.last_diagnosis = PlacementDiagnosis(
+        reason="ChipShortfall", last_attempt_time=time.time() - 3600.0)
+    client.update_status(live)
+    time.sleep(0.2)
+    checker = make_checker(quiet_cluster)
+    found = checker.check_pending_diagnosis()
+    assert found and "diagnosis stale" in found[0].detail
+
+    live = client.get(PodGang, "stale")
+    live.status.last_diagnosis.last_attempt_time = time.time()
+    client.update_status(live)
+    assert checker.check_pending_diagnosis() == []
+
+
+def test_scheduled_gang_needs_no_diagnosis(quiet_cluster):
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta("placed"))
+    client.create(gang)
+    live = client.get(PodGang, "placed")
+    live.status.conditions = set_condition(
+        live.status.conditions,
+        Condition(type=c.COND_SCHEDULED, status="True"))
+    client.update_status(live)
+    time.sleep(0.2)
+    assert make_checker(quiet_cluster).check_pending_diagnosis() == []
+
+
+# ---- no-duplicates ------------------------------------------------------
+
+def test_duplicate_pod_index_fires(quiet_cluster):
+    """The SURVEY §7 double-create: two live pods claiming one index of
+    one clique (and a pod count above spec) must both be caught."""
+    client = quiet_cluster.client
+    client.create(PodClique(meta=new_meta("dup"),
+                            spec=PodCliqueSpec(replicas=1)))
+    client.create(make_pod("dup-0", pclq="dup", index="0"))
+    client.create(make_pod("dup-0-again", pclq="dup", index="0"))
+    found = make_checker(quiet_cluster).check_no_duplicates()
+    kinds = sorted(v.detail.split(" ")[0] for v in found)
+    assert len(found) == 2, found
+    assert any("share index 0" in v.detail for v in found), (found, kinds)
+    assert any("exceed spec.replicas=1" in v.detail for v in found)
+
+
+def test_distinct_indices_green(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(PodClique(meta=new_meta("ok"),
+                            spec=PodCliqueSpec(replicas=2)))
+    client.create(make_pod("ok-0", pclq="ok", index="0"))
+    client.create(make_pod("ok-1", pclq="ok", index="1"))
+    assert make_checker(quiet_cluster).check_no_duplicates() == []
+
+
+# ---- gauge-consistency --------------------------------------------------
+
+def test_gauge_mismatch_fires(quiet_cluster):
+    """The checker must catch an observability plane that lies: a
+    doctored /metrics rendering disagreeing with the store."""
+    client = quiet_cluster.client
+    client.create(make_pod("real-pod"))
+    real_text = quiet_cluster.manager.metrics_text()
+    doctored = "\n".join(
+        line for line in real_text.splitlines()
+        if not (line.startswith("grove_state_objects")
+                and 'kind="Pod"' in line)
+    ) + '\ngrove_state_objects{kind="Pod",phase=""} 7\n'
+    quiet_cluster.manager.metrics_text = lambda: doctored
+    found = make_checker(quiet_cluster).check_gauge_consistency()
+    assert [v.invariant for v in found] == ["gauge-consistency"]
+    assert found[0].subject == "Pod"
+    assert "sums to 7" in found[0].detail
+
+
+def test_honest_gauges_green(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(make_pod("honest-pod"))
+    assert make_checker(quiet_cluster).check_gauge_consistency() == []
+
+
+# ---- wire-convergence ---------------------------------------------------
+
+class _StubLister:
+    def __init__(self, objs):
+        self._objs = objs
+
+    def list(self, namespace=None):
+        return self._objs
+
+
+class _StubInformer:
+    def __init__(self, objs):
+        self._lister = _StubLister(objs)
+
+    def lister(self):
+        return self._lister
+
+
+def test_diverged_wire_cache_fires(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(PodCliqueSet(meta=new_meta("present")))
+    stale_cache = _StubInformer([PodCliqueSet(meta=new_meta("ghost"))])
+    found = make_checker(quiet_cluster).check_wire_convergence(
+        {PodCliqueSet: (stale_cache, None)})
+    assert [v.invariant for v in found] == ["wire-convergence"]
+    assert "ghost" in found[0].detail and "present" in found[0].detail
+
+
+def test_converged_wire_cache_green(quiet_cluster):
+    client = quiet_cluster.client
+    pcs = client.create(PodCliqueSet(meta=new_meta("same")))
+    cache = _StubInformer([pcs])
+    assert make_checker(quiet_cluster).check_wire_convergence(
+        {PodCliqueSet: (cache, None)}) == []
+
+
+# ---- ttr-stability ------------------------------------------------------
+
+def test_ttr_collapse_fires_and_fast_jitter_does_not():
+    cluster = new_cluster(admission=False, fake_kubelet=False)
+    checker = make_checker(cluster, ttr_drift_factor=10.0,
+                           ttr_drift_floor_s=0.1)
+    checker.record_cycle_ttr([0.05])
+    checker.record_cycle_ttr([5.0])     # x100, absolutely slow
+    found = checker.check_ttr_stability()
+    assert [v.invariant for v in found] == ["ttr-stability"]
+    assert "x100.0" in found[0].detail
+
+    jitter = make_checker(cluster, ttr_drift_factor=10.0,
+                          ttr_drift_floor_s=10.0)
+    jitter.record_cycle_ttr([0.001])
+    jitter.record_cycle_ttr([0.05])     # x50 but absolutely fast
+    assert jitter.check_ttr_stability() == []
+
+
+# ---- the sweep ----------------------------------------------------------
+
+def test_empty_cluster_sweeps_green(quiet_cluster):
+    assert make_checker(quiet_cluster).sweep() == []
+
+
+def test_sweep_aggregates_multiple_invariants(quiet_cluster):
+    client = quiet_cluster.client
+    client.create(make_pod("half-0", gang="h", node="n1"))
+    client.create(make_pod("half-1", gang="h"))
+    found = make_checker(quiet_cluster).sweep()
+    names = {v.invariant for v in found}
+    # The partial gang trips binding; its unowned pods trip live-owner.
+    assert "gang-binding" in names and "live-owner" in names

@@ -238,29 +238,26 @@ def test_resumable_watch_events_recovers_from_gap(wired):
     assert got and got[-1][2].meta.name in fresh
 
 
-def test_wire_informer_reseeds_after_gap(wired):
+def test_wire_informer_reseeds_after_gap(wired, monkeypatch):
     """A wire-fed informer (Reflector over watch_events) recovers from
     WatchGoneError by relisting: the cache stays correct and current
-    instead of the agent crashing or serving a hole."""
+    instead of the agent crashing or serving a hole. The gap is forced
+    through the sanctioned fault hook (httpclient.arm_watch_gap — the
+    same injection point chaos/faults.py drives), not ad-hoc
+    monkeypatching, so the raise surfaces exactly where a real server
+    410 does."""
     from grove_tpu.runtime.informer import wire_informer
+    from grove_tpu.store.httpclient import FAULT_INJECT_ENV, arm_watch_gap
 
     cl, base = wired
+    monkeypatch.setenv(FAULT_INJECT_ENV, "1")
     http = HttpClient(base, token="tok-op")
-    real = http.watch_events
-    state = {"raised": False}
-
-    def flaky(*a, **kw):
-        if not state["raised"]:
-            state["raised"] = True
-            raise WatchGoneError("history gone")
-        return real(*a, **kw)
-
-    http.watch_events = flaky
+    arm_watch_gap(http)
     cl.client.create(pcs("w0"))
     inf, refl = wire_informer(http, PodCliqueSet, poll_timeout=2.0)
     refl.start()  # seed relist sees w0; first watch attempt 410s
     try:
-        wait_for(lambda: state["raised"] and inf.relists >= 2,
+        wait_for(lambda: http._armed_gaps == 0 and inf.relists >= 2,
                  timeout=10.0, desc="gap reseed happened")
         assert inf.lister().get("w0") is not None
         cl.client.create(pcs("w1"))  # flows through the resumed watch
@@ -269,6 +266,72 @@ def test_wire_informer_reseeds_after_gap(wired):
         assert len(inf) == 2
     finally:
         refl.stop()
+
+
+def test_watch_gap_fires_against_running_consumer(wired, monkeypatch):
+    """Arming AFTER the consumer is already mid-stream must still
+    fire: the check lives inside the poll loop (a Reflector holds one
+    watch generator for its whole life — a creation-time-only check
+    would make mid-soak injection a silent no-op)."""
+    from grove_tpu.runtime.informer import wire_informer
+    from grove_tpu.store.httpclient import FAULT_INJECT_ENV, arm_watch_gap
+
+    cl, base = wired
+    monkeypatch.setenv(FAULT_INJECT_ENV, "1")
+    http = HttpClient(base, token="tok-op")
+    inf, refl = wire_informer(http, PodCliqueSet, poll_timeout=1.0)
+    refl.start()
+    try:
+        wait_for(lambda: inf.relists >= 1, timeout=10.0,
+                 desc="seed relist")
+        arm_watch_gap(http)   # the long-lived generator is already live
+        wait_for(lambda: http._armed_gaps == 0 and inf.relists >= 2,
+                 timeout=10.0, desc="mid-stream gap consumed + reseed")
+        cl.client.create(pcs("after-midstream-gap"))
+        wait_for(lambda: inf.lister().get("after-midstream-gap")
+                 is not None, timeout=10.0, desc="watch resumed")
+    finally:
+        refl.stop()
+
+
+def test_watch_gap_hook_env_gated(wired, monkeypatch):
+    """The injection hook is an explicit chaos opt-in: arming without
+    GROVE_FAULT_INJECT=1 refuses loudly, and an armed gap raises from
+    the watch exactly once per poll before normal service resumes."""
+    from grove_tpu.store.httpclient import FAULT_INJECT_ENV, arm_watch_gap
+
+    cl, base = wired
+    http = HttpClient(base, token="tok-op")
+    monkeypatch.delenv(FAULT_INJECT_ENV, raising=False)
+    with pytest.raises(RuntimeError, match="GROVE_FAULT_INJECT"):
+        arm_watch_gap(http)
+    assert http._armed_gaps == 0
+
+    monkeypatch.setenv(FAULT_INJECT_ENV, "1")
+    arm_watch_gap(http)
+    with pytest.raises(WatchGoneError, match="injected"):
+        next(http.watch_events(poll_timeout=1.0))
+    # One-shot: the next watch poll is clean again. The consumer
+    # bootstraps at the CURRENT rv, so keep creating fresh objects
+    # until one lands after its bootstrap — a single timed create
+    # races the bootstrap on a throttled box.
+    assert http._armed_gaps == 0
+    gen = http.watch_events(kinds=["PodCliqueSet"], poll_timeout=5.0)
+    got: list = []
+    done = threading.Event()
+
+    def consume():
+        got.append(next(gen))
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for j in range(40):
+        cl.client.create(pcs(f"post-gap-{j}"))
+        if done.wait(0.5):
+            break
+    t.join(10.0)
+    assert got and got[0][2].meta.name.startswith("post-gap")
 
 
 def test_watch_driven_remote_agent(wired, tmp_path):

@@ -1,29 +1,13 @@
-"""The single source of timing truth for wall-clock-sensitive waits.
+"""Test-suite twin of the timing chokepoint.
 
-The container's CPU shares are throttled unpredictably: identical code
-has swung the full suite 155s -> 259s (CHANGES.md PR 6), and on the
-slow-wall runs the tightest ``wait_for`` deadlines in the
-availability / gang-scheduling / trace tests flaked — each passes in
-isolation; only the deadline was wrong, not the code.
-
-Every polling deadline therefore scales through ``TIME_SCALE`` at ONE
-chokepoint (``test_e2e_simple.wait_for`` multiplies by it), instead of
-each test hand-picking a number that is right on a fast box and wrong
-on a throttled one. A scaled deadline costs nothing when the condition
-arrives early — ``wait_for`` polls, it never sleeps the deadline out —
-so the default is generous.
-
-``GROVE_TEST_TIME_SCALE`` overrides it: crank it up on a known-slow
-runner, set it to 1 to reproduce a deadline-tightness flake locally.
+The authoritative definition moved to ``grove_tpu.runtime.timescale``
+so the chaos harness (package code, ``grove_tpu/chaos``) scales its
+invariant deadlines with the same knob; this module re-exports it so
+every test keeps importing ``from timing import TIME_SCALE`` unchanged.
+See that module's docstring for the why (CPU-share-throttled runner,
+GROVE_TEST_TIME_SCALE override).
 """
 
 from __future__ import annotations
 
-import os
-
-TIME_SCALE = max(0.1, float(os.environ.get("GROVE_TEST_TIME_SCALE", "3.0")))
-
-
-def scaled(seconds: float) -> float:
-    """A wall-clock deadline adjusted for this machine's slowness."""
-    return seconds * TIME_SCALE
+from grove_tpu.runtime.timescale import TIME_SCALE, scaled  # noqa: F401

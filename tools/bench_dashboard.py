@@ -48,8 +48,14 @@ def render(rows: list[dict]) -> str:
                if r.get("metric") == "serving_ttft_p99_ms"]
     serving_tok = [r for r in rows
                    if r.get("metric") == "serving_tokens_per_sec"]
+    chaos = [r for r in rows if r.get("metric") == "chaos_cycles_ok"]
+    chaos_drift = {(r.get("ts"), r.get("seed")): r.get("value")
+                   for r in rows
+                   if r.get("metric") == "chaos_ttr_p99_drift"}
+    leader_kills = [r for r in rows
+                    if r.get("metric") == "chaos_leader_kill_resume_s"]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
-                "serving-cpu"}
+                "serving-cpu", "chaos-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes]
     failed = [r for r in rows if r.get("value", 0) <= 0]
@@ -104,6 +110,45 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
                 f"| {r.get('value', 0):.0f} | {reasons} "
                 f"| {r.get('pending_s', 0):.1f} |")
+        out.append("")
+    if chaos:
+        out += ["## Chaos soak (fault mix + gang invariants)", "",
+                "_seeded fault mixes (tools/chaos_soak.py) with every "
+                "gang invariant swept between cycles; drift is "
+                "last-cycle time-to-ready p99 over cycle 1's "
+                "(docs/design/chaos-harness.md)_", "",
+                "| when | git | scenario | seed | cycles ok | fault "
+                "types | ttr p50 ms | ttr p99 ms | p99 drift | "
+                "violations |",
+                "|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(chaos, key=lambda r: r.get("ts", "")):
+            drift = chaos_drift.get((r.get("ts"), r.get("seed")),
+                                    r.get("ttr_p99_drift", "-"))
+            n_faults = len(r.get("fault_types") or [])
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('scenario', '?')} | {r.get('seed', '?')} "
+                f"| {r.get('value', 0):.0f}/{r.get('cycles', '?')} "
+                f"| {n_faults} "
+                f"| {r.get('ttr_p50_ms', 0):.0f} "
+                f"| {r.get('ttr_p99_ms', 0):.0f} "
+                f"| {drift if isinstance(drift, str) else f'{drift:.2f}'} "
+                f"| {r.get('violations', 0)} |")
+        out.append("")
+    if leader_kills:
+        out += ["## Leader-kill failover (HA acceptance, proposal 0002)",
+                "",
+                "_SIGKILL the manager mid-deploy; the standby takes over "
+                "via the flock+lease path — time to first post-takeover "
+                "reconcile progress_", "",
+                "| when | git | pods | killed at | resume s | "
+                "violations |", "|---|---|---|---|---|---|"]
+        for r in sorted(leader_kills, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('pods', '?')} | {r.get('pods_at_kill', '?')} "
+                f"| {r.get('value', 0):.2f} "
+                f"| {r.get('violations', 0)} |")
         out.append("")
     if serving:
         out += ["## Serving SLO loop (load-gen ramp, CPU engine)", "",

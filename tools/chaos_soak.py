@@ -1,0 +1,185 @@
+"""Chaos/soak front door — fault-injection scenarios with gang-invariant
+checking (grove_tpu/chaos, docs/design/chaos-harness.md).
+
+    python tools/chaos_soak.py --mix --seed 7 --cycles 5
+    python tools/chaos_soak.py --scenario preemption-storm --cycles 3
+    python tools/chaos_soak.py --scenario leader-kill --pods 300
+    python tools/chaos_soak.py --list
+
+The ``make ci`` gate is ``make chaos-smoke`` (a short fixed-seed mix);
+``make chaos-soak`` is the long run. A seed + the git rev is a full
+repro command: every fault choice, target, and stagger flows from the
+seed (wall-clock interleaving still varies — the seed pins the abuse).
+
+On an invariant violation the run dumps the live cluster's diagnostics
+bundle (tests/diagnostics.collect_cluster — the same on-failure bundle
+the e2e tiers write) under ``--diag-dir`` and exits 1.
+
+``--history`` appends two rows to bench-history/history.jsonl:
+``chaos_cycles_ok`` (cycles survived, fault mix, time-to-ready
+percentiles) and ``chaos_ttr_p99_drift`` (last-cycle p99 over
+first-cycle p99 — the soak's degradation signal), rendered by the
+chaos section of tools/bench_dashboard.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _dump_fn(diag_dir: str):
+    """On-violation diagnostics: reuse the e2e bundle collector so a
+    chaos failure leaves the same evidence a failing e2e test does."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        from diagnostics import collect_cluster
+    except ImportError:
+        collect_cluster = None
+
+    def dump(cluster) -> None:
+        outdir = os.path.join(diag_dir,
+                              f"chaos-{time.strftime('%Y%m%d-%H%M%S')}")
+        if collect_cluster is None:
+            os.makedirs(outdir, exist_ok=True)
+            with open(os.path.join(outdir, "metrics.txt"), "w") as f:
+                f.write(cluster.manager.metrics_text())
+            print(f"diagnostics (minimal) -> {outdir}", file=sys.stderr)
+            return
+        counts = collect_cluster(cluster, outdir, test_name="chaos-soak")
+        print(f"diagnostics bundle -> {outdir} "
+              f"({sum(counts.values())} objects)", file=sys.stderr)
+
+    return dump
+
+
+def _append_history(report: dict) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench_sched import append_history
+    append_history({
+        "metric": "chaos_cycles_ok",
+        "value": float(report["cycles_ok"]),
+        "unit": "cycles",
+        "cycles": report["cycles"],
+        "scenario": report["scenario"],
+        "seed": report["seed"],
+        "fault_types": report["fault_types_used"],
+        "ttr_p50_ms": report["ttr_p50_ms"],
+        "ttr_p99_ms": report["ttr_p99_ms"],
+        "ttr_p99_drift": report["ttr_p99_drift"],
+        "violations": len(report["violations"]),
+        "mode": "chaos-cpu",
+    })
+    append_history({
+        "metric": "chaos_ttr_p99_drift",
+        "value": report["ttr_p99_drift"],
+        "unit": "ratio",
+        "cycles": report["cycles"],
+        "scenario": report["scenario"],
+        "seed": report["seed"],
+        "mode": "chaos-cpu",
+    })
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="chaos-soak",
+        description="fault-injection scenarios with gang-invariant "
+                    "checking")
+    parser.add_argument("--scenario", default=None,
+                        help="named scenario (see --list), or leader-kill")
+    parser.add_argument("--mix", action="store_true",
+                        help="randomized soak: a seeded sample of >=4 "
+                             "fault types per cycle")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="RNG seed — the repro handle")
+    parser.add_argument("--cycles", type=int, default=5,
+                        help="compressed soak cycles (default 5)")
+    parser.add_argument("--slices", type=int, default=6,
+                        help="fleet size in 2x4 slices (default 6)")
+    parser.add_argument("--pods", type=int, default=300,
+                        help="leader-kill only: deploy size (default 300)")
+    parser.add_argument("--resume-budget", type=float, default=30.0,
+                        help="leader-kill only: seconds (pre-TIME_SCALE) "
+                             "for reconcile to resume after the kill")
+    parser.add_argument("--drift-factor", type=float, default=10.0,
+                        help="max allowed ttr p99 drift across cycles")
+    parser.add_argument("--history", action="store_true",
+                        help="append chaos rows to bench-history")
+    parser.add_argument("--diag-dir",
+                        default=os.path.join(REPO, "test-diagnostics"),
+                        help="where violation bundles are dumped")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and fault types")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu.chaos import FAULT_REGISTRY, SCENARIOS, ScenarioRunner
+    from grove_tpu.chaos.scenario import run_leader_kill
+
+    if args.list:
+        print("scenarios:")
+        for name, fault_names in sorted(SCENARIOS.items()):
+            print(f"  {name:18s} {', '.join(fault_names)}")
+        print("  mix                seeded sample of >=4 fault types "
+              "per cycle")
+        print("  leader-kill        SIGKILL the manager mid-deploy; "
+              "standby takes over")
+        print("fault types:", ", ".join(sorted(FAULT_REGISTRY)))
+        return 0
+
+    if args.scenario == "leader-kill":
+        report = run_leader_kill(pods=args.pods,
+                                 resume_budget_s=args.resume_budget)
+        print(json.dumps(report, indent=2))
+        if args.history:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from bench_sched import append_history
+            append_history({
+                "metric": "chaos_leader_kill_resume_s",
+                "value": report["time_to_resumed_s"],
+                "unit": "s",
+                "scenario": "leader-kill",
+                "pods": report["pods"],
+                "pods_at_kill": report["pods_at_kill"],
+                "violations": len(report["violations"]),
+                "mode": "chaos-cpu",
+            })
+        print(f"leader-kill OK: reconcile resumed in "
+              f"{report['time_to_resumed_s']}s "
+              f"(killed at {report['pods_at_kill']}/{report['pods']} "
+              f"pods), {report['pods']} pods exact, 0 violations")
+        return 0
+
+    if not args.mix and not args.scenario:
+        parser.error("pick --mix, --scenario NAME, or --list")
+    scenario = "mix" if args.mix else args.scenario
+    runner = ScenarioRunner(scenario=scenario, seed=args.seed,
+                            cycles=args.cycles, slices=args.slices,
+                            ttr_drift_factor=args.drift_factor,
+                            dump_fn=_dump_fn(args.diag_dir))
+    report = runner.run()
+    print(json.dumps(report, indent=2))
+    if args.history:
+        _append_history(report)
+    if report["violations"] or report["cycles_ok"] < args.cycles:
+        print(f"CHAOS FAIL: {report['cycles_ok']}/{args.cycles} cycles "
+              f"ok; violations:\n  "
+              + "\n  ".join(report["violations"]), file=sys.stderr)
+        return 1
+    print(f"chaos soak OK: {report['cycles_ok']}/{args.cycles} cycles, "
+          f"faults={','.join(report['fault_types_used'])}, "
+          f"ttr p50={report['ttr_p50_ms']:.0f}ms "
+          f"p99={report['ttr_p99_ms']:.0f}ms "
+          f"drift x{report['ttr_p99_drift']:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
