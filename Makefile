@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile docs native lint clean ci render-deploy chaos-smoke chaos-soak
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -65,6 +65,14 @@ bench-reconcile: ## controller reconcile p50/p99 + store-scan/write counts (CPU 
 	@# bench-history/history.jsonl.
 	$(PY) tools/bench_reconcile.py --compare
 
+bench-defrag:    ## defrag-on vs defrag-off churn bench (CPU only)
+	@# The defragmentation engine's proof (docs/design/defrag.md):
+	@# seeded arrivals+departures fragment a fixed fleet; slice-packed
+	@# probe gangs only place when the planner migrates fillers. Appends
+	@# defrag_placeable_per_1k_chips rows to bench-history/history.jsonl;
+	@# exit 1 unless defrag-on strictly beats defrag-off.
+	$(PY) tools/bench_defrag.py --history
+
 bench-serving:   ## SLO-driven autoscaling under a 4x traffic ramp (CPU only)
 	@# The serving telemetry plane's proof: open-loop Poisson load
 	@# (tools/loadgen.py) against the tiny CPU engine, TTFT p99 breach
@@ -115,6 +123,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# batched /metrics/push -> ServingObserver -> /debug/serving
 	@# renders with the SLO judged against the autoscaling target.
 	$(PY) tools/serving_smoke.py
+	@# defrag smoke: one fragmented 2-slice fleet -> migration plan ->
+	@# hold/drain/rebind -> the stuck gang schedules, the Fragmented
+	@# gauge drops, holds release (docs/design/defrag.md).
+	$(PY) tools/defrag_smoke.py
 	@# chaos smoke: 2 fixed-seed mix cycles (>=4 fault types each) with
 	@# the full gang-invariant sweep between cycles — the regression net
 	@# that lets the control plane refactor aggressively (ROADMAP 5).

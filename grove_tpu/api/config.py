@@ -134,6 +134,32 @@ class AutoscalerConfig:
 
 
 @dataclasses.dataclass
+class DefragConfig:
+    """Active placement repair (grove_tpu/defrag): a background planner
+    that migrates placed gangs to consolidate fragmented free capacity
+    when an unschedulable gang's explain diagnosis proves defrag would
+    seat it. ``enabled`` gates the manager runnable; the GROVE_DEFRAG
+    env var (read live, default on) is the incident kill switch for the
+    whole subsystem including roll-safe holds."""
+
+    enabled: bool = True
+    sync_period_seconds: float = 0.5
+    # Disruption budget: at most this many pods evicted for migrations
+    # inside any budget window — defrag must repair fragmentation, not
+    # become churn itself.
+    disruption_budget_pods: int = 8
+    budget_window_seconds: float = 30.0
+    # Rate limit: minimum gap between migration starts (one migration
+    # in flight at a time regardless).
+    cooldown_seconds: float = 1.0
+    # Hold lifecycle (pre-TIME_SCALE seconds): reservation TTL backstop,
+    # bind wait, and reland wait before the executor aborts + releases.
+    hold_ttl_seconds: float = 60.0
+    hold_timeout_seconds: float = 5.0
+    rebind_timeout_seconds: float = 30.0
+
+
+@dataclasses.dataclass
 class OperatorConfiguration:
     concurrency: ControllerConcurrency = dataclasses.field(
         default_factory=ControllerConcurrency)
@@ -153,6 +179,7 @@ class OperatorConfiguration:
         default_factory=ServerTlsConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
+    defrag: DefragConfig = dataclasses.field(default_factory=DefragConfig)
     node_lifecycle: NodeLifecycleConfig = dataclasses.field(
         default_factory=NodeLifecycleConfig)
     profiling: ProfilingConfig = dataclasses.field(
@@ -236,6 +263,23 @@ def validate_config(cfg: OperatorConfiguration) -> list[str]:
         errs.append("server_tls mode 'byo' requires cert_file and key_file")
     if tls.enabled and tls.mode == "self-managed" and not tls.sans:
         errs.append("server_tls.sans must not be empty")
+    if cfg.defrag.sync_period_seconds <= 0:
+        errs.append("defrag.sync_period_seconds must be > 0, got "
+                    f"{cfg.defrag.sync_period_seconds}")
+    if cfg.defrag.disruption_budget_pods < 1:
+        errs.append("defrag.disruption_budget_pods must be >= 1, got "
+                    f"{cfg.defrag.disruption_budget_pods}")
+    if cfg.defrag.budget_window_seconds <= 0:
+        errs.append("defrag.budget_window_seconds must be > 0, got "
+                    f"{cfg.defrag.budget_window_seconds}")
+    if cfg.defrag.cooldown_seconds < 0:
+        errs.append("defrag.cooldown_seconds must be >= 0, got "
+                    f"{cfg.defrag.cooldown_seconds}")
+    for knob in ("hold_ttl_seconds", "hold_timeout_seconds",
+                 "rebind_timeout_seconds"):
+        if getattr(cfg.defrag, knob) <= 0:
+            errs.append(f"defrag.{knob} must be > 0, got "
+                        f"{getattr(cfg.defrag, knob)}")
     if cfg.node_lifecycle.grace_seconds <= 0:
         errs.append("node_lifecycle.grace_seconds must be > 0, got "
                     f"{cfg.node_lifecycle.grace_seconds}")

@@ -42,6 +42,20 @@ NODE_LABEL_HOST = "kubernetes.io/hostname"
 # reservation controller; pods carrying the matching node_selector are the
 # ONLY pods placement admits onto such nodes (placement._selector_matches).
 LABEL_RESERVATION = f"{DOMAIN}/reservation"
+# Capacity-hold back-pointer: a SliceReservation created as a defrag
+# migration hold or a roll-safe hold names the PodGang it protects here;
+# the reservation controller GCs holds whose gang is gone and the chaos
+# defrag-holds invariant checks the pointer stays live both ways.
+LABEL_HOLD_FOR_GANG = f"{DOMAIN}/hold-for-gang"
+
+# ---- annotations ----
+# The ReuseReservationRef wiring (reference podgang.go:65-71 made live):
+# names the SliceReservation a gang currently holds — set by the defrag
+# executor (migration target hold) or the rolling-update path (roll-safe
+# slot hold). The gang scheduler resolves it to a bound slice, constrains
+# the gang's pending pods to the reserved hosts, and mirrors the value
+# into PodGang.status.reuse_reservation_ref for the read surfaces.
+ANNOTATION_RESERVATION_REF = f"{DOMAIN}/reuse-reservation-ref"
 
 # ---- env vars injected into workload pods ----
 ENV_PCS_NAME = "GROVE_PCS_NAME"

@@ -112,6 +112,13 @@ class PodGangStatus:
     placement_score: float = 0.0
     # chosen placement: slice name per group pod, filled by the scheduler
     assigned_slice: str = ""
+    # The SliceReservation this gang currently holds (defrag migration
+    # target or roll-safe slot hold) — the live ReuseReservationRef
+    # (reference podgang.go:140-190). Mirrored from the gang's
+    # reuse-reservation-ref annotation by the scheduler (single status
+    # writer); "" when the gang holds nothing. Surfaced by grovectl get
+    # (RESERVATION column) and grovectl explain.
+    reuse_reservation_ref: str = ""
     # Placement explainability: present while the gang is unschedulable
     # (scheduler clears it on successful schedule).
     last_diagnosis: PlacementDiagnosis | None = None

@@ -57,6 +57,22 @@ class SliceReservationSpec:
     generation: str = ""
     topology: str = ""
     slice_count: int = 1
+    # Explicit pinned slices (defrag migration targets, roll-safe slot
+    # holds): when non-empty the controller binds exactly these slices —
+    # occupied or not — instead of hunting free shape-matching ones.
+    # The fence still applies (only consumers place onto them); existing
+    # bound pods are untouched (the fence gates NEW placement only).
+    slices: list[str] = dataclasses.field(default_factory=list)
+    # Free-chip requirement gating the bind of an explicit slice: the
+    # hold is useless if the target's headroom was eaten between plan
+    # and hold, so binding waits until the slice has >= this many free
+    # chips (0 = no requirement; roll holds guard an occupied slot).
+    chips: int = 0
+    # Hold lifetime: the controller deletes the reservation this many
+    # seconds after creation (0 = never). Mandatory for holds — an
+    # aborted migration or crashed holder must not strand a fenced
+    # slice (proposal 0001's stranded-capacity mitigation).
+    ttl_seconds: float = 0.0
 
 
 class ReservationPhase(str, enum.Enum):

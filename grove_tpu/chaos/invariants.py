@@ -23,6 +23,9 @@ The invariants (ISSUE 8 / reference GS1-GS10 analog):
                        (the observability plane never lies)
 - **wire-convergence** wire informer caches match the store after
                        gap injection (410 recovery is complete)
+- **defrag-holds**     no dangling capacity hold: every defrag/roll
+                       SliceReservation names a live gang that still
+                       references it (leaked holds fence slices)
 - **ttr-stability**    time-to-ready p99 stays within a drift factor
                        of the first cycle's (no degradation across
                        cycles — the soak signal)
@@ -42,6 +45,7 @@ from grove_tpu.api import (
     PodCliqueScalingGroup,
     PodCliqueSet,
     PodGang,
+    SliceReservation,
     constants as c,
 )
 from grove_tpu.api.meta import is_condition_true
@@ -291,6 +295,63 @@ class InvariantChecker:
 
         return _poll_until_empty(probe, self.gauge_deadline)
 
+    def check_defrag_holds(self) -> list[Violation]:
+        """Capacity holds never dangle: every SliceReservation created
+        as a defrag migration hold or roll-safe slot hold (the
+        hold-for-gang label) must (a) protect a gang that still exists
+        and (b) be the reservation that gang's reuse-reservation-ref
+        annotation names. A hold that outlives either pointer fences a
+        slice nobody will ever unfence — capacity leaked until the TTL
+        backstop, invisible to the gang it was taken for."""
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            reservations = self.client.list(SliceReservation,
+                                            self.namespace)
+            live = {(r.meta.namespace, r.meta.name) for r in reservations}
+            for rsv in reservations:
+                if rsv.meta.deletion_timestamp is not None:
+                    continue
+                gname = rsv.meta.labels.get(c.LABEL_HOLD_FOR_GANG)
+                if not gname:
+                    continue    # PCS-template reservations: not holds
+                key = (f"SliceReservation "
+                       f"{rsv.meta.namespace}/{rsv.meta.name}")
+                try:
+                    gang = self.client.get(PodGang, gname,
+                                           rsv.meta.namespace)
+                except NotFoundError:
+                    out.append(Violation(
+                        "defrag-holds", key,
+                        f"protected gang {gname} is gone but the hold "
+                        "still fences its slices"))
+                    continue
+                ref = gang.meta.annotations.get(
+                    c.ANNOTATION_RESERVATION_REF, "")
+                if ref != rsv.meta.name:
+                    out.append(Violation(
+                        "defrag-holds", key,
+                        f"gang {gname} references {ref!r}, not this "
+                        "hold — it will never be consumed or released"))
+            # The reverse pointer: a gang whose annotation names a
+            # reservation that no longer exists stays pinned-looking on
+            # every surface and defrag-ineligible forever (the TTL
+            # expiry path clears it; persisting is a leak).
+            for gang in self.client.list(PodGang, self.namespace):
+                if gang.meta.deletion_timestamp is not None:
+                    continue
+                ref = gang.meta.annotations.get(
+                    c.ANNOTATION_RESERVATION_REF, "")
+                if ref and (gang.meta.namespace, ref) not in live:
+                    out.append(Violation(
+                        "defrag-holds",
+                        f"PodGang {gang.meta.namespace}/{gang.meta.name}",
+                        f"reuse-reservation-ref {ref!r} names a "
+                        "reservation that no longer exists"))
+            return out
+
+        return _poll_until_empty(probe, self.owner_deadline)
+
     def check_wire_convergence(
             self, wire_informers: dict | None) -> list[Violation]:
         """After watch-gap injection the wire informers must hold
@@ -368,6 +429,7 @@ class InvariantChecker:
         out += self.check_live_owner()
         out += self.check_no_duplicates()
         out += self.check_pending_diagnosis()
+        out += self.check_defrag_holds()
         out += self.check_gauge_consistency()
         out += self.check_wire_convergence(wire_informers)
         if include_ttr:

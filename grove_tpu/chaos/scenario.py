@@ -36,7 +36,7 @@ import random
 import statistics
 import time
 
-from grove_tpu.api import Pod, PodCliqueSet, constants as c, new_meta
+from grove_tpu.api import Node, Pod, PodCliqueSet, constants as c, new_meta
 from grove_tpu.api.core import ContainerSpec
 from grove_tpu.api.meta import is_condition_true, trace_id_of
 from grove_tpu.api.podcliqueset import (
@@ -93,18 +93,17 @@ def _workload_pcs(name: str, autoscale_metric: str,
             topology=POOL,
             startup_type=StartupType.ANY_ORDER,
             cliques=[
-                # PREFERRED slice pack (required=False), not the hard
-                # constraint: this clique rolls pod-by-pod under chaos,
-                # and a hard pack can wedge forever when another gang's
-                # replacement lands in the freed slot mid-roll — the
-                # harness found exactly that (StragglerUnplaced deadlock;
-                # the defragmenter that would fix it is ROADMAP item 2,
-                # see docs/design/chaos-harness.md). The probe gangs
-                # keep required=True: they deploy and delete atomically.
+                # REQUIRED slice pack again (the PR 8 wedge is fixed):
+                # this clique rolls pod-by-pod under chaos, and the
+                # roll-safe slot hold (grove_tpu/defrag) now fences the
+                # freed slot so the replacement relands in place instead
+                # of wedging as a forever-StragglerUnplaced when another
+                # gang's replacement lands there mid-roll. The soak
+                # proves the hold works under composed faults; the
+                # dedicated repro is run_roll_wedge below.
                 PodCliqueTemplate(name="steady", replicas=2,
                                   min_available=1, tpu_chips_per_pod=4,
-                                  topology=TopologyConstraint(
-                                      pack_level="slice", required=False),
+                                  topology=SLICE,
                                   container=ContainerSpec(
                                       argv=["sleep", "inf"])),
                 PodCliqueTemplate(name="elastic", replicas=1,
@@ -470,6 +469,188 @@ class ScenarioRunner:
                 InvariantChecker._p99(ttrs) * 1e3, 1) if ttrs else 0.0,
             "ttr_p99_drift": round(self.checker.ttr_drift(), 3),
         }
+
+
+# ---- roll-wedge: the PR 8 scheduling-wedge repro ------------------------
+
+
+def _wedge_pcs(name: str, pods: int, chips: int,
+               required: bool = True,
+               min_available: int | None = None) -> PodCliqueSet:
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            startup_type=StartupType.ANY_ORDER,
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=pods,
+                min_available=(pods if min_available is None
+                               else min_available),
+                tpu_chips_per_pod=chips,
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=required),
+                container=ContainerSpec(argv=["sleep", "inf"]))])))
+
+
+def run_roll_wedge(defrag_on: bool = True, attempts: int = 3,
+                   converge_s: float = 30.0) -> dict:
+    """Reproduce the PR 8 roll-wedge through public surfaces and assert
+    the defrag subsystem's verdict on it.
+
+    Shape: a full 2-slice fleet — a REQUIRED slice-packed 2-pod gang
+    ("wedge") owning slice A, a same-shaped blocker owning slice B, and
+    a pending 1-pod gang ("squat", preferred pack) waiting for any free
+    chips. A pod-level rolling update of the wedge gang then frees one
+    slot per replaced pod — the exact window where, pre-defrag, the
+    squat landed and the returning straggler deadlocked forever
+    (StragglerUnplaced, docs/design/chaos-harness.md).
+
+    ``defrag_on=True``: asserts the roll-safe slot hold keeps the slot
+    fenced and the roll CONVERGES within the scaled deadline — every
+    wedge pod back at the new hash, Ready, on one slice, no straggler
+    diagnosis, hold released.
+
+    ``defrag_on=False`` (GROVE_DEFRAG=0): asserts today's pre-defrag
+    behavior is restored exactly — the wedge REPRODUCES within
+    ``attempts`` rolls (the squat wins the freed slot and the wedge
+    gang sticks as StragglerUnplaced). The race is real, so each
+    attempt re-rolls until one wedges.
+    """
+    from grove_tpu.api import PodGang, SliceReservation
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.defrag import DEFRAG_ENV
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    log = get_logger("chaos.roll-wedge")
+    prev_env = os.environ.get(DEFRAG_ENV)
+    os.environ[DEFRAG_ENV] = "1" if defrag_on else "0"
+    cfg = OperatorConfiguration()
+    cfg.defrag.sync_period_seconds = 0.1
+    cfg.defrag.cooldown_seconds = 0.0
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=2)]))
+    report: dict = {"defrag_on": defrag_on}
+    try:
+        with cluster:
+            client = cluster.client
+
+            def pods_of(name: str) -> list:
+                return [p for p in client.list(
+                    Pod, selector={c.LABEL_PCS_NAME: name})
+                    if p.meta.deletion_timestamp is None]
+
+            def all_ready(name: str, n: int, hash_: str | None = None
+                          ) -> bool:
+                ps = pods_of(name)
+                return (len(ps) == n
+                        and all(p.status.node_name for p in ps)
+                        and all(is_condition_true(p.status.conditions,
+                                                  c.COND_READY)
+                                for p in ps)
+                        and (hash_ is None or all(
+                            p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH)
+                            == hash_ for p in ps)))
+
+            # Fill the fleet: wedge owns slice A, blocker owns slice B.
+            client.create(_wedge_pcs("wedge", pods=2, chips=4,
+                                     min_available=1))
+            client.create(_wedge_pcs("blocker", pods=2, chips=4))
+            _wait(lambda: all_ready("wedge", 2) and all_ready("blocker", 2),
+                  30.0, "wedge + blocker gangs up (fleet full)")
+
+            # The squatter: pending on a full fleet, wakes on any freed
+            # chip (preferred pack — it takes whatever opens up).
+            client.create(_wedge_pcs("squat", pods=1, chips=4,
+                                     required=False))
+            _wait(lambda: any(
+                g.status.last_diagnosis is not None
+                for g in client.list(PodGang,
+                                     selector={c.LABEL_PCS_NAME: "squat"})),
+                15.0, "squat gang pending with a diagnosis")
+
+            def wedge_gang() -> "PodGang":
+                return client.list(
+                    PodGang, selector={c.LABEL_PCS_NAME: "wedge"})[0]
+
+            def roll(generation: int) -> str:
+                from grove_tpu.controllers.expected import generation_hash
+                for _ in range(10):
+                    try:
+                        pcs = client.get(PodCliqueSet, "wedge")
+                        for t in pcs.spec.template.cliques:
+                            t.container.env["WEDGE_ROLL"] = str(generation)
+                        return generation_hash(client.update(pcs))
+                    except GroveError:
+                        time.sleep(0.05)
+                raise AssertionError("wedge roll edit kept conflicting")
+
+            if defrag_on:
+                target = roll(1)
+                t0 = time.time()
+                _wait(lambda: all_ready("wedge", 2, target), converge_s,
+                      "required-pack roll to converge (no wedge)")
+                gang = wedge_gang()
+                diag = gang.status.last_diagnosis
+                assert diag is None or diag.reason != "StragglerUnplaced", \
+                    f"roll converged but straggler diagnosis stuck: {diag}"
+                slices = {client.get(Node, p.status.node_name)
+                          .meta.labels[c.NODE_LABEL_SLICE]
+                          for p in pods_of("wedge")}
+                assert len(slices) == 1, \
+                    f"wedge gang split across slices {slices}"
+                # The hold must release with the roll: no roll- hold
+                # reservation left, annotation cleared.
+                _wait(lambda: not [
+                    r for r in client.list(SliceReservation)
+                    if r.meta.labels.get(c.LABEL_HOLD_FOR_GANG)],
+                    10.0, "roll hold released")
+                report.update({
+                    "converged": True,
+                    "roll_s": round(time.time() - t0, 2),
+                    "wedge_slices": sorted(slices),
+                })
+                log.info("roll-wedge (defrag on): converged in %.2fs",
+                         report["roll_s"])
+            else:
+                wedged = False
+                for attempt in range(1, attempts + 1):
+                    target = roll(attempt)
+                    deadline = time.time() + scaled(12.0)
+                    while time.time() < deadline:
+                        diag = wedge_gang().status.last_diagnosis
+                        if diag is not None and \
+                                diag.reason == "StragglerUnplaced":
+                            wedged = True
+                            break
+                        if all_ready("wedge", 2, target):
+                            break   # replacement won the race; re-roll
+                        time.sleep(0.1)
+                    if wedged:
+                        break
+                assert wedged, (
+                    f"GROVE_DEFRAG=0 did not reproduce the wedge in "
+                    f"{attempts} rolls — pre-defrag behavior changed")
+                # The wedge is the OLD steady state: squat holds the
+                # slot, the straggler stays diagnosed, nothing moves.
+                time.sleep(scaled(2.0))
+                diag = wedge_gang().status.last_diagnosis
+                assert diag is not None \
+                    and diag.reason == "StragglerUnplaced", \
+                    f"wedge did not persist: {diag}"
+                squat_bound = any(p.status.node_name
+                                  for p in pods_of("squat"))
+                report.update({"wedged": True, "attempt": attempt,
+                               "squat_bound": squat_bound})
+                log.info("roll-wedge (defrag off): wedged on roll %d "
+                         "(squat bound=%s) — pre-defrag behavior intact",
+                         attempt, squat_bound)
+        report["ok"] = True
+        return report
+    finally:
+        if prev_env is None:
+            os.environ.pop(DEFRAG_ENV, None)
+        else:
+            os.environ[DEFRAG_ENV] = prev_env
 
 
 # ---- leader-kill: the HA failover acceptance bench ----------------------

@@ -365,6 +365,11 @@ _PRINT_COLUMNS: dict = {
         ("SCHEDULED", lambda o: _cond(o, c.COND_SCHEDULED)),
         ("READY", lambda o: _cond(o, c.COND_READY)),
         ("PENDING-REASON", _pending_reason),
+        # The live ReuseReservationRef: a defrag migration target or
+        # roll-safe slot hold the gang is pinned to (grovectl explain
+        # expands on it).
+        ("RESERVATION", lambda o: str(
+            o["status"].get("reuse_reservation_ref", "") or "-")),
     ],
     "Pod": [
         ("PHASE", lambda o: str(o["status"].get("phase", ""))),
@@ -689,6 +694,24 @@ def cmd_serving_status(args: argparse.Namespace) -> int:
     breached = any((s.get("slo") or {}).get("breached")
                    for s in data.get("scopes", []))
     return 1 if breached else 0
+
+
+def cmd_defrag_status(args: argparse.Namespace) -> int:
+    """Render the serve daemon's defrag plan ledger: the in-flight
+    migration (hold/drain/rebind state), recent completed/aborted
+    plans with their chips-freed-per-pod scores, and the remaining
+    disruption budget — the placement-repair companion to `grovectl
+    explain` (explain says why a gang is stuck; this says what the
+    control plane is doing about it). Exit 0 while defrag is enabled,
+    1 when disabled (scripts can alert on a forgotten kill switch)."""
+    from grove_tpu.defrag.controller import render_defrag_status
+    status, data = _http(args.server, "/debug/defrag", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    for line in render_defrag_status(data, time.time()):
+        print(line)
+    return 0 if data.get("enabled") else 1
 
 
 def cmd_apply(args: argparse.Namespace) -> int:
@@ -1163,6 +1186,15 @@ def main(argv: list[str] | None = None) -> int:
     ss.add_argument("--server", default=default_server)
     add_ca(ss)
     ss.set_defaults(fn=cmd_serving_status)
+
+    dfs = sub.add_parser(
+        "defrag-status",
+        help="placement-repair ledger from a serve daemon: in-flight "
+             "migration, recent plans, disruption budget (exit 1 when "
+             "defrag is disabled)")
+    dfs.add_argument("--server", default=default_server)
+    add_ca(dfs)
+    dfs.set_defaults(fn=cmd_defrag_status)
 
     for verb in ("cordon", "uncordon"):
         cp = sub.add_parser(verb, help=f"{verb} a node "

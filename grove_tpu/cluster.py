@@ -110,6 +110,13 @@ def new_cluster(config: OperatorConfiguration | None = None,
         mgr.add_runnable(ServingObserver(
             mgr.client, metrics, mgr.store,
             tick=mgr.config.autoscaler.sync_period_seconds))
+    if mgr.config.defrag.enabled:
+        # Active placement repair (ROADMAP item 2): consumes the explain
+        # diagnoses and migrates gangs to consolidate fragmented free
+        # capacity; GROVE_DEFRAG=0 no-ops every sweep without rewiring.
+        from grove_tpu.defrag import DefragController
+        mgr.add_runnable(DefragController(mgr.client, mgr.store,
+                                          mgr.config.defrag))
     if mgr.config.node_lifecycle.enabled:
         from grove_tpu.controllers.nodelifecycle import (
             NodeLifecycleController,

@@ -233,6 +233,10 @@ class PodCliqueReconciler:
         stale = [p for p in pods
                  if p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) != target]
         if not stale:
+            # Roll complete for this clique: release the roll-safe slot
+            # hold once the gang is whole again (cache-read cheap; a
+            # sibling clique still rolling re-takes its own hold).
+            self._release_roll_hold(pclq, pods)
             return None
         # PCS-sequenced rollout: only the currently selected replica rolls
         # (one replica at a time across the set, like the reference's
@@ -253,6 +257,17 @@ class PodCliqueReconciler:
                     return StepResult.requeue(0.2)
             except NotFoundError:
                 pass
+
+        # Roll-safe slot hold (grove_tpu/defrag; the PR 8 wedge fix at
+        # the root): before a deletion frees any bound pod's chips,
+        # fence the gang's slice with a SliceReservation so another
+        # gang's pending pods cannot land in the slot mid-roll — the
+        # replacement relands in place instead of wedging forever as a
+        # StragglerUnplaced whose required pack nothing can satisfy.
+        if any(p.status.node_name for p in stale):
+            hold_wait = self._ensure_roll_hold(pclq)
+            if hold_wait is not None:
+                return hold_wait
 
         def ready(p: Pod) -> bool:
             return is_condition_true(p.status.conditions, c.COND_READY)
@@ -280,6 +295,114 @@ class PodCliqueReconciler:
         if err is not None:
             return err
         return StepResult.requeue(0.05)
+
+    # ---- roll-safe slot holds (grove_tpu/defrag; ISSUE 9) ---------------
+
+    ROLL_HOLD_TTL_SECONDS = 120.0   # pre-TIME_SCALE backstop
+
+    def _roll_hold_gang(self, pclq: PodClique):
+        """The gang a roll hold would protect, or None when holds don't
+        apply: defrag disabled, reservation-fenced cliques (their slices
+        are already exclusive), gangs without an effective required pack
+        (preferred packs relax instead of wedging), or gangs not yet
+        placed (no slot to protect). A required pack at EITHER level
+        counts — the scheduler hard-packs group-level constraints too
+        (plan_gang_grouped), so those rolls wedge exactly the same way."""
+        from grove_tpu.defrag import defrag_enabled
+        if not defrag_enabled() or pclq.spec.reservation:
+            return None
+        gang = self._gang_shared(self._gang_name(pclq), pclq.meta.namespace)
+        if gang is None or not gang.status.assigned_slice:
+            return None
+        topo = gang.spec.topology
+        required = (topo.required and bool(topo.pack_level)) \
+            if topo is not None else True   # scheduler default: slice
+        required = required or any(
+            grp.topology is not None and grp.topology.pack_level
+            and grp.topology.required for grp in gang.spec.groups)
+        return gang if required else None
+
+    def _ensure_roll_hold(self, pclq: PodClique) -> StepResult | None:
+        """Take (or wait for) the gang's roll hold. Returns a requeue
+        while the fence is not yet up — deleting a bound pod before the
+        hold is BOUND reopens the wedge window — or None to proceed."""
+        from grove_tpu.api import SliceReservation
+        from grove_tpu.api.reservation import (
+            ReservationPhase,
+            SliceReservationSpec,
+        )
+        from grove_tpu.defrag import roll_hold_name, set_reservation_ref
+        from grove_tpu.runtime.timescale import scaled
+        gang = self._roll_hold_gang(pclq)
+        if gang is None:
+            return None
+        name = roll_hold_name(gang.meta.name)
+        ns = pclq.meta.namespace
+        try:
+            rsv = self.client.get(SliceReservation, name, ns)
+        except NotFoundError:
+            try:
+                self.client.create(SliceReservation(
+                    meta=new_meta(name, namespace=ns, labels={
+                        c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                        c.LABEL_HOLD_FOR_GANG: gang.meta.name,
+                    }),
+                    spec=SliceReservationSpec(
+                        slices=[gang.status.assigned_slice],
+                        ttl_seconds=scaled(self.ROLL_HOLD_TTL_SECONDS))))
+            except GroveError as e:
+                # A racing sibling clique created it, or a transient
+                # store error: requeue and re-read either way.
+                self.log.debug("roll hold %s create raced: %s", name, e)
+            return StepResult.requeue(0.05)
+        # CAS from unset (or already ours): the gang pointing at a
+        # DIFFERENT reservation means a defrag migration is in flight —
+        # never steal its pointer, wait for the executor to resolve
+        # (rolling a mid-migration gang would fight its reland anyway).
+        if not set_reservation_ref(self.client, gang.meta.name, ns, name,
+                                   expect=("", name)):
+            return StepResult.requeue(0.2)
+        if rsv.status.phase != ReservationPhase.BOUND:
+            return StepResult.requeue(0.05)
+        return None
+
+    def _release_roll_hold(self, pclq: PodClique, pods: list[Pod]) -> None:
+        """Drop the gang's roll hold once the WHOLE gang is back on
+        nodes — the hold is per-gang while cliques roll one at a time,
+        so releasing on this clique's pods alone would unfence a sibling
+        clique's still-relanding replacement (the exact wedge window).
+        Only roll holds are released here — a defrag migration hold on
+        the same gang belongs to its executor."""
+        from grove_tpu.api import SliceReservation
+        from grove_tpu.defrag import defrag_enabled, roll_hold_name, \
+            set_reservation_ref
+        if not defrag_enabled():
+            return
+        gang = self._gang_shared(self._gang_name(pclq), pclq.meta.namespace)
+        if gang is None:
+            return
+        name = roll_hold_name(gang.meta.name)
+        if gang.meta.annotations.get(c.ANNOTATION_RESERVATION_REF) != name:
+            return
+        if any(not p.status.node_name for p in pods):
+            return                        # our replacement still relanding
+        expected = [pn for grp in gang.spec.groups for pn in grp.pod_names]
+        gang_pods = {p.meta.name: p for p in self.client.list(
+            Pod, pclq.meta.namespace,
+            selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+            if p.meta.deletion_timestamp is None}
+        if not expected or any(pn not in gang_pods
+                               or not gang_pods[pn].status.node_name
+                               for pn in expected):
+            return                        # a sibling clique still rolls
+        if not set_reservation_ref(self.client, gang.meta.name,
+                                   pclq.meta.namespace, "",
+                                   expect=(name,)):
+            return                        # retried on the next reconcile
+        try:
+            self.client.delete(SliceReservation, name, pclq.meta.namespace)
+        except (NotFoundError, GroveError):
+            pass
 
     def _create_observed(self, key: str, pod: Pod) -> None:
         try:

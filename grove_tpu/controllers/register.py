@@ -135,6 +135,20 @@ def register_controllers(mgr: Manager) -> Registry:
         return []
 
     rsv_ctrl.watches(["Node"], node_to_reservations)
+
+    def gang_to_holds(event: Event) -> list[Request]:
+        """A deleted PodGang's defrag/roll holds must release promptly
+        (the reconciler GCs holds whose gang is gone) — waiting out the
+        30s resync would leave a fenced slice and trip the chaos
+        defrag-holds invariant."""
+        if event.type.value != "DELETED":
+            return []
+        ns = event.obj.meta.namespace
+        return [Request(ns, r.meta.name) for r in client.list(
+            SliceReservation, ns,
+            selector={c.LABEL_HOLD_FOR_GANG: event.obj.meta.name})]
+
+    rsv_ctrl.watches(["PodGang"], gang_to_holds)
     mgr.add_controller(rsv_ctrl)
 
     if cfg.topology_aware_scheduling.enabled:

@@ -28,7 +28,7 @@ from __future__ import annotations
 import collections
 import time
 
-from grove_tpu.api import Node, Pod, SliceReservation, constants as c
+from grove_tpu.api import Node, Pod, PodGang, SliceReservation, constants as c
 from grove_tpu.api.core import PodPhase
 from grove_tpu.api.reservation import ReservationPhase
 from grove_tpu.runtime.controller import Request
@@ -75,19 +75,46 @@ class SliceReservationReconciler:
         if rsv.meta.deletion_timestamp is not None:
             return StepResult.finished()
 
+        # Hold GC: a defrag/roll hold whose protected gang is gone has
+        # nothing left to fence for — delete it so the slice returns to
+        # the pool (the TTL is the backstop; this is the prompt path,
+        # fed by the PodGang-delete watch mapping in register.py).
+        holder_gang = rsv.meta.labels.get(c.LABEL_HOLD_FOR_GANG)
+        if holder_gang:
+            try:
+                self.client.get(PodGang, holder_gang, req.namespace)
+            except NotFoundError:
+                return self._expire(rsv, "HoldOrphaned",
+                                    f"protected gang {holder_gang} is gone")
+
+        # TTL expiry: an abandoned hold must not strand capacity
+        # (proposal 0001's mandatory-TTL mitigation). Deleting the
+        # object returns its slices via the sweep below / next event.
+        ttl_left = None
+        if rsv.spec.ttl_seconds > 0:
+            ttl_left = (rsv.meta.creation_timestamp + rsv.spec.ttl_seconds
+                        - time.time())
+            if ttl_left <= 0:
+                return self._expire(
+                    rsv, "ReservationExpired",
+                    f"ttl {rsv.spec.ttl_seconds:.0f}s elapsed unreleased")
+
         nodes = self.client.list(Node, req.namespace)
         by_slice = _nodes_by_slice(nodes)
 
-        # Drop bindings whose slice no longer exists (heal path).
-        bound = [s for s in rsv.status.bound_slices if s in by_slice]
-        lost = [s for s in rsv.status.bound_slices if s not in by_slice]
+        if rsv.spec.slices:
+            bound, lost, missing = self._bind_explicit(rsv, by_slice)
+        else:
+            # Drop bindings whose slice no longer exists (heal path).
+            bound = [s for s in rsv.status.bound_slices if s in by_slice]
+            lost = [s for s in rsv.status.bound_slices if s not in by_slice]
 
-        missing = rsv.spec.slice_count - len(bound)
-        if missing > 0:
-            free = self._free_slices(rsv, by_slice, exclude=set(bound))
-            take = free[:missing]
-            bound.extend(take)
-            missing -= len(take)
+            missing = rsv.spec.slice_count - len(bound)
+            if missing > 0:
+                free = self._free_slices(rsv, by_slice, exclude=set(bound))
+                take = free[:missing]
+                bound.extend(take)
+                missing -= len(take)
 
         try:
             self._apply_labels(rsv, by_slice, set(bound))
@@ -96,10 +123,17 @@ class SliceReservationReconciler:
 
         phase = (ReservationPhase.BOUND if missing <= 0
                  else ReservationPhase.PENDING)
-        msg = "" if missing <= 0 else (
-            f"waiting for {missing} free "
-            f"{rsv.spec.generation or 'any'}/{rsv.spec.topology or 'any'} "
-            f"slice(s)")
+        if missing <= 0:
+            msg = ""
+        elif rsv.spec.slices:
+            msg = (f"waiting for {missing} pinned slice(s) of "
+                   f"{rsv.spec.slices}: fenced by another reservation, "
+                   f"nodes missing/not-ready, or fewer than "
+                   f"{rsv.spec.chips} chips free")
+        else:
+            msg = (f"waiting for {missing} free "
+                   f"{rsv.spec.generation or 'any'}/"
+                   f"{rsv.spec.topology or 'any'} slice(s)")
         changed = (sorted(bound) != sorted(rsv.status.bound_slices)
                    or phase != rsv.status.phase
                    or msg != rsv.status.message)
@@ -124,11 +158,80 @@ class SliceReservationReconciler:
         if time.monotonic() - self._last_sweep > self.RESYNC_SECONDS:
             self._last_sweep = time.monotonic()
             self._sweep_orphan_labels(req.namespace)
-        if missing > 0:
-            return StepResult.requeue(2.0)
-        return StepResult.requeue(self.RESYNC_SECONDS)
+        delay = 2.0 if missing > 0 else self.RESYNC_SECONDS
+        if ttl_left is not None:
+            # Wake at the TTL deadline, not a poll after it: a stranded
+            # hold fences real capacity for exactly as long as we sleep.
+            delay = max(0.05, min(delay, ttl_left))
+        return StepResult.requeue(delay)
 
     # ---- helpers --------------------------------------------------------
+
+    def _expire(self, rsv: SliceReservation, reason: str,
+                detail: str) -> StepResult:
+        """Delete a reservation whose hold lapsed (TTL) or whose gang
+        vanished; its node labels return via the sweep. A hold's gang
+        also loses its reuse-reservation-ref pointer — a dangling ref
+        would leave the gang defrag-ineligible forever (the planner
+        skips annotated gangs) and lie on every read surface."""
+        self.recorder.event(rsv, "Warning", reason,
+                            f"releasing {rsv.meta.name}: {detail}")
+        holder = rsv.meta.labels.get(c.LABEL_HOLD_FOR_GANG)
+        if holder:
+            # CAS clear: only while the gang still points at THIS hold
+            # (a fresh replacement hold must not lose its pointer).
+            from grove_tpu.defrag import set_reservation_ref
+            set_reservation_ref(self.client, holder, rsv.meta.namespace,
+                                "", expect=(rsv.meta.name,))
+        try:
+            self.client.delete(SliceReservation, rsv.meta.name,
+                               rsv.meta.namespace)
+        except (NotFoundError, GroveError):
+            pass
+        if not self._sweep_orphan_labels(rsv.meta.namespace):
+            return StepResult.requeue(2.0)
+        return StepResult.finished()
+
+    def _bind_explicit(self, rsv: SliceReservation,
+                       by_slice: dict[str, list[Node]]
+                       ) -> tuple[list[str], list[str], int]:
+        """Bind the explicitly pinned ``spec.slices`` (defrag targets and
+        roll-safe holds): occupancy does NOT block — the fence gates new
+        placement only, existing pods keep running — but a slice fenced
+        by ANOTHER reservation, missing its nodes, or (for defrag
+        targets) short of ``spec.chips`` free stays unbound. Already-
+        bound slices are never re-gated on chips: the consumer landing
+        on its reserved capacity must not unbind its own hold."""
+        used: dict[str, int] = collections.defaultdict(int)
+        if rsv.spec.chips > 0:
+            for p in self.client.list(Pod, rsv.meta.namespace):
+                if p.status.node_name and p.status.phase in (
+                        PodPhase.PENDING, PodPhase.RUNNING):
+                    used[p.status.node_name] += p.spec.tpu_chips
+        already = set(rsv.status.bound_slices)
+        bound: list[str] = []
+        lost: list[str] = []
+        for slice_name in rsv.spec.slices:
+            nodes = by_slice.get(slice_name)
+            if not nodes:
+                lost.append(slice_name)
+                continue
+            if slice_name in already:
+                bound.append(slice_name)    # keep: heal semantics
+                continue
+            if any((n.meta.labels.get(c.LABEL_RESERVATION) or rsv.meta.name)
+                   != rsv.meta.name for n in nodes):
+                continue                    # fenced by another reservation
+            if not all(n.status.ready for n in nodes):
+                continue                    # never bind flapping capacity
+            if rsv.spec.chips > 0:
+                free = sum(n.status.allocatable_chips - used[n.meta.name]
+                           for n in nodes)
+                if free < rsv.spec.chips:
+                    continue                # headroom eaten since the plan
+            bound.append(slice_name)
+        missing = len(rsv.spec.slices) - len(bound)
+        return bound, lost, missing
 
     def _free_slices(self, rsv: SliceReservation,
                      by_slice: dict[str, list[Node]],

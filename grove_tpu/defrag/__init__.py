@@ -1,0 +1,117 @@
+"""Defragmentation engine — active placement repair (ROADMAP item 2).
+
+PR 5 *diagnoses* why a gang cannot be placed (`Fragmented` /
+`TopologyPruned` / `StragglerUnplaced`); this package *fixes* it:
+
+- ``planner``    computes gang-atomic migration plans (move gang G from
+                 its current slices onto slice T) that provably unwedge
+                 a pending gang, scored by chips-freed-per-pod-moved
+                 under a disruption budget;
+- ``controller`` executes one plan at a time as
+                 hold → drain → rebind: take a ``SliceReservation`` on
+                 the target (wired to the gang through the
+                 reuse-reservation-ref annotation, mirrored into
+                 ``PodGang.status``), evict the gang's pods
+                 gang-atomically, and let the scheduler reland them on
+                 the reserved slice; abort + release cleanly on timeout
+                 or target loss.
+
+The rolling-update path takes the same reservation on a replaced pod's
+freed slot (``controllers/podclique.py``) so a replacement relands in
+place — deleting the PR 8 roll-wedge at the root.
+
+``GROVE_DEFRAG=0`` (read live, per decision) disables the whole
+subsystem — planner sweeps, migrations, and roll-safe holds — restoring
+pre-defrag behavior exactly. See docs/design/defrag.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFRAG_ENV = "GROVE_DEFRAG"
+
+
+def defrag_enabled() -> bool:
+    """The subsystem kill switch, read per decision (incident
+    mitigation and tests flip it live, like GROVE_EXPLAIN)."""
+    return os.environ.get(DEFRAG_ENV, "1") != "0"
+
+
+def migration_hold_name(gang_name: str) -> str:
+    """Deterministic SliceReservation name for a defrag migration of
+    ``gang_name`` (one migration per gang at a time by construction)."""
+    return f"defrag-{gang_name}"
+
+
+def roll_hold_name(gang_name: str) -> str:
+    """Deterministic SliceReservation name for a rolling update's
+    slot hold on ``gang_name``'s assigned slice."""
+    return f"roll-{gang_name}"
+
+
+def set_reservation_ref(client, gang_name: str, namespace: str,
+                        new_ref: str,
+                        expect: tuple[str, ...] | None = None) -> bool:
+    """Compare-and-swap the gang's reuse-reservation-ref annotation.
+
+    There is ONE pointer and two writers (the defrag executor and the
+    roll-hold path); a blind patch from either can orphan the other's
+    live hold. This helper is the only sanctioned write: it re-reads
+    the gang and retries on rv conflict, so the ``expect`` check and
+    the write are atomic against the store's optimistic concurrency.
+
+    ``expect``: acceptable CURRENT values ("" = unset); None = any.
+    Returns True when the annotation now equals ``new_ref`` ("" clears
+    it), False when the gang is gone or another writer owns the pointer.
+    """
+    from grove_tpu.api import PodGang, constants as c
+    from grove_tpu.runtime.errors import ConflictError, GroveError, \
+        NotFoundError
+    want = new_ref or ""
+    for _ in range(5):
+        try:
+            gang = client.get(PodGang, gang_name, namespace)
+        except NotFoundError:
+            return False
+        cur = gang.meta.annotations.get(c.ANNOTATION_RESERVATION_REF, "")
+        if cur == want:
+            return True
+        if expect is not None and cur not in expect:
+            return False
+        if want:
+            gang.meta.annotations[c.ANNOTATION_RESERVATION_REF] = want
+        else:
+            gang.meta.annotations.pop(c.ANNOTATION_RESERVATION_REF, None)
+        try:
+            client.update(gang)
+            return True
+        except ConflictError:
+            continue
+        except GroveError:
+            return False
+    return False
+
+
+from grove_tpu.defrag.planner import (  # noqa: E402
+    DEFRAG_REASONS,
+    MigrationPlan,
+    propose_plans,
+)
+from grove_tpu.defrag.controller import (  # noqa: E402
+    DefragController,
+    defrag_for,
+)
+
+__all__ = [
+    "DEFRAG_ENV",
+    "DEFRAG_REASONS",
+    "DefragController",
+    "MigrationPlan",
+    "defrag_enabled",
+    "defrag_for",
+    "migration_hold_name",
+    "propose_plans",
+    "roll_hold_name",
+    "set_reservation_ref",
+]

@@ -1,0 +1,482 @@
+"""DefragController — the hold → drain → rebind migration executor.
+
+A manager runnable (like the deploy/serving observatories) sweeping at
+``defrag.sync_period_seconds``: when a pending gang carries a
+defrag-eligible diagnosis, it asks the planner for a provably-unwedging
+migration and executes ONE at a time:
+
+1. **Hold**: create a ``SliceReservation`` pinned to the target slice
+   (``spec.slices``, ``spec.chips`` guarding the headroom, TTL
+   backstop) and point the victim gang at it through the
+   reuse-reservation-ref annotation — from here the target's free chips
+   are fenced for the migrating gang and the scheduler will pin its
+   reland there (``GangBackend._gang_hold``).
+2. **Drain**: once the hold is BOUND (and the pending gang still needs
+   it), delete the victim's pods gang-atomically. Its PodCliques
+   recreate them gated; gates lift when the gang is whole again —
+   exactly the preemption-eviction flow.
+3. **Rebind**: wait for the victim to reland fully on the target slice,
+   then release (annotation first — the scheduler must stop pinning
+   before the fence drops — then the reservation) and poke the explain
+   layer (``note_defrag_completed``) so stale pending diagnoses refresh
+   ahead of GROVE_EXPLAIN_REFRESH.
+
+Aborts (hold timeout, target loss, superseded plan, rebind timeout,
+victim deleted) release the same way — a failed migration leaves the
+gang free to land anywhere, never wedged on a dead hold. Disruption is
+bounded: at most ``disruption_budget_pods`` evicted per
+``budget_window_seconds``, one migration in flight, ``cooldown_seconds``
+between starts. ``GROVE_DEFRAG=0`` stops everything (read per sweep).
+
+Surfaces: ``GET /debug/defrag`` + ``Client/HttpClient.debug_defrag``
+twins + ``grovectl defrag-status`` render :meth:`payload`;
+``grove_defrag_*`` metric families count plans/chips/durations.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+
+from grove_tpu.api import Pod, PodGang, SliceReservation, constants as c
+from grove_tpu.api.config import DefragConfig
+from grove_tpu.api.meta import is_condition_true, new_meta
+from grove_tpu.api.reservation import ReservationPhase, SliceReservationSpec
+from grove_tpu.defrag import defrag_enabled, migration_hold_name, \
+    set_reservation_ref
+from grove_tpu.defrag.planner import DEFRAG_REASONS, MigrationPlan, \
+    propose_plans
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.events import EventRecorder
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.runtime.timescale import scaled
+from grove_tpu.store.client import Client
+
+# store (weakly) -> its controller, so the in-process Client resolves
+# debug_defrag without a manager reference (the deploywatch pattern).
+_CONTROLLERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def defrag_for(store) -> "DefragController | None":
+    return _CONTROLLERS.get(store)
+
+
+class _Migration:
+    """One in-flight plan's execution state."""
+
+    __slots__ = ("plan", "state", "reservation", "started_at",
+                 "drained_at", "finished_at", "outcome")
+
+    def __init__(self, plan: MigrationPlan, reservation: str) -> None:
+        self.plan = plan
+        self.reservation = reservation
+        self.state = "Holding"          # Holding | Draining | Rebinding
+        self.started_at = time.time()
+        self.drained_at: float | None = None
+        self.finished_at: float | None = None
+        self.outcome = ""               # executed | aborted:<reason>
+
+    def to_dict(self) -> dict:
+        import dataclasses
+        return {
+            "state": self.state,
+            "outcome": self.outcome,
+            "reservation": self.reservation,
+            "started_at": self.started_at,
+            "drained_at": self.drained_at,
+            "finished_at": self.finished_at,
+            "plan": dataclasses.asdict(self.plan),
+        }
+
+
+def render_defrag_status(payload: dict, now: float | None = None
+                         ) -> list[str]:
+    """Human-readable defrag ledger — what ``grovectl defrag-status``
+    prints. Works on the wire dict so the CLI renders identically from
+    the debug endpoint and the in-process twin."""
+    now = time.time() if now is None else now
+    cnt = payload.get("counters", {})
+    cfg = payload.get("config", {})
+    lines = [
+        "defrag: " + ("enabled" if payload.get("enabled")
+                      else "DISABLED (GROVE_DEFRAG=0)"),
+        f"  plans: {cnt.get('proposed', 0)} proposed, "
+        f"{cnt.get('executed', 0)} executed, "
+        f"{cnt.get('aborted', 0)} aborted; "
+        f"{cnt.get('chips_freed', 0)} chips freed",
+        f"  budget: {payload.get('budget_left_pods', 0)}/"
+        f"{cfg.get('disruption_budget_pods', 0)} pods left in the "
+        f"{cfg.get('budget_window_seconds', 0):.0f}s window",
+    ]
+    inflight = payload.get("inflight")
+    if inflight:
+        p = inflight.get("plan", {})
+        age = now - inflight.get("started_at", now)
+        lines.append(
+            f"  in flight ({inflight.get('state', '?')}, {age:.1f}s): "
+            f"gang {p.get('victim_gang', '?')} "
+            f"({p.get('pods_moved', 0)} pods, "
+            f"{p.get('chips_freed', 0)} chips) "
+            f"{p.get('source_slices', [])} -> "
+            f"{p.get('target_slice', '?')} "
+            f"for {p.get('pending_gang', '?')}")
+    recent = payload.get("recent") or []
+    if recent:
+        lines.append(f"  recent migrations ({len(recent)}, newest first):")
+        for m in recent[:8]:
+            p = m.get("plan", {})
+            took = (m.get("finished_at") or now) - m.get("started_at", now)
+            lines.append(
+                f"    {m.get('outcome', '?'):18s} "
+                f"{p.get('victim_gang', '?')} -> "
+                f"{p.get('target_slice', '?')} "
+                f"({p.get('chips_freed', 0)} chips / "
+                f"{p.get('pods_moved', 0)} pods, {took:.2f}s) "
+                f"for {p.get('pending_gang', '?')}")
+    return lines
+
+
+class DefragController:
+    """Background placement-repair runnable (one per manager)."""
+
+    RECENT_CAPACITY = 32
+
+    def __init__(self, client: Client, store,
+                 config: DefragConfig | None = None) -> None:
+        self.client = client
+        self.store = store
+        self.cfg = config or DefragConfig()
+        self.log = get_logger("defrag")
+        self.recorder = EventRecorder(client, "defrag")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Guards _active/_recent/_moved: the sweep thread mutates them,
+        # payload() reads them from the HTTP server thread.
+        self._lock = threading.Lock()
+        self._active: _Migration | None = None
+        self._recent: collections.deque = collections.deque(
+            maxlen=self.RECENT_CAPACITY)
+        # (monotonic start ts, pods moved) inside the budget window.
+        self._moved: collections.deque = collections.deque()
+        self._last_start = 0.0          # monotonic; rate limit anchor
+        self.counters = {"proposed": 0, "executed": 0, "aborted": 0,
+                         "chips_freed": 0}
+
+    # ---- runnable lifecycle ---------------------------------------------
+
+    def start(self) -> None:
+        # Registered at start (not construction): a built-but-unstarted
+        # controller must not shadow the running one (deploywatch
+        # precedent).
+        _CONTROLLERS[self.store] = self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="defrag",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if _CONTROLLERS.get(self.store) is self:
+            del _CONTROLLERS[self.store]
+
+    def _run(self) -> None:
+        from grove_tpu.store import writeobs
+        writeobs.set_writer("defrag")
+        while not self._stop.is_set():
+            try:
+                self.sweep()
+            except Exception:   # noqa: BLE001 — loop survival barrier
+                self.log.exception("defrag sweep panicked")
+            self._stop.wait(self.cfg.sync_period_seconds)
+
+    # ---- the sweep -------------------------------------------------------
+
+    def sweep(self) -> None:
+        """One decision round: advance the in-flight migration, else
+        plan and start a new one. Public so tests and tools can drive
+        it synchronously."""
+        if not defrag_enabled():
+            if self._active is not None:
+                self._abort(self._active, "disabled")
+            GLOBAL_METRICS.set("grove_defrag_inflight", 0.0)
+            return
+        if self._active is not None:
+            self._advance(self._active)
+        GLOBAL_METRICS.set("grove_defrag_inflight",
+                           1.0 if self._active is not None else 0.0)
+        if self._active is not None:
+            return                      # one migration at a time
+        now = time.monotonic()
+        if now - self._last_start < self.cfg.cooldown_seconds:
+            return
+        budget_left = self._budget_left(now)
+        if budget_left < 1:
+            return
+        gangs = self.client.list(PodGang, None)
+        if not any(g.status.last_diagnosis is not None
+                   and g.status.last_diagnosis.reason in DEFRAG_REASONS
+                   and g.meta.deletion_timestamp is None
+                   for g in gangs):
+            return                      # cheap early exit: nothing stuck
+        from grove_tpu.scheduler.backends import DEFAULT_LEVEL_LABELS, \
+            build_host_views
+        pods = self.client.list(Pod, None)
+        hosts = build_host_views(self.client, None, DEFAULT_LEVEL_LABELS)
+        plans = propose_plans(gangs, pods, hosts,
+                              max_pods_per_plan=budget_left)
+        if plans:
+            self._start_migration(plans[0])
+
+    def _budget_left(self, now: float) -> int:
+        window = self.cfg.budget_window_seconds
+        with self._lock:
+            while self._moved and now - self._moved[0][0] > window:
+                self._moved.popleft()
+            return self.cfg.disruption_budget_pods - sum(
+                n for _, n in self._moved)
+
+    # ---- execution -------------------------------------------------------
+
+    def _start_migration(self, plan: MigrationPlan) -> None:
+        name = migration_hold_name(plan.victim_gang)
+        ns = plan.victim_namespace
+        rsv = SliceReservation(
+            meta=new_meta(name, namespace=ns, labels={
+                c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                c.LABEL_HOLD_FOR_GANG: plan.victim_gang,
+            }),
+            spec=SliceReservationSpec(
+                slices=[plan.target_slice], chips=plan.chips_freed,
+                ttl_seconds=scaled(self.cfg.hold_ttl_seconds)))
+        try:
+            self.client.create(rsv)
+        except GroveError as e:
+            # A leftover hold with this name (aborted run's TTL still
+            # ticking) blocks the retry; skip this sweep — the TTL or
+            # the gang-delete GC clears it.
+            self.log.warning("defrag hold %s not created: %s", name, e)
+            return
+        # CAS from unset only: the planner's no-annotation filter ran
+        # against a pass-start snapshot, and the roll-hold path may have
+        # claimed the gang since — never clobber a live pointer.
+        if not set_reservation_ref(self.client, plan.victim_gang, ns,
+                                   name, expect=("",)):
+            self.log.warning("defrag ref on %s not set (gang gone or "
+                             "another hold claimed it)", plan.victim_gang)
+            self._delete_reservation(name, ns)
+            return
+        with self._lock:
+            self._active = _Migration(plan, name)
+        self._last_start = time.monotonic()
+        self.counters["proposed"] += 1
+        GLOBAL_METRICS.inc("grove_defrag_plans_proposed_total")
+        self.log.info(
+            "defrag: migrating gang %s (%d pods, %d chips) from %s to %s "
+            "to unwedge %s (score %.2f)", plan.victim_gang,
+            plan.pods_moved, plan.chips_freed, plan.source_slices,
+            plan.target_slice, plan.pending_gang, plan.score)
+        self._event(plan.victim_gang, ns, "Normal", "DefragMigrationStarted",
+                    f"migrating {plan.pods_moved} pod(s) from "
+                    f"{plan.source_slices} to {plan.target_slice} to "
+                    f"unwedge gang {plan.pending_gang} "
+                    f"(chips-freed-per-pod {plan.score:.1f})")
+
+    def _advance(self, m: _Migration) -> None:
+        plan = m.plan
+        ns = plan.victim_namespace
+        try:
+            gang = self.client.get(PodGang, plan.victim_gang, ns)
+        except NotFoundError:
+            self._abort(m, "victim-gone")
+            return
+        if m.state == "Holding":
+            try:
+                rsv = self.client.get(SliceReservation, m.reservation, ns)
+            except NotFoundError:
+                self._abort(m, "hold-lost")
+                return
+            if rsv.status.phase == ReservationPhase.BOUND \
+                    and rsv.status.bound_slices:
+                if not self._pending_still_needs(plan):
+                    self._abort(m, "superseded")
+                    return
+                self._drain(m, gang)
+                return
+            if time.time() - m.started_at > \
+                    scaled(self.cfg.hold_timeout_seconds):
+                self._abort(m, "hold-timeout")
+            return
+        if m.state == "Rebinding":
+            relanded = (
+                is_condition_true(gang.status.conditions, c.COND_SCHEDULED)
+                and gang.status.assigned_slice == plan.target_slice
+                and self._fully_bound(gang))
+            if relanded:
+                self._complete(m)
+                return
+            try:
+                self.client.get(SliceReservation, m.reservation, ns)
+            except NotFoundError:
+                # Target lost mid-reland (TTL, slice death): release the
+                # pin so the gang may land anywhere.
+                self._abort(m, "target-lost")
+                return
+            if time.time() - (m.drained_at or m.started_at) > \
+                    scaled(self.cfg.rebind_timeout_seconds):
+                self._abort(m, "rebind-timeout")
+
+    def _drain(self, m: _Migration, gang: PodGang) -> None:
+        """Gang-atomic eviction: every victim pod deleted in one round —
+        the PodCliques recreate them gated, so mid-migration the gang
+        only ever has FEWER pods bound than before, never a second live
+        copy (the chaos no-duplicates/gang-binding invariants hold)."""
+        plan = m.plan
+        pods = self.client.list(
+            Pod, plan.victim_namespace,
+            selector={c.LABEL_PODGANG_NAME: plan.victim_gang})
+        for p in pods:
+            if p.meta.deletion_timestamp is not None:
+                continue
+            try:
+                self.client.delete(Pod, p.meta.name, p.meta.namespace)
+            except (NotFoundError, GroveError):
+                pass
+        with self._lock:
+            self._moved.append((time.monotonic(), plan.pods_moved))
+        m.state = "Rebinding"
+        m.drained_at = time.time()
+
+    def _pending_still_needs(self, plan: MigrationPlan) -> bool:
+        """The pending gang must still be stuck for a defrag-eligible
+        reason — a gang that scheduled (capacity appeared elsewhere) or
+        vanished makes the migration pure churn."""
+        try:
+            pg = self.client.get(PodGang, plan.pending_gang,
+                                 plan.pending_namespace)
+        except NotFoundError:
+            return False
+        if is_condition_true(pg.status.conditions, c.COND_SCHEDULED) \
+                and pg.status.last_diagnosis is None:
+            return False
+        return True
+
+    def _fully_bound(self, gang: PodGang) -> bool:
+        expected = [pn for grp in gang.spec.groups for pn in grp.pod_names]
+        pods = {p.meta.name: p for p in self.client.list(
+            Pod, gang.meta.namespace,
+            selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+            if p.meta.deletion_timestamp is None}
+        return bool(expected) and all(
+            pn in pods and pods[pn].status.node_name for pn in expected)
+
+    # ---- completion / abort ----------------------------------------------
+
+    def _complete(self, m: _Migration) -> None:
+        plan = m.plan
+        self._release(m)
+        duration = time.time() - m.started_at
+        m.state, m.outcome = "Done", "executed"
+        m.finished_at = time.time()
+        self._finish(m)
+        self.counters["executed"] += 1
+        self.counters["chips_freed"] += plan.chips_freed
+        GLOBAL_METRICS.inc("grove_defrag_plans_executed_total")
+        GLOBAL_METRICS.inc("grove_defrag_chips_freed_total",
+                           plan.chips_freed)
+        GLOBAL_METRICS.observe("grove_defrag_migration_seconds", duration)
+        # The world every pending diagnosis describes just changed:
+        # force the next attempt to re-judge instead of waiting out
+        # GROVE_EXPLAIN_REFRESH (the unschedulable gauges read the
+        # persisted diagnosis).
+        from grove_tpu.scheduler.explain import note_defrag_completed
+        note_defrag_completed()
+        self.log.info("defrag: gang %s relanded on %s in %.2fs "
+                      "(%d chips freed for %s)", plan.victim_gang,
+                      plan.target_slice, duration, plan.chips_freed,
+                      plan.pending_gang)
+        self._event(plan.victim_gang, plan.victim_namespace, "Normal",
+                    "DefragMigrationCompleted",
+                    f"relanded on {plan.target_slice} in {duration:.2f}s; "
+                    f"{plan.chips_freed} chips freed on "
+                    f"{plan.source_slices} for gang {plan.pending_gang}")
+
+    def _abort(self, m: _Migration, reason: str) -> None:
+        at_state = m.state
+        self._release(m)
+        m.state, m.outcome = "Aborted", f"aborted:{reason}"
+        m.finished_at = time.time()
+        self._finish(m)
+        self.counters["aborted"] += 1
+        GLOBAL_METRICS.inc("grove_defrag_plans_aborted_total",
+                           reason=reason)
+        if m.drained_at is not None:
+            # Pods were already moved: the fleet state still changed,
+            # so stale diagnoses must re-judge it.
+            from grove_tpu.scheduler.explain import note_defrag_completed
+            note_defrag_completed()
+        self.log.warning("defrag: migration of %s aborted (%s) at %s",
+                         m.plan.victim_gang, reason, at_state)
+        self._event(m.plan.victim_gang, m.plan.victim_namespace, "Warning",
+                    "DefragMigrationAborted",
+                    f"migration to {m.plan.target_slice} aborted "
+                    f"({reason}); hold released")
+
+    def _release(self, m: _Migration) -> None:
+        """Annotation FIRST (the scheduler must stop pinning the gang to
+        the reservation before the fence vanishes), then the hold. CAS:
+        the annotation is only cleared while it still names THIS
+        migration's reservation — another writer (a roll-safe hold taken
+        after an abort raced us) must not lose its pointer."""
+        set_reservation_ref(self.client, m.plan.victim_gang,
+                            m.plan.victim_namespace, "",
+                            expect=(m.reservation,))
+        self._delete_reservation(m.reservation, m.plan.victim_namespace)
+
+    def _delete_reservation(self, name: str, namespace: str) -> None:
+        try:
+            self.client.delete(SliceReservation, name, namespace)
+        except (NotFoundError, GroveError):
+            pass
+
+    def _finish(self, m: _Migration) -> None:
+        with self._lock:
+            self._recent.appendleft(m.to_dict())
+            self._active = None
+
+    def _event(self, gang_name: str, namespace: str, etype: str,
+               reason: str, message: str) -> None:
+        try:
+            gang = self.client.get(PodGang, gang_name, namespace)
+        except (NotFoundError, GroveError):
+            return
+        self.recorder.event(gang, etype, reason, message)
+
+    # ---- read surface ----------------------------------------------------
+
+    def payload(self) -> dict:
+        """The /debug/defrag wire shape (grovectl defrag-status renders
+        it; one shape in-process and over HTTP)."""
+        budget_left = self._budget_left(time.monotonic())
+        with self._lock:
+            inflight = (self._active.to_dict()
+                        if self._active is not None else None)
+            recent = list(self._recent)
+        return {
+            "enabled": defrag_enabled(),
+            "config": {
+                "sync_period_seconds": self.cfg.sync_period_seconds,
+                "disruption_budget_pods": self.cfg.disruption_budget_pods,
+                "budget_window_seconds": self.cfg.budget_window_seconds,
+                "cooldown_seconds": self.cfg.cooldown_seconds,
+            },
+            "counters": dict(self.counters),
+            "budget_left_pods": budget_left,
+            "inflight": inflight,
+            "recent": recent,
+        }

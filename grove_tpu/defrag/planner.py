@@ -1,0 +1,225 @@
+"""Migration planning — which gang to move where, and why it helps.
+
+Pure data-in/data-out (like ``scheduler/placement.py``): the controller
+hands it the live object lists plus the host views and gets back ranked
+``MigrationPlan``s. A plan is only proposed when it PROVABLY unwedges a
+pending gang: both legs are verified with the real placement planner —
+the victim must fit on the target slice, and the pending gang must fit
+in the world where the victim's chips came home. Heuristics pick the
+candidates; ``plan_gang`` decides feasibility, so the planner can never
+promise a reland the scheduler would refuse.
+
+Scoring: chips-freed-per-pod-moved (a 2-chip filler beating a 16-chip
+gang teardown must mean it frees more per disruption), ties broken by
+fewer pods moved, then lower victim priority.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+
+from grove_tpu.api import Pod, PodGang, constants as c
+from grove_tpu.scheduler.placement import HostView, PodRequest, plan_gang
+
+# Diagnosis headlines defrag can act on: capacity exists but is in the
+# wrong places. ChipShortfall/SelectorMismatch gangs need chips or label
+# changes, not migrations.
+DEFRAG_REASONS = frozenset(
+    {"Fragmented", "TopologyPruned", "StragglerUnplaced"})
+
+# Candidate bounds: the planner runs inside the manager at sweep
+# cadence — it prunes with cheap totals and pays plan_gang only for the
+# top few (victim, target) pairs.
+MAX_VICTIMS = 16
+MAX_TARGETS = 8
+
+
+@dataclasses.dataclass
+class MigrationPlan:
+    """Move gang ``victim`` onto ``target_slice`` so ``pending`` fits."""
+
+    pending_gang: str = ""
+    pending_namespace: str = "default"
+    victim_gang: str = ""
+    victim_namespace: str = "default"
+    victim_pods: list[str] = dataclasses.field(default_factory=list)
+    pods_moved: int = 0
+    chips_freed: int = 0
+    source_slices: list[str] = dataclasses.field(default_factory=list)
+    target_slice: str = ""
+    score: float = 0.0           # chips_freed / pods_moved
+
+
+def _live(pods: list[Pod]) -> list[Pod]:
+    return [p for p in pods if p.meta.deletion_timestamp is None]
+
+
+def _req(p: Pod) -> PodRequest:
+    return PodRequest(p.meta.name, p.spec.tpu_chips,
+                      dict(p.spec.node_selector))
+
+
+def _views(hosts: list[HostView], free: dict[str, int]) -> list[HostView]:
+    return [dataclasses.replace(h, free_chips=free[h.name])
+            for h in hosts]
+
+
+def _pack_of(gang: PodGang) -> tuple[str, bool]:
+    topo = gang.spec.topology
+    if topo is None:
+        return "slice", True      # the scheduler's default
+    return (topo.pack_level or "slice"), topo.required
+
+
+def propose_plans(gangs: list[PodGang], pods: list[Pod],
+                  hosts: list[HostView], *,
+                  max_pods_per_plan: int,
+                  max_plans: int = 4) -> list[MigrationPlan]:
+    """Ranked migration plans for the currently-defrag-eligible pending
+    gangs. ``max_pods_per_plan`` is the remaining disruption budget —
+    victims bigger than it are never considered."""
+    if max_pods_per_plan < 1:
+        return []
+    host_by_name = {h.name: h for h in hosts}
+    base_free = {h.name: h.free_chips for h in hosts}
+    slice_hosts: dict[str, list[HostView]] = defaultdict(list)
+    for h in hosts:
+        if h.slice_name:
+            slice_hosts[h.slice_name].append(h)
+
+    by_gang: dict[tuple[str, str], list[Pod]] = defaultdict(list)
+    for p in _live(pods):
+        gname = p.meta.labels.get(c.LABEL_PODGANG_NAME)
+        if gname:
+            by_gang[(p.meta.namespace, gname)].append(p)
+
+    def gang_pods(g: PodGang) -> list[Pod]:
+        return by_gang.get((g.meta.namespace, g.meta.name), [])
+
+    pending: list[PodGang] = []
+    victims: list[tuple[PodGang, list[Pod], int]] = []
+    for g in gangs:
+        if g.meta.deletion_timestamp is not None:
+            continue
+        if g.meta.annotations.get(c.ANNOTATION_RESERVATION_REF):
+            continue    # already mid-migration or mid-roll: hands off
+        diag = g.status.last_diagnosis
+        if diag is not None and diag.reason in DEFRAG_REASONS:
+            pending.append(g)
+            continue
+        mine = gang_pods(g)
+        expected = [pn for grp in g.spec.groups for pn in grp.pod_names]
+        by_name = {p.meta.name: p for p in mine}
+        if not expected or any(pn not in by_name for pn in expected):
+            continue    # mid-recreate / scaling: not safely movable
+        placed = [by_name[pn] for pn in expected]
+        if any(not p.status.node_name or p.spec.scheduling_gates
+               or p.status.node_name not in host_by_name for p in placed):
+            continue    # partially bound or on a lost node
+        if any(c.LABEL_RESERVATION in p.spec.node_selector for p in placed):
+            continue    # fenced to a PCS reservation: not ours to move
+        if len(placed) > max_pods_per_plan:
+            continue
+        victims.append((g, placed, sum(p.spec.tpu_chips for p in placed)))
+
+    if not pending or not victims:
+        return []
+    # Highest-value victims first: most chips freed per pod moved.
+    victims.sort(key=lambda v: (-v[2] / len(v[1]), len(v[1])))
+    pending.sort(key=lambda g: (-g.spec.priority,
+                                g.meta.creation_timestamp))
+
+    plans: list[MigrationPlan] = []
+    for pg in pending:
+        if len(plans) >= max_plans:
+            break
+        plan = _plan_for(pg, gang_pods(pg), victims, hosts, host_by_name,
+                         base_free, slice_hosts)
+        if plan is not None:
+            plans.append(plan)
+    plans.sort(key=lambda p: (-p.score, p.pods_moved))
+    return plans
+
+
+def _plan_for(pending: PodGang, pending_pods: list[Pod],
+              victims, hosts, host_by_name, base_free,
+              slice_hosts) -> MigrationPlan | None:
+    """Best-scoring feasible migration that seats ``pending``, or None."""
+    unbound = [p for p in pending_pods
+               if not p.status.node_name and not p.spec.scheduling_gates]
+    bound = [p for p in pending_pods if p.status.node_name]
+    if not unbound:
+        return None
+    if any(c.LABEL_RESERVATION in p.spec.node_selector
+           for p in pending_pods):
+        return None     # reserved cliques live inside their own fence
+    level, required = _pack_of(pending)
+    anchor = ""
+    if bound:
+        # Straggler case: the unplaced pods must rejoin the slice their
+        # siblings hold (the hard pack that makes the wedge a wedge).
+        anchor = pending.status.assigned_slice
+        if not anchor:
+            h = host_by_name.get(bound[0].status.node_name)
+            anchor = h.slice_name if h is not None else ""
+        if not anchor:
+            return None
+
+    def pending_fits(after: dict[str, int]) -> bool:
+        reqs = [_req(p) for p in unbound]
+        if anchor:
+            pool = _views(slice_hosts.get(anchor, []), after)
+            return bool(pool) and plan_gang(
+                reqs, pool, pack_level="slice", required=True) is not None
+        return plan_gang(reqs, _views(hosts, after), pack_level=level,
+                         required=required) is not None
+
+    best: MigrationPlan | None = None
+    for victim, vpods, vchips in victims[:MAX_VICTIMS]:
+        if (victim.meta.namespace, victim.meta.name) == \
+                (pending.meta.namespace, pending.meta.name):
+            continue
+        if victim.spec.priority > pending.spec.priority:
+            continue    # never disrupt higher-priority work
+        if best is not None and vchips / len(vpods) <= best.score:
+            break       # victims are score-sorted: nothing better left
+        usage: dict[str, int] = defaultdict(int)
+        sources: set[str] = set()
+        for p in vpods:
+            usage[p.status.node_name] += p.spec.tpu_chips
+            sources.add(host_by_name[p.status.node_name].slice_name)
+        freed = dict(base_free)
+        for node, chips in usage.items():
+            freed[node] += chips
+        vreqs = [_req(p) for p in vpods]
+        targets = sorted(
+            (s for s in slice_hosts
+             if s not in sources
+             and sum(freed[h.name] for h in slice_hosts[s]) >= vchips),
+            key=lambda s: -sum(freed[h.name] for h in slice_hosts[s]))
+        for target in targets[:MAX_TARGETS]:
+            vplan = plan_gang(vreqs, _views(slice_hosts[target], freed),
+                              pack_level="slice", required=True)
+            if vplan is None:
+                continue
+            after = dict(freed)
+            chips_of = {p.meta.name: p.spec.tpu_chips for p in vpods}
+            for pod_name, host_name in vplan.assignments.items():
+                after[host_name] -= chips_of[pod_name]
+            if not pending_fits(after):
+                continue
+            plan = MigrationPlan(
+                pending_gang=pending.meta.name,
+                pending_namespace=pending.meta.namespace,
+                victim_gang=victim.meta.name,
+                victim_namespace=victim.meta.namespace,
+                victim_pods=sorted(p.meta.name for p in vpods),
+                pods_moved=len(vpods), chips_freed=vchips,
+                source_slices=sorted(sources), target_slice=target,
+                score=vchips / len(vpods))
+            if best is None or (plan.score, -plan.pods_moved) > \
+                    (best.score, -best.pods_moved):
+                best = plan
+            break       # targets are roomiest-first: first fit is best
+    return best

@@ -507,6 +507,34 @@ GLOBAL_METRICS.describe(
     "Applied scaling decisions per object and direction (up|down) — "
     "each has a matching ScaledUp/ScaledDown event with signal vs "
     "target")
+# Defragmentation engine (grove_tpu/defrag, docs/design/defrag.md):
+# active placement repair acting on the explain diagnoses.
+GLOBAL_METRICS.describe(
+    "grove_defrag_plans_proposed_total",
+    "Migration plans adopted for execution by the defrag controller "
+    "(each provably unwedges a pending gang at proposal time)")
+GLOBAL_METRICS.describe(
+    "grove_defrag_plans_executed_total",
+    "Migrations completed: the victim gang relanded whole on its "
+    "reserved target slice and the hold was released")
+GLOBAL_METRICS.describe(
+    "grove_defrag_plans_aborted_total",
+    "Migrations aborted per reason (hold-timeout|hold-lost|superseded|"
+    "rebind-timeout|target-lost|victim-gone|disabled) — every abort "
+    "releases its reservation and annotation")
+GLOBAL_METRICS.describe(
+    "grove_defrag_chips_freed_total",
+    "Chips vacated from fragmented domains by completed migrations "
+    "(the defragmented-capacity odometer)")
+GLOBAL_METRICS.describe(
+    "grove_defrag_inflight",
+    "1 while a migration is executing (hold/drain/rebind), else 0 — "
+    "the executor runs one plan at a time")
+GLOBAL_METRICS.describe_histogram(
+    "grove_defrag_migration_seconds",
+    "Wall time of one completed migration, hold creation to full "
+    "reland on the target slice",
+    buckets=LIFECYCLE_BUCKETS)
 GLOBAL_METRICS.describe(
     "grove_autoscaler_conflicts_total",
     "Scale writes rejected by the store (conflict or validation) per "

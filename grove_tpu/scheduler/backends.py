@@ -454,6 +454,13 @@ class GangBackend:
         diag = None
         trace_id = trace_id_of(gang)
 
+        # Reservation-aware placement: a gang holding a bound
+        # SliceReservation (defrag migration target, roll-safe slot
+        # hold) is constrained to — and admitted onto — the reserved
+        # hosts; resolved once per gang, only when the annotation is
+        # present (zero cost on the common path).
+        hold = self._gang_hold(gang) if bindable else ("", "")
+
         if not already_bound and group_ok and bindable:
             # First placement: gang-atomic plan over all present pods.
             # The span covers plan + preempt + bind — the
@@ -464,7 +471,7 @@ class GangBackend:
                     attrs={"gang": gang.meta.name,
                            "pods": len(bindable)}) as span:
                 placed_any, preempted, diag = self._place_initial(
-                    gang, snap, bindable, span)
+                    gang, snap, bindable, span, hold)
         elif already_bound and bindable:
             # Stragglers (scale-up within the gang, or pods re-created
             # after a partial bind): co-locate with their siblings,
@@ -484,7 +491,7 @@ class GangBackend:
                                                 bound_domains)
                     host = plan_single(
                         PodRequest(p.meta.name, p.spec.tpu_chips,
-                                   dict(p.spec.node_selector)),
+                                   self._hold_selector(p, hold)),
                         pool, prefer_slice=gang.status.assigned_slice)
                     if host is not None:
                         self._bind([p], {p.meta.name: host}, snap)
@@ -509,11 +516,17 @@ class GangBackend:
         return placed_any, preempted
 
     def _place_initial(self, gang: PodGang, snap: PlacementSnapshot,
-                       bindable: list[Pod], span) -> tuple[bool, bool, object]:
+                       bindable: list[Pod], span,
+                       hold: tuple[str, str] = ("", "")
+                       ) -> tuple[bool, bool, object]:
         """First gang-atomic placement (plan → preempt → min-floor
         fallback → bind). Returns (placed_any, preempted, diagnosis) —
         diagnosis is a PlacementDiagnosis when the gang stayed fully
-        unplaced and explain is enabled, else None."""
+        unplaced and explain is enabled, else None. ``hold`` is the
+        gang's bound reservation (name, slice): the injected selector
+        both admits the gang onto the fenced hosts and pins it there,
+        so a migrating gang relands on its reserved target instead of
+        squatting the capacity defrag just freed for someone else."""
         hosts = snap.hosts
         placed_any = False
         preempted = False
@@ -521,10 +534,11 @@ class GangBackend:
         pack_level = topo.pack_level if topo else "slice"
         required = topo.required if topo else True
         spread = self._spread_penalties(gang, snap)
+        hold_slice = hold[1]
 
         def req(p: Pod) -> PodRequest:
             return PodRequest(p.meta.name, p.spec.tpu_chips,
-                              dict(p.spec.node_selector))
+                              self._hold_selector(p, hold))
 
         grouped = any(grp.topology is not None and grp.topology.pack_level
                       for grp in gang.spec.groups)
@@ -550,12 +564,12 @@ class GangBackend:
                     greqs.append(GroupRequest(stray))
                 return lambda hv, idx=None: plan_gang_grouped(
                     greqs, hv, pack_level=pack_level, required=required,
-                    prefer_slice=self._reuse_slice(gang),
+                    prefer_slice=hold_slice or self._reuse_slice(gang),
                     spread_penalty=spread, domain_index=idx)
             requests = [req(p) for p in pods]
             return lambda hv, idx=None: plan_gang(
                 requests, hv, pack_level=pack_level, required=required,
-                prefer_slice=self._reuse_slice(gang),
+                prefer_slice=hold_slice or self._reuse_slice(gang),
                 spread_penalty=spread, domain_index=idx)
 
         plan_fn = make_plan_fn(bindable)
@@ -850,6 +864,38 @@ class GangBackend:
             first = False
         return pool
 
+    def _gang_hold(self, gang: PodGang) -> tuple[str, str]:
+        """Resolve the gang's reuse-reservation-ref annotation to a
+        BOUND SliceReservation: (name, first bound slice). ("", "")
+        when absent, missing, or not yet bound — an unbound hold never
+        constrains placement (a lost target must not wedge the gang)."""
+        ref = gang.meta.annotations.get(c.ANNOTATION_RESERVATION_REF, "")
+        if not ref:
+            return "", ""
+        from grove_tpu.api import SliceReservation
+        from grove_tpu.api.reservation import ReservationPhase
+        try:
+            rsv = self.client.get(SliceReservation, ref,
+                                  gang.meta.namespace)
+        except NotFoundError:
+            return "", ""
+        if rsv.status.phase != ReservationPhase.BOUND \
+                or not rsv.status.bound_slices:
+            return "", ""
+        return ref, rsv.status.bound_slices[0]
+
+    @staticmethod
+    def _hold_selector(pod: Pod, hold: tuple[str, str]) -> dict[str, str]:
+        """The pod's node selector with the gang's bound hold injected:
+        reserved hosts are fenced (placement._selector_matches), so the
+        selector is what ADMITS the gang onto its own hold — and pins it
+        there. A clique that already selects a PCS-level reservation is
+        left alone (two reservation keys can never both match)."""
+        sel = dict(pod.spec.node_selector)
+        if hold[0] and c.LABEL_RESERVATION not in sel:
+            sel[c.LABEL_RESERVATION] = hold[0]
+        return sel
+
     def _reuse_slice(self, gang: PodGang) -> str:
         """Resolve the placement-reuse hint to a slice name: an explicit
         preferred-slice annotation (rolling updates stamp the replaced
@@ -919,6 +965,12 @@ class GangBackend:
     def _update_status(self, gang: PodGang, initialized: bool,
                        placed_now: bool, snap: PlacementSnapshot) -> None:
         client = self.client
+        # Mirror the reuse-reservation-ref annotation (written by the
+        # defrag executor / rolling-update hold path) into status — the
+        # scheduler is the single PodGang status writer, so the mirror
+        # rides every status write instead of adding a second writer.
+        gang.status.reuse_reservation_ref = gang.meta.annotations.get(
+            c.ANNOTATION_RESERVATION_REF, "")
         existing, expected, _ = self._gang_pods(gang, snap)
         bound = sum(1 for p in existing if p.status.node_name)
         ready = sum(1 for p in existing
@@ -1002,6 +1054,11 @@ class GangBackend:
                 fresh.status.assigned_slice = gang.status.assigned_slice
                 fresh.status.placement_score = gang.status.placement_score
                 fresh.status.last_diagnosis = gang.status.last_diagnosis
+                # Re-mirror from the FRESH annotations: the conflicting
+                # writer may have been the hold path itself.
+                fresh.status.reuse_reservation_ref = \
+                    fresh.meta.annotations.get(
+                        c.ANNOTATION_RESERVATION_REF, "")
                 write(fresh)
             except (ConflictError, NotFoundError):
                 pass  # next pass recomputes from live state

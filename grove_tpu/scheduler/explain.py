@@ -54,6 +54,21 @@ def explain_enabled() -> bool:
     return os.environ.get(EXPLAIN_ENV, "1") != "0"
 
 
+# Diagnoses last refreshed before this wall-clock instant bypass the
+# refresh throttle once: a completed defrag migration changed the world
+# every pending diagnosis describes, so the gauges and explain surfaces
+# must re-judge it now, not after GROVE_EXPLAIN_REFRESH runs out.
+_refresh_floor = 0.0
+
+
+def note_defrag_completed(now: float | None = None) -> None:
+    """Called by the defrag executor when a migration lands (or aborts
+    after moving pods): forces the next merge_diagnosis of every stale
+    diagnosis to refresh instead of returning the pre-defrag record."""
+    global _refresh_floor
+    _refresh_floor = time.time() if now is None else now
+
+
 def refresh_seconds() -> float:
     try:
         return float(os.environ.get(REFRESH_ENV, DEFAULT_REFRESH_SECONDS))
@@ -216,7 +231,8 @@ def merge_diagnosis(prev: PlacementDiagnosis | None,
     if prev is not None:
         unchanged = (prev.reason == fresh.reason
                      and prev.message == fresh.message)
-        if unchanged and now - prev.last_attempt_time < refresh_seconds():
+        if unchanged and now - prev.last_attempt_time < refresh_seconds() \
+                and prev.last_attempt_time >= _refresh_floor:
             return prev
         fresh.attempts = prev.attempts + 1
         fresh.first_failure_time = prev.first_failure_time or now
@@ -243,6 +259,7 @@ def placement_payload(gang: PodGang) -> dict:
         "scheduled": is_condition_true(gang.status.conditions,
                                        c.COND_SCHEDULED),
         "assigned_slice": gang.status.assigned_slice,
+        "reuse_reservation_ref": gang.status.reuse_reservation_ref,
         "conditions": [to_dict(cd) for cd in gang.status.conditions],
         "diagnosis": (to_dict(gang.status.last_diagnosis)
                       if gang.status.last_diagnosis is not None else None),
@@ -266,6 +283,7 @@ def payload_from_obj(obj: dict) -> dict:
         "phase": st.get("phase", ""),
         "scheduled": scheduled,
         "assigned_slice": st.get("assigned_slice", ""),
+        "reuse_reservation_ref": st.get("reuse_reservation_ref", ""),
         "conditions": st.get("conditions") or [],
         "diagnosis": st.get("last_diagnosis"),
     }
@@ -279,13 +297,23 @@ def render_explain(payload: dict, now: float | None = None) -> list[str]:
     now = time.time() if now is None else now
     name = f"PodGang/{payload.get('name', '')}"
     diag = payload.get("diagnosis")
+    hold = payload.get("reuse_reservation_ref", "")
+    hold_line = (
+        f"  reservation: holds {hold!r} — a defrag migration target or "
+        "roll-safe slot hold; the gang is pinned to (and admitted onto) "
+        "the reserved slice until the hold releases" if hold else "")
     lines: list[str] = []
     if diag is None:
         where = payload.get("assigned_slice") or "multiple domains"
         state = ("scheduled onto " + where if payload.get("scheduled")
                  else f"phase {payload.get('phase', '?')}, no placement "
                       "diagnosis recorded")
+        if hold and not payload.get("scheduled"):
+            state = (f"phase {payload.get('phase', '?')}, relanding onto "
+                     f"reservation {hold!r}")
         lines.append(f"{name}: {state}")
+        if hold_line:
+            lines.append(hold_line)
         return lines
     pending = max(0.0, now - diag.get("first_failure_time", now))
     # A diagnosis can coexist with Scheduled=True (min-floor placed,
@@ -302,6 +330,10 @@ def render_explain(payload: dict, now: float | None = None) -> list[str]:
         f"{diag.get('pods', 0)} pods "
         f"(pack {diag.get('pack_level', '?')}, "
         f"{'required' if diag.get('required', True) else 'preferred'})")
+    if hold_line:
+        # Pending BECAUSE of a hold is a different story than a bare
+        # capacity verdict: say the gang is awaiting its reserved slice.
+        lines.append(hold_line)
     domains = diag.get("domains") or []
     if domains:
         total = diag.get("domains_total", len(domains))

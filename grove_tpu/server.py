@@ -53,6 +53,11 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       reporter liveness; grovectl
                                       serving-status renders it; same
                                       read gate as /debug/placement)
+  GET  /debug/defrag                  defrag plan ledger: in-flight
+                                      migration, recent plans, budget
+                                      (grovectl defrag-status renders
+                                      it; same read gate as
+                                      /debug/placement)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -433,6 +438,8 @@ class ApiServer:
                     elif len(parts) == 4 and parts[0] == "debug" \
                             and parts[1] == "serving":
                         self._debug_serving(parts[2], parts[3])
+                    elif url.path == "/debug/defrag":
+                        self._debug_defrag()
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -725,6 +732,15 @@ class ApiServer:
                 in do_GET's handler."""
                 self._send(200, cluster.client.debug_deploy(
                     name, namespace))
+
+            def _debug_defrag(self):
+                """GET /debug/defrag — the defrag controller's plan
+                ledger (``grovectl defrag-status`` renders it).
+                Aggregate placement-repair state like /debug/deploy, so
+                it shares the read gate, not the profiling gate.
+                NotFoundError from the twin maps to 404 in do_GET's
+                handler."""
+                self._send(200, cluster.client.debug_defrag())
 
             def _debug_serving(self, namespace: str, name: str):
                 """GET /debug/serving/<ns>/<name> — one serving scope's

@@ -135,6 +135,20 @@ class Client:
                 "(created before the observatory started, or evicted)")
         return payload
 
+    def debug_defrag(self) -> dict:
+        """The defrag controller's plan ledger — the in-process twin of
+        ``GET /debug/defrag`` (same payload shape; grovectl
+        defrag-status renders either). Raises NotFoundError when no
+        defrag controller runs on this store (defrag.enabled=False)."""
+        from grove_tpu.defrag import defrag_for
+        from grove_tpu.runtime.errors import NotFoundError
+        dc = defrag_for(self._store)
+        if dc is None:
+            raise NotFoundError(
+                "defrag controller is not running for this store "
+                "(no started Manager owns it, or defrag.enabled=False)")
+        return dc.payload()
+
     def debug_serving(self, name: str, namespace: str = "default") -> dict:
         """One serving scope's SLO state — the in-process twin of
         ``GET /debug/serving/<ns>/<name>`` (same payload shape;

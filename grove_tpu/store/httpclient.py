@@ -275,6 +275,11 @@ class HttpClient:
         return self._request(
             "GET", f"/debug/serving/{quote(namespace)}/{quote(name)}")
 
+    def debug_defrag(self) -> dict:
+        """The defrag plan ledger from ``GET /debug/defrag`` (the wire
+        twin of ``Client.debug_defrag``; 404 maps to NotFoundError)."""
+        return self._request("GET", "/debug/defrag")
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

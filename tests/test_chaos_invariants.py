@@ -278,6 +278,44 @@ def test_ttr_collapse_fires_and_fast_jitter_does_not():
 
 # ---- the sweep ----------------------------------------------------------
 
+def test_orphaned_defrag_hold_fires(quiet_cluster):
+    from grove_tpu.api import SliceReservation
+    client = quiet_cluster.client
+    rsv = SliceReservation(meta=new_meta("defrag-ghost-0", labels={
+        c.LABEL_HOLD_FOR_GANG: "ghost-0"}))
+    rsv.spec.slices = ["pool-0-slice-0"]
+    client.create(rsv)
+    out = make_checker(quiet_cluster).check_defrag_holds()
+    assert len(out) == 1 and out[0].invariant == "defrag-holds"
+    assert "ghost-0 is gone" in out[0].detail
+
+
+def test_unreferenced_defrag_hold_fires(quiet_cluster):
+    from grove_tpu.api import SliceReservation
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta("g-0"))
+    client.create(gang)           # exists, but references no hold
+    rsv = SliceReservation(meta=new_meta("roll-g-0", labels={
+        c.LABEL_HOLD_FOR_GANG: "g-0"}))
+    client.create(rsv)
+    out = make_checker(quiet_cluster).check_defrag_holds()
+    assert len(out) == 1 and out[0].invariant == "defrag-holds"
+    assert "never be consumed or released" in out[0].detail
+
+
+def test_live_referenced_hold_and_pcs_reservation_green(quiet_cluster):
+    from grove_tpu.api import SliceReservation
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta(
+        "g-0", annotations={c.ANNOTATION_RESERVATION_REF: "roll-g-0"}))
+    client.create(gang)
+    client.create(SliceReservation(meta=new_meta("roll-g-0", labels={
+        c.LABEL_HOLD_FOR_GANG: "g-0"})))
+    # A PCS-template reservation carries no hold label: never judged.
+    client.create(SliceReservation(meta=new_meta("pcs-rsv")))
+    assert make_checker(quiet_cluster).check_defrag_holds() == []
+
+
 def test_empty_cluster_sweeps_green(quiet_cluster):
     assert make_checker(quiet_cluster).sweep() == []
 

@@ -1,0 +1,534 @@
+"""Defragmentation engine (grove_tpu/defrag, ISSUE 9): migration
+planning, the hold → drain → rebind executor, roll-safe slice holds,
+and the off switch.
+
+Planner tests are pure (hand-built gangs/pods/hosts). Executor tests
+drive a manually-constructed DefragController synchronously (sweep by
+sweep) against a live cluster whose auto-controller is disabled — the
+deterministic way to pin gang-atomicity, abort cleanup, and the
+disruption budget. The roll-wedge and churn acceptance run the real
+end-to-end subsystems.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    PodGang,
+    SliceReservation,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.api.podgang import (
+    PlacementDiagnosis,
+    PodGangSpec,
+    PodGroup,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.defrag import (
+    DEFRAG_ENV,
+    DefragController,
+    migration_hold_name,
+    propose_plans,
+)
+from grove_tpu.scheduler.placement import HostView
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+
+# ---- planner (pure) ------------------------------------------------------
+
+
+def _host(name: str, slice_name: str, free: int,
+          total: int = 4) -> HostView:
+    return HostView(name=name, free_chips=free,
+                    domains={"slice": slice_name, "pool": "pool-0"},
+                    labels={}, total_chips=total)
+
+
+def _gang(name: str, pod_names: list[str], *, priority: int = 0,
+          reason: str = "", assigned_slice: str = "") -> PodGang:
+    g = PodGang(meta=new_meta(name), spec=PodGangSpec(
+        groups=[PodGroup(name="w", pod_names=list(pod_names),
+                         min_replicas=len(pod_names))],
+        priority=priority))
+    g.status.assigned_slice = assigned_slice
+    if reason:
+        g.status.last_diagnosis = PlacementDiagnosis(reason=reason)
+    return g
+
+
+def _pod(name: str, gang: str, chips: int, node: str = "") -> Pod:
+    p = Pod(meta=new_meta(name, labels={c.LABEL_PODGANG_NAME: gang}))
+    p.spec.tpu_chips = chips
+    p.status.node_name = node
+    return p
+
+
+def _two_slice_world():
+    """Slice A and B, every host 2 chips free; a 2-chip victim gang on
+    a1; a pending 4-chip gang diagnosed Fragmented."""
+    hosts = [_host("a1", "A", 2), _host("a2", "A", 2),
+             _host("b1", "B", 2), _host("b2", "B", 2)]
+    vic = _gang("vic", ["vic-0"])
+    pend = _gang("pend", ["pend-0"], reason="Fragmented")
+    pods = [_pod("vic-0", "vic", 2, "a1"), _pod("pend-0", "pend", 4)]
+    return [pend, vic], pods, hosts
+
+
+def test_planner_proposes_provably_unwedging_plan():
+    gangs, pods, hosts = _two_slice_world()
+    plans = propose_plans(gangs, pods, hosts, max_pods_per_plan=8)
+    assert len(plans) == 1
+    p = plans[0]
+    assert p.victim_gang == "vic" and p.pending_gang == "pend"
+    assert p.target_slice == "B" and p.source_slices == ["A"]
+    assert p.pods_moved == 1 and p.chips_freed == 2
+    assert p.score == pytest.approx(2.0)
+
+
+def test_planner_respects_disruption_budget():
+    gangs, pods, hosts = _two_slice_world()
+    assert propose_plans(gangs, pods, hosts, max_pods_per_plan=0) == []
+    # A 2-pod victim under a 1-pod budget is untouchable even though
+    # moving it would unwedge the pending gang.
+    vic = _gang("vic2", ["vic2-0", "vic2-1"])
+    pods2 = [_pod("vic2-0", "vic2", 2, "a1"),
+             _pod("vic2-1", "vic2", 2, "a2"),
+             _pod("pend-0", "pend", 4)]
+    pend = _gang("pend", ["pend-0"], reason="Fragmented")
+    assert propose_plans([pend, vic], pods2, hosts,
+                         max_pods_per_plan=1) == []
+    assert propose_plans([pend, vic], pods2, hosts,
+                         max_pods_per_plan=2) != []
+
+
+def test_planner_never_disrupts_higher_priority():
+    gangs, pods, hosts = _two_slice_world()
+    gangs[1].spec.priority = 10          # victim outranks the pending gang
+    assert propose_plans(gangs, pods, hosts, max_pods_per_plan=8) == []
+
+
+def test_planner_requires_a_feasible_target():
+    # No slice B: the victim has nowhere to go, so no plan — a migration
+    # that cannot reland is never proposed.
+    hosts = [_host("a1", "A", 2), _host("a2", "A", 2)]
+    vic = _gang("vic", ["vic-0"])
+    pend = _gang("pend", ["pend-0"], reason="Fragmented")
+    pods = [_pod("vic-0", "vic", 2, "a1"), _pod("pend-0", "pend", 4)]
+    assert propose_plans([pend, vic], pods, hosts,
+                         max_pods_per_plan=8) == []
+
+
+def test_planner_unwedges_straggler_via_anchor_slice():
+    # pend-0 bound on a1 (slice A full there); the squatter vic-0 holds
+    # a2's headroom; pend-1 must rejoin slice A (required pack).
+    hosts = [_host("a1", "A", 0), _host("a2", "A", 2),
+             _host("b1", "B", 2), _host("b2", "B", 2)]
+    vic = _gang("vic", ["vic-0"])
+    pend = _gang("pend", ["pend-0", "pend-1"],
+                 reason="StragglerUnplaced", assigned_slice="A")
+    pods = [_pod("vic-0", "vic", 2, "a2"),
+            _pod("pend-0", "pend", 4, "a1"),
+            _pod("pend-1", "pend", 4)]
+    plans = propose_plans([pend, vic], pods, hosts, max_pods_per_plan=8)
+    assert len(plans) == 1
+    assert plans[0].victim_gang == "vic"
+    assert plans[0].target_slice == "B"
+
+
+def test_planner_skips_held_and_reserved_gangs():
+    gangs, pods, hosts = _two_slice_world()
+    gangs[1].meta.annotations[c.ANNOTATION_RESERVATION_REF] = "roll-vic"
+    assert propose_plans(gangs, pods, hosts, max_pods_per_plan=8) == []
+    gangs, pods, hosts = _two_slice_world()
+    pods[0].spec.node_selector[c.LABEL_RESERVATION] = "pcs-hold"
+    assert propose_plans(gangs, pods, hosts, max_pods_per_plan=8) == []
+
+
+# ---- explain integration (satellite: gauge refresh + render) -----------
+
+
+def test_defrag_completion_bypasses_refresh_throttle(monkeypatch):
+    from grove_tpu.scheduler import explain
+    monkeypatch.setenv("GROVE_EXPLAIN_REFRESH", "3600")
+    prev = PlacementDiagnosis(reason="Fragmented", message="m",
+                              attempts=3, first_failure_time=50.0,
+                              last_attempt_time=100.0)
+    fresh = PlacementDiagnosis(reason="Fragmented", message="m")
+    # Inside the window, unchanged failure: throttled to the old record.
+    assert explain.merge_diagnosis(prev, fresh, now=101.0) is prev
+    # A defrag completion changed the world: the same merge refreshes.
+    explain.note_defrag_completed(now=101.5)
+    try:
+        merged = explain.merge_diagnosis(prev, fresh, now=102.0)
+        assert merged is fresh and merged.attempts == 4
+    finally:
+        explain.note_defrag_completed(now=0.0)   # reset for other tests
+
+
+def test_explain_names_the_hold():
+    from grove_tpu.scheduler.explain import render_explain
+    payload = {
+        "name": "g", "namespace": "default", "phase": "Pending",
+        "scheduled": False, "assigned_slice": "",
+        "reuse_reservation_ref": "defrag-g", "conditions": [],
+        "diagnosis": {"reason": "SelectorMismatch", "message": "m",
+                      "attempts": 1, "first_failure_time": 0.0,
+                      "requested_chips": 4, "pods": 1,
+                      "pack_level": "slice", "required": True,
+                      "domains": [], "domains_total": 0},
+    }
+    text = "\n".join(render_explain(payload, now=1.0))
+    assert "holds 'defrag-g'" in text
+    # No diagnosis yet (mid-drain): the hold still explains the wait.
+    payload["diagnosis"] = None
+    text = "\n".join(render_explain(payload, now=1.0))
+    assert "relanding onto reservation 'defrag-g'" in text
+
+
+def test_hold_selector_injection():
+    from grove_tpu.scheduler.backends import GangBackend
+    p = _pod("x", "g", 2)
+    assert GangBackend._hold_selector(p, ("", "")) == {}
+    assert GangBackend._hold_selector(p, ("defrag-g", "S")) == {
+        c.LABEL_RESERVATION: "defrag-g"}
+    p.spec.node_selector[c.LABEL_RESERVATION] = "pcs-hold"
+    assert GangBackend._hold_selector(p, ("defrag-g", "S")) == {
+        c.LABEL_RESERVATION: "pcs-hold"}
+
+
+# ---- executor (live cluster, synchronous sweeps) -------------------------
+
+
+def _pcs(name: str, pods: int, chips: int,
+         required: bool = True) -> PodCliqueSet:
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=pods, min_available=pods,
+                tpu_chips_per_pod=chips,
+                container=ContainerSpec(argv=["sleep", "inf"]))],
+            topology=TopologyConstraint(pack_level="slice",
+                                        required=required))))
+
+
+def _manual_cluster(slices: int):
+    """Cluster with the auto defrag controller DISABLED — tests drive
+    their own controller sweep by sweep."""
+    cfg = OperatorConfiguration()
+    cfg.defrag.enabled = False
+    return new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=slices)]))
+
+
+def _live_pods(client, pcs_name=None):
+    sel = {c.LABEL_PCS_NAME: pcs_name} if pcs_name else None
+    return [p for p in client.list(Pod, selector=sel)
+            if p.meta.deletion_timestamp is None]
+
+
+def _fragment(client, slices: int, chips: int = 2):
+    """Fill every host with two ``chips``-chip fillers, then delete one
+    per host: every host ends half-free — the post-churn fragmentation
+    every executor test starts from."""
+    n = slices * 4
+    for i in range(n):
+        client.create(_pcs(f"filler{i}", 1, chips))
+    wait_for(lambda: (lambda ps: len(ps) == n and all(
+        p.status.node_name for p in ps))(_live_pods(client)),
+        30.0, desc="fillers placed")
+    by_host: dict[str, list] = {}
+    for p in _live_pods(client):
+        by_host.setdefault(p.status.node_name, []).append(p)
+    for pods_on_host in by_host.values():
+        client.delete(PodCliqueSet,
+                      pods_on_host[0].meta.labels[c.LABEL_PCS_NAME])
+    wait_for(lambda: len(_live_pods(client)) == n // 2, 20.0,
+             desc="departures pruned")
+
+
+def _stuck_gang(client, name: str):
+    client.create(_pcs(name, 1, 4))
+    gang = f"{name}-0"
+    wait_for(lambda: _diag_reason(client, gang) == "Fragmented", 15.0,
+             desc=f"{gang} diagnosed Fragmented")
+    return gang
+
+
+def _diag_reason(client, gang: str) -> str:
+    try:
+        d = client.get(PodGang, gang).status.last_diagnosis
+        return d.reason if d is not None else ""
+    except Exception:   # noqa: BLE001 — gang not created yet
+        return ""
+
+
+def _drive(dc: DefragController, client, until, timeout=20.0,
+           desc="migration progress", sampler=None):
+    """Sweep the manual controller until ``until()`` — the synchronous
+    stand-in for its background thread."""
+    from timing import TIME_SCALE
+    deadline = time.time() + timeout * TIME_SCALE
+    while time.time() < deadline:
+        dc.sweep()
+        if sampler is not None:
+            sampler()
+        if until():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out driving defrag: {desc}")
+
+
+def test_migration_is_gang_atomic_and_unwedges():
+    cluster = _manual_cluster(slices=2)
+    with cluster:
+        client = cluster.client
+        _fragment(client, slices=2)
+        stuck = _stuck_gang(client, "stuck")
+        cfg = OperatorConfiguration().defrag
+        cfg.cooldown_seconds = 0.0
+        dc = DefragController(client, cluster.manager.store, cfg)
+
+        victim_bound_before: dict[str, int] = {}
+        samples: list[tuple[str, int, set]] = []
+
+        def sampler():
+            m = dc._active
+            name = m.plan.victim_gang if m is not None else \
+                (samples[-1][0] if samples else "")
+            if not name:
+                return
+            pods = _live_pods(client)
+            mine = [p for p in pods
+                    if p.meta.labels.get(c.LABEL_PODGANG_NAME) == name]
+            bound = sum(1 for p in mine if p.status.node_name)
+            idxs = [p.meta.labels.get(c.LABEL_POD_INDEX) for p in mine]
+            assert len(idxs) == len(set(idxs)), \
+                f"duplicate pod index live for {name}: {idxs}"
+            victim_bound_before.setdefault(name, bound)
+            samples.append((name, bound, {p.meta.name for p in mine}))
+
+        _drive(dc, client,
+               lambda: dc.counters["executed"] >= 1
+               and is_condition_true(
+                   client.get(PodGang, stuck).status.conditions,
+                   c.COND_SCHEDULED),
+               timeout=30.0, desc="fragmented gang unwedged",
+               sampler=sampler)
+
+        # Gang atomicity across every observed sample: never MORE pods
+        # bound than the victim had before the drain (no second live
+        # copy ever runs alongside the original).
+        name = next(iter(victim_bound_before))
+        cap = victim_bound_before[name]
+        assert all(b <= cap for n, b, _ in samples if n == name), samples
+        # Holds fully released: no reservation object, annotation gone.
+        wait_for(lambda: not client.list(SliceReservation), 10.0,
+                 desc="hold released")
+        vic = client.get(PodGang, name)
+        assert c.ANNOTATION_RESERVATION_REF not in vic.meta.annotations
+        # The victim relanded whole on the reserved target.
+        plan = dc._recent[0]["plan"]
+        assert vic.status.assigned_slice == plan["target_slice"]
+        # Fragmented gauge drops once the pass observes the repair.
+        wait_for(lambda: 'grove_gang_unschedulable{reason="Fragmented"} 1'
+                 not in cluster.manager.metrics_text(), 10.0,
+                 desc="Fragmented gauge drop")
+
+
+def test_superseded_plan_aborts_without_eviction():
+    cluster = _manual_cluster(slices=2)
+    with cluster:
+        client = cluster.client
+        _fragment(client, slices=2)
+        _stuck_gang(client, "stuck")
+        cfg = OperatorConfiguration().defrag
+        cfg.cooldown_seconds = 0.0
+        dc = DefragController(client, cluster.manager.store, cfg)
+        dc.sweep()
+        assert dc._active is not None and dc._active.state == "Holding"
+        victim = dc._active.plan.victim_gang
+        pods_before = {p.meta.name for p in _live_pods(client)
+                       if p.meta.labels.get(c.LABEL_PODGANG_NAME) == victim}
+        hold = migration_hold_name(victim)
+        wait_for(lambda: client.get(
+            SliceReservation, hold).status.bound_slices, 10.0,
+            desc="hold bound")
+        # The pending gang disappears before the drain: eviction now
+        # would be pure churn — the executor must abort and release.
+        client.delete(PodCliqueSet, "stuck")
+        wait_for(lambda: not client.list(
+            PodGang, selector={c.LABEL_PCS_NAME: "stuck"}), 10.0,
+            desc="stuck gang gone")
+        _drive(dc, client, lambda: dc.counters["aborted"] >= 1,
+               timeout=10.0, desc="superseded abort")
+        assert dc._recent[0]["outcome"] == "aborted:superseded"
+        # Nothing was evicted; the hold and annotation are gone.
+        pods_after = {p.meta.name for p in _live_pods(client)
+                      if p.meta.labels.get(c.LABEL_PODGANG_NAME) == victim}
+        assert pods_after == pods_before
+        wait_for(lambda: not client.list(SliceReservation), 10.0,
+                 desc="hold released after abort")
+        assert c.ANNOTATION_RESERVATION_REF not in \
+            client.get(PodGang, victim).meta.annotations
+
+
+def test_lost_hold_aborts_and_releases():
+    cluster = _manual_cluster(slices=2)
+    with cluster:
+        client = cluster.client
+        _fragment(client, slices=2)
+        _stuck_gang(client, "stuck")
+        cfg = OperatorConfiguration().defrag
+        cfg.cooldown_seconds = 0.0
+        dc = DefragController(client, cluster.manager.store, cfg)
+        dc.sweep()
+        assert dc._active is not None
+        victim = dc._active.plan.victim_gang
+        # The hold vanishes under the executor (TTL expiry / operator
+        # delete): abort, release the annotation, never drain. The big
+        # cooldown stops the very next sweep from re-planning before
+        # the assertions read the released state.
+        cfg.cooldown_seconds = 3600.0
+        client.delete(SliceReservation, migration_hold_name(victim))
+        _drive(dc, client, lambda: dc.counters["aborted"] >= 1,
+               timeout=10.0, desc="hold-lost abort")
+        assert dc._recent[0]["outcome"] == "aborted:hold-lost"
+        assert c.ANNOTATION_RESERVATION_REF not in \
+            client.get(PodGang, victim).meta.annotations
+
+
+def test_budget_and_cooldown_under_plan_storm():
+    cluster = _manual_cluster(slices=3)
+    with cluster:
+        client = cluster.client
+        _fragment(client, slices=3)
+        _stuck_gang(client, "stuck1")
+        _stuck_gang(client, "stuck2")
+        cfg = OperatorConfiguration().defrag
+        cfg.cooldown_seconds = 0.0
+        cfg.disruption_budget_pods = 1
+        cfg.budget_window_seconds = 3600.0
+        dc = DefragController(client, cluster.manager.store, cfg)
+        _drive(dc, client, lambda: dc.counters["executed"] >= 1,
+               timeout=30.0, desc="first migration")
+        # Two gangs still pending would justify a second plan, but the
+        # window's budget (1 pod) is spent: the storm is throttled.
+        for _ in range(10):
+            dc.sweep()
+        assert dc.counters["proposed"] == 1, dc.counters
+        assert dc._budget_left(time.monotonic()) == 0
+        # Budget restored but a long cooldown: still no second start.
+        cfg.disruption_budget_pods = 10
+        cfg.cooldown_seconds = 3600.0
+        for _ in range(10):
+            dc.sweep()
+        assert dc.counters["proposed"] == 1, dc.counters
+        # Both limits lifted: the second migration goes.
+        cfg.cooldown_seconds = 0.0
+        _drive(dc, client, lambda: dc.counters["executed"] >= 2,
+               timeout=30.0, desc="second migration after budget lift")
+
+
+def test_defrag_off_restores_pre_defrag_behavior(monkeypatch):
+    monkeypatch.setenv(DEFRAG_ENV, "0")
+    cluster = _manual_cluster(slices=2)
+    with cluster:
+        client = cluster.client
+        _fragment(client, slices=2)
+        stuck = _stuck_gang(client, "stuck")
+        dc = DefragController(client, cluster.manager.store,
+                              OperatorConfiguration().defrag)
+        for _ in range(10):
+            dc.sweep()
+            time.sleep(0.02)
+        # No plans, no holds, the gang stays honestly stuck Fragmented.
+        assert dc.counters["proposed"] == 0
+        assert not client.list(SliceReservation)
+        assert _diag_reason(client, stuck) == "Fragmented"
+        assert not is_condition_true(
+            client.get(PodGang, stuck).status.conditions,
+            c.COND_SCHEDULED)
+
+
+def test_expired_hold_clears_the_gang_annotation():
+    """A hold that lapses by TTL (crashed executor, lost manager) must
+    take its gang's reuse-reservation-ref with it — a dangling ref
+    leaves the gang pinned-looking and defrag-ineligible forever."""
+    from grove_tpu.api.reservation import SliceReservationSpec
+    from grove_tpu.defrag import roll_hold_name
+    cluster = _manual_cluster(slices=1)
+    with cluster:
+        client = cluster.client
+        client.create(_pcs("w", 1, 2))
+        wait_for(lambda: client.list(PodGang,
+                                     selector={c.LABEL_PCS_NAME: "w"}),
+                 10.0, desc="gang created")
+        gang = client.list(PodGang, selector={c.LABEL_PCS_NAME: "w"})[0]
+        name = roll_hold_name(gang.meta.name)
+        rsv = SliceReservation(meta=new_meta(name, labels={
+            c.LABEL_HOLD_FOR_GANG: gang.meta.name}))
+        rsv.spec = SliceReservationSpec(
+            slices=[client.list(Node)[0].meta.labels[c.NODE_LABEL_SLICE]],
+            ttl_seconds=0.3)
+        client.create(rsv)
+        client.patch(PodGang, gang.meta.name, {
+            "metadata": {"annotations": {
+                c.ANNOTATION_RESERVATION_REF: name}}})
+        wait_for(lambda: not client.list(SliceReservation), 15.0,
+                 desc="TTL expiry deletes the hold")
+        wait_for(lambda: c.ANNOTATION_RESERVATION_REF not in client.get(
+            PodGang, gang.meta.name).meta.annotations, 10.0,
+            desc="expiry clears the dangling annotation")
+
+
+# ---- roll-safe holds (the PR 8 wedge) ------------------------------------
+
+
+def test_roll_wedge_converges_with_defrag():
+    from grove_tpu.chaos.scenario import run_roll_wedge
+    report = run_roll_wedge(defrag_on=True)
+    assert report["ok"] and report["converged"]
+    assert len(report["wedge_slices"]) == 1
+
+
+@pytest.mark.slow
+def test_roll_wedge_reproduces_with_defrag_off():
+    from grove_tpu.chaos.scenario import run_roll_wedge
+    report = run_roll_wedge(defrag_on=False)
+    assert report["ok"] and report["wedged"]
+
+
+# ---- the churn acceptance (pinned bench) ---------------------------------
+
+
+@pytest.mark.slow
+def test_churn_bench_defrag_on_strictly_beats_off():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from bench_defrag import run_mode
+    on = run_mode(True, slices=2, rounds=2, seed=7)
+    off = run_mode(False, slices=2, rounds=2, seed=7)
+    assert on["placeable_per_1k_chips"] > off["placeable_per_1k_chips"], \
+        (on, off)
+    assert on["placed"] >= 1 and on["migrations"] >= 1, on

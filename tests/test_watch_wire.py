@@ -18,6 +18,7 @@ from grove_tpu.api.podcliqueset import (
     PodCliqueTemplate,
 )
 from grove_tpu.cluster import new_cluster
+from grove_tpu.runtime.errors import ConflictError
 from grove_tpu.store.httpclient import HttpClient, WatchGoneError
 from grove_tpu.store.store import Store
 from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
@@ -148,9 +149,20 @@ def test_http_watch_long_poll(wired):
     time.sleep(0.3)  # let the bootstrap + first long poll settle
     cl.client.create(pcs("watched"))
     wait_for(lambda: len(got) >= 1, timeout=10.0, desc="ADDED arrives")
-    live = cl.client.get(PodCliqueSet, "watched")
-    live.spec.replicas = 2
-    cl.client.update(live)
+    # Conflict-retried spec edit: the PCS controller writes the object
+    # on its own cadence (finalizer, status), so a bare get-update
+    # races it — the same precedent as test_availability's and
+    # test_pod_rolling_update's rollout edits.
+    for _ in range(10):
+        live = cl.client.get(PodCliqueSet, "watched")
+        live.spec.replicas = 2
+        try:
+            cl.client.update(live)
+            break
+        except ConflictError:
+            continue
+    else:
+        raise AssertionError("spec edit on watched kept conflicting")
     t.join(10.0)
     assert not t.is_alive()
     types = [etype for _, etype, _ in got]

@@ -48,6 +48,8 @@ def render(rows: list[dict]) -> str:
                if r.get("metric") == "serving_ttft_p99_ms"]
     serving_tok = [r for r in rows
                    if r.get("metric") == "serving_tokens_per_sec"]
+    defrag = [r for r in rows
+              if r.get("metric") == "defrag_placeable_per_1k_chips"]
     chaos = [r for r in rows if r.get("metric") == "chaos_cycles_ok"]
     chaos_drift = {(r.get("ts"), r.get("seed")): r.get("value")
                    for r in rows
@@ -55,7 +57,7 @@ def render(rows: list[dict]) -> str:
     leader_kills = [r for r in rows
                     if r.get("metric") == "chaos_leader_kill_resume_s"]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
-                "serving-cpu", "chaos-cpu"}
+                "serving-cpu", "chaos-cpu", "defrag-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes]
     failed = [r for r in rows if r.get("value", 0) <= 0]
@@ -110,6 +112,29 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
                 f"| {r.get('value', 0):.0f} | {reasons} "
                 f"| {r.get('pending_s', 0):.1f} |")
+        out.append("")
+    if defrag:
+        out += ["## Defrag churn bench (placeable gangs per 1k chips)",
+                "",
+                "_sustained arrivals + departures over a fragmented "
+                "fleet (tools/bench_defrag.py): slice-packed probe "
+                "gangs only place when the defrag engine consolidates "
+                "the holes — the acceptance is a strict defrag-on win "
+                "(docs/design/defrag.md)_", "",
+                "| when | git | slices | rounds | seed | defrag ON | "
+                "defrag OFF | placed on/off | migrations | chips "
+                "freed |",
+                "|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(defrag, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('slices', '?')} | {r.get('rounds', '?')} "
+                f"| {r.get('seed', '?')} "
+                f"| {r.get('value', 0):.1f} "
+                f"| {r.get('defrag_off', 0):.1f} "
+                f"| {r.get('placed_on', '?')}/{r.get('placed_off', '?')} "
+                f"| {r.get('migrations', '?')} "
+                f"| {r.get('chips_freed', '?')} |")
         out.append("")
     if chaos:
         out += ["## Chaos soak (fault mix + gang invariants)", "",

@@ -1,0 +1,227 @@
+"""Sustained-churn defrag bench: arrivals + departures over a fixed
+fleet, defrag-on vs defrag-off, scored as placeable gangs per 1000
+chips.
+
+Workload shape (seeded, identical for both modes): the fleet is packed
+with 2-chip filler gangs, then one seeded departure per host leaves
+every host 2 chips free — 50% of the fleet free, none of it usable by a
+4-chip pod. Each round a 4-chip slice-packed gang ARRIVES (the
+placeability probe), then DEPARTS, and a seeded filler is churned
+(delete + recreate) so the hole pattern keeps moving. Structurally:
+
+- defrag OFF: no host ever accumulates 4 free chips, so every arrival
+  pends ``Fragmented`` until its deadline — placeable stays ~0;
+- defrag ON:  the planner migrates a filler (2 chips / 1 pod) into
+  another slice's hole, the freed host seats the arrival, and the
+  probe schedules — every round.
+
+The headline, ``defrag_placeable_per_1k_chips``, is arrivals that
+reached Scheduled per 1000 fleet chips; the acceptance is a STRICT
+defrag-on win, pinned by tests/test_defrag.py and appended to
+bench-history (rendered by the defrag section of bench_dashboard.py).
+
+    python tools/bench_defrag.py [--slices 4] [--rounds 5] [--seed 7]
+                                 [--history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait(predicate, timeout_s: float, desc: str) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.03)
+    return False
+
+
+def run_mode(defrag_on: bool, slices: int, rounds: int, seed: int) -> dict:
+    """One full churn run. A fresh cluster per mode; the seed drives
+    every workload choice so both modes see the same abuse."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu.api import Pod, PodCliqueSet, PodGang, constants as c, \
+        new_meta
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import is_condition_true
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.defrag import DEFRAG_ENV, defrag_for
+    from grove_tpu.runtime.timescale import scaled
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    def pcs(name: str, pods: int, chips: int) -> "PodCliqueSet":
+        return PodCliqueSet(
+            meta=new_meta(name),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=pods, min_available=pods,
+                    tpu_chips_per_pod=chips,
+                    container=ContainerSpec(argv=["sleep", "inf"]))],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True))))
+
+    rng = random.Random(seed)
+    prev = os.environ.get(DEFRAG_ENV)
+    os.environ[DEFRAG_ENV] = "1" if defrag_on else "0"
+    cfg = OperatorConfiguration()
+    cfg.defrag.sync_period_seconds = 0.1
+    cfg.defrag.cooldown_seconds = 0.1
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=slices)]))
+    total_chips = slices * 8
+    placed = 0
+    fill_count = slices * 4     # two 2-chip fillers per host
+    next_filler = fill_count
+    t0 = time.time()
+    try:
+        with cluster:
+            client = cluster.client
+
+            def live_pods() -> list:
+                return [p for p in client.list(Pod)
+                        if p.meta.deletion_timestamp is None]
+
+            for i in range(fill_count):
+                client.create(pcs(f"filler{i}", 1, 2))
+            assert _wait(lambda: (lambda ps: len(ps) == fill_count and all(
+                p.status.node_name for p in ps))(live_pods()),
+                scaled(30.0), "fillers placed"), "fillers never placed"
+            # Seeded departures: one filler per host — every host ends
+            # at 2 free chips, the fleet 50% free and fully fragmented.
+            by_host: dict[str, list] = {}
+            for p in live_pods():
+                by_host.setdefault(p.status.node_name, []).append(p)
+            for host in sorted(by_host):
+                victim = rng.choice(by_host[host])
+                client.delete(PodCliqueSet,
+                              victim.meta.labels[c.LABEL_PCS_NAME])
+            assert _wait(
+                lambda: len(live_pods()) == fill_count - len(by_host),
+                scaled(20.0), "departures pruned"), "departures stuck"
+
+            arrival_deadline = scaled(10.0 if defrag_on else 1.5)
+            for r in range(rounds):
+                name = f"probe{r}"
+                client.create(pcs(name, 1, 4))
+                gang = f"{name}-0"
+
+                def scheduled() -> bool:
+                    try:
+                        return is_condition_true(
+                            client.get(PodGang, gang).status.conditions,
+                            c.COND_SCHEDULED)
+                    except Exception:   # noqa: BLE001 — not created yet
+                        return False
+                if _wait(scheduled, arrival_deadline, "probe scheduled"):
+                    placed += 1
+                client.delete(PodCliqueSet, name)
+                _wait(lambda: not [
+                    p for p in live_pods()
+                    if p.meta.labels.get(c.LABEL_PCS_NAME) == name],
+                    scaled(15.0), "probe pruned")
+                # Filler churn: arrival FIRST (the newcomer packs into
+                # some host's hole), then a seeded departure from the
+                # host it landed on — filler identity rotates while the
+                # fragmentation pattern is preserved, so defrag-off can
+                # never luck into a 4-free host through churn alone.
+                fresh = f"filler{next_filler}"
+                next_filler += 1
+                client.create(pcs(fresh, 1, 2))
+                if _wait(lambda: any(
+                        p.status.node_name for p in live_pods()
+                        if p.meta.labels.get(c.LABEL_PCS_NAME) == fresh),
+                        scaled(15.0), "churn arrival placed"):
+                    landed = next(
+                        p.status.node_name for p in live_pods()
+                        if p.meta.labels.get(c.LABEL_PCS_NAME) == fresh)
+                    olds = sorted({
+                        p.meta.labels[c.LABEL_PCS_NAME]
+                        for p in live_pods()
+                        if p.status.node_name == landed
+                        and p.meta.labels.get(c.LABEL_PCS_NAME) != fresh})
+                    if olds:
+                        client.delete(PodCliqueSet, rng.choice(olds))
+                else:
+                    # Nowhere to land (defrag off can pin the fleet at
+                    # 2-free-per-host with nothing movable): withdraw.
+                    client.delete(PodCliqueSet, fresh)
+                _wait(lambda: all(
+                    p.status.node_name or p.spec.scheduling_gates
+                    for p in live_pods()), scaled(15.0), "churn settled")
+            dc = defrag_for(cluster.manager.store)
+            counters = dict(dc.payload()["counters"]) if dc else {}
+    finally:
+        if prev is None:
+            os.environ.pop(DEFRAG_ENV, None)
+        else:
+            os.environ[DEFRAG_ENV] = prev
+    return {
+        "defrag": "on" if defrag_on else "off",
+        "slices": slices, "rounds": rounds, "seed": seed,
+        "total_chips": total_chips,
+        "placed": placed,
+        "placeable_per_1k_chips": round(placed * 1000.0 / total_chips, 2),
+        "migrations": counters.get("executed", 0),
+        "chips_freed": counters.get("chips_freed", 0),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench-defrag")
+    parser.add_argument("--slices", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--history", action="store_true",
+                        help="append defrag_placeable_per_1k_chips rows "
+                             "to bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    on = run_mode(True, args.slices, args.rounds, args.seed)
+    print(json.dumps(on, indent=2))
+    off = run_mode(False, args.slices, args.rounds, args.seed)
+    print(json.dumps(off, indent=2))
+
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "defrag_placeable_per_1k_chips",
+            "value": on["placeable_per_1k_chips"],
+            "unit": "gangs/1k-chips",
+            "defrag_off": off["placeable_per_1k_chips"],
+            "placed_on": on["placed"], "placed_off": off["placed"],
+            "rounds": args.rounds, "slices": args.slices,
+            "seed": args.seed,
+            "migrations": on["migrations"],
+            "chips_freed": on["chips_freed"],
+            "mode": "defrag-cpu",
+        })
+
+    win = on["placeable_per_1k_chips"] > off["placeable_per_1k_chips"]
+    print(f"defrag churn bench: on={on['placeable_per_1k_chips']} vs "
+          f"off={off['placeable_per_1k_chips']} placeable/1k chips "
+          f"({on['placed']}/{args.rounds} vs {off['placed']}/"
+          f"{args.rounds} arrivals, {on['migrations']} migrations) — "
+          + ("defrag-on WINS" if win else "NO WIN (regression)"))
+    return 0 if win else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

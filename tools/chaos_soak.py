@@ -131,7 +131,23 @@ def main(argv=None) -> int:
               "per cycle")
         print("  leader-kill        SIGKILL the manager mid-deploy; "
               "standby takes over")
+        print("  roll-wedge         the PR 8 required-pack roll wedge: "
+              "converges with defrag, reproduces with GROVE_DEFRAG=0")
         print("fault types:", ", ".join(sorted(FAULT_REGISTRY)))
+        return 0
+
+    if args.scenario == "roll-wedge":
+        from grove_tpu.chaos.scenario import run_roll_wedge
+        # Both halves of the acceptance: with defrag the required-pack
+        # roll converges (the hold fences the freed slot); with
+        # GROVE_DEFRAG=0 the PR 8 wedge reproduces exactly as before.
+        on = run_roll_wedge(defrag_on=True)
+        print(json.dumps(on, indent=2))
+        off = run_roll_wedge(defrag_on=False)
+        print(json.dumps(off, indent=2))
+        print(f"roll-wedge OK: defrag-on converged in {on['roll_s']}s on "
+              f"{on['wedge_slices']}; GROVE_DEFRAG=0 wedged on roll "
+              f"{off['attempt']} (pre-defrag behavior intact)")
         return 0
 
     if args.scenario == "leader-kill":

@@ -1,0 +1,197 @@
+"""Defrag smoke: one fragmented 2-slice fleet → plan → migrate → the
+stuck gang becomes schedulable.
+
+The defragmentation engine's CI gate (wired into ``make ci``): brings
+up an in-process cluster with two fake v5e 2x4 slices (2 hosts × 4
+chips each), packs every host half-full with 2-chip filler gangs via
+real churn (fill the fleet, then one seeded departure per host), and
+creates a 4-chip gang no host can hold — ``Fragmented`` by diagnosis,
+16 chips free fleet-wide. Then asserts the whole repair loop:
+
+- the defrag planner proposes a migration (filler off one host onto
+  another slice's hole) and the executor runs hold → drain → rebind,
+- the stuck gang schedules and
+  ``grove_gang_unschedulable{reason="Fragmented"}`` drops to 0,
+- the hold reservation is released (none left) and the victim gang's
+  ``reuse_reservation_ref`` cleared,
+- ``GET /debug/defrag`` + ``grovectl defrag-status`` render the
+  executed plan, and ``grove_defrag_*`` counters moved.
+
+    python tools/defrag_smoke.py [--timeout 40] [--history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="defrag-smoke")
+    parser.add_argument("--timeout", type=float, default=40.0)
+    parser.add_argument("--history", action="store_true",
+                        help="append a defrag_smoke row to "
+                             "bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu import cli
+    from grove_tpu.api import (
+        Pod,
+        PodCliqueSet,
+        PodGang,
+        SliceReservation,
+        constants as c,
+        new_meta,
+    )
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import is_condition_true
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.defrag import defrag_for
+    from grove_tpu.runtime.timescale import scaled
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    def pcs(name: str, pods: int, chips: int) -> PodCliqueSet:
+        return PodCliqueSet(
+            meta=new_meta(name),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=pods, min_available=pods,
+                    tpu_chips_per_pod=chips,
+                    container=ContainerSpec(argv=["sleep", "inf"]))],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True))))
+
+    cfg = OperatorConfiguration()
+    cfg.defrag.sync_period_seconds = 0.1
+    cfg.defrag.cooldown_seconds = 0.0
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=2)]))
+    timeout = scaled(args.timeout)
+    with cluster:
+        client = cluster.client
+        # Fill the fleet with 2-chip fillers (tightest-fit packs them two
+        # per host), then one departure per host: every host 2 chips
+        # free, no host can seat 4 — classic post-churn fragmentation.
+        for i in range(8):
+            client.create(pcs(f"filler{i}", 1, 2))
+        wait_for(lambda: (lambda ps: len(ps) == 8 and all(
+            p.status.node_name for p in ps))(client.list(Pod)),
+            timeout, "fillers placed")
+        by_host: dict[str, list] = {}
+        for p in client.list(Pod):
+            by_host.setdefault(p.status.node_name, []).append(p)
+        assert len(by_host) == 4, f"fillers landed on {len(by_host)} hosts"
+        for pods_on_host in by_host.values():
+            client.delete(PodCliqueSet,
+                          pods_on_host[0].meta.labels[c.LABEL_PCS_NAME])
+        wait_for(lambda: len([p for p in client.list(Pod)
+                              if p.meta.deletion_timestamp is None]) == 4,
+                 timeout, "departures pruned")
+
+        client.create(pcs("stuck", 1, 4))
+        gang_name = "stuck-0"
+
+        def diagnosis():
+            try:
+                return client.get(PodGang, gang_name).status.last_diagnosis
+            except Exception:   # noqa: BLE001 — gang not created yet
+                return None
+        wait_for(lambda: diagnosis() is not None, timeout,
+                 "fragmentation diagnosis")
+        diag = diagnosis()
+        assert diag.reason == "Fragmented", diag
+        t0 = time.time()
+        wait_for(lambda: is_condition_true(
+            client.get(PodGang, gang_name).status.conditions,
+            c.COND_SCHEDULED), timeout, "defrag to unwedge the gang")
+        unwedged_s = time.time() - t0
+
+        dc = defrag_for(cluster.manager.store)
+        assert dc is not None, "defrag controller not registered"
+        # The stuck gang schedules the moment chips free up — the
+        # migration itself completes when the victim relands, a few
+        # sweeps later.
+        wait_for(lambda: dc.payload()["counters"]["executed"] >= 1,
+                 timeout, "migration to complete")
+        counters = dc.payload()["counters"]
+        assert counters["chips_freed"] >= 2, counters
+        # Holds release with the migration; the victim's ref mirror
+        # clears on the scheduler's next status write.
+        wait_for(lambda: not client.list(SliceReservation), timeout,
+                 "migration hold released")
+        wait_for(lambda: not any(
+            g.status.reuse_reservation_ref
+            for g in client.list(PodGang)), timeout,
+            "reuse_reservation_ref mirrors cleared")
+        # The Fragmented gauge must drop with the fix, not linger.
+        wait_for(lambda: 'grove_gang_unschedulable{reason="Fragmented"} 1'
+                 not in cluster.manager.metrics_text(), timeout,
+                 "Fragmented gauge to drop")
+        metrics = cluster.manager.metrics_text()
+        assert "grove_defrag_plans_executed_total 1" in metrics, \
+            [l for l in metrics.splitlines() if "defrag" in l]
+
+        server = ApiServer(cluster, port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli.main(["defrag-status", "--server", url])
+            text = out.getvalue()
+            assert rc == 0, text
+            assert "1 executed" in text and "chips freed" in text, text
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = cli.main(["get", "PodGang", "-o", "table",
+                               "--server", url])
+            table = out.getvalue()
+            assert rc == 0 and "RESERVATION" in table, table
+        finally:
+            server.stop()
+
+    print(f"defrag smoke OK: {gang_name} diagnosed Fragmented, migrated "
+          f"{counters['executed']} gang(s) ({counters['chips_freed']} "
+          f"chips freed), unwedged in {unwedged_s:.2f}s, holds released, "
+          "CLI + gauge verified")
+
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "defrag_smoke_unwedge_s",
+            "value": round(unwedged_s, 3),
+            "unit": "s",
+            "migrations": counters["executed"],
+            "chips_freed": counters["chips_freed"],
+            "mode": "defrag-cpu",
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
