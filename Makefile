@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag docs native lint clean ci render-deploy chaos-smoke chaos-soak
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-failover docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
@@ -73,6 +73,17 @@ bench-defrag:    ## defrag-on vs defrag-off churn bench (CPU only)
 	@# exit 1 unless defrag-on strictly beats defrag-off.
 	$(PY) tools/bench_defrag.py --history
 
+bench-failover:  ## hot-standby vs cold leader takeover at 300 pods (CPU only)
+	@# The HA control plane's proof (docs/design/ha.md): SIGKILL the
+	@# leader mid-300-pod deploy (after a same-size deploy+teardown
+	@# history phase deepens the WAL); the hot standby's promotion —
+	@# epoch fence + WAL-delta warm load from its wire mirror — must
+	@# resume reconcile under the PR 8 budget, strictly faster than
+	@# the cold flock-takeover path, stale-epoch writes provably
+	@# rejected. Appends failover_resume_{warm,cold}_s rows to
+	@# bench-history/history.jsonl.
+	$(PY) tools/bench_failover.py --history
+
 bench-serving:   ## SLO-driven autoscaling under a 4x traffic ramp (CPU only)
 	@# The serving telemetry plane's proof: open-loop Poisson load
 	@# (tools/loadgen.py) against the tiny CPU engine, TTFT p99 breach
@@ -131,6 +142,10 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# the full gang-invariant sweep between cycles — the regression net
 	@# that lets the control plane refactor aggressively (ROADMAP 5).
 	$(PY) tools/chaos_soak.py --mix --seed 7 --cycles 2
+	@# failover smoke: leader subprocess + hot standby on a 1-gang PCS,
+	@# SIGKILL mid-run -> promotion + epoch bump + stale-epoch write
+	@# rejected + reconcile resumed (docs/design/ha.md).
+	$(PY) tools/failover_smoke.py
 	GROVE_CI_TIERS=1 $(PY) tools/ci_budget.py --budget 600 \
 		--label "test suite (core+slow tiers)" -- \
 		$(PY) -m pytest tests/ -q

@@ -160,6 +160,22 @@ class DefragConfig:
 
 
 @dataclasses.dataclass
+class HAConfig:
+    """HA control plane (grove_tpu/ha, proposal 0002): ``enabled``
+    wires a LeaderElector runnable so the manager campaigns (epoch
+    bump + writer fencing) at start — required for multi-replica
+    deployments, inert single-replica overhead otherwise (exactly one
+    extra WAL record per boot). ``replica`` names this process in
+    leadership gauges and /debug/leadership (defaults to
+    $GROVE_REPLICA, then "r0"). The GROVE_HA env var (read live,
+    default on) is the incident kill switch for the whole subsystem —
+    fence checks, campaigns, standby machinery."""
+
+    enabled: bool = False
+    replica: str = ""
+
+
+@dataclasses.dataclass
 class OperatorConfiguration:
     concurrency: ControllerConcurrency = dataclasses.field(
         default_factory=ControllerConcurrency)
@@ -180,6 +196,7 @@ class OperatorConfiguration:
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
     defrag: DefragConfig = dataclasses.field(default_factory=DefragConfig)
+    ha: HAConfig = dataclasses.field(default_factory=HAConfig)
     node_lifecycle: NodeLifecycleConfig = dataclasses.field(
         default_factory=NodeLifecycleConfig)
     profiling: ProfilingConfig = dataclasses.field(

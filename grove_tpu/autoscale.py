@@ -225,8 +225,20 @@ class Autoscaler:
     def stop(self) -> None:
         self._stop.set()
 
+    def pause(self) -> None:
+        """Leadership parking (grove_tpu/ha): a demoted replica's scale
+        writes would be fenced anyway; pausing spares the error noise
+        and the registry churn."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
     def _run(self) -> None:
         while not self._stop.is_set():
+            if getattr(self, "_paused", False):
+                self._stop.wait(self.sync_period)
+                continue
             try:
                 self._pass()
             except Exception:  # noqa: BLE001 - loop survival

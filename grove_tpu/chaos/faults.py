@@ -355,9 +355,76 @@ class AgentKillFault(Fault):
         ctx.log.info("chaos: node agent restarted")
 
 
+class LeaderKillFault(Fault):
+    """A leadership transition mid-chaos (grove_tpu/ha): a rival
+    replica fences the store (epoch bump — exactly what a promoting
+    standby does first) and this manager notices it lost, demoting:
+    controllers park and DROP queued work, expectation stores clear,
+    writer runnables pause. The fence is PROVEN on the spot — a write
+    stamped with the deposed epoch must come back FencedError, else
+    inject raises and the fault doesn't count toward coverage. Heal
+    re-campaigns (promote: epoch bump past the rival, stamp, resync) —
+    the soak's recovery waits then prove reconcile resumes cleanly,
+    exercising transitions continuously as the ISSUE demands.
+
+    Public-surface note: demote/promote are the manager's own
+    leadership API (what the elector drives) and the epoch bump is the
+    store's fencing verb — the same calls a real rival performs, like
+    AgentKillFault killing kubelets through their pool."""
+
+    name = "leader-kill"
+
+    def __init__(self) -> None:
+        self._deposed = False
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        from grove_tpu.api import PodCliqueSet
+        from grove_tpu.ha import ha_enabled
+        from grove_tpu.runtime.errors import FencedError
+        from grove_tpu.store.client import Client
+
+        if not ha_enabled():
+            # GROVE_HA=0 disables the fence on purpose: a transition
+            # fault cannot prove (or exercise) anything — no-op, not
+            # a false "guard is broken" failure.
+            ctx.log.info("chaos: leader-kill skipped (GROVE_HA=0)")
+            return False
+        mgr = ctx.cluster.manager
+        store = mgr.store
+        rival_epoch = store.bump_epoch()        # the rival fences
+        dropped = mgr.demote(leader_hint="chaos-rival")
+        self._deposed = True
+        # Prove the fence: a write carrying the PRE-rival epoch (what
+        # this manager's in-flight reconciles still hold) must be
+        # rejected at the store.
+        probe = Client(store)
+        probe.epoch = rival_epoch - 1
+        try:
+            probe.patch_status(PodCliqueSet, ctx.workload_pcs, {},
+                               namespace=ctx.namespace)
+        except FencedError:
+            ctx.log.info("chaos: leadership lost at epoch %d (%d queued "
+                         "items dropped); stale-epoch write fenced as "
+                         "required", rival_epoch, dropped)
+            return True
+        except (NotFoundError, GroveError):
+            pass
+        raise AssertionError(
+            "epoch fence did not fire: a stale-epoch write was accepted "
+            "after the rival's bump — the zombie-leader guard is broken")
+
+    def heal(self, ctx: ChaosContext) -> None:
+        if not self._deposed:
+            return
+        epoch = ctx.cluster.manager.promote()   # re-campaign
+        self._deposed = False
+        ctx.log.info("chaos: re-promoted at epoch %d", epoch)
+
+
 # name -> factory; the scenario runner samples these from its seed.
 FAULT_REGISTRY: dict[str, type[Fault]] = {
     f.name: f for f in (NodeHeartbeatLossFault, NodeDeleteFault,
                         PreemptionStormFault, WatchGapFault,
-                        AutoscaleFlapFault, AgentKillFault)
+                        AutoscaleFlapFault, AgentKillFault,
+                        LeaderKillFault)
 }

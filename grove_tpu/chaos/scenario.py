@@ -66,6 +66,12 @@ SCENARIOS: dict[str, list[str]] = {
     "watch-gap": ["watch-gap"],
     "autoscale-flap": ["autoscale-flap"],
     "agent-restart": ["agent-kill"],
+    # In-process leadership transitions (grove_tpu/ha): rival fences,
+    # manager demotes (queue drop + expectations clear), fence proven,
+    # re-promotion warm-starts reconcile — every cycle. The subprocess
+    # kill-the-leader bench is the separate "leader-kill" scenario
+    # (run_leader_kill, tools/chaos_soak.py).
+    "leadership": ["leader-kill"],
 }
 MIX_FAULTS_PER_CYCLE = 4
 
@@ -671,12 +677,77 @@ state_dir = {state_dir!r}
 progress = {progress!r}
 pods_per_gang = {pods_per_gang}
 gangs = {gangs}
+serve_port_file = {serve_port_file!r}
 
 hosts = max(4, (pods_per_gang * gangs) // 64)
-cl = new_cluster(state_dir=state_dir, fleet=FleetSpec(slices=[
+config = None
+if serve_port_file:
+    # Hot-standby variant: the leader serves HTTP so the standby can
+    # mirror it; a system token lets the standby see Secret events
+    # (an anonymous watch censors them, breaking mirror contiguity
+    # and with it the warm-load fast path).
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    config = OperatorConfiguration()
+    config.server_auth.tokens["chaos-standby"] = OPERATOR_ACTOR
+cl = new_cluster(config=config, state_dir=state_dir,
+                 fleet=FleetSpec(slices=[
     SliceSpec(generation="v5e", topology="4x4",
               count=max(1, hosts // 4))]))
 with cl:
+    if serve_port_file:
+        from grove_tpu.server import ApiServer
+        srv = ApiServer(cl, port=0)
+        srv.start()
+        tmp = serve_port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(srv.port))
+        os.replace(tmp, serve_port_file)
+    # History phase: a full same-size deploy + teardown BEFORE the
+    # measured one gives the state dir a production-depth WAL+snapshot
+    # (creates, binds, readiness churn, cascade deletes — compaction
+    # included once past the threshold). A control plane that dies has
+    # usually been RUNNING; a takeover bench against a near-empty WAL
+    # would hide exactly the load cost the hot standby exists to skip.
+    def _mk(name):
+        return PodCliqueSet(
+            meta=new_meta(name),
+            spec=PodCliqueSetSpec(replicas=gangs,
+                                  template=PodCliqueSetTemplate(
+                startup_type=StartupType.ANY_ORDER,
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=pods_per_gang,
+                    min_available=pods_per_gang, tpu_chips_per_pod=0,
+                    container=ContainerSpec(argv=["sleep", "inf"]))])))
+    cl.client.create(_mk("ha-warmup"))
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if cl.client.get(PodCliqueSet, "ha-warmup") \\
+                .status.available_replicas >= gangs:
+            break
+        time.sleep(0.1)
+    cl.client.delete(PodCliqueSet, "ha-warmup")
+    # The drain gets its OWN deadline and must complete: teardown
+    # deletes bleeding into the measured deploy would spend the kill
+    # threshold on delete records and land the kill before the first
+    # pod create.
+    drain_deadline = time.time() + 180
+    while time.time() < drain_deadline and cl.client.list(
+            Pod, selector={{c.LABEL_PCS_NAME: "ha-warmup"}}):
+        time.sleep(0.1)
+    time.sleep(1.0)     # let trailing cascade deletes settle
+    # Fold the warmup history into the snapshot NOW: the measured
+    # deploy then starts with a fresh WAL, so the in-operation
+    # compactor's rotation (threshold crossing) cannot land inside the
+    # kill window and orphan a segment the takeover must fall back on.
+    # Cold still pays the full snapshot decode; the mirror covers it.
+    cl.manager.store.compact_now()
+    # Deploy only once the bench is ready (hot variant: the standby
+    # must be seeded and watching before the burst, as a real warm
+    # replica would be; the parent touches the marker).
+    ready_file = {ready_file!r}
+    while ready_file and not os.path.exists(ready_file):
+        time.sleep(0.02)
     cl.client.create(PodCliqueSet(
         meta=new_meta("ha-deploy"),
         spec=PodCliqueSetSpec(replicas=gangs,
@@ -697,11 +768,75 @@ with cl:
 """
 
 
+# The assassin: a DEDICATED process that watches the leader's WAL and
+# SIGKILLs it at a record-count threshold. Neither the leader (whose
+# GIL is saturated by the deploy) nor the bench parent (whose GIL a
+# hot standby's mirror decode saturates) can deliver a timely kill —
+# both biases land the kill AFTER the deploy completes in exactly one
+# of the warm/cold variants, silently making them measure different
+# recovery paths. A third process has no other load in either mode,
+# and the WAL is appended SYNCHRONOUSLY inside every store write (the
+# progress file the leader maintains lags by a whole GIL-stretched
+# tick — hundreds of creates during a burst), so counting WAL records
+# pins the kill within a few milliseconds of the threshold write. Its
+# stamp is the authoritative t_kill.
+_KILL_WATCHER = """
+import os, signal, sys, time
+wal, progress, pid, kill_records, stamp = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5])
+
+
+def count() -> int:
+    try:
+        with open(wal, "rb") as f:
+            return f.read().count(b"\\n")
+    except OSError:
+        return 0
+
+
+# Anchor at the DEPLOY's start, not the process's: cluster bring-up
+# (fleet nodes, topology) writes its own WAL records; the progress
+# file appears when the leader has created the PodCliqueSet. Appends
+# are accumulated as DELTAS because compaction rotates the live WAL
+# (the line count drops to ~0 at every rotation — a raw threshold
+# would never fire on a leader whose history phase compacted).
+while not os.path.exists(progress):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        sys.exit(0)
+    time.sleep(0.002)
+prev = count()
+appended = 0
+while True:
+    n = count()
+    if n > prev:
+        appended += n - prev
+    prev = n                        # n < prev means a rotation reset
+    if appended >= kill_records:
+        with open(stamp + ".tmp", "w") as f:
+            f.write(repr(time.time()))
+        os.replace(stamp + ".tmp", stamp)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        break
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        break                       # leader died early; nothing to kill
+    time.sleep(0.002)
+"""
+
+
 def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
                     state_dir: str | None = None,
                     kill_fraction: float = 0.2,
                     resume_budget_s: float = 30.0,
-                    deploy_timeout_s: float = 120.0) -> dict:
+                    deploy_timeout_s: float = 120.0,
+                    hot_standby: bool = False) -> dict:
     """SIGKILL the manager mid-deploy; the standby fences and takes
     over (flock + lease, store/persist.py — proposal 0002's acceptance
     bench). Asserts: no orphaned pods, no duplicated pods, the deploy
@@ -711,7 +846,15 @@ def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
 
     The leader is a real child process running the full control plane
     against ``state_dir``; this process plays the standby — a different
-    pid, so the flock/lease takeover path is the genuine article."""
+    pid, so the flock/lease takeover path is the genuine article.
+
+    ``hot_standby=True`` is the grove_tpu/ha variant: the child also
+    serves HTTP, this process runs a ``HotStandby`` mirroring it over
+    the watch stream for the whole deploy, and takeover goes through
+    ``HotStandby.promote()`` — fence (epoch bump), WAL-delta warm load
+    from the mirror's rv, warm-start reconcile. The report gains
+    ``mode``/``load`` so the bench can pin warm strictly faster than
+    the cold path on the same seed."""
     import signal
     import subprocess
     import sys
@@ -729,11 +872,15 @@ def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
     log.info("leader-kill workdir (state dir + leader log): %s", workdir)
     state_dir = state_dir or os.path.join(workdir, "state")
     progress = os.path.join(workdir, "progress")
+    port_file = os.path.join(workdir, "port") if hot_standby else ""
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
+    threshold = max(1, int(pods * kill_fraction))
+    ready_file = os.path.join(workdir, "ready")
     child_code = textwrap.dedent(_LEADER_CHILD).format(
         state_dir=state_dir, progress=progress,
-        pods_per_gang=pods_per_gang, gangs=gangs)
+        pods_per_gang=pods_per_gang, gangs=gangs,
+        serve_port_file=port_file, ready_file=ready_file)
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=repo + os.pathsep + os.environ.get(
                    "PYTHONPATH", ""))
@@ -746,7 +893,17 @@ def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
     child_log = open(child_log_path, "wb")
     leader = subprocess.Popen([sys.executable, "-c", child_code], env=env,
                               stdout=child_log, stderr=child_log)
-    threshold = max(1, int(pods * kill_fraction))
+    kill_stamp = os.path.join(workdir, "killstamp")
+    # Threshold in WAL records past the deploy's start: the deploy
+    # phase is dominated by creates (pods + their gang/clique/pcs
+    # parents), so records ≈ pods-created — undershooting keeps the
+    # kill safely mid-deploy.
+    watcher = subprocess.Popen(
+        [sys.executable, "-c", _KILL_WATCHER,
+         os.path.join(state_dir, "wal.jsonl"), progress,
+         str(leader.pid), str(threshold), kill_stamp],
+        env=env)
+    hot = None
     try:
         def progress_count() -> int:
             try:
@@ -755,40 +912,99 @@ def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
             except (OSError, ValueError):
                 return 0
 
-        _wait(lambda: leader.poll() is not None
-              or progress_count() >= threshold,
-              deploy_timeout_s, f"leader to create >= {threshold} pods",
-              interval=0.005)   # tight: the kill should land mid-burst
-        if leader.poll() is not None:
+        def _leader_died(what: str) -> "AssertionError":
             child_log.flush()
             with open(child_log_path, "rb") as f:
                 tail = f.read()[-2000:]
-            raise AssertionError(
-                f"leader died before the kill point: "
+            return AssertionError(
+                f"leader died before {what}: "
                 f"{tail.decode(errors='replace')}")
+
+        if hot_standby:
+            # The standby warms up while the leader is alive: mirror
+            # seeded from a full relist, then fed by the watch stream —
+            # all the decode work promotion would otherwise pay.
+            from grove_tpu.ha.standby import HotStandby
+            _wait(lambda: leader.poll() is not None
+                  or os.path.exists(port_file),
+                  deploy_timeout_s, "leader HTTP server up")
+            if leader.poll() is not None:
+                raise _leader_died("serving")
+            with open(port_file) as f:
+                port = int(f.read().strip())
+            hot = HotStandby(f"http://127.0.0.1:{port}",
+                             state_dir=state_dir, token="chaos-standby",
+                             replica="chaos-standby")
+            hot.start()
+        # Green-light the deploy (the child holds the PCS create until
+        # the standby — when there is one — is seeded and watching).
+        with open(ready_file + ".tmp", "w") as f:
+            f.write("go")
+        os.replace(ready_file + ".tmp", ready_file)
+        # The watcher process SIGKILLs the leader at the threshold (see
+        # _KILL_WATCHER for why neither this process nor the leader
+        # can): wait for the death it delivers.
+        _wait(lambda: leader.poll() is not None, deploy_timeout_s,
+              f"the watcher to kill the leader at >= {threshold} pods",
+              interval=0.005)
+        if leader.returncode != -signal.SIGKILL:
+            raise _leader_died(f"the kill point (exit "
+                               f"{leader.returncode})")
+        try:
+            with open(kill_stamp) as f:
+                t_kill = float(f.read().strip())
+        except (OSError, ValueError):
+            t_kill = time.time()    # stamp lost: parent detection time
         pods_at_kill = progress_count()
-        leader.send_signal(signal.SIGKILL)
-        t_kill = time.time()
-        leader.wait(timeout=10)
         log.info("leader SIGKILLed at %d/%d pods", pods_at_kill, pods)
     except BaseException:
         if leader.poll() is None:
             leader.kill()
+        if watcher.poll() is None:
+            watcher.kill()
         raise
     finally:
         child_log.close()
+        try:
+            watcher.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            watcher.kill()
 
-    # Standby takeover: the kernel released the dead leader's flock;
-    # load snapshot+WAL and resume reconciling from loaded state.
-    store = Store(state_dir=state_dir, takeover_wait=True)
-    standby = new_cluster(store=store)
+    # Takeover: the kernel released the dead leader's flock. Cold path
+    # loads snapshot+full-WAL into a fresh cluster; hot path promotes
+    # the warm standby (fence -> WAL-delta load -> warm start). The
+    # load phase is timed separately in both: it is the component the
+    # warm path optimizes, and the end-to-end resume on a throttled
+    # box is too noisy to show it alone.
+    phases: dict = {}
+    if hot is not None:
+        standby = hot.promote()
+        store = standby.manager.store
+        phases = dict(hot.last_promotion)
+        # promote() started the cluster, so a pod count here would
+        # include post-start creates; the pre-start count is the
+        # mirror's (what the new leader actually LOADED).
+        loaded_pods = sum(1 for (k, _, _) in hot.mirror_snapshot()[0]
+                          if k == "Pod")
+    else:
+        t_to = time.perf_counter()
+        store = Store(state_dir=state_dir, takeover_wait=True)
+        phases["load_s"] = round(time.perf_counter() - t_to, 4)
+        standby = new_cluster(store=store)
+        loaded_pods = len(standby.client.list(
+            Pod, selector={c.LABEL_PCS_NAME: "ha-deploy"}))
+        phases["total_s"] = round(time.perf_counter() - t_to, 4)
     client = standby.client
     sel = {c.LABEL_PCS_NAME: "ha-deploy"}
-    loaded_pods = len(client.list(Pod, selector=sel))
     report: dict = {
         "pods": pods, "gangs": gangs,
         "pods_at_kill": pods_at_kill,
         "pods_loaded": loaded_pods,
+        "mode": "warm" if hot is not None else "cold",
+        "epoch": store.fencing_epoch(),
+        "load": dict(store._persister.last_load)
+        if store._persister is not None else {},
+        "phases": phases,
     }
     with standby:
         # Resumed = the new leader makes PROGRESS, not just loads: the
@@ -816,6 +1032,23 @@ def run_leader_kill(pods: int = 300, pods_per_gang: int = 12,
                  if p.meta.deletion_timestamp is None]
         assert len(final) == pods, \
             f"{len(final)} pods after failover, expected exactly {pods}"
+
+        # Epoch fence proof (warm path — promotion bumped the epoch):
+        # a write still stamped with the dead leader's term must be
+        # REJECTED at the store, observably. This is the zombie-leader
+        # guard the whole epoch machinery exists for.
+        if report["epoch"] > 0:
+            from grove_tpu.runtime.errors import FencedError
+            from grove_tpu.store.client import Client as _Client
+            probe = _Client(store)
+            probe.epoch = report["epoch"] - 1
+            try:
+                probe.patch_status(PodCliqueSet, "ha-deploy", {})
+                raise AssertionError(
+                    "stale-epoch write ACCEPTED after promotion — the "
+                    "zombie-leader fence is broken")
+            except FencedError:
+                report["fence_proven"] = True
 
         checker = InvariantChecker(standby)
         violations = (checker.check_live_owner()

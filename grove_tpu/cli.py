@@ -17,6 +17,7 @@ demo/e2e driver.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -94,15 +95,17 @@ def _table(rows) -> None:
         print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
 
 
-def _build_cluster(args: argparse.Namespace):
-    """Shared bring-up for run/serve: config, fleet, --real agent."""
-    import os
+def _serve_config(args: argparse.Namespace):
+    """Config for a serve-shaped command: --config file plus bearer
+    tokens from --token-file/$GROVE_TOKEN_FILE (kube --token-auth-file
+    analog; the deploy bundle mounts its Secret here). None when
+    neither is given. Shared by the leader path and the standby path —
+    a promoted standby must honor the same tokens the dead leader did,
+    or failover silently locks every operator out."""
     config = None
-    if args.config:
+    if getattr(args, "config", None):
         from grove_tpu.api.config import load_config
         config = load_config(args.config)
-    # Bearer tokens from a file (kube --token-auth-file analog; the
-    # deploy bundle mounts its Secret here via GROVE_TOKEN_FILE).
     token_file = (getattr(args, "token_file", None)
                   or os.environ.get("GROVE_TOKEN_FILE"))
     if token_file:
@@ -111,8 +114,20 @@ def _build_cluster(args: argparse.Namespace):
         if config is None:
             config = OperatorConfiguration()
         config.server_auth.tokens.update(load_token_file(token_file))
+    return config
+
+
+def _build_cluster(args: argparse.Namespace):
+    """Shared bring-up for run/serve: config, fleet, --real agent."""
+    config = _serve_config(args)
     state_dir = getattr(args, "state_dir", None)
     takeover = bool(getattr(args, "takeover", False))
+    if getattr(args, "replica", None):
+        from grove_tpu.api.config import OperatorConfiguration
+        if config is None:
+            config = OperatorConfiguration()
+        config.ha.replica = args.replica
+        config.ha.enabled = True    # naming a replica implies HA intent
     if takeover and state_dir:
         print(f"standing by for state-dir lease {state_dir!r} "
               "(takes over when the current holder exits)",
@@ -179,6 +194,21 @@ def cmd_run(args: argparse.Namespace) -> int:
 def cmd_serve(args: argparse.Namespace) -> int:
     """Long-running daemon: control plane + HTTP API."""
     from grove_tpu.server import ApiServer
+    if getattr(args, "standby", False):
+        if not getattr(args, "peer", None):
+            print("error: --standby requires --peer <leader-url>",
+                  file=sys.stderr)
+            return 1
+        if not getattr(args, "state_dir", None):
+            # Without the shared state dir a promotion would come up on
+            # an EMPTY in-memory store (the mirror is a cache, not the
+            # durable state) and without the flock nothing would stop
+            # split-brain on a partition false-positive.
+            print("error: --standby requires --state-dir (the shared "
+                  "durable state the promotion loads and flocks)",
+                  file=sys.stderr)
+            return 1
+        return _serve_standby(args)
     cluster = _build_cluster(args)
     try:
         with cluster:
@@ -253,12 +283,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
 def _http(server: str, path: str, method: str = "GET",
           body: bytes | None = None,
           content_type: str = "application/yaml",
-          token: str | None = None, ca: str | None = None):
+          token: str | None = None, ca: str | None = None,
+          _followed: bool = False):
     """Request against a serve daemon. Returns (status, decoded-body);
     status 0 = could not reach the server. Shared by the client verbs and
     the server tests. ``token`` (default: $GROVE_API_TOKEN) authenticates
     mutating verbs; ``ca`` (default: $GROVE_API_CA) pins the TLS CA for
-    https:// servers."""
+    https:// servers. A 503 whose body names the leader (a standby
+    refusing a write — grove_tpu/ha) retries once against the hint, so
+    grovectl pointed at any replica just works."""
     import json as _json
     import os as _os
     import urllib.error
@@ -300,8 +333,14 @@ def _http(server: str, path: str, method: str = "GET",
             raw = e.read()
         except (OSError, _hc.HTTPException):
             raw = b""
-        return e.code, decode(raw,
-                              e.headers.get("Content-Type", "") or "json")
+        decoded = decode(raw, e.headers.get("Content-Type", "") or "json")
+        hint = (decoded.get("leader") or ""
+                if isinstance(decoded, dict) else "")
+        if e.code == 503 and hint and not _followed \
+                and hint.rstrip("/") != server.rstrip("/"):
+            return _http(hint.rstrip("/"), path, method, body,
+                         content_type, token, ca, _followed=True)
+        return e.code, decoded
     except urllib.error.URLError as e:
         return 0, {"error": f"cannot reach {server}: {e.reason}"}
 
@@ -712,6 +751,98 @@ def cmd_defrag_status(args: argparse.Namespace) -> int:
     for line in render_defrag_status(data, time.time()):
         print(line)
     return 0 if data.get("enabled") else 1
+
+
+def cmd_leader_status(args: argparse.Namespace) -> int:
+    """Render a replica's leadership view (GET /debug/leadership):
+    role, fencing epoch (this replica's claim AND the store's — a
+    mismatch means the replica was fenced), transitions, and the
+    leader hint a standby redirects writes to. Exit 0 when the queried
+    replica leads un-fenced, 1 otherwise (scripts can probe 'is this
+    the leader' with it)."""
+    status, data = _http(args.server, "/debug/leadership", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    role = data.get("role", "?")
+    print(f"replica:      {data.get('replica', '?')}")
+    print(f"role:         {role}")
+    epoch = data.get("epoch", 0)
+    store_epoch = data.get("store_epoch")
+    line = f"epoch:        {epoch}"
+    if store_epoch is not None and store_epoch != epoch:
+        line += f"  (store at {store_epoch} — this replica is FENCED)"
+    print(line)
+    print(f"transitions:  {data.get('transitions', 0)}")
+    print(f"since:        {data.get('since_s', 0.0):.1f}s")
+    if data.get("leader_hint"):
+        print(f"leader:       {data['leader_hint']}")
+    if not data.get("ha_enabled", True):
+        print("ha:           DISABLED (GROVE_HA=0)")
+    fenced = bool(data.get("fenced"))
+    return 0 if role == "leader" and not fenced else 1
+
+
+def _serve_standby(args: argparse.Namespace) -> int:
+    """``serve --standby --peer <leader-url>``: run as a hot standby —
+    wire mirror of the leader kept warm, reads served locally, writes
+    refused with a leader hint — and PROMOTE when the leader stops
+    answering health probes (the lease fence in store/persist.py
+    guards the state dir itself, so a network-split false positive
+    blocks on the flock instead of going split-brain). After
+    promotion the process re-execs the normal serve path on the same
+    port."""
+    from grove_tpu.ha.standby import HotStandby, StandbyServer
+    from grove_tpu.server import ApiServer
+
+    standby = HotStandby(args.peer, state_dir=args.state_dir,
+                         token=os.environ.get("GROVE_API_TOKEN", ""),
+                         replica=args.replica or "standby",
+                         ca_file=args.ca or "")
+    standby.start()
+    server = StandbyServer(standby, host=args.host, port=args.port)
+    server.start()
+    print(f"grove-tpu hot standby on http://{args.host}:{server.port} "
+          f"(mirroring {args.peer}; ctrl-c to stop)")
+    misses = 0
+    try:
+        while True:
+            time.sleep(1.0)
+            status, _ = _http(args.peer, "/healthz", ca=args.ca)
+            misses = misses + 1 if status == 0 else 0
+            if misses >= 3:
+                print(f"leader {args.peer} unreachable x{misses}; "
+                      "promoting", file=sys.stderr)
+                break
+    except KeyboardInterrupt:
+        server.stop()
+        return 0
+    server.stop()                    # free the port for the real server
+    config = _serve_config(args)
+    cluster = standby.promote(config=config)
+    # Same bootstrap-credential rule as the leader path: a promoted
+    # control plane with no configured tokens must print one, or
+    # failover locks every remote operator out.
+    auth = cluster.manager.config.server_auth
+    if not auth.tokens and not auth.allow_anonymous_mutations:
+        import secrets
+        from grove_tpu.admission.authorization import OPERATOR_ACTOR
+        bootstrap = secrets.token_urlsafe(24)
+        auth.tokens[bootstrap] = OPERATOR_ACTOR
+        print(f"api token (generated at promotion): {bootstrap}\n"
+              f"  export GROVE_API_TOKEN={bootstrap}")
+    api = ApiServer(cluster, host=args.host, port=args.port)
+    api.start()
+    print(f"promoted: control plane serving on "
+          f"http://{args.host}:{api.port} "
+          f"(epoch {cluster.manager.store.fencing_epoch()})")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        api.stop()
+        cluster.stop()
+    return 0
 
 
 def cmd_apply(args: argparse.Namespace) -> int:
@@ -1196,6 +1327,14 @@ def main(argv: list[str] | None = None) -> int:
     add_ca(dfs)
     dfs.set_defaults(fn=cmd_defrag_status)
 
+    ls = sub.add_parser(
+        "leader-status",
+        help="leadership view of a replica: role, fencing epoch, "
+             "transitions, leader hint (exit 0 = an un-fenced leader)")
+    ls.add_argument("--server", default=default_server)
+    add_ca(ls)
+    ls.set_defaults(fn=cmd_leader_status)
+
     for verb in ("cordon", "uncordon"):
         cp = sub.add_parser(verb, help=f"{verb} a node "
                             "(kubectl analog; cordon takes --drain)")
@@ -1282,6 +1421,19 @@ def main(argv: list[str] | None = None) -> int:
                             "wait as a standby and take over when the "
                             "holder exits (leader-election analog); "
                             "default is to refuse immediately")
+    serve.add_argument("--standby", action="store_true",
+                       help="run as a HOT standby of --peer: mirror its "
+                            "state over the watch stream, serve reads, "
+                            "refuse writes with a leader hint, and "
+                            "promote (epoch-fenced warm start) when the "
+                            "leader dies (grove_tpu/ha)")
+    serve.add_argument("--peer", help="the leader's URL for --standby")
+    serve.add_argument("--replica",
+                       help="this replica's name in leadership gauges "
+                            "and /debug/leadership (default $GROVE_REPLICA"
+                            " or r0/standby)")
+    serve.add_argument("--ca", help="CA certificate to pin for an https "
+                                    "--peer (default $GROVE_API_CA)")
     serve.set_defaults(fn=cmd_serve)
 
     agent_p = sub.add_parser(

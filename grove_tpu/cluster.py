@@ -96,8 +96,11 @@ def new_cluster(config: OperatorConfiguration | None = None,
         from grove_tpu.autoscale import Autoscaler, MetricsRegistry
         from grove_tpu.runtime.servingwatch import ServingObserver
         metrics = MetricsRegistry()
+        # Writer runnables take the manager's LEADER client so a
+        # leadership transition fences their writes (grove_tpu/ha);
+        # read-only observers and the kubelet pool stay on mgr.client.
         mgr.add_runnable(Autoscaler(
-            mgr.client, metrics,
+            mgr.leader_client, metrics,
             sync_period=mgr.config.autoscaler.sync_period_seconds,
             scale_down_stabilization=mgr.config.autoscaler
             .scale_down_stabilization_seconds))
@@ -115,14 +118,21 @@ def new_cluster(config: OperatorConfiguration | None = None,
         # diagnoses and migrates gangs to consolidate fragmented free
         # capacity; GROVE_DEFRAG=0 no-ops every sweep without rewiring.
         from grove_tpu.defrag import DefragController
-        mgr.add_runnable(DefragController(mgr.client, mgr.store,
+        mgr.add_runnable(DefragController(mgr.leader_client, mgr.store,
                                           mgr.config.defrag))
+    if mgr.config.ha.enabled:
+        # HA leadership (grove_tpu/ha): the elector campaigns at
+        # manager start — epoch bump, writer fencing, /debug/leadership
+        # live. Off by default: a single-replica start keeps the exact
+        # pre-HA shape (epoch 0, clients unfenced).
+        from grove_tpu.ha.election import LeaderElector
+        mgr.add_runnable(LeaderElector(mgr, state_dir=state_dir))
     if mgr.config.node_lifecycle.enabled:
         from grove_tpu.controllers.nodelifecycle import (
             NodeLifecycleController,
         )
         mgr.add_runnable(NodeLifecycleController(
-            mgr.client,
+            mgr.leader_client,
             grace_seconds=mgr.config.node_lifecycle.grace_seconds,
             sync_period=mgr.config.node_lifecycle.sync_period_seconds))
     if fleet is not None:

@@ -57,8 +57,20 @@ class NodeLifecycleController:
         if self._thread is not None:
             self._thread.join(2.0)
 
+    def pause(self) -> None:
+        """Leadership parking (grove_tpu/ha): a demoted replica must
+        not fail nodes or evict pods — its writes would be fenced, and
+        the noise would race the real leader's lifecycle decisions."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
     def _run(self) -> None:
         while not self._stop.is_set():
+            if getattr(self, "_paused", False):
+                self._stop.wait(self.sync_period)
+                continue
             try:
                 self._pass()
             except Exception:  # noqa: BLE001 - controller survival

@@ -35,10 +35,13 @@ def _label_requests(label: str):
 
 def register_controllers(mgr: Manager) -> Registry:
     cfg = mgr.config
-    # Schedulers keep the direct client: their read path is the
+    # Schedulers keep a direct client: their read path is the
     # placement snapshot (PR 1), which shares the same per-version
-    # clones the informer caches do.
-    registry = build_registry(cfg, mgr.client)
+    # clones the informer caches do. It is the manager's LEADER client
+    # (not mgr.client) so promotion stamps the scheduler's binds with
+    # the fencing epoch — a deposed replica's in-flight bind must be
+    # rejected, while node agents on mgr.client stay unfenced.
+    registry = build_registry(cfg, mgr.leader_client)
     # Controllers and their event mappers read through the shared
     # informer caches: list-shaped reads become indexed lookups over
     # shared objects instead of per-call store scans. Writes (and point
@@ -76,6 +79,11 @@ def register_controllers(mgr: Manager) -> Registry:
             PodClique, ns, selector={c.LABEL_PCS_NAME: pcs_name})]
 
     pclq_ctrl.watches(["PodGang"], gang_to_pclqs)
+    # Demotion hygiene (grove_tpu/ha): parking the controller clears
+    # its ExpectationsStore — expectations are IOUs against THIS
+    # replica's watch feed, and stale ones surviving a leadership gap
+    # are exactly the SURVEY §7 duplicate-pod hazard.
+    pclq_ctrl.on_park = pclq.expectations.clear
     mgr.add_controller(pclq_ctrl)
 
     pcsg = ScalingGroupReconciler(client)

@@ -185,10 +185,22 @@ class DefragController:
         if _CONTROLLERS.get(self.store) is self:
             del _CONTROLLERS[self.store]
 
+    def pause(self) -> None:
+        """Leadership parking (grove_tpu/ha): a demoted replica must
+        not start (or continue planning) migrations — evictions from a
+        fenced replica would be pure disruption."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
     def _run(self) -> None:
         from grove_tpu.store import writeobs
         writeobs.set_writer("defrag")
         while not self._stop.is_set():
+            if getattr(self, "_paused", False):
+                self._stop.wait(self.cfg.sync_period_seconds)
+                continue
             try:
                 self.sweep()
             except Exception:   # noqa: BLE001 — loop survival barrier

@@ -1,0 +1,55 @@
+"""HA control plane — hot-standby managers with epoch-fenced writes.
+
+ROADMAP item 4 / proposal 0002's full build-out: the reference operator
+runs leader-elected (`operator/cmd/main.go` → Lease-based election);
+this package turns the single-process manager into a 2–3 replica
+control plane:
+
+- ``election.LeaderElector`` — campaign → renew → release over the
+  state dir's flock + lease (store/persist.py), with a monotonic
+  **fencing epoch** persisted through snapshot+WAL. Every control-plane
+  write carries its writer's epoch and the Store rejects stale-epoch
+  writes (``FencedError``) — closing the zombie-leader race SIGKILL
+  fencing alone cannot (a wedged leader can wake up mid-write after
+  the standby promotes).
+- ``standby.HotStandby`` — a warm replica: a wire mirror of every kind
+  kept current over ``resumable_watch_events`` against the leader,
+  controllers and scheduler not running. On ``promote()`` it fences,
+  replays only the WAL delta since its last seen rv
+  (``StatePersister.load_warm``), and warm-starts reconcile.
+- ``standby.StandbyServer`` — serves reads from the mirror; mutating
+  verbs get 503 + a leader hint (clients follow it, see
+  ``HttpClient`` / ``cli._http``).
+
+``GROVE_HA=0`` disables the whole subsystem at runtime: no epoch is
+ever bumped or stamped, the fence check no-ops, and a single-replica
+start behaves exactly as before this package existed.
+
+See docs/design/ha.md for the failover timeline and data flow.
+"""
+
+from __future__ import annotations
+
+import os
+
+HA_ENV = "GROVE_HA"
+
+
+def ha_enabled() -> bool:
+    """Read the kill switch per call (the GROVE_INFORMER idiom):
+    flipping ``GROVE_HA=0`` mid-process restores pre-HA behavior —
+    no fencing, no standby machinery — without rebuilding anything."""
+    return os.environ.get(HA_ENV, "1") != "0"
+
+
+def __getattr__(name: str):
+    # Lazy submodule exports: grove_tpu.ha is imported by the store for
+    # ha_enabled(), and eager election/standby imports from here would
+    # cycle back through store/manager.
+    if name in ("LeaderElector", "LeadershipState"):
+        from grove_tpu.ha import election
+        return getattr(election, name)
+    if name in ("HotStandby", "StandbyServer"):
+        from grove_tpu.ha import standby
+        return getattr(standby, name)
+    raise AttributeError(name)

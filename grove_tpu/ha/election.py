@@ -1,0 +1,180 @@
+"""Leader election with fencing epochs — campaign → renew → release.
+
+Generalizes ``store/persist.py``'s flock + lease single-writer guard
+(the reference's Lease-based election, ``operator/cmd/main.go`` →
+manager.go:55-147, per proposal 0002) into an explicit leadership API:
+
+- **campaign** — take (or confirm) the state dir's exclusive lock, then
+  FENCE: bump the store's monotonic epoch (durable before returning)
+  and stamp the manager's control-plane writers with it. From that
+  moment any write still carrying an older epoch — a deposed leader's
+  straggler reconcile, a zombie thread waking mid-write — is rejected
+  by the store (``FencedError``), which is the guarantee SIGKILL
+  fencing alone cannot give.
+- **renew** — the lease heartbeat (persist.py stamps ``LEASE`` every
+  TTL/5 from a daemon thread for the lock-hold lifetime); ``renew()``
+  re-stamps once explicitly for callers that want a synchronous proof
+  of liveness.
+- **release** — demote the manager (park controllers, drop queued
+  work, clear expectations) and optionally hand back the state-dir
+  lock so a successor in the same process can acquire it.
+
+``LeadershipState`` is the observable half: role, epoch, transitions,
+and timestamps — served at ``/debug/leadership`` and rendered by
+``grovectl leader-status``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Any
+
+from grove_tpu.ha import ha_enabled
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+# store (weakly) -> the LeadershipState of the manager that runs it, so
+# the in-process Client can serve debug_leadership like the other
+# observatory twins (deploywatch.observer_for pattern). Registered at
+# Manager.start(), so a constructed-but-unstarted Manager can't shadow
+# the running one.
+_LEADERSHIP: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def leadership_for(store) -> "LeadershipState | None":
+    return _LEADERSHIP.get(store)
+
+
+def register_leadership(store, state: "LeadershipState") -> None:
+    _LEADERSHIP[store] = state
+
+
+class LeadershipState:
+    """This replica's view of who leads: role, fencing epoch, and the
+    transition ledger. Thread-safe (the server reads while the manager
+    transitions)."""
+
+    def __init__(self, replica: str = ""):
+        self.replica = replica or os.environ.get("GROVE_REPLICA", "r0")
+        self._lock = threading.Lock()
+        self.role = "leader"        # single-replica default: pre-HA shape
+        self.epoch = 0
+        self.leader_hint = ""       # where writes should go when standby
+        self.transitions = 0
+        self.changed_at = time.time()
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == "leader"
+
+    def note_promoted(self, epoch: int) -> None:
+        with self._lock:
+            was = self.role
+            self.role = "leader"
+            self.epoch = epoch
+            self.leader_hint = ""
+            if was != "leader":
+                self.transitions += 1
+            self.changed_at = time.time()
+        GLOBAL_METRICS.set("grove_leader", 1.0, replica=self.replica)
+        GLOBAL_METRICS.set("grove_leadership_epoch", float(epoch))
+        if was != "leader":
+            GLOBAL_METRICS.inc("grove_leadership_transitions_total",
+                               direction="promoted")
+
+    def note_demoted(self, leader_hint: str = "") -> None:
+        with self._lock:
+            was = self.role
+            self.role = "standby"
+            self.leader_hint = leader_hint
+            if was == "leader":
+                self.transitions += 1
+            self.changed_at = time.time()
+        GLOBAL_METRICS.set("grove_leader", 0.0, replica=self.replica)
+        if was == "leader":
+            GLOBAL_METRICS.inc("grove_leadership_transitions_total",
+                               direction="demoted")
+
+    def payload(self, store=None) -> dict:
+        """The /debug/leadership document (one shape for the in-process
+        twin, the wire endpoint, and the standby server)."""
+        with self._lock:
+            out = {
+                "replica": self.replica,
+                "role": self.role,
+                "epoch": self.epoch,
+                "leader_hint": self.leader_hint,
+                "transitions": self.transitions,
+                "since_s": round(time.time() - self.changed_at, 3),
+                "ha_enabled": ha_enabled(),
+            }
+        if store is not None:
+            # The store's epoch is the authority; a mismatch with the
+            # replica's claimed epoch means this replica was fenced.
+            out["store_epoch"] = store.fencing_epoch()
+            out["fenced"] = (out["role"] == "leader"
+                             and out["epoch"] < out["store_epoch"])
+        return out
+
+
+class LeaderElector:
+    """Manager runnable driving campaign/renew/release for one manager.
+
+    The flock acquisition itself rides the manager's Store construction
+    (a persistent Store holds the state-dir lock before its first
+    read); ``campaign()`` is the FENCING half — epoch bump + writer
+    stamping + controller un-parking — and works for in-memory stores
+    too (the epoch just isn't durable). As a runnable it campaigns at
+    ``start()`` when the manager's config enables HA, so a 2-replica
+    deployment is: leader serves, standby blocks in Store construction
+    (takeover_wait) until the lease fences, then its elector campaigns.
+    """
+
+    def __init__(self, manager: Any, state_dir: str | None = None):
+        self.manager = manager
+        self.state_dir = state_dir
+        self.log = get_logger("ha.elector")
+
+    # -- campaign ---------------------------------------------------------
+
+    def campaign(self) -> int:
+        """Fence and lead: bump the store's epoch (durably, when
+        persistent), stamp the manager's writers, un-park controllers,
+        and record the transition. Returns the new epoch (0 with
+        GROVE_HA=0 — the whole ceremony no-ops)."""
+        if not ha_enabled():
+            self.manager.leadership.note_promoted(
+                self.manager.store.fencing_epoch())
+            return 0
+        epoch = self.manager.promote()
+        self.log.info("campaign won: replica=%s epoch=%d",
+                      self.manager.leadership.replica, epoch)
+        return epoch
+
+    def renew(self) -> None:
+        """One synchronous lease re-stamp (the daemon heartbeat does
+        this continuously; explicit renewal is for tests and probes)."""
+        if self.state_dir is not None:
+            from grove_tpu.store.persist import _stamp_lease
+            _stamp_lease(self.state_dir)
+
+    def release(self, hand_back_lock: bool = False) -> None:
+        """Stand down: demote the manager (park + drop + clear); with
+        ``hand_back_lock`` also release the state-dir flock so a
+        successor in this process can acquire it."""
+        self.manager.demote()
+        if hand_back_lock and self.state_dir is not None:
+            from grove_tpu.store.persist import release_state_lock
+            release_state_lock(self.state_dir)
+
+    # -- runnable ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.campaign()
+
+    def stop(self) -> None:
+        pass    # leadership ends with the process (kernel frees the flock)

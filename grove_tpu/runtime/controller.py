@@ -148,6 +148,22 @@ class _DelayQueue:
                 heapq.heappush(self._heap, (now, next(self._seq), req))
                 self._lock.notify()
 
+    def drain(self) -> int:
+        """Drop every queued (not-yet-picked-up) request — demotion
+        hygiene: a deposed leader's backlog was computed under a view
+        a new leader is already rewriting, and replaying it on
+        re-promotion would race the fresh resync. In-flight requests
+        finish (their writes are fenced); their dirty re-adds are
+        dropped with the rest. Returns the number dropped."""
+        with self._lock:
+            n = len(self._pending) + len(self._dirty)
+            self._heap.clear()
+            self._pending.clear()
+            self._dirty.clear()
+            self._ready.clear()
+            self._trace.clear()
+            return n
+
     def shutdown(self) -> None:
         with self._lock:
             self._shutdown = True
@@ -180,6 +196,16 @@ class Controller:
                                       dict[str, str] | None]] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # Leadership parking (grove_tpu/ha): a parked controller's
+        # watches keep flowing into the informer caches, but nothing
+        # reaches the queue and workers drop anything already popped —
+        # a standby/demoted replica observes without reconciling.
+        self._parked = False
+        # Demotion hook (Manager.demote): clears reconciler-owned state
+        # that must not survive a leadership gap (ExpectationsStore —
+        # stale expectations on re-promotion are the SURVEY §7
+        # duplicate-pod hazard). Set by controller registration.
+        self.on_park: Callable[[], Any] | None = None
         self.reconcile_count = 0
         self.error_count = 0
         # Per-request-key reconcile totals (under _count_lock: worker
@@ -210,8 +236,38 @@ class Controller:
         self._watch_specs.append((kinds, mapper, selector))
         return self
 
-    def enqueue(self, req: Request, delay: float = 0.0) -> None:
-        self.queue.add(req, delay)
+    def enqueue(self, req: Request, delay: float = 0.0,
+                trace_id: str = "") -> None:
+        if self._parked:
+            return
+        self.queue.add(req, delay, trace_id=trace_id)
+
+    # ---- leadership parking (grove_tpu/ha) ----
+
+    def park(self) -> int:
+        """Stop reconciling (demotion/standby): drop all queued work
+        and gate new enqueues. Watches keep running — cache freshness
+        is leadership-independent. Returns dropped-item count, and runs
+        the registered on_park hook (expectations clear)."""
+        self._parked = True
+        dropped = self.queue.drain()
+        if self.on_park is not None:
+            try:
+                self.on_park()
+            except Exception:  # noqa: BLE001 — hygiene must not block
+                self.log.exception("on_park hook panicked")
+        return dropped
+
+    def unpark(self) -> None:
+        """Resume reconciling (promotion): re-open the queue, then
+        resync every watch so the backlog rebuilds from LIVE state —
+        the warm-start reconcile (informer caches are already current;
+        the resync is index reads, not store scans)."""
+        if not self._parked:
+            return
+        self._parked = False
+        for kinds, mapper, selector in self._watch_specs:
+            self._resync(kinds, mapper, selector)
 
     # ---- lifecycle ----
 
@@ -254,7 +310,7 @@ class Controller:
                 try:
                     tid = trace_id_of(obj)
                     for req in mapper(Event(EventType.ADDED, obj)):
-                        self.queue.add(req, trace_id=tid)
+                        self.enqueue(req, trace_id=tid)
                 except Exception:  # noqa: BLE001
                     self.log.exception("resync mapper panic")
 
@@ -269,7 +325,7 @@ class Controller:
                 # reconcile it triggers lands in the same trace.
                 tid = trace_id_of(event.obj)
                 for req in mapper(event):
-                    self.queue.add(req, trace_id=tid)
+                    self.enqueue(req, trace_id=tid)
             except Exception:  # noqa: BLE001
                 self.log.exception("watch mapper panic (event dropped)")
 
@@ -277,6 +333,11 @@ class Controller:
         while not self._stop.is_set():
             req = self.queue.get(timeout=0.2)
             if req is None:
+                continue
+            if self._parked:
+                # Popped between drain and the gate closing (or while
+                # parked): a standby must not reconcile.
+                self.queue.done(req)
                 continue
             t0 = time.perf_counter()
             try:
@@ -345,8 +406,8 @@ class Controller:
                     GLOBAL_METRICS.inc("grove_reconcile_requeues_total",
                                        controller=self.name,
                                        reason="requeue_after")
-                    self.queue.add(req, result.requeue_after,
-                                   trace_id=trace_hint)
+                    self.enqueue(req, result.requeue_after,
+                                 trace_id=trace_hint)
         finally:
             writeobs.reset_writer(writer_token)
 
@@ -365,4 +426,4 @@ class Controller:
             "grove_reconcile_requeues_total", controller=self.name,
             reason=reason or ("requeue_after" if override is not None
                               else "backoff"))
-        self.queue.add(req, delay, trace_id=trace_id)
+        self.enqueue(req, delay, trace_id=trace_id)

@@ -41,6 +41,18 @@ class ConflictError(GroveError):
     code = "ERR_CONFLICT"
 
 
+class FencedError(ConflictError):
+    """Write rejected by the leadership fence: the writer's epoch is
+    older than the store's — a deposed leader (or its straggler
+    threads) tried to write after a newer leader fenced the store.
+    A ConflictError subclass so wire mapping (409) and existing
+    conflict handling treat it as a terminal staleness signal, but
+    unlike an rv conflict there is no point re-reading and retrying:
+    the epoch only moves forward."""
+
+    code = "ERR_FENCED"
+
+
 class ValidationError(GroveError):
     code = "ERR_VALIDATION"
 

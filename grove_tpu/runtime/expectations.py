@@ -120,3 +120,18 @@ class ExpectationsStore:
         with self._lock:
             self._by_key.pop(key, None)
             self._export_pending_locked()
+
+    def clear(self) -> int:
+        """Drop EVERY expectation — demotion hygiene (grove_tpu/ha).
+        Expectations are watch-delivery IOUs against THIS replica's
+        informer feed; across a leadership gap the events they await
+        may have been consumed by another leader entirely, and a
+        re-promoted replica acting on the stale ledger would skip (or
+        double-run) mutating sync passes — the SURVEY §7 duplicate-pod
+        hazard verbatim. The next sync recomputes from live state.
+        Returns the number of keys dropped."""
+        with self._lock:
+            n = len(self._by_key)
+            self._by_key.clear()
+            self._export_pending_locked()
+        return n

@@ -2,10 +2,16 @@
 
 Role parity with reference internal/controller/manager.go:55-147 +
 cmd/main.go:44-143. Leader election's single-writer guarantee lives at
-the state-dir instead (flock + standby takeover, store/persist.py
+the state-dir (flock + standby takeover, store/persist.py
 _acquire_state_lock — a second `serve --state-dir X` is refused or
-waits as a standby); webhook TLS is subsumed by admission running
-in-process at the client boundary (see grove_tpu.admission).
+waits as a standby) plus the epoch fence (grove_tpu/ha): ``promote()``
+bumps the store's fencing epoch and stamps this manager's control-plane
+writers with it; ``demote()`` parks controllers (queued work dropped,
+expectations cleared — the SURVEY §7 duplicate-pod hygiene) and pauses
+writer runnables while leaving the stale epoch on the clients, so a
+straggler write after a rival's takeover is REJECTED by the store
+instead of racing the new leader. Webhook TLS is subsumed by admission
+running in-process at the client boundary (see grove_tpu.admission).
 """
 
 from __future__ import annotations
@@ -33,6 +39,30 @@ class Manager:
         setup_logging(self.config.log.level, self.config.log.format)
         self.store = store or Store()
         self.client = client or Client(self.store)
+        # The control plane's OWN writer identity, separate from
+        # self.client: schedulers, node-lifecycle, autoscaler, and
+        # defrag write through this one so promotion can stamp it with
+        # the fencing epoch WITHOUT fencing the data plane (kubelets
+        # and agents keep self.client — in a real failover the node
+        # fleet re-targets the new leader; it is never "deposed").
+        self.leader_client = Client(self.store, self.client.actor)
+        # Leadership view (grove_tpu/ha): single-replica default is
+        # "leader with epoch 0, clients unfenced" — exactly the pre-HA
+        # behavior until someone campaigns (elector, standby promote,
+        # chaos transition).
+        from grove_tpu.ha.election import LeadershipState
+        self.leadership = LeadershipState(
+            replica=getattr(self.config, "ha", None)
+            and self.config.ha.replica or "")
+        self.leadership.epoch = self.store.fencing_epoch()
+        # Stamp the control-plane writers with the CURRENT term from
+        # the start: at epoch N a claim of N is always accepted (no
+        # behavior change for a single replica), but the moment a
+        # rival campaigns (bump to N+1) every write this manager's
+        # controllers/schedulers still have in flight is fenced — the
+        # zombie guard must not depend on this replica having formally
+        # campaigned first.
+        self.leader_client.epoch = self.leadership.epoch
         # Shared informer layer (one watch cache per kind, shared by
         # every controller in this manager — the SharedInformerFactory
         # role); controllers read through cached_client, everything
@@ -41,6 +71,7 @@ class Manager:
         # store per call.
         self.informers = InformerSet(store=self.store)
         self.cached_client = CachedClient(self.client, self.informers)
+        self.cached_client.epoch = self.leader_client.epoch
         # Lifecycle tracer handle (the flight recorder every pipeline
         # stage appends spans to); the server serves it at
         # /debug/traces through this handle, not the global.
@@ -64,6 +95,11 @@ class Manager:
         self.runnables.append(runnable)
 
     def start(self) -> None:
+        if self._started:
+            return      # idempotent: a promoted cluster may be handed
+            #             to a `with` block that starts it again
+        from grove_tpu.ha.election import register_leadership
+        register_leadership(self.store, self.leadership)
         for c in self.controllers:
             c.start()
         for r in self.runnables:
@@ -78,6 +114,77 @@ class Manager:
         for r in self.runnables:
             r.stop()
         self._started = False
+
+    # ---- leadership transitions (grove_tpu/ha, proposal 0002) ----
+
+    def promote(self) -> int:
+        """Become (or re-become) the reconciling leader: bump the
+        store's fencing epoch (durable before the first write under the
+        new term), stamp this manager's control-plane writers with it,
+        un-park controllers (each re-syncs its watches so the queue
+        rebuilds from live state — the warm-start reconcile), and
+        resume paused writer runnables. Returns the new epoch."""
+        epoch = self.store.bump_epoch()
+        self.leader_client.epoch = epoch
+        self.cached_client.epoch = epoch
+        for c in self.controllers:
+            c.unpark()
+        for r in self.runnables:
+            resume = getattr(r, "resume", None)
+            if callable(resume):
+                resume()
+        self.leadership.note_promoted(epoch)
+        self._record_transition_event("LeaderElected",
+                                      f"replica promoted at epoch {epoch}")
+        self.log.info("promoted: epoch=%d (%d controllers resynced)",
+                      epoch, len(self.controllers))
+        return epoch
+
+    def demote(self, leader_hint: str = "") -> int:
+        """Stand down after losing leadership: park every controller
+        (queued work DROPPED — it was computed under a now-stale view),
+        clear their expectation stores (stale expectations on a later
+        re-promotion are exactly the SURVEY §7 duplicate-pod hazard),
+        and pause writer runnables. The clients KEEP their stale epoch:
+        that is the fence — an in-flight reconcile finishing after this
+        returns gets FencedError from the store, not a committed write.
+        Returns the number of dropped queue items."""
+        self.leadership.note_demoted(leader_hint)
+        dropped = 0
+        for c in self.controllers:
+            dropped += c.park()
+        for r in self.runnables:
+            pause = getattr(r, "pause", None)
+            if callable(pause):
+                pause()
+        self._record_transition_event(
+            "LeaderDemoted",
+            f"replica demoted (dropped {dropped} queued items"
+            + (f"; leader: {leader_hint}" if leader_hint else "") + ")")
+        self.log.info("demoted: %d queued items dropped, runnables "
+                      "paused", dropped)
+        return dropped
+
+    def _record_transition_event(self, reason: str, message: str) -> None:
+        """Promotion/demotion event pair, written through an UNFENCED
+        client on purpose: a demoted replica must still be able to
+        leave its demotion in the event log (its fenced clients could
+        not). Best-effort like all events."""
+        try:
+            from grove_tpu.runtime.events import Event
+            from grove_tpu.api.meta import new_meta
+            import time as _time
+            now = _time.time()
+            name = (f"leadership.{self.leadership.replica}."
+                    f"{reason.lower()}.{self.leadership.transitions}")
+            Client(self.store).create(Event(
+                meta=new_meta(name, labels={"component": "ha"}),
+                involved_kind="Manager",
+                involved_name=self.leadership.replica,
+                type="Normal", reason=reason, message=message,
+                first_seen=now, last_seen=now))
+        except Exception:  # noqa: BLE001 — observability must not block
+            pass           # a transition (duplicate names included)
 
     # ---- health/readiness (reference manager.go:73-89) ----
 
@@ -101,6 +208,13 @@ class Manager:
             except Exception:  # noqa: BLE001 - best-effort gauge
                 pass
         self._export_state_objects()
+        # Leadership gauges re-asserted per scrape (a scrape between
+        # transitions must still see the current role/epoch).
+        GLOBAL_METRICS.set("grove_leader",
+                           1.0 if self.leadership.is_leader else 0.0,
+                           replica=self.leadership.replica)
+        GLOBAL_METRICS.set("grove_leadership_epoch",
+                           float(self.store.fencing_epoch()))
         return GLOBAL_METRICS.render()
 
     def _export_state_objects(self) -> None:

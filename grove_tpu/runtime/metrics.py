@@ -540,3 +540,28 @@ GLOBAL_METRICS.describe(
     "Scale writes rejected by the store (conflict or validation) per "
     "object — a sustained rate means something else fights the "
     "autoscaler over replicas")
+# HA control plane (grove_tpu/ha, docs/design/ha.md): leadership role,
+# fencing epoch, transition counts, and the failover-resume SLO.
+GLOBAL_METRICS.describe(
+    "grove_leader",
+    "1 on the replica currently holding leadership, 0 on standbys "
+    "and demoted replicas (labeled by replica name)")
+GLOBAL_METRICS.describe(
+    "grove_leadership_epoch",
+    "The store's current fencing epoch (monotonic term number; bumps "
+    "exactly once per leadership transition)")
+GLOBAL_METRICS.describe(
+    "grove_leadership_transitions_total",
+    "Leadership transitions observed by this process per direction "
+    "(promoted|demoted)")
+GLOBAL_METRICS.describe(
+    "grove_store_fenced_writes_total",
+    "Writes rejected by the leadership fence (writer epoch older than "
+    "the store's) per kind, verb, and writer — a deposed leader's "
+    "zombie writes made visible")
+GLOBAL_METRICS.describe_histogram(
+    "grove_failover_resume_seconds",
+    "Leader death to reconcile observably resumed on the promoted "
+    "replica (promotion wall time: fence + state load + controller "
+    "warm start), observed once per promotion",
+    buckets=LIFECYCLE_BUCKETS)

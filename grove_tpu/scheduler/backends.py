@@ -286,6 +286,16 @@ class _PlacementLoop:
         self._stop.set()
         self._wake.set()
 
+    def pause(self) -> None:
+        """Leadership parking (grove_tpu/ha): a demoted replica's binds
+        would be fenced; parking the pass also keeps its placement
+        snapshot from fighting the real leader's."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        self._wake.set()    # immediate pass: promotion wants placements
+
     def _run(self) -> None:
         # Writer attribution for store write telemetry: the loop thread
         # is the scheduler's only writer (binds, diagnosis status), so
@@ -295,6 +305,8 @@ class _PlacementLoop:
         while not self._stop.is_set():
             self._wake.wait(self.tick)
             self._wake.clear()
+            if getattr(self, "_paused", False):
+                continue
             try:
                 self.place()
             except ConflictError:

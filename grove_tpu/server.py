@@ -58,6 +58,17 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       (grovectl defrag-status renders
                                       it; same read gate as
                                       /debug/placement)
+  GET  /debug/leadership              this replica's leadership view:
+                                      role, fencing epoch, transitions,
+                                      leader hint (grovectl
+                                      leader-status renders it; same
+                                      read gate as /debug/placement).
+                                      Mutating verbs on a non-leader
+                                      replica return 503 + the hint;
+                                      an X-Grove-Epoch request header
+                                      stamps the write with the
+                                      caller's claimed fencing epoch
+                                      (stale epoch -> 409)
   POST /apply                         YAML/JSON manifest (create-or-
                                       update; ?dry_run=1 = admission-only
                                       server-side dry run)
@@ -332,7 +343,19 @@ class ApiServer:
 
             def _mutating_client(self):
                 """Impersonated client for a mutating request, or None
-                after an error response has been sent."""
+                after an error response has been sent. A non-leader
+                replica refuses every mutation with 503 + a leader
+                hint (clients follow it — HttpClient / cli._http);
+                an X-Grove-Epoch header stamps the returned client so
+                the store's fence judges the caller's claimed term."""
+                leadership = cluster.manager.leadership
+                if not leadership.is_leader:
+                    self._send(503, {
+                        "error": "this replica is not the leader; "
+                                 "writes must go to the leader",
+                        "leader": leadership.payload().get(
+                            "leader_hint", "")})
+                    return None
                 actor = self._actor()
                 if actor is None:
                     self._send(401, {"error": "invalid bearer token"})
@@ -352,7 +375,21 @@ class ApiServer:
                                      "verbs need Authorization: Bearer "
                                      "<token> (see server_auth.tokens)"})
                     return None
-                return cluster.client.impersonate(actor)
+                client = cluster.client.impersonate(actor)
+                epoch_hdr = self.headers.get("X-Grove-Epoch", "")
+                if epoch_hdr:
+                    # The wire writer claims a fencing epoch: stamp the
+                    # per-request client so the store's fence applies to
+                    # this write exactly as to an in-process one. A bad
+                    # header is a bad request, not an unfenced write.
+                    try:
+                        client.epoch = int(epoch_hdr)
+                    except ValueError:
+                        self._send(400, {"error": f"bad X-Grove-Epoch "
+                                         f"{epoch_hdr!r}; must be an "
+                                         "integer"})
+                        return None
+                return client
 
             def do_GET(self):
                 url = urlparse(self.path)
@@ -440,6 +477,8 @@ class ApiServer:
                         self._debug_serving(parts[2], parts[3])
                     elif url.path == "/debug/defrag":
                         self._debug_defrag()
+                    elif url.path == "/debug/leadership":
+                        self._debug_leadership()
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -520,6 +559,10 @@ class ApiServer:
                                                 "action": "forbidden",
                                                 "error": str(e2)})
                     self._send(403 if forbidden else 200, results)
+                except ConflictError as e:
+                    # Fenced or rv-stale apply: 409 so wire clients see
+                    # the same conflict taxonomy as PUT/PATCH.
+                    self._send(409, {"error": str(e)})
                 except GroveError as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - malformed input
@@ -741,6 +784,16 @@ class ApiServer:
                 NotFoundError from the twin maps to 404 in do_GET's
                 handler."""
                 self._send(200, cluster.client.debug_defrag())
+
+            def _debug_leadership(self):
+                """GET /debug/leadership — this replica's leadership
+                view (``grovectl leader-status`` renders it): role,
+                fencing epoch (claimed and the store's), transitions,
+                leader hint. Plain operational state, so it shares the
+                read gate like /debug/placement, not the profiling
+                gate."""
+                self._send(200, cluster.manager.leadership.payload(
+                    cluster.manager.store))
 
             def _debug_serving(self, namespace: str, name: str):
                 """GET /debug/serving/<ns>/<name> — one serving scope's

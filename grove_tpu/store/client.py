@@ -20,10 +20,18 @@ class Client:
     def __init__(self, store: Store, actor: str = "system:grove-operator"):
         self._store = store
         self.actor = actor
+        # Leadership fencing epoch (grove_tpu/ha): stamped by the
+        # Manager on the control plane's own writers at promotion so a
+        # deposed leader's straggler writes are rejected by the store
+        # (FencedError) instead of racing the new leader. None = an
+        # unfenced writer (users, node agents) — never gated.
+        self.epoch: int | None = None
 
     def impersonate(self, actor: str) -> "Client":
         """A client acting as a different principal (authorization tests,
-        user-facing surfaces)."""
+        user-facing surfaces). The impersonated client is UNFENCED
+        (epoch None): wire-user writes are gated by the server's
+        leadership check, not the writer epoch."""
         return Client(self._store, actor)
 
     def get(self, kind_cls: type, name: str, namespace: str = "default") -> Any:
@@ -49,49 +57,58 @@ class Client:
         return self._store.current_rv()
 
     def create(self, obj: Any) -> Any:
-        return self._store.create(obj, actor=self.actor)
+        return self._store.create(obj, actor=self.actor, epoch=self.epoch)
 
     def dry_run_admit(self, obj: Any) -> str:
         return self._store.dry_run_admit(obj, actor=self.actor)
 
     def update(self, obj: Any) -> Any:
-        return self._store.update(obj, actor=self.actor)
+        return self._store.update(obj, actor=self.actor, epoch=self.epoch)
 
     def update_status(self, obj: Any) -> Any:
-        return self._store.update_status(obj, actor=self.actor)
+        return self._store.update_status(obj, actor=self.actor,
+                                         epoch=self.epoch)
 
     def update_status_many(self, objs: list[Any]) -> list[Exception | None]:
-        return self._store.update_status_many(objs, actor=self.actor)
+        return self._store.update_status_many(objs, actor=self.actor,
+                                              epoch=self.epoch)
 
     def patch_status(self, kind_cls: type, name: str, patch: dict,
                      namespace: str = "default") -> Any:
         """Status-subresource merge patch (conditions merge by type; no
         rv precondition — see Store.patch_status)."""
         return self._store.patch_status(kind_cls, name, patch, namespace,
-                                        actor=self.actor)
+                                        actor=self.actor, epoch=self.epoch)
 
     def patch_status_many(self, kind_cls: type,
                           items: list[tuple[str, dict]],
                           namespace: str = "default"
                           ) -> list[Exception | None]:
         return self._store.patch_status_many(kind_cls, items, namespace,
-                                             actor=self.actor)
+                                             actor=self.actor,
+                                             epoch=self.epoch)
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
-        return self._store.delete(kind_cls, name, namespace, actor=self.actor)
+        return self._store.delete(kind_cls, name, namespace,
+                                  actor=self.actor, epoch=self.epoch)
 
     def patch(self, kind_cls: type, name: str, patch: dict,
               namespace: str = "default", retries: int = 3) -> Any:
         """JSON-merge-patch (RFC 7386) against spec/labels/annotations
         with a bounded optimistic-concurrency retry (the client-go
         MergeFrom analog — see store/patch.py)."""
-        from grove_tpu.runtime.errors import ConflictError
+        from grove_tpu.runtime.errors import ConflictError, FencedError
         from grove_tpu.store.patch import apply_patch
         last: Exception | None = None
         for _ in range(max(1, retries)):
             live = self.get(kind_cls, name, namespace)
             try:
                 return self.update(apply_patch(live, patch))
+            except FencedError:
+                # Terminal: the epoch only moves forward, so re-reading
+                # and retrying a fenced write is guaranteed identical
+                # failure — stand down immediately.
+                raise
             except ConflictError as e:  # raced a writer; re-read and retry
                 last = e
         raise last
@@ -148,6 +165,20 @@ class Client:
                 "defrag controller is not running for this store "
                 "(no started Manager owns it, or defrag.enabled=False)")
         return dc.payload()
+
+    def debug_leadership(self) -> dict:
+        """This replica's leadership view — the in-process twin of
+        ``GET /debug/leadership`` (same payload shape; grovectl
+        leader-status renders either). Raises NotFoundError when no
+        started Manager owns this store."""
+        from grove_tpu.ha.election import leadership_for
+        from grove_tpu.runtime.errors import NotFoundError
+        ls = leadership_for(self._store)
+        if ls is None:
+            raise NotFoundError(
+                "no leadership state for this store "
+                "(no started Manager owns it)")
+        return ls.payload(self._store)
 
     def debug_serving(self, name: str, namespace: str = "default") -> dict:
         """One serving scope's SLO state — the in-process twin of

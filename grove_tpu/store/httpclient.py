@@ -88,6 +88,17 @@ class HttpClient:
         self.timeout = timeout
         self.ca_file = ca_file
         self._ssl_ctx = None
+        # Leadership fencing epoch (grove_tpu/ha): when set, every
+        # mutating request carries X-Grove-Epoch so the leader's store
+        # judges this writer's term (stale epoch -> 409 FencedError).
+        # None = unfenced (ordinary clients).
+        self.epoch: int | None = None
+        # Leader-follow: a 503 whose body names the leader retries the
+        # request there once (the standby's write redirect — clients
+        # already retry on conflict; this is the HA analog). The hint
+        # REPLACES self.server so subsequent requests go straight to
+        # the leader.
+        self.follow_leader = True
         # Armed fault-injection gaps (see arm_watch_gap): each
         # watch_events call consumes one and raises WatchGoneError.
         # Lock because arming (chaos thread) races consumption (the
@@ -110,13 +121,15 @@ class HttpClient:
         return self._ssl_ctx
 
     def _request(self, method: str, path: str, body: dict | None = None,
-                 timeout: float | None = None):
+                 timeout: float | None = None, _followed: bool = False):
         import urllib.error
         import urllib.request
 
         headers = {"Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        if self.epoch is not None:
+            headers["X-Grove-Epoch"] = str(self.epoch)
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(f"{self.server}{path}", method=method,
                                      data=data, headers=headers)
@@ -127,10 +140,22 @@ class HttpClient:
                 return json.loads(resp.read() or b"null")
         except urllib.error.HTTPError as e:
             raw = e.read()
+            hint = ""
             try:
-                msg = json.loads(raw).get("error", raw.decode(errors="replace"))
-            except ValueError:
+                decoded = json.loads(raw)
+                msg = decoded.get("error", raw.decode(errors="replace"))
+                hint = str(decoded.get("leader") or "")
+            except (ValueError, AttributeError):
                 msg = raw.decode(errors="replace")
+            if e.code == 503 and hint and self.follow_leader \
+                    and not _followed and hint.rstrip("/") != self.server:
+                # Standby redirect: re-target the leader and retry ONCE
+                # (a hint chain longer than one hop means split-brain
+                # confusion worth surfacing, not chasing).
+                self.server = hint.rstrip("/")
+                self._ssl_ctx = None    # scheme/CA may differ per host
+                return self._request(method, path, body, timeout,
+                                     _followed=True)
             if e.code == 404:
                 raise NotFoundError(msg)
             if e.code == 403:
@@ -279,6 +304,12 @@ class HttpClient:
         """The defrag plan ledger from ``GET /debug/defrag`` (the wire
         twin of ``Client.debug_defrag``; 404 maps to NotFoundError)."""
         return self._request("GET", "/debug/defrag")
+
+    def debug_leadership(self) -> dict:
+        """This replica's leadership view from ``GET /debug/leadership``
+        (the wire twin of ``Client.debug_leadership``; grovectl
+        leader-status renders either)."""
+        return self._request("GET", "/debug/leadership")
 
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,

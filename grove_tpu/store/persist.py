@@ -9,13 +9,34 @@ rebuilds objects + resource-version counter from snapshot+WAL before
 serving its first read. Controllers then reconcile from the loaded
 state exactly as reference controllers do from informer resync.
 
-Format: ``snapshot.json`` = {"version": V, "rv": N,
+Format: ``snapshot.json`` = {"version": V, "rv": N, "epoch": E,
 "objects": [{"kind", "data"}]}, ``wal.jsonl`` =
-{"op": "put"|"delete", "kind", "data"|("ns","name")} per line. Object
-payloads are the full serde dict (meta+spec+status), decoded through
-the same KIND_REGISTRY the manifest codec uses. Appends flush to the OS
-on every record; fsync durability is not attempted (matching the
-in-memory store's crash model: a torn final line is skipped on load).
+{"op": "put"|"delete"|"epoch"|"rotated", "kind", "data"|("ns","name"),
+"rv", "e"} per line. Object payloads are the full serde dict
+(meta+spec+status), decoded through the same KIND_REGISTRY the manifest
+codec uses. Appends flush to the OS on every record; fsync durability
+is not attempted for object records (matching the in-memory store's
+crash model: a torn final line is skipped on load) — only the fencing
+``epoch`` record is fsynced, because the epoch bump IS the fence a new
+leader relies on.
+
+Leadership fencing (grove_tpu/ha, proposal 0002): the store's monotonic
+fencing epoch is persisted three ways — in the snapshot header, as
+``{"op": "epoch"}`` WAL records, and mirrored into a tiny ``EPOCH``
+sidecar (atomic tmp+rename+fsync) so the warm-start loader can learn it
+without decoding the snapshot. Every put/delete record is stamped with
+the epoch in effect (``"e"``); replay drops records whose stamp
+predates the highest epoch seen so far — a zombie leader that appends
+to the WAL after a takeover bumped the epoch loses those records on
+the next load instead of silently corrupting state.
+
+Compaction runs IN OPERATION without stalling writers: when the WAL
+crosses the threshold the live file is rotated (footer record + fsync +
+rename to ``wal.compacting.jsonl``) under the store lock — cheap — and
+a background thread writes the snapshot (tmp + fsync + rename + dir
+fsync) before unlinking the rotated segment. Load replays
+snapshot → segment → live WAL; a segment whose footer rv the snapshot
+already covers is skipped (the crash-between-replace-and-unlink case).
 
 Schema evolution (the reference's self-managed CRD upgrade story,
 proposal 436-crd-upgrader): field ADDITIONS are free — serde's
@@ -34,9 +55,21 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Callable, Optional
 
 from grove_tpu.api.serde import from_dict, to_dict
+
+# The snapshot header's rv/epoch, readable from the file head:
+# _write_snapshot emits {"version": V, "rv": N, "epoch": E, ...} with
+# these keys first, so the warm loader learns the compaction horizon
+# and the base fencing term without parsing the whole file.
+_SNAP_RV_RE = re.compile(r'"rv":\s*(\d+)')
+_SNAP_EPOCH_RE = re.compile(r'"epoch":\s*(\d+)')
+# Epoch records as raw WAL lines (we write them with exactly this key
+# order), so the warm loader can find the last bump BEFORE its cut
+# point with a string-prefix scan instead of decoding every payload.
+_EPOCH_LINE_PREFIX = b'{"op": "epoch"'
 
 # Current on-disk schema version. Bump when a persisted field is
 # renamed/restructured, and register the rewrite in MIGRATIONS.
@@ -106,22 +139,51 @@ def _stamp_lease(state_dir: str) -> None:
         pass                                  # lease is advisory liveness
 
 
+# Heartbeat stop events per state dir (realpath): release_state_lock
+# must silence the renewal thread, or a released dir keeps getting
+# stamped by a non-holder forever (confusing the next takeover's
+# staleness check).
+_HEARTBEAT_STOPS: dict[str, Any] = {}
+
+
 def _start_lease_heartbeat(state_dir: str) -> None:
-    """Daemon renewal thread for the process lifetime. A SIGSTOPped or
+    """Daemon renewal thread for the lock-hold lifetime. A SIGSTOPped or
     otherwise wedged process stops renewing (all its threads freeze),
     which is exactly the signal the standby fences on."""
     import threading
-    import time
 
     _stamp_lease(state_dir)
+    stop = threading.Event()
+    _HEARTBEAT_STOPS[os.path.realpath(state_dir)] = stop
 
     def loop() -> None:
         interval = max(_lease_ttl() / 5.0, 0.05)
-        while True:
-            time.sleep(interval)
+        while not stop.wait(interval):
             _stamp_lease(state_dir)
 
     threading.Thread(target=loop, name="state-lease", daemon=True).start()
+
+
+def release_state_lock(state_dir: str) -> bool:
+    """Voluntarily release this process's hold on a state dir: stop the
+    lease heartbeat and close the flock'd fd (the kernel releases the
+    flock on close). The in-process leadership-handoff primitive —
+    normal leaders hold until process exit (the kernel releases even on
+    SIGKILL), but tests and the demote path need an explicit release so
+    a takeover in the SAME process exercises the genuine acquisition
+    path. Returns False when this process held no lock on the dir."""
+    key = os.path.realpath(state_dir)
+    fd = _PROCESS_LOCKS.pop(key, None)
+    stop = _HEARTBEAT_STOPS.pop(key, None)
+    if stop is not None:
+        stop.set()
+    if fd is None:
+        return False
+    try:
+        os.close(fd)
+    except OSError:
+        pass
+    return True
 
 
 def _maybe_fence_wedged_holder(state_dir: str, lock_fd: int) -> None:
@@ -278,35 +340,88 @@ def _registry() -> dict[str, type]:
 
 class StatePersister:
     def __init__(self, state_dir: str, compact_every: int = 1000,
-                 takeover_wait: bool = False):
+                 takeover_wait: bool = False, compact_async: bool = True):
         self.state_dir = state_dir
         self.compact_every = compact_every
+        self.compact_async = compact_async
         os.makedirs(state_dir, exist_ok=True)
         # Single-writer guard BEFORE the first read: a takeover must
         # re-load state after the previous holder's final appends.
         _acquire_state_lock(state_dir, wait=takeover_wait)
         self.snapshot_path = os.path.join(state_dir, "snapshot.json")
         self.wal_path = os.path.join(state_dir, "wal.jsonl")
+        # Rotated-but-not-yet-folded WAL segment (background
+        # compaction in flight, or a crash mid-compaction).
+        self.segment_path = os.path.join(state_dir, "wal.compacting.jsonl")
+        self.epoch_path = os.path.join(state_dir, "EPOCH")
         self._wal_file = None
         self._wal_records = 0
+        self._compact_thread = None
+        # How the last load ran — the warm-start bench asserts the tail
+        # path actually skipped work ({"mode": "warm"|"full",
+        # "decoded": n, "lines": m}).
+        self.last_load: dict[str, Any] = {}
 
     # ---- load ------------------------------------------------------------
 
-    def load(self) -> tuple[list[Any], int]:
-        """Return (objects, max_rv) from snapshot + WAL replay, running
-        schema migrations when the state predates STATE_VERSION (and
-        compacting immediately after, so disk is atomically current)."""
+    def _read_records(self, path: str, repair: bool = False) -> list[dict]:
+        """Decode one JSONL WAL file into records, stopping at a torn
+        tail. ``repair`` truncates the tear / restores a lost final
+        newline in place (only safe on the LIVE wal — the rotated
+        segment is immutable history)."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        records: list[dict] = []
+        good = 0   # byte length of the valid prefix
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                good += len(line) + 1
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                break  # torn tail record: stop (and truncate below)
+            good += len(line) + 1
+        good = min(good, len(raw))
+        if repair:
+            if good < len(raw):
+                # Truncate the torn tail NOW: appending after it would
+                # merge two records into one undecodable line, and the
+                # NEXT restart would then discard every record after
+                # the tear.
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+            elif raw and not raw.endswith(b"\n"):
+                # Final record's JSON is complete but its newline was
+                # lost (torn exactly at the line boundary): terminate it
+                # before any append, or the next record concatenates
+                # onto it and the merged line loses BOTH records on the
+                # following load.
+                with open(path, "ab") as f:
+                    f.write(b"\n")
+        return records
+
+    def _sidecar_epoch(self) -> int:
+        try:
+            with open(self.epoch_path) as f:
+                return int(json.load(f)["epoch"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return 0
+
+    def load(self) -> tuple[list[Any], int, int]:
+        """Return (objects, max_rv, epoch) from snapshot + rotated
+        segment + WAL replay, running schema migrations when the state
+        predates STATE_VERSION (and compacting immediately after, so
+        disk is atomically current). Records stamped with an epoch
+        older than the highest epoch seen so far are dropped — they are
+        a fenced zombie leader's post-takeover appends."""
         registry = _registry()
         objects: dict[tuple[str, str, str], Any] = {}
         max_rv = 0
+        epoch = 0
         snap_version = STATE_VERSION
-        # WAL records are versioned by the WAL'S OWN header, never by
-        # the snapshot: a crash between the upgrade-compact's snapshot
-        # replace and its WAL truncation leaves a current-version
-        # snapshot next to an old WAL — inferring the WAL's version
-        # from the snapshot would replay those records unmigrated.
-        # A headerless non-empty WAL is by construction pre-versioning.
-        wal_version = 1
+        snap_rv = 0
+        snap_objects = 0
 
         def put(kind: str, data: dict, version: int) -> None:
             nonlocal max_rv
@@ -333,23 +448,27 @@ class StatePersister:
                     f"one (STATE_VERSION={STATE_VERSION}); refusing to "
                     "load — downgrading would silently corrupt "
                     "control-plane state")
-            max_rv = snap.get("rv", 0)
+            max_rv = snap_rv = snap.get("rv", 0)
+            epoch = snap.get("epoch", 0)
             for entry in snap.get("objects", []):
                 put(entry["kind"], entry["data"], snap_version)
-        if os.path.exists(self.wal_path):
-            with open(self.wal_path, "rb") as f:
-                raw = f.read()
-            good = 0   # byte length of the valid prefix
-            for line in raw.split(b"\n"):
-                if not line.strip():
-                    good += len(line) + 1
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    break  # torn tail record: stop (and truncate below)
-                good += len(line) + 1
-                if rec["op"] == "version":
+            snap_objects = len(snap.get("objects", []))
+
+        total_lines = 0
+        had_old_wal = False
+        segment_pending = False
+
+        def replay(records: list[dict]) -> None:
+            """One WAL file's records onto ``objects``. WAL records are
+            versioned by the FILE'S OWN header, never by the snapshot: a
+            crash between the upgrade-compact's snapshot replace and its
+            WAL truncation leaves a current-version snapshot next to an
+            old WAL. A headerless non-empty WAL is pre-versioning."""
+            nonlocal epoch, had_old_wal, max_rv
+            wal_version = 1
+            for rec in records:
+                op = rec["op"]
+                if op == "version":
                     wal_version = rec["v"]
                     if wal_version > STATE_VERSION:
                         raise StateVersionError(
@@ -358,40 +477,268 @@ class StatePersister:
                             f"newer build (STATE_VERSION="
                             f"{STATE_VERSION}); refusing to load")
                     continue
-                if rec["op"] == "put":
+                if op == "epoch":
+                    epoch = max(epoch, int(rec["epoch"]))
+                    continue
+                if op == "rotated":
+                    continue
+                # Zombie-leader fence at replay time: a record stamped
+                # with an epoch older than one already seen was appended
+                # by a deposed writer AFTER the takeover bump — drop it.
+                if int(rec.get("e", epoch)) < epoch:
+                    continue
+                if op == "put":
                     put(rec["kind"], rec["data"], wal_version)
-                elif rec["op"] == "delete":
+                elif op == "delete":
                     objects.pop(migrate_key(rec["kind"], rec["ns"],
                                             rec["name"], wal_version),
                                 None)
+                    # Deletes allocate their own seq (stamped since the
+                    # HA work): count it into max_rv, or a WAL ending in
+                    # deletes reloads into a store that REISSUES those
+                    # rvs — and with them, watch seqs.
+                    max_rv = max(max_rv, int(rec.get("rv", 0)))
                 self._wal_records += 1
-            good = min(good, len(raw))
-            if good < len(raw):
-                # Truncate the torn tail NOW: appending after it would
-                # merge two records into one undecodable line, and the
-                # NEXT restart would then discard every record after
-                # the tear.
+            if wal_version < STATE_VERSION and records:
+                had_old_wal = True
+
+        if os.path.exists(self.segment_path):
+            try:
+                seg = self._read_records(self.segment_path)
+            except FileNotFoundError:
+                seg = []    # a racing background compaction folded it
+            footer_rv = next(
+                (r["rv"] for r in reversed(seg) if r["op"] == "rotated"),
+                None)
+            if footer_rv is not None and snap_rv >= footer_rv:
+                # Crash between snapshot replace and segment unlink:
+                # the snapshot already folds every segment record in.
+                try:
+                    os.unlink(self.segment_path)
+                except OSError:
+                    segment_pending = True
+            else:
+                # Crash between rotation and snapshot replace: the
+                # segment is the WAL's older half — replay it first.
+                total_lines += len(seg)
+                replay(seg)
+                segment_pending = True
+        if os.path.exists(self.wal_path):
+            live = self._read_records(self.wal_path, repair=True)
+            total_lines += len(live)
+            replay(live)
+        epoch = max(epoch, self._sidecar_epoch())
+        loaded = list(objects.values())
+        self.last_load = {"mode": "full", "decoded": total_lines,
+                          "lines": total_lines,
+                          "snapshot_objects": snap_objects}
+        if snap_version < STATE_VERSION or had_old_wal or segment_pending:
+            # Upgrade (and any leftover compaction segment) completes
+            # atomically BEFORE the first new append — a WAL can then
+            # never mix schema versions, and the segment never outlives
+            # one load.
+            self.compact(loaded, max_rv, epoch)
+        return loaded, max_rv, epoch
+
+    def load_warm(self, warm: dict[tuple[str, str, str], Any],
+                  warm_rv: int) -> tuple[list[Any], int, int] | None:
+        """Warm-start load (the hot standby's promotion path): the
+        caller's mirror already holds the exact store state at
+        ``warm_rv`` (maintained from the leader's watch stream), so
+        only the WAL delta past it needs decoding — at a 300-pod deploy
+        the full WAL is thousands of full-object JSON payloads and the
+        delta is near zero. Returns None whenever the tail-only read
+        cannot be PROVEN equivalent to a full load (compaction segment
+        present, snapshot newer than the mirror, pre-epoch delete
+        records, old schema) — the caller falls back to ``load()``.
+
+        Scans the live WAL backwards, decoding lines until one at or
+        below ``warm_rv``; puts carry their rv inside the payload,
+        deletes carry a top-level ``rv`` stamp (records without one are
+        a fallback trigger). Epoch comes from the sidecar plus any
+        epoch records in the decoded tail — the sidecar is rewritten on
+        every bump precisely so this path never has to scan the whole
+        WAL for the current term."""
+        registry = _registry()
+        if os.path.exists(self.segment_path):
+            # A rotated-but-unfolded segment (the leader died between
+            # rotation and the snapshot landing — near-certain when a
+            # kill races a compaction). Every segment record predates
+            # its rotation footer, so a mirror at or past the footer
+            # rv COVERS the whole segment: skip it. Anything else
+            # falls back to the full load.
+            try:
+                with open(self.segment_path, "rb") as f:
+                    raw_seg = f.read()
+                last = raw_seg.rstrip(b"\n").rsplit(b"\n", 1)[-1]
+                rec = json.loads(last)
+                if rec.get("op") != "rotated" \
+                        or int(rec["rv"]) > warm_rv:
+                    return None
+            except (OSError, ValueError, KeyError, TypeError):
+                return None
+        snap_rv = 0
+        snap_epoch = 0
+        if os.path.exists(self.snapshot_path):
+            # The snapshot header is written first ({"version", "rv",
+            # "epoch", ...) — read only the head, not the whole file.
+            with open(self.snapshot_path, "rb") as f:
+                head = f.read(256).decode(errors="replace")
+            m = _SNAP_RV_RE.search(head)
+            if m is None:
+                return None
+            snap_rv = int(m.group(1))
+            m = _SNAP_EPOCH_RE.search(head)
+            if m is not None:
+                snap_epoch = int(m.group(1))
+        if snap_rv > warm_rv:
+            # Records in (warm_rv, snap_rv] were compacted out of the
+            # WAL; the mirror saw them via watch, but proving that is
+            # the contiguity guard's job — be conservative.
+            return None
+        if not os.path.exists(self.wal_path):
+            objects = dict(warm)
+            epoch = max(snap_epoch, self._sidecar_epoch())
+            self.last_load = {"mode": "warm", "decoded": 0, "lines": 0}
+            return list(objects.values()), warm_rv, epoch
+        with open(self.wal_path, "rb") as f:
+            raw = f.read()
+        # Tail repair BEFORE anything else, exactly as load() does via
+        # _read_records(repair=True): the promoted store appends to
+        # this file, and appending onto a torn final line would merge
+        # two records into one undecodable line — the NEXT load would
+        # then discard every record after the tear (all the new
+        # leader's post-failover writes).
+        if raw and not raw.endswith(b"\n"):
+            last = raw.rsplit(b"\n", 1)[-1]
+            try:
+                json.loads(last)
+            except ValueError:
+                # Torn mid-record: truncate the partial line.
                 with open(self.wal_path, "r+b") as f:
-                    f.truncate(good)
-            elif raw and not raw.endswith(b"\n"):
-                # Final record's JSON is complete but its newline was
-                # lost (torn exactly at the line boundary): terminate it
-                # before any append, or the next record concatenates onto
-                # it and the merged line loses BOTH records on the
-                # following load.
+                    f.truncate(len(raw) - len(last))
+                raw = raw[:len(raw) - len(last)]
+            else:
+                # Complete JSON, lost newline: re-terminate it.
                 with open(self.wal_path, "ab") as f:
                     f.write(b"\n")
-        loaded = list(objects.values())
-        if snap_version < STATE_VERSION or (
-                self._wal_records and wal_version < STATE_VERSION):
-            # Upgrade completes atomically BEFORE the first new append —
-            # a WAL can then never mix schema versions.
-            self.compact(loaded, max_rv)
-        return loaded, max_rv
+        lines = [ln for ln in raw.split(b"\n") if ln.strip()]
+        # Schema gate: decode the header line only. ANY version other
+        # than ours falls back — older needs migrations, and NEWER must
+        # reach load()'s StateVersionError refusal (a warm path that
+        # silently decoded a newer build's records would be the exact
+        # downgrade corruption the version header exists to prevent).
+        if lines:
+            try:
+                first = json.loads(lines[0])
+            except ValueError:
+                return None
+            if first.get("op") == "version" and first["v"] != STATE_VERSION:
+                return None
+        tail: list[dict] = []
+        cut = len(lines)                    # index of the cut-point line
+        floor_rv = None     # rvs must strictly DECREASE walking backward
+        for i in range(len(lines) - 1, -1, -1):
+            try:
+                rec = json.loads(lines[i])
+            except ValueError:
+                return None                 # mid-file corruption: full load
+            op = rec["op"]
+            if op in ("version", "rotated"):
+                continue
+            if op == "epoch":
+                tail.append(rec)
+                continue
+            if op == "put":
+                rv = int(rec["data"]["meta"]["resource_version"])
+            elif op == "delete":
+                if "rv" not in rec:
+                    return None             # pre-HA record: no stamp
+                rv = int(rec["rv"])
+            else:
+                continue
+            if floor_rv is not None and rv >= floor_rv:
+                # Appends are rv-ordered under the store lock; a
+                # non-monotonic tail means a zombie leader appended
+                # through a stale handle (its rv counter rewound). The
+                # cut-point heuristic cannot be trusted against that —
+                # a zombie's low rv would masquerade as the mirrored
+                # boundary and silently drop the real leader's
+                # unmirrored records. Full load handles zombies via
+                # the in-order epoch fence.
+                return None
+            floor_rv = rv
+            if rv <= warm_rv:
+                cut = i
+                break                       # everything earlier is mirrored
+            tail.append(rec)
+        if cut < len(lines):
+            # Validate the cut itself: the nearest preceding OBJECT
+            # record must carry a smaller rv, or the "cut" is a zombie
+            # append at the very end of the file (the commonest zombie
+            # shape) masquerading as the mirrored boundary.
+            cut_rv = floor_rv
+            for i in range(cut - 1, -1, -1):
+                try:
+                    rec = json.loads(lines[i])
+                except ValueError:
+                    return None
+                op = rec["op"]
+                if op == "put":
+                    prev_rv = int(rec["data"]["meta"]["resource_version"])
+                elif op == "delete":
+                    prev_rv = int(rec.get("rv", 0))
+                else:
+                    continue
+                if prev_rv >= cut_rv:
+                    return None             # rv rewound at the cut
+                break
+        tail.reverse()
+        objects = dict(warm)
+        max_rv = warm_rv
+        # The fencing epoch IN EFFECT at the cut point, so the tail's
+        # zombie-drop rule evolves in log order exactly as load()'s
+        # does (seeding from the sidecar — the LATEST bump — would drop
+        # legitimate records written before a bump that sits later in
+        # the tail). Epoch records before the cut are found by a
+        # string-prefix scan; their payloads never need decoding.
+        epoch = snap_epoch
+        for i in range(cut - 1, -1, -1):
+            if lines[i].startswith(_EPOCH_LINE_PREFIX):
+                try:
+                    epoch = max(epoch, int(json.loads(lines[i])["epoch"]))
+                except (ValueError, KeyError, TypeError):
+                    pass
+                break                       # latest bump before the cut
+        for rec in tail:
+            if rec["op"] == "epoch":
+                epoch = max(epoch, int(rec["epoch"]))
+                continue
+            if int(rec.get("e", epoch)) < epoch:
+                continue                    # zombie append (see load())
+            if rec["op"] == "put":
+                cls = registry.get(rec["kind"])
+                if cls is None:
+                    continue
+                obj = from_dict(cls, rec["data"])
+                objects[(rec["kind"], obj.meta.namespace,
+                         obj.meta.name)] = obj
+                max_rv = max(max_rv, obj.meta.resource_version)
+            else:
+                objects.pop((rec["kind"], rec["ns"], rec["name"]), None)
+                max_rv = max(max_rv, int(rec["rv"]))
+        self._wal_records = len(lines)
+        # The sidecar (rewritten on every bump, fsynced) backstops the
+        # final term — e.g. a bump whose WAL record sits in a rotated
+        # segment this path refused to read.
+        epoch = max(epoch, self._sidecar_epoch())
+        self.last_load = {"mode": "warm", "decoded": len(tail) + 1,
+                          "lines": len(lines), "snapshot_objects": 0}
+        return list(objects.values()), max_rv, epoch
 
     # ---- append ----------------------------------------------------------
 
-    def _append(self, record: dict) -> None:
+    def _append(self, record: dict, fsync: bool = False) -> None:
         if self._wal_file is None:
             fresh = (not os.path.exists(self.wal_path)
                      or os.path.getsize(self.wal_path) == 0)
@@ -404,37 +751,161 @@ class StatePersister:
                     {"op": "version", "v": STATE_VERSION}) + "\n")
         self._wal_file.write(json.dumps(record) + "\n")
         self._wal_file.flush()
+        if fsync:
+            os.fsync(self._wal_file.fileno())
         self._wal_records += 1
 
-    def record_put(self, obj: Any) -> None:
-        self._append({"op": "put", "kind": obj.KIND, "data": to_dict(obj)})
+    def record_put(self, obj: Any, epoch: int = 0) -> None:
+        self._append({"op": "put", "kind": obj.KIND, "e": epoch,
+                      "data": to_dict(obj)})
 
-    def record_delete(self, obj: Any) -> None:
+    def record_delete(self, obj: Any, rv: int = 0, epoch: int = 0) -> None:
+        # ``rv`` is the deletion's own seq (the store allocates one per
+        # delete): the warm-start tail scan needs every record rv-
+        # addressable, and replaying an unstamped delete over a mirror
+        # could remove a later re-creation it never should have seen.
         self._append({"op": "delete", "kind": obj.KIND,
-                      "ns": obj.meta.namespace, "name": obj.meta.name})
+                      "ns": obj.meta.namespace, "name": obj.meta.name,
+                      "rv": rv, "e": epoch})
 
-    def maybe_compact(self, objects: list[Any], rv: int) -> bool:
-        """Snapshot + truncate the WAL once it exceeds the threshold.
-        Caller passes a consistent view (holds the store lock)."""
+    def record_epoch(self, epoch: int) -> None:
+        """Persist a fencing-epoch bump: an fsynced WAL record (the
+        bump IS the fence — it must be durable before the new leader's
+        first write) plus the sidecar rewrite for the warm loader."""
+        self._append({"op": "epoch", "epoch": epoch}, fsync=True)
+        tmp = f"{self.epoch_path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({"epoch": epoch}))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.epoch_path)
+        except OSError:
+            pass          # sidecar is a fast-path hint; WAL is truth
+
+    # ---- compaction ------------------------------------------------------
+
+    def maybe_compact(self, objects: list[Any], rv: int,
+                      epoch: int = 0) -> bool:
+        """Fold the WAL into a snapshot once it exceeds the threshold.
+        Caller passes a consistent view (holds the store lock). The
+        expensive half — serializing every object — runs in a
+        BACKGROUND thread; only the WAL rotation (footer + fsync +
+        rename + fresh file) happens on the write path, so a large
+        fleet's writers never stall behind an O(objects) json.dump."""
         if self._wal_records < self.compact_every:
             return False
-        self.compact(objects, rv)
+        if self._compact_thread is not None \
+                and self._compact_thread.is_alive():
+            return False                    # one compaction at a time
+        if os.path.exists(self.segment_path):
+            # A leftover segment (crashed compaction that load() didn't
+            # see — e.g. the crash was ours, mid-run) folds
+            # synchronously: rotating a second segment on top would
+            # need an ordered chain nothing replays.
+            self.compact(objects, rv, epoch)
+            return True
+        if not self.compact_async:
+            self.compact(objects, rv, epoch)
+            return True
+        self._rotate_wal(rv)
+        import threading
+        self._compact_thread = threading.Thread(
+            target=self._finish_compaction, args=(list(objects), rv, epoch),
+            name="wal-compact", daemon=True)
+        self._compact_thread.start()
         return True
 
-    def compact(self, objects: list[Any], rv: int) -> None:
+    def _rotate_wal(self, rv: int) -> None:
+        """Seal the live WAL as the compacting segment (caller holds
+        the store lock): footer record naming the view rv, fsync so the
+        footer survives the rename, rename, reset. The next append
+        opens a fresh WAL with its own version header."""
+        if self._wal_file is None:
+            self._wal_file = open(self.wal_path, "a")
+        self._wal_file.write(json.dumps({"op": "rotated", "rv": rv}) + "\n")
+        self._wal_file.flush()
+        os.fsync(self._wal_file.fileno())
+        self._wal_file.close()
+        self._wal_file = None
+        os.replace(self.wal_path, self.segment_path)
+        self._wal_records = 0
+
+    def _finish_compaction(self, objects: list[Any], rv: int,
+                           epoch: int) -> None:
+        """Background half: write the snapshot durably, then drop the
+        folded segment. Object references are immutable per version
+        (the store replaces, never mutates), so serializing outside
+        the lock is race-free."""
+        try:
+            self._write_snapshot(objects, rv, epoch)
+            os.unlink(self.segment_path)
+        except OSError:
+            pass      # load() folds a leftover segment on next boot
+
+    def _write_snapshot(self, objects: list[Any], rv: int,
+                        epoch: int) -> None:
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
+            # Header keys first and in this order: the warm loader
+            # reads "rv" from the file head without a full parse.
             json.dump({"version": STATE_VERSION, "rv": rv,
+                       "epoch": epoch,
                        "objects": [{"kind": o.KIND, "data": to_dict(o)}
                                    for o in objects]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        # Never regress the snapshot: the test harness's simulated
+        # restarts run sequential Store instances over one dir in ONE
+        # process (they share the flock), so an abandoned instance's
+        # still-running background compaction could otherwise rename an
+        # OLDER view over the successor's newer one. Checked right
+        # before the rename to shrink the window to the rename itself;
+        # cross-process this cannot happen (the flock serializes, and a
+        # dead process has no background thread).
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                m = _SNAP_RV_RE.search(f.read(256).decode(errors="replace"))
+            if m is not None and int(m.group(1)) > rv:
+                os.unlink(tmp)
+                return
+        except OSError:
+            pass
         os.replace(tmp, self.snapshot_path)
+        # Directory fsync: the rename itself must survive a power cut,
+        # or load() could see the OLD snapshot next to a truncated WAL.
+        try:
+            dfd = os.open(self.state_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    def compact(self, objects: list[Any], rv: int, epoch: int = 0) -> None:
+        """Synchronous compaction (load-time upgrades, leftover-segment
+        folds, tests): snapshot durably, then truncate WAL + segment."""
+        self.join_compaction()
+        self._write_snapshot(objects, rv, epoch)
         if self._wal_file is not None:
             self._wal_file.close()
             self._wal_file = None
         open(self.wal_path, "w").close()
+        try:
+            os.unlink(self.segment_path)
+        except OSError:
+            pass
         self._wal_records = 0
 
+    def join_compaction(self, timeout: float = 10.0) -> None:
+        """Wait out an in-flight background compaction (tests, close)."""
+        t = self._compact_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
     def close(self) -> None:
+        self.join_compaction()
         if self._wal_file is not None:
             self._wal_file.close()
             self._wal_file = None

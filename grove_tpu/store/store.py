@@ -28,6 +28,7 @@ from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.runtime.errors import (
     AlreadyExistsError,
     ConflictError,
+    FencedError,
     NotFoundError,
     ValidationError,
 )
@@ -138,7 +139,14 @@ class _WriteGuard:
 
 class Store:
     def __init__(self, state_dir: str | None = None,
-                 takeover_wait: bool = False) -> None:
+                 takeover_wait: bool = False,
+                 warm: tuple[dict, int] | None = None) -> None:
+        """``warm=(objects_by_key, rv)`` is the hot standby's promotion
+        fast path: the caller's wire mirror already holds exact store
+        state at ``rv``, so loading replays only the WAL delta past it
+        (``StatePersister.load_warm``) instead of decoding snapshot +
+        full WAL; falls back to the full load whenever equivalence
+        cannot be proven. Ignored without ``state_dir``."""
         self._lock = threading.RLock()
         # Signalled on every _emit: wire long-polls block on this instead
         # of rescanning the ring on a poll interval.
@@ -174,6 +182,13 @@ class Store:
         # semantics, exactly the kube watch contract).
         self._history: collections.deque[tuple[int, Event]] = \
             collections.deque(maxlen=4096)
+        # Leadership fencing epoch (grove_tpu/ha, proposal 0002): the
+        # monotonic term number. Writes that carry an epoch older than
+        # this are rejected (FencedError) — the zombie-deposed-leader
+        # guard. 0 = no leadership transition has ever fenced this
+        # store; writers without an epoch (None — user clients, agents)
+        # are never fenced.
+        self._epoch = 0
         # Durability (etcd analog, store/persist.py): WAL every mutation,
         # snapshot compaction, full state restore on construction.
         self._persister = None
@@ -181,7 +196,12 @@ class Store:
             from grove_tpu.store.persist import StatePersister
             self._persister = StatePersister(state_dir,
                                              takeover_wait=takeover_wait)
-            objects, max_rv = self._persister.load()
+            loaded = None
+            if warm is not None:
+                loaded = self._persister.load_warm(warm[0], warm[1])
+            if loaded is None:
+                loaded = self._persister.load()
+            objects, max_rv, self._epoch = loaded
             for obj in objects:
                 self._objects.setdefault(obj.KIND, {})[_key(obj)] = obj
             self._rv = itertools.count(max_rv + 1)
@@ -201,14 +221,52 @@ class Store:
         steady-sweep ratio."""
         return _WriteGuard(self, verb)
 
+    # ---- leadership fencing (grove_tpu/ha, proposal 0002) ----
+
+    def fencing_epoch(self) -> int:
+        """The store's current fencing epoch (term number)."""
+        with self._lock:
+            return self._epoch
+
+    def bump_epoch(self) -> int:
+        """Advance the fencing epoch — THE promotion action: after this
+        returns (durably, when persistent), any write still carrying
+        the previous epoch is rejected. Returns the new epoch."""
+        with self._lock:
+            self._epoch += 1
+            if self._persister is not None:
+                self._persister.record_epoch(self._epoch)
+            epoch = self._epoch
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        GLOBAL_METRICS.set("grove_leadership_epoch", float(epoch))
+        return epoch
+
+    def _check_fence(self, kind: str, verb: str,
+                     epoch: int | None) -> None:
+        """Reject a write whose writer claims a stale epoch (called
+        under the lock, before admission — a deposed leader gets the
+        fence, not a validation error). ``None`` = an unfenced writer
+        (user clients, node agents): leadership never gates those.
+        GROVE_HA=0 disables the check entirely."""
+        if epoch is None or epoch >= self._epoch:
+            return
+        from grove_tpu.ha import ha_enabled
+        if not ha_enabled():
+            return
+        writeobs.note_fenced(kind, verb)
+        raise FencedError(
+            f"{kind} {verb} fenced: writer epoch {epoch} predates the "
+            f"store's fencing epoch {self._epoch} — a newer leader has "
+            "taken over; this writer must stand down")
+
     def _persist_put(self, obj: Any) -> None:
         if self._persister is not None:
-            self._persister.record_put(obj)
+            self._persister.record_put(obj, epoch=self._epoch)
             self._maybe_compact()
 
-    def _persist_delete(self, obj: Any) -> None:
+    def _persist_delete(self, obj: Any, rv: int = 0) -> None:
         if self._persister is not None:
-            self._persister.record_delete(obj)
+            self._persister.record_delete(obj, rv=rv, epoch=self._epoch)
             self._maybe_compact()
 
     def _maybe_compact(self) -> None:
@@ -216,7 +274,21 @@ class Store:
         # is consistent, and stored objects are never mutated in place.
         self._persister.maybe_compact(
             [o for objs in self._objects.values() for o in objs.values()],
-            rv=self._peek_rv())
+            rv=self._peek_rv(), epoch=self._epoch)
+
+    def compact_now(self) -> bool:
+        """Synchronously fold the WAL into a snapshot, regardless of
+        the threshold — the operational pre-backup / pre-handoff
+        surface (and what the failover bench uses to keep a compaction
+        rotation out of its kill window). False without persistence."""
+        with self._lock:
+            if self._persister is None:
+                return False
+            self._persister.compact(
+                [o for objs in self._objects.values()
+                 for o in objs.values()],
+                rv=self._peek_rv(), epoch=self._epoch)
+            return True
 
     def _peek_rv(self) -> int:
         # itertools.count has no peek; track via a probe-and-restore.
@@ -443,9 +515,11 @@ class Store:
 
     # ---- writes ----
 
-    def create(self, obj: Any, actor: str = "system:grove-operator") -> Any:
+    def create(self, obj: Any, actor: str = "system:grove-operator",
+               epoch: int | None = None) -> Any:
         with self._locked_write("create"):
             kind = obj.KIND
+            self._check_fence(kind, "create", epoch)
             objs = self._objects.setdefault(kind, {})
             key = _key(obj)
             if key in objs:
@@ -494,9 +568,11 @@ class Store:
             raise NotFoundError(f"{obj.KIND} {ns}/{name} not found")
         return live
 
-    def update(self, obj: Any, actor: str = "system:grove-operator") -> Any:
+    def update(self, obj: Any, actor: str = "system:grove-operator",
+               epoch: int | None = None) -> Any:
         """Full update (spec+meta). Bumps generation when spec changed."""
         with self._locked_write("update"):
+            self._check_fence(obj.KIND, "update", epoch)
             live = self._get_live(obj)
             if obj.meta.resource_version != live.meta.resource_version:
                 writeobs.note_conflict(obj.KIND, "update")
@@ -520,7 +596,8 @@ class Store:
             return clone(stored)
 
     def update_status(self, obj: Any,
-                      actor: str = "system:grove-operator") -> Any:
+                      actor: str = "system:grove-operator",
+                      epoch: int | None = None) -> Any:
         """Status-only update: ignores spec/meta edits in ``obj``.
 
         No-op writes (byte-identical status) are suppressed: reconcilers
@@ -529,6 +606,7 @@ class Store:
         at steady state.
         """
         with self._locked_write("update_status"):
+            self._check_fence(obj.KIND, "update_status", epoch)
             stored = self._update_status_locked(obj, actor)
         # Return through the per-version bytes cache instead of a fresh
         # dumps+loads: every reconcile ends in a status write, and at
@@ -571,7 +649,8 @@ class Store:
 
     def patch_status(self, kind_cls: type, name: str, patch: dict,
                      namespace: str = "default",
-                     actor: str = "system:grove-operator") -> Any:
+                     actor: str = "system:grove-operator",
+                     epoch: int | None = None) -> Any:
         """Server-side status merge (the kubelet PATCH pattern —
         store/patch.py merge_status; conditions merge by type). No
         resource-version precondition: the read-modify-write happens
@@ -580,6 +659,7 @@ class Store:
         what keeps a fleet of wire agents from conflict-looping against
         controllers that also write the same objects' status."""
         with self._locked_write("patch_status"):
+            self._check_fence(kind_cls.KIND, "patch_status", epoch)
             stored = self._patch_status_locked(kind_cls, name, patch,
                                                namespace, actor)
         return self._read_clone(stored)  # as update_status: cached bytes
@@ -608,7 +688,8 @@ class Store:
     def patch_status_many(self, kind_cls: type,
                           items: list[tuple[str, dict]],
                           namespace: str = "default",
-                          actor: str = "system:grove-operator"
+                          actor: str = "system:grove-operator",
+                          epoch: int | None = None
                           ) -> list[Exception | None]:
         """Batched status merge-patches under ONE lock acquisition — the
         wire twin of ``update_status_many`` (a kubelet fleet marking a
@@ -624,6 +705,11 @@ class Store:
         from grove_tpu.runtime.errors import ForbiddenError
         results: list[Exception | None] = []
         with self._locked_write("patch_status"):
+            # One fence check per batch (one writer, one epoch): a
+            # deposed writer's whole batch is rejected before anything
+            # commits — exactly the partial-batch ambiguity the
+            # per-item result shape cannot express for fencing.
+            self._check_fence(kind_cls.KIND, "patch_status", epoch)
             for name, patch in items:
                 try:
                     self._patch_status_locked(kind_cls, name, patch,
@@ -634,7 +720,8 @@ class Store:
         return results
 
     def update_status_many(self, objs: list[Any],
-                           actor: str = "system:grove-operator"
+                           actor: str = "system:grove-operator",
+                           epoch: int | None = None
                            ) -> list[Exception | None]:
         """Batched status updates under one lock acquisition (the gang
         scheduler binds hundreds of pods at once; per-call locking and
@@ -648,6 +735,8 @@ class Store:
         """
         results: list[Exception | None] = []
         with self._locked_write("update_status"):
+            if objs:    # one fence check per batch (see patch_status_many)
+                self._check_fence(objs[0].KIND, "update_status", epoch)
             for obj in objs:
                 try:
                     self._update_status_locked(obj, actor)
@@ -657,10 +746,12 @@ class Store:
         return results
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default",
-               actor: str = "system:grove-operator") -> None:
+               actor: str = "system:grove-operator",
+               epoch: int | None = None) -> None:
         """Finalizer-aware delete: marks for deletion if finalizers remain,
         removes (and cascades to owned objects) otherwise."""
         with self._locked_write("delete"):
+            self._check_fence(kind_cls.KIND, "delete", epoch)
             objs = self._objects.get(kind_cls.KIND, {})
             obj = objs.get((namespace, name))
             if obj is None:
@@ -687,10 +778,13 @@ class Store:
         self._snapshot_cache.pop(
             (obj.KIND, obj.meta.namespace, obj.meta.name), None)
         writeobs.note_commit(obj.KIND, "delete")
-        self._persist_delete(obj)
         # Deletions get their own seq (kube bumps rv on delete too) so
-        # resumable watches order them after the final MODIFIED.
-        self._emit(EventType.DELETED, obj, seq=next(self._rv))
+        # resumable watches order them after the final MODIFIED; the
+        # WAL delete record carries it so the warm-start tail scan can
+        # rv-address every record.
+        seq = next(self._rv)
+        self._persist_delete(obj, rv=seq)
+        self._emit(EventType.DELETED, obj, seq=seq)
         # Cascade: anything owned (controller ref) by this uid gets deleted.
         uid = obj.meta.uid
         dependents = [

@@ -15,6 +15,10 @@ Exported series (rendered by the shared MetricsHub):
   write suppressed as a no-op counts under ``_noop_``, not here).
 - ``grove_store_conflicts_total{kind,verb,writer}`` — optimistic-
   concurrency rejections (stale resource_version).
+- ``grove_store_fenced_writes_total{kind,verb,writer}`` — writes
+  rejected by the leadership fence (writer epoch older than the
+  store's, grove_tpu/ha): a deposed leader's zombie writes, made
+  visible.
 - ``grove_store_noop_writes_total{kind,writer}`` — suppressed
   byte-identical status writes (the steady-state self-trigger guard).
 - ``grove_store_events_total{kind,type}`` — event-ring appends (the
@@ -100,7 +104,7 @@ class WriteRecord:
     """Telemetry buffered across one public store write verb."""
 
     __slots__ = ("verb", "writer", "commits", "noops", "conflicts",
-                 "events", "wait_s", "hold_s")
+                 "fenced", "events", "wait_s", "hold_s")
 
     def __init__(self, verb: str, writer: str) -> None:
         self.verb = verb
@@ -108,6 +112,7 @@ class WriteRecord:
         self.commits: list[tuple[str, str]] = []    # (kind, verb)
         self.noops: list[str] = []                  # kind
         self.conflicts: list[tuple[str, str]] = []  # (kind, verb)
+        self.fenced: list[tuple[str, str]] = []     # (kind, verb)
         self.events: list[tuple[str, str]] = []     # (kind, type)
         self.wait_s = 0.0
         self.hold_s = 0.0
@@ -148,6 +153,16 @@ def note_conflict(kind: str, verb: str) -> None:
         rec.conflicts.append((kind, verb))
 
 
+def note_fenced(kind: str, verb: str) -> None:
+    """A write rejected by the leadership fence (stale writer epoch —
+    grove_tpu/ha): counted into ``grove_store_fenced_writes_total`` so
+    a deposed leader's rejected writes are visible evidence, not a
+    silent exception path."""
+    rec = _rec()
+    if rec is not None:
+        rec.fenced.append((kind, verb))
+
+
 def note_event(kind: str, etype: str) -> None:
     rec = _rec()
     if rec is not None:
@@ -164,6 +179,7 @@ def note_event(kind: str, etype: str) -> None:
 _WRITE_INC: dict[tuple, tuple] = {}
 _NOOP_INC: dict[tuple, tuple] = {}
 _CONFLICT_INC: dict[tuple, tuple] = {}
+_FENCED_INC: dict[tuple, tuple] = {}
 _EVENT_INC: dict[tuple, tuple] = {}
 _VERB_LABELS: dict[str, tuple] = {}
 
@@ -201,7 +217,8 @@ def flush(rec: WriteRecord) -> None:
     exactly one of these."""
     _active.rec = None
     w = rec.writer
-    if not rec.commits and not rec.conflicts and not rec.events:
+    if not rec.commits and not rec.conflicts and not rec.events \
+            and not rec.fenced:
         if rec.noops:
             GLOBAL_METRICS.bulk(incs=[
                 _cached(_NOOP_INC, (kind, w),
@@ -222,6 +239,11 @@ def flush(rec: WriteRecord) -> None:
         incs.append(_cached(
             _CONFLICT_INC, (kind, verb, w),
             "grove_store_conflicts_total",
+            (("kind", kind), ("verb", verb), ("writer", w))))
+    for kind, verb in rec.fenced:
+        incs.append(_cached(
+            _FENCED_INC, (kind, verb, w),
+            "grove_store_fenced_writes_total",
             (("kind", kind), ("verb", verb), ("writer", w))))
     for kind, etype in rec.events:
         incs.append(_cached(

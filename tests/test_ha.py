@@ -1,0 +1,601 @@
+"""HA control plane (grove_tpu/ha, proposal 0002): epoch-fenced writes,
+leadership transitions (demote hygiene / warm-start re-promotion), the
+hot-standby mirror + warm WAL-delta load, and the standby write
+redirect. The full-scale failover proof is ``make bench-failover``;
+these pin the mechanisms in isolation."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.runtime.errors import ConflictError, FencedError
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.store.client import Client
+from grove_tpu.store.persist import release_state_lock
+from grove_tpu.store.store import Store
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+
+def pcs(name="web", replicas=1, pods=2):
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=replicas,
+                              template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=pods, tpu_chips_per_pod=4,
+                container=ContainerSpec(argv=["sleep", "inf"]))])))
+
+
+# 2x4 slices (2 hosts / 8 chips each, the chaos-harness shape): one
+# 2-pod x 4-chip gang packs a slice.
+FLEET = FleetSpec(slices=[SliceSpec(generation="v5e", topology="2x4",
+                                    count=2)])
+
+
+# ---- epoch fencing at the store ----------------------------------------
+
+def test_fenced_write_rejected_and_counted():
+    store = Store()
+    store.create(pcs("fence"))
+    assert store.fencing_epoch() == 0
+    epoch = store.bump_epoch()
+    assert epoch == 1
+
+    stale = Client(store)
+    stale.epoch = 0
+    before = GLOBAL_METRICS.counter_total("grove_store_fenced_writes_total")
+    with pytest.raises(FencedError):
+        stale.patch_status(PodCliqueSet, "fence", {})
+    with pytest.raises(FencedError):
+        stale.create(pcs("fence-2"))
+    with pytest.raises(FencedError):
+        stale.delete(PodCliqueSet, "fence")
+    live = stale.get(PodCliqueSet, "fence")
+    with pytest.raises(FencedError):
+        stale.update(live)
+    with pytest.raises(FencedError):
+        stale.update_status(live)
+    with pytest.raises(FencedError):
+        stale.update_status_many([live])
+    after = GLOBAL_METRICS.counter_total("grove_store_fenced_writes_total")
+    assert after - before == 6
+    # FencedError is a ConflictError: existing wire/conflict handling
+    # treats it as terminal staleness, not a validation bug.
+    assert issubclass(FencedError, ConflictError)
+
+
+def test_current_epoch_and_unfenced_writes_pass():
+    store = Store()
+    store.bump_epoch()
+    current = Client(store)
+    current.epoch = store.fencing_epoch()
+    current.create(pcs("ok"))                      # current epoch: fine
+    unfenced = Client(store)                       # epoch None: never gated
+    assert unfenced.epoch is None
+    unfenced.patch_status(PodCliqueSet, "ok", {})
+    # a FUTURE epoch (writer promoted against a store that hasn't seen
+    # the bump yet) is not stale — accepted.
+    ahead = Client(store)
+    ahead.epoch = store.fencing_epoch() + 5
+    ahead.patch_status(PodCliqueSet, "ok", {})
+
+
+def test_ha_kill_switch_disables_fence(monkeypatch):
+    monkeypatch.setenv("GROVE_HA", "0")
+    store = Store()
+    store.create(pcs("off"))
+    store.bump_epoch()
+    stale = Client(store)
+    stale.epoch = 0
+    stale.patch_status(PodCliqueSet, "off", {})    # no FencedError
+
+
+# ---- epoch persistence (snapshot + WAL + zombie records) ---------------
+
+def test_epoch_persists_through_wal_and_compaction(tmp_path):
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("e"))
+    assert s1.bump_epoch() == 1
+    assert s1.bump_epoch() == 2
+
+    s2 = Store(state_dir=d)                        # WAL replay
+    assert s2.fencing_epoch() == 2
+    s2._persister.compact(
+        [o for objs in s2._objects.values() for o in objs.values()],
+        rv=s2.current_rv(), epoch=s2.fencing_epoch())
+    s3 = Store(state_dir=d)                        # snapshot only
+    assert s3.fencing_epoch() == 2
+    # sidecar mirrors the epoch for the warm loader
+    assert json.load(open(os.path.join(d, "EPOCH")))["epoch"] == 2
+
+
+def test_zombie_stale_epoch_wal_records_dropped_on_load(tmp_path):
+    """A fenced ex-leader appending to the WAL after the takeover bump
+    loses those records on the next load — the record-level half of
+    the zombie guard (the store-level half is FencedError)."""
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("zombie", replicas=1))
+    s1.bump_epoch()                                # the new leader fences
+    # Zombie append: a stale-epoch put rewriting replicas, plus a
+    # stale-epoch delete of the object — crafted as the dead writer's
+    # file handle would have written them.
+    from grove_tpu.api.serde import to_dict
+    live = s1.get(PodCliqueSet, "zombie")
+    live.spec.replicas = 99
+    live.meta.resource_version = s1.current_rv() + 100
+    with open(os.path.join(d, "wal.jsonl"), "a") as f:
+        f.write(json.dumps({"op": "put", "kind": "PodCliqueSet",
+                            "e": 0, "data": to_dict(live)}) + "\n")
+        f.write(json.dumps({"op": "delete", "kind": "PodCliqueSet",
+                            "ns": "default", "name": "zombie",
+                            "rv": s1.current_rv() + 101, "e": 0}) + "\n")
+    s2 = Store(state_dir=d)
+    back = s2.get(PodCliqueSet, "zombie")          # delete was dropped
+    assert back.spec.replicas == 1                 # put was dropped
+
+
+# ---- warm (WAL-delta) load ---------------------------------------------
+
+def _all_objects(store: Store) -> dict:
+    return {(k, ns, name): o
+            for k, objs in store._objects.items()
+            for (ns, name), o in objs.items()}
+
+
+def _mirror_at_now(store: Store) -> tuple[dict, int]:
+    """A perfect mirror at the store's current rv (what a caught-up
+    standby holds), as serde round-tripped copies."""
+    from grove_tpu.api.serde import clone
+    return ({k: clone(o) for k, o in _all_objects(store).items()},
+            store.current_rv())
+
+
+def test_warm_load_equals_full_load(tmp_path):
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("a"))
+    s1.create(pcs("b"))
+    s1.delete(PodCliqueSet, "b")
+    mirror, rv = _mirror_at_now(s1)
+    # Delta past the mirror: an update, a create, and a delete.
+    live = s1.get(PodCliqueSet, "a")
+    live.spec.replicas = 7
+    s1.update(live)
+    s1.create(pcs("c"))
+    s1.delete(PodCliqueSet, "c")
+    s1.bump_epoch()
+
+    warm = Store(state_dir=d, warm=(mirror, rv))
+    assert warm._persister.last_load["mode"] == "warm"
+    assert warm._persister.last_load["decoded"] < \
+        warm._persister.last_load["lines"]
+    assert warm.fencing_epoch() == 1
+    assert warm.get(PodCliqueSet, "a").spec.replicas == 7
+    with pytest.raises(Exception):
+        warm.get(PodCliqueSet, "c")
+    release_state_lock(d)
+
+    full = Store(state_dir=d)
+    assert full._persister.last_load["mode"] == "full"
+    from grove_tpu.api.serde import to_dict
+    warm_state = {k: to_dict(o) for k, o in _all_objects(warm).items()}
+    full_state = {k: to_dict(o) for k, o in _all_objects(full).items()}
+    assert warm_state == full_state
+    assert warm.current_rv() == full.current_rv()
+
+
+def test_warm_load_repairs_torn_tail_before_appending(tmp_path):
+    """A SIGKILL mid-append (the failover case) leaves a torn final WAL
+    line; the warm loader must repair it exactly as the full loader
+    does — or the promoted store's first append merges into the torn
+    line and the NEXT load drops every post-failover record."""
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("a"))
+    mirror, rv = _mirror_at_now(s1)
+    s1.create(pcs("b"))                          # the unmirrored delta
+    with open(os.path.join(d, "wal.jsonl"), "a") as f:
+        f.write('{"op": "put", "kind": "PodCliqueSet", "e": 0, "da')
+
+    warm = Store(state_dir=d, warm=(mirror, rv))
+    assert warm._persister.last_load["mode"] == "warm"
+    warm.create(pcs("post-failover"))            # appends to the WAL
+    release_state_lock(d)
+    full = Store(state_dir=d)                    # nothing merged/lost
+    assert {o.meta.name for o in full.list(PodCliqueSet)} == \
+        {"a", "b", "post-failover"}
+
+
+def test_warm_load_falls_back_on_zombie_rv_rewind(tmp_path):
+    """A zombie leader appending through a stale handle rewinds the
+    tail's rv ordering; the backward cut-point scan must refuse (full
+    load handles zombies via the in-order epoch fence) rather than
+    mistake the zombie's low rv for the mirrored boundary and drop the
+    real leader's unmirrored records."""
+    from grove_tpu.api.serde import to_dict
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("a"))
+    mirror, rv = _mirror_at_now(s1)
+    live = s1.get(PodCliqueSet, "a")
+    live.spec.replicas = 9
+    s1.update(live)                              # unmirrored: rv+1
+    s1.bump_epoch()
+    # Zombie append: stale epoch AND a rewound rv (its own counter).
+    zombie = s1.get(PodCliqueSet, "a")
+    zombie.spec.replicas = 1
+    zombie.meta.resource_version = rv            # <= warm_rv: the trap
+    with open(os.path.join(d, "wal.jsonl"), "a") as f:
+        f.write(json.dumps({"op": "put", "kind": "PodCliqueSet",
+                            "e": 0, "data": to_dict(zombie)}) + "\n")
+    warm = Store(state_dir=d, warm=(mirror, rv))
+    assert warm._persister.last_load["mode"] == "full"
+    assert warm.get(PodCliqueSet, "a").spec.replicas == 9
+
+
+def test_warm_load_refuses_newer_build_wal(tmp_path):
+    """A WAL headed by a NEWER schema version must not be warm-decoded
+    by an older standby — the fallback reaches load()'s proper
+    StateVersionError refusal instead of silent downgrade corruption."""
+    from grove_tpu.store.persist import StateVersionError
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("v"))
+    mirror, rv = _mirror_at_now(s1)
+    wal = os.path.join(d, "wal.jsonl")
+    lines = open(wal).read().splitlines()
+    header = json.loads(lines[0])
+    header["v"] += 1                               # a newer build's WAL
+    with open(wal, "w") as f:
+        f.write("\n".join([json.dumps(header)] + lines[1:]) + "\n")
+    release_state_lock(d)
+    with pytest.raises(StateVersionError, match="newer build"):
+        Store(state_dir=d, warm=(mirror, rv))
+
+
+def test_leader_kill_fault_noops_with_ha_disabled(monkeypatch):
+    import random
+    from grove_tpu.chaos.faults import ChaosContext, LeaderKillFault
+    monkeypatch.setenv("GROVE_HA", "0")
+    cluster = new_cluster(fleet=FLEET)
+    with cluster:
+        ctx = ChaosContext(cluster, random.Random(0), workload_pcs="x")
+        assert LeaderKillFault().inject(ctx) is False
+        assert cluster.manager.leadership.is_leader  # nothing demoted
+
+
+def test_warm_load_falls_back_when_snapshot_outruns_mirror(tmp_path):
+    d = str(tmp_path / "state")
+    s1 = Store(state_dir=d)
+    s1.create(pcs("x"))
+    mirror, rv = _mirror_at_now(s1)
+    s1.create(pcs("y"))
+    # Compaction folds the y-create into the snapshot: the mirror at rv
+    # can no longer be completed from the WAL alone.
+    s1._persister.compact(
+        [o for objs in s1._objects.values() for o in objs.values()],
+        rv=s1.current_rv(), epoch=0)
+    warm = Store(state_dir=d, warm=(mirror, rv))
+    assert warm._persister.last_load["mode"] == "full"
+    warm.get(PodCliqueSet, "y")                    # nothing lost
+
+
+# ---- wire fence + standby redirect -------------------------------------
+
+@pytest.fixture()
+def served_cluster():
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.server import ApiServer
+    config = OperatorConfiguration()
+    config.server_auth.allow_anonymous_mutations = True
+    # An operator token so standbys can mirror Secrets (anonymous
+    # watches censor them, breaking mirror contiguity by design).
+    config.server_auth.tokens["op-token"] = OPERATOR_ACTOR
+    cluster = new_cluster(config=config, fleet=FLEET)
+    cluster.start()
+    server = ApiServer(cluster, port=0)
+    server.start()
+    yield cluster, server
+    server.stop()
+    cluster.stop()
+
+
+def test_wire_epoch_fence(served_cluster):
+    from grove_tpu.store.httpclient import HttpClient
+    cluster, server = served_cluster
+    cluster.client.create(pcs("wire"))
+    cluster.manager.store.bump_epoch()
+    http = HttpClient(f"http://127.0.0.1:{server.port}")
+    http.epoch = 0                                 # deposed writer
+    with pytest.raises(ConflictError, match="fenced"):
+        http.patch_status(PodCliqueSet, "wire", {})
+    live = http.get(PodCliqueSet, "wire")
+    with pytest.raises(ConflictError, match="fenced"):
+        http.update_status(live)
+    # current term: accepted (patch — no rv precondition, so a racing
+    # controller status write can't turn the positive case into a 409)
+    http.epoch = cluster.manager.store.fencing_epoch()
+    http.patch_status(PodCliqueSet, "wire", {})
+
+
+def test_debug_leadership_surfaces(served_cluster):
+    from grove_tpu.store.httpclient import HttpClient
+    cluster, server = served_cluster
+    http = HttpClient(f"http://127.0.0.1:{server.port}")
+    payload = http.debug_leadership()
+    assert payload["role"] == "leader"
+    assert payload["store_epoch"] == cluster.manager.store.fencing_epoch()
+    twin = cluster.client.debug_leadership()
+    assert twin["role"] == payload["role"]
+    assert twin["replica"] == payload["replica"]
+
+
+def test_leader_status_cli(served_cluster, capsys):
+    import argparse
+    from grove_tpu.cli import cmd_leader_status
+    cluster, server = served_cluster
+    args = argparse.Namespace(server=f"http://127.0.0.1:{server.port}",
+                              ca=None)
+    assert cmd_leader_status(args) == 0            # un-fenced leader
+    out = capsys.readouterr().out
+    assert "role:         leader" in out
+    assert "epoch:" in out
+    # a fenced replica (store epoch moved past its claim) exits 1 and
+    # says so
+    cluster.manager.store.bump_epoch()
+    assert cmd_leader_status(args) == 1
+    assert "FENCED" in capsys.readouterr().out
+
+
+def test_standby_server_503_hint_and_client_follow(served_cluster):
+    """The standby refuses writes with 503 + a leader hint; HttpClient
+    and cli._http both follow the hint and land the write."""
+    from grove_tpu.cli import _http
+    from grove_tpu.ha.standby import HotStandby, StandbyServer
+    from grove_tpu.store.httpclient import HttpClient
+    cluster, server = served_cluster
+    leader_url = f"http://127.0.0.1:{server.port}"
+    standby = HotStandby(leader_url)
+    standby.start()
+    sserver = StandbyServer(standby)
+    sserver.start()
+    try:
+        cluster.client.create(pcs("redir"))
+        wait_for(lambda: standby.get_object(
+            "PodCliqueSet", "redir", "default") is not None,
+            desc="mirror catches the create")
+        standby_url = f"http://127.0.0.1:{sserver.port}"
+        # reads serve from the mirror
+        http = HttpClient(standby_url)
+        assert http.get(PodCliqueSet, "redir").meta.name == "redir"
+        # a write follows the hint to the leader (client re-targets)
+        http.patch_status(PodCliqueSet, "redir", {})
+        assert http.server == leader_url
+        # cli._http follows too
+        status, body = _http(standby_url, "/api/PodCliqueSet/redir",
+                             "DELETE")
+        assert status == 200 and body.get("deleted") == "redir"
+        # without a follow, the refusal names the leader
+        raw = HttpClient(standby_url)
+        raw.follow_leader = False
+        from grove_tpu.runtime.errors import GroveError
+        with pytest.raises(GroveError, match="standby"):
+            raw.patch_status(PodCliqueSet, "redir", {})
+    finally:
+        sserver.stop()
+        standby.stop()
+
+
+def test_standby_mirror_stays_contiguous(served_cluster):
+    from grove_tpu.ha.standby import HotStandby
+    cluster, server = served_cluster
+    standby = HotStandby(f"http://127.0.0.1:{server.port}",
+                         token="op-token")
+    standby.start()
+    try:
+        for i in range(3):
+            cluster.client.create(pcs(f"m{i}"))
+        cluster.client.delete(PodCliqueSet, "m1")
+        rv0 = cluster.manager.store.current_rv()
+        # Catch up to a FIXED point (the live cluster keeps writing
+        # status behind us, so equality with a later current_rv races).
+        wait_for(lambda: standby.rv >= rv0, desc="mirror catches rv0")
+        assert standby.get_object("PodCliqueSet", "m2",
+                                  "default") is not None
+        assert standby.get_object("PodCliqueSet", "m1",
+                                  "default") is None
+        _objects, _rv, contiguous = standby.mirror_snapshot()
+        assert contiguous, "a system-token watch delivers every seq " \
+            "(nothing censored) — the warm-load precondition"
+    finally:
+        standby.stop()
+
+
+# ---- leadership transitions: demote hygiene + warm re-promotion --------
+
+def test_demote_parks_drops_and_clears_then_repromote():
+    """The SURVEY §7 hygiene pin: losing leadership mid-flight drops
+    queued work, clears the ExpectationsStore, and fences in-flight
+    writers; re-promotion resyncs from live state and finishes the job
+    with zero duplicates."""
+    from grove_tpu.chaos.invariants import InvariantChecker
+
+    cluster = new_cluster(fleet=FLEET)
+    with cluster:
+        mgr = cluster.manager
+        client = cluster.client
+        client.create(pcs("ha", pods=2))
+        wait_for(lambda: client.get(PodCliqueSet, "ha")
+                 .status.available_replicas >= 1, timeout=20.0,
+                 desc="workload up before the transition")
+
+        # A rival replica fences the store, and this manager notices.
+        rival_epoch = mgr.store.bump_epoch()
+        dropped = mgr.demote(leader_hint="rival")
+        assert not mgr.leadership.is_leader
+        # queued work is gone and new work is refused
+        pclq = next(ctrl for ctrl in mgr.controllers
+                    if ctrl.name == "podclique")
+        from grove_tpu.runtime.controller import Request
+        pclq.enqueue(Request("default", "ignored"))
+        assert len(pclq.queue) == 0
+        # expectations cleared (seed one to prove the hook runs on the
+        # next demote too)
+        reconciler_expectations = pclq.on_park.__self__
+        reconciler_expectations.expect_creates("default/ha-0-w",
+                                               ["uid-stale"])
+        mgr.demote()
+        assert reconciler_expectations.satisfied("default/ha-0-w")
+        # deposed writers are fenced
+        with pytest.raises(FencedError):
+            mgr.cached_client.patch_status(PodCliqueSet, "ha", {})
+
+        # A spec change lands while deposed (the USER is not fenced) —
+        # nothing may act on it until re-promotion.
+        live = client.get(PodCliqueSet, "ha")
+        live.spec.replicas = 2
+        client.update(live)
+        time.sleep(0.3)
+        assert client.get(PodCliqueSet, "ha") \
+            .status.available_replicas <= 1
+
+        new_epoch = mgr.promote()
+        assert new_epoch > rival_epoch
+        assert mgr.leadership.is_leader
+        assert mgr.leadership.transitions >= 2
+        wait_for(lambda: client.get(PodCliqueSet, "ha")
+                 .status.available_replicas >= 2, timeout=30.0,
+                 desc="re-promoted leader finishes the scale-up")
+        checker = InvariantChecker(cluster)
+        violations = (checker.check_no_duplicates()
+                      + checker.check_live_owner())
+        assert not violations, violations
+        # current-term writers work again
+        mgr.cached_client.patch_status(PodCliqueSet, "ha", {})
+
+
+def test_leader_kill_chaos_fault_roundtrip():
+    """The chaos mix's leadership fault: inject proves the fence and
+    demotes; heal re-promotes; the workload converges after."""
+    import random
+    from grove_tpu.chaos.faults import ChaosContext, LeaderKillFault
+
+    cluster = new_cluster(fleet=FLEET)
+    with cluster:
+        client = cluster.client
+        client.create(pcs("soak"))
+        wait_for(lambda: client.get(PodCliqueSet, "soak")
+                 .status.available_replicas >= 1, timeout=20.0,
+                 desc="workload up")
+        ctx = ChaosContext(cluster, random.Random(0),
+                           workload_pcs="soak")
+        fault = LeaderKillFault()
+        assert fault.inject(ctx) is True
+        assert not cluster.manager.leadership.is_leader
+        fault.heal(ctx)
+        assert cluster.manager.leadership.is_leader
+        wait_for(lambda: client.get(PodCliqueSet, "soak")
+                 .status.available_replicas >= 1, timeout=20.0,
+                 desc="workload healthy after the transition")
+
+
+# ---- in-process standby promotion (the subprocess twin is the smoke) ---
+
+def test_hot_standby_promotes_warm_in_process(tmp_path):
+    """Leader cluster on a state dir + server; standby mirrors it; the
+    leader 'dies' (cluster stopped, lock released — the in-process
+    stand-in for SIGKILL); promote() warm-loads, fences, and the new
+    cluster reconciles the loaded workload."""
+    from grove_tpu.admission.authorization import OPERATOR_ACTOR
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.server import ApiServer
+
+    d = str(tmp_path / "state")
+    config = OperatorConfiguration()
+    config.server_auth.tokens["op-token"] = OPERATOR_ACTOR
+    leader = new_cluster(config=config, fleet=FLEET, state_dir=d)
+    leader.start()
+    server = ApiServer(leader, port=0)
+    server.start()
+    from grove_tpu.ha.standby import HotStandby
+    standby = HotStandby(f"http://127.0.0.1:{server.port}", state_dir=d,
+                         replica="standby-test", token="op-token")
+    try:
+        leader.client.create(pcs("ha"))
+        wait_for(lambda: leader.client.get(PodCliqueSet, "ha")
+                 .status.available_replicas >= 1, timeout=20.0,
+                 desc="leader deploys")
+        standby.start()
+        wait_for(lambda: standby.rv >= leader.client.current_rv(),
+                 desc="mirror caught up")
+        # leader dies
+        server.stop()
+        leader.stop()
+        release_state_lock(d)
+
+        promoted = standby.promote()
+        try:
+            store = promoted.manager.store
+            assert store._persister.last_load["mode"] == "warm"
+            assert store.fencing_epoch() == 1
+            assert promoted.manager.leadership.is_leader
+            assert promoted.manager.leadership.replica == "standby-test"
+            # loaded workload is live and reconciled by the new leader
+            live = promoted.client.get(PodCliqueSet, "ha")
+            assert live.spec.replicas == 1
+            live.spec.replicas = 2
+            promoted.client.update(live)
+            wait_for(lambda: promoted.client.get(PodCliqueSet, "ha")
+                     .status.available_replicas >= 2, timeout=30.0,
+                     desc="promoted leader scales the loaded workload")
+            # the dead leader's term is fenced
+            stale = Client(store)
+            stale.epoch = 0
+            with pytest.raises(FencedError):
+                stale.patch_status(PodCliqueSet, "ha", {})
+        finally:
+            promoted.stop()
+    finally:
+        standby.stop()
+        server.stop()
+
+
+# ---- controller parking unit ------------------------------------------
+
+def test_delayqueue_drain_drops_pending_and_dirty():
+    from grove_tpu.runtime.controller import Request, _DelayQueue
+    q = _DelayQueue("t")
+    a, b, d = (Request("default", x) for x in ("a", "b", "d"))
+    q.add(a)
+    q.add(b, delay=5.0)
+    popped = q.get(timeout=1.0)
+    q.add(popped)                                  # dirty while processing
+    q.add(d, delay=0.0)
+    dropped = q.drain()
+    assert dropped == 3                    # b + d pending, a dirty
+    q.done(popped)                                 # dirty re-add dropped too
+    assert q.get(timeout=0.05) is None
+    assert len(q) == 0

@@ -56,6 +56,9 @@ def render(rows: list[dict]) -> str:
                    if r.get("metric") == "chaos_ttr_p99_drift"}
     leader_kills = [r for r in rows
                     if r.get("metric") == "chaos_leader_kill_resume_s"]
+    failovers = [r for r in rows
+                 if r.get("metric") in ("failover_resume_warm_s",
+                                        "failover_resume_cold_s")]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
                 "serving-cpu", "chaos-cpu", "defrag-cpu"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
@@ -174,6 +177,30 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('pods', '?')} | {r.get('pods_at_kill', '?')} "
                 f"| {r.get('value', 0):.2f} "
                 f"| {r.get('violations', 0)} |")
+        out.append("")
+    if failovers:
+        out += ["## Hot-standby vs cold failover (grove_tpu/ha, "
+                "docs/design/ha.md)", "",
+                "_same seed, leader SIGKILLed mid-300-pod deploy after "
+                "a deploy+teardown history phase; warm = epoch fence + "
+                "WAL-delta load from the standby's wire mirror_", "",
+                "| when | git | takeover | pods | resume s | load s | "
+                "WAL decoded/total | epoch | ok |",
+                "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(failovers, key=lambda r: (r.get("ts", ""),
+                                                  r.get("metric", ""))):
+            takeover = ("warm" if "warm" in r.get("metric", "")
+                        else "cold")
+            load_s = r.get("load_s")
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {takeover} | {r.get('pods', '?')} "
+                f"| {r.get('value', 0):.2f} "
+                f"| {load_s if load_s is not None else '-'} "
+                f"| {r.get('load_decoded', '?')}/"
+                f"{r.get('load_lines', '?')} "
+                f"| {r.get('epoch', 0)} "
+                f"| {'yes' if r.get('ok') else 'NO'} |")
         out.append("")
     if serving:
         out += ["## Serving SLO loop (load-gen ramp, CPU engine)", "",

@@ -108,6 +108,11 @@ def main(argv=None) -> int:
     parser.add_argument("--resume-budget", type=float, default=30.0,
                         help="leader-kill only: seconds (pre-TIME_SCALE) "
                              "for reconcile to resume after the kill")
+    parser.add_argument("--hot", action="store_true",
+                        help="leader-kill only: take over via the HOT "
+                             "standby (wire mirror + epoch fence + "
+                             "WAL-delta warm load, grove_tpu/ha) "
+                             "instead of the cold flock-takeover path")
     parser.add_argument("--drift-factor", type=float, default=10.0,
                         help="max allowed ttr p99 drift across cycles")
     parser.add_argument("--history", action="store_true",
@@ -152,7 +157,8 @@ def main(argv=None) -> int:
 
     if args.scenario == "leader-kill":
         report = run_leader_kill(pods=args.pods,
-                                 resume_budget_s=args.resume_budget)
+                                 resume_budget_s=args.resume_budget,
+                                 hot_standby=args.hot)
         print(json.dumps(report, indent=2))
         if args.history:
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -164,6 +170,8 @@ def main(argv=None) -> int:
                 "scenario": "leader-kill",
                 "pods": report["pods"],
                 "pods_at_kill": report["pods_at_kill"],
+                "takeover": report.get("mode", "cold"),
+                "epoch": report.get("epoch", 0),
                 "violations": len(report["violations"]),
                 "mode": "chaos-cpu",
             })
