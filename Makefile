@@ -134,6 +134,11 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# batched /metrics/push -> ServingObserver -> /debug/serving
 	@# renders with the SLO judged against the autoscaling target.
 	$(PY) tools/serving_smoke.py
+	@# engine-profile smoke: tiny engine -> flight recorder + compile
+	@# tracker (exactly the expected lowerings, 0 recompiles) ->
+	@# /debug/xprof renders -> grovectl engine-profile exits 0
+	@# (docs/design/data-plane-observability.md).
+	$(PY) tools/engine_profile_smoke.py
 	@# defrag smoke: one fragmented 2-slice fleet -> migration plan ->
 	@# hold/drain/rebind -> the stuck gang schedules, the Fragmented
 	@# gauge drops, holds release (docs/design/defrag.md).

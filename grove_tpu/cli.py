@@ -735,6 +735,30 @@ def cmd_serving_status(args: argparse.Namespace) -> int:
     return 1 if breached else 0
 
 
+def cmd_engine_profile(args: argparse.Namespace) -> int:
+    """Render one serving engine's data-plane observatory payload from
+    the serve daemon (GET /debug/xprof/<ns>/<name>): device-time phase
+    breakdown with the hottest phase starred, the XLA compile table
+    (lowerings, recompiles, storm warnings), memory accounting with a
+    KV-headroom bar, and roofline estimates (stamped model-derived on
+    backends without live stats) — the execution-layer companion to
+    `grovectl serving-status` (that judges latency SLOs; this says
+    where the device time and HBM go). Exit 0 on a healthy profile,
+    1 when recompile storms were recorded (scripts alert on shape
+    churn)."""
+    from grove_tpu.serving.xprof import render_engine_profile
+    status, data = _http(args.server,
+                         f"/debug/xprof/{args.namespace}/{args.name}",
+                         ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    for line in render_engine_profile(data):
+        print(line)
+    storms = (data.get("compile") or {}).get("storms", 0)
+    return 1 if storms else 0
+
+
 def cmd_defrag_status(args: argparse.Namespace) -> int:
     """Render the serve daemon's defrag plan ledger: the in-flight
     migration (hold/drain/rebind state), recent completed/aborted
@@ -1317,6 +1341,19 @@ def main(argv: list[str] | None = None) -> int:
     ss.add_argument("--server", default=default_server)
     add_ca(ss)
     ss.set_defaults(fn=cmd_serving_status)
+
+    ep = sub.add_parser(
+        "engine-profile",
+        help="data-plane observatory view of a serving engine: "
+             "device-time phase breakdown, XLA compile table, memory "
+             "accounting, roofline estimates (exit 0 = healthy, 1 = "
+             "recompile storms recorded; the execution-layer companion "
+             "to serving-status)")
+    ep.add_argument("name")
+    ep.add_argument("--namespace", default="default")
+    ep.add_argument("--server", default=default_server)
+    add_ca(ep)
+    ep.set_defaults(fn=cmd_engine_profile)
 
     dfs = sub.add_parser(
         "defrag-status",

@@ -41,6 +41,22 @@ LIFECYCLE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 PENDING_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
                    1800.0, 3600.0)
 
+# Pinned buckets for the data-plane device-step histogram
+# (serving/xprof.py): a tiny CPU test engine decodes in tens of
+# microseconds to milliseconds per step, a real chip in low
+# milliseconds, and a tunnelled/degraded relay can stretch one block
+# dispatch past a second — the default duration buckets (5ms floor)
+# would flatten the entire healthy band.
+DEVICE_STEP_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
+                       2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25,
+                       0.5, 1.0, 2.5)
+
+# Pinned buckets for XLA compile wall time: a tiny test graph builds in
+# tens of milliseconds, a flagship decode graph in seconds, and a cold
+# 70B-scale lowering over a slow relay in minutes.
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0, 120.0, 300.0)
+
 # Pinned buckets for the store-lock wait/hold histograms: a healthy
 # write's critical section is microseconds, contention under a deploy
 # storm is milliseconds, and anything past 100ms means the global lock
@@ -559,6 +575,39 @@ GLOBAL_METRICS.describe(
     "Writes rejected by the leadership fence (writer epoch older than "
     "the store's) per kind, verb, and writer — a deposed leader's "
     "zombie writes made visible")
+# Data-plane observatory (serving/xprof.py, docs/design/
+# data-plane-observability.md): XLA compile/step/memory telemetry for
+# the serving engine — all host-side, GROVE_XPROF=0 disables.
+GLOBAL_METRICS.describe_histogram(
+    "grove_compile_seconds",
+    "XLA compile wall time per engine-compiled function (prefill|"
+    "step|step_sampled|step_block|step_block_sampled), recorded by "
+    "the CompileTracker when a dispatch grew the jit cache",
+    buckets=COMPILE_BUCKETS)
+GLOBAL_METRICS.describe(
+    "grove_recompiles_total",
+    "Executable builds per compiled fn and reason (first=expected "
+    "warm-up lowering, shape-change=new argument signature, "
+    "cache-evict=signature seen before but rebuilt) — any non-first "
+    "rate on a serving engine means shapes are churning")
+GLOBAL_METRICS.describe(
+    "grove_recompile_storms_total",
+    "Recompile-storm warnings: more than the threshold of non-first "
+    "compiles inside the sliding window (the dynamic-shape-leak "
+    "alarm; each one also logs a warning)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_device_step_seconds",
+    "Sampled per-step device time by phase (prefill|step|sample|"
+    "host_transfer), measured host-side with synced dispatch ends by "
+    "the decode-step flight recorder — every Nth dispatch, never on "
+    "the JIT path",
+    buckets=DEVICE_STEP_BUCKETS)
+GLOBAL_METRICS.describe(
+    "grove_hbm_bytes",
+    "Engine memory accounting per kind (kv_cache|weights|workspace|"
+    "total) and scope, from device.memory_stats() where the backend "
+    "supports it and model-derived byte counts otherwise (the "
+    "payload's source field says which)")
 GLOBAL_METRICS.describe_histogram(
     "grove_failover_resume_seconds",
     "Leader death to reconcile observably resumed on the promoted "

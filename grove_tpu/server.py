@@ -53,6 +53,13 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       reporter liveness; grovectl
                                       serving-status renders it; same
                                       read gate as /debug/placement)
+  GET  /debug/xprof/<ns>/<name>       data-plane observatory payload
+                                      for one serving engine (compile
+                                      table, device-time phase
+                                      breakdown, memory accounting,
+                                      roofline estimates; grovectl
+                                      engine-profile renders it; same
+                                      read gate as /debug/placement)
   GET  /debug/defrag                  defrag plan ledger: in-flight
                                       migration, recent plans, budget
                                       (grovectl defrag-status renders
@@ -475,6 +482,9 @@ class ApiServer:
                     elif len(parts) == 4 and parts[0] == "debug" \
                             and parts[1] == "serving":
                         self._debug_serving(parts[2], parts[3])
+                    elif len(parts) == 4 and parts[0] == "debug" \
+                            and parts[1] == "xprof":
+                        self._debug_xprof(parts[2], parts[3])
                     elif url.path == "/debug/defrag":
                         self._debug_defrag()
                     elif url.path == "/debug/leadership":
@@ -803,6 +813,16 @@ class ApiServer:
                 NotFoundError from the twin maps to 404 in do_GET's
                 handler."""
                 self._send(200, cluster.client.debug_serving(
+                    name, namespace))
+
+            def _debug_xprof(self, namespace: str, name: str):
+                """GET /debug/xprof/<ns>/<name> — one engine's
+                data-plane observatory payload (``grovectl
+                engine-profile`` renders it). Aggregate device-time/
+                compile/memory data like /debug/serving, so it shares
+                the read gate, not the profiling gate. NotFoundError
+                from the twin maps to 404 in do_GET's handler."""
+                self._send(200, cluster.client.debug_xprof(
                     name, namespace))
 
             def _workload_owns(self, actor: str, payload: dict) -> bool:

@@ -17,6 +17,7 @@ and TPU-shaped:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import deque
 from functools import partial
@@ -169,7 +170,8 @@ class DecodeEngine:
                  host_sync_interval: int = 8,
                  sampler: SamplerConfig | None = None,
                  quant: str | None = None,
-                 telemetry=None):
+                 telemetry=None,
+                 xprof=None):
         self.cfg = cfg
         # Init-only: the sampled step closes over this config at compile
         # time, so later mutation cannot take effect (and is rejected).
@@ -264,6 +266,40 @@ class DecodeEngine:
 
         self._prefill = jax.jit(pf, donate_argnums=(3,))
 
+        # TTFT stamp semantics: by default admit_ts is queue-exit
+        # (pre-prefill) and first_token_ts is prefill completion — the
+        # split the flight recorder's direct prefill timing enables.
+        # GROVE_TTFT_COMPAT=1 restores the historical fused stamp
+        # (admit == first-token, both post-prefill).
+        self._ttft_compat = os.environ.get("GROVE_TTFT_COMPAT", "0") == "1"
+
+        # Data-plane observatory (serving/xprof.py): compile tracking
+        # on the jitted callables, sampled device timings, memory
+        # gauges — all host-side. ``xprof`` may be an Observatory (the
+        # caller names the scope), None (auto-create unless
+        # GROVE_XPROF=0), or False (explicitly off). With the
+        # observatory off, every attribute below stays the raw jit and
+        # the hot path is exactly the pre-observatory shape.
+        self.xprof = None
+        if xprof is not False:
+            from grove_tpu.serving import xprof as xprof_mod
+            if xprof is not None:
+                self.xprof = xprof
+                self.xprof.cfg = cfg
+                self.xprof.batch = batch
+                self.xprof.max_len = self.max_len
+            elif xprof_mod.enabled():
+                self.xprof = xprof_mod.Observatory(
+                    cfg=cfg, batch=batch, max_len=self.max_len)
+        if self.xprof is not None:
+            wrap = self.xprof.compile.wrap
+            self._prefill = wrap("prefill", self._prefill)
+            self._step = wrap("step", self._step)
+            self._step_sampled = wrap("step_sampled", self._step_sampled)
+            self._step_block = wrap("step_block", self._step_block)
+            self._step_block_sampled = wrap("step_block_sampled",
+                                            self._step_block_sampled)
+
     @property
     def sampler(self) -> SamplerConfig:
         return self._sampler
@@ -314,16 +350,27 @@ class DecodeEngine:
         if self.telemetry is not None:
             self.telemetry.sample_gauges(len(self._queue),
                                          self.kv_lane_utilization)
+        if self.xprof is not None:
+            self.xprof.observe_memory(self, self.telemetry)
 
-    def _stamp_admit(self, req: Request, now: float) -> None:
-        """Admission stamps: the prefill's sampled token IS the first
-        token, so admit and first-token coincide (a request that never
-        went through submit() gets enqueue = admit: zero queue wait).
-        Both admission paths append that token right after stamping, so
-        it is counted here — the drain only sees decode-step tokens."""
-        req.admit_ts = now
+    def _stamp_admit(self, req: Request, now: float,
+                     admit: float | None = None) -> None:
+        """Admission stamps. ``now`` is when the first token existed
+        (the prefill's sampled token, post-prefill); ``admit`` is when
+        the request left the queue (pre-prefill). Historically one
+        stamp covered both, which conflated queue-exit with prefill
+        completion in the queue-wait histogram — the flight recorder
+        times prefill directly now, so the stamps split.
+        GROVE_TTFT_COMPAT=1 (or a path with no queue-exit time) fuses
+        them back to the old derivation. A request that never went
+        through submit() gets enqueue = admit: zero queue wait. Both
+        admission paths append the prefill token right after stamping,
+        so it is counted here — the drain only sees decode tokens."""
+        if self._ttft_compat or admit is None or admit > now:
+            admit = now
+        req.admit_ts = admit
         if not req.enqueue_ts:
-            req.enqueue_ts = now
+            req.enqueue_ts = admit
         req.first_token_ts = now
         if self.telemetry is not None:
             self.telemetry.add_tokens(1)
@@ -356,6 +403,10 @@ class DecodeEngine:
             lengths = jnp.full((b,), s, jnp.int32)
         else:
             lengths = jnp.asarray(lengths, jnp.int32)
+        x = self.xprof
+        admit_wall = time.time()  # queue-exit: prefill not yet started
+        if x is not None:
+            t0 = time.perf_counter()
         logits, self.cache = self._prefill(self.params, prompts, lengths,
                                            self.cache)
         if self._sampling:
@@ -363,6 +414,9 @@ class DecodeEngine:
             self._tokens = sample_tokens(logits, sub, self._sampler)
         else:
             self._tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if x is not None:
+            jax.block_until_ready(self._tokens)
+            x.record("prefill", time.perf_counter() - t0, tokens=b)
         self._active[:] = True
         if max_new_tokens is not None:
             prompts_np = np.asarray(prompts)
@@ -376,7 +430,7 @@ class DecodeEngine:
                               prompt_len=int(lengths_np[i]))
                 self._next_rid += 1
                 self._requests[i] = req
-                self._stamp_admit(req, now)
+                self._stamp_admit(req, now, admit=admit_wall)
                 # Count the prefill-sampled token like insert() does —
                 # both admission paths account tokens identically.
                 req.generated.append(int(first[i]))
@@ -432,7 +486,10 @@ class DecodeEngine:
         self._lane_window_start[lane] = len(self._pending_tokens)
         if request is not None:
             request.prompt_len = result.length
-            self._stamp_admit(request, time.time())
+            # A request pre-stamped at queue-exit (admit_from_queue)
+            # keeps that admit; bare inserts fuse admit = first-token.
+            self._stamp_admit(request, time.time(),
+                              admit=request.admit_ts or None)
             request.generated.append(result.next_token)
 
     def admit_from_queue(self, prefiller: PrefillWorker) -> int:
@@ -441,8 +498,32 @@ class DecodeEngine:
         lanes = self.free_lanes()
         while lanes and self._queue:
             take = min(len(lanes), prefiller.batch, len(self._queue))
+            popped = time.time()  # queue-exit, before the prefill runs
             reqs = [self._queue.popleft() for _ in range(take)]
+            for r in reqs:
+                r.admit_ts = popped
+            x = self.xprof
+            if x is not None:
+                # The worker's jit is NOT one of this engine's wrapped
+                # callables, so compile detection watches its cache
+                # size directly — a grown cache means this wall was an
+                # XLA build, recorded as a compile and kept out of the
+                # device-time histogram.
+                cache_size = getattr(getattr(prefiller, "_prefill", None),
+                                     "_cache_size", None)
+                before = cache_size() if cache_size is not None else -1
+                t0 = time.perf_counter()
             results = prefiller.prefill([r.prompt for r in reqs])
+            if x is not None:
+                # prefill() fetches the sampled tokens to host, so the
+                # wall here is completed device time, not dispatch.
+                dt = time.perf_counter() - t0
+                compiled = (cache_size is not None
+                            and cache_size() != before)
+                if compiled:
+                    x.compile.note_external_compile("worker_prefill", dt)
+                else:
+                    x.recorder.record("prefill", dt, tokens=take)
             for req, res in zip(reqs, results):
                 self.insert(lanes.pop(0), res, req)
                 admitted += 1
@@ -454,12 +535,24 @@ class DecodeEngine:
     def step(self) -> None:
         """One decode step across all lanes (inactive lanes compute too —
         static shapes beat per-lane control flow on TPU)."""
+        x = self.xprof
+        sampled = x is not None and x.should_sample()
+        if sampled:
+            # Drain the pending dispatch chain first, then time this
+            # step with synced ends: the delta is device time for ONE
+            # step, not queued backlog.
+            jax.block_until_ready(self._tokens)
+            t0 = time.perf_counter()
         if self._sampling:
             self._tokens, self.cache, self._rng = self._step_sampled(
                 self.params, self._tokens, self.cache, self._rng)
         else:
             self._tokens, self.cache = self._step(self.params, self._tokens,
                                                   self.cache)
+        if sampled:
+            jax.block_until_ready(self._tokens)
+            x.record("sample" if self._sampling else "step",
+                     time.perf_counter() - t0, tokens=self.batch)
         self.steps += 1
         if any(r is not None for r in self._requests):
             self._pending_tokens.append(self._tokens)
@@ -479,7 +572,11 @@ class DecodeEngine:
         window."""
         if not self._pending_tokens:
             return
+        if self.xprof is not None:
+            t0 = time.perf_counter()
         toks = np.asarray(jnp.stack(self._pending_tokens))  # [w, batch]
+        if self.xprof is not None:
+            self.xprof.record("host_transfer", time.perf_counter() - t0)
         self._pending_tokens.clear()
         self._process_window(toks, offsets=self._lane_window_start)
         self._lane_window_start[:] = 0
@@ -547,7 +644,12 @@ class DecodeEngine:
             block_steps = steps
         steps -= (block_steps // K) * K
         windows: list[jnp.ndarray] = []
+        x = self.xprof
         for _ in range(block_steps // K):
+            sampled = x is not None and x.should_sample()
+            if sampled:
+                jax.block_until_ready(self._tokens)
+                t0 = time.perf_counter()
             if self._sampling:
                 self._tokens, self.cache, window, self._rng = \
                     self._step_block_sampled(self.params, self._tokens,
@@ -555,6 +657,11 @@ class DecodeEngine:
             else:
                 self._tokens, self.cache, window = self._step_block(
                     self.params, self._tokens, self.cache)
+            if sampled:
+                jax.block_until_ready(self._tokens)
+                x.record("sample" if self._sampling else "step",
+                         time.perf_counter() - t0, steps=K,
+                         tokens=K * self.batch)
             self.steps += K
             if tracked:
                 windows.append(window)
@@ -563,8 +670,12 @@ class DecodeEngine:
             # This fetch doubles as the hard sync for the block phase:
             # it waits on the last window's compute, and its final row
             # IS the current token state — no second round trip needed.
+            if x is not None:
+                t0 = time.perf_counter()
             toks = np.asarray(windows[0] if len(windows) == 1
                               else jnp.concatenate(windows, axis=0))
+            if x is not None:
+                x.record("host_transfer", time.perf_counter() - t0)
             self._process_window(toks)
             fetched = True
         for _ in range(steps):

@@ -80,6 +80,12 @@ class EngineTelemetry:
         # Point-sampled gauges (latest value wins, like any gauge).
         self.queue_depth = 0
         self.kv_utilization = 0.0
+        # Latest memory accounting from the data-plane observatory
+        # (serving/xprof.py memory_snapshot shape; None until the
+        # observatory samples once). Rides the same digest as
+        # TTFT/TPOT so the autoscaler and /debug/serving see memory
+        # pressure, not just latency.
+        self.memory: dict | None = None
 
     # ---- engine-side hooks ----
 
@@ -87,6 +93,12 @@ class EngineTelemetry:
                       kv_utilization: float) -> None:
         self.queue_depth = queue_depth
         self.kv_utilization = kv_utilization
+
+    def sample_memory(self, mem: dict) -> None:
+        """Latest engine memory accounting (xprof.memory_snapshot
+        payload: kv_cache/weight/workspace/total bytes, kv_headroom,
+        source) — point-sampled like the gauges."""
+        self.memory = mem
 
     def add_tokens(self, n: int) -> None:
         """Decoded-token counter, bumped once per drained window (NOT
@@ -156,6 +168,7 @@ class EngineTelemetry:
         return {
             "queue_depth": self.queue_depth,
             "kv_utilization": self.kv_utilization,
+            "memory": self.memory,
             "requests_completed": completed,
             "tokens_total": tokens,
             "ttft_p50_s": self.quantile("ttft_seconds", 0.5),
@@ -180,7 +193,20 @@ def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
     """
     s = telemetry.snapshot()
     ms = 1000.0
-    return [
+    samples = []
+    if s.get("memory"):
+        mem = s["memory"]
+        # Memory pressure alongside latency: headroom averages (the
+        # scope's usable slack), byte totals sum across replicas.
+        samples += [
+            {"metric": "kv_headroom_frac",
+             "value": float(mem.get("kv_headroom", 0.0)), "agg": "avg"},
+            {"metric": "kv_cache_bytes",
+             "value": float(mem.get("kv_cache_bytes", 0)), "agg": "sum"},
+            {"metric": "hbm_total_bytes",
+             "value": float(mem.get("total_bytes", 0)), "agg": "sum"},
+        ]
+    return samples + [
         {"metric": "queue_depth", "value": float(s["queue_depth"]),
          "agg": "sum"},
         {"metric": "kv_utilization", "value": float(s["kv_utilization"]),

@@ -1,0 +1,690 @@
+"""Data-plane observatory — XLA compile/step/memory telemetry for the
+serving engine (docs/design/data-plane-observability.md).
+
+The control plane has been observable end to end since PR 6 (write
+attribution, deploy milestones, serving SLO digests), but the JAX
+execution layer underneath ``DecodeEngine`` was a black box: a slow
+round could not say whether the framework was slow, the backend was
+degraded, or the backend never existed (the BENCH_r01–r05 blind-zero
+era). This module gives the engine the same depth the store got —
+three instruments, all host-side, NOTHING on the JIT path:
+
+- **CompileTracker** wraps the engine's jitted callables
+  (``compiled_prefill``/``compiled_step``/``compiled_step_block``)
+  and records compile wall time and recompile events into
+  ``grove_compile_seconds{fn}`` / ``grove_recompiles_total{fn,reason}``.
+  Detection rides ``jit.__wrapped__``-free introspection: the jit
+  cache size before/after each dispatch (a grown cache IS a compile),
+  classified as first / shape-change / cache-evict from the argument
+  signature. A recompile burst inside a sliding window raises a
+  recompile-storm warning (the shape-churn failure mode that silently
+  eats serving throughput).
+- **FlightRecorder** is a bounded ring sampling every Nth decode step
+  with host-side ``block_until_ready`` device timings, split into
+  prefill / step / sample / host_transfer phases, feeding the
+  pinned-bucket ``grove_device_step_seconds{phase}`` histograms plus
+  MFU / HBM-utilization estimates from the model's FLOP/byte counts
+  against the chip roofline (on the CPU backend the roofline is the
+  v5e datasheet and the payload stamps the numbers as model-derived
+  estimates, never as measurements).
+- **Memory accounting** reads live ``device.memory_stats()`` where the
+  backend supports it (TPU) and falls back to model-derived byte
+  counts (KV cache array sizes + live weight bytes) otherwise, feeding
+  ``grove_hbm_bytes{kind}`` gauges and a KV-headroom signal the
+  ``EngineTelemetry`` digest pushes alongside TTFT/TPOT.
+
+Surfaces follow the house pattern: ``GET /debug/xprof/<ns>/<name>``
+(server.py), ``Client.debug_xprof`` / ``HttpClient.debug_xprof``
+twins, and ``grovectl engine-profile`` (phase breakdown with the
+hottest phase starred, compile table, memory bar).
+
+``GROVE_XPROF=0`` disables the observatory entirely: the engine's hot
+path is then exactly the pre-observatory shape (no wrappers, no
+sampling branches taken, no syncs). The overhead with it ON is pinned
+<5% of engine tokens/sec by the dual estimator in tests/test_xprof.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import threading
+import time
+import weakref
+
+logger = logging.getLogger("grove.xprof")
+
+# Decode-step phases the flight recorder attributes device time to.
+# "step" is the greedy decode dispatch (single or per-step normalized
+# block), "sample" the key-threaded sampled variant, "host_transfer"
+# the window drain's device→host fetch.
+PHASES = ("prefill", "step", "sample", "host_transfer")
+
+# Recompile-storm window: more than STORM_THRESHOLD non-first compiles
+# inside STORM_WINDOW_S means shapes are churning (a dynamic-shape leak
+# into the serving path) — warn loudly, once per window.
+STORM_WINDOW_S = 60.0
+STORM_THRESHOLD = 3
+
+# Datasheet roofline defaults (v5e, per chip) — the same knobs bench.py
+# honors, so utilization estimates agree across surfaces.
+PEAK_FLOPS = float(os.environ.get("GROVE_PEAK_FLOPS", 197e12))
+PEAK_HBM_BW = float(os.environ.get("GROVE_PEAK_HBM_BW", 819e9))
+
+
+def enabled() -> bool:
+    """The observatory kill switch, read at engine construction (same
+    contract as GROVE_TRACE/GROVE_WRITE_OBS: 0 = the exact pre-feature
+    hot path)."""
+    return os.environ.get("GROVE_XPROF", "1") != "0"
+
+
+# ---- model cost functions (shared with bench.py — one derivation) ----
+
+def decode_flops_per_token(cfg, ctx: int) -> float:
+    """Model FLOPs to decode one token at context length ``ctx``.
+
+    Matmul weights count 2 FLOPs/param (multiply+add); attention adds
+    the logits and value matmuls against the KV cache. Embedding lookup
+    and norms are negligible.
+    """
+    c = cfg
+    w_matmul = (c.n_layers * (c.d_model * c.n_heads * c.head_dim       # wq
+                              + 2 * c.d_model * c.n_kv_heads * c.head_dim
+                              + c.n_heads * c.head_dim * c.d_model     # wo
+                              + 3 * c.d_model * c.d_ff)                # mlp
+                + c.d_model * c.vocab_size)                            # head
+    attn = 4 * ctx * c.n_layers * c.n_heads * c.head_dim
+    return 2.0 * w_matmul + attn
+
+
+def prefill_flops_per_token(cfg, prompt_len: int) -> float:
+    """Model FLOPs per prompt token: weight matmuls plus causal
+    attention at the average context (prompt_len / 2)."""
+    c = cfg
+    w_matmul = (c.n_layers * (c.d_model * c.n_heads * c.head_dim
+                              + 2 * c.d_model * c.n_kv_heads * c.head_dim
+                              + c.n_heads * c.head_dim * c.d_model
+                              + 3 * c.d_model * c.d_ff)
+                + c.d_model * c.vocab_size)
+    attn = 4 * (prompt_len / 2) * c.n_layers * c.n_heads * c.head_dim
+    return 2.0 * w_matmul + attn
+
+
+def decode_hbm_bytes_per_token(cfg, cache_len: int, batch: int,
+                               weight_bytes: float | None = None) -> float:
+    """HBM bytes moved per decoded token: full weight read amortized
+    over the batch, plus this lane's KV cache read and one-entry write.
+    ``cache_len`` is the ALLOCATED cache length — the padded read is
+    what the implementation actually moves, regardless of live context.
+    ``weight_bytes`` overrides the bf16 weight size (int8 quantization
+    halves the read; the roofline must use what actually crosses HBM).
+    """
+    import jax.numpy as jnp
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    kv_read = (2 * cfg.n_layers * cache_len * cfg.n_kv_heads
+               * cfg.head_dim * itemsize)
+    kv_write = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * itemsize
+    weights = cfg.params_bytes if weight_bytes is None else weight_bytes
+    return weights / batch + kv_read + kv_write
+
+
+# ---- compile observability ----
+
+@dataclasses.dataclass
+class CompileEvent:
+    fn: str
+    seconds: float
+    reason: str        # first | shape-change | cache-evict
+    ts: float
+
+
+def _arg_signature(args) -> tuple:
+    """Abstract signature of a call's array leaves: (shape, dtype)
+    tuples — exactly what jit keys its executable cache on. Computed
+    only when a compile was detected (never on the steady path)."""
+    import jax
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        sig.append((tuple(shape) if shape is not None else type(leaf),
+                    str(dtype)))
+    return tuple(sig)
+
+
+class CompileTracker:
+    """Wraps jitted callables and attributes every executable build.
+
+    The wrapper is transparent (same args, same returns, donation
+    semantics untouched — it only *calls*); per dispatch it costs two
+    ``_cache_size()`` reads and two clock reads. When the jit cache
+    grew across a call, that call compiled, and its wall time is
+    recorded as the compile time (dispatch cost is noise next to an
+    XLA build)."""
+
+    EVENT_CAPACITY = 256
+
+    def __init__(self, metrics=None) -> None:
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self._seen_sigs: dict[str, set] = {}
+        self._compiles: dict[str, int] = collections.defaultdict(int)
+        self._recompiles: dict[str, int] = collections.defaultdict(int)
+        self._seconds: dict[str, float] = collections.defaultdict(float)
+        self._last: dict[str, CompileEvent] = {}
+        self.events: collections.deque[CompileEvent] = collections.deque(
+            maxlen=self.EVENT_CAPACITY)
+        # Non-first compile timestamps inside the storm window.
+        self._storm_ring: collections.deque[float] = collections.deque(
+            maxlen=64)
+        self._storm_warned_at = 0.0
+        self.storms = 0
+        # True when the most recent wrapped call built an executable —
+        # the flight recorder drops that dispatch's timing (its wall is
+        # compile time, already recorded in grove_compile_seconds, and
+        # would poison the device-step histogram).
+        self.last_call_compiled = False
+
+    def wrap(self, name: str, jitted):
+        cache_size = getattr(jitted, "_cache_size", None)
+
+        def wrapped(*args, **kwargs):
+            before = cache_size() if cache_size is not None else -1
+            t0 = time.perf_counter()
+            out = jitted(*args, **kwargs)
+            after = cache_size() if cache_size is not None else -1
+            self.last_call_compiled = after != before
+            if after != before or cache_size is None:
+                # cache_size unavailable: fall back to signature-only
+                # detection (a new signature implies a compile).
+                self._on_compile(name, time.perf_counter() - t0,
+                                 _arg_signature((args, kwargs)),
+                                 confirmed=after != before)
+            return out
+
+        wrapped.__name__ = f"xprof_{name}"
+        wrapped.__wrapped__ = jitted
+        return wrapped
+
+    def _on_compile(self, name: str, seconds: float, sig: tuple,
+                    confirmed: bool) -> None:
+        now = time.time()
+        with self._lock:
+            seen = self._seen_sigs.setdefault(name, set())
+            if not confirmed and sig in seen:
+                return  # signature-only mode: steady repeat, no compile
+            if not seen:
+                reason = "first"
+            elif sig in seen:
+                reason = "cache-evict"
+            else:
+                reason = "shape-change"
+            seen.add(sig)
+            self._compiles[name] += 1
+            self._seconds[name] += seconds
+            ev = CompileEvent(name, seconds, reason, now)
+            self._last[name] = ev
+            self.events.append(ev)
+            storm = False
+            if reason != "first":
+                self._recompiles[name] += 1
+                self._storm_ring.append(now)
+                recent = [t for t in self._storm_ring
+                          if now - t <= STORM_WINDOW_S]
+                if (len(recent) > STORM_THRESHOLD
+                        and now - self._storm_warned_at > STORM_WINDOW_S):
+                    self._storm_warned_at = now
+                    self.storms += 1
+                    storm = True
+        if self._metrics is not None:
+            self._metrics.observe("grove_compile_seconds", seconds, fn=name)
+            self._metrics.inc("grove_recompiles_total", fn=name,
+                              reason=reason)
+            if storm:
+                self._metrics.inc("grove_recompile_storms_total")
+        if storm:
+            logger.warning(
+                "recompile storm: >%d recompiles inside %.0fs (last: %s "
+                "%.2fs, %s) — shapes are churning on the serving path",
+                STORM_THRESHOLD, STORM_WINDOW_S, name, seconds, reason)
+
+    def note_external_compile(self, name: str, seconds: float) -> None:
+        """Record a compile observed OUTSIDE a wrapped callable (the
+        engine watches a PrefillWorker's jit cache on the
+        admit_from_queue path). One synthetic signature per name: the
+        first build classifies ``first``, later ones ``cache-evict``
+        (the external watcher cannot see argument shapes)."""
+        self._on_compile(name, seconds, ("external",), confirmed=True)
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._compiles)
+
+    def recompile_count(self) -> int:
+        with self._lock:
+            return sum(self._recompiles.values())
+
+    def total_seconds(self) -> float:
+        with self._lock:
+            return sum(self._seconds.values())
+
+    def payload(self) -> dict:
+        with self._lock:
+            fns = []
+            for name in sorted(self._compiles):
+                last = self._last.get(name)
+                fns.append({
+                    "fn": name,
+                    "compiles": self._compiles[name],
+                    "recompiles": self._recompiles.get(name, 0),
+                    "total_seconds": round(self._seconds[name], 4),
+                    "last_reason": last.reason if last else "",
+                    "last_seconds": round(last.seconds, 4) if last else 0.0,
+                })
+            return {"fns": fns,
+                    "total_seconds": round(sum(self._seconds.values()), 4),
+                    "recompiles": sum(self._recompiles.values()),
+                    "storms": self.storms}
+
+
+# ---- decode-step flight recorder ----
+
+@dataclasses.dataclass
+class StepSample:
+    ts: float
+    phase: str
+    seconds: float     # whole dispatch wall (device time: synced ends)
+    steps: int         # decode steps covered (blocks: K)
+    tokens: int        # tokens the dispatch produced
+
+
+class FlightRecorder:
+    """Bounded ring of sampled device timings (the PR 3 trace-ring
+    shape, scoped to one engine). ``should_sample`` gates every hook:
+    one modulo per step when enabled, nothing at all when the
+    observatory is off."""
+
+    def __init__(self, capacity: int = 1024, sample_every: int = 16,
+                 metrics=None) -> None:
+        self.capacity = capacity
+        self.sample_every = max(1, sample_every)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._ring: collections.deque[StepSample] = collections.deque(
+            maxlen=capacity)
+        self.samples_total = 0
+        self._dispatches = 0
+
+    def should_sample(self) -> bool:
+        """Every Nth DISPATCH (single step or fused K-step block) is
+        sampled — counting dispatches, not steps, keeps the sync cost
+        at 1/N of dispatches regardless of block size (counting steps
+        would sample every block once K >= N)."""
+        self._dispatches += 1
+        return (self._dispatches - 1) % self.sample_every == 0
+
+    def record(self, phase: str, seconds: float, steps: int = 1,
+               tokens: int = 0) -> None:
+        per_step = seconds / max(1, steps)
+        with self._lock:
+            self._ring.append(StepSample(time.time(), phase, seconds,
+                                         steps, tokens))
+            self.samples_total += 1
+        if self._metrics is not None:
+            self._metrics.observe("grove_device_step_seconds", per_step,
+                                  phase=phase)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self) -> list[StepSample]:
+        with self._lock:
+            return list(self._ring)
+
+    def phase_stats(self) -> dict[str, dict]:
+        """Per-phase stats over the ring: count, total device seconds,
+        per-step p50/p95 ms, tokens. Computed at read time — the record
+        path stays append-only."""
+        out: dict[str, dict] = {}
+        for s in self.snapshot():
+            d = out.setdefault(s.phase, {"count": 0, "total_s": 0.0,
+                                         "steps": 0, "tokens": 0,
+                                         "_per_step": []})
+            d["count"] += 1
+            d["total_s"] += s.seconds
+            d["steps"] += s.steps
+            d["tokens"] += s.tokens
+            d["_per_step"].append(s.seconds / max(1, s.steps))
+        for d in out.values():
+            vals = sorted(d.pop("_per_step"))
+            d["total_s"] = round(d["total_s"], 6)
+            d["p50_ms"] = round(vals[len(vals) // 2] * 1e3, 4)
+            d["p95_ms"] = round(
+                vals[min(len(vals) - 1, int(len(vals) * 0.95))] * 1e3, 4)
+        return out
+
+
+# ---- memory accounting ----
+
+def memory_snapshot(engine) -> dict:
+    """Byte accounting for one engine: live ``device.memory_stats()``
+    where the backend supports it (source "device"), model-derived
+    array/weight sizes otherwise (source "model-estimate" — the CPU
+    backend returns no stats, and the payload must say the numbers are
+    derived, not measured)."""
+    from grove_tpu.serving.quant import params_bytes as live_params_bytes
+
+    kv_bytes = int(engine.cache.k.nbytes + engine.cache.v.nbytes)
+    weight_bytes = int(live_params_bytes(engine.params))
+    stats, limit, in_use = None, 0, 0
+    try:
+        dev = next(iter(engine.cache.k.devices()))
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — backends without the API
+        stats = None
+    if stats:
+        in_use = int(stats.get("bytes_in_use", 0))
+        limit = int(stats.get("bytes_limit", 0))
+    source = "device" if stats else "model-estimate"
+    total = in_use if stats else kv_bytes + weight_bytes
+    workspace = max(0, total - kv_bytes - weight_bytes)
+    # KV headroom: how much the KV working set could still grow. With
+    # live stats it is the device's free fraction; model-derived it is
+    # the unused fraction of the allocated cache (lane occupancy).
+    if stats and limit:
+        headroom = max(0.0, 1.0 - total / limit)
+    else:
+        headroom = max(0.0, 1.0 - engine.kv_lane_utilization)
+    return {"kv_cache_bytes": kv_bytes, "weight_bytes": weight_bytes,
+            "workspace_bytes": workspace, "total_bytes": total,
+            "limit_bytes": limit, "source": source,
+            "kv_headroom": round(headroom, 4)}
+
+
+# ---- the observatory ----
+
+class Observatory:
+    """One engine's data-plane instruments, bundled: compile tracker,
+    flight recorder, memory gauges, roofline estimates. Construction
+    is cheap; everything heavy happens only on sampled events."""
+
+    MEMORY_MIN_INTERVAL_S = 0.25
+
+    def __init__(self, cfg=None, batch: int = 1, max_len: int = 0,
+                 capacity: int | None = None,
+                 sample_every: int | None = None,
+                 metrics=None, name: str | None = None,
+                 namespace: str = "default") -> None:
+        if metrics is None:
+            from grove_tpu.runtime.metrics import GLOBAL_METRICS
+            metrics = GLOBAL_METRICS
+        if capacity is None:
+            capacity = int(os.environ.get("GROVE_XPROF_RING", 1024))
+        if sample_every is None:
+            sample_every = int(os.environ.get("GROVE_XPROF_SAMPLE", 16))
+        self.cfg = cfg
+        self.batch = batch
+        self.max_len = max_len
+        self._metrics = metrics
+        self.compile = CompileTracker(metrics=metrics)
+        self.recorder = FlightRecorder(capacity=capacity,
+                                       sample_every=sample_every,
+                                       metrics=metrics)
+        self.namespace = namespace
+        self.name = name or _next_auto_name()
+        self._last_memory: dict | None = None
+        self._last_memory_ts = 0.0
+        self._weight_bytes: int | None = None
+        register(self)
+
+    # -- hooks the engine calls --
+
+    def should_sample(self) -> bool:
+        return self.recorder.should_sample()
+
+    def record(self, phase: str, seconds: float, steps: int = 1,
+               tokens: int = 0) -> None:
+        if self.compile.last_call_compiled and phase != "host_transfer":
+            return  # that wall was an XLA build, not a device step
+        self.recorder.record(phase, seconds, steps=steps, tokens=tokens)
+
+    def observe_memory(self, engine, telemetry=None) -> None:
+        """Refresh the memory gauges from the engine's live state
+        (rate-limited — admission and drain call this opportunistically,
+        and a submit storm must not turn it into a syscall storm)."""
+        now = time.time()
+        if now - self._last_memory_ts < self.MEMORY_MIN_INTERVAL_S:
+            return
+        self._last_memory_ts = now
+        mem = memory_snapshot(engine)
+        self._last_memory = mem
+        self._weight_bytes = mem["weight_bytes"]
+        scope = f"{self.namespace}/{self.name}"
+        for kind, key in (("kv_cache", "kv_cache_bytes"),
+                          ("weights", "weight_bytes"),
+                          ("workspace", "workspace_bytes"),
+                          ("total", "total_bytes")):
+            self._metrics.set("grove_hbm_bytes", float(mem[key]),
+                              kind=kind, scope=scope)
+        # getattr-guarded: tests pass telemetry doubles that only
+        # implement the SLO hooks.
+        push = getattr(telemetry, "sample_memory", None)
+        if push is not None:
+            push(mem)
+
+    # -- derived views --
+
+    def backend(self) -> dict:
+        try:
+            import jax
+            dev = jax.devices()[0]
+            platform, kind = dev.platform, dev.device_kind
+        except Exception:  # noqa: BLE001 — backend init failed
+            platform, kind = "unknown", "unknown"
+        return {"platform": platform, "device_kind": kind,
+                "estimated": platform not in ("tpu", "axon")}
+
+    def throughput_estimate(self, stats: dict | None = None,
+                            ) -> dict | None:
+        """Tokens/sec over the ring's decode samples placed against the
+        roofline. On non-TPU backends the peaks are still the v5e
+        datasheet (comparable across rounds) and the whole block is
+        stamped ``basis: model-estimate``. ``stats`` lets payload()
+        reuse one phase_stats() snapshot instead of re-walking the
+        ring under the recorder lock."""
+        if self.cfg is None:
+            return None
+        if stats is None:
+            stats = self.recorder.phase_stats()
+        decode = [stats[p] for p in ("step", "sample") if p in stats]
+        tokens = sum(d["tokens"] for d in decode)
+        secs = sum(d["total_s"] for d in decode)
+        if not tokens or secs <= 0:
+            return None
+        tps = tokens / secs
+        ctx = max(1, self.max_len // 2)
+        flops_tok = decode_flops_per_token(self.cfg, ctx)
+        bytes_tok = decode_hbm_bytes_per_token(
+            self.cfg, self.max_len or self.cfg.max_seq_len,
+            max(1, self.batch), weight_bytes=self._weight_bytes)
+        backend = self.backend()
+        return {
+            "tokens_per_sec_est": round(tps, 1),
+            "mfu_est": round(tps * flops_tok / PEAK_FLOPS, 6),
+            "hbm_util_est": round(tps * bytes_tok / PEAK_HBM_BW, 6),
+            "basis": ("device-sampled vs v5e datasheet"
+                      if not backend["estimated"]
+                      else "model-estimate (CPU backend; v5e datasheet "
+                           "roofline for cross-round comparability)"),
+            "estimated": backend["estimated"],
+        }
+
+    def payload(self) -> dict:
+        """The /debug/xprof payload (one shape for both client twins;
+        ``render_engine_profile`` and grovectl render it)."""
+        phases = self.recorder.phase_stats()
+        hottest = max(phases, key=lambda p: phases[p]["total_s"]) \
+            if phases else None
+        return {
+            "scope": {"namespace": self.namespace, "name": self.name},
+            "backend": self.backend(),
+            "sample_every": self.recorder.sample_every,
+            "ring": {"len": len(self.recorder),
+                     "capacity": self.recorder.capacity,
+                     "samples_total": self.recorder.samples_total},
+            "phases": phases,
+            "hottest_phase": hottest,
+            "compile": self.compile.payload(),
+            "memory": self._last_memory,
+            "throughput": self.throughput_estimate(phases),
+        }
+
+
+# ---- per-process observatory registry (the debug_xprof surface) ----
+
+_REGISTRY: "collections.OrderedDict[tuple[str, str], weakref.ref]" = \
+    collections.OrderedDict()
+_REGISTRY_CAPACITY = 64
+_registry_lock = threading.Lock()
+_auto_seq = [0]
+
+
+def _next_auto_name() -> str:
+    with _registry_lock:
+        _auto_seq[0] += 1
+        return f"engine-{_auto_seq[0]}"
+
+
+def _zero_scope_gauges(scope: str, metrics) -> None:
+    """Zero a dead/evicted scope's grove_hbm_bytes series: a retired
+    engine's bytes must read 0, not linger at their last value (the
+    set_gauge_family / kube-state-metrics convention; the hub keeps
+    the zeroed series in the rendering, which is the standard
+    Prometheus staleness shape)."""
+    for kind in ("kv_cache", "weights", "workspace", "total"):
+        metrics.set("grove_hbm_bytes", 0.0, kind=kind, scope=scope)
+
+
+def register(obs: Observatory, name: str | None = None,
+             namespace: str | None = None) -> None:
+    """(Re)register an observatory under a scope. Engines auto-register
+    as default/engine-N at construction; serving wrappers re-register
+    under the scope name the control plane knows (the PCSG), so
+    ``grovectl engine-profile <name>`` finds it. Weakly held and
+    LRU-capped: a dead engine's entry evicts and its gauge series
+    zero, never lingering at stale byte values."""
+    if name is not None and name != obs.name and obs._last_memory:
+        # Re-registration under a new scope: the gauges written under
+        # the old scope would otherwise read stale forever.
+        _zero_scope_gauges(f"{obs.namespace}/{obs.name}", obs._metrics)
+    if name is not None:
+        obs.name = name
+    if namespace is not None:
+        obs.namespace = namespace
+    key = (obs.namespace, obs.name)
+    # Zero this scope's gauges when the observatory is collected (the
+    # finalizer must not hold obs — capture only the scope string).
+    weakref.finalize(obs, _zero_scope_gauges,
+                     f"{obs.namespace}/{obs.name}", obs._metrics)
+    with _registry_lock:
+        _REGISTRY.pop(key, None)
+        _REGISTRY[key] = weakref.ref(obs)
+        while len(_REGISTRY) > _REGISTRY_CAPACITY:
+            _REGISTRY.popitem(last=False)
+
+
+def observatory_for(name: str, namespace: str = "default",
+                    ) -> Observatory | None:
+    with _registry_lock:
+        ref = _REGISTRY.get((namespace, name))
+        obs = ref() if ref is not None else None
+        if ref is not None and obs is None:
+            del _REGISTRY[(namespace, name)]
+        return obs
+
+
+def scopes() -> list[tuple[str, str]]:
+    with _registry_lock:
+        return [k for k, ref in _REGISTRY.items() if ref() is not None]
+
+
+# ---- rendering (grovectl engine-profile) ----
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def render_engine_profile(payload: dict) -> list[str]:
+    """Human rendering of one observatory payload: phase breakdown
+    (hottest phase starred), compile table, memory bar."""
+    out: list[str] = []
+    scope = payload.get("scope") or {}
+    backend = payload.get("backend") or {}
+    out.append(f"engine:    {scope.get('namespace', '?')}/"
+               f"{scope.get('name', '?')}")
+    est = " (estimates are model-derived)" if backend.get("estimated") \
+        else ""
+    out.append(f"backend:   {backend.get('platform', '?')}:"
+               f"{backend.get('device_kind', '?')}{est}")
+    ring = payload.get("ring") or {}
+    out.append(f"sampling:  every {payload.get('sample_every', '?')} "
+               f"steps, ring {ring.get('len', 0)}/"
+               f"{ring.get('capacity', 0)} "
+               f"({ring.get('samples_total', 0)} samples total)")
+    phases = payload.get("phases") or {}
+    if phases:
+        out.append("")
+        out.append(f"  {'phase':<15}{'samples':>8}{'p50 ms':>10}"
+                   f"{'p95 ms':>10}{'total s':>10}  ")
+        hottest = payload.get("hottest_phase")
+        for name in sorted(phases, key=lambda p: -phases[p]["total_s"]):
+            d = phases[name]
+            star = " *" if name == hottest else ""
+            out.append(f"  {name:<15}{d['count']:>8}{d['p50_ms']:>10.3f}"
+                       f"{d['p95_ms']:>10.3f}{d['total_s']:>10.3f}{star}")
+    else:
+        out.append("  (no device-time samples yet)")
+    comp = payload.get("compile") or {}
+    if comp.get("fns"):
+        out.append("")
+        out.append(f"  {'compiled fn':<22}{'compiles':>9}{'recompiles':>11}"
+                   f"{'total s':>9}  last")
+        for f in comp["fns"]:
+            out.append(f"  {f['fn']:<22}{f['compiles']:>9}"
+                       f"{f['recompiles']:>11}{f['total_seconds']:>9.2f}"
+                       f"  {f['last_reason']} ({f['last_seconds']:.2f}s)")
+        if comp.get("storms"):
+            out.append(f"  RECOMPILE STORMS: {comp['storms']} — shapes "
+                       "are churning on the serving path")
+    mem = payload.get("memory")
+    if mem:
+        out.append("")
+        out.append(f"memory ({mem['source']}):")
+        total = max(1, mem["total_bytes"])
+        for kind, key in (("kv_cache", "kv_cache_bytes"),
+                          ("weights", "weight_bytes"),
+                          ("workspace", "workspace_bytes")):
+            b = mem[key]
+            bar = "#" * min(40, int(40 * b / total))
+            out.append(f"  {kind:<11}{_fmt_bytes(b):>12}  {bar}")
+        limit = (f" / limit {_fmt_bytes(mem['limit_bytes'])}"
+                 if mem.get("limit_bytes") else "")
+        out.append(f"  {'total':<11}{_fmt_bytes(mem['total_bytes']):>12}"
+                   f"{limit}  kv_headroom {mem['kv_headroom']:.2f}")
+    thr = payload.get("throughput")
+    if thr:
+        out.append("")
+        tag = " [estimate]" if thr.get("estimated") else ""
+        out.append(f"throughput: {thr['tokens_per_sec_est']:.1f} tok/s, "
+                   f"MFU {thr['mfu_est'] * 100:.2f}%, "
+                   f"HBM {thr['hbm_util_est'] * 100:.1f}%{tag}")
+        out.append(f"  basis: {thr['basis']}")
+    return out

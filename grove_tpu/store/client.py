@@ -180,6 +180,26 @@ class Client:
                 "(no started Manager owns it)")
         return ls.payload(self._store)
 
+    def debug_xprof(self, name: str, namespace: str = "default") -> dict:
+        """One engine's data-plane observatory payload (compile table,
+        phase breakdown, memory accounting, roofline estimates) — the
+        in-process twin of ``GET /debug/xprof/<ns>/<name>`` (same
+        payload shape; grovectl engine-profile renders either). Raises
+        NotFoundError when no observatory is registered under the
+        scope in this process (engine not running here, or
+        GROVE_XPROF=0)."""
+        from grove_tpu.runtime.errors import NotFoundError
+        from grove_tpu.serving import xprof
+        obs = xprof.observatory_for(name, namespace)
+        if obs is None:
+            known = ", ".join(f"{ns}/{n}" for ns, n in xprof.scopes()) \
+                or "none"
+            raise NotFoundError(
+                f"no xprof observatory registered for {namespace}/{name} "
+                f"in this process (GROVE_XPROF=0, or the engine runs "
+                f"elsewhere; registered: {known})")
+        return obs.payload()
+
     def debug_serving(self, name: str, namespace: str = "default") -> dict:
         """One serving scope's SLO state — the in-process twin of
         ``GET /debug/serving/<ns>/<name>`` (same payload shape;

@@ -300,6 +300,13 @@ class HttpClient:
         return self._request(
             "GET", f"/debug/serving/{quote(namespace)}/{quote(name)}")
 
+    def debug_xprof(self, name: str, namespace: str = "default") -> dict:
+        """One engine's data-plane observatory payload from
+        ``GET /debug/xprof/<ns>/<name>`` (the wire twin of
+        ``Client.debug_xprof``; 404 maps to NotFoundError)."""
+        return self._request(
+            "GET", f"/debug/xprof/{quote(namespace)}/{quote(name)}")
+
     def debug_defrag(self) -> dict:
         """The defrag plan ledger from ``GET /debug/defrag`` (the wire
         twin of ``Client.debug_defrag``; 404 maps to NotFoundError)."""

@@ -115,7 +115,11 @@ def test_engine_stamps_lifecycle_end_to_end():
             eng.step()
     assert len(eng.completed) == len(rids)
     for req in eng.completed:
-        assert req.enqueue_ts <= req.admit_ts == req.first_token_ts \
+        # admit is queue-exit (pre-prefill), first-token is prefill
+        # completion — split stamps since the data-plane observatory
+        # (GROVE_TTFT_COMPAT=1 restores the old fused derivation;
+        # test_ttft_stamp_split_and_compat covers both).
+        assert req.enqueue_ts <= req.admit_ts <= req.first_token_ts \
             <= req.done_ts
     for name in HISTOGRAMS:
         assert tel.hist_count(name) == len(rids), name
@@ -125,6 +129,43 @@ def test_engine_stamps_lifecycle_end_to_end():
     assert s["requests_completed"] == len(rids)
     # Lanes drained: the utilization gauge saw both busy and idle.
     assert eng.kv_lane_utilization == 0.0
+
+
+def test_ttft_stamp_split_and_compat(monkeypatch):
+    """The admit/first-token split (data-plane observatory satellite):
+    by default admit_ts is queue-exit and first_token_ts is prefill
+    completion, so queue-wait no longer swallows prefill device time;
+    GROVE_TTFT_COMPAT=1 restores the historical fused stamp exactly.
+    Both modes regression-tested, per the PR contract."""
+    from tools.loadgen import build_tiny_engine
+
+    def drive(compat: bool):
+        monkeypatch.setenv("GROVE_TTFT_COMPAT", "1" if compat else "0")
+        eng, pw = build_tiny_engine(batch=2)
+        rng = np.random.default_rng(3)
+        eng.submit(rng.integers(0, 256, size=8), max_new_tokens=4)
+        for _ in range(50):
+            eng.admit_from_queue(pw)
+            if eng.completed:
+                break
+            if np.count_nonzero(eng._active):
+                eng.step()
+        assert eng.completed
+        return eng.completed[0]
+
+    req = drive(compat=False)
+    assert req.enqueue_ts <= req.admit_ts < req.first_token_ts, \
+        (req.admit_ts, req.first_token_ts)  # prefill takes real time
+
+    old = drive(compat=True)
+    assert old.admit_ts == old.first_token_ts  # the fused derivation
+
+    # The split lands in the histograms: queue-wait (enqueue->admit)
+    # excludes prefill, TTFT (enqueue->first) still includes it.
+    tel = EngineTelemetry()
+    tel.observe_request(req)
+    assert tel.quantile("ttft_seconds", 0.5) > \
+        tel.quantile("queue_wait_seconds", 0.5)
 
 
 def test_engine_telemetry_overhead_under_pin():

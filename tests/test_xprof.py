@@ -1,0 +1,273 @@
+"""Data-plane observatory (serving/xprof.py): flight-recorder ring
+bounding and sampling cadence, recompile detection on a forced shape
+change, CPU-backend memory-estimate fallback, the GROVE_XPROF=0
+byte-identical hot path, debug surface twins, and the PR 6-style
+dual-estimator pin holding observatory overhead <5% of engine
+tokens/sec."""
+
+import dataclasses
+import gc
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import llama
+from grove_tpu.ops.kvcache import KVCache
+from grove_tpu.serving import xprof
+from grove_tpu.serving.engine import DecodeEngine
+
+CFG = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                          max_seq_len=64)
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _prompts(b=2, s=8, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0,
+                              CFG.vocab_size)
+
+
+# ---- flight recorder ----
+
+def test_ring_bounded():
+    rec = xprof.FlightRecorder(capacity=8, sample_every=1)
+    for i in range(50):
+        rec.record("step", 0.001 * (i + 1), tokens=2)
+    assert len(rec) == 8                  # bounded: old samples evict
+    assert rec.samples_total == 50        # the odometer keeps counting
+    stats = rec.phase_stats()
+    assert stats["step"]["count"] == 8
+
+
+def test_sampling_cadence_counts_dispatches():
+    rec = xprof.FlightRecorder(sample_every=4)
+    fired = [rec.should_sample() for _ in range(12)]
+    assert fired == [True, False, False, False] * 3
+
+
+def test_engine_samples_every_nth_step():
+    obs = xprof.Observatory(sample_every=4, name="cadence-test")
+    eng = DecodeEngine(CFG, _params(), batch=2, xprof=obs)
+    eng.admit_prompts(_prompts())
+    for _ in range(16):
+        eng.step()
+    eng.sync()
+    stats = obs.recorder.phase_stats()
+    # Dispatches 0,4,8,12 sampled; dispatch 0 carried the step compile
+    # and is dropped (its wall is an XLA build, not a device step).
+    assert stats["step"]["count"] == 3, stats
+    # Sampled steps carry per-step timings in the ms-or-less band, not
+    # the compile's hundreds of ms.
+    assert stats["step"]["p95_ms"] < 200.0, stats
+
+
+# ---- compile tracking ----
+
+def test_compile_tracker_classifies_reasons():
+    tracker = xprof.CompileTracker()
+    f = tracker.wrap("f", jax.jit(lambda x: x * 2))
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))                     # warm: no event
+    f(jnp.ones((3,)))                     # new signature: recompile
+    assert tracker.counts() == {"f": 2}
+    assert tracker.recompile_count() == 1
+    assert [e.reason for e in tracker.events] == ["first", "shape-change"]
+    payload = tracker.payload()
+    assert payload["fns"][0]["last_reason"] == "shape-change"
+
+
+def test_engine_recompile_detected_on_forced_shape_change():
+    """Driving the engine's compiled step with a different batch is
+    exactly the silent-recompile hazard the tracker exists to catch."""
+    eng = DecodeEngine(CFG, _params(), batch=2)
+    if eng.xprof is None:
+        pytest.skip("GROVE_XPROF=0 in this environment")
+    step = eng.compiled_step()
+    cache2 = KVCache.create(CFG.n_layers, 2, 32, CFG.n_kv_heads,
+                            CFG.head_dim, jnp.float32)
+    toks2 = jnp.zeros((2,), jnp.int32)
+    step(eng.params, toks2, cache2)
+    assert eng.xprof.compile.counts()["step"] == 1
+    cache4 = KVCache.create(CFG.n_layers, 4, 32, CFG.n_kv_heads,
+                            CFG.head_dim, jnp.float32)
+    toks4 = jnp.zeros((4,), jnp.int32)
+    step(eng.params, toks4, cache4)       # batch change → new executable
+    assert eng.xprof.compile.counts()["step"] == 2
+    assert eng.xprof.compile.recompile_count() == 1
+    fns = {f["fn"]: f for f in eng.xprof.compile.payload()["fns"]}
+    assert fns["step"]["last_reason"] == "shape-change"
+
+
+def test_recompile_storm_warning():
+    tracker = xprof.CompileTracker()
+    f = tracker.wrap("f", jax.jit(lambda x: x + 1))
+    for n in range(2, 2 + xprof.STORM_THRESHOLD + 3):
+        f(jnp.ones((n,)))                 # every call a fresh shape
+    assert tracker.recompile_count() >= xprof.STORM_THRESHOLD + 1
+    assert tracker.storms == 1            # warned once per window
+
+
+# ---- memory accounting ----
+
+def test_cpu_backend_memory_estimate_fallback():
+    """The CPU backend has no memory_stats(): the accounting must fall
+    back to model-derived byte counts and SAY so, never report zeros
+    or pretend the estimate was measured."""
+    eng = DecodeEngine(CFG, _params(), batch=2)
+    if eng.xprof is None:
+        pytest.skip("GROVE_XPROF=0 in this environment")
+    eng.admit_prompts(_prompts(), max_new_tokens=4)  # _report_metric fires
+    mem = eng.xprof._last_memory
+    assert mem is not None
+    assert mem["source"] == "model-estimate"
+    assert mem["kv_cache_bytes"] == int(eng.cache.k.nbytes
+                                        + eng.cache.v.nbytes)
+    assert mem["weight_bytes"] > 0
+    assert 0.0 <= mem["kv_headroom"] <= 1.0
+    # The gauges rendered with kind labels in the hub text.
+    from grove_tpu.runtime import metrics as m
+    hbm = m.parse_counters(m.GLOBAL_METRICS.render(), "grove_hbm_bytes")
+    scope = f"default/{eng.xprof.name}"
+    assert any(dict(lbl) == {"kind": "kv_cache", "scope": scope}
+               for lbl in hbm)
+
+
+def test_memory_rides_the_telemetry_digest():
+    from grove_tpu.serving.slo import EngineTelemetry, samples_for_push
+    tel = EngineTelemetry()
+    eng = DecodeEngine(CFG, _params(), batch=2, telemetry=tel)
+    if eng.xprof is None:
+        pytest.skip("GROVE_XPROF=0 in this environment")
+    eng.admit_prompts(_prompts(), max_new_tokens=4)
+    assert tel.snapshot()["memory"] is not None
+    names = {s["metric"] for s in samples_for_push(tel)}
+    assert {"kv_headroom_frac", "kv_cache_bytes",
+            "hbm_total_bytes"} <= names
+
+
+# ---- the escape hatch ----
+
+def test_xprof_disabled_restores_pre_observatory_hot_path(monkeypatch):
+    """GROVE_XPROF=0: no observatory, no wrappers (the compiled
+    callables are the raw jits), and token-for-token identical decode
+    against an instrumented twin."""
+    params = _params()
+    prompts = _prompts()
+
+    monkeypatch.setenv("GROVE_XPROF", "0")
+    off = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    assert off.xprof is None
+    # The compiled callables are the raw jits (PjitFunction), not the
+    # tracker's xprof_* wrappers.
+    assert not getattr(off._step, "__name__", "").startswith("xprof_")
+    assert not getattr(off._prefill, "__name__", "").startswith("xprof_")
+
+    monkeypatch.setenv("GROVE_XPROF", "1")
+    on = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+    assert on.xprof is not None
+    assert on._step.__name__ == "xprof_step"
+    assert on._prefill.__name__ == "xprof_prefill"
+
+    for eng in (off, on):
+        eng.admit_prompts(prompts, max_new_tokens=12)
+        eng.run(14)
+    assert len(off.completed) == len(on.completed) == 2
+    for a, b in zip(sorted(off.completed, key=lambda r: r.rid),
+                    sorted(on.completed, key=lambda r: r.rid)):
+        assert a.generated == b.generated
+    np.testing.assert_array_equal(np.asarray(off._tokens),
+                                  np.asarray(on._tokens))
+
+
+# ---- overhead pin (PR 6-style dual estimator) ----
+
+def _decode_wall(eng, prompts, steps=48, rounds=3) -> float:
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        eng.admit_prompts(prompts, max_new_tokens=steps)
+        for _ in range(steps):
+            eng.step()
+    eng.sync()
+    return time.perf_counter() - t0
+
+
+def test_observatory_overhead_under_pin(monkeypatch):
+    """<5% of engine tokens/sec with GROVE_XPROF=1 — the observatory's
+    headline promise. Interleaved windows over the same engine pair,
+    dual estimator (min AND median must both exceed the bar to count
+    as a regression), one escalation pass — the PR 6 write-obs /
+    serving-telemetry precedent for timing pins on a CPU-share-
+    throttled box."""
+    params = _params()
+    prompts = _prompts()
+    engines = {}
+    for on in (False, True):
+        monkeypatch.setenv("GROVE_XPROF", "1" if on else "0")
+        eng = DecodeEngine(CFG, params, batch=2, host_sync_interval=4)
+        _decode_wall(eng, prompts)        # compile + warm, untimed
+        engines[on] = eng
+
+    def measure(reps: int) -> tuple[float, float]:
+        walls = {False: [], True: []}
+        for rep in range(reps):
+            order = (False, True) if rep % 2 == 0 else (True, False)
+            for on in order:
+                walls[on].append(_decode_wall(engines[on], prompts))
+        return (min(walls[True]) / min(walls[False]),
+                statistics.median(walls[True])
+                / statistics.median(walls[False]))
+
+    bar = 1.05
+    min_r, med_r = measure(4)
+    if min_r > bar and med_r > bar:
+        min_r, med_r = measure(8)         # escalation: re-judge calmly
+    assert min_r <= bar or med_r <= bar, (
+        f"observatory costs {100 * (min_r - 1):.1f}% best-case / "
+        f"{100 * (med_r - 1):.1f}% median tokens/sec — something "
+        "landed on the hot path")
+
+
+# ---- surfaces ----
+
+def test_debug_xprof_client_twin_and_registry():
+    from grove_tpu.runtime.errors import NotFoundError
+    from grove_tpu.store.client import Client
+    from grove_tpu.store.store import Store
+
+    eng = DecodeEngine(CFG, _params(), batch=2)
+    if eng.xprof is None:
+        pytest.skip("GROVE_XPROF=0 in this environment")
+    xprof.register(eng.xprof, "twin-test")
+    eng.admit_prompts(_prompts(), max_new_tokens=4)
+    eng.run(8)
+
+    client = Client(Store())
+    payload = client.debug_xprof("twin-test")
+    assert payload["scope"] == {"namespace": "default",
+                                "name": "twin-test"}
+    assert payload["compile"]["fns"]
+    with pytest.raises(NotFoundError):
+        client.debug_xprof("no-such-engine")
+
+    lines = xprof.render_engine_profile(payload)
+    assert any(ln.strip().endswith("*") for ln in lines), lines
+    assert any("compiled fn" in ln for ln in lines)
+
+    # The registry holds engines weakly: a dead engine's scope clears
+    # instead of leaking a 64-entry LRU of corpses — and its gauge
+    # series zero instead of lingering at stale byte values.
+    name = eng.xprof.name
+    del eng, payload
+    gc.collect()
+    assert xprof.observatory_for("twin-test") is None, name
+    from grove_tpu.runtime import metrics as m
+    hbm = m.parse_counters(m.GLOBAL_METRICS.render(), "grove_hbm_bytes")
+    dead = {lbl: v for lbl, v in hbm.items()
+            if dict(lbl).get("scope") == "default/twin-test"}
+    assert dead and all(v == 0.0 for v in dead.values()), dead

@@ -61,8 +61,13 @@ def render(rows: list[dict]) -> str:
                                         "failover_resume_cold_s")]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
                 "serving-cpu", "chaos-cpu", "defrag-cpu"}
+    # Control-plane rows without a mode stamp (the failover/leader-kill
+    # seconds rows) must not masquerade as tok/s in the serving table.
+    cp_metrics = {"failover_resume_warm_s", "failover_resume_cold_s",
+                  "chaos_leader_kill_resume_s"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
-              and r.get("mode") not in cp_modes]
+              and r.get("mode") not in cp_modes
+              and r.get("metric") not in cp_metrics]
     failed = [r for r in rows if r.get("value", 0) <= 0]
     disagg = [r for r in ok_all if r.get("mode") == "disagg"]
     ok = [r for r in ok_all if r.get("mode") != "disagg"]
@@ -246,14 +251,21 @@ def render(rows: list[dict]) -> str:
         out.append("")
     if ok:
         out += ["## Successful runs", "",
-                "| when | git | model | batch | quant | tok/s/chip | "
-                "vs bare JAX | vs engine loop | HBM util | prefill tok/s |",
-                "|---|---|---|---|---|---|---|---|---|---|"]
+                "_backend-mode semantics (docs/design/"
+                "data-plane-observability.md): tpu-ok = relay healthy, "
+                "tpu-degraded = probe above the latency threshold, "
+                "cpu-fallback = relay down, REAL run on the CPU mesh "
+                "with vs_baseline measured on the same backend_", "",
+                "| when | git | model | batch | quant | backend | "
+                "tok/s/chip | vs bare JAX | vs engine loop | HBM util | "
+                "prefill tok/s |",
+                "|---|---|---|---|---|---|---|---|---|---|---|"]
         for r in sorted(ok, key=lambda r: r.get("ts", "")):
             out.append(
                 f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
                 f"| {r.get('metric', '?').split('_')[0]} "
                 f"| {r.get('batch', '?')} | {r.get('quant', '?')} "
+                f"| {r.get('backend_mode', '-')} "
                 f"| {r.get('value', 0):.1f} "
                 f"| {r.get('vs_baseline', 0):.3f} "
                 f"| {r.get('vs_engine_bare', r.get('vs_baseline', 0)):.3f} "
@@ -283,11 +295,45 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('prefill_tok_s', 0):.0f} "
                 f"| {r.get('prefill_chunked_tok_s', 0):.0f} |")
         out.append("")
+    observatory = [r for r in rows
+                   if r.get("device_step_ms_p50") is not None
+                   or r.get("compile_seconds") is not None]
+    if observatory:
+        out += ["## Data-plane observatory (device time & compiles)", "",
+                "_per-step device-time p50 and XLA compile evidence "
+                "from the serving engine's flight recorder / "
+                "CompileTracker (serving/xprof.py) — stamped on bench "
+                "rows and the bench-serving device-time row; recompiles "
+                "> 0 means shapes churned on the serving path_", "",
+                "| when | git | metric | backend | device step p50 ms | "
+                "prefill p50 ms | compile s | lowerings | recompiles |",
+                "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(observatory, key=lambda r: r.get("ts", "")):
+            phases = r.get("phases") or {}
+            pf = (phases.get("prefill") or {}).get("p50_ms")
+            d = r.get("device_step_ms_p50")
+            comp = r.get("compile_seconds")
+            lowerings = sum((r.get("compiles") or {}).values())
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('metric', '?')} "
+                f"| {r.get('backend_mode', '-')} "
+                f"| {f'{d:.3f}' if d is not None else '-'} "
+                f"| {f'{pf:.3f}' if pf is not None else '-'} "
+                f"| {f'{comp:.2f}' if comp is not None else '-'} "
+                f"| {lowerings or '-'} "
+                f"| {r.get('recompiles', '-')} |")
+        out.append("")
     if failed:
         out += ["## Failure timeline (relay outages)", "",
-                "| when | git | error |", "|---|---|---|"]
+                "_every error row carries the backend classification "
+                "and probe outcome since the data-plane observatory — "
+                "a 0.0 with no evidence is impossible by construction_",
+                "",
+                "| when | git | backend | error |", "|---|---|---|---|"]
         for r in sorted(failed, key=lambda r: r.get("ts", "")):
             out.append(f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                       f"| {r.get('backend_mode', '-')} "
                        f"| {r.get('error', '?')} |")
         out.append("")
     return "\n".join(out)

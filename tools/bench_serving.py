@@ -102,6 +102,47 @@ def bench_overhead(reps: int = 6, steps: int = 48, rounds: int = 4) -> dict:
             "within_bound": min_r <= OVERHEAD_BAR or med_r <= OVERHEAD_BAR}
 
 
+def bench_device_time(steps: int = 48, rounds: int = 3) -> dict:
+    """Device-time/backend evidence row from the data-plane observatory
+    (serving/xprof.py): drive the tiny engine through warm admit→decode
+    cycles and report the flight recorder's per-step phase p50s, the
+    compile table, and the backend classification — the bench-history
+    row `make bench-serving` appends so the dashboard's observatory
+    section always has a CPU-measured point to anchor on."""
+    import jax
+
+    os.environ.setdefault("GROVE_XPROF", "1")
+    eng, _pw = build_tiny_engine(batch=2)
+    assert eng.xprof is not None, "observatory disabled (GROVE_XPROF=0)"
+    prompts = jax.numpy.asarray(
+        np.random.default_rng(1).integers(0, 256, size=(2, 8)))
+    eng.xprof.recorder.sample_every = 2   # short run: sample densely
+    for _ in range(rounds + 1):           # first round pays the compiles
+        eng.admit_prompts(prompts, max_new_tokens=steps)
+        eng.run(steps)
+    p = eng.xprof.payload()
+    phases = p["phases"]
+    step = phases.get("step") or {}
+    comp = p["compile"]
+    thr = p["throughput"] or {}
+    platform = p["backend"]["platform"]
+    return {
+        "metric": "engine_device_step_ms_p50",
+        "value": step.get("p50_ms", 0.0),
+        "unit": "ms",
+        "mode": "serving-cpu",
+        "backend_mode": platform,
+        "device_step_ms_p50": step.get("p50_ms"),
+        "phases": {name: {k: d[k] for k in ("count", "p50_ms", "p95_ms")}
+                   for name, d in phases.items()},
+        "compile_seconds": comp["total_seconds"],
+        "compiles": {f["fn"]: f["compiles"] for f in comp["fns"]},
+        "recompiles": comp["recompiles"],
+        "tokens_per_sec_est": thr.get("tokens_per_sec_est"),
+        "estimated": thr.get("estimated", True),
+    }
+
+
 def bench_ramp(duration: float, base_rate: float | None,
                seed: int = 0) -> dict:
     """The closed loop: ramped load → TTFT breach → scale-out."""
@@ -277,6 +318,19 @@ def main(argv=None) -> int:
                     "mode": "serving-cpu", **{k: over[k] for k in
                     ("overhead_min_ratio", "overhead_median_ratio",
                      "within_bound")}})
+
+    dev = bench_device_time()
+    print(f"device time ({dev['backend_mode']}): step p50 "
+          f"{dev['value']:.3f} ms, "
+          f"{sum(dev['compiles'].values())} lowerings in "
+          f"{dev['compile_seconds']:.2f}s, "
+          f"{dev['recompiles']} recompiles", flush=True)
+    append_history(dev)
+    if dev["recompiles"]:
+        print("FAIL: the fixed-shape device-time bench recompiled — "
+              "shapes are churning on the serving path",
+              file=sys.stderr)
+        return 1
 
     row = bench_ramp(args.duration, args.base_rate, seed=args.seed)
     print(f"ramp: {row['base_rate']:.1f} -> {row['peak_rate']:.1f} req/s "

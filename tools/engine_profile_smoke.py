@@ -1,0 +1,140 @@
+"""Engine-profile smoke: tiny CPU engine → flight recorder populated →
+compile tracker shows exactly the expected lowerings → /debug/xprof
+renders over HTTP → ``grovectl engine-profile`` exits 0 — the
+data-plane observatory's CI gate (wired into ``make ci``, the
+serving_smoke/deploy_smoke sibling; docs/design/
+data-plane-observability.md).
+
+Drives the real tiny-config CPU engine through every dispatch shape
+(in-engine prefill, single steps, fused block), then asserts at each
+hop of the observability chain:
+
+- the decode-step flight recorder sampled real device timings into its
+  bounded ring, with the prefill/step/host_transfer phase split,
+- the CompileTracker saw EXACTLY the expected lowerings — one prefill,
+  one step, one step_block — and zero recompiles (a silent recompile
+  here means shapes are churning on the serving path),
+- memory accounting fell back to model-derived estimates on the CPU
+  backend and says so (``source: model-estimate``),
+- ``grove_compile_seconds`` / ``grove_device_step_seconds`` /
+  ``grove_hbm_bytes`` rendered in the control plane's /metrics text,
+- ``GET /debug/xprof/<ns>/<name>`` serves the payload over the wire,
+- ``grovectl engine-profile`` renders it and exits 0.
+
+    python tools/engine_profile_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="engine-profile-smoke")
+    parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["GROVE_XPROF"] = "1"          # the subject of this smoke
+    os.environ["GROVE_XPROF_SAMPLE"] = "2"   # tiny run: sample densely
+
+    import jax
+    import numpy as np
+
+    from loadgen import build_tiny_engine
+
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.runtime import metrics as m
+    from grove_tpu.server import ApiServer
+    from grove_tpu.serving import xprof
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    # ---- engine side: every dispatch shape, instrumented ----
+    eng, pw = build_tiny_engine(batch=2)
+    assert eng.xprof is not None, "GROVE_XPROF=1 but no observatory"
+    xprof.register(eng.xprof, "smoke-engine")
+
+    prompts = jax.numpy.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(2, 8)))
+    eng.admit_prompts(prompts, max_new_tokens=24)   # prefill lowering
+    for _ in range(8):
+        eng.step()                                  # step lowering
+    eng.run(16)                                     # step_block lowering
+    eng.sync()
+    # Second admission cycle: the first prefill dispatch was the
+    # lowering itself (the recorder rightly drops compile-bearing
+    # dispatches), so the WARM prefill is what lands in the ring.
+    eng.admit_prompts(prompts, max_new_tokens=24)
+    eng.run(24)
+
+    obs = eng.xprof
+    assert len(obs.recorder) > 0, "flight recorder ring is empty"
+    phases = obs.recorder.phase_stats()
+    for want in ("prefill", "step", "host_transfer"):
+        assert want in phases and phases[want]["count"] > 0, \
+            (want, phases)
+
+    # Exactly the expected lowerings, nothing twice: the engine's three
+    # dispatch shapes each compiled once, and NOTHING recompiled — a
+    # recompile in this fixed-shape run would be a silent shape leak.
+    counts = obs.compile.counts()
+    assert counts == {"prefill": 1, "step": 1, "step_block": 1}, counts
+    assert obs.compile.recompile_count() == 0, obs.compile.payload()
+    assert obs.compile.storms == 0
+
+    payload = obs.payload()
+    assert payload["scope"]["name"] == "smoke-engine"
+    mem = payload["memory"]
+    assert mem is not None and mem["source"] == "model-estimate", mem
+    assert mem["kv_cache_bytes"] > 0 and mem["weight_bytes"] > 0
+    assert payload["throughput"] is not None \
+        and payload["throughput"]["estimated"], payload["throughput"]
+
+    # ---- metrics text: the new families rendered and populated ----
+    text = m.GLOBAL_METRICS.render()
+    comp = m.parse_histograms(text, "grove_compile_seconds")
+    assert comp, "grove_compile_seconds missing from /metrics"
+    dev = m.parse_histograms(text, "grove_device_step_seconds")
+    assert any(dict(lbl).get("phase") == "step" for lbl in dev), dev
+    hbm = m.parse_counters(text, "grove_hbm_bytes")
+    assert any(dict(lbl).get("kind") == "kv_cache" and v > 0
+               for lbl, v in hbm.items()), hbm
+
+    # ---- wire surface + CLI ----
+    cluster = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cluster:
+        server = ApiServer(cluster, port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            from grove_tpu.cli import _http, main as cli_main
+            status, data = _http(base, "/debug/xprof/default/smoke-engine")
+            assert status == 200, (status, data)
+            assert data["scope"]["name"] == "smoke-engine"
+            assert data["compile"]["fns"], data["compile"]
+            status, data = _http(base, "/debug/xprof/default/nosuch")
+            assert status == 404, (status, data)
+
+            rc = cli_main(["engine-profile", "smoke-engine",
+                           "--server", base])
+            assert rc == 0, f"grovectl engine-profile exited {rc}"
+        finally:
+            server.stop()
+
+    from grove_tpu.serving.xprof import render_engine_profile
+    lines = render_engine_profile(payload)
+    assert any("*" in ln for ln in lines), "hottest phase not starred"
+    print("\n".join(lines))
+    print(f"engine-profile smoke OK: {len(obs.recorder)} ring samples, "
+          f"{sum(counts.values())} lowerings "
+          f"({payload['compile']['total_seconds']:.2f}s compile), "
+          "0 recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
