@@ -4,6 +4,14 @@ PY ?= python
 
 .PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-failover docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
+lint:            ## the semantic gate: compile check + grovelint (AST
+	@# invariant rules, docs/design/static-analysis.md) + one
+	@# lock-order-witness smoke (GROVE_LOCKDEP=1 deploy cycle,
+	@# zero acquisition-graph cycles, zero hub-under-store-lock).
+	$(PY) -m compileall -q grove_tpu tests tools bench.py __graft_entry__.py
+	$(PY) -m grove_tpu.analysis grove_tpu tests tools bench.py
+	$(PY) tools/lockdep_smoke.py
+
 test:            ## full suite on the virtual CPU mesh
 	$(PY) -m pytest tests/ -q
 
@@ -30,6 +38,10 @@ chaos-smoke:     ## short seeded chaos mix (the make-ci gate): 2 cycles,
 	@# >=4 fault types each, every gang invariant swept between them
 	@# (docs/design/chaos-harness.md). Fixed seed = reproducible abuse.
 	$(PY) tools/chaos_soak.py --mix --seed 7 --cycles 2
+	@# one cycle under the lock-order witness: the invariant sweep's
+	@# lock-order check asserts zero acquisition-graph cycles and zero
+	@# blocking-under-lock while faults fire (static-analysis.md).
+	GROVE_LOCKDEP=1 $(PY) tools/chaos_soak.py --mix --seed 7 --cycles 1
 
 chaos-soak:      ## long randomized soak + the leader-kill failover bench
 	@# 8 compressed mix cycles with bench-history chaos rows, then
@@ -107,13 +119,14 @@ serve:           ## run the control plane as a daemon with the HTTP API
 	$(PY) -m grove_tpu.cli serve --fleet v5e:4x4:2
 
 ci:              ## the CI gate (reference .github/workflows analog):
-	@#  lint (compile-check) → tiered suite (core first with a 300s
-	@#  time-box printed+enforced from inside the session, slow tier
-	@#  after; ONE pytest run, one collection) under a 600s wall →
-	@#  budgeted scale point. Budgets are WALLS (tools/ci_budget.py +
-	@#  conftest tier plugin): a green-but-slow suite fails the gate,
-	@#  so wall time cannot silently creep past the 10-minute guidance.
-	$(PY) -m compileall -q grove_tpu tests bench.py __graft_entry__.py
+	@#  lint (compile + grovelint + lockdep smoke) → tiered suite (core
+	@#  first with a 300s time-box printed+enforced from inside the
+	@#  session, slow tier after; ONE pytest run, one collection) under
+	@#  a 600s wall → budgeted scale point. Budgets are WALLS
+	@#  (tools/ci_budget.py + conftest tier plugin): a green-but-slow
+	@#  suite fails the gate, so wall time cannot silently creep past
+	@#  the 10-minute guidance.
+	$(MAKE) lint
 	@# bench-reconcile harness smoke (1-pod shape, no history): catches
 	@# harness rot without paying the full sweep; the informer tests
 	@# themselves run in the core tier below.

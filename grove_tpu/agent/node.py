@@ -46,8 +46,18 @@ class FakeKubeletPool:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal-only phase of the manager's two-phase shutdown."""
         self._stop.set()
+
+    def stop(self) -> None:
+        self.request_stop()
+        if self._thread is not None:
+            # Bounded join: an unjoined kubelet pass outlives shutdown
+            # and races teardown's store mutations (the runnable
+            # contract, grovelint thread-join-in-stop).
+            self._thread.join(timeout=2.0)
+            self._thread = None
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,33 @@
+"""Static + dynamic enforcement of the control plane's earned invariants.
+
+Two halves (docs/design/static-analysis.md):
+
+- ``grovelint`` — an AST-based checker framework. Five PRs of
+  concurrency-heavy machinery each shipped a hard-won rule that lived
+  only in docstrings ("never touch the MetricsHub under the store
+  lock", "control-plane writes go through ``leader_client``", "nothing
+  on the JIT path", "test waits scale through TIME_SCALE"); grovelint
+  turns each into a checker class that fails CI instead of a comment
+  that rots. ``python -m grove_tpu.analysis`` / ``grovectl lint``.
+
+- ``lockdep`` — a lock-order witness (the Linux lockdep model):
+  ``GROVE_LOCKDEP=1`` wraps the store/hub/observer/defrag/standby
+  locks, records the cross-thread acquisition graph, and fails on
+  cycles or on blocking calls made while a witnessed lock is held.
+  Run by ``tools/lockdep_smoke.py`` and as a chaos-harness invariant.
+"""
+
+# Lazy exports: the lockdep wrapper is imported by Store.__init__ on
+# every construction, and pulling the whole linter in with it would tax
+# a path that only wants one env check.
+_LINT_EXPORTS = {"Finding", "LintEngine", "Rule", "default_engine"}
+
+
+def __getattr__(name: str):
+    import importlib
+    if name in _LINT_EXPORTS:
+        mod = importlib.import_module("grove_tpu.analysis.grovelint")
+        return getattr(mod, name)
+    if name in ("lockdep", "grovelint", "rules"):
+        return importlib.import_module(f"grove_tpu.analysis.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,7 @@
+"""``python -m grove_tpu.analysis`` — the grovelint entry point."""
+
+import sys
+
+from grove_tpu.analysis.grovelint import main
+
+sys.exit(main())

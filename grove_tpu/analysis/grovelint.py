@@ -1,0 +1,315 @@
+"""grovelint — AST enforcement of the project's earned invariants.
+
+Each rule is one class encoding one incident this codebase already
+paid for (the catalog with its history: docs/design/static-analysis.md).
+The framework is deliberately small: parse each file once, hand every
+rule the same ``ModuleFile``, collect ``Finding``s, apply pragma
+suppression, and render human text or a machine-readable JSON report.
+
+Pragmas (the grandfathering mechanism — every use needs a one-line
+justification after ``--``):
+
+    x = risky_thing()  # grovelint: disable=rule-name -- why it's safe
+    # grovelint: disable-file=rule-name -- module-wide exemption
+
+Exit codes are diff-friendly for CI gates: 0 = clean (or no NEW
+findings vs ``--baseline``), 1 = findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Iterable
+
+# Directories never worth parsing (generated, caches, scm internals).
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+             "bench-history", "scale-history", "pod-logs"}
+
+PRAGMA_RE = re.compile(
+    r"#\s*grovelint:\s*(disable|disable-file)\s*=\s*([a-z0-9,\-]+)"
+    r"(?:\s*--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line numbers churn with every edit, so a
+        finding is 'the same one' when rule+file+message match — good
+        enough for a no-NEW-findings CI gate."""
+        return (self.rule, self.path, self.message)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class PragmaError(Exception):
+    """A pragma that exists but is malformed (no justification)."""
+
+
+class ModuleFile:
+    """One parsed source file plus everything a rule needs to judge it."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # Pragma maps, parsed once from COMMENT tokens (not raw lines:
+        # pragma-looking text inside a string literal — a lint-test
+        # fixture, a docs snippet — must not create a real exemption).
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        self.bare_pragmas: list[int] = []   # pragma lines missing -- why
+        for i, text in self._comments(source):
+            m = PRAGMA_RE.search(text)
+            if not m:
+                continue
+            verb, rules, why = m.group(1), m.group(2), m.group(3)
+            names = {r.strip() for r in rules.split(",") if r.strip()}
+            if not why:
+                self.bare_pragmas.append(i)
+            if verb == "disable-file":
+                self.file_disables |= names
+            else:
+                self.line_disables.setdefault(i, set()).update(names)
+
+    @staticmethod
+    def _comments(source: str) -> list[tuple[int, str]]:
+        """(line, text) for every real comment token. The file already
+        parsed as AST before this runs, so tokenize errors can't
+        happen on content we lint — but stay defensive anyway."""
+        out: list[tuple[int, str]] = []
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.append((tok.start[0], tok.string))
+        except tokenize.TokenError:
+            pass
+        return out
+
+    @property
+    def is_test(self) -> bool:
+        return self.rel.startswith("tests/")
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_disables:
+            return True
+        return finding.rule in self.line_disables.get(finding.line, set())
+
+
+class Rule:
+    """One invariant. Subclasses set ``name``/``description`` and
+    implement ``check``; ``applies`` scopes the rule to the modules
+    whose contract it encodes (a rule about the store lock has no
+    business parsing the model code)."""
+
+    name = "abstract"
+    description = ""
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return True
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        raise NotImplementedError
+
+    # -- shared AST helpers ------------------------------------------------
+
+    @staticmethod
+    def attr_chain(node: ast.AST) -> list[str]:
+        """``a.b.c`` -> ["a","b","c"]; [] when the base isn't a Name."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            parts.reverse()
+            return parts
+        return []
+
+    def finding(self, mod: ModuleFile, node: ast.AST, message: str) -> Finding:
+        return Finding(self.name, mod.rel, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+class LintEngine:
+    def __init__(self, rules: Iterable[Rule]) -> None:
+        self.rules = list(rules)
+        self.files_scanned = 0
+        self.parse_errors: list[str] = []
+
+    # -- file discovery ----------------------------------------------------
+
+    def iter_files(self, paths: list[str], root: str) -> Iterable[str]:
+        for p in paths:
+            full = p if os.path.isabs(p) else os.path.join(root, p)
+            if not os.path.exists(full):
+                # A typo'd / renamed path must fail the gate loudly —
+                # "0 files, 0 findings, exit 0" is how a CI lint line
+                # silently dies.
+                self.parse_errors.append(f"{p}: no such file or directory"
+                                         f" (resolved to {full})")
+                continue
+            if os.path.isfile(full):
+                if full.endswith(".py"):
+                    yield full
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+    # -- linting -----------------------------------------------------------
+
+    def lint_source(self, source: str, rel: str) -> list[Finding]:
+        mod = ModuleFile(rel, source)
+        out: list[Finding] = []
+        for rule in self.rules:
+            if not rule.applies(mod):
+                continue
+            out.extend(f for f in rule.check(mod) if not mod.suppressed(f))
+        # A pragma without a justification is itself a finding: the
+        # grandfathering policy is "exemption + why", never bare.
+        for line in mod.bare_pragmas:
+            out.append(Finding("pragma-justification", mod.rel, line, 0,
+                               "grovelint pragma without a '-- why' "
+                               "justification"))
+        return out
+
+    def lint_paths(self, paths: list[str], root: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for full in self.iter_files(paths, root):
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            try:
+                with open(full, encoding="utf-8") as fh:
+                    source = fh.read()
+            except OSError as e:
+                self.parse_errors.append(f"{rel}: {e}")
+                continue
+            try:
+                findings.extend(self.lint_source(source, rel))
+            except SyntaxError as e:
+                self.parse_errors.append(f"{rel}: syntax error: {e}")
+            self.files_scanned += 1
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+    # -- reports -----------------------------------------------------------
+
+    def report(self, findings: list[Finding]) -> dict:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "tool": "grovelint",
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "rules": [{"name": r.name, "description": r.description}
+                      for r in self.rules],
+            "counts": counts,
+            "parse_errors": self.parse_errors,
+            "findings": [f.to_dict() for f in findings],
+        }
+
+
+def default_engine() -> LintEngine:
+    from grove_tpu.analysis.rules import ALL_RULES
+    return LintEngine(r() for r in ALL_RULES)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+DEFAULT_PATHS = ["grove_tpu", "tests", "tools", "bench.py"]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="grovelint",
+        description="AST invariant linter for the grove-tpu control "
+                    "plane (docs/design/static-analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable JSON report")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="suppress findings present in this prior JSON "
+                         "report; exit 0 unless NEW findings appear")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write the JSON report to FILE (for future "
+                         "--baseline gating) and exit by the usual codes")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths (default: the "
+                         "tree this package lives in)")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    engine = default_engine()
+    try:
+        findings = engine.lint_paths(args.paths or DEFAULT_PATHS, root)
+    except OSError as e:
+        print(f"grovelint: {e}", file=sys.stderr)
+        return 2
+
+    new = findings
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                base = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"grovelint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        known = {(f["rule"], f["path"], f["message"])
+                 for f in base.get("findings", [])}
+        new = [f for f in findings if f.key() not in known]
+
+    report = engine.report(findings)
+    report["new_findings"] = [f.to_dict() for f in new]
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        for f in new:
+            print(f)
+        label = "new " if args.baseline else ""
+        print(f"grovelint: {engine.files_scanned} files, "
+              f"{len(new)} {label}finding(s)"
+              + (f" ({len(findings)} total incl. baselined)"
+                 if args.baseline else ""))
+        for err in engine.parse_errors:
+            print(f"grovelint: parse error: {err}", file=sys.stderr)
+
+    if engine.parse_errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,357 @@
+"""Lock-order witness — runtime lockdep for the control plane's locks.
+
+The Go reference leans on the race detector to keep 90k LoC of
+concurrent controller code honest; this Python port has 22+
+lock-holding modules and nothing but discipline. This module is the
+dynamic half of that gap (grovelint is the static half): with
+``GROVE_LOCKDEP=1`` the store / metrics-hub / deploy-observer /
+serving-observer / defrag / standby locks are wrapped at construction,
+every cross-lock acquisition records a *class-level* edge (lock
+"classes" aggregate instances, the Linux lockdep model — two Stores'
+locks are one "store" class), and two things become violations:
+
+- an **acquisition-graph cycle**: thread 1 takes store→hub while
+  thread 2 takes hub→store — a deadlock that hasn't fired yet, caught
+  the first time both orders are *observed*, no actual interleaving
+  required;
+- a **blocking call under a witnessed lock**: ``time.sleep`` while
+  holding the store lock stalls every writer behind a wait that has
+  nothing to do with them (the PR 6 buffer-then-flush discipline,
+  enforced at runtime).
+
+Off by default and zero-cost when off: ``maybe_wrap`` returns the raw
+lock unless the env flag is set at construction time, so the hot write
+path never sees the proxy. Consumers: ``tools/lockdep_smoke.py``, the
+chaos harness's lock-order invariant, and tests/test_lockdep.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import traceback
+
+ENV = "GROVE_LOCKDEP"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV, "0") == "1"
+
+
+@dataclasses.dataclass
+class LockViolation:
+    kind: str       # "cycle" | "blocking-under-lock"
+    detail: str
+    stack: str = ""
+
+    def __str__(self) -> str:
+        return f"[lockdep:{self.kind}] {self.detail}"
+
+
+class _Held:
+    __slots__ = ("name", "lock_id")
+
+    def __init__(self, name: str, lock_id: int) -> None:
+        self.name = name
+        self.lock_id = lock_id
+
+
+class LockWitness:
+    """The process-global acquisition-graph recorder.
+
+    Guarded by a plain (unwitnessed) lock; the held-stack is
+    thread-local so the common path — no other witnessed lock held —
+    costs one TLS read and no graph lock at all."""
+
+    def __init__(self) -> None:
+        self._graph_lock = threading.Lock()
+        self._tls = threading.local()
+        # (from, to) -> first-observation stack (class-level edges).
+        self.edges: dict[tuple[str, str], str] = {}
+        self.edge_counts: dict[tuple[str, str], int] = {}
+        self.violations: list[LockViolation] = []
+        self._flagged_cycles: set[tuple[str, str]] = set()
+        # Per-class acquire tallies — the positive control: a consumer
+        # asserting "no violations" must also be able to assert the
+        # locks it cares about were actually witnessed (a de-wired
+        # witness reports a perfect empty graph forever). Tallies are
+        # PER-THREAD dicts (no graph lock on the acquire fast path —
+        # serializing every witnessed acquire through one mutex would
+        # suppress the very interleavings chaos exists to provoke),
+        # registered once per thread and merged at report time.
+        self._tallies: list[dict[str, int]] = []
+
+    # -- held stack --------------------------------------------------------
+
+    def _held(self) -> list[_Held]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def held_names(self) -> list[str]:
+        return [h.name for h in self._held()]
+
+    # -- events from witnessed locks ---------------------------------------
+
+    def note_acquire(self, name: str, lock_id: int) -> None:
+        """Record edges held→name, then push. Called BEFORE the inner
+        acquire: the deadlock potential exists at attempt time."""
+        held = self._held()
+        tally = getattr(self._tls, "tally", None)
+        if tally is None:
+            tally = self._tls.tally = {}
+            with self._graph_lock:    # once per thread, not per acquire
+                self._tallies.append(tally)
+        tally[name] = tally.get(name, 0) + 1
+        reentrant = any(h.lock_id == lock_id for h in held)
+        if not reentrant:
+            for h in held:
+                # Same-class different-instance nesting is not an
+                # inter-class order (and a class-level self-edge would
+                # flag every such pair as a cycle).
+                if h.name != name:
+                    self._add_edge(h.name, name)
+        held.append(_Held(name, lock_id))
+
+    def note_acquire_failed(self, lock_id: int) -> None:
+        """Undo the push for a failed non-blocking acquire."""
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock_id == lock_id:
+                del held[i]
+                return
+
+    def note_release(self, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock_id == lock_id:
+                del held[i]
+                return
+
+    def note_release_all(self, lock_id: int) -> int:
+        """Condition-wait support (RLock._release_save): pop every
+        nested hold of this lock, return how many."""
+        held = self._held()
+        n = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock_id == lock_id:
+                del held[i]
+                n += 1
+        return n
+
+    def note_reacquire(self, name: str, lock_id: int, n: int) -> None:
+        """Condition-wake support (RLock._acquire_restore): the lock is
+        back; no edges — the order was already recorded at first
+        acquire, and edges from a wakeup would invert causality."""
+        held = self._held()
+        for _ in range(max(1, n)):
+            held.append(_Held(name, lock_id))
+
+    def note_blocking(self, what: str) -> None:
+        """A known-blocking call is happening on this thread; if any
+        witnessed lock is held, that's a violation."""
+        held = self.held_names()
+        if not held:
+            return
+        stack = "".join(traceback.format_stack(limit=12)[:-2])
+        with self._graph_lock:
+            self.violations.append(LockViolation(
+                "blocking-under-lock",
+                f"{what} while holding {held} — every other thread "
+                "queued on those locks waits it out",
+                stack))
+
+    # -- graph -------------------------------------------------------------
+
+    def _add_edge(self, a: str, b: str) -> None:
+        stack = None
+        with self._graph_lock:
+            key = (a, b)
+            self.edge_counts[key] = self.edge_counts.get(key, 0) + 1
+            if key not in self.edges:
+                stack = "".join(traceback.format_stack(limit=12)[:-3])
+                self.edges[key] = stack
+            # Immediate lockdep-style detection: does b already reach a?
+            if key not in self._flagged_cycles and self._reaches(b, a):
+                self._flagged_cycles.add(key)
+                self.violations.append(LockViolation(
+                    "cycle",
+                    f"acquisition order {a} -> {b} closes a cycle "
+                    f"(some thread has taken {b} .. -> {a}); ABBA "
+                    "deadlock armed",
+                    self.edges.get(key, "") or (stack or "")))
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        """DFS over recorded edges; caller holds _graph_lock."""
+        seen = set()
+        frontier = [src]
+        while frontier:
+            node = frontier.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            frontier.extend(b for (a, b) in self.edges if a == node)
+        return False
+
+    # -- reporting ---------------------------------------------------------
+
+    def check(self) -> list[LockViolation]:
+        """All violations observed so far (cycles are recorded at edge
+        insertion; this is a stable read, not a recompute)."""
+        with self._graph_lock:
+            return list(self.violations)
+
+    def report(self) -> dict:
+        with self._graph_lock:
+            return {
+                "enabled": enabled(),
+                "acquires": self._merged_acquires(),
+                "edges": [{"from": a, "to": b,
+                           "count": self.edge_counts.get((a, b), 0)}
+                          for (a, b) in sorted(self.edges)],
+                "violations": [dataclasses.asdict(v)
+                               for v in self.violations],
+            }
+
+    def _merged_acquires(self) -> dict[str, int]:
+        """Sum the per-thread tallies (caller holds _graph_lock, which
+        guards the registry list; the dicts themselves mutate lock-free
+        on their owner threads, so snapshot each with a retry — a
+        live thread inserting a NEW class mid-copy is the only race,
+        and class keys stabilize after its first few acquires)."""
+        out: dict[str, int] = {}
+        for tally in self._tallies:
+            for _ in range(3):
+                try:
+                    snap = dict(tally)
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                snap = {}
+            for name, n in snap.items():
+                out[name] = out.get(name, 0) + n
+        return out
+
+    def reset(self) -> None:
+        with self._graph_lock:
+            self.edges.clear()
+            self.edge_counts.clear()
+            self.violations.clear()
+            self._flagged_cycles.clear()
+            for tally in self._tallies:
+                tally.clear()
+
+
+_WITNESS = LockWitness()
+
+
+def witness() -> LockWitness:
+    return _WITNESS
+
+
+class _WitnessedLock:
+    """Proxy for a plain ``threading.Lock``: acquire/release feed the
+    witness; everything else delegates. Deliberately does NOT define
+    ``_release_save``/``_acquire_restore`` — a plain Lock has neither,
+    and a Condition built on one must see the same surface."""
+
+    def __init__(self, inner, name: str) -> None:
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _WITNESS.note_acquire(self._name, id(self))
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            _WITNESS.note_acquire_failed(id(self))
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _WITNESS.note_release(id(self))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._name} {self._inner!r}>"
+
+
+class _WitnessedRLock(_WitnessedLock):
+    """RLock proxy: additionally speaks the Condition protocol
+    (``_is_owned``/``_release_save``/``_acquire_restore``) so
+    ``threading.Condition(store._lock)`` keeps working — and keeps the
+    witness's held-stack truthful across a wait()."""
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        n = _WITNESS.note_release_all(id(self))
+        return (state, n)
+
+    def _acquire_restore(self, saved) -> None:
+        state, n = saved
+        self._inner._acquire_restore(state)
+        _WITNESS.note_reacquire(self._name, id(self), n)
+
+    def locked(self) -> bool:  # RLock has no .locked() pre-3.12
+        locked = getattr(self._inner, "locked", None)
+        return locked() if callable(locked) else self._inner._is_owned()
+
+
+_real_sleep = time.sleep
+_probes_installed = False
+
+
+def _checking_sleep(seconds: float) -> None:
+    # Sub-millisecond sleeps are scheduler yields (spin-wait etiquette),
+    # not blocking waits; flagging them would drown the signal.
+    if seconds >= 0.001:
+        _WITNESS.note_blocking(f"time.sleep({seconds:g})")
+    _real_sleep(seconds)
+
+
+def install_blocking_probes() -> None:
+    """Patch the known-blocking calls (``time.sleep``) with a
+    held-lock check. Opt-in diagnostics only — never on a default
+    path; idempotent."""
+    global _probes_installed
+    if _probes_installed:
+        return
+    time.sleep = _checking_sleep
+    _probes_installed = True
+
+
+def uninstall_blocking_probes() -> None:
+    global _probes_installed
+    if _probes_installed:
+        time.sleep = _real_sleep
+        _probes_installed = False
+
+
+def maybe_wrap(lock, name: str):
+    """The one call sites use: returns ``lock`` untouched unless
+    GROVE_LOCKDEP=1 was set when the owning object was constructed
+    (zero overhead when off — the hot path never sees the proxy)."""
+    if not enabled():
+        return lock
+    install_blocking_probes()
+    if hasattr(lock, "_release_save"):      # RLock
+        return _WitnessedRLock(lock, name)
+    return _WitnessedLock(lock, name)

@@ -1,0 +1,517 @@
+"""The rule catalog — one class per invariant this codebase paid to learn.
+
+Every rule header names the incident it encodes; the long-form history
+is docs/design/static-analysis.md. Rules are scoped (``applies``) to
+the modules whose contract they enforce — a rule about the store lock
+does not parse the model code, so false-positive surface stays small
+enough that a finding means something.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from grove_tpu.analysis.grovelint import Finding, ModuleFile, Rule
+
+# The store/client write verbs — one list shared by the leader-client
+# rule and anyone gating on "is this a mutation".
+WRITE_VERBS = frozenset({
+    "create", "update", "update_status", "update_status_many",
+    "patch_status", "patch_status_many", "patch", "delete",
+})
+
+JAX_MODULES = ("jax", "jaxlib")
+
+
+def _is_jax_import(node: ast.stmt) -> bool:
+    if isinstance(node, ast.Import):
+        return any(a.name.split(".")[0] in JAX_MODULES for a in node.names)
+    if isinstance(node, ast.ImportFrom):
+        return bool(node.module) and node.module.split(".")[0] in JAX_MODULES
+    return False
+
+
+def _const_number(node: ast.AST) -> float | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
+class HubUnderStoreLock(Rule):
+    """PR 6's overhead discipline: the MetricsHub's lock is held across
+    every /metrics render, so a hub call made while holding the store
+    lock stalls ALL writers behind each scrape. Store code buffers
+    telemetry in per-thread records under the lock and flushes in one
+    hub acquisition after release (store/writeobs.py); this rule keeps
+    it that way. Scope: grove_tpu/store/. Under-lock regions are
+    ``with self._locked_write(..)`` / ``with self._lock`` bodies plus
+    functions named ``*_locked`` (the store's under-lock idiom)."""
+
+    name = "hub-under-store-lock"
+    description = ("no MetricsHub/GLOBAL_METRICS call reachable while "
+                   "the store lock is held (buffer + flush after "
+                   "release instead)")
+
+    HUB_NAMES = {"GLOBAL_METRICS"}
+    HUB_METHODS = {"inc", "observe", "set", "bulk", "render",
+                   "set_gauge_family", "observe_many"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel.startswith("grove_tpu/store/")
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        hub_touching = self._hub_touching_functions(mod)
+
+        for region, owner in self._locked_regions(mod):
+            for node in ast.walk(region):
+                out.extend(self._judge(mod, node, owner, hub_touching))
+        return out
+
+    # A function "touches the hub" when it references GLOBAL_METRICS or
+    # calls writeobs.flush; calls to such functions from under-lock
+    # regions are one-hop violations.
+    def _hub_touching_functions(self, mod: ModuleFile) -> set[str]:
+        touching: set[str] = set()
+        for qual, fn in self._functions(mod):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in self.HUB_NAMES:
+                    touching.add(qual)
+                    break
+                chain = self.attr_chain(node) if isinstance(
+                    node, ast.Attribute) else []
+                if chain and (set(chain) & self.HUB_NAMES
+                              or chain[-2:] == ["writeobs", "flush"]):
+                    touching.add(qual)
+                    break
+        return touching
+
+    @staticmethod
+    def _functions(mod: ModuleFile):
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node.name, node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield f"{node.name}.{sub.name}", sub
+
+    def _locked_regions(self, mod: ModuleFile):
+        """Yield (ast-node, owner-class-name) pairs whose whole subtree
+        runs with the store lock held."""
+        for qual, fn in self._functions(mod):
+            owner = qual.split(".")[0] if "." in qual else ""
+            if fn.name.endswith("_locked"):
+                yield fn, owner
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    expr = item.context_expr
+                    chain = []
+                    if isinstance(expr, ast.Call):
+                        chain = self.attr_chain(expr.func)
+                    elif isinstance(expr, ast.Attribute):
+                        chain = self.attr_chain(expr)
+                    if chain and (chain[-1] == "_locked_write"
+                                  or chain[-1].endswith("_lock")
+                                  or chain[-1] == "_event_cond"):
+                        for stmt in node.body:
+                            yield stmt, owner
+
+    def _judge(self, mod: ModuleFile, node: ast.AST, owner: str,
+               hub_touching: set[str]) -> list[Finding]:
+        out = []
+        if isinstance(node, ast.Name) and node.id in self.HUB_NAMES:
+            out.append(self.finding(
+                mod, node,
+                "GLOBAL_METRICS touched under the store lock — buffer "
+                "in the thread's WriteRecord and flush after release "
+                "(store/writeobs.py)"))
+        elif isinstance(node, ast.Call):
+            chain = self.attr_chain(node.func)
+            if chain[-2:] == ["writeobs", "flush"]:
+                out.append(self.finding(
+                    mod, node,
+                    "writeobs.flush under the store lock — the flush "
+                    "IS the post-release hub batch; call it after the "
+                    "guard exits"))
+            elif len(chain) == 2 and chain[0] == "self":
+                qual = f"{owner}.{chain[1]}" if owner else chain[1]
+                if qual in hub_touching:
+                    out.append(self.finding(
+                        mod, node,
+                        f"call to hub-touching {qual}() under the "
+                        "store lock"))
+            elif len(chain) == 1 and chain[0] in hub_touching:
+                out.append(self.finding(
+                    mod, node,
+                    f"call to hub-touching {chain[0]}() under the "
+                    "store lock"))
+        return out
+
+
+class LeaderClientWrite(Rule):
+    """PR 10's zombie-leader guard: control-plane writers (controllers,
+    schedulers, autoscaler, defrag) must write through the manager's
+    epoch-stamped ``leader_client``/``cached_client`` so a deposed
+    replica's in-flight write is FENCED, not committed. A write through
+    ``mgr.client`` (the unfenced data-plane identity) or a locally
+    minted ``Client(...)`` silently reopens the split-brain race the
+    fencing epoch closed."""
+
+    name = "leader-client-write"
+    description = ("control-plane writes go through the epoch-fenced "
+                   "leader client, never mgr.client / a fresh Client()")
+
+    SCOPES = ("grove_tpu/controllers/", "grove_tpu/scheduler/",
+              "grove_tpu/defrag/", "grove_tpu/autoscale.py")
+    MANAGER_NAMES = {"mgr", "manager"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return any(mod.rel.startswith(s) for s in self.SCOPES)
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # Minting an unfenced writer locally.
+            if isinstance(node.func, ast.Name) and node.func.id == "Client":
+                out.append(self.finding(
+                    mod, node,
+                    "direct Client(...) construction in a control-plane "
+                    "writer — accept the manager's epoch-fenced "
+                    "leader_client/cached_client by injection instead"))
+                continue
+            chain = self.attr_chain(node.func)
+            if len(chain) < 3 or chain[-1] not in WRITE_VERBS:
+                continue
+            # <mgr|manager|self.mgr|self.manager>.client.<write-verb>()
+            base, attr = chain[:-2], chain[-2]
+            if attr != "client":
+                continue
+            root = base[-1] if base else ""
+            if root in self.MANAGER_NAMES or (
+                    len(base) >= 2 and base[-2] == "self"
+                    and base[-1] in self.MANAGER_NAMES):
+                out.append(self.finding(
+                    mod, node,
+                    f"write verb .{chain[-1]}() on {'.'.join(chain[:-1])} "
+                    "— the manager's plain client is the UNFENCED "
+                    "data-plane identity; control-plane writes use "
+                    "mgr.leader_client (epoch-stamped)"))
+        return out
+
+
+class JaxInTelemetry(Rule):
+    """PR 7/11's "nothing on the JIT path": host-side telemetry modules
+    must stay importable and callable without touching JAX — a jax
+    import at module scope drags XLA init into the control plane, and
+    an unbracketed jax call in a telemetry hot path can trigger a
+    device sync inside the serving loop. The sanctioned dispatch
+    bracket is a *function-local* ``import jax`` (the xprof idiom:
+    paid only inside the documented roofline/compile-tracker calls,
+    never at import or on the steady telemetry path)."""
+
+    name = "jax-in-telemetry"
+    description = ("no module-level jax/jnp in host-side telemetry; "
+                   "jax use only inside a function-local import bracket")
+
+    TELEMETRY_MODULES = {
+        "grove_tpu/serving/slo.py",
+        "grove_tpu/serving/xprof.py",
+        "grove_tpu/serving/metrics_push.py",
+        "grove_tpu/runtime/metrics.py",
+        "grove_tpu/runtime/servingwatch.py",
+        "grove_tpu/store/writeobs.py",
+    }
+    JAX_NAMES = {"jax", "jnp", "jaxlib"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel in self.TELEMETRY_MODULES
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        funcs: list[ast.AST] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append(node)
+        in_func: set[int] = {id(n) for f in funcs for n in ast.walk(f)}
+
+        # Module-level jax imports: always a finding.
+        for node in ast.walk(mod.tree):
+            if _is_jax_import(node) and id(node) not in in_func:
+                out.append(self.finding(
+                    mod, node,
+                    "module-level jax import in a host-side telemetry "
+                    "module — move it inside the dispatch-bracket "
+                    "function that needs it"))
+
+        # jax/jnp name use inside a function without its own bracket
+        # import (i.e. leaning on some module-level import).
+        for fn in funcs:
+            bracket = any(_is_jax_import(n) for n in ast.walk(fn))
+            if bracket:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and node.id in self.JAX_NAMES \
+                        and isinstance(node.ctx, ast.Load):
+                    out.append(self.finding(
+                        mod, node,
+                        f"'{node.id}' used in telemetry function "
+                        f"{fn.name}() without a function-local import "
+                        "bracket"))
+        # Module-level (non-function) jax name use.
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Name) and node.id in self.JAX_NAMES \
+                    and isinstance(node.ctx, ast.Load) \
+                    and id(node) not in in_func:
+                out.append(self.finding(
+                    mod, node,
+                    f"module-level '{node.id}' use in a host-side "
+                    "telemetry module"))
+        return out
+
+
+class RawTestSleep(Rule):
+    """PR 7's one-flake-per-slow-run lesson: the container's CPU shares
+    throttle unpredictably (identical code swung the suite 155s→259s),
+    so every wall-clock wait in tests scales through TIME_SCALE
+    (runtime/timescale.py) at one chokepoint. A raw ``time.sleep(0.6)``
+    settle or a hand-rolled ``time.time() + 20`` deadline is right on a
+    fast box and a flake on a throttled one. Poll intervals (< 0.25s,
+    inside a scaled-deadline loop) are fine — they never sleep a
+    deadline out."""
+
+    name = "raw-test-sleep"
+    description = ("test waits must scale through runtime/timescale.py "
+                   "(settle()/scaled()), not raw sleeps or deadlines")
+
+    # Below this a literal sleep is a poll interval, not a deadline.
+    DEADLINE_FLOOR = 0.25
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.is_test
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                chain = self.attr_chain(node.func)
+                if chain in (["time", "sleep"], ["sleep"]) and node.args:
+                    v = _const_number(node.args[0])
+                    if v is not None and v >= self.DEADLINE_FLOOR:
+                        out.append(self.finding(
+                            mod, node,
+                            f"raw time.sleep({v:g}) — a fixed settle "
+                            "this long is a deadline; use "
+                            f"timing.settle({v:g}) so a throttled "
+                            "runner gets proportionally more"))
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                left = node.left
+                if isinstance(left, ast.Call):
+                    chain = self.attr_chain(left.func)
+                    if chain in (["time", "time"], ["time", "monotonic"]):
+                        v = _const_number(node.right)
+                        if v is not None:
+                            out.append(self.finding(
+                                mod, node,
+                                f"unscaled deadline time.{chain[-1]}() + "
+                                f"{v:g} — wrap the budget in scaled() "
+                                "(tests/timing.py)"))
+        return out
+
+
+class ThreadJoinInStop(Rule):
+    """The runnable contract (runtime/manager.py): the manager calls
+    ``stop()`` on every runnable at shutdown, and a started thread that
+    stop() doesn't join keeps mutating the store/hub while teardown
+    (or the next test) runs — the chaos harness's original flake
+    factory. Any class with start()/stop() that creates a
+    threading.Thread must join it in stop() (directly or via a helper
+    stop() calls)."""
+
+    name = "thread-join-in-stop"
+    description = ("a runnable that starts a threading.Thread must "
+                   "join it in its stop()")
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel.startswith("grove_tpu/")
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(mod, node))
+        return out
+
+    def _check_class(self, mod: ModuleFile,
+                     cls: ast.ClassDef) -> list[Finding]:
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if "start" not in methods or "stop" not in methods:
+            return []
+        thread_calls = []
+        for m in methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call):
+                    chain = self.attr_chain(node.func)
+                    if chain in (["threading", "Thread"], ["Thread"]):
+                        thread_calls.append(node)
+        if not thread_calls:
+            return []
+        if self._joins(methods["stop"], methods, depth=2):
+            return []
+        return [self.finding(
+            mod, node,
+            f"{cls.name} starts a threading.Thread but its stop() "
+            "never joins one — an unjoined runnable thread outlives "
+            "shutdown and races teardown")
+            for node in thread_calls]
+
+    def _joins(self, fn: ast.AST, methods: dict, depth: int) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = self.attr_chain(node.func)
+                if chain and chain[-1] == "join" \
+                        and self._is_thread_join(node, chain):
+                    return True
+                # One/two-hop: stop() delegating to a helper that joins.
+                if depth > 0 and len(chain) == 2 and chain[0] == "self" \
+                        and chain[1] in methods:
+                    if self._joins(methods[chain[1]], methods, depth - 1):
+                        return True
+        return False
+
+    @staticmethod
+    def _is_thread_join(node: ast.Call, chain: list[str]) -> bool:
+        """A bare ``.join(`` also matches os.path.join and
+        str.join — both common in teardown, and either would
+        permanently blind this rule for the class. A THREAD join is
+        one whose receiver names a thread (``self._thread.join()``,
+        ``t.join()`` over a threads list) or that passes the
+        ``timeout=`` kwarg only thread/process joins accept."""
+        if any(k.arg == "timeout" for k in node.keywords):
+            return True
+        return any("thread" in part.lower() or part in ("t", "th")
+                   for part in chain[:-1])
+
+
+class CloneBeforeMutate(Rule):
+    """The informer-cache contract (runtime/informer.py): list-shaped
+    reads through the cached client / listers return SHARED objects —
+    one mutation in place corrupts every other reader's view of the
+    cache (and the store's per-version snapshot clones). Reconcilers
+    that edit a listed object ``clone()`` first. This rule tracks, per
+    function, names bound from ``.list(...)``/``.list_snapshot(...)``
+    (and loop vars over them) and flags attribute/subscript stores on
+    them without an intervening clone."""
+
+    name = "clone-before-mutate"
+    description = ("objects from informer-cache lists are shared: "
+                   "clone() before mutating")
+
+    SCOPES = ("grove_tpu/controllers/", "grove_tpu/scheduler/",
+              "grove_tpu/defrag/", "grove_tpu/autoscale.py")
+    LIST_VERBS = {"list", "list_snapshot"}
+    CLONERS = {"clone", "serde_clone", "deepcopy", "replace"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return any(mod.rel.startswith(s) for s in self.SCOPES)
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(mod, node))
+        return out
+
+    def _is_list_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        chain = self.attr_chain(node.func)
+        return bool(chain) and chain[-1] in self.LIST_VERBS
+
+    def _check_function(self, mod: ModuleFile, fn: ast.AST) -> list[Finding]:
+        out: list[Finding] = []
+        # env: name -> "collection" (a shared list) | "object" (a shared
+        # element). A forward pass in statement order; assignment from
+        # anything else kills the taint.
+        env: dict[str, str] = {}
+
+        def root_name(node: ast.AST) -> str | None:
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                node = node.value
+            return node.id if isinstance(node, ast.Name) else None
+
+        def visit(stmts: list[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    name = stmt.targets[0].id
+                    val = stmt.value
+                    if self._is_list_call(val):
+                        env[name] = "collection"
+                    elif isinstance(val, ast.Call) and isinstance(
+                            val.func, ast.Name) \
+                            and val.func.id in self.CLONERS:
+                        env.pop(name, None)
+                    elif isinstance(val, ast.Subscript) \
+                            and env.get(root_name(val) or "") == "collection":
+                        env[name] = "object"
+                    elif isinstance(val, ast.Name) and val.id in env:
+                        env[name] = env[val.id]
+                    else:
+                        env.pop(name, None)
+                elif isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for tgt in targets:
+                        if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                            root = root_name(tgt)
+                            if root and env.get(root) == "object":
+                                out.append(self.finding(
+                                    mod, stmt,
+                                    f"mutating '{root}', an object from "
+                                    "a shared list read — clone() it "
+                                    "first (informer-cache contract, "
+                                    "runtime/informer.py)"))
+                if isinstance(stmt, ast.For):
+                    tainted = False
+                    if self._is_list_call(stmt.iter):
+                        tainted = True
+                    elif isinstance(stmt.iter, ast.Name) \
+                            and env.get(stmt.iter.id) == "collection":
+                        tainted = True
+                    if tainted and isinstance(stmt.target, ast.Name):
+                        env[stmt.target.id] = "object"
+                    visit(stmt.body)
+                    visit(stmt.orelse)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    visit(stmt.body)
+                    visit(stmt.orelse)
+                elif isinstance(stmt, ast.With):
+                    visit(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    visit(stmt.body)
+                    for h in stmt.handlers:
+                        visit(h.body)
+                    visit(stmt.orelse)
+                    visit(stmt.finalbody)
+
+        visit(fn.body)
+        return out
+
+
+ALL_RULES = [
+    HubUnderStoreLock,
+    LeaderClientWrite,
+    JaxInTelemetry,
+    RawTestSleep,
+    ThreadJoinInStop,
+    CloneBeforeMutate,
+]

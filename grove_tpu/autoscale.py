@@ -222,8 +222,18 @@ class Autoscaler:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal-only phase of the manager's two-phase shutdown."""
         self._stop.set()
+
+    def stop(self) -> None:
+        self.request_stop()
+        if self._thread is not None:
+            # A sync pass landing after stop() would write scale
+            # decisions into a store mid-teardown (the runnable
+            # contract, grovelint thread-join-in-stop).
+            self._thread.join(timeout=2.0)
+            self._thread = None
 
     def pause(self) -> None:
         """Leadership parking (grove_tpu/ha): a demoted replica's scale
@@ -288,7 +298,7 @@ class Autoscaler:
                     self.log.info("scaling %s/%s %d -> %d (%s=%.2f)",
                                   obj.KIND, obj.meta.name, old,
                                   want, a.metric, value)
-                    obj.spec.replicas = want
+                    obj.spec.replicas = want  # grovelint: disable=clone-before-mutate -- autoscaler lists through the DIRECT leader client (never the informer cache): store lists return per-call clones, safe to edit
                     try:
                         self.client.update(obj)
                     except ConflictError:

@@ -29,6 +29,10 @@ The invariants (ISSUE 8 / reference GS1-GS10 analog):
 - **ttr-stability**    time-to-ready p99 stays within a drift factor
                        of the first cycle's (no degradation across
                        cycles — the soak signal)
+- **lock-order**       under GROVE_LOCKDEP=1, the witnessed-lock
+                       acquisition graph stays acyclic and no blocking
+                       call runs under a witnessed lock
+                       (grove_tpu/analysis/lockdep.py)
 """
 
 from __future__ import annotations
@@ -417,6 +421,20 @@ class InvariantChecker:
                 "plane is degrading across cycles")]
         return []
 
+    # ---- lock-order witness (grove_tpu/analysis/lockdep.py) -------------
+
+    def check_lock_order(self) -> list[Violation]:
+        """When the run is under GROVE_LOCKDEP=1, the acquisition graph
+        the witnessed locks recorded must be free of cycles and of
+        blocking-calls-under-lock. No polling grace: a recorded
+        violation is history, not a transient — it cannot converge
+        away."""
+        from grove_tpu.analysis import lockdep
+        if not lockdep.enabled():
+            return []
+        return [Violation("lock-order", v.kind, v.detail)
+                for v in lockdep.witness().check()]
+
     # ---- the sweep -------------------------------------------------------
 
     def sweep(self, wire_informers: dict | None = None,
@@ -432,6 +450,7 @@ class InvariantChecker:
         out += self.check_defrag_holds()
         out += self.check_gauge_consistency()
         out += self.check_wire_convergence(wire_informers)
+        out += self.check_lock_order()
         if include_ttr:
             out += self.check_ttr_stability()
         for v in out:

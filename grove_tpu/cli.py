@@ -1221,6 +1221,21 @@ def cmd_render_deploy(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """grovelint over the tree (the golangci-lint analog): AST rules
+    for the project's earned invariants, JSON report, diff-friendly
+    exit codes (docs/design/static-analysis.md)."""
+    from grove_tpu.analysis.grovelint import main as lint_main
+    forwarded: list[str] = list(args.paths or [])
+    if args.json:
+        forwarded.append("--json")
+    if args.baseline:
+        forwarded += ["--baseline", args.baseline]
+    if args.write_baseline:
+        forwarded += ["--write-baseline", args.write_baseline]
+    return lint_main(forwarded)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="grovectl")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -1499,6 +1514,22 @@ def main(argv: list[str] | None = None) -> int:
                         default="gke")
     render.add_argument("--out", required=True, help="output directory")
     render.set_defaults(fn=cmd_render_deploy)
+
+    lint = sub.add_parser(
+        "lint",
+        help="grovelint: AST invariant rules over the tree "
+             "(exit 0 clean, 1 findings; --baseline gates on NEW "
+             "findings only)")
+    lint.add_argument("paths", nargs="*",
+                      help="files/dirs (default: grove_tpu tests tools "
+                           "bench.py)")
+    lint.add_argument("--json", action="store_true",
+                      help="machine-readable JSON report")
+    lint.add_argument("--baseline", help="prior JSON report; only NEW "
+                                         "findings fail")
+    lint.add_argument("--write-baseline", help="write the JSON report "
+                                               "to this path")
+    lint.set_defaults(fn=cmd_lint)
 
     run = sub.add_parser("run", help="run a cluster, apply manifests, report")
     run.add_argument("--fleet", default="v5e:4x4:2",

@@ -155,7 +155,8 @@ class DefragController:
         self._thread: threading.Thread | None = None
         # Guards _active/_recent/_moved: the sweep thread mutates them,
         # payload() reads them from the HTTP server thread.
-        self._lock = threading.Lock()
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "defrag")
         self._active: _Migration | None = None
         self._recent: collections.deque = collections.deque(
             maxlen=self.RECENT_CAPACITY)

@@ -67,7 +67,8 @@ class HotStandby:
         self.leadership = LeadershipState(replica=replica)
         self.leadership.note_demoted(leader_hint=self.leader_url)
         self.log = get_logger("ha.standby")
-        self._lock = threading.Lock()
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "standby")
         # (kind, ns, name) -> obj — the merged all-kind mirror.
         self._objects: dict[tuple[str, str, str], Any] = {}
         self.rv = 0
@@ -84,7 +85,7 @@ class HotStandby:
 
     def start(self) -> None:
         self._seed()
-        self._thread = threading.Thread(target=self._run,
+        self._thread = threading.Thread(target=self._run,  # grovelint: disable=thread-join-in-stop -- mirrors the leader over a wire long-poll (up to poll_timeout); a promotion-path stop() cannot afford to wait that out, and the daemon thread only writes its own mirror
                                         name="ha-standby-watch",
                                         daemon=True)
         self._thread.start()
@@ -358,11 +359,19 @@ class StandbyServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         name="standby-server", daemon=True).start()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="standby-server",
+            daemon=True)
+        self._serve_thread.start()
 
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        # serve_forever returns at shutdown(); join so a stopped
+        # standby server provably serves nothing (grovelint
+        # thread-join-in-stop).
+        if getattr(self, "_serve_thread", None) is not None:
+            self._serve_thread.join(timeout=2.0)
+            self._serve_thread = None

@@ -287,9 +287,27 @@ class Controller:
             t.start()
             self._threads.append(t)
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal only (idempotent): flips the stop flag and unblocks
+        workers. The manager signals EVERY controller before joining
+        any (Manager.stop), so all dispatch threads run out their
+        0.2s poll concurrently instead of serially per controller."""
         self._stop.set()
         self.queue.shutdown()
+
+    def stop(self) -> None:
+        self.request_stop()
+        # Bounded join of watch dispatchers (0.2s poll) and workers
+        # (unblocked by the queue shutdown above): a worker finishing a
+        # reconcile after stop() returns writes into a store the
+        # caller already considers quiesced (grovelint
+        # thread-join-in-stop). Self-join guard: a reconcile that
+        # stops its own manager must not deadlock on itself.
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is not me:
+                t.join(timeout=2.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def _resync(self, kinds, mapper, selector) -> None:
         from grove_tpu.manifest import KIND_REGISTRY

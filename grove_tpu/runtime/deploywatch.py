@@ -131,7 +131,8 @@ class DeployObserver:
         # leak every discarded Manager's store + records for process
         # lifetime (the weakref-doc caveat).
         self._store_ref = weakref.ref(store)
-        self._lock = threading.Lock()
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "deploy-observer")
         self._records: "collections.OrderedDict[tuple[str, str], DeployRecord]" = \
             collections.OrderedDict()
         # Keys of records that can still finalize (not yet Available,
@@ -163,10 +164,14 @@ class DeployObserver:
                                         name="deploy-observer", daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal-only phase of the manager's two-phase shutdown."""
         self._stop.set()
         if self._watcher is not None:
             self._watcher.close()
+
+    def stop(self) -> None:
+        self.request_stop()
         # Join before a possible restart: _apply's unlocked _pending
         # read assumes ONE event thread; a stop->start inside the old
         # thread's poll window would otherwise leave two running.

@@ -517,7 +517,7 @@ class Reflector:
         # between the seed list and the watch connecting are replayed,
         # not silently skipped (the same contract the gap path honors).
         self._seed_rv = self.informer.relist_now("seed")
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # grovelint: disable=thread-join-in-stop -- blocks in a wire long-poll up to poll_timeout; joining would stall every shutdown that long, and the daemon thread only READS (applies events to its own cache)
             target=self._run, name=f"reflector-{self.informer.KIND}",
             daemon=True)
         self._thread.start()

@@ -82,7 +82,12 @@ class _Hist:
 
 class MetricsHub:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # Witnessed under GROVE_LOCKDEP=1: this lock is held across
+        # every /metrics render, which is exactly why nothing may take
+        # it while holding the store lock (grovelint's
+        # hub-under-store-lock rule is the static twin of this edge).
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "hub")
         self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
         self._gauges: dict[tuple[str, tuple], float] = {}
         self._hists: dict[tuple[str, tuple], _Hist] = {}

@@ -59,7 +59,8 @@ class ServingObserver:
         self._store_ref = weakref.ref(store)
         self.tick = tick
         self.log = get_logger("servingwatch")
-        self._lock = threading.Lock()
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "serving-observer")
         # (namespace, name) -> list of per-kind scope dicts (payload()).
         self._state: dict[tuple[str, str], list[dict]] = {}
         self._stop = threading.Event()
@@ -81,8 +82,12 @@ class ServingObserver:
                                         daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal-only phase of the manager's two-phase shutdown."""
         self._stop.set()
+
+    def stop(self) -> None:
+        self.request_stop()
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=2.0)

@@ -25,10 +25,38 @@ runner, set it to 1 to reproduce a deadline-tightness flake locally.
 from __future__ import annotations
 
 import os
+import time
 
-TIME_SCALE = max(0.1, float(os.environ.get("GROVE_TEST_TIME_SCALE", "3.0")))
+DEFAULT_SCALE = 3.0
+
+TIME_SCALE = max(0.1, float(os.environ.get("GROVE_TEST_TIME_SCALE",
+                                           str(DEFAULT_SCALE))))
 
 
 def scaled(seconds: float) -> float:
     """A wall-clock deadline adjusted for this machine's slowness."""
     return seconds * TIME_SCALE
+
+
+# The factor settle() applies: 1.0 at (or below) the default scale,
+# proportional above it. Exported so a test whose subject has a REAL
+# wall-clock window (e.g. the autoscaler's scale-down stabilization)
+# can scale that window by the same factor as its settles — keeping
+# the before/after-the-window ratios invariant at any scale.
+SETTLE_SCALE = max(1.0, TIME_SCALE / DEFAULT_SCALE)
+
+
+def settle(seconds: float) -> None:
+    """Sleep a settle floor — the "give the system time to do the
+    wrong thing" wait before a negative assertion, or a propagation
+    floor a poll can't replace.
+
+    Unlike a polled deadline, a sleep ALWAYS pays its full duration,
+    so settles scale relative to the DEFAULT scale rather than by raw
+    TIME_SCALE: at the default configuration this is exactly
+    ``time.sleep(seconds)`` (no suite-wide slowdown for the common
+    case), while a known-slow runner that cranks GROVE_TEST_TIME_SCALE
+    above the default gets proportionally longer settles. Floored at
+    1x — a settle is a minimum, shrinking it changes what the test
+    means. Grovelint's raw-test-sleep rule points here."""
+    time.sleep(seconds * SETTLE_SCALE)

@@ -209,24 +209,37 @@ class Tracer:
 
     # ---- lifecycle milestones → SLO histograms ----
 
-    def note_created(self, obj) -> None:
+    def note_created(self, obj, defer_observe: bool = False):
         """Milestone hook for Store.create: gang creation is the first
         per-gang milestone (the root object's create is the trace
-        start, recorded by ensure/mint)."""
+        start, recorded by ensure/mint). With ``defer_observe`` the
+        milestone itself is recorded NOW (so later milestones — a
+        scheduler binding the gang off the ADDED event — see
+        gang_created already present) and the returned callable
+        carries only the hub observation, for the store to run after
+        its lock drops (the hub lock is held across /metrics renders;
+        grove_tpu/analysis/lockdep.py convicted the in-lock call)."""
         if obj.KIND != "PodGang":
-            return
+            return None
         tid = obj.meta.annotations.get(ANNOTATION_TRACE_ID, "")
-        self.milestone(tid, f"{obj.meta.namespace}/{obj.meta.name}",
-                       "gang_created", ts=obj.meta.creation_timestamp)
+        return self.milestone(tid,
+                              f"{obj.meta.namespace}/{obj.meta.name}",
+                              "gang_created",
+                              ts=obj.meta.creation_timestamp,
+                              defer_observe=defer_observe)
 
     def milestone(self, trace_id: str, subject: str, phase: str,
-                  ts: float | None = None) -> None:
+                  ts: float | None = None,
+                  defer_observe: bool = False):
         """First-write-wins milestone for (trace, subject). Reaching a
         milestone observes the SLO histograms for the phase it closes;
         repeats (condition flapping, re-reconciles) are ignored so each
-        gang contributes exactly one observation per phase."""
+        gang contributes exactly one observation per phase. With
+        ``defer_observe`` the milestone is recorded but the histogram
+        observation is returned as a callable for the caller to run
+        once it holds no locks (else None when nothing to observe)."""
         if not self.enabled or not trace_id:
-            return
+            return None
         ts = time.time() if ts is None else ts
         with self._lock:
             key = (trace_id, subject)
@@ -236,7 +249,7 @@ class Tracer:
                 while len(self._milestones) > self.TRACE_CAPACITY:
                     self._milestones.popitem(last=False)
             if phase in m:
-                return
+                return None
             m[phase] = ts
             # Anchor: trace mint time; a trace whose start was lost
             # (ring eviction, restart) falls back to its first
@@ -245,7 +258,10 @@ class Tracer:
             t0 = self._trace_start.get(trace_id,
                                        m.get("gang_created", ts))
             snapshot = dict(m)
+        if defer_observe:
+            return lambda: self._observe(phase, snapshot, t0, ts)
         self._observe(phase, snapshot, t0, ts)
+        return None
 
     @staticmethod
     def _observe(phase: str, m: dict[str, float], t0: float,

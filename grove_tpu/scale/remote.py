@@ -60,7 +60,7 @@ class WireNodeDriver:
         for target, name in ((self._watch_loop, "wire-watch"),
                              (self._heartbeat_loop, "wire-heartbeat"),
                              (self._kubelet_loop, "wire-kubelet")):
-            t = threading.Thread(target=target, name=name, daemon=True)
+            t = threading.Thread(target=target, name=name, daemon=True)  # grovelint: disable=thread-join-in-stop -- the watch loop blocks in an HTTP long-poll up to 10s; stop() sets the flag and the daemon threads drain on their next wake (joining would stall driver shutdown the poll timeout)
             t.start()
             self._threads.append(t)
 

@@ -260,6 +260,7 @@ class _PlacementLoop:
         self.log = get_logger(f"scheduler.{name}")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._pump_thread: threading.Thread | None = None
         self._wake = threading.Event()
 
     def start(self) -> None:
@@ -276,15 +277,28 @@ class _PlacementLoop:
                     pass
                 self._wake.set()
 
-        threading.Thread(target=pump, name=f"sched-{self.name}-watch",
-                         daemon=True).start()
+        self._pump_thread = threading.Thread(
+            target=pump, name=f"sched-{self.name}-watch", daemon=True)
+        self._pump_thread.start()
         self._thread = threading.Thread(target=self._run,
                                         name=f"sched-{self.name}", daemon=True)
         self._thread.start()
 
-    def stop(self) -> None:
+    def request_stop(self) -> None:
+        """Signal-only phase of the manager's two-phase shutdown."""
         self._stop.set()
         self._wake.set()
+
+    def stop(self) -> None:
+        self.request_stop()
+        # A placement pass finishing after stop() binds pods into a
+        # store mid-teardown (grovelint thread-join-in-stop). The pump
+        # polls at 0.2s, the loop wakes on _wake: both exit promptly.
+        for t in (self._thread, getattr(self, "_pump_thread", None)):
+            if t is not None:
+                t.join(timeout=2.0)
+        self._thread = None
+        self._pump_thread = None
 
     def pause(self) -> None:
         """Leadership parking (grove_tpu/ha): a demoted replica's binds
@@ -1120,7 +1134,7 @@ class SimpleBackend:
                 PodRequest(pod.meta.name, pod.spec.tpu_chips,
                            dict(pod.spec.node_selector)), hosts)
             if host is not None:
-                pod.status.node_name = host
+                pod.status.node_name = host  # grovelint: disable=clone-before-mutate -- the simple backend lists through the DIRECT leader client (store lists clone per call); only the gang backend reads shared snapshots
                 client.update_status(pod)
                 # In-place deduction replaces the full per-bind re-list
                 # (the same accounting the rebuild would arrive at).

@@ -1079,8 +1079,10 @@ class ApiServer:
         self._httpd = QuietServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._setup_tls()
-        threading.Thread(target=self._httpd.serve_forever,
-                         name="api-server", daemon=True).start()
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="api-server",
+            daemon=True)
+        self._serve_thread.start()
 
     def stop(self) -> None:
         self._stopped = True
@@ -1090,3 +1092,9 @@ class ApiServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        # shutdown() returns once serve_forever exits its loop; the
+        # join makes "stopped" mean no request thread still touches the
+        # manager (grovelint thread-join-in-stop).
+        if getattr(self, "_serve_thread", None) is not None:
+            self._serve_thread.join(timeout=2.0)
+            self._serve_thread = None

@@ -147,7 +147,10 @@ class Store:
         (``StatePersister.load_warm``) instead of decoding snapshot +
         full WAL; falls back to the full load whenever equivalence
         cannot be proven. Ignored without ``state_dir``."""
-        self._lock = threading.RLock()
+        # Wrapped by the lock-order witness under GROVE_LOCKDEP=1
+        # (grove_tpu/analysis/lockdep.py); the raw RLock otherwise.
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.RLock(), "store")
         # Signalled on every _emit: wire long-polls block on this instead
         # of rescanning the ring on a poll interval.
         self._event_cond = threading.Condition(self._lock)
@@ -556,9 +559,20 @@ class Store:
             objs[key] = stored
             writeobs.note_commit(kind, "create")
             self._persist_put(stored)
-            GLOBAL_TRACER.note_created(stored)
+            # The gang_created MILESTONE is recorded before the emit
+            # (a scheduler binding off the ADDED event must find it
+            # already present — its scheduled milestone anchors phase
+            # deltas on it), but the hub OBSERVATION it closes is
+            # deferred past lock release: the hub lock is held across
+            # /metrics renders, and taking it here was the first
+            # store→hub edge the GROVE_LOCKDEP witness recorded.
+            observe = GLOBAL_TRACER.note_created(stored,
+                                                 defer_observe=True)
             self._emit(EventType.ADDED, stored)
-            return clone(stored)
+            out = clone(stored)
+        if observe is not None:
+            observe()
+        return out
 
     def _get_live(self, obj: Any) -> Any:
         objs = self._objects.get(obj.KIND, {})

@@ -104,7 +104,7 @@ class WriteRecord:
     """Telemetry buffered across one public store write verb."""
 
     __slots__ = ("verb", "writer", "commits", "noops", "conflicts",
-                 "fenced", "events", "wait_s", "hold_s")
+                 "fenced", "events", "scans", "wait_s", "hold_s")
 
     def __init__(self, verb: str, writer: str) -> None:
         self.verb = verb
@@ -114,6 +114,7 @@ class WriteRecord:
         self.conflicts: list[tuple[str, str]] = []  # (kind, verb)
         self.fenced: list[tuple[str, str]] = []     # (kind, verb)
         self.events: list[tuple[str, str]] = []     # (kind, type)
+        self.scans: list[str] = []                  # kind (reentrant lists)
         self.wait_s = 0.0
         self.hold_s = 0.0
 
@@ -198,8 +199,20 @@ def count_scan(kind: str) -> None:
     """One list-shaped scan of ``kind`` into
     ``grove_store_list_scans_total`` (cached key; called outside the
     store lock on every Store.list/list_snapshot — the direct-read
-    escape hatch path pays this thousands of times per sweep)."""
+    escape hatch path pays this thousands of times per sweep).
+
+    When this thread has a write record open, the scan came from a
+    REENTRANT list inside a write verb (the admission chain listing
+    nodes under ``_locked_write``) and the store RLock is still held —
+    so the inc is buffered into the record and flushed with everything
+    else after release, instead of taking the hub lock under the store
+    lock (the GROVE_LOCKDEP=1 witness caught exactly this edge on the
+    create path)."""
     if not enabled():
+        return
+    rec = _rec()
+    if rec is not None:
+        rec.scans.append(kind)
         return
     inc = _SCAN_INC.get(kind)
     if inc is None:
@@ -219,14 +232,22 @@ def flush(rec: WriteRecord) -> None:
     w = rec.writer
     if not rec.commits and not rec.conflicts and not rec.events \
             and not rec.fenced:
-        if rec.noops:
+        if rec.noops or rec.scans:
             GLOBAL_METRICS.bulk(incs=[
                 _cached(_NOOP_INC, (kind, w),
                         "grove_store_noop_writes_total",
                         (("kind", kind), ("writer", w)))
-                for kind in rec.noops])
+                for kind in rec.noops] + [
+                _cached(_SCAN_INC, kind,
+                        "grove_store_list_scans_total",
+                        (("kind", kind),))
+                for kind in rec.scans])
         return
     incs: list[tuple[str, tuple, float]] = []
+    for kind in rec.scans:
+        incs.append(_cached(
+            _SCAN_INC, kind, "grove_store_list_scans_total",
+            (("kind", kind),)))
     for kind, verb in rec.commits:
         incs.append(_cached(
             _WRITE_INC, (kind, verb, w), "grove_store_writes_total",

@@ -15,12 +15,19 @@ from grove_tpu.autoscale import Autoscaler, MetricsRegistry
 from grove_tpu.store.client import Client
 from grove_tpu.store.store import Store
 
+from timing import SETTLE_SCALE, settle
+
 
 def make_scaler(stabilization: float):
     client = Client(Store())
     metrics = MetricsRegistry()
+    # The stabilization window is REAL wall time inside the scaler, and
+    # the tests sleep settle()-scaled fractions of it to land on either
+    # side of the boundary — scale the window by the same factor so the
+    # before/after ratios hold at any GROVE_TEST_TIME_SCALE.
     scaler = Autoscaler(client, metrics,
-                        scale_down_stabilization=stabilization)
+                        scale_down_stabilization=stabilization
+                        * SETTLE_SCALE)
     pcsg = PodCliqueScalingGroup(
         meta=new_meta("sg"),
         spec=PodCliqueScalingGroupSpec(
@@ -55,7 +62,7 @@ def test_scale_down_waits_out_the_window():
     assert replicas(client) == 5, "must not shrink inside the window"
 
     # After the window drains, the low signal wins.
-    time.sleep(0.6)
+    settle(0.6)
     scaler._pass()
     assert replicas(client) == 1
 
@@ -77,10 +84,10 @@ def test_spike_during_drain_resets_the_window():
     client, metrics, scaler = make_scaler(stabilization=0.5)
     metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
     scaler._pass()
-    time.sleep(0.3)
+    settle(0.3)
     metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 45.0)
     scaler._pass()
-    time.sleep(0.3)
+    settle(0.3)
     # 0.6s since the FIRST spike, only 0.3 since the second → hold.
     metrics.set("PodCliqueScalingGroup", "sg", "queue_depth", 5.0)
     scaler._pass()

@@ -12,6 +12,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import simple_pcs, wait_for
 
+from timing import settle
+
 
 @pytest.fixture
 def cluster():
@@ -56,7 +58,7 @@ def test_custom_level_labels_drive_placement(cluster):
         return client.get(ClusterTopology,
                           "default").status.synced_backends == ["gang"]
     wait_for(resynced, desc="CT resynced")
-    time.sleep(0.3)  # let the backend pick up the new hierarchy
+    settle(0.3)  # let the backend pick up the new hierarchy
 
     client.create(simple_pcs(name="wide", pods=5, chips=4))  # 20 chips
     wait_for(lambda: all(

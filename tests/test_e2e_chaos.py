@@ -35,6 +35,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
 
 from test_e2e_simple import wait_for
 
+from timing import settle
+
 SLICE = TopologyConstraint(pack_level="slice", required=True)
 POOL = TopologyConstraint(pack_level="pool", required=True)
 
@@ -158,7 +160,7 @@ def test_chaos_reservation_heal_under_autoscale(cluster):
         fresh = build_node("v5e", "2x2", lost,
                            int(n.meta.labels[c.NODE_LABEL_SLICE_WORKER]))
         client.create(fresh)
-    time.sleep(0.5)
+    settle(0.5)
     assert all(not n.meta.labels.get(c.LABEL_RESERVATION)
                for n in client.list(Node)
                if n.meta.labels.get(c.NODE_LABEL_SLICE) == lost)

@@ -33,6 +33,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import simple_pcs, wait_for
 
+from timing import settle
+
 
 def disagg_pcs(name="disagg", sg_replicas=2, sg_min=1):
     return PodCliqueSet(
@@ -89,7 +91,7 @@ def test_disagg_converges_and_stays_stable(cluster):
         "controllers never went idle"
     pclqs_before = {q.meta.name: q.meta.uid for q in client.list(PodClique)}
     pods_before = {p.meta.name: p.meta.uid for p in client.list(Pod)}
-    time.sleep(1.0)
+    settle(1.0)
     pclqs_after = {q.meta.name: q.meta.uid for q in client.list(PodClique)}
     pods_after = {p.meta.name: p.meta.uid for p in client.list(Pod)}
     assert pclqs_before == pclqs_after, "PCLQ churn at steady state"
@@ -129,7 +131,7 @@ def test_steady_state_reconcile_cost_bounded(cluster):
     assert cluster.manager.wait_idle(timeout=10.0, settle=0.5)
     before = {name: v["reconciles"] for name, v in
               cluster.manager.healthz()["controllers"].items()}
-    time.sleep(2.0)
+    settle(2.0)
     after = {name: v["reconciles"] for name, v in
              cluster.manager.healthz()["controllers"].items()}
     drift = {k: after[k] - before[k] for k in after}

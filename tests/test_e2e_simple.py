@@ -29,7 +29,7 @@ from grove_tpu.api.podcliqueset import (
 from grove_tpu.cluster import new_cluster
 from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
-from timing import TIME_SCALE
+from timing import TIME_SCALE, settle
 
 
 def wait_for(predicate, timeout=10.0, interval=0.05, desc="condition"):
@@ -119,7 +119,7 @@ def test_gang_does_not_fit_stays_pending(cluster):
     client = cluster.client
     client.create(simple_pcs(name="toobig", pods=5, chips=4))  # 20 chips > 16
 
-    time.sleep(1.0)
+    settle(1.0)
     pods = client.list(Pod, selector={c.LABEL_PCS_NAME: "toobig"})
     assert len(pods) == 5
     assert all(not p.status.node_name for p in pods), "partial placement!"

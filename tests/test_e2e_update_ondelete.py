@@ -18,6 +18,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import simple_pcs, wait_for
 
+from timing import settle
+
 
 @pytest.fixture
 def cluster():
@@ -60,7 +62,7 @@ def test_template_edit_touches_nothing(cluster):
     wait_for(bookkeeping, desc="OnDelete bookkeeping")
 
     # ...and stays that way: no pod is deleted or recreated
-    time.sleep(1.0)
+    settle(1.0)
     after = {p.meta.name: p.meta.uid
              for p in client.list(Pod, selector={c.LABEL_PCS_NAME: "od"})}
     assert after == before, "OnDelete must not touch pods on its own"

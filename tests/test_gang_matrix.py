@@ -43,6 +43,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import wait_for
 
+from timing import settle
+
 PODS_PER_SLICE = 2
 
 
@@ -124,7 +126,7 @@ def test_gs1_full_replicas_atomic_then_placed():
         # Needs 3 slices (a + 2 gang-guaranteed sg replicas); 2 available.
         cl.client.create(wl("wl1", sg_replicas=2, sg_min=2))
         wait_for(lambda: len(pods_of(cl, "wl1")) == 6, desc="pods created")
-        time.sleep(0.6)
+        settle(0.6)
         assert bound(cl, "wl1") == [], "must be all-pending"
         assert_no_partial_binds(cl, "wl1")
         assert not gang_scheduled(cl, "wl1-0")
@@ -152,7 +154,7 @@ def test_gs2_pcsg_scale_out_under_pressure():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl2")) == 6,
                  desc="scaled pods created")
-        time.sleep(0.6)
+        settle(0.6)
         assert len(bound(cl, "wl2")) == 4, "scaled gang must wait"
         assert_no_partial_binds(cl, "wl2")
         after = {p.meta.name: p.meta.uid for p in pods_of(cl, "wl2")}
@@ -178,7 +180,7 @@ def test_gs3_pcs_scale_out_under_pressure():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl3")) == 8,
                  desc="replica 1 pods created")
-        time.sleep(0.6)
+        settle(0.6)
         assert len(bound(cl, "wl3")) == 4
         assert_no_partial_binds(cl, "wl3")
         assert not gang_scheduled(cl, "wl3-1")
@@ -251,7 +253,7 @@ def test_gs6_elastic_gangs_never_disturb_base():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl6")) == 10,
                  desc="elastic pods created")
-        time.sleep(0.6)
+        settle(0.6)
         assert len(bound(cl, "wl6")) == 6
         assert_no_partial_binds(cl, "wl6")
         after = {p.meta.name: p.meta.uid for p in bound(cl, "wl6")}
@@ -266,13 +268,13 @@ def test_gs7_freed_capacity_admits_exactly_one_elastic():
         set_cordon(cl, slice_nodes(cl, 2), True)
         cl.client.create(wl("wl7", sg_replicas=3, sg_min=1))
         wait_for(lambda: len(bound(cl, "wl7")) == 4, desc="base up")
-        time.sleep(0.6)
+        settle(0.6)
         assert len(pods_of(cl, "wl7")) == 8  # a + 3 sg replicas, 2 pods each
 
         set_cordon(cl, slice_nodes(cl, 2), False)  # room for ONE gang
         wait_for(lambda: len(bound(cl, "wl7")) == 6, timeout=10.0,
                  desc="one elastic admitted")
-        time.sleep(0.6)
+        settle(0.6)
         assert len(bound(cl, "wl7")) == 6
         assert_no_partial_binds(cl, "wl7")
         scheduled = [g for g in ("wl7-0-x-1", "wl7-0-x-2")
@@ -290,7 +292,7 @@ def test_gs9_pcs_scale_up_while_first_replica_pending():
         set_cordon(cl, all_nodes, True)
         cl.client.create(wl("wl9", sg_replicas=1, sg_min=1))
         wait_for(lambda: len(pods_of(cl, "wl9")) == 4, desc="pods created")
-        time.sleep(0.4)
+        settle(0.4)
         assert bound(cl, "wl9") == []
 
         live = cl.client.get(PodCliqueSet, "wl9")
@@ -298,7 +300,7 @@ def test_gs9_pcs_scale_up_while_first_replica_pending():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl9")) == 8,
                  desc="replica 1 pods created while 0 pending")
-        time.sleep(0.6)
+        settle(0.6)
         assert bound(cl, "wl9") == []
         assert_no_partial_binds(cl, "wl9")
 
@@ -330,7 +332,7 @@ def test_gs10_scale_in_releases_capacity_for_pending_gang():
                     name="w", replicas=2, tpu_chips_per_pod=4,
                     container=ContainerSpec(argv=["x"]))])))
         cl.client.create(late)
-        time.sleep(0.6)
+        settle(0.6)
         assert bound(cl, "late") == [], \
             "late must wait (big's elastic outranks it)"
 
@@ -354,7 +356,7 @@ def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
         cl.client.create(wl("gs8", sg_replicas=1, sg_min=1))
         wait_for(lambda: len(pods_of(cl, "gs8")) == 4,
                  desc="4 pods created, all pending")
-        time.sleep(0.3)
+        settle(0.3)
         assert not bound(cl, "gs8")
 
         # scale the PCSG 1 -> 3 while everything is pending
@@ -363,7 +365,7 @@ def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "gs8")) == 8,
                  desc="scale-out adds 4 more pending pods")
-        time.sleep(0.3)
+        settle(0.3)
         assert not bound(cl, "gs8")
         assert_no_partial_binds(cl, "gs8")
 
@@ -371,7 +373,7 @@ def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
         set_cordon(cl, slice_nodes(cl, 0, 1), False)
         wait_for(lambda: len(bound(cl, "gs8")) == 4,
                  desc="base gang binds first")
-        time.sleep(0.3)
+        settle(0.3)
         assert len(bound(cl, "gs8")) == 4
         assert gang_scheduled(cl, "gs8-0")
         assert_no_partial_binds(cl, "gs8")
@@ -380,7 +382,7 @@ def test_gs8_pcsg_scaled_while_all_pending_then_staged_release():
         set_cordon(cl, slice_nodes(cl, 2), False)
         wait_for(lambda: len(bound(cl, "gs8")) == 6,
                  desc="one scaled gang admitted")
-        time.sleep(0.3)
+        settle(0.3)
         assert len(bound(cl, "gs8")) == 6
         assert_no_partial_binds(cl, "gs8")
 
@@ -405,13 +407,13 @@ def test_gs11_interleaved_pcs_pcsg_scaling_with_floors():
         cl.client.create(wl("wl11", sg_replicas=2, sg_min=1))
         # base (a + x-0) = 4 pods, elastic x-1 = 2 pods — all pending.
         wait_for(lambda: len(pods_of(cl, "wl11")) == 6, desc="created")
-        time.sleep(0.5)
+        settle(0.5)
         assert len(bound(cl, "wl11")) == 0
 
         # 2 slices free → exactly the base gang (the floor) places.
         set_cordon(cl, slice_nodes(cl, 0, 1), False)
         wait_for(lambda: len(bound(cl, "wl11")) == 4, desc="base placed")
-        time.sleep(0.4)
+        settle(0.4)
         assert len(bound(cl, "wl11")) == 4
         assert_no_partial_binds(cl, "wl11")
 
@@ -424,7 +426,7 @@ def test_gs11_interleaved_pcs_pcsg_scaling_with_floors():
         live.spec.template.scaling_groups[0].replicas = 3
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl11")) == 8, desc="x-2 created")
-        time.sleep(0.4)
+        settle(0.4)
         assert len(bound(cl, "wl11")) == 6
         set_cordon(cl, slice_nodes(cl, 3), False)
         wait_for(lambda: len(bound(cl, "wl11")) == 8, desc="x-2 placed")
@@ -435,14 +437,14 @@ def test_gs11_interleaved_pcs_pcsg_scaling_with_floors():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl11")) == 16,
                  desc="replica-1 pods created")
-        time.sleep(0.4)
+        settle(0.4)
         assert len(bound(cl, "wl11")) == 8
 
         # 2 slices free → replica-1's BASE places; elastics still gated.
         set_cordon(cl, slice_nodes(cl, 4, 5), False)
         wait_for(lambda: len(bound(cl, "wl11")) == 12,
                  desc="replica-1 base placed")
-        time.sleep(0.4)
+        settle(0.4)
         assert len(bound(cl, "wl11")) == 12
         assert_no_partial_binds(cl, "wl11")
 
@@ -477,14 +479,14 @@ def test_gs12_scale_everything_while_pending_then_staged_release():
         cl.client.update(live)
         wait_for(lambda: len(pods_of(cl, "wl12")) == 16,
                  desc="all elastic pods created")
-        time.sleep(0.5)
+        settle(0.5)
         assert len(bound(cl, "wl12")) == 0
 
         # 4 slices free → both BASES place (4 pods each), elastics gated.
         set_cordon(cl, slice_nodes(cl, 0, 1, 2, 3), False)
         wait_for(lambda: len(bound(cl, "wl12")) == 8,
                  desc="both bases placed")
-        time.sleep(0.4)
+        settle(0.4)
         assert len(bound(cl, "wl12")) == 8
         assert_no_partial_binds(cl, "wl12")
 

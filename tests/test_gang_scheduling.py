@@ -20,6 +20,8 @@ from test_availability import _ready_pods
 from test_e2e_disagg import disagg_pcs
 from test_e2e_simple import simple_pcs, wait_for
 
+from timing import settle
+
 
 @pytest.fixture
 def small_cluster():
@@ -52,7 +54,7 @@ def test_scaled_gang_pending_never_degrades_base(small_cluster):
     wait_for(lambda: is_condition_true(
         states()["over-0-model-1"].status.conditions, c.COND_SCHEDULED),
         timeout=30.0, desc="first scaled gang placed")
-    time.sleep(0.5)
+    settle(0.5)
     gangs = states()
     assert not is_condition_true(
         gangs["over-0-model-2"].status.conditions, c.COND_SCHEDULED)
@@ -70,7 +72,7 @@ def test_waiting_gang_places_when_capacity_frees(small_cluster):
     wait_for(lambda: len(_ready_pods(client, "a")) == 8, desc="a up (both slices)")
 
     client.create(simple_pcs(name="b", pods=4, chips=4))
-    time.sleep(0.6)
+    settle(0.6)
     assert not any(p.status.node_name for p in client.list(
         Pod, selector={c.LABEL_PCS_NAME: "b"})), "b should be waiting"
 
@@ -148,7 +150,7 @@ def test_no_pointless_preemption(small_cluster):
     wait_for(lambda: len(_ready_pods(client, "a")) == 9, timeout=15.0,
              desc="a up")
     client.create(simple_pcs(name="huge", pods=5, chips=4))  # 20 > 16/slice
-    time.sleep(1.0)
+    settle(1.0)
     assert len(_ready_pods(client, "a")) == 9, "innocent capacity evicted"
     from grove_tpu.runtime.events import events_for
     assert not any(e.reason == "GangPreempted"

@@ -1,0 +1,475 @@
+"""grovelint: every rule must FIRE on a violating fixture and stay
+green on a compliant one — and the repo itself must lint clean.
+
+The PR 8 precedent ("the harness can't rot always-green"): a linter
+whose rules silently stop matching is worse than no linter, because it
+keeps testifying the invariants hold. Each rule therefore gets a
+minimal violating snippet proving the detector still detects, and the
+final test runs the real engine over the real tree so a new violation
+(or a rule broken by a refactor) fails CI either way.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from grove_tpu.analysis.grovelint import (
+    Finding,
+    LintEngine,
+    default_engine,
+    repo_root,
+)
+
+
+def lint(source: str, rel: str) -> list[Finding]:
+    return default_engine().lint_source(textwrap.dedent(source), rel)
+
+
+def rules_of(findings: list[Finding]) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---- hub-under-store-lock ------------------------------------------------
+
+HUB_BAD = """
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS
+
+    class Store:
+        def create(self, obj):
+            with self._locked_write("create"):
+                GLOBAL_METRICS.inc("grove_store_writes_total")
+
+        def _emit_locked(self):
+            GLOBAL_METRICS.set("grove_store_objects", 1.0)
+
+        def helper(self):
+            GLOBAL_METRICS.inc("x")
+
+        def update(self, obj):
+            with self._lock:
+                self.helper()
+"""
+
+HUB_GOOD = """
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS
+    from grove_tpu.store import writeobs
+
+    class Store:
+        def create(self, obj):
+            with self._locked_write("create"):
+                writeobs.note_commit(obj.KIND, "create")
+            GLOBAL_METRICS.inc("grove_store_writes_total")
+
+        def bump(self):
+            with self._lock:
+                epoch = self._epoch
+            GLOBAL_METRICS.set("grove_leadership_epoch", float(epoch))
+"""
+
+
+def test_hub_under_store_lock_fires():
+    findings = lint(HUB_BAD, "grove_tpu/store/store.py")
+    assert rules_of(findings) == {"hub-under-store-lock"}
+    # direct ref under _locked_write, ref inside *_locked fn, one-hop
+    # self-call under the bare lock: all three shapes.
+    assert len(findings) == 3
+
+
+def test_hub_under_store_lock_allows_post_release():
+    assert lint(HUB_GOOD, "grove_tpu/store/store.py") == []
+
+
+def test_hub_rule_scoped_to_store_package():
+    # The same source outside grove_tpu/store/ is not this rule's
+    # business (other modules' locks are the witness's job).
+    assert lint(HUB_BAD, "grove_tpu/runtime/other.py") == []
+
+
+# ---- leader-client-write -------------------------------------------------
+
+LEADER_BAD = """
+    from grove_tpu.store.client import Client
+
+    class Reconciler:
+        def reconcile(self, mgr, obj):
+            mgr.client.update_status(obj)
+
+        def rebuild(self, store):
+            c = Client(store)
+            return c
+
+        def helper(self, obj):
+            self.mgr.client.patch_status(type(obj), obj.meta.name, {})
+"""
+
+LEADER_GOOD = """
+    class Reconciler:
+        def __init__(self, client):
+            self.client = client   # injected: the manager's fenced one
+
+        def reconcile(self, mgr, obj):
+            self.client.update_status(obj)
+            mgr.leader_client.patch_status(type(obj), obj.meta.name, {})
+            got = mgr.client.get(type(obj), obj.meta.name)
+            return got
+"""
+
+
+def test_leader_client_write_fires():
+    findings = lint(LEADER_BAD, "grove_tpu/controllers/podgang.py")
+    assert rules_of(findings) == {"leader-client-write"}
+    assert len(findings) == 3
+
+
+def test_leader_client_write_allows_fenced_paths():
+    assert lint(LEADER_GOOD, "grove_tpu/controllers/podgang.py") == []
+
+
+def test_leader_client_rule_scope():
+    # Manager/cluster wiring code legitimately constructs Clients.
+    assert lint(LEADER_BAD, "grove_tpu/runtime/manager.py") == []
+
+
+# ---- jax-in-telemetry ----------------------------------------------------
+
+JAX_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    def render(x):
+        return jnp.sum(x)
+"""
+
+JAX_GOOD = """
+    def roofline(cfg):
+        import jax.numpy as jnp
+        return jnp.dtype(cfg.dtype).itemsize
+
+    def render(samples):
+        return sum(samples)
+"""
+
+
+def test_jax_in_telemetry_fires():
+    findings = lint(JAX_BAD, "grove_tpu/serving/slo.py")
+    assert rules_of(findings) == {"jax-in-telemetry"}
+    # two module-level imports + one unbracketed use
+    assert len(findings) == 3
+
+
+def test_jax_in_telemetry_allows_local_bracket():
+    assert lint(JAX_GOOD, "grove_tpu/serving/xprof.py") == []
+
+
+def test_jax_rule_only_telemetry_modules():
+    assert lint(JAX_BAD, "grove_tpu/models/llama.py") == []
+
+
+# ---- raw-test-sleep ------------------------------------------------------
+
+SLEEP_BAD = """
+    import time
+
+    def test_something(cluster):
+        time.sleep(0.6)
+        deadline = time.time() + 20
+"""
+
+SLEEP_GOOD = """
+    import time
+    from timing import scaled, settle
+
+    def test_something(cluster):
+        settle(0.6)
+        deadline = time.time() + scaled(20)
+        while time.time() < deadline:
+            time.sleep(0.05)     # poll interval, not a deadline
+"""
+
+
+def test_raw_test_sleep_fires():
+    findings = lint(SLEEP_BAD, "tests/test_x.py")
+    assert rules_of(findings) == {"raw-test-sleep"}
+    assert len(findings) == 2
+
+
+def test_raw_test_sleep_allows_scaled():
+    assert lint(SLEEP_GOOD, "tests/test_x.py") == []
+
+
+def test_raw_test_sleep_only_in_tests():
+    assert lint(SLEEP_BAD, "tools/bench_x.py") == []
+
+
+# ---- thread-join-in-stop -------------------------------------------------
+
+THREAD_BAD = """
+    import threading
+
+    class Runnable:
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def stop(self):
+            self._stop.set()
+"""
+
+THREAD_GOOD = """
+    import threading
+
+    class Runnable:
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def stop(self):
+            self._stop.set()
+            self._halt()
+
+        def _halt(self):
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+"""
+
+
+def test_thread_join_in_stop_fires():
+    findings = lint(THREAD_BAD, "grove_tpu/runtime/thing.py")
+    assert rules_of(findings) == {"thread-join-in-stop"}
+
+
+def test_thread_join_via_helper_ok():
+    assert lint(THREAD_GOOD, "grove_tpu/runtime/thing.py") == []
+
+
+def test_string_or_path_join_does_not_satisfy_thread_rule():
+    """os.path.join / sep.join in stop() must not count as joining the
+    thread — either would permanently blind the rule for the class."""
+    src = """
+        import os
+        import threading
+
+        class Runnable:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def stop(self):
+                self._stop.set()
+                os.path.join(self.dir, "pidfile")
+                ", ".join(["a", "b"])
+    """
+    findings = lint(src, "grove_tpu/runtime/thing.py")
+    assert rules_of(findings) == {"thread-join-in-stop"}
+
+
+def test_thread_rule_ignores_non_runnables():
+    # No stop() method -> not a runnable -> not this rule's contract.
+    src = """
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """
+    assert lint(src, "grove_tpu/runtime/thing.py") == []
+
+
+# ---- clone-before-mutate -------------------------------------------------
+
+CLONE_BAD = """
+    class Reconciler:
+        def reconcile(self, req):
+            for pod in self.client.list(Pod, req.namespace):
+                pod.status.phase = "Running"
+                self.client.update_status(pod)
+"""
+
+CLONE_GOOD = """
+    from grove_tpu.api.serde import clone
+
+    class Reconciler:
+        def reconcile(self, req):
+            for pod in self.client.list(Pod, req.namespace):
+                fresh = clone(pod)
+                fresh.status.phase = "Running"
+                self.client.update_status(fresh)
+"""
+
+
+def test_clone_before_mutate_fires():
+    findings = lint(CLONE_BAD, "grove_tpu/controllers/podclique.py")
+    assert rules_of(findings) == {"clone-before-mutate"}
+
+
+def test_clone_before_mutate_allows_cloned():
+    assert lint(CLONE_GOOD, "grove_tpu/controllers/podclique.py") == []
+
+
+def test_clone_rule_ignores_point_gets():
+    src = """
+        class Reconciler:
+            def reconcile(self, req):
+                obj = self.client.get(Pod, req.name)
+                obj.status.phase = "Running"   # gets clone per call
+    """
+    assert lint(src, "grove_tpu/controllers/podclique.py") == []
+
+
+# ---- pragmas -------------------------------------------------------------
+
+def test_inline_pragma_suppresses_with_justification():
+    src = """
+        import time
+
+        def test_x():
+            time.sleep(0.6)  # grovelint: disable=raw-test-sleep -- negative assertion needs real wall time
+    """
+    assert lint(src, "tests/test_x.py") == []
+
+
+def test_bare_pragma_is_itself_a_finding():
+    # The pragma is assembled at runtime so the repo-wide lint of THIS
+    # file doesn't see a bare pragma on a source line.
+    src = ("import time\n\n"
+           "def test_x():\n"
+           "    time.sleep(0.6)  # grovelint: " + "disable=raw-test-sleep\n")
+    findings = default_engine().lint_source(src, "tests/test_x.py")
+    assert rules_of(findings) == {"pragma-justification"}
+
+
+def test_file_pragma_suppresses_module_wide():
+    src = """
+        # grovelint: disable-file=raw-test-sleep -- timing-calibration module measures real sleeps
+        import time
+
+        def test_x():
+            time.sleep(0.6)
+
+        def test_y():
+            time.sleep(0.9)
+    """
+    assert lint(src, "tests/test_x.py") == []
+
+
+def test_pragma_inside_string_literal_is_not_an_exemption():
+    """Pragmas parse from COMMENT tokens: pragma-looking text inside a
+    string (a lint-test fixture, a docs snippet) must not silently
+    disable rules for the file carrying it."""
+    src = '''
+        import time
+
+        FIXTURE = """
+        # grovelint: disable-file=raw-test-sleep -- this is DATA, not a pragma
+        """
+
+        def test_x():
+            time.sleep(0.6)
+    '''
+    findings = lint(src, "tests/test_x.py")
+    assert rules_of(findings) == {"raw-test-sleep"}
+
+
+def test_pragma_only_disables_named_rule():
+    src = """
+        import time
+        import threading
+
+        def test_x():
+            time.sleep(0.6)  # grovelint: disable=thread-join-in-stop -- wrong rule named
+    """
+    findings = lint(src, "tests/test_x.py")
+    assert "raw-test-sleep" in rules_of(findings)
+
+
+# ---- engine / report / baseline -----------------------------------------
+
+def test_json_report_shape():
+    eng = default_engine()
+    findings = eng.lint_source(textwrap.dedent(SLEEP_BAD), "tests/test_x.py")
+    report = eng.report(findings)
+    assert report["tool"] == "grovelint"
+    assert report["counts"] == {"raw-test-sleep": 2}
+    assert {r["name"] for r in report["rules"]} >= {
+        "hub-under-store-lock", "leader-client-write", "jax-in-telemetry",
+        "raw-test-sleep", "thread-join-in-stop", "clone-before-mutate"}
+    for f in report["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message"}
+
+
+def test_baseline_gates_on_new_findings_only(tmp_path):
+    """The --diff contract: a prior report suppresses known findings;
+    only a NEW one fails the gate."""
+    bad = tmp_path / "tests"
+    bad.mkdir()
+    f = bad / "test_old.py"
+    f.write_text("import time\n\ndef test_a():\n    time.sleep(0.5)\n")
+    base = tmp_path / "baseline.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "grove_tpu.analysis", "tests",
+             "--root", str(tmp_path), *extra],
+            capture_output=True, text=True, cwd=repo_root())
+
+    first = run("--write-baseline", str(base))
+    assert first.returncode == 1, first.stdout + first.stderr
+    # Same tree against its own baseline: clean gate.
+    gated = run("--baseline", str(base))
+    assert gated.returncode == 0, gated.stdout + gated.stderr
+    # A new violation appears: the gate fails and names ONLY it.
+    f2 = bad / "test_new.py"
+    f2.write_text("import time\n\ndef test_b():\n    time.sleep(0.9)\n")
+    regressed = run("--baseline", str(base))
+    assert regressed.returncode == 1
+    assert "test_new.py" in regressed.stdout
+    assert "test_old.py" not in regressed.stdout
+
+
+def test_nonexistent_path_is_exit_2_not_clean(tmp_path):
+    """A typo'd/renamed path in the CI lint line must fail loudly —
+    '0 files, 0 findings, exit 0' is how a gate silently dies."""
+    out = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.analysis", "no_such_dir",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=repo_root())
+    assert out.returncode == 2
+    assert "no such file" in (out.stderr + out.stdout)
+
+
+def test_syntax_error_is_exit_2_not_crash(tmp_path):
+    src_dir = tmp_path / "tests"
+    src_dir.mkdir()
+    (src_dir / "test_broken.py").write_text("def nope(:\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.analysis", "tests",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=repo_root())
+    assert out.returncode == 2
+    assert "syntax error" in out.stderr + out.stdout
+
+
+# ---- the repo itself stays clean ----------------------------------------
+
+def test_repo_lints_clean():
+    """The acceptance gate inside the suite: grovelint over the real
+    tree returns zero findings. A new violation anywhere (or a pragma
+    stripped of its justification) fails here AND in make lint."""
+    eng = default_engine()
+    findings = eng.lint_paths(["grove_tpu", "tests", "tools", "bench.py"],
+                              repo_root())
+    assert eng.parse_errors == []
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_json_mode_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "grove_tpu.analysis", "grove_tpu/analysis",
+         "--json"],
+        capture_output=True, text=True, cwd=repo_root())
+    assert out.returncode == 0, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert report["tool"] == "grovelint"
+    assert report["findings"] == []

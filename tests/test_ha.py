@@ -35,6 +35,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import wait_for
 
+from timing import settle
+
 
 def pcs(name="web", replicas=1, pods=2):
     return PodCliqueSet(
@@ -478,7 +480,7 @@ def test_demote_parks_drops_and_clears_then_repromote():
         live = client.get(PodCliqueSet, "ha")
         live.spec.replicas = 2
         client.update(live)
-        time.sleep(0.3)
+        settle(0.3)
         assert client.get(PodCliqueSet, "ha") \
             .status.available_replicas <= 1
 

@@ -12,6 +12,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import simple_pcs, wait_for
 
+from timing import settle
+
 
 @pytest.fixture
 def cluster():
@@ -154,7 +156,7 @@ def test_unschedulable_event(cluster):
     wait_for(warned, desc="unschedulable event recorded")
     # Rate-limited: repeated passes must not write a new event each tick.
     evs1 = events_for(client, "PodGang", "big-0")
-    time.sleep(0.8)
+    settle(0.8)
     evs2 = events_for(client, "PodGang", "big-0")
     assert len(evs2) == len(evs1) == 1
     assert evs2[0].count - evs1[0].count <= 1

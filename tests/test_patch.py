@@ -17,6 +17,8 @@ from grove_tpu.runtime.errors import ConflictError, ValidationError
 from grove_tpu.store.client import FakeClient
 from grove_tpu.store.patch import apply_patch, json_merge_patch
 
+from timing import scaled
+
 
 def pcs(name="web", replicas=1):
     return PodCliqueSet(
@@ -128,7 +130,7 @@ def test_http_patch_scales_the_gang(server):
     from grove_tpu.api import constants as c
 
     cl.client.create(pcs(name="psvc"))
-    deadline = time.time() + 20
+    deadline = time.time() + scaled(20)
     sel = {c.LABEL_PCS_NAME: "psvc"}
     while time.time() < deadline and \
             len(cl.client.list(Pod, selector=sel)) < 2:

@@ -25,6 +25,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
 
 from test_e2e_simple import wait_for
 
+from timing import scaled
+
 
 def pcs(name="web"):
     return PodCliqueSet(
@@ -480,7 +482,7 @@ def test_kill9_mid_compaction_reconstructs_exact_state(tmp_path):
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     # Let it churn through several compaction cycles, then kill -9.
-    deadline = time.time() + 30
+    deadline = time.time() + scaled(30)
     while time.time() < deadline:
         try:
             with open(manifest) as f:

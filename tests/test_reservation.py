@@ -33,6 +33,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
 from test_e2e_simple import wait_for
 
+from timing import settle
+
 
 def _pcs(name, *, replicas=1, reservations, cliques=None, topology=None):
     cliques = cliques or [PodCliqueTemplate(
@@ -377,7 +379,7 @@ def test_notready_flap_keeps_binding(cluster):
             n.status.ready = False
             client.update_status(n)
     import time
-    time.sleep(0.5)
+    settle(0.5)
     live = client.get(SliceReservation, rsv.meta.name)
     assert live.status.bound_slices == [held], \
         "NotReady flap must not drop the binding"

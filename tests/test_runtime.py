@@ -16,6 +16,8 @@ from grove_tpu.runtime.indextracker import available_indices
 from grove_tpu.runtime.manager import Manager
 from grove_tpu.store import FakeClient
 
+from timing import settle
+
 
 def test_flow_short_circuit():
     calls = []
@@ -39,7 +41,7 @@ def test_expectations():
     # ttl expiry path
     e.expect_deletes("k2", ["u3"])
     assert not e.satisfied("k2")
-    time.sleep(0.25)
+    settle(0.25)
     assert e.satisfied("k2")
 
 
@@ -162,7 +164,7 @@ def test_queue_dedupes_pending():
         for _ in range(5):
             c.enqueue(Request("default", "later"))
         block.set()
-        time.sleep(0.5)
+        settle(0.5)
         assert processed.count(Request("default", "later")) == 1
     finally:
         c.stop()

@@ -24,6 +24,8 @@ from grove_tpu.api import PodCliqueSet
 from grove_tpu.store.persist import StateLockError
 from grove_tpu.store.store import Store
 
+from timing import settle
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -96,7 +98,7 @@ def test_second_writer_refused_and_standby_takes_over(tmp_path):
             names = sorted(o.meta.name for o in s.list(PodCliqueSet))
             print("TOOK-OVER", json.dumps(names))
         """, d)
-        time.sleep(1.0)
+        settle(1.0)
         assert standby.poll() is None, standby.communicate()
 
         # ...the winner dies hard (no cleanup path runs)...
@@ -186,7 +188,7 @@ def test_healthy_holder_not_fenced(tmp_path):
             print("TOOK-OVER")
         """, d, extra_env=lease_env)
         # Several TTLs pass; the healthy holder keeps its lease.
-        time.sleep(3.0)
+        settle(3.0)
         assert holder.poll() is None, holder.communicate()
         assert standby.poll() is None, standby.communicate()
         holder.kill()                 # real death → takeover proceeds

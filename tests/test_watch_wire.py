@@ -25,6 +25,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
 
 from test_e2e_simple import wait_for
 
+from timing import settle
+
 
 def pcs(name, replicas=1):
     return PodCliqueSet(
@@ -146,7 +148,7 @@ def test_http_watch_long_poll(wired):
     t = threading.Thread(target=consume, daemon=True)
     t.start()
     started.wait()
-    time.sleep(0.3)  # let the bootstrap + first long poll settle
+    settle(0.3)  # let the bootstrap + first long poll settle
     cl.client.create(pcs("watched"))
     wait_for(lambda: len(got) >= 1, timeout=10.0, desc="ADDED arrives")
     # Conflict-retried spec edit: the PCS controller writes the object
@@ -199,7 +201,7 @@ def test_resumable_watch_events_recovers_from_gap(wired):
     t0 = threading.Thread(target=lambda: first.append(next(gen)),
                           daemon=True)
     t0.start()
-    time.sleep(0.3)  # let the bootstrap + first long poll settle
+    settle(0.3)  # let the bootstrap + first long poll settle
     cl.client.create(pcs("g0"))
     t0.join(10.0)
     assert not t0.is_alive()

@@ -23,6 +23,8 @@ from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 from test_e2e_simple import simple_pcs, wait_for
 from test_server import _req
 
+from timing import settle
+
 OPERATOR_TOKEN = "wt-operator-token"
 
 
@@ -67,7 +69,7 @@ def test_secret_minted_once_and_cascades(cluster):
     # stable across reconciles (a regenerated token would cut off
     # running pods)
     import time
-    time.sleep(0.5)
+    settle(0.5)
     assert client.get(Secret, "tok-workload-token").data["token"] == token
 
     client.delete(PodCliqueSet, "tok")

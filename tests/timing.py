@@ -10,4 +10,9 @@ GROVE_TEST_TIME_SCALE override).
 
 from __future__ import annotations
 
-from grove_tpu.runtime.timescale import TIME_SCALE, scaled  # noqa: F401
+from grove_tpu.runtime.timescale import (  # noqa: F401
+    SETTLE_SCALE,
+    TIME_SCALE,
+    scaled,
+    settle,
+)

@@ -1,0 +1,150 @@
+"""Lock-order witness smoke: one real deploy under GROVE_LOCKDEP=1.
+
+The dynamic half of the static-analysis gate (docs/design/
+static-analysis.md): brings up the in-process cluster with every
+witnessed lock wrapped (store, metrics hub, deploy/serving observers,
+defrag, standby — standby only when HA is in play), drives a 1-gang
+PodCliqueSet to Available plus a teardown, and then asserts the
+acquisition graph recorded NO cycles and NO blocking-under-lock
+violations. Exercised orders that must stay acyclic:
+
+- every store write flushes its telemetry to the hub AFTER the store
+  lock drops (an edge store→hub here is the PR 6 regression),
+- the deploy observer takes its own lock around event application and
+  reads the store without holding it,
+- the defrag sweep plans against snapshots, never store-lock-in-hand.
+
+Exit 0 and a one-line edge summary on a clean run; exit 1 with the
+violation stacks otherwise.
+
+    python tools/lockdep_smoke.py [--timeout 30] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Must be set before any grove import constructs a lock.
+os.environ["GROVE_LOCKDEP"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    from grove_tpu.runtime.timescale import scaled
+    deadline = time.time() + scaled(timeout)
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="lockdep-smoke")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--json", action="store_true",
+                        help="dump the full acquisition-graph report")
+    args = parser.parse_args(argv)
+
+    from grove_tpu.analysis import lockdep
+    assert lockdep.enabled(), "GROVE_LOCKDEP=1 must be set (it is, above)"
+
+    from grove_tpu.api import PodCliqueSet
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import new_meta
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+    )
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    witness = lockdep.witness()
+    witness.reset()
+
+    cluster = new_cluster(fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="4x4", count=1)]))
+    with cluster:
+        client = cluster.client
+        client.create(PodCliqueSet(
+            meta=new_meta("lockdepsmoke"),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplate(cliques=[PodCliqueTemplate(
+                    name="w", replicas=3, min_available=3,
+                    container=ContainerSpec(argv=["sleep", "inf"]),
+                    tpu_chips_per_pod=4)]))))
+        wait_for(lambda: client.get(PodCliqueSet, "lockdepsmoke")
+                 .status.available_replicas == 1, args.timeout,
+                 "lockdepsmoke available")
+        # Exercise the delete path too: cascade deletion holds the
+        # store lock across the fan-out — historically the likeliest
+        # place for a hub call to sneak under it.
+        client.delete(PodCliqueSet, "lockdepsmoke")
+        wait_for(lambda: not client.list(PodCliqueSet),
+                 args.timeout, "teardown")
+        # A /metrics render takes the hub lock while reading manager
+        # state — the other half of any would-be store/hub cycle.
+        cluster.manager.metrics_text()
+
+    report = witness.report()
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+
+    # Positive control BEFORE judging violations: a de-wired witness
+    # (maybe_wrap dropped from a constructor, env check regressed)
+    # reports a perfect empty graph forever — the PR 8 always-green
+    # failure mode. The deploy above cannot happen without store and
+    # hub acquires, and the deploy observer applied its events.
+    acquires = report["acquires"]
+    for cls in ("store", "hub", "deploy-observer"):
+        if not acquires.get(cls):
+            print(f"lockdep-smoke: witness recorded ZERO '{cls}' "
+                  "acquires across a full deploy — the lock is no "
+                  "longer wrapped (check lockdep.maybe_wrap at its "
+                  "construction site); a blind witness proves nothing",
+                  file=sys.stderr)
+            return 1
+
+    violations = witness.check()
+    if violations:
+        print(f"lockdep-smoke: {len(violations)} violation(s):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+            if v.stack:
+                print("    " + v.stack.replace("\n", "\n    "),
+                      file=sys.stderr)
+        return 1
+
+    edges = report["edges"]
+    # Stricter than "no cycles": the buffer-then-flush discipline says
+    # the hub lock is NEVER taken while the store lock is held, cycle
+    # or not. Two latent store→hub nestings (admission-chain scan
+    # counting, tracer create milestone) shipped for five PRs before
+    # this gate existed; keep the edge itself illegal.
+    nested = [e for e in edges if e["from"] == "store" and e["to"] == "hub"]
+    if nested:
+        print("lockdep-smoke: store->hub acquisition observed "
+              f"({nested[0]['count']}x) — a MetricsHub call is "
+              "reachable under the store lock again; buffer in the "
+              "WriteRecord and flush after release (store/writeobs.py)",
+              file=sys.stderr)
+        return 1
+    shown = ", ".join("{}->{}".format(e["from"], e["to"])
+                      for e in edges) or "none"
+    print(f"lockdep-smoke: OK — {len(edges)} acquisition edge(s), "
+          f"0 cycles, 0 blocking-under-lock ({shown})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
