@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-failover docs native lint clean ci render-deploy chaos-smoke chaos-soak
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-reclaim bench-failover docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 lint:            ## the semantic gate: compile check + grovelint (AST
 	@# invariant rules, docs/design/static-analysis.md) + one
@@ -85,6 +85,15 @@ bench-defrag:    ## defrag-on vs defrag-off churn bench (CPU only)
 	@# exit 1 unless defrag-on strictly beats defrag-off.
 	$(PY) tools/bench_defrag.py --history
 
+bench-reclaim:   ## spot-slice reclaim-to-ready under the disruption contract (CPU only)
+	@# The reclaim controller's proof (docs/design/disruption-contract.md):
+	@# seeded repeated reclamations of the gang's own slice — notice →
+	@# checkpoint barrier → pinned reland on the survivor → Ready —
+	@# with withdrawal + return between rounds. Appends
+	@# reclaim_to_ready_s rows to bench-history/history.jsonl; exit 1
+	@# on any invariant violation or a zero measurement.
+	$(PY) tools/bench_reclaim.py --history
+
 bench-failover:  ## hot-standby vs cold leader takeover at 300 pods (CPU only)
 	@# The HA control plane's proof (docs/design/ha.md): SIGKILL the
 	@# leader mid-300-pod deploy (after a same-size deploy+teardown
@@ -156,6 +165,11 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# hold/drain/rebind -> the stuck gang schedules, the Fragmented
 	@# gauge drops, holds release (docs/design/defrag.md).
 	$(PY) tools/defrag_smoke.py
+	@# reclaim smoke: one of two slices spot-reclaimed under a standing
+	@# PCS -> checkpoint barrier -> pinned reland on the survivor ->
+	@# Ready, invariants green, CLI renders
+	@# (docs/design/disruption-contract.md).
+	$(PY) tools/reclaim_smoke.py
 	@# chaos smoke: 2 fixed-seed mix cycles (>=4 fault types each) with
 	@# the full gang-invariant sweep between cycles — the regression net
 	@# that lets the control plane refactor aggressively (ROADMAP 5).

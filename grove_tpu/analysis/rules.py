@@ -168,7 +168,8 @@ class LeaderClientWrite(Rule):
                    "leader client, never mgr.client / a fresh Client()")
 
     SCOPES = ("grove_tpu/controllers/", "grove_tpu/scheduler/",
-              "grove_tpu/defrag/", "grove_tpu/autoscale.py")
+              "grove_tpu/defrag/", "grove_tpu/disruption/",
+              "grove_tpu/autoscale.py")
     MANAGER_NAMES = {"mgr", "manager"}
 
     def applies(self, mod: ModuleFile) -> bool:
@@ -416,7 +417,8 @@ class CloneBeforeMutate(Rule):
                    "clone() before mutating")
 
     SCOPES = ("grove_tpu/controllers/", "grove_tpu/scheduler/",
-              "grove_tpu/defrag/", "grove_tpu/autoscale.py")
+              "grove_tpu/defrag/", "grove_tpu/disruption/",
+              "grove_tpu/autoscale.py")
     LIST_VERBS = {"list", "list_snapshot"}
     CLONERS = {"clone", "serde_clone", "deepcopy", "replace"}
 

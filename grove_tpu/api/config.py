@@ -160,6 +160,45 @@ class DefragConfig:
 
 
 @dataclasses.dataclass
+class DisruptionConfig:
+    """The disruption contract + spot-slice reclamation
+    (grove_tpu/disruption, docs/design/disruption-contract.md).
+    ``enabled`` gates the reclaim controller runnable (which also
+    drives checkpoint responders for every barrier); the
+    GROVE_DISRUPTION env var (read live, default on) is the incident
+    kill switch for the CONTRACT itself — with it off, every planned
+    eviction proceeds immediately, exactly the pre-contract shape."""
+
+    enabled: bool = True
+    sync_period_seconds: float = 0.25
+    # Checkpoint-barrier deadline: a notice expires (and the eviction
+    # proceeds, stamped barrier=expired) this long after posting unless
+    # the workload acks earlier. Spot reclaim clamps it further to the
+    # node's advertised reclaim-at instant.
+    default_deadline_seconds: float = 8.0
+    # Failed checkpoint acks retry with exponential backoff between
+    # these bounds until the deadline (pre-TIME_SCALE seconds).
+    ack_retry_base_seconds: float = 0.2
+    ack_retry_max_seconds: float = 2.0
+    # Evacuation hold lifecycle (pre-TIME_SCALE seconds) — same roles
+    # as the defrag knobs: reservation TTL backstop, bind wait, and
+    # reland wait before the evacuation degrades gracefully.
+    hold_ttl_seconds: float = 60.0
+    hold_timeout_seconds: float = 5.0
+    # Short enough that a wedged pinned reland degrades (pin released,
+    # self-heal lands the gang wherever capacity exists) well inside
+    # the chaos harness's recovery budgets.
+    rebind_timeout_seconds: float = 20.0
+    # Concurrent gang evacuations (a reclaimed slice usually carries
+    # several gangs and they are all racing the same deadline; defrag's
+    # one-at-a-time pacing would forfeit workloads).
+    max_concurrent_evacuations: int = 4
+    # How many times a TTL-expired (or otherwise lost) hold is re-taken
+    # mid-evacuation before the evacuation proceeds unpinned.
+    rehold_attempts: int = 3
+
+
+@dataclasses.dataclass
 class HAConfig:
     """HA control plane (grove_tpu/ha, proposal 0002): ``enabled``
     wires a LeaderElector runnable so the manager campaigns (epoch
@@ -196,6 +235,8 @@ class OperatorConfiguration:
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
     defrag: DefragConfig = dataclasses.field(default_factory=DefragConfig)
+    disruption: DisruptionConfig = dataclasses.field(
+        default_factory=DisruptionConfig)
     ha: HAConfig = dataclasses.field(default_factory=HAConfig)
     node_lifecycle: NodeLifecycleConfig = dataclasses.field(
         default_factory=NodeLifecycleConfig)
@@ -297,6 +338,19 @@ def validate_config(cfg: OperatorConfiguration) -> list[str]:
         if getattr(cfg.defrag, knob) <= 0:
             errs.append(f"defrag.{knob} must be > 0, got "
                         f"{getattr(cfg.defrag, knob)}")
+    for knob in ("sync_period_seconds", "default_deadline_seconds",
+                 "ack_retry_base_seconds", "ack_retry_max_seconds",
+                 "hold_ttl_seconds", "hold_timeout_seconds",
+                 "rebind_timeout_seconds"):
+        if getattr(cfg.disruption, knob) <= 0:
+            errs.append(f"disruption.{knob} must be > 0, got "
+                        f"{getattr(cfg.disruption, knob)}")
+    if cfg.disruption.max_concurrent_evacuations < 1:
+        errs.append("disruption.max_concurrent_evacuations must be >= 1, "
+                    f"got {cfg.disruption.max_concurrent_evacuations}")
+    if cfg.disruption.rehold_attempts < 0:
+        errs.append("disruption.rehold_attempts must be >= 0, got "
+                    f"{cfg.disruption.rehold_attempts}")
     if cfg.node_lifecycle.grace_seconds <= 0:
         errs.append("node_lifecycle.grace_seconds must be > 0, got "
                     f"{cfg.node_lifecycle.grace_seconds}")

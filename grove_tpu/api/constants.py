@@ -56,6 +56,29 @@ LABEL_HOLD_FOR_GANG = f"{DOMAIN}/hold-for-gang"
 # the gang's pending pods to the reserved hosts, and mirrors the value
 # into PodGang.status.reuse_reservation_ref for the read surfaces.
 ANNOTATION_RESERVATION_REF = f"{DOMAIN}/reuse-reservation-ref"
+# The disruption contract (grove_tpu/disruption, docs/design/
+# disruption-contract.md): a JSON-encoded DisruptionNotice on a PodGang
+# — every PLANNED eviction (defrag migration, rolling update, spot
+# reclaim) posts one and waits for the workload's checkpoint ack (or
+# the deadline) before deleting bound pods. Written only through the
+# CAS helpers in disruption/contract.py; the gang scheduler mirrors it
+# into PodGang.status.disruption + the DisruptionTarget condition.
+ANNOTATION_DISRUPTION_NOTICE = f"{DOMAIN}/disruption-notice"
+# Opt-out of the barrier's auto-ack for OUT-OF-PROCESS workloads: a
+# PodGang carrying this annotation (any non-empty value) declares that
+# something remote checkpoints on its behalf, so a missing in-process
+# responder must NOT auto-ack the notice — the remote workload watches
+# status.disruption / the notice annotation and acks over the wire
+# (disruption.ack_notice works against HttpClient), or the deadline
+# expires and the eviction proceeds stamped barrier=expired.
+ANNOTATION_CHECKPOINT_REQUIRED = f"{DOMAIN}/checkpoint-required"
+# Spot-slice reclamation notice on a Node: absolute unix timestamp
+# after which the host (and its whole slice — GKE spot reclaims slices
+# wholesale) will be withdrawn. Set by the cloud integration or the
+# chaos spot-reclaim injector; surfaced by controllers/nodelifecycle.py
+# (cordon + Warning event) and consumed by the reclaim controller
+# (grove_tpu/disruption/reclaim.py) as the evacuation trigger.
+ANNOTATION_RECLAIM_AT = f"{DOMAIN}/reclaim-at"
 
 # ---- env vars injected into workload pods ----
 ENV_PCS_NAME = "GROVE_PCS_NAME"

@@ -106,6 +106,29 @@ class PlacementDiagnosis:
 
 
 @dataclasses.dataclass
+class DisruptionNotice:
+    """One planned-eviction barrier on a gang (the disruption contract,
+    grove_tpu/disruption): posted by whoever intends to delete the
+    gang's bound pods (defrag migration, rolling update, spot-slice
+    reclaim), acknowledged by the workload once its checkpoint is
+    durable, expiring at ``deadline`` so an unresponsive workload can
+    delay — never veto — the eviction. Lives in the gang's
+    ``ANNOTATION_DISRUPTION_NOTICE`` annotation (single CAS write path,
+    disruption/contract.py); the scheduler mirrors it here and into a
+    ``DisruptionTarget`` condition on every status write."""
+
+    id: str = ""
+    reason: str = ""           # defrag-migration | rolling-update | spot-reclaim
+    requested_at: float = 0.0
+    deadline: float = 0.0      # absolute; eviction proceeds past it
+    acked_at: float = 0.0      # 0 = not (yet) acknowledged
+    ack_source: str = ""       # workload | auto ("" while unacked)
+    evicted_at: float = 0.0    # stamped the moment eviction proceeded
+    barrier: str = ""          # final state at eviction: acked | expired
+    coalesced: int = 0         # later post_notice calls that joined this one
+
+
+@dataclasses.dataclass
 class PodGangStatus:
     phase: PodGangPhase = PodGangPhase.PENDING
     conditions: list[Condition] = dataclasses.field(default_factory=list)
@@ -122,6 +145,11 @@ class PodGangStatus:
     # Placement explainability: present while the gang is unschedulable
     # (scheduler clears it on successful schedule).
     last_diagnosis: PlacementDiagnosis | None = None
+    # Disruption contract: the live notice, mirrored from the
+    # ANNOTATION_DISRUPTION_NOTICE annotation by the scheduler (single
+    # status writer, like reuse_reservation_ref); None when no planned
+    # eviction is in flight.
+    disruption: DisruptionNotice | None = None
 
 
 @dataclasses.dataclass

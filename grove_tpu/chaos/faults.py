@@ -223,6 +223,137 @@ class NodeDeleteFault(Fault):
         self._deleted.clear()
 
 
+class SpotReclaimFault(Fault):
+    """A slice's spot capacity is reclaimed (GKE spot: the nodes vanish
+    *together*, with advance notice): every node of a victim slice gets
+    the ``ANNOTATION_RECLAIM_AT`` stamp through the public API — the
+    node-lifecycle controller cordons them, the reclaim controller
+    (grove_tpu/disruption) evacuates their gangs behind the checkpoint
+    barrier. Heal is the reclamation actually happening followed by
+    spot capacity returning: the noticed nodes are deleted and
+    identical fresh ones re-register."""
+
+    name = "spot-reclaim"
+
+    def __init__(self, notice_window_s: float = 6.0) -> None:
+        self.notice_window_s = notice_window_s
+        # (name, generation, topology, slice, worker, pool)
+        self._noticed: list[tuple[str, str, str, str, int, str]] = []
+
+    def _notice_slice(self, ctx: ChaosContext, victim: str,
+                      deadline: float) -> int:
+        stamped = 0
+        for n in ctx.nodes_of_slice(victim):
+            gen = n.meta.labels.get(
+                c.NODE_LABEL_TPU_ACCELERATOR, "tpu-v5e").removeprefix("tpu-")
+            try:
+                ctx.client.patch(Node, n.meta.name, {
+                    "metadata": {"annotations": {
+                        c.ANNOTATION_RECLAIM_AT: str(deadline)}}},
+                    namespace=n.meta.namespace)
+            except (NotFoundError, GroveError) as e:
+                ctx.log.warning("reclaim notice on %s failed: %s",
+                                n.meta.name, e)
+                continue
+            self._noticed.append((
+                n.meta.name, gen,
+                n.meta.labels.get(c.NODE_LABEL_TPU_TOPOLOGY, "2x2"),
+                victim, int(n.meta.labels.get(c.NODE_LABEL_SLICE_WORKER, 0)),
+                n.meta.labels.get(c.NODE_LABEL_POOL, "pool-0")))
+            stamped += 1
+        return stamped
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        from grove_tpu.runtime.timescale import scaled
+        slices = ctx.slices()
+        if len(slices) < 2:
+            return False  # a reclaim with no survivors is just node loss
+        victim = ctx.rng.choice(slices)
+        deadline = time.time() + scaled(self.notice_window_s)
+        if not self._notice_slice(ctx, victim, deadline):
+            return False
+        ctx.log.info("chaos: slice %s spot-reclaim noticed "
+                     "(withdraws in %.1fs)", victim,
+                     deadline - time.time())
+        return True
+
+    def heal(self, ctx: ChaosContext) -> None:
+        """The withdrawal, then the return: noticed nodes vanish (the
+        reclamation really happens — mid-evacuation if the barrier or
+        reland is still running, exactly the race the controller must
+        survive), then identical fresh nodes re-register notice-free."""
+        from grove_tpu.topology.fleet import build_node
+        for name, *_ in self._noticed:
+            try:
+                ctx.client.delete(Node, name, ctx.namespace)
+            except (NotFoundError, GroveError):
+                continue
+        for _name, gen, topo, slice_name, worker, pool in self._noticed:
+            fresh = build_node(gen, topo, slice_name, worker, pool=pool,
+                               namespace=ctx.namespace)
+            try:
+                ctx.client.create(fresh)
+            except GroveError:
+                continue  # already re-registered
+        self._noticed.clear()
+
+
+class DisruptionStormFault(Fault):
+    """Overlapping planned disruptions — the coalescing stress: spot
+    reclaim notices on MULTIPLE slices (staggered deadlines) while a
+    rolling update churns the standing workload, so reclaim and
+    rolling-update barriers land on the same gangs in the same window
+    and the per-gang notice must coalesce instead of thrashing. Heal
+    withdraws and re-registers the noticed capacity."""
+
+    name = "disruption-storm"
+
+    def __init__(self, notice_window_s: float = 6.0) -> None:
+        self.notice_window_s = notice_window_s
+        self._reclaim = SpotReclaimFault(notice_window_s)
+
+    def inject(self, ctx: ChaosContext) -> bool:
+        from grove_tpu.runtime.timescale import scaled
+        slices = ctx.slices()
+        if len(slices) < 3:
+            return False  # storm needs >=2 victims and a survivor
+        victims = ctx.rng.sample(slices, k=min(2, len(slices) - 1))
+        fired = 0
+        for i, victim in enumerate(victims):
+            deadline = time.time() + scaled(
+                self.notice_window_s + i * 0.5)
+            fired += self._reclaim._notice_slice(ctx, victim, deadline)
+        if not fired:
+            return False
+        self._roll_workload(ctx)
+        ctx.log.info("chaos: disruption storm — %d slice(s) reclaim-"
+                     "noticed + rolling update", len(victims))
+        return True
+
+    def _roll_workload(self, ctx: ChaosContext) -> None:
+        """Template edit through the public API (the same surface a
+        user deploy takes): a roll mid-reclaim makes both barrier
+        callers coalesce on the standing gangs."""
+        if not ctx.workload_pcs:
+            return
+        for _ in range(5):
+            try:
+                pcs = ctx.client.get(PodCliqueSet, ctx.workload_pcs,
+                                     ctx.namespace)
+                for t in pcs.spec.template.cliques:
+                    t.container.env["CHAOS_DISRUPTION_STORM"] = str(
+                        ctx.rng.randrange(1 << 30))
+                ctx.client.update(pcs)
+                return
+            except NotFoundError:
+                return
+            except GroveError:
+                time.sleep(0.05)   # conflict: re-read and retry
+
+    def heal(self, ctx: ChaosContext) -> None:
+        self._reclaim.heal(ctx)
+
+
 class PreemptionStormFault(Fault):
     """A burst of high-priority single-slice gangs lands on a full
     fleet: the gang scheduler preempts the workload's elastic scaled
@@ -424,6 +555,7 @@ class LeaderKillFault(Fault):
 # name -> factory; the scenario runner samples these from its seed.
 FAULT_REGISTRY: dict[str, type[Fault]] = {
     f.name: f for f in (NodeHeartbeatLossFault, NodeDeleteFault,
+                        SpotReclaimFault, DisruptionStormFault,
                         PreemptionStormFault, WatchGapFault,
                         AutoscaleFlapFault, AgentKillFault,
                         LeaderKillFault)

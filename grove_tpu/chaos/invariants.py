@@ -26,6 +26,11 @@ The invariants (ISSUE 8 / reference GS1-GS10 analog):
 - **defrag-holds**     no dangling capacity hold: every defrag/roll
                        SliceReservation names a live gang that still
                        references it (leaked holds fence slices)
+- **disruption-contract** every planned eviction honored the barrier:
+                       an evicted gang's DisruptionNotice reads acked
+                       or expired (never pending/absent), and a gang
+                       wearing DisruptionTarget=True still carries its
+                       notice (grove_tpu/disruption)
 - **ttr-stability**    time-to-ready p99 stays within a drift factor
                        of the first cycle's (no degradation across
                        cycles — the soak signal)
@@ -356,6 +361,42 @@ class InvariantChecker:
 
         return _poll_until_empty(probe, self.owner_deadline)
 
+    def check_disruption_contract(self) -> list[Violation]:
+        """The planned-eviction audit (grove_tpu/disruption): a gang
+        whose notice was stamped evicted must show barrier acked or
+        expired — an eviction that proceeded while the barrier still
+        read pending (or with no notice behind a DisruptionTarget
+        condition) broke the one promise the contract makes. Both
+        directions get the usual settling grace: the condition mirror
+        rides scheduler status writes and can lag a just-cleared
+        notice."""
+        from grove_tpu.disruption.contract import notice_of
+
+        def probe() -> list[Violation]:
+            out: list[Violation] = []
+            for gang in self.client.list(PodGang, self.namespace):
+                if gang.meta.deletion_timestamp is not None:
+                    continue
+                key = f"PodGang {gang.meta.namespace}/{gang.meta.name}"
+                notice = notice_of(gang)
+                if notice is not None and notice.evicted_at \
+                        and notice.barrier not in ("acked", "expired"):
+                    out.append(Violation(
+                        "disruption-contract", key,
+                        f"evicted under notice {notice.id} with barrier "
+                        f"{notice.barrier!r} — the eviction proceeded "
+                        "without an ack or a deadline expiry"))
+                if notice is None and is_condition_true(
+                        gang.status.conditions, c.COND_DISRUPTION_TARGET):
+                    out.append(Violation(
+                        "disruption-contract", key,
+                        "DisruptionTarget=True but the disruption-notice "
+                        "annotation is absent — a barrier vanished "
+                        "mid-flight"))
+            return out
+
+        return _poll_until_empty(probe, self.owner_deadline)
+
     def check_wire_convergence(
             self, wire_informers: dict | None) -> list[Violation]:
         """After watch-gap injection the wire informers must hold
@@ -448,6 +489,7 @@ class InvariantChecker:
         out += self.check_no_duplicates()
         out += self.check_pending_diagnosis()
         out += self.check_defrag_holds()
+        out += self.check_disruption_contract()
         out += self.check_gauge_consistency()
         out += self.check_wire_convergence(wire_informers)
         out += self.check_lock_order()

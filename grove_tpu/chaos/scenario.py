@@ -63,6 +63,14 @@ POOL = TopologyConstraint(pack_level="pool", required=True)
 SCENARIOS: dict[str, list[str]] = {
     "node-flap": ["node-heartbeat-loss", "node-delete"],
     "preemption-storm": ["preemption-storm"],
+    # Spot-slice reclamation (grove_tpu/disruption): a slice is
+    # reclaim-noticed mid-cycle, its gangs evacuate behind the
+    # checkpoint barrier, heal withdraws + re-registers the capacity;
+    # the disruption-contract invariant audits every eviction's barrier.
+    "spot-reclaim": ["spot-reclaim"],
+    # Overlapping planned disruptions: multi-slice reclaim notices plus
+    # a rolling update in one window — barrier coalescing under stress.
+    "disruption-storm": ["disruption-storm"],
     "watch-gap": ["watch-gap"],
     "autoscale-flap": ["autoscale-flap"],
     "agent-restart": ["agent-kill"],

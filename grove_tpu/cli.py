@@ -777,6 +777,26 @@ def cmd_defrag_status(args: argparse.Namespace) -> int:
     return 0 if data.get("enabled") else 1
 
 
+def cmd_disruptions(args: argparse.Namespace) -> int:
+    """Render the serve daemon's disruption-contract ledger: every live
+    DisruptionNotice (reason, barrier state, deadline), in-flight and
+    recent spot-reclaim evacuations, and the notice/ack/expiry
+    counters — the planned-eviction companion to `grovectl
+    defrag-status` (that shows placement repair; this shows the
+    checkpoint barriers every planned eviction waits behind,
+    docs/design/disruption-contract.md). Exit 0 while the contract is
+    enabled, 1 when GROVE_DISRUPTION=0 (scripts can alert on a
+    forgotten kill switch)."""
+    from grove_tpu.disruption.reclaim import render_disruptions
+    status, data = _http(args.server, "/debug/disruption", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    for line in render_disruptions(data, time.time()):
+        print(line)
+    return 0 if data.get("contract_enabled") else 1
+
+
 def cmd_leader_status(args: argparse.Namespace) -> int:
     """Render a replica's leadership view (GET /debug/leadership):
     role, fencing epoch (this replica's claim AND the store's — a
@@ -1378,6 +1398,16 @@ def main(argv: list[str] | None = None) -> int:
     dfs.add_argument("--server", default=default_server)
     add_ca(dfs)
     dfs.set_defaults(fn=cmd_defrag_status)
+
+    dis = sub.add_parser(
+        "disruptions",
+        help="disruption-contract ledger from a serve daemon: live "
+             "eviction notices with barrier state, in-flight/recent "
+             "spot-reclaim evacuations (exit 1 when the contract is "
+             "disabled)")
+    dis.add_argument("--server", default=default_server)
+    add_ca(dis)
+    dis.set_defaults(fn=cmd_disruptions)
 
     ls = sub.add_parser(
         "leader-status",

@@ -118,8 +118,20 @@ def new_cluster(config: OperatorConfiguration | None = None,
         # diagnoses and migrates gangs to consolidate fragmented free
         # capacity; GROVE_DEFRAG=0 no-ops every sweep without rewiring.
         from grove_tpu.defrag import DefragController
-        mgr.add_runnable(DefragController(mgr.leader_client, mgr.store,
-                                          mgr.config.defrag))
+        mgr.add_runnable(DefragController(
+            mgr.leader_client, mgr.store, mgr.config.defrag,
+            disruption_deadline_s=mgr.config.disruption
+            .default_deadline_seconds,
+            barriers_enabled=mgr.config.disruption.enabled))
+    if mgr.config.disruption.enabled:
+        # Spot-slice reclamation + disruption-contract coordination
+        # (ROADMAP items 3/5): evacuates gangs off reclaim-noticed
+        # capacity behind the checkpoint barrier and drives registered
+        # checkpoint responders for every planned eviction's notice.
+        # GROVE_DISRUPTION=0 strips the barriers without rewiring.
+        from grove_tpu.disruption.reclaim import ReclaimController
+        mgr.add_runnable(ReclaimController(mgr.leader_client, mgr.store,
+                                           mgr.config.disruption))
     if mgr.config.ha.enabled:
         # HA leadership (grove_tpu/ha): the elector campaigns at
         # manager start — epoch bump, writer fencing, /debug/leadership

@@ -19,6 +19,15 @@ analog that closes the loop for host loss:
 Nodes that have never heartbeated (``heartbeat_time == 0``) are exempt:
 in-process fleets publish status at creation and have no agent to beat.
 Recovery is owned by the agent — its next heartbeat sets ready=True.
+
+This controller also SURFACES spot-slice reclamation notices
+(``ANNOTATION_RECLAIM_AT`` — the GKE spot termination-notice analog,
+stamped by the cloud integration or the chaos injector): a noticed node
+is cordoned (``spec.unschedulable``) the moment the notice appears so
+nothing new lands on dying capacity, with a Warning event naming the
+withdrawal instant. The coordinated response — checkpoint barrier,
+pinned reland on surviving capacity — is the reclaim controller's job
+(grove_tpu/disruption/reclaim.py, docs/design/disruption-contract.md).
 """
 
 from __future__ import annotations
@@ -81,6 +90,10 @@ class NodeLifecycleController:
         now = time.time()
         nodes = self.client.list(Node, self.namespace)
         for node in nodes:
+            if node.meta.annotations.get(c.ANNOTATION_RECLAIM_AT) \
+                    and not node.spec.unschedulable:
+                self._cordon_reclaimed(node)
+        for node in nodes:
             if node.spec.fake or node.status.heartbeat_time <= 0:
                 continue
             stale = now - node.status.heartbeat_time > self.grace_seconds
@@ -136,6 +149,32 @@ class NodeLifecycleController:
                                  pod.meta.name, pod.status.node_name)
             except (NotFoundError, GroveError):
                 continue
+
+    def _cordon_reclaimed(self, node: Node) -> None:
+        """Spot-reclamation notice surfaced: cordon the node so no new
+        placement lands on capacity that is about to vanish (listed
+        objects are shared — re-get before mutating)."""
+        try:
+            live = self.client.get(Node, node.meta.name,
+                                   node.meta.namespace)
+            stamp = live.meta.annotations.get(c.ANNOTATION_RECLAIM_AT)
+            if not stamp or live.spec.unschedulable:
+                return  # raced the injector's heal or another pass
+            live.spec.unschedulable = True
+            self.client.update(live)
+        except (NotFoundError, GroveError):
+            return  # next pass re-evaluates
+        try:
+            left = float(stamp) - time.time()
+            when = f"in {left:.1f}s" if left > 0 else "imminently"
+        except ValueError:
+            when = f"at {stamp!r}"
+        self.log.warning("node %s: spot reclamation noticed (withdraws "
+                         "%s); cordoned", node.meta.name, when)
+        self.recorder.event(node, "Warning", "SpotReclaimNoticed",
+                            f"spot reclamation notice: capacity "
+                            f"withdraws {when}; cordoned — the reclaim "
+                            "controller evacuates its gangs")
 
     def _mark_lost(self, node: Node, now: float) -> None:
         age = now - node.status.heartbeat_time

@@ -49,9 +49,23 @@ class PodCliqueReconciler:
     CRASH_BACKOFF_MAX = 30.0
     CRASH_RESET_AFTER = 60.0
 
-    def __init__(self, client: Client, scheduler_registry: Registry):
+    def __init__(self, client: Client, scheduler_registry: Registry,
+                 disruption_deadline_s: float | None = None,
+                 barriers_enabled: bool = True):
         self.client = client
         self.schedulers = scheduler_registry
+        # Checkpoint-barrier wiring for the roll path (the operator's
+        # disruption config, threaded by register.py; dataclass default
+        # when constructed bare in tests). barriers_enabled mirrors
+        # disruption.enabled: with the coordinator runnable off,
+        # posting notices would stall responder-registered gangs to
+        # expiry on every roll — config-off means contract-off here.
+        if disruption_deadline_s is None:
+            from grove_tpu.api.config import DisruptionConfig
+            disruption_deadline_s = \
+                DisruptionConfig().default_deadline_seconds
+        self._disruption_deadline_s = disruption_deadline_s
+        self._barriers_enabled = barriers_enabled
         # Named store => grove_expectations_pending{controller="podclique"}
         # gauge; TTL expiry (a watch event was lost — the double-create
         # hazard's precursor, SURVEY.md §7) surfaces as a Warning event
@@ -235,8 +249,10 @@ class PodCliqueReconciler:
         if not stale:
             # Roll complete for this clique: release the roll-safe slot
             # hold once the gang is whole again (cache-read cheap; a
-            # sibling clique still rolling re-takes its own hold).
+            # sibling clique still rolling re-takes its own hold), and
+            # clear the gang's rolling-update disruption notice with it.
             self._release_roll_hold(pclq, pods)
+            self._clear_roll_notice(pclq)
             return None
         # PCS-sequenced rollout: only the currently selected replica rolls
         # (one replica at a time across the set, like the reference's
@@ -287,6 +303,15 @@ class PodCliqueReconciler:
         ready_count = sum(1 for p in pods if ready(p))
         if ready_count < pclq.spec.min_available:
             return StepResult.requeue(0.2)
+
+        # The disruption contract: taking down a READY pod is a planned
+        # eviction, so it waits behind the gang's checkpoint barrier
+        # (one protocol shared with defrag migrations and spot reclaim,
+        # grove_tpu/disruption). GROVE_DISRUPTION=0 restores the
+        # pre-contract immediate deletion exactly.
+        barrier_wait = self._roll_barrier(pclq)
+        if barrier_wait is not None:
+            return barrier_wait
 
         victim = min(stale, key=lambda p: p.meta.creation_timestamp or 0.0)
         self.log.info("%s: rolling pod %s -> hash %s (%d stale left)",
@@ -365,6 +390,73 @@ class PodCliqueReconciler:
         if rsv.status.phase != ReservationPhase.BOUND:
             return StepResult.requeue(0.05)
         return None
+
+    def _roll_barrier(self, pclq: PodClique) -> StepResult | None:
+        """Post the gang's rolling-update DisruptionNotice and wait for
+        ack/deadline before a ready victim goes down. Returns a requeue
+        while the barrier is pending, None to proceed (the verdict —
+        acked|expired — is stamped onto the notice at that moment).
+        Each ready victim re-arms the barrier (the workload's state
+        moved between victims, so it re-checkpoints per eviction) —
+        but a PENDING barrier is only READ on re-entry, never
+        re-posted: polling through post_notice would CAS a coalesce
+        write onto the gang every 0.1s requeue."""
+        from grove_tpu.disruption import REASON_ROLLING, barrier_state, \
+            disruption_enabled, note_evicted, notice_of, request_barrier
+        if not self._barriers_enabled or not disruption_enabled():
+            return None     # pre-contract: delete immediately
+        gang = self._gang_shared(self._gang_name(pclq),
+                                 pclq.meta.namespace)
+        if gang is None or not gang.status.assigned_slice:
+            return None     # nothing placed: deletion disrupts nothing
+        notice = notice_of(gang)
+        if notice is not None and not notice.evicted_at:
+            state = barrier_state(notice)   # read-only poll path
+        else:
+            state, notice = request_barrier(
+                self.client, gang.meta.name, pclq.meta.namespace,
+                REASON_ROLLING, self._disruption_deadline_s)
+            if state == "retry":
+                # The notice write lost every CAS round: not a license
+                # to delete — try again shortly.
+                return StepResult.requeue(0.1)
+            if state in ("disabled", "gone"):
+                return None
+        if state == "pending":
+            return StepResult.requeue(0.1)
+        if notice is not None and not notice.evicted_at:
+            # First victim under this notice: freeze the verdict
+            # (repeat calls are id-CAS'd no-ops).
+            note_evicted(self.client, gang.meta.name,
+                         pclq.meta.namespace, notice.id)
+        return None
+
+    def _clear_roll_notice(self, pclq: PodClique) -> None:
+        """Drop the gang's rolling-update notice once the WHOLE gang is
+        back on nodes (the roll hold's wholeness rule: per-gang notice,
+        cliques roll one at a time). Only rolling-update notices are
+        touched — a defrag or reclaim barrier on the same gang belongs
+        to its own executor."""
+        from grove_tpu.disruption import REASON_ROLLING, clear_notice
+        from grove_tpu.disruption.contract import notice_of
+        gang = self._gang_shared(self._gang_name(pclq),
+                                 pclq.meta.namespace)
+        if gang is None:
+            return
+        notice = notice_of(gang)
+        if notice is None or notice.reason != REASON_ROLLING:
+            return
+        expected = [pn for grp in gang.spec.groups for pn in grp.pod_names]
+        gang_pods = {p.meta.name: p for p in self.client.list(
+            Pod, pclq.meta.namespace,
+            selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+            if p.meta.deletion_timestamp is None}
+        if not expected or any(pn not in gang_pods
+                               or not gang_pods[pn].status.node_name
+                               for pn in expected):
+            return                        # a sibling clique still rolls
+        clear_notice(self.client, gang.meta.name, pclq.meta.namespace,
+                     notice.id)
 
     def _release_roll_hold(self, pclq: PodClique, pods: list[Pod]) -> None:
         """Drop the gang's roll hold once the WHOLE gang is back on

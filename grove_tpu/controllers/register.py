@@ -59,7 +59,10 @@ def register_controllers(mgr: Manager) -> Registry:
                       "Service"], _label_requests(c.LABEL_PCS_NAME))
     mgr.add_controller(pcs_ctrl)
 
-    pclq = PodCliqueReconciler(client, registry)
+    pclq = PodCliqueReconciler(
+        client, registry,
+        disruption_deadline_s=cfg.disruption.default_deadline_seconds,
+        barriers_enabled=cfg.disruption.enabled)
     pclq_ctrl = Controller("podclique", client, pclq.reconcile,
                            workers=cfg.concurrency.podclique,
                            backoff_base=cfg.requeue_base_seconds,

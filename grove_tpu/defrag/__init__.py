@@ -93,6 +93,25 @@ def set_reservation_ref(client, gang_name: str, namespace: str,
     return False
 
 
+def release_hold(client, gang_name: str, namespace: str,
+                 reservation: str) -> None:
+    """The one hold-release contract, shared by every hold owner
+    (defrag executor, roll path, reclaim evacuations): clear the gang's
+    reuse-reservation-ref FIRST — the scheduler must stop pinning the
+    gang before the fence drops — CAS'd so another writer's live
+    pointer is never clobbered, then delete the reservation."""
+    from grove_tpu.api import SliceReservation
+    from grove_tpu.runtime.errors import GroveError, NotFoundError
+    if not reservation:
+        return
+    set_reservation_ref(client, gang_name, namespace, "",
+                        expect=(reservation,))
+    try:
+        client.delete(SliceReservation, reservation, namespace)
+    except (NotFoundError, GroveError):
+        pass
+
+
 from grove_tpu.defrag.planner import (  # noqa: E402
     DEFRAG_REASONS,
     MigrationPlan,
@@ -112,6 +131,7 @@ __all__ = [
     "defrag_for",
     "migration_hold_name",
     "propose_plans",
+    "release_hold",
     "roll_hold_name",
     "set_reservation_ref",
 ]

@@ -12,9 +12,13 @@ migration and executes ONE at a time:
    are fenced for the migrating gang and the scheduler will pin its
    reland there (``GangBackend._gang_hold``).
 2. **Drain**: once the hold is BOUND (and the pending gang still needs
-   it), delete the victim's pods gang-atomically. Its PodCliques
-   recreate them gated; gates lift when the gang is whole again —
-   exactly the preemption-eviction flow.
+   it) AND the victim's disruption barrier resolved — the migration is
+   a *planned* eviction, so it posts a ``DisruptionNotice`` at hold
+   time and waits for the workload's checkpoint ack or the deadline
+   (grove_tpu/disruption, one contract shared with the rolling-update
+   and spot-reclaim paths) — delete the victim's pods gang-atomically.
+   Its PodCliques recreate them gated; gates lift when the gang is
+   whole again — exactly the preemption-eviction flow.
 3. **Rebind**: wait for the victim to reland fully on the target slice,
    then release (annotation first — the scheduler must stop pinning
    before the fence drops — then the reservation) and poke the explain
@@ -68,7 +72,8 @@ class _Migration:
     """One in-flight plan's execution state."""
 
     __slots__ = ("plan", "state", "reservation", "started_at",
-                 "drained_at", "finished_at", "outcome")
+                 "drained_at", "finished_at", "outcome", "notice_id",
+                 "barrier")
 
     def __init__(self, plan: MigrationPlan, reservation: str) -> None:
         self.plan = plan
@@ -78,6 +83,8 @@ class _Migration:
         self.drained_at: float | None = None
         self.finished_at: float | None = None
         self.outcome = ""               # executed | aborted:<reason>
+        self.notice_id = ""             # disruption-contract barrier
+        self.barrier = ""               # verdict stamped at drain
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -88,6 +95,8 @@ class _Migration:
             "started_at": self.started_at,
             "drained_at": self.drained_at,
             "finished_at": self.finished_at,
+            "notice_id": self.notice_id,
+            "barrier": self.barrier,
             "plan": dataclasses.asdict(self.plan),
         }
 
@@ -145,10 +154,25 @@ class DefragController:
     RECENT_CAPACITY = 32
 
     def __init__(self, client: Client, store,
-                 config: DefragConfig | None = None) -> None:
+                 config: DefragConfig | None = None,
+                 disruption_deadline_s: float | None = None,
+                 barriers_enabled: bool = True) -> None:
         self.client = client
         self.store = store
         self.cfg = config or DefragConfig()
+        # Checkpoint-barrier wiring for the drain: the operator's
+        # disruption.default_deadline_seconds (threaded by cluster.py;
+        # the dataclass default when constructed bare in tests), and
+        # whether barriers apply AT ALL — disruption.enabled=False
+        # removes the ack coordinator, so posting notices without it
+        # would stall responder-registered gangs to expiry on every
+        # migration: config-off means contract-off here too.
+        if disruption_deadline_s is None:
+            from grove_tpu.api.config import DisruptionConfig
+            disruption_deadline_s = \
+                DisruptionConfig().default_deadline_seconds
+        self._disruption_deadline_s = disruption_deadline_s
+        self._barriers_enabled = barriers_enabled
         self.log = get_logger("defrag")
         self.recorder = EventRecorder(client, "defrag")
         self._stop = threading.Event()
@@ -284,8 +308,14 @@ class DefragController:
                              "another hold claimed it)", plan.victim_gang)
             self._delete_reservation(name, ns)
             return
+        m = _Migration(plan, name)
+        # The disruption contract: declare the planned eviction NOW so
+        # the workload's checkpoint runs in parallel with the hold
+        # binding (one barrier protocol for defrag, rolls, and spot
+        # reclaim — docs/design/disruption-contract.md).
+        self._post_barrier(m)
         with self._lock:
-            self._active = _Migration(plan, name)
+            self._active = m
         self._last_start = time.monotonic()
         self.counters["proposed"] += 1
         GLOBAL_METRICS.inc("grove_defrag_plans_proposed_total")
@@ -299,6 +329,26 @@ class DefragController:
                     f"{plan.source_slices} to {plan.target_slice} to "
                     f"unwedge gang {plan.pending_gang} "
                     f"(chips-freed-per-pod {plan.score:.1f})")
+
+    def _post_barrier(self, m: _Migration) -> bool:
+        """Post (or re-post after write contention) the migration's
+        disruption notice. True once the barrier question is settled
+        (notice posted, or contract disabled / victim gone); False
+        means a contended write — retry next sweep, never drain."""
+        from grove_tpu.disruption import REASON_DEFRAG, request_barrier
+        if not self._barriers_enabled:
+            m.barrier = "disabled"
+            return True
+        state, notice = request_barrier(
+            self.client, m.plan.victim_gang, m.plan.victim_namespace,
+            REASON_DEFRAG, self._disruption_deadline_s)
+        if notice is not None:
+            m.notice_id = notice.id
+            return True
+        if state in ("disabled", "gone"):
+            m.barrier = "disabled"
+            return True
+        return False            # "retry": contended annotation
 
     def _advance(self, m: _Migration) -> None:
         plan = m.plan
@@ -319,6 +369,23 @@ class DefragController:
                 if not self._pending_still_needs(plan):
                     self._abort(m, "superseded")
                     return
+                if not m.notice_id and m.barrier != "disabled":
+                    # The initial post lost every CAS round (contended
+                    # annotation): re-post — write contention must
+                    # never silently strip the barrier and drain an
+                    # un-noticed gang while the contract is enabled.
+                    if not self._post_barrier(m):
+                        return
+                if m.notice_id:
+                    # The checkpoint barrier: drain only once the
+                    # victim acked (or the deadline expired — the
+                    # workload delays, never vetoes). The notice
+                    # self-expires, so this wait is bounded.
+                    from grove_tpu.disruption import barrier_state, \
+                        notice_of
+                    state = barrier_state(notice_of(gang))
+                    if state == "pending":
+                        return
                 self._drain(m, gang)
                 return
             if time.time() - m.started_at > \
@@ -348,8 +415,16 @@ class DefragController:
         """Gang-atomic eviction: every victim pod deleted in one round —
         the PodCliques recreate them gated, so mid-migration the gang
         only ever has FEWER pods bound than before, never a second live
-        copy (the chaos no-duplicates/gang-binding invariants hold)."""
+        copy (the chaos no-duplicates/gang-binding invariants hold).
+        The barrier verdict is stamped onto the notice FIRST — the
+        disruption-contract invariant's audit record."""
         plan = m.plan
+        if m.notice_id:
+            from grove_tpu.disruption import note_evicted
+            stamped = note_evicted(self.client, plan.victim_gang,
+                                   plan.victim_namespace, m.notice_id)
+            if stamped:
+                m.barrier = stamped
         pods = self.client.list(
             Pod, plan.victim_namespace,
             selector={c.LABEL_PODGANG_NAME: plan.victim_gang})
@@ -441,15 +516,17 @@ class DefragController:
                     f"({reason}); hold released")
 
     def _release(self, m: _Migration) -> None:
-        """Annotation FIRST (the scheduler must stop pinning the gang to
-        the reservation before the fence vanishes), then the hold. CAS:
-        the annotation is only cleared while it still names THIS
-        migration's reservation — another writer (a roll-safe hold taken
-        after an abort raced us) must not lose its pointer."""
-        set_reservation_ref(self.client, m.plan.victim_gang,
-                            m.plan.victim_namespace, "",
-                            expect=(m.reservation,))
-        self._delete_reservation(m.reservation, m.plan.victim_namespace)
+        """The shared annotation-first release contract
+        (defrag.release_hold). The disruption notice goes with it
+        (id-CAS'd the same way) so the gang does not keep wearing a
+        phantom barrier."""
+        from grove_tpu.defrag import release_hold
+        release_hold(self.client, m.plan.victim_gang,
+                     m.plan.victim_namespace, m.reservation)
+        if m.notice_id:
+            from grove_tpu.disruption import clear_notice
+            clear_notice(self.client, m.plan.victim_gang,
+                         m.plan.victim_namespace, m.notice_id)
 
     def _delete_reservation(self, name: str, namespace: str) -> None:
         try:

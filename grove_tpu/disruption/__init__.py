@@ -1,0 +1,86 @@
+"""Disruption contract + spot-slice reclamation (ROADMAP items 3/5).
+
+Every *planned* eviction in this control plane — a defrag migration
+draining its victim, a rolling update taking down a ready pod, a
+spot-slice reclaim evacuating a dying slice — now routes through ONE
+barrier protocol (the reference operator's gang-termination-delay /
+rolling semantics, SURVEY.md §4, generalized to TPU slice granularity):
+
+- ``contract``  — the DisruptionNotice lifecycle: post (CAS onto the
+                  gang's annotation), workload ack, deadline expiry,
+                  eviction stamping, clearing. One pointer, one write
+                  path, like reuse-reservation-ref.
+- ``reclaim``   — the ReclaimController: turns a spot-reclamation
+                  notice on a slice's nodes (``ANNOTATION_RECLAIM_AT``,
+                  surfaced/cordoned by controllers/nodelifecycle.py)
+                  into gang-atomic evacuations — notice → checkpoint
+                  barrier → pinned SliceReservation on surviving
+                  capacity (the defrag hold→drain→rebind machinery) →
+                  reland → ready. It also *drives* the barrier for all
+                  three callers: registered checkpoint responders
+                  (serving/checkpoint.py warm-restart path) run with
+                  retry/backoff until ack or deadline.
+
+``GROVE_DISRUPTION=0`` (read live, per decision) disables the CONTRACT:
+post_notice returns None and every caller evicts immediately — exactly
+the pre-contract behavior. The reclaim controller itself stays active
+(abandoning a dying slice is not an acceptable "off"); only its barrier
+degrades to immediate. See docs/design/disruption-contract.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+DISRUPTION_ENV = "GROVE_DISRUPTION"
+
+# Notice reasons — the three sanctioned planned-eviction callers.
+REASON_DEFRAG = "defrag-migration"
+REASON_ROLLING = "rolling-update"
+REASON_RECLAIM = "spot-reclaim"
+
+
+def disruption_enabled() -> bool:
+    """The contract kill switch, read per decision (incident mitigation
+    and tests flip it live, like GROVE_DEFRAG)."""
+    return os.environ.get(DISRUPTION_ENV, "1") != "0"
+
+
+def reclaim_hold_name(gang_name: str) -> str:
+    """Deterministic SliceReservation name for a reclaim evacuation of
+    ``gang_name`` (one evacuation per gang at a time by construction;
+    distinct from defrag-/roll- so the three hold owners never collide)."""
+    return f"reclaim-{gang_name}"
+
+
+from grove_tpu.disruption.contract import (  # noqa: E402
+    ack_notice,
+    barrier_state,
+    clear_notice,
+    notice_of,
+    note_evicted,
+    post_notice,
+    register_responder,
+    request_barrier,
+    responder_for,
+    unregister_responder,
+)
+
+__all__ = [
+    "DISRUPTION_ENV",
+    "REASON_DEFRAG",
+    "REASON_RECLAIM",
+    "REASON_ROLLING",
+    "ack_notice",
+    "barrier_state",
+    "clear_notice",
+    "disruption_enabled",
+    "note_evicted",
+    "notice_of",
+    "post_notice",
+    "reclaim_hold_name",
+    "register_responder",
+    "request_barrier",
+    "responder_for",
+    "unregister_responder",
+]

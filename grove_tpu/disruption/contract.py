@@ -1,0 +1,334 @@
+"""The DisruptionNotice lifecycle — one barrier protocol for every
+planned eviction.
+
+A notice lives in its gang's ``ANNOTATION_DISRUPTION_NOTICE`` annotation
+as JSON (the reuse-reservation-ref pattern: one pointer, one sanctioned
+CAS write path, mirrored into ``PodGang.status.disruption`` and a
+``DisruptionTarget`` condition by the scheduler's status writes). The
+states:
+
+- **posted**   — an evictor (defrag executor, rolling update, reclaim
+                 controller) declared intent; ``deadline`` is absolute.
+                 A second caller posting onto a gang that already
+                 carries a live notice COALESCES onto it (same id, same
+                 deadline — the workload checkpoints once no matter how
+                 many reasons want it moved).
+- **acked**    — the workload (or the auto-ack for gangs with no
+                 registered checkpoint responder — nothing to flush
+                 means nothing to wait for) confirmed its checkpoint is
+                 durable. An ack AFTER the deadline is recorded but the
+                 barrier still reads ``expired`` — the eviction already
+                 proceeded and replaying the late ack would lie.
+- **expired**  — the deadline passed unacked; eviction proceeds anyway
+                 (the workload may delay, never veto) and is stamped
+                 ``barrier=expired``.
+- **evicted**  — ``note_evicted`` stamped the moment pods were deleted;
+                 the chaos disruption-contract invariant checks that an
+                 evicted gang's barrier reads acked or expired, never
+                 pending/absent.
+- **cleared**  — the evictor removed the notice once the gang is whole
+                 again (or its operation aborted without evicting).
+
+``GROVE_DISRUPTION=0`` (read live): ``post_notice`` returns None and
+callers evict immediately — the exact pre-contract behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import uuid
+from typing import Callable
+
+from grove_tpu.api import PodGang, constants as c
+from grove_tpu.api.podgang import DisruptionNotice
+from grove_tpu.disruption import disruption_enabled
+from grove_tpu.runtime.errors import ConflictError, GroveError, NotFoundError
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.runtime.timescale import scaled
+
+log = get_logger("disruption")
+
+# ---- checkpoint responder registry --------------------------------------
+
+# (namespace, gang name) -> callable(notice_dict) -> None. Raising means
+# "checkpoint failed, retry me"; returning means the checkpoint is
+# durable and the notice may be acked. Process-local by design: the
+# responder IS the in-process serving engine's hook (remote workloads
+# ack over the wire by writing the annotation through the API).
+_RESPONDERS: dict[tuple[str, str], Callable] = {}
+_RESPONDERS_LOCK = threading.Lock()
+
+
+def register_responder(gang_name: str, fn: Callable,
+                       namespace: str = "default") -> None:
+    """Register ``fn`` as the checkpoint hook for a gang. While
+    registered, barriers on the gang wait for the reclaim controller to
+    run it (retry/backoff until the deadline); without one, barriers
+    auto-ack at post time."""
+    with _RESPONDERS_LOCK:
+        _RESPONDERS[(namespace, gang_name)] = fn
+
+
+def unregister_responder(gang_name: str,
+                         namespace: str = "default") -> None:
+    with _RESPONDERS_LOCK:
+        _RESPONDERS.pop((namespace, gang_name), None)
+
+
+def responder_for(gang_name: str,
+                  namespace: str = "default") -> Callable | None:
+    with _RESPONDERS_LOCK:
+        return _RESPONDERS.get((namespace, gang_name))
+
+
+# ---- notice (de)serialization -------------------------------------------
+
+
+def notice_of(gang: PodGang) -> DisruptionNotice | None:
+    """Parse the gang's live notice; None when absent or undecodable
+    (a corrupt annotation must degrade to 'no barrier', not wedge the
+    eviction path behind a parse error forever)."""
+    raw = gang.meta.annotations.get(c.ANNOTATION_DISRUPTION_NOTICE, "")
+    if not raw:
+        return None
+    try:
+        data = json.loads(raw)
+        return DisruptionNotice(**{
+            f.name: data.get(f.name, getattr(DisruptionNotice, f.name, ""))
+            for f in dataclasses.fields(DisruptionNotice)
+            if f.name in data})
+    except (ValueError, TypeError):
+        log.warning("gang %s/%s carries an undecodable disruption "
+                    "notice; treating as absent",
+                    gang.meta.namespace, gang.meta.name)
+        return None
+
+
+def _encode(notice: DisruptionNotice) -> str:
+    return json.dumps(dataclasses.asdict(notice), sort_keys=True)
+
+
+def barrier_state(notice: DisruptionNotice | None,
+                  now: float | None = None) -> str:
+    """absent | pending | acked | expired. An ack stamped past the
+    deadline does not resurrect the barrier — the eviction already
+    proceeded under ``expired`` and the state must keep saying so."""
+    if notice is None:
+        return "absent"
+    now = time.time() if now is None else now
+    if notice.acked_at and notice.acked_at <= notice.deadline:
+        return "acked"
+    if now > notice.deadline:
+        return "expired"
+    return "pending"
+
+
+# ---- the one sanctioned write path --------------------------------------
+
+
+def _mutate(client, gang_name: str, namespace: str,
+            fn: Callable[[PodGang, DisruptionNotice | None],
+                         "DisruptionNotice | None | bool"],
+            retries: int = 6) -> DisruptionNotice | None:
+    """CAS loop over the gang's notice annotation. ``fn`` sees the live
+    gang + parsed notice and returns the notice to write (None =
+    remove the annotation, False = abort without writing). Returns the
+    written notice (or the live one on abort), None when the gang is
+    gone or every retry conflicted."""
+    for _ in range(retries):
+        try:
+            gang = client.get(PodGang, gang_name, namespace)
+        except NotFoundError:
+            return None
+        current = notice_of(gang)
+        out = fn(gang, current)
+        if out is False:
+            return current
+        if out is None:
+            if c.ANNOTATION_DISRUPTION_NOTICE not in gang.meta.annotations:
+                return None
+            gang.meta.annotations.pop(c.ANNOTATION_DISRUPTION_NOTICE, None)
+        else:
+            encoded = _encode(out)
+            if gang.meta.annotations.get(
+                    c.ANNOTATION_DISRUPTION_NOTICE) == encoded:
+                return out
+            gang.meta.annotations[c.ANNOTATION_DISRUPTION_NOTICE] = encoded
+        try:
+            client.update(gang)
+            return out if out is not None else None
+        except ConflictError:
+            continue
+        except GroveError as e:
+            log.warning("disruption notice write on %s/%s failed: %s",
+                        namespace, gang_name, e)
+            return None
+    return None
+
+
+def post_notice(client, gang_name: str, namespace: str, reason: str,
+                deadline_s: float) -> DisruptionNotice | None:
+    """Declare eviction intent. Returns the LIVE notice — fresh, or the
+    existing one when a barrier is already up (double-notice
+    coalescing: one checkpoint covers every reason that wants the gang
+    moved). A coalescing caller can SHORTEN the deadline but never
+    extend it — a re-post must not grant a stay of execution, and a
+    spot reclaim joining an earlier roll/defrag notice must keep its
+    withdrawal-clamped deadline or the gang dies with the slice while
+    the barrier still reads pending. None when the contract is disabled
+    (GROVE_DISRUPTION=0) or the gang is gone — callers distinguish the
+    two through :func:`request_barrier`."""
+    if not disruption_enabled():
+        return None
+    posted = {"fresh": False}
+
+    def mutate(gang: PodGang, current: DisruptionNotice | None):
+        if current is not None and not current.evicted_at:
+            deadline = min(current.deadline,
+                           time.time() + scaled(deadline_s))
+            coalesced = dataclasses.replace(
+                current, coalesced=current.coalesced + 1,
+                deadline=deadline)
+            posted["fresh"] = False
+            return coalesced
+        notice = DisruptionNotice(
+            id=uuid.uuid4().hex[:12], reason=reason,
+            requested_at=time.time(),
+            deadline=time.time() + scaled(deadline_s))
+        if responder_for(gang_name, namespace) is None and \
+                not gang.meta.annotations.get(
+                    c.ANNOTATION_CHECKPOINT_REQUIRED):
+            # No checkpoint responder and no out-of-process one
+            # declared: nothing to flush, nothing to wait for — the
+            # barrier auto-acks at post time (the no-serving-engine
+            # case; also what keeps pure control-plane workloads
+            # eviction-latency-free). A checkpoint-required gang waits
+            # for its remote workload's wire ack (or the deadline).
+            notice.acked_at = time.time()
+            notice.ack_source = "auto"
+        posted["fresh"] = True
+        return notice
+
+    notice = _mutate(client, gang_name, namespace, mutate)
+    if notice is not None and posted["fresh"]:
+        GLOBAL_METRICS.inc("grove_disruption_notices_total", reason=reason)
+        if notice.ack_source == "auto":
+            GLOBAL_METRICS.inc("grove_disruption_acks_total", source="auto")
+        log.info("disruption notice %s on %s/%s (%s): deadline in %.1fs%s",
+                 notice.id, namespace, gang_name, reason,
+                 notice.deadline - time.time(),
+                 " [auto-acked]" if notice.ack_source == "auto" else "")
+    return notice
+
+
+def ack_notice(client, gang_name: str, namespace: str, notice_id: str,
+               source: str = "workload") -> bool:
+    """The workload's checkpoint acknowledgment. True iff the ack is
+    now recorded on the identified notice (repeat acks are True
+    no-ops); False when the notice is gone or superseded. Late acks
+    (past the deadline) are recorded — they are evidence — but the
+    barrier keeps reading expired."""
+    recorded = {"new": False, "late": False}
+
+    def mutate(gang: PodGang, current: DisruptionNotice | None):
+        if current is None or current.id != notice_id:
+            return False
+        if current.acked_at:
+            return False            # already acked: no write needed
+        now = time.time()
+        recorded["new"] = True
+        recorded["late"] = now > current.deadline
+        return dataclasses.replace(current, acked_at=now, ack_source=source)
+
+    out = _mutate(client, gang_name, namespace, mutate)
+    if out is None:
+        return False
+    if recorded["new"]:
+        GLOBAL_METRICS.inc("grove_disruption_acks_total", source=source)
+        GLOBAL_METRICS.observe("grove_disruption_barrier_wait_seconds",
+                               max(0.0, out.acked_at - out.requested_at))
+        if recorded["late"]:
+            log.warning("late ack on notice %s (%s/%s): deadline passed "
+                        "%.1fs earlier — eviction already proceeded",
+                        notice_id, namespace, gang_name,
+                        out.acked_at - out.deadline)
+    return out.id == notice_id and bool(out.acked_at)
+
+
+def note_evicted(client, gang_name: str, namespace: str,
+                 notice_id: str) -> str:
+    """Stamp the moment eviction proceeded, freezing the barrier
+    verdict (acked|expired) onto the notice — the record the chaos
+    disruption-contract invariant audits. Returns the stamped barrier
+    state ("" when the notice vanished)."""
+    stamped = {"barrier": "", "reason": ""}
+
+    def mutate(gang: PodGang, current: DisruptionNotice | None):
+        if current is None or current.id != notice_id:
+            return False
+        if current.evicted_at:
+            stamped["barrier"] = current.barrier
+            return False
+        state = barrier_state(current)
+        stamped["barrier"] = state
+        stamped["reason"] = current.reason
+        return dataclasses.replace(current, evicted_at=time.time(),
+                                   barrier=state)
+
+    _mutate(client, gang_name, namespace, mutate)
+    if stamped["reason"]:
+        GLOBAL_METRICS.inc("grove_disruption_evictions_total",
+                           reason=stamped["reason"],
+                           barrier=stamped["barrier"])
+        if stamped["barrier"] == "expired":
+            GLOBAL_METRICS.inc("grove_disruption_expired_total",
+                               reason=stamped["reason"])
+    return stamped["barrier"]
+
+
+def clear_notice(client, gang_name: str, namespace: str,
+                 notice_id: str) -> bool:
+    """Remove the notice once its eviction's story ends (gang whole
+    again, or the operation aborted without evicting). CAS on id: a
+    successor notice posted since must not be cleared by a stale
+    caller."""
+
+    def mutate(gang: PodGang, current: DisruptionNotice | None):
+        if current is None:
+            return False
+        if current.id != notice_id:
+            return False
+        return None
+
+    _mutate(client, gang_name, namespace, mutate)
+    return True
+
+
+def request_barrier(client, gang_name: str, namespace: str, reason: str,
+                    deadline_s: float) -> tuple[str, DisruptionNotice | None]:
+    """The caller-facing one-liner: post (or join) the gang's notice
+    and report the barrier verdict. Outcomes callers act on:
+
+    - ``("disabled", None)`` — GROVE_DISRUPTION=0: evict immediately,
+      the pre-contract shape;
+    - ``("gone", None)`` — the gang no longer exists: the eviction is
+      moot;
+    - ``("retry", None)`` — the notice write lost every CAS round to
+      other writers: NOT a license to evict; try again next pass (a
+      contended annotation must never silently strip the barrier);
+    - ``("pending"|"acked"|"expired", notice)`` — the barrier proper.
+    """
+    if not disruption_enabled():
+        return "disabled", None
+    notice = post_notice(client, gang_name, namespace, reason, deadline_s)
+    if notice is None:
+        try:
+            client.get(PodGang, gang_name, namespace)
+        except NotFoundError:
+            return "gone", None
+        return "retry", None
+    return barrier_state(notice), notice

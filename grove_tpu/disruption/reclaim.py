@@ -1,0 +1,855 @@
+"""ReclaimController — spot-slice reclamation as a first-class event.
+
+On GKE spot, a slice's nodes vanish *together*. The reclamation notice
+arrives ahead of the withdrawal (``ANNOTATION_RECLAIM_AT`` on each
+node, stamped by the cloud integration or the chaos spot-reclaim
+injector; ``controllers/nodelifecycle.py`` cordons the nodes the moment
+it sees one). This controller turns that notice into gang-atomic
+evacuations instead of letting the gangs die with the slice:
+
+1. **Notice**: every gang with a pod on reclaim-noticed capacity gets a
+   ``DisruptionNotice`` (reason ``spot-reclaim``, deadline clamped to
+   the node's advertised withdrawal instant) through the one contract
+   every planned eviction shares (disruption/contract.py).
+2. **Barrier**: registered checkpoint responders (the serving engine's
+   warm-restart hook, serving/checkpoint.py) run with retry/backoff
+   until they ack or the deadline expires — the workload may delay,
+   never veto. Gangs with no responder auto-ack at post time.
+3. **Hold**: a ``SliceReservation`` pinned to surviving capacity chosen
+   by the real gang planner (``plan_gang`` with the multislice
+   DCN-spread penalties — replicas spread before they pack), wired to
+   the gang via the reuse-reservation-ref annotation exactly like a
+   defrag migration hold.
+4. **Drain → reland**: pods deleted gang-atomically (stamped
+   ``barrier=acked|expired`` first — the record the chaos
+   disruption-contract invariant audits), the PodCliques recreate them
+   gated, the scheduler relands them pinned to the hold; the evacuation
+   completes when the gang is Ready again
+   (``grove_disruption_reclaim_to_ready_seconds``).
+
+Degradations are graceful by construction: no surviving capacity fits →
+drain unpinned and let self-heal land the gang when capacity returns; a
+hold's TTL expires mid-evacuation (the reservation controller deletes
+it AND clears the gang's annotation, the PR 9 precedent) → the
+evacuation RE-HOLDS and continues rather than stranding a half-drained
+gang; the deadline passes unacked → evict anyway, stamped expired.
+
+Surfaces: ``GET /debug/disruption`` + ``Client/HttpClient
+.debug_disruption`` twins + ``grovectl disruptions`` render
+:meth:`payload`; ``grove_disruption_*`` metric families.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+
+from grove_tpu.api import Node, Pod, PodGang, SliceReservation, \
+    constants as c
+from grove_tpu.api.config import DisruptionConfig
+from grove_tpu.api.meta import is_condition_true, new_meta
+from grove_tpu.api.reservation import ReservationPhase, SliceReservationSpec
+from grove_tpu.defrag import release_hold, set_reservation_ref
+from grove_tpu.disruption import (
+    REASON_RECLAIM,
+    barrier_state,
+    clear_notice,
+    disruption_enabled,
+    note_evicted,
+    notice_of,
+    post_notice,
+    reclaim_hold_name,
+    responder_for,
+)
+from grove_tpu.disruption.contract import ack_notice
+from grove_tpu.runtime.errors import GroveError, NotFoundError
+from grove_tpu.runtime.events import EventRecorder
+from grove_tpu.runtime.logger import get_logger
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.runtime.timescale import TIME_SCALE, scaled
+
+# store (weakly) -> its controller, so the in-process Client resolves
+# debug_disruption without a manager reference (the defrag pattern).
+_CONTROLLERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def reclaim_for(store) -> "ReclaimController | None":
+    return _CONTROLLERS.get(store)
+
+
+def reclaim_noticed_nodes(nodes: list[Node]) -> list[Node]:
+    """The nodes carrying a live spot-reclamation notice (shared with
+    controllers/nodelifecycle.py, which cordons them)."""
+    return [n for n in nodes
+            if n.meta.annotations.get(c.ANNOTATION_RECLAIM_AT)]
+
+
+def _reclaim_at(node: Node) -> float:
+    try:
+        return float(node.meta.annotations.get(
+            c.ANNOTATION_RECLAIM_AT, "0"))
+    except ValueError:
+        return 0.0
+
+
+class _Evacuation:
+    """One gang's evacuation state."""
+
+    __slots__ = ("gang", "namespace", "source_slices", "state", "barrier",
+                 "notice_id", "reservation", "target_slices", "pinned",
+                 "started_at", "hold_at", "drained_at", "finished_at",
+                 "outcome", "reholds", "pods_moved", "chips")
+
+    def __init__(self, gang: str, namespace: str,
+                 source_slices: list[str]) -> None:
+        self.gang = gang
+        self.namespace = namespace
+        self.source_slices = sorted(source_slices)
+        self.state = "Barrier"      # Barrier | Holding | Relanding
+        self.barrier = ""           # verdict stamped at drain
+        self.notice_id = ""
+        self.reservation = ""
+        self.target_slices: list[str] = []
+        self.pinned = False
+        self.started_at = time.time()
+        self.hold_at: float | None = None
+        self.drained_at: float | None = None
+        self.finished_at: float | None = None
+        self.outcome = ""           # evacuated | aborted:<reason>
+        self.reholds = 0
+        self.pods_moved = 0
+        self.chips = 0
+
+    def to_dict(self) -> dict:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+def render_disruptions(payload: dict, now: float | None = None
+                       ) -> list[str]:
+    """Human-readable disruption ledger — what ``grovectl disruptions``
+    prints. Works on the wire dict so the CLI renders identically from
+    the debug endpoint and the in-process twin."""
+    now = time.time() if now is None else now
+    cnt = payload.get("counters", {})
+    lines = [
+        "disruption contract: " + (
+            "enabled" if payload.get("contract_enabled")
+            else "DISABLED (GROVE_DISRUPTION=0 — evictions proceed "
+                 "without barriers)"),
+        f"  notices: {cnt.get('notices', 0)} posted, "
+        f"{cnt.get('acks_driven', 0)} acks driven "
+        f"({cnt.get('ack_failures', 0)} checkpoint failures retried), "
+        f"{cnt.get('expired', 0)} expired",
+        f"  evacuations: {cnt.get('started', 0)} started, "
+        f"{cnt.get('completed', 0)} completed, "
+        f"{cnt.get('aborted', 0)} aborted, "
+        f"{cnt.get('reholds', 0)} re-holds after TTL expiry",
+    ]
+    notices = payload.get("notices") or []
+    if notices:
+        lines.append(f"  live notices ({len(notices)}):")
+        for n in notices:
+            age = now - n.get("requested_at", now)
+            left = n.get("deadline", now) - now
+            lines.append(
+                f"    {n.get('gang', '?'):30s} {n.get('reason', '?'):16s} "
+                f"{n.get('state', '?'):8s} age {age:5.1f}s "
+                + (f"deadline in {left:.1f}s" if left > 0
+                   else f"deadline passed {-left:.1f}s ago")
+                + (f" (coalesced x{n['coalesced']}"
+                   f")" if n.get("coalesced") else ""))
+    inflight = payload.get("inflight") or []
+    if inflight:
+        lines.append(f"  evacuations in flight ({len(inflight)}):")
+        for e in inflight:
+            age = now - e.get("started_at", now)
+            lines.append(
+                f"    {e.get('gang', '?'):30s} {e.get('state', '?'):10s} "
+                f"{age:5.1f}s  {e.get('source_slices', [])} -> "
+                f"{e.get('target_slices') or 'unpinned'}"
+                + (f" (re-held x{e['reholds']})" if e.get("reholds")
+                   else ""))
+    recent = payload.get("recent") or []
+    if recent:
+        lines.append(f"  recent evacuations ({len(recent)}, newest first):")
+        for e in recent[:8]:
+            took = (e.get("finished_at") or now) - e.get("started_at", now)
+            lines.append(
+                f"    {e.get('outcome', '?'):20s} {e.get('gang', '?'):30s} "
+                f"{e.get('source_slices', [])} -> "
+                f"{e.get('target_slices') or 'unpinned'} "
+                f"barrier={e.get('barrier') or '?'} "
+                f"({e.get('pods_moved', 0)} pods, {took:.2f}s)")
+    return lines
+
+
+class ReclaimController:
+    """Background evacuation runnable (one per manager). Also the
+    barrier *coordinator*: its ack pass drives registered checkpoint
+    responders for EVERY live notice (defrag's and the roll path's
+    included), so one runnable owns the retry/backoff machinery."""
+
+    RECENT_CAPACITY = 32
+
+    def __init__(self, client, store,
+                 config: DisruptionConfig | None = None) -> None:
+        self.client = client
+        self.store = store
+        self.cfg = config or DisruptionConfig()
+        self.log = get_logger("disruption.reclaim")
+        self.recorder = EventRecorder(client, "reclaim")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Guards _active/_recent: the sweep thread mutates them,
+        # payload() reads them from the HTTP server thread.
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "disruption")
+        self._active: dict[tuple[str, str], _Evacuation] = {}
+        self._recent: collections.deque = collections.deque(
+            maxlen=self.RECENT_CAPACITY)
+        # notice id -> (attempts, next retry at; monotonic) for the
+        # responder retry/backoff schedule; _ack_inflight (under _lock)
+        # holds the notice ids whose responder thread is running.
+        self._ack_schedule: dict[str, tuple[int, float]] = {}
+        self._ack_inflight: set[str] = set()
+        self.counters = {"notices": 0, "acks_driven": 0, "ack_failures": 0,
+                         "expired": 0, "started": 0, "completed": 0,
+                         "aborted": 0, "reholds": 0}
+
+    # ---- runnable lifecycle ---------------------------------------------
+
+    def start(self) -> None:
+        _CONTROLLERS[self.store] = self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="reclaim",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if _CONTROLLERS.get(self.store) is self:
+            del _CONTROLLERS[self.store]
+
+    def pause(self) -> None:
+        """Leadership parking (grove_tpu/ha): a demoted replica must
+        not evacuate — its writes would be fenced, and racing the real
+        leader's evacuations would double-evict."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def _run(self) -> None:
+        from grove_tpu.store import writeobs
+        writeobs.set_writer("reclaim")
+        while not self._stop.is_set():
+            if getattr(self, "_paused", False):
+                self._stop.wait(self.cfg.sync_period_seconds)
+                continue
+            try:
+                self.sweep()
+            except Exception:   # noqa: BLE001 — loop survival barrier
+                self.log.exception("reclaim sweep panicked")
+            self._stop.wait(self.cfg.sync_period_seconds)
+
+    # ---- the sweep -------------------------------------------------------
+
+    def sweep(self) -> None:
+        """One decision round: drive checkpoint responders, detect
+        newly noticed capacity, advance every in-flight evacuation.
+        Public so tests and tools can drive it synchronously."""
+        gangs = self.client.list(PodGang, None)
+        self._ack_pass(gangs)
+        self._detect(gangs)
+        with self._lock:
+            active = list(self._active.values())
+        for ev in active:
+            try:
+                self._advance(ev)
+            except GroveError as e:
+                self.log.warning("evacuation of %s/%s hiccuped: %s",
+                                 ev.namespace, ev.gang, e)
+        GLOBAL_METRICS.set("grove_disruption_inflight",
+                           float(len(self._active)))
+
+    # ---- barrier coordination (retry/backoff on checkpoint acks) --------
+
+    def _ack_pass(self, gangs: list[PodGang]) -> None:
+        """For every gang with a pending notice AND a registered
+        checkpoint responder: run the responder — on its OWN thread,
+        one in flight per notice, so a single slow checkpoint cannot
+        starve the other gangs racing the same reclaim deadline — ack
+        on success; retry with exponential backoff until the deadline
+        on failure. Gangs without responders were auto-acked at post
+        time (unless they declared an out-of-process checkpointer via
+        the checkpoint-required annotation — those wait for the wire
+        ack or the deadline)."""
+        now = time.monotonic()
+        live_ids = set()
+        for gang in gangs:
+            notice = notice_of(gang)
+            if notice is None:
+                continue
+            live_ids.add(notice.id)
+            if barrier_state(notice) != "pending":
+                continue
+            fn = responder_for(gang.meta.name, gang.meta.namespace)
+            if fn is None:
+                if gang.meta.annotations.get(
+                        c.ANNOTATION_CHECKPOINT_REQUIRED):
+                    continue    # a remote workload owns this ack
+                # Responder unregistered since the post (engine shut
+                # down mid-barrier): nothing left to flush — auto-ack.
+                ack_notice(self.client, gang.meta.name,
+                           gang.meta.namespace, notice.id, source="auto")
+                self.counters["acks_driven"] += 1
+                continue
+            attempts, next_try = self._ack_schedule.get(notice.id, (0, 0.0))
+            if now < next_try:
+                continue
+            with self._lock:
+                if notice.id in self._ack_inflight:
+                    continue    # this notice's responder is still running
+                self._ack_inflight.add(notice.id)
+            threading.Thread(
+                target=self._run_responder, name=f"ack-{notice.id}",
+                args=(fn, gang.meta.name, gang.meta.namespace, notice,
+                      attempts), daemon=True).start()
+        # Drop retry state for notices that no longer exist.
+        for nid in list(self._ack_schedule):
+            if nid not in live_ids:
+                del self._ack_schedule[nid]
+
+    def _run_responder(self, fn, gang_name: str, namespace: str,
+                       notice, attempts: int) -> None:
+        """One checkpoint attempt, off the sweep thread."""
+        try:
+            try:
+                fn(notice)
+            except Exception as e:  # noqa: BLE001 — a failing checkpoint
+                # must be retried, not kill the coordinator
+                backoff = min(
+                    scaled(self.cfg.ack_retry_base_seconds) * (2 ** attempts),
+                    scaled(self.cfg.ack_retry_max_seconds))
+                self._ack_schedule[notice.id] = (attempts + 1,
+                                                 time.monotonic() + backoff)
+                self.counters["ack_failures"] += 1
+                GLOBAL_METRICS.inc("grove_disruption_ack_failures_total",
+                                   reason=notice.reason)
+                self.log.warning(
+                    "checkpoint responder for %s/%s failed (attempt %d, "
+                    "retry in %.2fs): %s", namespace, gang_name,
+                    attempts + 1, backoff, e)
+                return
+            if ack_notice(self.client, gang_name, namespace, notice.id,
+                          source="workload"):
+                self.counters["acks_driven"] += 1
+                self._ack_schedule.pop(notice.id, None)
+                self.log.info("checkpoint acked for %s/%s (notice %s, "
+                              "attempt %d)", namespace, gang_name,
+                              notice.id, attempts + 1)
+        finally:
+            with self._lock:
+                self._ack_inflight.discard(notice.id)
+
+    # ---- detection -------------------------------------------------------
+
+    def _noticed_nodes(self) -> list[Node]:
+        return reclaim_noticed_nodes(self.client.list(Node, None))
+
+    def _detect(self, gangs: list[PodGang]) -> None:
+        noticed = self._noticed_nodes()
+        if not noticed:
+            return
+        noticed_names = {(n.meta.namespace, n.meta.name) for n in noticed}
+        slice_of = {(n.meta.namespace, n.meta.name):
+                    n.meta.labels.get(c.NODE_LABEL_SLICE, "")
+                    for n in noticed}
+        affected: dict[tuple[str, str], set[str]] = {}
+        for p in self.client.list(Pod, None):
+            if p.meta.deletion_timestamp is not None \
+                    or not p.status.node_name:
+                continue
+            key = (p.meta.namespace, p.status.node_name)
+            if key not in noticed_names:
+                continue
+            gname = p.meta.labels.get(c.LABEL_PODGANG_NAME, "")
+            if gname:
+                affected.setdefault(
+                    (p.meta.namespace, gname), set()).add(slice_of[key])
+        by_name = {(g.meta.namespace, g.meta.name): g for g in gangs}
+        for key, slices in sorted(affected.items()):
+            with self._lock:
+                if key in self._active:
+                    continue
+                if len(self._active) >= self.cfg.max_concurrent_evacuations:
+                    break               # the rest start next sweep(s)
+                gang = by_name.get(key)
+                if gang is None or gang.meta.deletion_timestamp is not None:
+                    continue
+                ev = _Evacuation(key[1], key[0], sorted(s for s in slices
+                                                        if s))
+                self._active[key] = ev
+            self._start_evacuation(ev, gang, noticed)
+
+    def _start_evacuation(self, ev: _Evacuation, gang: PodGang,
+                          noticed: list[Node]) -> None:
+        self.counters["started"] += 1
+        GLOBAL_METRICS.inc("grove_disruption_evacuations_total")
+        notice = self._post_reclaim_notice(ev, gang, noticed)
+        self.log.info("reclaim: evacuating gang %s/%s off %s "
+                      "(barrier %s)", ev.namespace, ev.gang,
+                      ev.source_slices,
+                      notice.id if notice is not None else ev.barrier
+                      or "retrying")
+        self._event(ev, "Normal", "SpotReclaimEvacuation",
+                    f"slice(s) {ev.source_slices} under spot "
+                    f"reclamation; evacuating gang "
+                    + (f"behind checkpoint barrier {notice.id}"
+                       if notice is not None else
+                       "without a barrier (contract disabled)"
+                       if ev.barrier == "disabled" else
+                       "(notice post contended; retrying)"))
+
+    def _post_reclaim_notice(self, ev: _Evacuation, gang: PodGang,
+                             noticed: list[Node]):
+        """Post (or re-post after write contention) the evacuation's
+        notice. Deadline: the contract default, clamped to the earliest
+        advertised withdrawal of THIS gang's noticed capacity — a
+        barrier outliving its own hardware protects nothing, and
+        another slice's (possibly stale) stamp must not cut this gang's
+        checkpoint window. post_notice scales its argument, so the
+        wall-clock remainder is divided back to pre-scale seconds."""
+        from grove_tpu.disruption import request_barrier
+        deadline_s = self.cfg.default_deadline_seconds
+        own = set(ev.source_slices)
+        stamps = [t for t in (
+            _reclaim_at(n) for n in noticed
+            if n.meta.labels.get(c.NODE_LABEL_SLICE, "") in own) if t > 0]
+        if stamps:
+            remaining = (min(stamps) - time.time()) / TIME_SCALE
+            deadline_s = max(0.1, min(deadline_s, remaining))
+        state, notice = request_barrier(self.client, ev.gang, ev.namespace,
+                                        REASON_RECLAIM, deadline_s)
+        if state in ("disabled", "gone"):
+            # Pre-contract behavior (kill switch) or a moot eviction:
+            # no barrier, straight to the hold — the switch strips the
+            # CONTRACT, not the pinned evacuation itself.
+            ev.barrier = "disabled"
+            ev.state = "Holding"
+            ev.hold_at = time.time()
+            self._take_hold(ev, gang)
+            return None
+        if notice is None:
+            return None     # "retry": the Barrier state re-posts
+        if not ev.notice_id:
+            self.counters["notices"] += 1
+        ev.notice_id = notice.id
+        return notice
+
+    # ---- the per-evacuation state machine --------------------------------
+
+    def _advance(self, ev: _Evacuation) -> None:
+        try:
+            gang = self.client.get(PodGang, ev.gang, ev.namespace)
+        except NotFoundError:
+            self._abort(ev, "victim-gone")
+            return
+        if ev.state == "Barrier":
+            if not ev.notice_id:
+                # The initial post lost every CAS round (contended
+                # annotation): re-post — write contention must never
+                # silently strip the barrier.
+                if self._post_reclaim_notice(ev, gang,
+                                             self._noticed_nodes()) is None:
+                    return      # disabled path advanced, or retry again
+            state = barrier_state(notice_of(gang))
+            if state == "pending":
+                return
+            if state == "absent":
+                # A POSTED notice vanished (operator clear / corrupt):
+                # the capacity is still dying — proceed as expired.
+                state = "expired"
+            ev.barrier = state
+            if state == "expired":
+                self.counters["expired"] += 1
+            ev.state = "Holding"
+            ev.hold_at = time.time()
+            self._take_hold(ev, gang)
+            return
+        if ev.state == "Holding":
+            self._advance_holding(ev, gang)
+            return
+        if ev.state == "Relanding":
+            self._advance_relanding(ev, gang)
+
+    def _take_hold(self, ev: _Evacuation, gang: PodGang) -> None:
+        """Pin surviving capacity for the reland. May leave the
+        evacuation unpinned (no feasible target, or the gang's pointer
+        is owned by an in-flight defrag/roll hold that the drain will
+        supersede anyway) — graceful degradation, not failure."""
+        target = self._plan_target(ev, gang)
+        if target is None:
+            ev.pinned = False
+            self.log.warning(
+                "reclaim: no surviving capacity fits gang %s/%s — "
+                "draining unpinned (self-heal relands it when capacity "
+                "returns)", ev.namespace, ev.gang)
+            self._event(ev, "Warning", "SpotReclaimDegraded",
+                        "no surviving capacity fits the gang; draining "
+                        "unpinned — it relands when capacity returns")
+            return
+        slices, chips = target
+        name = reclaim_hold_name(ev.gang)
+        rsv = SliceReservation(
+            meta=new_meta(name, namespace=ev.namespace, labels={
+                c.LABEL_MANAGED_BY: c.LABEL_MANAGED_BY_VALUE,
+                c.LABEL_HOLD_FOR_GANG: ev.gang,
+            }),
+            spec=SliceReservationSpec(
+                slices=slices, chips=chips,
+                ttl_seconds=scaled(self.cfg.hold_ttl_seconds)))
+        try:
+            self.client.create(rsv)
+        except GroveError as e:
+            self.log.warning("reclaim hold %s not created: %s", name, e)
+        # CAS from unset or already-ours: a defrag/roll hold owning the
+        # pointer means that machinery is mid-flight on this gang — the
+        # drain below supersedes it, but never steal the pointer; the
+        # evacuation just runs unpinned (its abort path will release).
+        if set_reservation_ref(self.client, ev.gang, ev.namespace, name,
+                               expect=("", name)):
+            ev.reservation = name
+            ev.target_slices = slices
+            ev.chips = chips
+            ev.pinned = True
+        else:
+            self._delete_reservation(name, ev.namespace)
+            ev.pinned = False
+            self.log.warning(
+                "reclaim: gang %s/%s pointer owned by another hold "
+                "(defrag/roll in flight); evacuating unpinned",
+                ev.namespace, ev.gang)
+
+    def _plan_target(self, ev: _Evacuation,
+                     gang: PodGang) -> tuple[list[str], int] | None:
+        """Choose surviving capacity with the real planner: the gang's
+        own pack constraints (group-level slice packs included — the
+        scheduler will enforce them at reland, so a target that ignored
+        them would wedge), the multislice DCN-spread penalties (sibling
+        PCS replicas' slices penalized so replicas spread before they
+        pack), noticed capacity excluded. Planned over the FULL spec
+        membership, not just live pods — mid-chaos a gang may be
+        missing replicas, and a hold sized to the survivors would pin
+        the healed gang onto capacity it cannot fit."""
+        from grove_tpu.scheduler.backends import DEFAULT_LEVEL_LABELS, \
+            build_host_views
+        from grove_tpu.scheduler.placement import (
+            GroupRequest,
+            PodRequest,
+            plan_gang,
+            plan_gang_grouped,
+        )
+        noticed = {n.meta.name for n in self._noticed_nodes()}
+        hosts = [h for h in build_host_views(self.client, None,
+                                             DEFAULT_LEVEL_LABELS)
+                 if h.name not in noticed]
+        if not hosts:
+            return None
+        live = {p.meta.name: p for p in self.client.list(
+            Pod, ev.namespace, selector={c.LABEL_PODGANG_NAME: ev.gang})
+            if p.meta.deletion_timestamp is None}
+
+        def chips_of(grp, pod_name: str) -> int:
+            p = live.get(pod_name)
+            if p is not None:
+                return p.spec.tpu_chips
+            # Group pods are same-shaped: borrow a live sibling's ask.
+            for sib in grp.pod_names:
+                sp = live.get(sib)
+                if sp is not None:
+                    return sp.spec.tpu_chips
+            return 0
+
+        def selector_of(grp) -> dict[str, str]:
+            for sib in grp.pod_names:
+                sp = live.get(sib)
+                if sp is not None:
+                    return {k: v for k, v in sp.spec.node_selector.items()
+                            if k != c.LABEL_RESERVATION}
+            return {}
+
+        topo = gang.spec.topology
+        pack_level = (topo.pack_level if topo else "slice") or "slice"
+        required = topo.required if topo else True
+        # DCN-spread: penalize slices already hosting sibling replicas
+        # of the same PCS (scheduler/backends._spread_penalties logic
+        # against a plain gang list — no pass snapshot here).
+        penalties: dict[str, float] = {}
+        pcs = gang.meta.labels.get(c.LABEL_PCS_NAME, "")
+        if pcs:
+            for other in self.client.list(
+                    PodGang, ev.namespace,
+                    selector={c.LABEL_PCS_NAME: pcs}):
+                if other.meta.name != ev.gang \
+                        and other.status.assigned_slice:
+                    penalties[other.status.assigned_slice] = \
+                        penalties.get(other.status.assigned_slice, 0.0) + 2.0
+        grouped = any(grp.topology is not None and grp.topology.pack_level
+                      for grp in gang.spec.groups)
+        total_chips = 0
+        if grouped:
+            greqs = []
+            for grp in gang.spec.groups:
+                sel = selector_of(grp)
+                reqs = [PodRequest(pn, chips_of(grp, pn), sel)
+                        for pn in grp.pod_names]
+                total_chips += sum(r.chips for r in reqs)
+                greqs.append(GroupRequest(
+                    reqs,
+                    grp.topology.pack_level if grp.topology else "",
+                    grp.topology.required if grp.topology else True))
+            plan = plan_gang_grouped(greqs, hosts, pack_level=pack_level,
+                                     required=required,
+                                     spread_penalty=penalties)
+        else:
+            reqs = [PodRequest(pn, chips_of(grp, pn), selector_of(grp))
+                    for grp in gang.spec.groups for pn in grp.pod_names]
+            total_chips = sum(r.chips for r in reqs)
+            plan = plan_gang(reqs, hosts, pack_level=pack_level,
+                             required=required, spread_penalty=penalties)
+        if plan is None or not total_chips:
+            return None
+        host_slice = {h.name: h.domains.get("slice", "") for h in hosts}
+        slices = sorted({host_slice[hn] for hn in plan.assignments.values()
+                         if host_slice.get(hn)})
+        if not slices:
+            return None
+        # The reservation's free-chip bind gate is per-slice (the
+        # defrag single-slice shape); a multi-slice target (pool-level
+        # gang) binds ungated — the plan above already proved headroom.
+        chips = total_chips if len(slices) == 1 else 0
+        return slices, chips
+
+    def _advance_holding(self, ev: _Evacuation, gang: PodGang) -> None:
+        if not ev.pinned:
+            self._drain(ev)
+            return
+        try:
+            rsv = self.client.get(SliceReservation, ev.reservation,
+                                  ev.namespace)
+        except NotFoundError:
+            # TTL expiry (which also cleared the gang's annotation —
+            # the PR 9 precedent) or operator delete: REQUEUE the
+            # evacuation by re-holding, never strand it half-done.
+            if not self._rehold(ev, gang):
+                self._drain(ev)     # out of re-holds: go unpinned
+            return
+        if rsv.status.phase == ReservationPhase.BOUND \
+                and rsv.status.bound_slices:
+            self._drain(ev)
+            return
+        if time.time() - (ev.hold_at or ev.started_at) > \
+                scaled(self.cfg.hold_timeout_seconds):
+            # The target's headroom vanished while we waited and the
+            # slice underneath us is still dying: release the pin and
+            # drain unpinned — late is worse than unpinned here.
+            self._release(ev)
+            ev.pinned = False
+            self._event(ev, "Warning", "SpotReclaimDegraded",
+                        f"hold {ev.reservation} never bound within "
+                        f"{self.cfg.hold_timeout_seconds:.0f}s; draining "
+                        "unpinned")
+            self._drain(ev)
+
+    def _rehold(self, ev: _Evacuation, gang: PodGang) -> bool:
+        """Re-take a lost hold mid-evacuation. True while re-holding is
+        still viable (the evacuation stays pinned), False when the
+        attempt budget is spent."""
+        if ev.reholds >= self.cfg.rehold_attempts:
+            ev.pinned = False
+            self.log.warning(
+                "reclaim: hold for %s/%s lost %d time(s); continuing "
+                "unpinned", ev.namespace, ev.gang, ev.reholds)
+            return False
+        ev.reholds += 1
+        self.counters["reholds"] += 1
+        GLOBAL_METRICS.inc("grove_disruption_reholds_total")
+        self.log.warning(
+            "reclaim: hold %s for gang %s/%s vanished (TTL expiry?); "
+            "re-holding (attempt %d/%d) and requeueing the evacuation",
+            ev.reservation, ev.namespace, ev.gang, ev.reholds,
+            self.cfg.rehold_attempts)
+        ev.hold_at = time.time()
+        self._take_hold(ev, gang)
+        return ev.pinned
+
+    def _drain(self, ev: _Evacuation) -> None:
+        """Gang-atomic eviction off the dying slice: the barrier
+        verdict is stamped onto the notice FIRST (the disruption-
+        contract invariant's audit record), then every pod goes in one
+        round — mid-evacuation the gang only ever has FEWER pods than
+        before, never a second live copy."""
+        if ev.notice_id:
+            stamped = note_evicted(self.client, ev.gang, ev.namespace,
+                                   ev.notice_id)
+            if stamped:
+                ev.barrier = stamped
+        pods = [p for p in self.client.list(
+            Pod, ev.namespace, selector={c.LABEL_PODGANG_NAME: ev.gang})
+            if p.meta.deletion_timestamp is None]
+        for p in pods:
+            try:
+                self.client.delete(Pod, p.meta.name, p.meta.namespace)
+            except (NotFoundError, GroveError):
+                pass
+        ev.pods_moved = len(pods)
+        ev.drained_at = time.time()
+        ev.state = "Relanding"
+        self.log.info("reclaim: gang %s/%s drained (%d pods, barrier=%s)"
+                      " -> reland on %s", ev.namespace, ev.gang,
+                      len(pods), ev.barrier,
+                      ev.target_slices if ev.pinned else "any capacity")
+
+    def _advance_relanding(self, ev: _Evacuation, gang: PodGang) -> None:
+        if is_condition_true(gang.status.conditions, c.COND_READY) \
+                and self._fully_bound(gang):
+            self._complete(ev)
+            return
+        if ev.pinned:
+            try:
+                self.client.get(SliceReservation, ev.reservation,
+                                ev.namespace)
+            except NotFoundError:
+                # TTL expired mid-reland: the reservation controller
+                # already cleared the gang's dangling annotation —
+                # requeue by re-holding so the reland stays pinned (or
+                # degrade to unpinned once the budget is spent).
+                self._rehold(ev, gang)
+        if time.time() - (ev.drained_at or ev.started_at) > \
+                scaled(self.cfg.rebind_timeout_seconds):
+            # Nothing left to do for this evacuation: release the pin
+            # and leave the gang to the ordinary self-heal machinery
+            # (its diagnosis explains what it is waiting for).
+            self._abort(ev, "rebind-timeout")
+
+    def _fully_bound(self, gang: PodGang) -> bool:
+        expected = [pn for grp in gang.spec.groups for pn in grp.pod_names]
+        pods = {p.meta.name: p for p in self.client.list(
+            Pod, gang.meta.namespace,
+            selector={c.LABEL_PODGANG_NAME: gang.meta.name})
+            if p.meta.deletion_timestamp is None}
+        return bool(expected) and all(
+            pn in pods and pods[pn].status.node_name for pn in expected)
+
+    # ---- completion / abort ----------------------------------------------
+
+    def _complete(self, ev: _Evacuation) -> None:
+        self._release(ev)
+        if ev.notice_id:
+            clear_notice(self.client, ev.gang, ev.namespace, ev.notice_id)
+        duration = time.time() - ev.started_at
+        ev.state, ev.outcome = "Done", "evacuated"
+        ev.finished_at = time.time()
+        self._finish(ev)
+        self.counters["completed"] += 1
+        GLOBAL_METRICS.inc("grove_disruption_evacuations_completed_total")
+        GLOBAL_METRICS.observe("grove_disruption_reclaim_to_ready_seconds",
+                               duration)
+        self.log.info("reclaim: gang %s/%s relanded ready on %s in %.2fs "
+                      "(barrier=%s, %d pods)", ev.namespace, ev.gang,
+                      ev.target_slices or "surviving capacity", duration,
+                      ev.barrier, ev.pods_moved)
+        landed = ev.target_slices or "surviving capacity"
+        self._event(ev, "Normal", "SpotReclaimCompleted",
+                    f"relanded ready on {landed} in {duration:.2f}s "
+                    f"(barrier={ev.barrier}, {ev.pods_moved} pods)")
+
+    def _abort(self, ev: _Evacuation, reason: str) -> None:
+        at_state = ev.state
+        self._release(ev)
+        if ev.notice_id and ev.drained_at is None:
+            # Nothing was evicted: the notice must not linger as a
+            # phantom barrier on the gang.
+            clear_notice(self.client, ev.gang, ev.namespace, ev.notice_id)
+        elif ev.notice_id:
+            # Pods WERE evicted; clear the (stamped) notice so a future
+            # planned eviction can post a fresh barrier — the stamped
+            # eviction record already fed the counters.
+            clear_notice(self.client, ev.gang, ev.namespace, ev.notice_id)
+        ev.state, ev.outcome = "Aborted", f"aborted:{reason}"
+        ev.finished_at = time.time()
+        self._finish(ev)
+        self.counters["aborted"] += 1
+        GLOBAL_METRICS.inc("grove_disruption_evacuations_aborted_total",
+                           reason=reason)
+        self.log.warning("reclaim: evacuation of %s/%s aborted (%s) "
+                         "at %s", ev.namespace, ev.gang, reason, at_state)
+        self._event(ev, "Warning", "SpotReclaimAborted",
+                    f"evacuation aborted ({reason}) at {at_state}; "
+                    "hold released, self-heal owns the gang now")
+
+    def _release(self, ev: _Evacuation) -> None:
+        release_hold(self.client, ev.gang, ev.namespace, ev.reservation)
+
+    def _delete_reservation(self, name: str, namespace: str) -> None:
+        try:
+            self.client.delete(SliceReservation, name, namespace)
+        except (NotFoundError, GroveError):
+            pass
+
+    def _finish(self, ev: _Evacuation) -> None:
+        with self._lock:
+            self._recent.appendleft(ev.to_dict())
+            self._active.pop((ev.namespace, ev.gang), None)
+
+    def _event(self, ev: _Evacuation, etype: str, reason: str,
+               message: str) -> None:
+        try:
+            gang = self.client.get(PodGang, ev.gang, ev.namespace)
+        except (NotFoundError, GroveError):
+            return
+        self.recorder.event(gang, etype, reason, message)
+
+    # ---- read surface ----------------------------------------------------
+
+    def payload(self) -> dict:
+        """The /debug/disruption wire shape (grovectl disruptions
+        renders it; one shape in-process and over HTTP)."""
+        notices = []
+        try:
+            for gang in self.client.list(PodGang, None):
+                n = notice_of(gang)
+                if n is None:
+                    continue
+                d = {"gang": f"{gang.meta.namespace}/{gang.meta.name}",
+                     "state": barrier_state(n)}
+                d.update({k: getattr(n, k) for k in (
+                    "id", "reason", "requested_at", "deadline", "acked_at",
+                    "ack_source", "evicted_at", "barrier", "coalesced")})
+                notices.append(d)
+        except GroveError:
+            pass
+        with self._lock:
+            inflight = [e.to_dict() for e in self._active.values()]
+            recent = list(self._recent)
+        return {
+            "contract_enabled": disruption_enabled(),
+            "config": {
+                "sync_period_seconds": self.cfg.sync_period_seconds,
+                "default_deadline_seconds":
+                    self.cfg.default_deadline_seconds,
+                "max_concurrent_evacuations":
+                    self.cfg.max_concurrent_evacuations,
+                "rehold_attempts": self.cfg.rehold_attempts,
+            },
+            "counters": dict(self.counters),
+            "notices": notices,
+            "inflight": inflight,
+            "recent": recent,
+        }

@@ -556,6 +556,64 @@ GLOBAL_METRICS.describe_histogram(
     "Wall time of one completed migration, hold creation to full "
     "reland on the target slice",
     buckets=LIFECYCLE_BUCKETS)
+# Disruption contract + spot-slice reclamation (grove_tpu/disruption,
+# docs/design/disruption-contract.md): every planned eviction's
+# checkpoint barrier, and the reclaim controller's evacuations.
+GLOBAL_METRICS.describe(
+    "grove_disruption_notices_total",
+    "DisruptionNotices posted per reason (defrag-migration|"
+    "rolling-update|spot-reclaim) — coalesced joins onto a live notice "
+    "do not count again")
+GLOBAL_METRICS.describe(
+    "grove_disruption_acks_total",
+    "Checkpoint-barrier acknowledgments per source (workload=a "
+    "registered responder's checkpoint completed, auto=no responder "
+    "registered so nothing needed flushing)")
+GLOBAL_METRICS.describe(
+    "grove_disruption_expired_total",
+    "Barriers that hit their deadline unacked per reason — the "
+    "eviction proceeded anyway, stamped barrier=expired (the workload "
+    "delays, never vetoes)")
+GLOBAL_METRICS.describe(
+    "grove_disruption_evictions_total",
+    "Planned evictions executed per reason and barrier verdict "
+    "(acked|expired) — the disruption-contract invariant's counters")
+GLOBAL_METRICS.describe(
+    "grove_disruption_ack_failures_total",
+    "Checkpoint responder failures per reason (each retries with "
+    "exponential backoff until the ack lands or the deadline expires)")
+GLOBAL_METRICS.describe(
+    "grove_disruption_evacuations_total",
+    "Spot-reclaim evacuations started (one per gang on reclaim-"
+    "noticed capacity)")
+GLOBAL_METRICS.describe(
+    "grove_disruption_evacuations_completed_total",
+    "Evacuations that relanded their gang Ready on surviving capacity")
+GLOBAL_METRICS.describe(
+    "grove_disruption_evacuations_aborted_total",
+    "Evacuations abandoned per reason (victim-gone|rebind-timeout) — "
+    "every abort releases its hold and notice; self-heal owns the "
+    "gang afterward")
+GLOBAL_METRICS.describe(
+    "grove_disruption_reholds_total",
+    "Mid-evacuation hold re-takes after a reservation TTL expiry or "
+    "loss — the evacuation requeues instead of stranding a "
+    "half-drained gang")
+GLOBAL_METRICS.describe(
+    "grove_disruption_inflight",
+    "Gang evacuations currently executing (notice/barrier/hold/"
+    "reland)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_disruption_barrier_wait_seconds",
+    "Notice post to checkpoint ack (auto-acks observe ~0) — how long "
+    "planned evictions wait on workloads",
+    buckets=LIFECYCLE_BUCKETS)
+GLOBAL_METRICS.describe_histogram(
+    "grove_disruption_reclaim_to_ready_seconds",
+    "Spot-reclamation notice to the evacuated gang Ready again on "
+    "surviving capacity — the reclaim robustness headline "
+    "(make bench-reclaim pins it)",
+    buckets=LIFECYCLE_BUCKETS)
 GLOBAL_METRICS.describe(
     "grove_autoscaler_conflicts_total",
     "Scale writes rejected by the store (conflict or validation) per "

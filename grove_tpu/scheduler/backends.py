@@ -890,6 +890,28 @@ class GangBackend:
             first = False
         return pool
 
+    def _mirror_disruption(self, gang: PodGang):
+        """Mirror the disruption-notice annotation into
+        ``status.disruption`` and return the DisruptionTarget condition
+        to set (None when there is no notice and no stale True
+        condition to clear). Mirror-only: posting/acking/clearing live
+        in disruption/contract.py."""
+        from grove_tpu.disruption.contract import barrier_state, notice_of
+        notice = notice_of(gang)
+        gang.status.disruption = notice
+        if notice is not None:
+            state = barrier_state(notice)
+            return Condition(
+                type=c.COND_DISRUPTION_TARGET, status="True",
+                reason=notice.reason,
+                message=f"barrier {state} (notice {notice.id}"
+                        + (f", evicted" if notice.evicted_at else "") + ")")
+        if is_condition_true(gang.status.conditions,
+                             c.COND_DISRUPTION_TARGET):
+            return Condition(type=c.COND_DISRUPTION_TARGET,
+                             status="False", reason="NoticeCleared")
+        return None
+
     def _gang_hold(self, gang: PodGang) -> tuple[str, str]:
         """Resolve the gang's reuse-reservation-ref annotation to a
         BOUND SliceReservation: (name, first bound slice). ("", "")
@@ -997,6 +1019,12 @@ class GangBackend:
         # rides every status write instead of adding a second writer.
         gang.status.reuse_reservation_ref = gang.meta.annotations.get(
             c.ANNOTATION_RESERVATION_REF, "")
+        # Same single-writer mirror for the disruption contract: the
+        # live notice (disruption/contract.py annotation) lands in
+        # status.disruption + a DisruptionTarget condition carrying the
+        # barrier verdict, so every read surface sees the planned
+        # eviction without a second status writer.
+        disruption_cond = self._mirror_disruption(gang)
         existing, expected, _ = self._gang_pods(gang, snap)
         bound = sum(1 for p in existing if p.status.node_name)
         ready = sum(1 for p in existing
@@ -1027,6 +1055,8 @@ class GangBackend:
             type=c.COND_READY,
             status="True" if all_ready else "False",
             reason=f"{ready}/{expected} ready"))
+        if disruption_cond is not None:
+            conds = set_condition(conds, disruption_cond)
         # Placement explainability: mirror the diagnosis headline into
         # an Unschedulable condition; on schedule, observe how long the
         # gang sat pending and clear the diagnosis (it answered its
@@ -1081,10 +1111,13 @@ class GangBackend:
                 fresh.status.placement_score = gang.status.placement_score
                 fresh.status.last_diagnosis = gang.status.last_diagnosis
                 # Re-mirror from the FRESH annotations: the conflicting
-                # writer may have been the hold path itself.
+                # writer may have been the hold path (or the disruption
+                # contract) itself.
                 fresh.status.reuse_reservation_ref = \
                     fresh.meta.annotations.get(
                         c.ANNOTATION_RESERVATION_REF, "")
+                from grove_tpu.disruption.contract import notice_of
+                fresh.status.disruption = notice_of(fresh)
                 write(fresh)
             except (ConflictError, NotFoundError):
                 pass  # next pass recomputes from live state

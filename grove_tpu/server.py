@@ -60,6 +60,11 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       roofline estimates; grovectl
                                       engine-profile renders it; same
                                       read gate as /debug/placement)
+  GET  /debug/disruption              disruption-contract ledger: live
+                                      notices with barrier state,
+                                      in-flight/recent spot-reclaim
+                                      evacuations (grovectl
+                                      disruptions renders it)
   GET  /debug/defrag                  defrag plan ledger: in-flight
                                       migration, recent plans, budget
                                       (grovectl defrag-status renders
@@ -487,6 +492,8 @@ class ApiServer:
                         self._debug_xprof(parts[2], parts[3])
                     elif url.path == "/debug/defrag":
                         self._debug_defrag()
+                    elif url.path == "/debug/disruption":
+                        self._debug_disruption()
                     elif url.path == "/debug/leadership":
                         self._debug_leadership()
                     else:
@@ -794,6 +801,16 @@ class ApiServer:
                 NotFoundError from the twin maps to 404 in do_GET's
                 handler."""
                 self._send(200, cluster.client.debug_defrag())
+
+            def _debug_disruption(self):
+                """GET /debug/disruption — the disruption-contract
+                ledger (``grovectl disruptions`` renders it): live
+                notices with barrier state, in-flight and recent
+                spot-reclaim evacuations, counters. Aggregate
+                operational state like /debug/defrag, so it shares the
+                read gate, not the profiling gate. NotFoundError from
+                the twin maps to 404 in do_GET's handler."""
+                self._send(200, cluster.client.debug_disruption())
 
             def _debug_leadership(self):
                 """GET /debug/leadership — this replica's leadership

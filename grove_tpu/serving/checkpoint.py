@@ -47,3 +47,49 @@ def latest_step(path: str) -> int | None:
         return None
     steps = [int(d) for d in os.listdir(path) if d.isdigit()]
     return max(steps) if steps else None
+
+
+# ---- engine warm restart (the disruption contract's checkpoint path) ----
+
+
+def save_engine(path: str, engine: Any, step: int | None = None) -> str:
+    """Checkpoint a serving engine's params; returns the checkpoint
+    directory. ``step`` defaults to one past the latest existing step
+    so repeated barriers (a roll's per-victim checkpoints, storm
+    coalescing) never clobber the previous durable state."""
+    if step is None:
+        prev = latest_step(path)
+        step = 0 if prev is None else prev + 1
+    return save_params(path, engine.params, step=step)
+
+
+def warm_restart(path: str, engine: Any, step: int | None = None) -> int:
+    """Restore the latest (or given) checkpoint onto a serving engine
+    in place — restored leaves land directly on the engine's current
+    mesh via the sharding-aware loader, so the relanded replica of an
+    evacuated gang resumes serving without re-downloading or
+    re-sharding weights. Returns the step restored."""
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint steps under {path!r} to warm-restart from")
+    engine.params = load_params(path, step, like=engine.params)
+    return step
+
+
+def engine_responder(engine: Any, path: str):
+    """Build a disruption-barrier checkpoint responder for ``engine``
+    (grove_tpu/disruption): register it with
+    ``disruption.register_responder(gang_name, engine_responder(e, d))``
+    and every planned eviction of the gang — defrag migration, rolling
+    update, spot reclaim — flushes the engine's params durably before
+    its pods are drained; the relanded replica ``warm_restart``s from
+    the same directory. Raising propagates to the reclaim controller's
+    retry/backoff loop, so a transiently failing save is retried until
+    the deadline."""
+
+    def respond(_notice) -> None:
+        save_engine(path, engine)
+
+    return respond

@@ -166,6 +166,22 @@ class Client:
                 "(no started Manager owns it, or defrag.enabled=False)")
         return dc.payload()
 
+    def debug_disruption(self) -> dict:
+        """The disruption-contract ledger — the in-process twin of
+        ``GET /debug/disruption`` (same payload shape; grovectl
+        disruptions renders either). Raises NotFoundError when no
+        reclaim controller runs on this store
+        (disruption.enabled=False)."""
+        from grove_tpu.disruption.reclaim import reclaim_for
+        from grove_tpu.runtime.errors import NotFoundError
+        rc = reclaim_for(self._store)
+        if rc is None:
+            raise NotFoundError(
+                "reclaim controller is not running for this store "
+                "(no started Manager owns it, or disruption.enabled="
+                "False)")
+        return rc.payload()
+
     def debug_leadership(self) -> dict:
         """This replica's leadership view — the in-process twin of
         ``GET /debug/leadership`` (same payload shape; grovectl

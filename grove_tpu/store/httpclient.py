@@ -312,6 +312,12 @@ class HttpClient:
         twin of ``Client.debug_defrag``; 404 maps to NotFoundError)."""
         return self._request("GET", "/debug/defrag")
 
+    def debug_disruption(self) -> dict:
+        """The disruption-contract ledger from ``GET /debug/disruption``
+        (the wire twin of ``Client.debug_disruption``; 404 maps to
+        NotFoundError)."""
+        return self._request("GET", "/debug/disruption")
+
     def debug_leadership(self) -> dict:
         """This replica's leadership view from ``GET /debug/leadership``
         (the wire twin of ``Client.debug_leadership``; grovectl

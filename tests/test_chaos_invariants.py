@@ -316,6 +316,73 @@ def test_live_referenced_hold_and_pcs_reservation_green(quiet_cluster):
     assert make_checker(quiet_cluster).check_defrag_holds() == []
 
 
+# ---- disruption-contract ------------------------------------------------
+
+def _notice_json(**over) -> str:
+    """A DisruptionNotice annotation value with sane defaults (the
+    deadline is notice DATA the checker reads, not a wait budget —
+    far future so the synthetic notice reads pending)."""
+    import json
+    now = time.time()
+    base = {"id": "n-test", "reason": "spot-reclaim",
+            "requested_at": now - 5.0,
+            "deadline": now + 30.0,
+            "acked_at": 0.0, "ack_source": "", "evicted_at": 0.0,
+            "barrier": "", "coalesced": 0}
+    base.update(over)
+    return json.dumps(base)
+
+
+def test_eviction_without_barrier_fires(quiet_cluster):
+    """A gang stamped evicted while its barrier still reads pending is
+    THE contract breach: pods were deleted without an ack or a
+    deadline expiry."""
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta("breached", annotations={
+        c.ANNOTATION_DISRUPTION_NOTICE: _notice_json(
+            evicted_at=time.time(), barrier="pending")}))
+    client.create(gang)
+    found = make_checker(quiet_cluster).check_disruption_contract()
+    assert [v.invariant for v in found] == ["disruption-contract"]
+    assert "without an ack or a deadline expiry" in found[0].detail
+
+
+def test_condition_without_notice_fires(quiet_cluster):
+    """DisruptionTarget=True with no notice annotation: the barrier
+    record vanished while a surface still claims an eviction is in
+    flight."""
+    client = quiet_cluster.client
+    gang = PodGang(meta=new_meta("phantom"))
+    client.create(gang)
+    live = client.get(PodGang, "phantom")
+    live.status.conditions = set_condition(
+        live.status.conditions,
+        Condition(type=c.COND_DISRUPTION_TARGET, status="True",
+                  reason="spot-reclaim"))
+    client.update_status(live)
+    found = make_checker(quiet_cluster).check_disruption_contract()
+    assert [v.invariant for v in found] == ["disruption-contract"]
+    assert "annotation is absent" in found[0].detail
+
+
+def test_acked_and_expired_evictions_green(quiet_cluster):
+    """The two sanctioned eviction shapes — barrier acked, and barrier
+    expired (deadline passed unacked) — plus a pending-but-unevicted
+    notice all stay silent."""
+    client = quiet_cluster.client
+    client.create(PodGang(meta=new_meta("acked-ok", annotations={
+        c.ANNOTATION_DISRUPTION_NOTICE: _notice_json(
+            acked_at=time.time() - 1.0, ack_source="workload",
+            evicted_at=time.time(), barrier="acked")})))
+    client.create(PodGang(meta=new_meta("expired-ok", annotations={
+        c.ANNOTATION_DISRUPTION_NOTICE: _notice_json(
+            deadline=time.time() - 1.0,
+            evicted_at=time.time(), barrier="expired")})))
+    client.create(PodGang(meta=new_meta("pending-unevicted", annotations={
+        c.ANNOTATION_DISRUPTION_NOTICE: _notice_json()})))
+    assert make_checker(quiet_cluster).check_disruption_contract() == []
+
+
 def test_empty_cluster_sweeps_green(quiet_cluster):
     assert make_checker(quiet_cluster).sweep() == []
 

@@ -172,6 +172,11 @@ def test_defrag_completion_bypasses_refresh_throttle(monkeypatch):
                               attempts=3, first_failure_time=50.0,
                               last_attempt_time=100.0)
     fresh = PlacementDiagnosis(reason="Fragmented", message="m")
+    # Reset the completion stamp first: any earlier test that ran a
+    # REAL migration (its _complete calls note_defrag_completed with
+    # wall time) would otherwise trip the bypass against this test's
+    # fake clock.
+    explain.note_defrag_completed(now=0.0)
     # Inside the window, unchanged failure: throttled to the old record.
     assert explain.merge_diagnosis(prev, fresh, now=101.0) is prev
     # A defrag completion changed the world: the same merge refreshes.

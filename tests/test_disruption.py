@@ -1,0 +1,657 @@
+"""Disruption contract + spot-slice reclamation (grove_tpu/disruption,
+ISSUE 14): the DisruptionNotice lifecycle and its edge cases, the
+reclaim controller's notice → barrier → hold → drain → reland state
+machine (driven synchronously, the test_defrag pattern), the
+TTL-expiry-requeues-the-evacuation fix, and the pins proving the defrag
+executor and the rolling-update path route through the SAME barrier.
+
+Contract tests run against an unstarted, admission-free cluster (a
+store the test owns); controller tests drive a manually-constructed
+ReclaimController sweep by sweep against a live cluster whose auto
+controller is disabled.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from grove_tpu.api import (
+    Node,
+    Pod,
+    PodCliqueSet,
+    PodGang,
+    SliceReservation,
+    constants as c,
+    new_meta,
+)
+from grove_tpu.api.config import OperatorConfiguration
+from grove_tpu.api.core import ContainerSpec
+from grove_tpu.api.meta import is_condition_true
+from grove_tpu.api.podcliqueset import (
+    PodCliqueSetSpec,
+    PodCliqueSetTemplate,
+    PodCliqueTemplate,
+    TopologyConstraint,
+)
+from grove_tpu.cluster import new_cluster
+from grove_tpu.disruption import (
+    DISRUPTION_ENV,
+    REASON_DEFRAG,
+    REASON_RECLAIM,
+    REASON_ROLLING,
+    ack_notice,
+    barrier_state,
+    clear_notice,
+    note_evicted,
+    notice_of,
+    post_notice,
+    reclaim_hold_name,
+    register_responder,
+    request_barrier,
+    unregister_responder,
+)
+from grove_tpu.disruption.reclaim import ReclaimController, \
+    render_disruptions
+from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+from test_e2e_simple import wait_for
+
+
+# ---- contract (unstarted cluster: the notice is just data) ---------------
+
+
+@pytest.fixture
+def quiet():
+    cluster = new_cluster(admission=False, fake_kubelet=False)
+    cluster.client.create(PodGang(meta=new_meta("g")))
+    return cluster
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_responders():
+    yield
+    from grove_tpu.disruption import contract
+    with contract._RESPONDERS_LOCK:
+        contract._RESPONDERS.clear()
+
+
+def test_post_auto_acks_without_responder(quiet):
+    """The no-serving-engine case: nothing registered a checkpoint
+    hook, so there is nothing to flush — the barrier auto-acks at post
+    time and the eviction proceeds without a round trip."""
+    n = post_notice(quiet.client, "g", "default", REASON_RECLAIM, 30.0)
+    assert n is not None and n.ack_source == "auto" and n.acked_at > 0
+    assert barrier_state(n) == "acked"
+    # The annotation is the durable copy.
+    live = notice_of(quiet.client.get(PodGang, "g"))
+    assert live.id == n.id and live.acked_at == n.acked_at
+
+
+def test_double_notice_coalesces(quiet):
+    """A second caller (another reason entirely) joins the live notice
+    — the workload checkpoints once no matter how many planned
+    evictions want it moved. Deadlines only ever SHRINK on coalesce: a
+    later, more urgent caller (a spot reclaim racing the hardware) can
+    pull the barrier in; nobody can extend a stay of execution."""
+    register_responder("g", lambda notice: None)
+    first = post_notice(quiet.client, "g", "default", REASON_RECLAIM, 30.0)
+    assert barrier_state(first) == "pending"
+    second = post_notice(quiet.client, "g", "default", REASON_ROLLING, 5.0)
+    assert second.id == first.id
+    assert second.deadline < first.deadline    # urgency pulls it in
+    assert second.reason == REASON_RECLAIM     # the original stands
+    assert second.coalesced == 1
+    third = post_notice(quiet.client, "g", "default", REASON_DEFRAG, 99.0)
+    assert third.id == first.id and third.coalesced == 2
+    assert third.deadline == second.deadline   # never extended
+
+
+def test_workload_ack_and_eviction_stamp(quiet):
+    register_responder("g", lambda notice: None)
+    n = post_notice(quiet.client, "g", "default", REASON_DEFRAG, 30.0)
+    assert barrier_state(n) == "pending"
+    assert ack_notice(quiet.client, "g", "default", n.id)
+    live = notice_of(quiet.client.get(PodGang, "g"))
+    assert barrier_state(live) == "acked"
+    assert live.ack_source == "workload"
+    assert note_evicted(quiet.client, "g", "default", n.id) == "acked"
+    live = notice_of(quiet.client.get(PodGang, "g"))
+    assert live.evicted_at > 0 and live.barrier == "acked"
+    # Repeat stamps are id-CAS'd no-ops.
+    first_stamp = live.evicted_at
+    assert note_evicted(quiet.client, "g", "default", n.id) == "acked"
+    assert notice_of(quiet.client.get(PodGang, "g")).evicted_at \
+        == first_stamp
+
+
+def test_ack_after_deadline_is_recorded_but_stays_expired(quiet):
+    """The eviction already proceeded under expired; a late ack is
+    evidence, not a verdict change."""
+    register_responder("g", lambda notice: None)
+    n = post_notice(quiet.client, "g", "default", REASON_RECLAIM, 0.01)
+    wait_for(lambda: barrier_state(
+        notice_of(quiet.client.get(PodGang, "g"))) == "expired",
+        5.0, desc="deadline to pass")
+    assert note_evicted(quiet.client, "g", "default", n.id) == "expired"
+    assert ack_notice(quiet.client, "g", "default", n.id)   # recorded
+    live = notice_of(quiet.client.get(PodGang, "g"))
+    assert live.acked_at > live.deadline
+    assert barrier_state(live) == "expired"    # verdict unchanged
+    assert live.barrier == "expired"
+
+
+def test_disabled_contract_restores_pre_contract_eviction(quiet,
+                                                          monkeypatch):
+    """GROVE_DISRUPTION=0: post_notice returns None, request_barrier
+    says proceed, and NOTHING is written to the gang — the exact
+    pre-contract shape."""
+    monkeypatch.setenv(DISRUPTION_ENV, "0")
+    register_responder("g", lambda notice: None)   # even with a hook
+    assert post_notice(quiet.client, "g", "default",
+                       REASON_RECLAIM, 30.0) is None
+    state, notice = request_barrier(quiet.client, "g", "default",
+                                    REASON_ROLLING, 30.0)
+    assert state == "disabled" and notice is None
+    gang = quiet.client.get(PodGang, "g")
+    assert c.ANNOTATION_DISRUPTION_NOTICE not in gang.meta.annotations
+
+
+def test_clear_notice_is_id_cased(quiet):
+    n = post_notice(quiet.client, "g", "default", REASON_RECLAIM, 30.0)
+    clear_notice(quiet.client, "g", "default", "someone-elses-id")
+    assert notice_of(quiet.client.get(PodGang, "g")).id == n.id
+    clear_notice(quiet.client, "g", "default", n.id)
+    assert notice_of(quiet.client.get(PodGang, "g")) is None
+
+
+def test_scheduler_mirrors_notice_into_status(quiet):
+    """The single-status-writer mirror: status.disruption + the
+    DisruptionTarget condition ride the scheduler's status write."""
+    from grove_tpu.scheduler.backends import GangBackend
+    n = post_notice(quiet.client, "g", "default", REASON_RECLAIM, 30.0)
+    backend = GangBackend()
+    gang = quiet.client.get(PodGang, "g")
+    cond = backend._mirror_disruption(gang)
+    assert gang.status.disruption is not None
+    assert gang.status.disruption.id == n.id
+    assert cond is not None and cond.status == "True"
+    assert cond.reason == REASON_RECLAIM
+    assert "acked" in cond.message
+    # Notice cleared: a stale True condition flips to False once.
+    clear_notice(quiet.client, "g", "default", n.id)
+    from grove_tpu.api.meta import set_condition
+    gang = quiet.client.get(PodGang, "g")
+    gang.status.conditions = set_condition(gang.status.conditions, cond)
+    cond2 = backend._mirror_disruption(gang)
+    assert gang.status.disruption is None
+    assert cond2 is not None and cond2.status == "False"
+
+
+# ---- reclaim controller (manual drive) -----------------------------------
+
+
+def _pcs(name: str, pods: int, chips: int,
+         min_available: int | None = None) -> PodCliqueSet:
+    return PodCliqueSet(
+        meta=new_meta(name),
+        spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+            cliques=[PodCliqueTemplate(
+                name="w", replicas=pods,
+                min_available=(pods if min_available is None
+                               else min_available),
+                tpu_chips_per_pod=chips,
+                container=ContainerSpec(argv=["sleep", "inf"]))],
+            topology=TopologyConstraint(pack_level="slice",
+                                        required=True))))
+
+
+def _manual_cluster(slices: int = 2):
+    """Cluster with the auto reclaim controller DISABLED — tests drive
+    their own controller sweep by sweep."""
+    cfg = OperatorConfiguration()
+    cfg.disruption.enabled = False
+    return new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=slices)]))
+
+
+def _live_pods(client, pcs_name=None):
+    sel = {c.LABEL_PCS_NAME: pcs_name} if pcs_name else None
+    return [p for p in client.list(Pod, selector=sel)
+            if p.meta.deletion_timestamp is None]
+
+
+def _deploy_workload(client, name="work", pods=2, chips=4,
+                     min_available=None) -> PodGang:
+    client.create(_pcs(name, pods, chips, min_available))
+    wait_for(lambda: (lambda ps: len(ps) == pods and all(
+        p.status.node_name for p in ps))(_live_pods(client, name)),
+        20.0, desc=f"{name} placed")
+    gang = client.list(PodGang, selector={c.LABEL_PCS_NAME: name})[0]
+    wait_for(lambda: is_condition_true(
+        client.get(PodGang, gang.meta.name).status.conditions,
+        c.COND_READY), 20.0, desc=f"{name} ready")
+    return client.get(PodGang, gang.meta.name)
+
+
+def _notice_slice(client, slice_name: str, in_s: float = 60.0) -> None:
+    deadline = str(time.time() + in_s)
+    for n in client.list(Node):
+        if n.meta.labels.get(c.NODE_LABEL_SLICE) == slice_name:
+            client.patch(Node, n.meta.name, {"metadata": {"annotations": {
+                c.ANNOTATION_RECLAIM_AT: deadline}}})
+
+
+def _drive(rc: ReclaimController, until, timeout=25.0,
+           desc="reclaim progress"):
+    from timing import TIME_SCALE
+    deadline = time.time() + timeout * TIME_SCALE
+    while time.time() < deadline:
+        rc.sweep()
+        if until():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out driving reclaim: {desc}")
+
+
+def test_reclaim_evacuates_gang_to_surviving_slice():
+    cluster = _manual_cluster()
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client)
+        src = gang.status.assigned_slice
+        cfg = OperatorConfiguration().disruption
+        rc = ReclaimController(client, cluster.manager.store, cfg)
+        _notice_slice(client, src)
+
+        def relanded_ready():
+            g = client.get(PodGang, gang.meta.name)
+            return (g.status.assigned_slice not in ("", src)
+                    and is_condition_true(g.status.conditions,
+                                          c.COND_READY))
+        _drive(rc, lambda: rc.counters["completed"] >= 1
+               and relanded_ready(), desc="evacuation to complete")
+        # Barrier honored (auto-ack: no responder), record audited.
+        done = rc.payload()["recent"][0]
+        assert done["outcome"] == "evacuated"
+        assert done["barrier"] == "acked"
+        assert done["source_slices"] == [src]
+        # Holds and notice fully released.
+        wait_for(lambda: not client.list(SliceReservation), 10.0,
+                 desc="reclaim hold released")
+        g = client.get(PodGang, gang.meta.name)
+        assert c.ANNOTATION_DISRUPTION_NOTICE not in g.meta.annotations
+        assert c.ANNOTATION_RESERVATION_REF not in g.meta.annotations
+        # The chaos invariants stay green through the whole shape.
+        from grove_tpu.chaos.invariants import InvariantChecker
+        checker = InvariantChecker(cluster, bind_deadline_s=5.0,
+                                   owner_deadline_s=5.0)
+        assert checker.check_disruption_contract() == []
+        assert checker.check_gang_binding() == []
+        assert checker.check_no_duplicates() == []
+
+
+def test_reclaim_ttl_expiry_requeues_the_evacuation():
+    """The ISSUE 14 fix: a hold lost mid-evacuation (TTL expiry — which
+    also clears the gang's reuse-reservation-ref, the PR 9 precedent)
+    must RE-HOLD and continue, never strand a half-drained gang."""
+    cluster = _manual_cluster()
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client)
+        src = gang.status.assigned_slice
+        cfg = OperatorConfiguration().disruption
+        rc = ReclaimController(client, cluster.manager.store, cfg)
+        _notice_slice(client, src)
+        # One sweep: barrier auto-acks and the hold is taken (state
+        # Holding). Now lose the hold the way TTL expiry does —
+        # reservation deleted AND annotation cleared — BEFORE the next
+        # sweep can observe it bound and drain.
+        rc.sweep()
+        inflight = rc.payload()["inflight"]
+        assert inflight and inflight[0]["state"] == "Holding" \
+            and inflight[0]["pinned"], inflight
+        hold = reclaim_hold_name(gang.meta.name)
+        from grove_tpu.defrag import set_reservation_ref
+        client.delete(SliceReservation, hold)
+        set_reservation_ref(client, gang.meta.name, "default", "",
+                            expect=(hold,))
+        _drive(rc, lambda: rc.counters["completed"] >= 1,
+               desc="evacuation completes after re-hold")
+        assert rc.counters["reholds"] >= 1
+        done = rc.payload()["recent"][0]
+        assert done["outcome"] == "evacuated"
+        assert done["reholds"] >= 1
+        g = client.get(PodGang, gang.meta.name)
+        assert g.status.assigned_slice != src
+        assert c.ANNOTATION_RESERVATION_REF not in g.meta.annotations
+        wait_for(lambda: not client.list(SliceReservation), 10.0,
+                 desc="re-held reservation released at completion")
+
+
+def test_reclaim_with_contract_disabled_still_evacuates(monkeypatch):
+    """GROVE_DISRUPTION=0 strips the barrier, not the robustness: the
+    evacuation runs immediately with barrier=disabled and no notice is
+    ever written."""
+    monkeypatch.setenv(DISRUPTION_ENV, "0")
+    cluster = _manual_cluster()
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client)
+        src = gang.status.assigned_slice
+        rc = ReclaimController(client, cluster.manager.store,
+                               OperatorConfiguration().disruption)
+        _notice_slice(client, src)
+        _drive(rc, lambda: rc.counters["completed"] >= 1,
+               desc="barrier-less evacuation")
+        done = rc.payload()["recent"][0]
+        assert done["barrier"] == "disabled"
+        g = client.get(PodGang, gang.meta.name)
+        assert c.ANNOTATION_DISRUPTION_NOTICE not in g.meta.annotations
+        assert g.status.assigned_slice != src
+
+
+def test_responder_retry_backoff_then_ack():
+    """A transiently failing checkpoint retries with backoff and the
+    barrier resolves acked once it lands."""
+    cluster = _manual_cluster()
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client)
+        src = gang.status.assigned_slice
+        cfg = OperatorConfiguration().disruption
+        cfg.ack_retry_base_seconds = 0.01
+        cfg.ack_retry_max_seconds = 0.05
+        rc = ReclaimController(client, cluster.manager.store, cfg)
+        calls = {"n": 0}
+
+        def flaky_checkpoint(notice):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("checkpoint volume hiccup")
+        register_responder(gang.meta.name, flaky_checkpoint)
+        _notice_slice(client, src)
+        _drive(rc, lambda: rc.counters["completed"] >= 1,
+               desc="evacuation after flaky checkpoint acks")
+        assert calls["n"] >= 3
+        assert rc.counters["ack_failures"] >= 2
+        assert rc.payload()["recent"][0]["barrier"] == "acked"
+
+
+def test_unacked_barrier_expires_and_eviction_proceeds():
+    """The deadline is a promise both ways: a workload that never acks
+    delays the eviction, never vetoes it — stamped barrier=expired."""
+    cluster = _manual_cluster()
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client)
+        src = gang.status.assigned_slice
+        cfg = OperatorConfiguration().disruption
+        cfg.default_deadline_seconds = 0.3
+        rc = ReclaimController(client, cluster.manager.store, cfg)
+
+        def never_acks(notice):
+            raise RuntimeError("checkpoint never completes")
+        register_responder(gang.meta.name, never_acks)
+        _notice_slice(client, src)
+        _drive(rc, lambda: rc.counters["completed"] >= 1,
+               desc="expired-barrier evacuation")
+        done = rc.payload()["recent"][0]
+        assert done["barrier"] == "expired"
+        assert rc.counters["expired"] >= 1
+        g = client.get(PodGang, gang.meta.name)
+        assert g.status.assigned_slice != src
+
+
+# ---- both callers route through the same barrier -------------------------
+
+
+def test_defrag_drain_waits_for_the_barrier():
+    """Pin: the defrag executor posts a defrag-migration notice at hold
+    time and will not drain while the barrier is pending — the SAME
+    contract the reclaim controller uses."""
+    from grove_tpu.defrag import DefragController
+    cfg = OperatorConfiguration()
+    cfg.defrag.enabled = False
+    cfg.disruption.enabled = False
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=2)]))
+    with cluster:
+        client = cluster.client
+        # Post-churn fragmentation (the test_defrag shape): every host
+        # half-free, a 4-chip gang placeable nowhere.
+        for i in range(8):
+            client.create(_pcs(f"filler{i}", 1, 2))
+        wait_for(lambda: (lambda ps: len(ps) == 8 and all(
+            p.status.node_name for p in ps))(_live_pods(client)),
+            30.0, desc="fillers placed")
+        by_host: dict[str, list] = {}
+        for p in _live_pods(client):
+            by_host.setdefault(p.status.node_name, []).append(p)
+        for pods_on_host in by_host.values():
+            client.delete(PodCliqueSet,
+                          pods_on_host[0].meta.labels[c.LABEL_PCS_NAME])
+        wait_for(lambda: len(_live_pods(client)) == 4, 20.0,
+                 desc="departures pruned")
+        client.create(_pcs("stuck", 1, 4))
+        wait_for(lambda: any(
+            g.status.last_diagnosis is not None
+            for g in client.list(PodGang,
+                                 selector={c.LABEL_PCS_NAME: "stuck"})),
+            15.0, desc="stuck diagnosed")
+
+        dcfg = OperatorConfiguration().defrag
+        dcfg.cooldown_seconds = 0.0
+        dc = DefragController(client, cluster.manager.store, dcfg)
+        dc.sweep()
+        assert dc._active is not None
+        victim = dc._active.plan.victim_gang
+        # The victim's (pre-registered for every gang — we don't know
+        # the victim ahead of the plan) responder holds the barrier.
+        # Register late is fine: the notice was posted WITHOUT a
+        # responder... so instead assert the posted notice exists and
+        # carries the defrag reason, then that drain waits on pending.
+        notice = notice_of(client.get(PodGang, victim))
+        assert notice is not None and notice.reason == REASON_DEFRAG
+        # Force the barrier back to pending to prove the executor
+        # waits: rewrite the notice unacked (the store is ours).
+        import dataclasses as _dc
+        from grove_tpu.disruption.contract import _encode
+        g = client.get(PodGang, victim)
+        g.meta.annotations[c.ANNOTATION_DISRUPTION_NOTICE] = _encode(
+            _dc.replace(notice, acked_at=0.0, ack_source=""))
+        client.update(g)
+        pods_before = {p.meta.name for p in _live_pods(client)
+                       if p.meta.labels.get(c.LABEL_PODGANG_NAME) == victim}
+        wait_for(lambda: client.get(
+            SliceReservation,
+            dc._active.reservation).status.bound_slices, 10.0,
+            desc="defrag hold bound")
+        for _ in range(5):
+            dc.sweep()
+            time.sleep(0.02)
+        assert dc._active is not None and dc._active.state == "Holding"
+        pods_now = {p.meta.name for p in _live_pods(client)
+                    if p.meta.labels.get(c.LABEL_PODGANG_NAME) == victim}
+        assert pods_now == pods_before, \
+            "defrag drained through a PENDING barrier"
+        # Ack → the very next sweeps drain and the migration runs to
+        # completion, stamped acked.
+        assert ack_notice(client, victim, "default", notice.id)
+        from timing import TIME_SCALE
+        deadline = time.time() + 30.0 * TIME_SCALE
+        while time.time() < deadline and dc.counters["executed"] < 1:
+            dc.sweep()
+            time.sleep(0.05)
+        assert dc.counters["executed"] == 1
+        assert dc._recent[0]["barrier"] == "acked"
+        # Notice cleared with the migration's release.
+        wait_for(lambda: notice_of(
+            client.get(PodGang, victim)) is None, 10.0,
+            desc="defrag notice cleared")
+
+
+def _roll_edit(client, name="roll"):
+    from grove_tpu.runtime.errors import GroveError
+    for _ in range(10):
+        try:
+            pcs = client.get(PodCliqueSet, name)
+            for t in pcs.spec.template.cliques:
+                t.container.env["ROLL"] = "1"
+            client.update(pcs)
+            return
+        except GroveError:
+            time.sleep(0.05)
+    raise AssertionError("roll edit kept conflicting")
+
+
+def test_rolling_update_waits_for_the_barrier():
+    """Pin: the pod-level rolling update posts a rolling-update notice
+    and holds the ready victim until the checkpoint lands — the SAME
+    contract again, driven by the real coordinator (a responder that
+    fails until the workload's checkpoint is 'durable')."""
+    cfg = OperatorConfiguration()
+    cfg.disruption.sync_period_seconds = 0.1
+    cfg.disruption.ack_retry_base_seconds = 0.05
+    cfg.disruption.ack_retry_max_seconds = 0.1
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=1)]))
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client, "roll", pods=2, chips=2,
+                                min_available=1)
+        durable = {"ok": False}
+
+        def responder(notice):
+            if not durable["ok"]:
+                raise RuntimeError("checkpoint not yet durable")
+        register_responder(gang.meta.name, responder)
+        _roll_edit(client)
+        # The roll must post the notice and then STALL on the pending
+        # barrier with every old-hash ready pod still alive.
+        wait_for(lambda: (lambda n: n is not None
+                          and n.reason == REASON_ROLLING)(
+            notice_of(client.get(PodGang, gang.meta.name))),
+            15.0, desc="rolling-update notice posted")
+        from timing import settle
+        settle(1.0)
+        pods = _live_pods(client, "roll")
+        assert len(pods) == 2 and all(p.status.node_name for p in pods), \
+            "roll deleted a ready victim through a pending barrier"
+        # The checkpoint lands → the coordinator acks → the roll
+        # proceeds, completes, and clears the notice.
+        durable["ok"] = True
+        from grove_tpu.controllers.expected import generation_hash
+        target = generation_hash(client.get(PodCliqueSet, "roll"))
+        wait_for(lambda: (lambda ps: len(ps) == 2 and all(
+            p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) == target
+            and is_condition_true(p.status.conditions, c.COND_READY)
+            for p in ps))(_live_pods(client, "roll")),
+            40.0, desc="roll to complete after the checkpoint ack")
+        wait_for(lambda: notice_of(
+            client.get(PodGang, gang.meta.name)) is None, 15.0,
+            desc="rolling-update notice cleared at completion")
+
+
+def test_roll_skips_barrier_when_coordinator_config_off():
+    """disruption.enabled=False removes the ack coordinator, so the
+    roll path must not post barriers at all — a responder-registered
+    gang would otherwise stall to deadline expiry on every victim with
+    its checkpoint never run (config-off = contract-off)."""
+    cfg = OperatorConfiguration()
+    cfg.disruption.enabled = False
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=1)]))
+    with cluster:
+        client = cluster.client
+        gang = _deploy_workload(client, "roll", pods=2, chips=2,
+                                min_available=1)
+        register_responder(gang.meta.name,
+                           lambda notice: (_ for _ in ()).throw(
+                               RuntimeError("never runs anyway")))
+        _roll_edit(client)
+        from grove_tpu.controllers.expected import generation_hash
+        target = generation_hash(client.get(PodCliqueSet, "roll"))
+        wait_for(lambda: (lambda ps: len(ps) == 2 and all(
+            p.meta.labels.get(c.LABEL_POD_TEMPLATE_HASH) == target
+            and is_condition_true(p.status.conditions, c.COND_READY)
+            for p in ps))(_live_pods(client, "roll")),
+            40.0, desc="roll to complete with no barrier")
+        assert c.ANNOTATION_DISRUPTION_NOTICE not in \
+            client.get(PodGang, gang.meta.name).meta.annotations
+
+
+def test_checkpoint_required_gang_is_never_auto_acked(quiet):
+    """The out-of-process escape hatch: a gang annotated
+    checkpoint-required waits for its remote workload's wire ack (or
+    the deadline) even though no in-process responder exists."""
+    client = quiet.client
+    client.patch(PodGang, "g", {"metadata": {"annotations": {
+        c.ANNOTATION_CHECKPOINT_REQUIRED: "true"}}})
+    n = post_notice(client, "g", "default", REASON_RECLAIM, 30.0)
+    assert n is not None and n.acked_at == 0
+    assert barrier_state(n) == "pending"
+    # The remote workload acks through the same contract call (works
+    # against HttpClient too — it only uses get/update).
+    assert ack_notice(client, "g", "default", n.id)
+    assert barrier_state(notice_of(client.get(PodGang, "g"))) == "acked"
+
+
+# ---- render + checkpoint plumbing ----------------------------------------
+
+
+def test_render_disruptions_shapes():
+    payload = {
+        "contract_enabled": True,
+        "counters": {"notices": 3, "acks_driven": 2, "ack_failures": 1,
+                     "expired": 1, "started": 2, "completed": 1,
+                     "aborted": 0, "reholds": 1},
+        "notices": [{"gang": "default/g", "reason": "spot-reclaim",
+                     "state": "pending", "requested_at": 0.0,
+                     "deadline": 10.0, "coalesced": 2}],
+        "inflight": [{"gang": "g", "state": "Relanding",
+                      "started_at": 1.0, "source_slices": ["A"],
+                      "target_slices": ["B"], "reholds": 1}],
+        "recent": [{"outcome": "evacuated", "gang": "h",
+                    "source_slices": ["A"], "target_slices": ["B"],
+                    "barrier": "acked", "pods_moved": 2,
+                    "started_at": 0.0, "finished_at": 4.0}],
+    }
+    text = "\n".join(render_disruptions(payload, now=12.0))
+    assert "enabled" in text
+    assert "3 posted" in text and "1 expired" in text
+    assert "coalesced x2" in text
+    assert "Relanding" in text and "re-held x1" in text
+    assert "evacuated" in text and "barrier=acked" in text
+    off = "\n".join(render_disruptions({"contract_enabled": False,
+                                        "counters": {}}))
+    assert "DISABLED" in off
+
+
+def test_engine_checkpoint_warm_restart_roundtrip(tmp_path):
+    """serving/checkpoint.py's engine warm-restart path: save_engine
+    steps forward, warm_restart lands the latest params back on the
+    engine, and engine_responder wires it into the barrier."""
+    import numpy as np
+
+    from grove_tpu.serving import checkpoint as ckpt
+
+    class FakeEngine:
+        def __init__(self, v):
+            self.params = {"w": np.full((4,), v, dtype=np.float32)}
+
+    path = str(tmp_path / "ckpt")
+    engine = FakeEngine(1.0)
+    ckpt.save_engine(path, engine)                  # step 0
+    engine.params = {"w": np.full((4,), 2.0, dtype=np.float32)}
+    responder = ckpt.engine_responder(engine, path)
+    responder(None)                                 # step 1 (barrier hook)
+    assert ckpt.latest_step(path) == 1
+    engine.params = {"w": np.zeros((4,), dtype=np.float32)}
+    step = ckpt.warm_restart(path, engine)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(engine.params["w"]),
+                               np.full((4,), 2.0, dtype=np.float32))
+    with pytest.raises(FileNotFoundError):
+        ckpt.warm_restart(str(tmp_path / "empty"), engine)

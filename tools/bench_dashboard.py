@@ -50,6 +50,8 @@ def render(rows: list[dict]) -> str:
                    if r.get("metric") == "serving_tokens_per_sec"]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
+    reclaim = [r for r in rows
+               if r.get("metric") == "reclaim_to_ready_s"]
     chaos = [r for r in rows if r.get("metric") == "chaos_cycles_ok"]
     chaos_drift = {(r.get("ts"), r.get("seed")): r.get("value")
                    for r in rows
@@ -60,7 +62,7 @@ def render(rows: list[dict]) -> str:
                  if r.get("metric") in ("failover_resume_warm_s",
                                         "failover_resume_cold_s")]
     cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
-                "serving-cpu", "chaos-cpu", "defrag-cpu"}
+                "serving-cpu", "chaos-cpu", "defrag-cpu", "reclaim-cpu"}
     # Control-plane rows without a mode stamp (the failover/leader-kill
     # seconds rows) must not masquerade as tok/s in the serving table.
     cp_metrics = {"failover_resume_warm_s", "failover_resume_cold_s",
@@ -143,6 +145,24 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('placed_on', '?')}/{r.get('placed_off', '?')} "
                 f"| {r.get('migrations', '?')} "
                 f"| {r.get('chips_freed', '?')} |")
+        out.append("")
+    if reclaim:
+        out += ["## Spot-slice reclaim (disruption contract)", "",
+                "_seeded repeated reclamations of the gang's own slice "
+                "(tools/bench_reclaim.py): reclamation notice → "
+                "checkpoint barrier → pinned reland on the survivor → "
+                "Ready (docs/design/disruption-contract.md)_", "",
+                "| when | git | rounds | seed | to-ready p50 s | "
+                "p95 s | evacuations | re-holds |",
+                "|---|---|---|---|---|---|---|---|"]
+        for r in sorted(reclaim, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('rounds', '?')} | {r.get('seed', '?')} "
+                f"| {r.get('value', 0):.2f} "
+                f"| {r.get('p95_s', 0):.2f} "
+                f"| {r.get('evacuations', '?')} "
+                f"| {r.get('reholds', 0)} |")
         out.append("")
     if chaos:
         out += ["## Chaos soak (fault mix + gang invariants)", "",

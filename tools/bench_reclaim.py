@@ -1,0 +1,202 @@
+"""Reclaim-to-ready bench: repeated spot-slice reclamations of a
+standing gang, ping-ponging between two slices (docs/design/
+disruption-contract.md).
+
+Each round reclaims the slice the gang currently occupies (the
+``ANNOTATION_RECLAIM_AT`` stamp through the public API), waits for the
+coordinated evacuation — notice → auto-acked barrier → pinned hold on
+the survivor → gang-atomic drain → reland → Ready — then simulates the
+withdrawal-and-return cycle (noticed nodes deleted, identical fresh
+ones re-registered) so the next round has a survivor again. Seeded and
+deterministic in its abuse; wall-clock noise is the weather.
+
+Appends one ``reclaim_to_ready_s`` row (p50 over the rounds, with p95
+and the per-round samples) to bench-history/history.jsonl, rendered by
+the spot-reclaim section of tools/bench_dashboard.py. Exit 1 when any
+round fails to reland or any invariant trips.
+
+    python tools/bench_reclaim.py [--rounds 5] [--seed 7] [--history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="bench-reclaim")
+    parser.add_argument("--rounds", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--timeout", type=float, default=40.0,
+                        help="per-round reland budget (pre-TIME_SCALE s)")
+    parser.add_argument("--history", action="store_true",
+                        help="append a reclaim_to_ready_s row to "
+                             "bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+
+    from grove_tpu.api import (
+        Node,
+        PodCliqueSet,
+        PodGang,
+        SliceReservation,
+        constants as c,
+        new_meta,
+    )
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import is_condition_true
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+    from grove_tpu.chaos.invariants import InvariantChecker
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.disruption.reclaim import reclaim_for
+    from grove_tpu.runtime.timescale import scaled
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec, build_node
+
+    rng = random.Random(args.seed)
+    cfg = OperatorConfiguration()
+    cfg.disruption.sync_period_seconds = 0.1
+    cfg.node_lifecycle.sync_period_seconds = 0.2
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=2)]))
+    timeout = scaled(args.timeout)
+    samples: list[float] = []
+    with cluster:
+        client = cluster.client
+        client.create(PodCliqueSet(
+            meta=new_meta("work"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, min_available=2,
+                    tpu_chips_per_pod=4,
+                    container=ContainerSpec(argv=["sleep", "inf"]))],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True)))))
+
+        def gang():
+            return client.get(PodGang, "work-0")
+
+        wait_for(lambda: client.list(
+            PodGang, selector={c.LABEL_PCS_NAME: "work"})
+            and is_condition_true(gang().status.conditions, c.COND_READY),
+            timeout, "standing gang ready")
+
+        for rnd in range(args.rounds):
+            src = gang().status.assigned_slice
+            doomed = [(n.meta.name,
+                       n.meta.labels.get(c.NODE_LABEL_TPU_ACCELERATOR,
+                                         "tpu-v5e").removeprefix("tpu-"),
+                       n.meta.labels.get(c.NODE_LABEL_TPU_TOPOLOGY, "2x4"),
+                       src,
+                       int(n.meta.labels.get(c.NODE_LABEL_SLICE_WORKER, 0)),
+                       n.meta.labels.get(c.NODE_LABEL_POOL, "pool-0"))
+                      for n in client.list(Node)
+                      if n.meta.labels.get(c.NODE_LABEL_SLICE) == src]
+            notice_s = scaled(rng.uniform(20.0, 30.0))
+            stamp = str(time.time() + notice_s)
+            t0 = time.time()
+            for name, *_ in doomed:
+                client.patch(Node, name, {"metadata": {"annotations": {
+                    c.ANNOTATION_RECLAIM_AT: stamp}}})
+            wait_for(lambda: (lambda g: g.status.assigned_slice
+                              not in ("", src)
+                              and is_condition_true(
+                                  g.status.conditions, c.COND_READY))(
+                gang()), timeout,
+                f"round {rnd}: gang relanded Ready off {src}")
+            took = time.time() - t0
+            samples.append(took)
+            print(f"round {rnd}: {src} reclaimed -> relanded Ready in "
+                  f"{took:.2f}s", file=sys.stderr)
+            # Withdrawal + spot capacity returning: dead nodes out,
+            # identical fresh (notice-free) nodes back in.
+            for name, *_ in doomed:
+                try:
+                    client.delete(Node, name)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+            for _name, gen, topo, slice_name, worker, pool in doomed:
+                client.create(build_node(gen, topo, slice_name, worker,
+                                         pool=pool))
+            wait_for(lambda: not client.list(SliceReservation), timeout,
+                     f"round {rnd}: hold released")
+
+        rc = reclaim_for(cluster.manager.store)
+        counters = dict(rc.counters) if rc is not None else {}
+        checker = InvariantChecker(cluster, bind_deadline_s=8.0,
+                                   owner_deadline_s=8.0)
+        violations = (checker.check_gang_binding()
+                      + checker.check_live_owner()
+                      + checker.check_no_duplicates()
+                      + checker.check_disruption_contract())
+        if violations:
+            print("BENCH FAIL: invariants violated:\n  "
+                  + "\n  ".join(str(v) for v in violations),
+                  file=sys.stderr)
+            return 1
+
+    p50 = statistics.median(samples)
+    # The trace_smoke.py percentile shape: at small n the slowest
+    # sample IS the p95 (int(0.95*5)-1 would report ~p80 and hide a
+    # one-in-five blowup).
+    p95 = sorted(samples)[min(len(samples) - 1,
+                              int(0.95 * len(samples)))]
+    report = {
+        "rounds": args.rounds,
+        "seed": args.seed,
+        "reclaim_to_ready_s": [round(s, 3) for s in samples],
+        "p50_s": round(p50, 3),
+        "p95_s": round(p95, 3),
+        "counters": counters,
+    }
+    print(json.dumps(report, indent=2))
+    if p50 <= 0:
+        print("BENCH FAIL: zero reclaim-to-ready — nothing was measured",
+              file=sys.stderr)
+        return 1
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "reclaim_to_ready_s",
+            "value": round(p50, 3),
+            "unit": "s",
+            "p95_s": round(p95, 3),
+            "rounds": args.rounds,
+            "seed": args.seed,
+            "samples_s": [round(s, 3) for s in samples],
+            "evacuations": counters.get("completed", 0),
+            "reholds": counters.get("reholds", 0),
+            "mode": "reclaim-cpu",
+        })
+    print(f"bench-reclaim OK: {args.rounds} reclaims, reclaim-to-ready "
+          f"p50 {p50:.2f}s p95 {p95:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

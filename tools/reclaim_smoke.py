@@ -1,0 +1,201 @@
+"""Spot-reclaim smoke: kill one of two slices under a standing PCS →
+checkpoint barrier → pinned reland on the survivor → Ready, with every
+chaos invariant green.
+
+The disruption contract's CI gate (wired into ``make ci``,
+docs/design/disruption-contract.md): brings up an in-process cluster
+with two fake v5e 2x4 slices, deploys a slice-packed 2-pod gang, then
+stamps the gang's slice with ``ANNOTATION_RECLAIM_AT`` through the
+public API — exactly what the GKE spot integration (or the chaos
+``spot-reclaim`` injector) does. Asserts the whole coordinated
+response:
+
+- the node-lifecycle controller cordons the noticed nodes,
+- the reclaim controller posts a ``DisruptionNotice`` (auto-acked —
+  no checkpoint responder is registered), takes a pinned
+  ``SliceReservation`` on the surviving slice, drains gang-atomically
+  with ``barrier=acked`` stamped, and relands the gang Ready,
+- the reclaimed nodes are then ACTUALLY withdrawn (deleted) and the
+  gang does not notice,
+- holds + notice fully released, ``grove_disruption_*`` counters moved,
+- the chaos invariants (gang atomicity, live owners, no duplicates,
+  disruption contract) sweep green,
+- ``GET /debug/disruption`` + ``grovectl disruptions`` render it.
+
+    python tools/reclaim_smoke.py [--timeout 40] [--history]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def wait_for(predicate, timeout: float, desc: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {desc}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="reclaim-smoke")
+    parser.add_argument("--timeout", type=float, default=40.0)
+    parser.add_argument("--history", action="store_true",
+                        help="append a reclaim_smoke row to "
+                             "bench-history/history.jsonl")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from grove_tpu import cli
+    from grove_tpu.api import (
+        Node,
+        Pod,
+        PodCliqueSet,
+        PodGang,
+        SliceReservation,
+        constants as c,
+        new_meta,
+    )
+    from grove_tpu.api.config import OperatorConfiguration
+    from grove_tpu.api.core import ContainerSpec
+    from grove_tpu.api.meta import is_condition_true
+    from grove_tpu.api.podcliqueset import (
+        PodCliqueSetSpec,
+        PodCliqueSetTemplate,
+        PodCliqueTemplate,
+        TopologyConstraint,
+    )
+    from grove_tpu.chaos.invariants import InvariantChecker
+    from grove_tpu.cluster import new_cluster
+    from grove_tpu.disruption.reclaim import reclaim_for
+    from grove_tpu.runtime.timescale import scaled
+    from grove_tpu.server import ApiServer
+    from grove_tpu.topology.fleet import FleetSpec, SliceSpec
+
+    cfg = OperatorConfiguration()
+    cfg.disruption.sync_period_seconds = 0.1
+    cfg.node_lifecycle.sync_period_seconds = 0.2
+    cluster = new_cluster(config=cfg, fleet=FleetSpec(slices=[
+        SliceSpec(generation="v5e", topology="2x4", count=2)]))
+    timeout = scaled(args.timeout)
+    with cluster:
+        client = cluster.client
+        client.create(PodCliqueSet(
+            meta=new_meta("work"),
+            spec=PodCliqueSetSpec(replicas=1, template=PodCliqueSetTemplate(
+                cliques=[PodCliqueTemplate(
+                    name="w", replicas=2, min_available=2,
+                    tpu_chips_per_pod=4,
+                    container=ContainerSpec(argv=["sleep", "inf"]))],
+                topology=TopologyConstraint(pack_level="slice",
+                                            required=True)))))
+
+        def gang():
+            return client.get(PodGang, "work-0")
+
+        wait_for(lambda: client.list(
+            PodGang, selector={c.LABEL_PCS_NAME: "work"})
+            and is_condition_true(gang().status.conditions, c.COND_READY),
+            timeout, "standing gang ready")
+        src = gang().status.assigned_slice
+        assert src, "gang has no assigned slice"
+
+        # The reclamation notice, through the public API (what the GKE
+        # spot integration stamps): this slice withdraws in 30s.
+        doomed = [n for n in client.list(Node)
+                  if n.meta.labels.get(c.NODE_LABEL_SLICE) == src]
+        deadline = str(time.time() + scaled(30.0))
+        t0 = time.time()
+        for n in doomed:
+            client.patch(Node, n.meta.name, {"metadata": {"annotations": {
+                c.ANNOTATION_RECLAIM_AT: deadline}}})
+
+        # Checkpoint (auto-ack) → pinned hold → drain → reland → Ready.
+        wait_for(lambda: (lambda g: g.status.assigned_slice
+                          not in ("", src)
+                          and is_condition_true(g.status.conditions,
+                                                c.COND_READY))(gang()),
+                 timeout, "gang relanded Ready on the surviving slice")
+        reclaim_to_ready_s = time.time() - t0
+
+        rc = reclaim_for(cluster.manager.store)
+        assert rc is not None, "reclaim controller not registered"
+        wait_for(lambda: rc.counters["completed"] >= 1, timeout,
+                 "evacuation recorded complete")
+        done = rc.payload()["recent"][0]
+        assert done["outcome"] == "evacuated", done
+        assert done["barrier"] == "acked", done
+        assert done["source_slices"] == [src], done
+
+        # The noticed nodes cordoned before they die.
+        wait_for(lambda: all(
+            client.get(Node, n.meta.name).spec.unschedulable
+            for n in doomed), timeout, "noticed nodes cordoned")
+
+        # The withdrawal actually happens — and the gang doesn't care.
+        for n in doomed:
+            client.delete(Node, n.meta.name)
+        g = gang()
+        assert is_condition_true(g.status.conditions, c.COND_READY)
+
+        # Hygiene: hold and notice released, counters moved.
+        wait_for(lambda: not client.list(SliceReservation), timeout,
+                 "reclaim hold released")
+        assert c.ANNOTATION_DISRUPTION_NOTICE not in g.meta.annotations
+        metrics = cluster.manager.metrics_text()
+        assert "grove_disruption_evacuations_completed_total 1" in metrics, \
+            [ln for ln in metrics.splitlines() if "disruption" in ln]
+        assert 'grove_disruption_acks_total{source="auto"} 1' in metrics
+
+        # Every chaos invariant green on the post-reclaim world.
+        checker = InvariantChecker(cluster, bind_deadline_s=8.0,
+                                   owner_deadline_s=8.0)
+        violations = (checker.check_gang_binding()
+                      + checker.check_live_owner()
+                      + checker.check_no_duplicates()
+                      + checker.check_disruption_contract())
+        assert not violations, "invariants violated:\n  " + "\n  ".join(
+            str(v) for v in violations)
+
+        # Render surfaces: /debug/disruption + grovectl disruptions.
+        server = ApiServer(cluster, port=0)
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc_code = cli.main(["disruptions", "--server", url])
+            text = out.getvalue()
+            assert rc_code == 0, text
+            assert "1 completed" in text and "evacuated" in text, text
+        finally:
+            server.stop()
+
+    print(f"reclaim smoke OK: slice {src} reclaimed, gang checkpointed "
+          f"(barrier=acked), relanded Ready on the survivor in "
+          f"{reclaim_to_ready_s:.2f}s, nodes withdrawn, holds+notice "
+          "released, invariants green, CLI verified")
+
+    if args.history:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_sched import append_history
+        append_history({
+            "metric": "reclaim_smoke_to_ready_s",
+            "value": round(reclaim_to_ready_s, 3),
+            "unit": "s",
+            "mode": "reclaim-cpu",
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
