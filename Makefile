@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-reclaim bench-failover docs native lint clean ci render-deploy chaos-smoke chaos-soak
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-reclaim bench-failover bench-decode docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 lint:            ## the semantic gate: compile check + grovelint (AST
 	@# invariant rules, docs/design/static-analysis.md) + one
@@ -105,6 +105,15 @@ bench-failover:  ## hot-standby vs cold leader takeover at 300 pods (CPU only)
 	@# bench-history/history.jsonl.
 	$(PY) tools/bench_failover.py --history
 
+bench-decode:    ## paged vs lanes decode engine on the mixed-length workload (CPU only)
+	@# The continuous-batching rebuild's proof (docs/design/
+	@# continuous-batching.md): same KV token budget, same seeded
+	@# open-loop Poisson mixed-length schedules; appends
+	@# decode_tokens_per_sec_paged_vs_lanes rows. Exit 1 unless the
+	@# paged engine clears 2x AND its CompileTracker shows zero
+	@# steady-state compiles.
+	$(PY) tools/bench_decode.py
+
 bench-serving:   ## SLO-driven autoscaling under a 4x traffic ramp (CPU only)
 	@# The serving telemetry plane's proof: open-loop Poisson load
 	@# (tools/loadgen.py) against the tiny CPU engine, TTFT p99 breach
@@ -161,6 +170,11 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# /debug/xprof renders -> grovectl engine-profile exits 0
 	@# (docs/design/data-plane-observability.md).
 	$(PY) tools/engine_profile_smoke.py
+	@# decode smoke: the paged continuous-batching engine through a
+	@# mixed-length workload — pinned per-bucket lowerings, ZERO
+	@# steady-state recompiles, token parity vs the lanes engine,
+	@# allocator hygiene (docs/design/continuous-batching.md).
+	$(PY) tools/decode_smoke.py
 	@# defrag smoke: one fragmented 2-slice fleet -> migration plan ->
 	@# hold/drain/rebind -> the stuck gang schedules, the Fragmented
 	@# gauge drops, holds release (docs/design/defrag.md).

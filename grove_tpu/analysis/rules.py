@@ -509,6 +509,131 @@ class CloneBeforeMutate(Rule):
         return out
 
 
+class HostSyncInStepLoop(Rule):
+    """The paged-engine rebuild's hot-path discipline (PR 15): the
+    decode step loop dispatches asynchronously, and a host sync —
+    ``jax.block_until_ready``, ``.item()``, ``np.asarray`` on a device
+    value — on the dispatch path stalls the chain for a device round
+    trip PER STEP: the difference between dispatch-bound and HBM-bound
+    decode on high-latency transports (the tunnelled PJRT relay most
+    of all). Scope is the WHOLE dispatch path: ``step()``/``run()``
+    and the per-tick internals ``_decode_tick()``/``_prefill_tick()``
+    they delegate to. The one sanctioned sync there is the xprof
+    sampling gate (``if sampled: block_until_ready`` — paid on
+    1/N dispatches by design). Window drains and prefill-completion
+    fetches live in named helpers (``_drain``/``_fetch_windows``/
+    ``_finish_prefill``) outside this rule's scope: once per window or
+    per request, never per step — moving a sync there is the fix, not
+    an evasion."""
+
+    name = "host-sync-in-step-loop"
+    description = ("no block_until_ready/.item()/np.asarray on the "
+                   "engine dispatch path (step/run/_decode_tick/"
+                   "_prefill_tick) except under the sampling gate")
+
+    # The per-step dispatch path: the public tick entrypoints AND the
+    # per-tick internals they delegate to — scoping only to step/run
+    # would leave the paged engine's actual dispatch bodies unchecked.
+    STEP_FUNCS = {"step", "run", "_decode_tick", "_prefill_tick"}
+    # What marks an If-test as THE sampling gate: the bound gate flag
+    # (``sampled = x is not None and x.should_sample()``) or the gate
+    # method itself. Deliberately NOT substrings like "sample" or
+    # "xprof" — ``if self._sampling:`` / ``if self.xprof is not
+    # None:`` are mode branches taken EVERY dispatch, and a sync
+    # hidden under either is exactly the per-step stall this rule
+    # exists to catch.
+    GATE_NAMES = {"sampled", "should_sample"}
+    NP_ROOTS = {"np", "numpy"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel == "grove_tpu/serving/engine.py"
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name in self.STEP_FUNCS:
+                    self._visit(mod, fn.body, gated=False, out=out)
+        return out
+
+    def _is_gate(self, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            name = ""
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name.lower() in self.GATE_NAMES:
+                return True
+        return False
+
+    def _visit(self, mod: ModuleFile, stmts: list[ast.stmt], gated: bool,
+               out: list[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                # The TEST itself runs every step — a sync there (e.g.
+                # `if self._flag.item():`) is flagged under the
+                # current gating, while the gate's own body is exempt.
+                self._scan_expr(mod, stmt.test, gated, out)
+                self._visit(mod, stmt.body,
+                            gated or self._is_gate(stmt.test), out)
+                self._visit(mod, stmt.orelse, gated, out)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                header = stmt.iter if isinstance(stmt, ast.For) \
+                    else stmt.test
+                self._scan_expr(mod, header, gated, out)
+                self._visit(mod, stmt.body, gated, out)
+                self._visit(mod, stmt.orelse, gated, out)
+                continue
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._scan_expr(mod, item.context_expr, gated, out)
+                self._visit(mod, stmt.body, gated, out)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._visit(mod, stmt.body, gated, out)
+                for h in stmt.handlers:
+                    self._visit(mod, h.body, gated, out)
+                self._visit(mod, stmt.orelse, gated, out)
+                self._visit(mod, stmt.finalbody, gated, out)
+                continue
+            self._scan_expr(mod, stmt, gated, out)
+
+    def _scan_expr(self, mod: ModuleFile, node: ast.AST, gated: bool,
+                   out: list[Finding]) -> None:
+        if gated or node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                msg = self._sync_call(sub)
+                if msg:
+                    out.append(self.finding(mod, sub, msg))
+
+    def _sync_call(self, node: ast.Call) -> str | None:
+        chain = self.attr_chain(node.func)
+        if not chain:
+            return None
+        if chain[-1] == "block_until_ready":
+            return ("jax.block_until_ready on the step path outside "
+                    "the sampling gate — the dispatch chain stalls one "
+                    "round trip per step; sync in a once-per-window "
+                    "helper instead")
+        if chain[-1] == "item" and not node.args and not node.keywords:
+            return (".item() on the step path — a device→host sync "
+                    "per step; accumulate on device and drain per "
+                    "window")
+        if chain[-1] == "asarray" and len(chain) >= 2 \
+                and chain[-2] in self.NP_ROOTS:
+            return ("np.asarray on the step path fetches a device "
+                    "value synchronously — move the fetch into the "
+                    "window drain helper")
+        return None
+
+
 ALL_RULES = [
     HubUnderStoreLock,
     LeaderClientWrite,
@@ -516,4 +641,5 @@ ALL_RULES = [
     RawTestSleep,
     ThreadJoinInStop,
     CloneBeforeMutate,
+    HostSyncInStepLoop,
 ]

@@ -433,6 +433,154 @@ def decode_step(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
     return logits, KVCache(k=k_all, v=v_all, lengths=new_lengths)
 
 
+# ---- paged (block-table) execution path ------------------------------
+# The continuous-batching engine's memory model (serving/kvcache.py):
+# K/V live in fixed-size blocks [layers, num_blocks, block_size, n_kv, d]
+# and a sequence's tokens are addressed through its block table. These
+# kernels take the raw pool arrays (not the PagedKV wrapper) so the
+# model stays import-cycle-free and mesh-agnostic — the GSPMD shardings
+# are applied by the engine's jit (parallel/sharding.py).
+
+
+def _paged_gather(cache_blocks: jnp.ndarray,
+                  tables: jnp.ndarray) -> jnp.ndarray:
+    """Gather a batch's KV sequences out of the block pool.
+
+    cache_blocks: [num_blocks, bs, n_kv, d]; tables: [b, w] int32 →
+    [b, w*bs, n_kv, d] in position order (table order IS sequence
+    order). Padded table rows point at the null block; the attention
+    length mask discards whatever lives there.
+    """
+    b, w = tables.shape
+    nb, bs, n_kv, d = cache_blocks.shape
+    return cache_blocks[tables].reshape(b, w * bs, n_kv, d)
+
+
+def _paged_scatter(cache_blocks: jnp.ndarray, kv: jnp.ndarray,
+                   tables: jnp.ndarray, positions: jnp.ndarray,
+                   valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Write ``kv`` [b, s, n_kv, d] at token ``positions`` [b, s]
+    through the block tables (one flat scatter). Positions past a
+    table's real width resolve to the null block (table padding), so
+    inactive batch slots write garbage nowhere that matters — the
+    price of static shapes, same trade as the lanes engine's
+    inactive-lane compute.
+
+    ``valid`` [b, s] bool, when given, reroutes masked-out writes to
+    the NULL block explicitly. Required whenever a position may exceed
+    the table's backed capacity: ``take_along_axis`` would CLAMP the
+    block index into the last real block and the garbage write would
+    race live K/V at the same flat slot (the chunk-padding overflow —
+    a padded prefill tail past per-sequence capacity corrupted real
+    prompt tokens before this mask existed)."""
+    nb, bs = cache_blocks.shape[0], cache_blocks.shape[1]
+    block = jnp.take_along_axis(tables, positions // bs, axis=1)  # [b, s]
+    flat_idx = block * bs + positions % bs
+    if valid is not None:
+        # Invalid rows land in the null block (block 0, slots cycled by
+        # sequence position so collisions stay inside it).
+        flat_idx = jnp.where(valid, flat_idx, positions % bs)
+    flat = cache_blocks.reshape((nb * bs,) + cache_blocks.shape[2:])
+    flat = flat.at[flat_idx.reshape(-1)].set(
+        kv.reshape((-1,) + kv.shape[2:]).astype(flat.dtype))
+    return flat.reshape(cache_blocks.shape)
+
+
+def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
+                      kv_k: jnp.ndarray, kv_v: jnp.ndarray,
+                      tables: jnp.ndarray, lengths: jnp.ndarray
+                      ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step over block tables. tokens: [b]; kv pools:
+    [layers, num_blocks, bs, n_kv, d]; tables: [b, w]; lengths: [b] =
+    tokens already in cache (the new token writes at that position).
+
+    Returns (logits [b, vocab], new kv_k, new kv_v). Attention reads
+    only the gathered w*bs window — the whole point: w is the BUCKETED
+    width of the live sequences, not the engine-wide worst case, so a
+    20-token conversation stops paying a max_len-wide HBM read.
+    """
+    b = tokens.shape[0]
+    positions = lengths[:, None]  # [b, 1]
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = embed(cfg, params, tokens[:, None])  # [b, 1, d]
+    new_lengths = lengths + 1
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        kc = _paged_scatter(kc, k, tables, positions)
+        vc = _paged_scatter(vc, v, tables, positions)
+        attn = decode_attention(q, _paged_gather(kc, tables),
+                                _paged_gather(vc, tables), new_lengths)
+        x = _attn_out(x, attn, lp)
+        x = _mlp_block(cfg, x, lp)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], kv_k, kv_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], _w(params["lm_head"]),
+                        preferred_element_type=jnp.float32)
+    return logits, k_all, v_all
+
+
+def prefill_chunk_paged(cfg: LlamaConfig, params: Params,
+                        tokens: jnp.ndarray, kv_k: jnp.ndarray,
+                        kv_v: jnp.ndarray, tables: jnp.ndarray,
+                        offset: jnp.ndarray, logit_idx: jnp.ndarray,
+                        n_valid: jnp.ndarray | None = None
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chunked-prefill window over block tables.
+
+    tokens: [b, c] at absolute positions [offset, offset+c); ``offset``
+    and ``logit_idx`` are TRACED scalars — one executable per
+    (c, table-width) shape that every window position reuses. The
+    contiguous ``prefill_chunk`` compiles one program per STATIC
+    offset; the paged engine interleaves chunks of many prompts with
+    decode steps, so per-offset executables would be a recompile storm
+    by construction.
+
+    ``n_valid`` (traced scalar; default c) is the count of REAL tokens
+    in this chunk — padded tail rows scatter to the null block instead
+    of clamping into the sequence's last backed block (see
+    ``_paged_scatter``; padded rows are causally invisible to valid
+    queries regardless).
+
+    Attention is the plain XLA formulation (a traced offset rules out
+    the flash kernel's trace-time tiling decision); the window reads
+    only the gathered w*bs prefix, which is the bounded-memory property
+    chunking exists for. Returns (logits [b, vocab] taken at row
+    ``logit_idx`` — the caller passes the last valid row for the chunk
+    that completes the prompt, anything for earlier chunks — plus the
+    updated pools).
+    """
+    b, c = tokens.shape
+    positions = jnp.broadcast_to(offset + jnp.arange(c)[None, :], (b, c))
+    if n_valid is None:
+        n_valid = jnp.int32(c)
+    valid = jnp.broadcast_to(jnp.arange(c)[None, :] < n_valid, (b, c))
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = embed(cfg, params, tokens)
+
+    def body(x, xs):
+        lp, kc, vc = xs
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        kc = _paged_scatter(kc, k, tables, positions, valid=valid)
+        vc = _paged_scatter(vc, v, tables, positions, valid=valid)
+        attn = causal_attention(q, _paged_gather(kc, tables),
+                                _paged_gather(vc, tables),
+                                q_offset=offset)
+        x = _attn_out(x, attn, lp)
+        x = _mlp_block(cfg, x, lp)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], kv_k, kv_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    row = jnp.take(x, logit_idx, axis=1)  # [b, d] (clipped gather)
+    logits = jnp.einsum("bd,dv->bv", row, _w(params["lm_head"]),
+                        preferred_element_type=jnp.float32)
+    return logits, k_all, v_all
+
+
 def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """Mean next-token cross-entropy (shared by all model families)."""
     targets = tokens[:, 1:]

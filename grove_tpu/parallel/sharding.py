@@ -85,15 +85,34 @@ def param_pspec(name: str) -> P:
     return logical_pspec(*logical)
 
 
+def _path_entry_name(entry) -> str:
+    """A tree-path entry's plain name: DictKey carries .key, a
+    registered dataclass's GetAttrKey carries .name."""
+    if hasattr(entry, "key"):
+        return entry.key
+    if hasattr(entry, "name"):
+        return entry.name
+    return str(entry)
+
+
 def param_pspecs(params: Any) -> Any:
     """A pytree of PartitionSpecs matching ``params`` (dict-of-dict layout).
 
     The single source of truth for parameter placement — consumed both by
     ``param_shardings`` (device_put) and by shard_map in_specs (e.g. the
     MoE expert-parallel path).
+
+    Quantized trees (serving/quant.QTensor) are handled: the int8 ``q``
+    leaf takes its parent weight's spec (same shape), the per-channel
+    ``scale`` replicates — its contracted axes are kept as size-1 dims,
+    and sharding a size-1 axis over tp>1 is invalid while the bytes are
+    negligible anyway.
     """
     def leaf(path, _):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        name = _path_entry_name(path[-1])
+        if name in ("q", "scale") and len(path) >= 2:
+            return param_pspec(_path_entry_name(path[-2])) \
+                if name == "q" else P()
         return param_pspec(name)
     return jax.tree_util.tree_map_with_path(leaf, params)
 
@@ -108,3 +127,58 @@ def param_shardings(mesh: Mesh, params: Any) -> Any:
 def shard_params(mesh: Mesh, params: Any) -> Any:
     """Device-put params with their canonical shardings."""
     return jax.device_put(params, param_shardings(mesh, params))
+
+
+# ---- paged serving path (GSPMD over the ICI mesh) --------------------
+# The PagedDecodeEngine jits every dispatch with explicit NamedSharding
+# in/out shardings (the modern GSPMD pattern — jit + NamedSharding, XLA
+# inserts the collectives; not pmap). KV blocks shard like the weights
+# that produced them: over tp on the kv_heads axis. Token buffers,
+# block tables, and lengths are tiny host-fed arrays and replicate — a
+# decode batch is one cooperative tp group, not a dp-split workload.
+# On a 1-chip mesh (the CPU fallback) every spec collapses to a no-op,
+# which is exactly the "same engine, both worlds" contract.
+
+
+def paged_kv_pspec() -> P:
+    """[layers, num_blocks, block_size, n_kv, head_dim] — kv heads
+    shard over tp, everything else replicated (blocks are a shared
+    pool addressed by table, never a parallel axis)."""
+    return logical_pspec("layers", None, None, "kv_heads", "head_dim")
+
+
+def paged_kv_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, paged_kv_pspec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def paged_step_shardings(mesh: Mesh, params: Any,
+                         sampled: bool = False) -> tuple:
+    """(in_shardings, out_shardings) for the paged decode step:
+    (params, tokens[b], kv_k, kv_v, tables[b,w], lengths[b][, key]) →
+    (next[b], kv_k, kv_v, lengths[b][, key])."""
+    ps = param_shardings(mesh, params)
+    kv = paged_kv_sharding(mesh)
+    rep = replicated(mesh)
+    ins = (ps, rep, kv, kv, rep, rep)
+    outs = (rep, kv, kv, rep)
+    if sampled:
+        ins += (rep,)
+        outs += (rep,)
+    return ins, outs
+
+
+def paged_prefill_shardings(mesh: Mesh, params: Any) -> tuple:
+    """(in_shardings, out_shardings) for one chunked-prefill window:
+    (params, tokens[1,c], kv_k, kv_v, table[1,w], offset, logit_idx,
+    n_valid) → (logits[1,vocab], kv_k, kv_v). The spec list mirrors
+    ``models/llama.prefill_chunk_paged``'s full signature — an arity
+    drift here surfaces only as a jit error at engine construction,
+    so keep them together."""
+    ps = param_shardings(mesh, params)
+    kv = paged_kv_sharding(mesh)
+    rep = replicated(mesh)
+    return (ps, rep, kv, kv, rep, rep, rep, rep), (rep, kv, kv)

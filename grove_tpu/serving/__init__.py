@@ -1,3 +1,6 @@
-from grove_tpu.serving.engine import DecodeEngine, PrefillResult, PrefillWorker
+from grove_tpu.serving.engine import (DecodeEngine, PagedDecodeEngine,
+                                      PrefillResult, PrefillWorker,
+                                      engine_mode, make_engine)
 
-__all__ = ["DecodeEngine", "PrefillResult", "PrefillWorker"]
+__all__ = ["DecodeEngine", "PagedDecodeEngine", "PrefillResult",
+           "PrefillWorker", "engine_mode", "make_engine"]

@@ -30,6 +30,8 @@ import numpy as np
 from grove_tpu.models import llama
 from grove_tpu.models.llama import LlamaConfig
 from grove_tpu.ops.kvcache import KVCache
+from grove_tpu.serving.kvcache import PagedKV, BlockAllocator, pad_tables
+from grove_tpu.serving.schedule import PagedScheduler, pick_bucket
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +90,33 @@ class PrefillResult:
     v: jnp.ndarray        # [layers, s_pad, n_kv, d]
     length: int
     next_token: int
+
+
+def _stamp_admit_impl(req: Request, now: float, admit: float | None,
+                      compat: bool, telemetry) -> None:
+    """Shared admission-stamp semantics for both engines (lanes and
+    paged): ``now`` is when the first token existed, ``admit`` when the
+    request left the queue. Compat mode (GROVE_TTFT_COMPAT=1) fuses
+    them back to the historical single stamp. The prefill-sampled
+    token is counted here so the drain only accounts decode tokens."""
+    if compat or admit is None or admit > now:
+        admit = now
+    req.admit_ts = admit
+    if not req.enqueue_ts:
+        req.enqueue_ts = admit
+    req.first_token_ts = now
+    if telemetry is not None:
+        telemetry.add_tokens(1)
+
+
+def _complete_impl(req: Request, completed: list, telemetry) -> None:
+    """Shared completion bookkeeping: stamp done, record, fold into
+    the telemetry."""
+    req.done = True
+    req.done_ts = time.time()
+    completed.append(req)
+    if telemetry is not None:
+        telemetry.observe_request(req)
 
 
 class PrefillWorker:
@@ -366,23 +395,13 @@ class DecodeEngine:
         through submit() gets enqueue = admit: zero queue wait. Both
         admission paths append the prefill token right after stamping,
         so it is counted here — the drain only sees decode tokens."""
-        if self._ttft_compat or admit is None or admit > now:
-            admit = now
-        req.admit_ts = admit
-        if not req.enqueue_ts:
-            req.enqueue_ts = admit
-        req.first_token_ts = now
-        if self.telemetry is not None:
-            self.telemetry.add_tokens(1)
+        _stamp_admit_impl(req, now, admit, self._ttft_compat,
+                          self.telemetry)
 
     def _complete(self, req: Request) -> None:
         """Shared completion bookkeeping (window drain + lane retire):
         stamp done, record, and fold the request into the telemetry."""
-        req.done = True
-        req.done_ts = time.time()
-        self.completed.append(req)
-        if self.telemetry is not None:
-            self.telemetry.observe_request(req)
+        _complete_impl(req, self.completed, self.telemetry)
 
     # ---- standalone mode (bench path) ----
 
@@ -559,6 +578,20 @@ class DecodeEngine:
             if len(self._pending_tokens) >= self.host_sync_interval:
                 self._drain()
 
+    def _fetch_windows(self, windows: list[jnp.ndarray]) -> np.ndarray:
+        """Fetch accumulated block windows to host ([w, batch] rows).
+        The once-per-window device→host sync lives HERE, outside the
+        step loop's dispatch path — the host-sync-in-step-loop lint
+        rule pins that split (docs/design/static-analysis.md)."""
+        x = self.xprof
+        if x is not None:
+            t0 = time.perf_counter()
+        toks = np.asarray(windows[0] if len(windows) == 1
+                          else jnp.concatenate(windows, axis=0))
+        if x is not None:
+            x.record("host_transfer", time.perf_counter() - t0)
+        return toks
+
     def _lane_has_room(self, req: Request, n: int) -> bool:
         """Host-side capacity check (no device fetch): after g generated
         tokens the lane's next write lands at prompt_len + g - 1, so n
@@ -670,15 +703,774 @@ class DecodeEngine:
             # This fetch doubles as the hard sync for the block phase:
             # it waits on the last window's compute, and its final row
             # IS the current token state — no second round trip needed.
-            if x is not None:
-                t0 = time.perf_counter()
-            toks = np.asarray(windows[0] if len(windows) == 1
-                              else jnp.concatenate(windows, axis=0))
-            if x is not None:
-                x.record("host_transfer", time.perf_counter() - t0)
-            self._process_window(toks)
+            self._process_window(self._fetch_windows(windows))
             fetched = True
         for _ in range(steps):
             self.step()
         if steps or not fetched:
             self.sync()
+
+
+class PagedDecodeEngine:
+    """Continuous-batching decode over a paged KV cache.
+
+    The throughput rebuild of ``DecodeEngine`` (GROVE_ENGINE=paged —
+    the default; ``lanes`` restores the seed engine):
+
+    - **Paged KV** (serving/kvcache.py): fixed-size blocks + per-request
+      block tables replace per-lane max-length buffers, so effective
+      batch is bounded by tokens in flight, not worst-case length, and
+      decode attention reads the BUCKETED live width instead of a
+      max_len-wide padded row.
+    - **Continuous batching** (serving/schedule.py): requests join and
+      leave the decode batch at any step. Dispatch shapes come off
+      fixed power-of-two bucket ladders — a finite executable set, so
+      warmed steady state runs ZERO recompiles (pinned by
+      tools/decode_smoke.py via the CompileTracker).
+    - **Chunked prefill**: prompts advance one fixed chunk per engine
+      tick, interleaved with decode, so a long prompt stalls TPOT for
+      at most one chunk. The chunk executable takes a TRACED offset —
+      one program per (chunk, width-bucket), reused at every window
+      position.
+    - **GSPMD execution**: every dispatch is ``jax.jit`` with
+      ``NamedSharding`` in/out shardings over the ICI mesh
+      (parallel/sharding.paged_step_shardings — the modern GSPMD
+      pattern, not pmap). On a 1-chip CPU mesh the shardings collapse
+      to no-ops; on a v5e slice the KV pool and attention heads shard
+      over tp with XLA inserting the collectives. Same engine, both
+      worlds.
+
+    Host discipline: the per-step dispatch path performs NO device
+    syncs (the host-sync-in-step-loop grovelint rule). Sampled tokens
+    chain on device; bookkeeping drains once per ``host_sync_interval``
+    window or at a composition change, whichever comes first.
+    """
+
+    def __init__(self, cfg: LlamaConfig, key_or_params, batch: int = 8,
+                 max_len: int | None = None,
+                 block_size: int | None = None,
+                 num_blocks: int | None = None,
+                 prefill_chunk: int | None = None,
+                 metric_hook: Callable[[int], None] | None = None,
+                 host_sync_interval: int = 8,
+                 sampler: SamplerConfig | None = None,
+                 quant: str | None = None,
+                 telemetry=None,
+                 xprof=None,
+                 mesh=None):
+        self.cfg = cfg
+        self._sampler = sampler or SamplerConfig()
+        if isinstance(key_or_params, jax.Array) \
+                and key_or_params.dtype == jnp.uint32:
+            self.params = llama.init_params(cfg, key_or_params)
+        else:
+            self.params = key_or_params
+        assert quant in (None, "int8"), f"unknown quant mode {quant!r}"
+        self.quant = quant
+        if quant == "int8":
+            from grove_tpu.serving.quant import quantize_params
+            self.params = quantize_params(self.params)
+        self.batch = batch          # max decode slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.metric_hook = metric_hook
+        self.telemetry = telemetry
+        self.host_sync_interval = max(1, host_sync_interval)
+        self._ttft_compat = os.environ.get("GROVE_TTFT_COMPAT", "0") == "1"
+
+        # Block geometry. Defaults: 16-token blocks (a v5e lane-friendly
+        # granule; GROVE_PAGED_BLOCK overrides) and a pool sized to the
+        # lanes engine's worst case (batch × max_len) so the DEFAULT
+        # shape never regresses capacity — deployments shrink num_blocks
+        # to bank the memory win.
+        if block_size is None:
+            block_size = int(os.environ.get("GROVE_PAGED_BLOCK", 16))
+        block_size = max(1, min(block_size, self.max_len))
+        self.block_size = block_size
+        self.max_blocks_per_seq = -(-self.max_len // block_size)
+        if num_blocks is None:
+            num_blocks = batch * self.max_blocks_per_seq + 1  # + null
+        # The pool must fit at least ONE full sequence, or a lone
+        # max-length request could never be served no matter how the
+        # scheduler evicts (everything else degrades gracefully;
+        # this cannot).
+        assert num_blocks - 1 >= self.max_blocks_per_seq, \
+            (num_blocks, self.max_blocks_per_seq)
+        self.kv = PagedKV.create(cfg.n_layers, num_blocks, block_size,
+                                 cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+        self._alloc = BlockAllocator(num_blocks, block_size)
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get("GROVE_PAGED_CHUNK", 32))
+        self.prefill_chunk = max(1, min(prefill_chunk, self.max_len))
+        self._sched = PagedScheduler(self._alloc, batch,
+                                     self.max_blocks_per_seq,
+                                     self.prefill_chunk)
+
+        # ---- GSPMD: mesh + shardings (1-chip CPU degrades to no-ops) --
+        from grove_tpu.parallel import sharding as shardlib
+        from grove_tpu.parallel.mesh import single_device_mesh
+        if mesh is None:
+            mesh = single_device_mesh()
+        tp = mesh.shape.get("tp", 1)
+        assert cfg.n_kv_heads % tp == 0, \
+            f"n_kv_heads {cfg.n_kv_heads} must divide over tp={tp}"
+        self.mesh = mesh
+        self.params = shardlib.shard_params(mesh, self.params)
+        kv_sh = shardlib.paged_kv_sharding(mesh)
+        self.kv = PagedKV(k=jax.device_put(self.kv.k, kv_sh),
+                          v=jax.device_put(self.kv.v, kv_sh))
+        # Host-fed buffers (tokens at recompose, tables, prefill chunks)
+        # are COMMITTED to the replicated sharding before dispatch:
+        # an uncommitted host array and a device-chained committed one
+        # would otherwise key two executables per bucket.
+        self._rep = shardlib.replicated(mesh)
+
+        self._rng = jax.random.PRNGKey(self._sampler.seed)
+        self._sampling = self._sampler.temperature > 0.0
+
+        # Per-bucket jitted executables (lazy): each (shape-bucket) key
+        # owns its own jit object, so its cache holds exactly one entry
+        # and a recompile is impossible by construction — the finite
+        # bucket ladder is the zero-steady-state-recompiles guarantee.
+        self._step_jits: dict[tuple, Callable] = {}
+        self._prefill_jits: dict[int, Callable] = {}
+
+        # Request flow state.
+        self._queue: deque[Request] = deque()
+        self._next_rid = 0
+        self.completed: list[Request] = []
+        self.steps = 0              # decode dispatches
+        self.ticks = 0              # engine ticks (prefill or decode)
+        # Device-resident decode state for the CURRENT composition.
+        self._tokens = None         # [B] int32 (B = batch bucket)
+        self._lengths_dev = None    # [B] int32
+        self._tables_dev = None     # [B, W] int32
+        self._cur_shape: tuple[int, int] | None = None
+        self._tables_sig: tuple = ()
+        self._run_order: tuple = ()
+        self._composition_dirty = True
+        self._pending: list[jnp.ndarray] = []
+        self._finishing: list = []
+
+        # Data-plane observatory (same contract as the lanes engine).
+        self.xprof = None
+        if xprof is not False:
+            from grove_tpu.serving import xprof as xprof_mod
+            if xprof is not None:
+                self.xprof = xprof
+                self.xprof.cfg = cfg
+                self.xprof.batch = batch
+                self.xprof.max_len = self.max_len
+            elif xprof_mod.enabled():
+                self.xprof = xprof_mod.Observatory(
+                    cfg=cfg, batch=batch, max_len=self.max_len)
+
+    # ---- jit construction (one executable per shape bucket) ----
+
+    def _wrap(self, name: str, jitted):
+        if self.xprof is not None:
+            return self.xprof.compile.wrap(name, jitted)
+        return jitted
+
+    def _get_step(self, B: int, W: int):
+        key = (B, W, self._sampling)
+        fn = self._step_jits.get(key)
+        if fn is not None:
+            return fn
+        from grove_tpu.parallel import sharding as shardlib
+        cfg = self.cfg
+        sampler_cfg = self._sampler
+
+        def step_greedy(params, tokens, kv_k, kv_v, tables, lengths):
+            logits, kv_k, kv_v = llama.decode_step_paged(
+                cfg, params, tokens, kv_k, kv_v, tables, lengths)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, kv_k, kv_v, lengths + 1
+
+        def step_sampled(params, tokens, kv_k, kv_v, tables, lengths, key):
+            logits, kv_k, kv_v = llama.decode_step_paged(
+                cfg, params, tokens, kv_k, kv_v, tables, lengths)
+            key, sub = jax.random.split(key)
+            nxt = sample_tokens(logits, sub, sampler_cfg)
+            return nxt, kv_k, kv_v, lengths + 1, key
+
+        ins, outs = shardlib.paged_step_shardings(
+            self.mesh, self.params, sampled=self._sampling)
+        fn = jax.jit(step_sampled if self._sampling else step_greedy,
+                     donate_argnums=(2, 3), in_shardings=ins,
+                     out_shardings=outs)
+        suffix = "_sampled" if self._sampling else ""
+        fn = self._wrap(f"paged_step{suffix}[b{B},w{W}]", fn)
+        self._step_jits[key] = fn
+        return fn
+
+    def _get_prefill(self, W: int):
+        fn = self._prefill_jits.get(W)
+        if fn is not None:
+            return fn
+        from grove_tpu.parallel import sharding as shardlib
+        cfg = self.cfg
+
+        def chunk_fn(params, tokens, kv_k, kv_v, table, offset, logit_idx,
+                     n_valid):
+            return llama.prefill_chunk_paged(cfg, params, tokens, kv_k,
+                                             kv_v, table, offset,
+                                             logit_idx, n_valid)
+
+        ins, outs = shardlib.paged_prefill_shardings(self.mesh, self.params)
+        fn = jax.jit(chunk_fn, donate_argnums=(2, 3), in_shardings=ins,
+                     out_shardings=outs)
+        fn = self._wrap(f"paged_prefill[c{self.prefill_chunk},w{W}]", fn)
+        self._prefill_jits[W] = fn
+        return fn
+
+    def warmup(self, batches: list[int] | None = None,
+               widths: list[int] | None = None,
+               prefill_widths: list[int] | None = None) -> int:
+        """Pre-compile bucket executables by dispatching over the NULL
+        block: tables all point at block 0, lengths are 0, so the
+        garbage lands in the one block no sequence ever owns — live
+        state is untouched by design. Returns the number of executables
+        built. A deployment calls this at startup so the first real
+        traffic never pays an XLA build (the decode bench uses it to
+        pin zero compiles across the measured window).
+
+        ``prefill_widths`` defaults to ``widths`` (and both to the full
+        ladder); pass ``[]`` to skip prefill builds when ``widths``
+        describes a decode-only trajectory — prefill and decode cross
+        DIFFERENT width ranges for the same run, and an unused
+        executable is a real XLA build wasted."""
+        built = 0
+        for B in batches or self._sched.batch_buckets:
+            for W in widths or self._sched.width_buckets:
+                if (B, W, self._sampling) not in self._step_jits:
+                    built += 1
+                fn = self._get_step(B, W)
+                # Commit-ness mirrors the steady state exactly (or the
+                # warm entry would not be THE entry): tokens/lengths
+                # committed, tables host-fed.
+                toks = jax.device_put(np.zeros((B,), np.int32), self._rep)
+                tables = np.zeros((B, W), np.int32)
+                lens = jax.device_put(np.zeros((B,), np.int32), self._rep)
+                if self._sampling:
+                    _, k, v, _, self._rng = fn(self.params, toks, self.kv.k,
+                                               self.kv.v, tables, lens,
+                                               self._rng)
+                else:
+                    _, k, v, _ = fn(self.params, toks, self.kv.k,
+                                    self.kv.v, tables, lens)
+                self.kv = PagedKV(k=k, v=v)
+        if prefill_widths is None:
+            prefill_widths = widths or self._sched.width_buckets
+        for W in prefill_widths:
+            if W not in self._prefill_jits:
+                built += 1
+            fn = self._get_prefill(W)
+            toks = np.zeros((1, self.prefill_chunk), np.int32)
+            table = np.zeros((1, W), np.int32)
+            _, k, v = fn(self.params, toks, self.kv.k, self.kv.v, table,
+                         np.int32(0), np.int32(0), np.int32(0))
+            self.kv = PagedKV(k=k, v=v)
+        jax.block_until_ready(self.kv.k)
+        return built
+
+    def decode_width_buckets(self, start_tokens: int,
+                             end_tokens: int) -> list[int]:
+        """The width buckets a sequence crosses decoding from
+        ``start_tokens`` to ``end_tokens`` in cache — what a caller
+        passes to ``warmup(widths=...)`` to pre-build exactly the
+        executables a known-length run will touch (the full ladder is
+        overkill when the trajectory is known: a fixed-batch bench
+        crossing 3 width buckets should not compile 6)."""
+        bs = self.block_size
+        ladder = self._sched.width_buckets
+        lo = pick_bucket(max(1, -(-start_tokens // bs)), ladder)
+        hi = pick_bucket(max(1, -(-end_tokens // bs)), ladder)
+        return [w for w in ladder if lo <= w <= hi]
+
+    # ---- request intake ----
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int = 32) -> int:
+        prompt = np.asarray(prompt, np.int32)
+        assert len(prompt) < self.max_len, \
+            (f"prompt of {len(prompt)} tokens cannot fit max_len="
+             f"{self.max_len} with room to generate")
+        req = Request(rid=self._next_rid, prompt=prompt,
+                      max_new_tokens=max_new_tokens,
+                      enqueue_ts=time.time())
+        self._next_rid += 1
+        self._queue.append(req)
+        self._report_metric()
+        return req.rid
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests not yet (re)admitted: the submit queue plus
+        preempted sequences awaiting recompute."""
+        return len(self._queue) + len(self._sched.preempted)
+
+    @property
+    def kv_lane_utilization(self) -> float:
+        """Fraction of the KV block pool in use — the paged analog of
+        the lanes gauge (1.0 = allocator dry, admissions defer)."""
+        return self._alloc.utilization
+
+    @property
+    def _active(self) -> np.ndarray:
+        """Liveness mask (run_load compatibility): one True per
+        sequence currently prefilling or decoding, plus one while
+        undrained window tokens or completions are pending — a driver
+        stepping only while "active" must keep ticking until the last
+        request's bookkeeping lands (the 2365/2366 clean-exit leak)."""
+        n = self._sched.live
+        if n == 0 and (self._pending or self._finishing):
+            n = 1
+        return np.ones((n,), bool)
+
+    @property
+    def cache(self) -> PagedKV:
+        """The KV pool (xprof.memory_snapshot reads .k/.v through
+        this, same as the lanes engine's contiguous cache)."""
+        return self.kv
+
+    def _report_metric(self) -> None:
+        if self.metric_hook is not None:
+            self.metric_hook(self.queue_depth)
+        if self.telemetry is not None:
+            self.telemetry.sample_gauges(self.queue_depth,
+                                         self.kv_lane_utilization)
+        if self.xprof is not None:
+            self.xprof.observe_memory(self, self.telemetry)
+
+    def _stamp_admit(self, req: Request, now: float,
+                     admit: float | None = None) -> None:
+        _stamp_admit_impl(req, now, admit, self._ttft_compat,
+                          self.telemetry)
+
+    def _complete(self, req: Request) -> None:
+        _complete_impl(req, self.completed, self.telemetry)
+
+    # ---- admission ----
+
+    def admit_from_queue(self, prefiller=None) -> int:
+        """Admit queued work into the scheduler: preempted sequences
+        re-enter first (recompute), then fresh requests FIFO, each
+        gated on a free slot + the allocator's first-chunk grant.
+        ``prefiller`` is accepted for lanes-engine call-site
+        compatibility (tools/loadgen.run_load) and ignored — chunked
+        prefill is in-engine here."""
+        admitted = 0
+        while self._sched.preempted:
+            seq = self._sched.preempted.popleft()
+            if self._sched.readmit(seq) is None:
+                self._sched.preempted.appendleft(seq)
+                break
+            admitted += 1
+        while self._queue:
+            req = self._queue[0]
+            popped = time.time()  # queue-exit, before any prefill work
+            if self._sched.admit(
+                    req, req.prompt[:req.prompt_len]) is None:
+                break
+            self._queue.popleft()
+            if not req.admit_ts:
+                req.admit_ts = popped
+            admitted += 1
+        if admitted:
+            self._report_metric()
+        return admitted
+
+    def admit_prompts(self, prompts, max_new_tokens: int | None = None,
+                      lengths=None) -> None:
+        """Bench-path bulk admission: submit a [b, s] batch and drive
+        chunked prefill to completion so every row is decoding. The
+        lanes engine prefills this in one batched dispatch; here each
+        prompt advances chunk-by-chunk (the steady-state machinery is
+        the thing being benchmarked)."""
+        prompts_np = np.asarray(prompts)
+        b, s = prompts_np.shape
+        lengths_np = (np.full((b,), s, np.int32) if lengths is None
+                      else np.asarray(lengths, np.int32))
+        for i in range(b):
+            n = int(lengths_np[i])
+            new = (max_new_tokens if max_new_tokens is not None
+                   else self.max_len - n)
+            self.submit(prompts_np[i, :n], max_new_tokens=new)
+        self.admit_from_queue()
+        stalled = 0
+        while self._sched.has_prefill_work() or self._queue \
+                or self._sched.preempted:
+            before = self._admit_progress()
+            if self._sched.has_prefill_work():
+                self._prefill_tick()
+            elif self._sched.running:
+                # Slots full with prompts still queued (a batch larger
+                # than the engine's slot count): decode the live set so
+                # completions free slots — without this the loop would
+                # spin forever waiting on admissions that can't happen.
+                self._decode_tick()
+            self.admit_from_queue()
+            stalled = stalled + 1 if self._admit_progress() == before \
+                else 0
+            if stalled > 4 * self.batch + 16:
+                raise RuntimeError(
+                    "admit_prompts stalled: KV pool too small for the "
+                    f"batch ({self._alloc.payload()})")
+
+    def _admit_progress(self) -> tuple:
+        """Monotone progress signature for admit_prompts' stall guard:
+        prefill positions, decode positions, completions, admissions —
+        if a full iteration moves none of these, nothing ever will."""
+        return (sum(sq.pos for sq in self._sched.prefilling),
+                sum(sq.pos for sq in self._sched.running),
+                len(self.completed), self._sched.admitted_total)
+
+    # ---- the tick loop ----
+
+    def step(self) -> None:
+        """One engine tick: at most one prefill chunk (continuous
+        batching's admission lane) followed by one decode dispatch over
+        the compacted batch. No device syncs on this path — windows
+        drain in ``_drain`` (host-sync-in-step-loop lint rule)."""
+        if self._sched.has_prefill_work():
+            self._prefill_tick()
+        if self._sched.running:
+            self._decode_tick()
+        elif self._pending or self._finishing:
+            # The decode set emptied with a window in flight: fold it
+            # in now — nothing else will (the last completion must not
+            # wait for traffic that may never come).
+            self._drain()
+        self.ticks += 1
+
+    def run(self, steps: int) -> None:
+        """Drive ``steps`` ticks, then drain + hard-sync (timed-loop
+        honesty: callers measure completed work, not queued dispatch)."""
+        for _ in range(steps):
+            self.step()
+        self.sync()
+
+    def sync(self) -> None:
+        self._drain()
+        if self._tokens is not None:
+            np.asarray(self._tokens)
+
+    # ---- chunked prefill ----
+
+    def _prefill_tick(self) -> None:
+        seq = self._sched.next_prefill()
+        if seq is None:
+            if not self._sched.prefilling:
+                return
+            # OOM at the prefill head. Decode has ABSOLUTE priority
+            # for the pool (the vLLM ordering): with anything running,
+            # the head simply waits — completions free blocks, and
+            # running progress is guaranteed (decode-side OOM preempts
+            # among running and reclaims from prefilling, never the
+            # other way). Preempting running work to feed a prefill
+            # ping-pongs forever once two near-complete sequences
+            # cannot coexist — the tight-pool storm test caught
+            # exactly that livelock. With NOTHING running, the blocks
+            # are pinned by other prefilling sequences that can never
+            # advance past the FIFO head — evict the newest back to
+            # the queue instead of deadlocking on completions that
+            # cannot come.
+            if not self._sched.running:
+                head = self._sched.prefilling[0]
+                victim = self._sched.evict_newest_prefilling(protect=head)
+                if victim is not None:
+                    self._requeue_prefill_victim(victim)
+                    self._report_metric()
+            return
+        c = self.prefill_chunk
+        pos, total = seq.pos, seq.prompt_len
+        valid = min(c, total - pos)
+        toks = np.zeros((1, c), np.int32)
+        toks[0, :valid] = seq.tokens[pos:pos + valid]
+        W = pick_bucket(len(seq.blocks.blocks), self._sched.width_buckets)
+        table = pad_tables([seq.blocks.blocks], W)
+        fn = self._get_prefill(W)
+        x = self.xprof
+        sampled = x is not None and x.should_sample()
+        if sampled:
+            jax.block_until_ready(self.kv.k)
+            t0 = time.perf_counter()
+        logits, k, v = fn(self.params, toks, self.kv.k, self.kv.v, table,
+                          np.int32(pos), np.int32(max(0, valid - 1)),
+                          np.int32(valid))
+        self.kv = PagedKV(k=k, v=v)
+        if sampled:
+            jax.block_until_ready(logits)
+            x.record("prefill", time.perf_counter() - t0, tokens=valid)
+        seq.pos += valid
+        if seq.prefill_done:
+            self._finish_prefill(seq, logits)
+
+    def _requeue_prefill_victim(self, victim) -> None:
+        """Re-queue a sequence evicted from the prefill queue. A
+        recompute victim carries generated history in its tokens and
+        must re-enter through the preempted path (readmit restores
+        n_generated); requeueing its bare Request would replay only
+        the prompt and re-stamp TTFT — the output-corruption bug a
+        review pass caught."""
+        if victim.recompute:
+            self._sched.preempted.appendleft(victim)
+        else:
+            self._queue.appendleft(victim.req)
+
+    def _finish_prefill(self, seq, logits) -> None:
+        """The chunk that PRODUCES the first token just ran: sample it,
+        stamp TTFT here — at token emission, not at batch-wide prefill
+        completion (the chunked-prefill TTFT satellite; both
+        GROVE_TTFT_COMPAT modes regression-tested)."""
+        if self._sampling:
+            self._rng, sub = jax.random.split(self._rng)
+            tok = int(np.asarray(
+                sample_tokens(logits, sub, self._sampler))[0])
+        else:
+            tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        req = seq.req
+        if seq.recompute:
+            # Recompute replays history; the sampled token is the next
+            # DECODE token, not a first token — no stamp rewrite.
+            req.generated.append(tok)
+            if self.telemetry is not None:
+                self.telemetry.add_tokens(1)
+        else:
+            self._stamp_admit(req, time.time(), admit=req.admit_ts or None)
+            req.generated.append(tok)
+        seq.n_generated = len(req.generated)
+        seq.last_token = tok
+        self._sched.promote(seq)
+        self._composition_dirty = True
+        if seq.finished():
+            self._sched.retire(seq)
+            self._complete(req)
+        self._report_metric()
+
+    # ---- decode ----
+
+    def _decode_tick(self) -> None:
+        sched = self._sched
+        # Cache-full truncation (the lanes engine's _lane_has_room
+        # analog): a sequence whose next write would land past max_len
+        # completes NOW — letting it grow would push its block table
+        # past the width ladder's top bucket and crash the dispatch.
+        full = [s for s in sched.running if s.pos + 1 > self.max_len]
+        if full:
+            self._drain()
+            for s in full:
+                sched.retire(s)
+                self._complete(s.req)
+            self._composition_dirty = True
+            self._report_metric()
+            if not sched.running:
+                return
+        # Capacity: a block grant does NOT change composition, so the
+        # cheap path needs no drain; only a shortfall (preemption) or a
+        # finished/joined sequence forces one.
+        needy = [s for s in sched.running if not s.blocks.ensure(s.pos + 1)]
+        if needy:
+            self._drain()
+            if sched.ensure_decode_capacity():
+                self._composition_dirty = True
+                self._report_metric()
+            stuck = [s for s in sched.running
+                     if s.blocks.capacity < s.pos + 1]
+            for s in stuck:
+                # Before truncating, reclaim pool from the PREFILL
+                # queue: preempt_newest only sees running sequences,
+                # but blocks pinned by prefilling ones are just as
+                # reclaimable (their occupants re-queue without losing
+                # produced tokens).
+                while s.blocks.capacity < s.pos + 1:
+                    victim = sched.evict_newest_prefilling()
+                    if victim is None:
+                        break
+                    self._requeue_prefill_victim(victim)
+                    s.blocks.ensure(s.pos + 1)
+                if s.blocks.capacity >= s.pos + 1:
+                    continue
+                # Truly un-growable: the pool cannot back one more
+                # token — truncate rather than livelock.
+                sched.retire(s)
+                self._complete(s.req)
+                self._composition_dirty = True
+            if not sched.running:
+                return
+        sig = tuple(len(s.blocks.blocks) for s in self._run_order)
+        if self._composition_dirty:
+            self._recompose()
+        elif sig != self._tables_sig:
+            self._refresh_tables()
+        if not sched.running:
+            return
+        B, W = self._cur_shape
+        fn = self._get_step(B, W)
+        x = self.xprof
+        sampled = x is not None and x.should_sample()
+        if sampled:
+            jax.block_until_ready(self._tokens)
+            t0 = time.perf_counter()
+        if self._sampling:
+            tokens, k, v, lengths, self._rng = fn(
+                self.params, self._tokens, self.kv.k, self.kv.v,
+                self._tables_dev, self._lengths_dev, self._rng)
+        else:
+            tokens, k, v, lengths = fn(
+                self.params, self._tokens, self.kv.k, self.kv.v,
+                self._tables_dev, self._lengths_dev)
+        if sampled:
+            jax.block_until_ready(tokens)
+            x.record("sample" if self._sampling else "step",
+                     time.perf_counter() - t0,
+                     tokens=len(self._run_order))
+        self.kv = PagedKV(k=k, v=v)
+        self._tokens, self._lengths_dev = tokens, lengths
+        # Each pending window remembers ITS composition: joins/leaves
+        # between windows then need no drain — the fold-in maps each
+        # window's columns through its own snapshot.
+        self._pending.append((tokens, self._run_order))
+        self.steps += 1
+        for seq in self._run_order:
+            if seq.req.done:
+                continue
+            seq.pos += 1
+            seq.n_generated += 1
+            if seq.finished() and seq in sched.running:
+                # Count-based completion: no token values needed, so
+                # blocks free IMMEDIATELY; the window tokens drain
+                # later into req.generated.
+                sched.retire(seq)
+                self._finishing.append(seq)
+                self._composition_dirty = True
+        if len(self._pending) >= self.host_sync_interval:
+            self._drain()
+
+    def _recompose(self) -> None:
+        """Rebuild the device-resident decode state after sequences
+        joined or left: drain pending windows (their snapshots carry
+        the survivors' current tokens to the host), compact the running
+        set into slots [0, n), and ship fresh token/length/table
+        buffers at the new buckets. Measured on the CPU mesh this beats
+        a drain-free eager-gather variant — the per-recompose device
+        scatter cost more than the window sync it avoided."""
+        self._drain()
+        running = self._sched.running
+        self._run_order = tuple(running)
+        self._composition_dirty = False
+        if not running:
+            self._tokens = None
+            self._lengths_dev = None
+            self._tables_dev = None
+            self._cur_shape = None
+            self._tables_sig = ()
+            return
+        B, W = self._sched.decode_shape()
+        self._cur_shape = (B, W)
+        toks = np.zeros((B,), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, s in enumerate(running):
+            toks[i] = s.last_token
+            lens[i] = s.pos
+        self._tokens = jax.device_put(toks, self._rep)
+        self._lengths_dev = jax.device_put(lens, self._rep)
+        self._push_tables(B, W)
+
+    def _refresh_tables(self) -> None:
+        """A running sequence grew a block (same composition): only the
+        table buffer is stale; tokens/lengths stay device-chained. The
+        width bucket may step up — batch bucket is unchanged."""
+        B = self._cur_shape[0]
+        W = pick_bucket(
+            max(len(s.blocks.blocks) for s in self._run_order),
+            self._sched.width_buckets)
+        self._cur_shape = (B, W)
+        self._push_tables(B, W)
+
+    def _push_tables(self, B: int, W: int) -> None:
+        rows = pad_tables([s.blocks.blocks for s in self._run_order], W)
+        full = np.zeros((B, W), np.int32)
+        full[:len(self._run_order)] = rows
+        # Kept as a host array: the jit commits it on dispatch. Every
+        # step call then passes tables the same way (host-fed), so the
+        # arg keys ONE executable per bucket — mixing committed and
+        # host-fed tables would key two.
+        self._tables_dev = full
+        self._tables_sig = tuple(len(s.blocks.blocks)
+                                 for s in self._run_order)
+
+    def _drain(self) -> None:
+        """Fold pending window tokens into their requests: ONE chain
+        wait per window (the first fetch), everything after is
+        already-materialised. Runs once per host_sync_interval or at a
+        composition change — never per step."""
+        if not self._pending:
+            return
+        x = self.xprof
+        if x is not None:
+            t0 = time.perf_counter()
+        entries = [(np.asarray(t), order) for t, order in self._pending]
+        if x is not None:
+            x.record("host_transfer", time.perf_counter() - t0)
+        self._pending.clear()
+        appended = 0
+        for arr, order in entries:
+            for i, seq in enumerate(order):
+                req = seq.req
+                if req.done or len(req.generated) >= req.max_new_tokens:
+                    continue
+                tok = int(arr[i])
+                req.generated.append(tok)
+                seq.last_token = tok
+                appended += 1
+        if self.telemetry is not None:
+            self.telemetry.add_tokens(appended)
+        if self._finishing:
+            for seq in self._finishing:
+                self._complete(seq.req)
+            self._finishing = []
+            self._report_metric()
+
+    def payload(self) -> dict:
+        """Debug view: scheduler + allocator state (the /debug twins
+        ride the xprof surface; this is the engine-side snapshot)."""
+        return {"engine": "paged", "slots": self.batch,
+                "max_len": self.max_len,
+                "block_size": self.block_size,
+                "prefill_chunk": self.prefill_chunk,
+                "queue_depth": self.queue_depth,
+                "steps": self.steps, "ticks": self.ticks,
+                "completed": len(self.completed),
+                "schedule": self._sched.payload()}
+
+
+def engine_mode() -> str:
+    """GROVE_ENGINE=paged|lanes (default paged). ``lanes`` restores the
+    seed fixed-lane engine byte-for-byte — the escape hatch every
+    rebuild in this repo ships with."""
+    mode = os.environ.get("GROVE_ENGINE", "paged")
+    if mode not in ("paged", "lanes"):
+        raise ValueError(f"GROVE_ENGINE={mode!r} (expected paged|lanes)")
+    return mode
+
+
+def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
+                max_len: int | None = None,
+                host_sync_interval: int = 8,
+                sampler: SamplerConfig | None = None,
+                quant: str | None = None,
+                metric_hook=None, telemetry=None, xprof=None,
+                mesh=None, mode: str | None = None,
+                **paged_kwargs):
+    """Engine factory honoring GROVE_ENGINE. Paged-only knobs
+    (block_size, num_blocks, prefill_chunk) pass through
+    ``paged_kwargs`` and are ignored by the lanes engine."""
+    mode = mode or engine_mode()
+    common = dict(batch=batch, max_len=max_len,
+                  host_sync_interval=host_sync_interval, sampler=sampler,
+                  quant=quant, metric_hook=metric_hook,
+                  telemetry=telemetry, xprof=xprof)
+    if mode == "lanes":
+        return DecodeEngine(cfg, key_or_params, **common)
+    return PagedDecodeEngine(cfg, key_or_params, mesh=mesh,
+                             **common, **paged_kwargs)

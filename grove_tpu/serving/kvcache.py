@@ -1,0 +1,216 @@
+"""Paged KV cache for the continuous-batching decode engine.
+
+The seed engine (`DecodeEngine`) pre-allocates one contiguous
+``max_len`` KV row per decode lane, so effective batch size is bounded
+by the WORST-CASE sequence length: a 512-token cache budget funds 4
+lanes at max_len 128 even when the live traffic averages 20 tokens.
+This module replaces that with the vLLM memory model, TPU-shaped:
+
+- **Fixed-size blocks.** One device pool per engine,
+  ``[layers, num_blocks, block_size, n_kv, head_dim]`` for K and V.
+  Block 0 is the NULL block: padded block-table rows and inactive
+  batch slots point at it, so the scatter/gather paths never need a
+  dynamic-shape branch — garbage lands in (and is read from) a block
+  no live sequence owns, and the attention mask discards it.
+- **Per-request block tables.** A sequence owns an ordered list of
+  block ids; token position ``p`` lives at block ``table[p // bs]``,
+  slot ``p % bs``. Tables are padded to bucketed widths on the way to
+  the device (static shapes → no recompiles; see serving/schedule.py
+  for the bucket ladder).
+- **Host-side allocator.** A LIFO free list (reuse-hot blocks stay in
+  cache) with strict invariants: allocation is all-or-nothing, a
+  shortfall returns None (the scheduler's OOM backpressure signal —
+  defer admission or preempt, never a partial grant), double-free and
+  foreign-free raise. Everything here is plain host bookkeeping;
+  nothing touches a device.
+
+Effective batch is then bounded by TOKENS IN FLIGHT: the same 512-token
+budget serves ~25 live 20-token sequences instead of 4 worst-case
+lanes. The model-side gather/scatter lives in
+``models/llama.decode_step_paged`` / ``prefill_chunk_paged``; the
+design rationale (block size, bucket ladder, recompile story) is
+docs/design/continuous-batching.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Block 0 never leaves the allocator: padding rows of every block table
+# point at it, and inactive batch slots scatter their dead writes into
+# it. One sacrificial block buys static shapes everywhere else.
+NULL_BLOCK = 0
+
+
+class PagedKV(NamedTuple):
+    """The device half: one K and one V block pool.
+
+    Shapes: ``[layers, num_blocks, block_size, n_kv, head_dim]``. The
+    pool rides jit boundaries as a plain pytree and is DONATED through
+    every decode/prefill dispatch (the engine threads the returned pool
+    forward, exactly like the contiguous cache)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @classmethod
+    def create(cls, n_layers: int, num_blocks: int, block_size: int,
+               n_kv: int, head_dim: int, dtype=jnp.bfloat16) -> "PagedKV":
+        shape = (n_layers, num_blocks, block_size, n_kv, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def tokens_capacity(self) -> int:
+        """Usable token capacity (the null block is not allocatable)."""
+        return (self.num_blocks - 1) * self.block_size
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the block pool.
+
+    LIFO reuse (recently freed blocks are likeliest still warm in HBM
+    caches / host page tables), all-or-nothing grants, and loud
+    invariant violations: a double free or a free of a never-granted
+    block is a scheduler bug, not a recoverable condition.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        assert num_blocks >= 2, "need at least the null block + one real"
+        assert block_size >= 1
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # Block ids count down so early allocations pop low ids — makes
+        # allocator traces readable; NULL_BLOCK (0) is never in the list.
+        self._free: list[int] = list(range(num_blocks - 1, NULL_BLOCK, -1))
+        self._allocated: set[int] = set()
+        # Counters for the telemetry/debug surfaces and the soak tests.
+        self.allocs_total = 0
+        self.frees_total = 0
+        self.oom_events = 0
+        self.high_water = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of the allocatable pool in use — the paged analog
+        of the lanes engine's kv_lane_utilization gauge."""
+        return self.used_blocks / self.capacity if self.capacity else 0.0
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Grant ``n`` blocks, or None (backpressure) — never partial.
+        The None is the signal the scheduler turns into deferred
+        admission or preemption; raising here would make every
+        steady-state OOM an exception on the hot path."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            self.oom_events += 1
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._allocated.update(got)
+        self.allocs_total += n
+        self.high_water = max(self.high_water, len(self._allocated))
+        return got
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("freeing the null block")
+            if b not in self._allocated:
+                raise ValueError(
+                    f"free of unallocated block {b} (double free or "
+                    "foreign block) — scheduler bookkeeping is corrupt")
+            self._allocated.remove(b)
+            self._free.append(b)
+            self.frees_total += 1
+
+    def check(self) -> None:
+        """Structural invariants (the soak test sweeps this between
+        every operation): free ∪ allocated partitions [1, num_blocks),
+        no duplicates anywhere, null block owned by neither."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate in free list"
+        assert not (free & self._allocated), "block both free and allocated"
+        assert NULL_BLOCK not in free and NULL_BLOCK not in self._allocated
+        assert free | self._allocated == set(range(1, self.num_blocks)), \
+            "leaked or foreign block"
+
+    def payload(self) -> dict:
+        return {"capacity": self.capacity, "used": self.used_blocks,
+                "free": self.free_blocks, "block_size": self.block_size,
+                "utilization": round(self.utilization, 4),
+                "allocs_total": self.allocs_total,
+                "frees_total": self.frees_total,
+                "oom_events": self.oom_events,
+                "high_water": self.high_water}
+
+
+@dataclasses.dataclass
+class SeqBlocks:
+    """One sequence's block table: the ordered block ids backing token
+    positions [0, capacity). Growth is allocator-mediated and
+    all-or-nothing; ``release`` is idempotent."""
+
+    allocator: BlockAllocator
+    blocks: list[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.blocks) * self.allocator.block_size
+
+    def ensure(self, n_tokens: int) -> bool:
+        """Grow the table to hold ``n_tokens`` total. False = OOM
+        backpressure (table unchanged — the all-or-nothing grant means
+        a failed ensure never strands half the growth)."""
+        bs = self.allocator.block_size
+        need = max(0, -(-n_tokens // bs) - len(self.blocks))
+        if need == 0:
+            return True
+        got = self.allocator.alloc(need)
+        if got is None:
+            return False
+        self.blocks.extend(got)
+        return True
+
+    def release(self) -> None:
+        if self.blocks:
+            self.allocator.free(self.blocks)
+            self.blocks = []
+
+
+def pad_tables(tables: list[list[int]], width: int) -> np.ndarray:
+    """Stack per-sequence block-id lists into a ``[len(tables), width]``
+    int32 array, padding with the null block. ``width`` must cover the
+    widest table (the scheduler's width bucket guarantees it)."""
+    out = np.full((len(tables), width), NULL_BLOCK, np.int32)
+    for i, t in enumerate(tables):
+        assert len(t) <= width, (len(t), width)
+        out[i, :len(t)] = t
+    return out

@@ -1,0 +1,301 @@
+"""Continuous-batching schedule policy for the paged decode engine.
+
+Pure host bookkeeping — no jax imports, nothing here touches a device.
+The engine (serving/engine.py ``PagedDecodeEngine``) owns the device
+arrays and the jitted dispatch; this module owns the decisions:
+
+- **Bucketed shapes.** Every dispatch shape comes off two fixed
+  power-of-two ladders (batch slots, block-table width), so the set of
+  executables is FINITE and workload-independent: once the buckets a
+  deployment actually uses are warm, steady state runs zero recompiles
+  (the decode_smoke / CompileTracker pin). Rounding a 5-sequence batch
+  up to 8 wastes three rows of compute — the classic static-shape
+  trade, and still far cheaper than one mid-traffic XLA build.
+- **Admission.** A request is admitted when a decode slot is free and
+  the allocator grants its first prefill chunk. A shortfall defers the
+  request in place (FIFO; no head-of-line skipping — a starving big
+  request must eventually get its blocks).
+- **Chunked prefill.** Prompts are processed one fixed-size chunk per
+  engine tick, interleaved with decode steps: the longest prompt can
+  stall TPOT for at most one chunk's wall time, never the whole
+  prefill. Capacity is ensured for the chunk's VALID tokens only; the
+  padded tail scatters into the null block via the kernel's
+  ``n_valid`` mask (models/llama._paged_scatter — without the mask,
+  the clipped scatter corrupted a real block's tokens, found the hard
+  way in kernel bring-up).
+- **Preemption by recompute.** When decode needs a block and the pool
+  is dry, the NEWEST running sequence is evicted: blocks freed,
+  prompt + generated-so-far becomes its recompute prompt, and it
+  re-enters at the head of the admission queue (vLLM's recompute
+  policy). The victim's stamps and token counts survive — recompute
+  regenerates cache state, not history.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+
+from grove_tpu.serving.kvcache import BlockAllocator, SeqBlocks
+
+
+def bucket_ladder(maximum: int, start: int = 1) -> list[int]:
+    """Powers of two from ``start`` up, capped by (and always
+    including) ``maximum`` — the fixed shape ladder."""
+    assert maximum >= 1
+    out, v = [], max(1, start)
+    while v < maximum:
+        out.append(v)
+        v *= 2
+    out.append(maximum)
+    return sorted(set(out))
+
+
+def pick_bucket(n: int, ladder: list[int]) -> int:
+    """Smallest ladder entry >= n."""
+    for b in ladder:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds the top bucket {ladder[-1]}")
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: seqs are keys
+class PagedSeq:
+    """One request's life inside the paged engine. ``tokens`` is what
+    prefill must process — the prompt, or prompt + generated for a
+    recompute after preemption. ``pos`` is tokens already written to
+    the cache; ``n_generated`` counts sampled tokens (the prefill's
+    first token included, matching the lanes engine's accounting)."""
+
+    req: object                     # serving.engine.Request
+    tokens: np.ndarray              # int32 [len] — prefill input
+    blocks: SeqBlocks
+    order: int                      # admission sequence (preemption key)
+    pos: int = 0                    # tokens written to the KV cache
+    n_generated: int = 0
+    recompute: bool = False         # re-prefill after preemption
+    last_token: int = -1            # host view of the newest token
+    preemptions: int = 0
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.pos >= len(self.tokens)
+
+    def finished(self) -> bool:
+        return self.n_generated >= self.req.max_new_tokens
+
+
+class PagedScheduler:
+    """Admission / prefill / decode-set policy over one allocator.
+
+    States a sequence moves through:
+    ``preempted`` (recompute queue, drains first) → ``prefilling``
+    (chunks advancing) → ``running`` (in the decode batch) → gone
+    (finished: blocks freed by the engine). The engine calls the
+    transition methods; everything here is synchronous host work.
+    """
+
+    def __init__(self, allocator: BlockAllocator, max_slots: int,
+                 max_blocks_per_seq: int, chunk: int) -> None:
+        self.allocator = allocator
+        self.max_slots = max_slots
+        self.chunk = chunk
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.batch_buckets = bucket_ladder(max_slots)
+        self.width_buckets = bucket_ladder(max_blocks_per_seq)
+        self.prefilling: deque[PagedSeq] = deque()
+        self.running: list[PagedSeq] = []
+        self.preempted: deque[PagedSeq] = deque()
+        self._order = 0
+        # Policy counters (debug payloads + tests).
+        self.admitted_total = 0
+        self.deferred_total = 0
+        self.preemptions_total = 0
+
+    # ---- occupancy ----
+
+    @property
+    def live(self) -> int:
+        return len(self.prefilling) + len(self.running)
+
+    @property
+    def slots_free(self) -> int:
+        return self.max_slots - self.live
+
+    def has_prefill_work(self) -> bool:
+        return bool(self.prefilling)
+
+    # ---- admission ----
+
+    def _chunk_capacity(self, seq: PagedSeq) -> int:
+        """Token capacity the NEXT chunk dispatch needs: its VALID
+        tokens (the kernel's n_valid mask reroutes the padded tail to
+        the null block, so backing the padding would just tighten OOM
+        pressure in small pools for nothing)."""
+        return min(seq.pos + self.chunk, len(seq.tokens),
+                   self.max_blocks_per_seq * self.allocator.block_size)
+
+    def _head_starved(self) -> bool:
+        """True when the prefill head's next chunk cannot currently be
+        granted — new admissions must then defer (head priority), or
+        an admit/evict cycle could livelock: the head's shortfall gets
+        re-granted to fresh admissions forever."""
+        if not self.prefilling:
+            return False
+        head = self.prefilling[0]
+        bs = self.allocator.block_size
+        need = (-(-self._chunk_capacity(head) // bs)
+                - len(head.blocks.blocks))
+        return need > 0 and not self.allocator.can_alloc(need)
+
+    def admit(self, req, tokens: np.ndarray,
+              recompute: bool = False) -> PagedSeq | None:
+        """Admit one request if a slot is free, the prefill head is not
+        starved, and the allocator grants the first chunk. None =
+        backpressure (nothing allocated)."""
+        if self.slots_free <= 0 or self._head_starved():
+            self.deferred_total += 1
+            return None
+        seq = PagedSeq(req=req, tokens=np.asarray(tokens, np.int32),
+                       blocks=SeqBlocks(self.allocator), order=self._order,
+                       recompute=recompute)
+        if not seq.blocks.ensure(self._chunk_capacity(seq)):
+            self.deferred_total += 1
+            return None
+        self._order += 1
+        self.admitted_total += 1
+        self.prefilling.append(seq)
+        return seq
+
+    def readmit(self, seq: PagedSeq) -> PagedSeq | None:
+        """Move the front preempted sequence back in (called before
+        fresh admissions so recompute work drains first)."""
+        got = self.admit(seq.req, seq.tokens, recompute=True)
+        if got is not None:
+            got.n_generated = seq.n_generated
+            got.preemptions = seq.preemptions
+        return got
+
+    # ---- prefill ----
+
+    def next_prefill(self) -> PagedSeq | None:
+        """The chunk to run this tick: front of the prefill queue,
+        ready only if its next (padded) chunk's capacity is granted.
+        FIFO — a later prompt never overtakes a blocked earlier one."""
+        if not self.prefilling:
+            return None
+        seq = self.prefilling[0]
+        if not seq.blocks.ensure(self._chunk_capacity(seq)):
+            return None
+        return seq
+
+    def promote(self, seq: PagedSeq) -> None:
+        """Prefill finished → join the decode batch (continuous: this
+        happens at ANY step, between any two decode dispatches)."""
+        assert self.prefilling and self.prefilling[0] is seq
+        self.prefilling.popleft()
+        self.running.append(seq)
+
+    # ---- decode-set maintenance ----
+
+    def retire(self, seq: PagedSeq) -> None:
+        """Remove a finished sequence and free its blocks."""
+        self.running.remove(seq)
+        seq.blocks.release()
+
+    def evict_newest_prefilling(self, protect: PagedSeq | None = None
+                                ) -> PagedSeq | None:
+        """Release the NEWEST prefilling sequence's blocks and drop it
+        from the prefill queue (its Request restarts from scratch via
+        the engine's queue — no token was produced yet, so nothing is
+        replayed). The escape hatch for prefill head-of-line OOM when
+        NOTHING is decoding: with every block pinned by other
+        prefilling sequences that can never advance (head-only FIFO),
+        waiting for completions would wait forever."""
+        candidates = [s for s in self.prefilling if s is not protect]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda s: s.order)
+        self.prefilling.remove(victim)
+        victim.blocks.release()
+        victim.pos = 0
+        self.preemptions_total += 1
+        return victim
+
+    def preempt_newest(self, protect: PagedSeq | None = None
+                       ) -> PagedSeq | None:
+        """Evict the newest running sequence (≠ ``protect``) for
+        recompute: free its blocks, queue it at the preempted head.
+        Returns the victim, or None when nobody is evictable."""
+        candidates = [s for s in self.running if s is not protect]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda s: s.order)
+        self.running.remove(victim)
+        victim.blocks.release()
+        # Recompute input: everything decoded so far rides the new
+        # prompt, so prefill reconstructs the exact cache state (greedy
+        # or seeded sampling — history is replayed, not re-drawn).
+        # CALLERS MUST DRAIN FIRST: req.generated is the replay source,
+        # and an undrained window here would replay a cache one-or-more
+        # tokens short (a value-equality heuristic cannot detect that —
+        # greedy decode repeats tokens routinely), so assert instead.
+        gen = list(getattr(victim.req, "generated", []))
+        assert victim.last_token < 0 or (
+            gen and gen[-1] == victim.last_token), \
+            "preempt_newest called with undrained window tokens"
+        victim.tokens = np.concatenate(
+            [np.asarray(victim.req.prompt[:victim.req.prompt_len],
+                        np.int32),
+             np.asarray(gen, np.int32)]) if gen else \
+            np.asarray(victim.req.prompt[:victim.req.prompt_len], np.int32)
+        victim.pos = 0
+        victim.preemptions += 1
+        self.preemptions_total += 1
+        self.preempted.appendleft(victim)
+        return victim
+
+    def ensure_decode_capacity(self) -> list[PagedSeq]:
+        """Grant every running sequence room for one more token,
+        preempting newest-first on shortfall. Returns the victims (the
+        engine re-queues them). A lone un-growable sequence is left to
+        the engine to force-finish — preempting the only occupant
+        would livelock."""
+        victims: list[PagedSeq] = []
+        for seq in list(self.running):
+            if seq not in self.running:
+                continue  # already evicted this sweep
+            while not seq.blocks.ensure(seq.pos + 1):
+                v = self.preempt_newest(protect=seq)
+                if v is None:
+                    return victims  # engine handles the stuck lone seq
+                victims.append(v)
+        return victims
+
+    # ---- shape selection ----
+
+    def decode_shape(self) -> tuple[int, int]:
+        """(batch bucket, width bucket) for the current running set."""
+        n = len(self.running)
+        w = max((len(s.blocks.blocks) for s in self.running), default=1)
+        return pick_bucket(n, self.batch_buckets), \
+            pick_bucket(w, self.width_buckets)
+
+    def payload(self) -> dict:
+        return {"running": len(self.running),
+                "prefilling": len(self.prefilling),
+                "preempted": len(self.preempted),
+                "max_slots": self.max_slots,
+                "chunk": self.chunk,
+                "batch_buckets": self.batch_buckets,
+                "width_buckets": self.width_buckets,
+                "admitted_total": self.admitted_total,
+                "deferred_total": self.deferred_total,
+                "preemptions_total": self.preemptions_total,
+                "allocator": self.allocator.payload()}

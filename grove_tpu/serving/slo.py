@@ -24,6 +24,15 @@ windowed (``host_sync_interval``), so completion-side stamps are
 observed at drain time — up to interval-1 steps late by design; the
 enqueue/admit stamps are exact.
 
+Chunked prefill (the paged engine, PR 15) refines the first-token
+stamp: ``first_token_ts`` lands when the CHUNK that produces the
+token completes — the sampling moment — not at batch-wide prefill
+completion, so TTFT stays honest when a prompt's windows interleave
+with other work. ``admit_ts`` stays queue-exit; GROVE_TTFT_COMPAT=1
+fuses the two exactly as before. Both engines route through one stamp
+helper (engine._stamp_admit_impl), so the split can't drift between
+them.
+
 ``snapshot()`` compresses the histograms into the percentile digest the
 batched push ships (serving/metrics_push.push_samples): per-metric
 value + aggregation mode, so the control plane's MetricsRegistry knows

@@ -59,7 +59,11 @@ logger = logging.getLogger("grove.xprof")
 # Decode-step phases the flight recorder attributes device time to.
 # "step" is the greedy decode dispatch (single or per-step normalized
 # block), "sample" the key-threaded sampled variant, "host_transfer"
-# the window drain's device→host fetch.
+# the window drain's device→host fetch. The paged engine (PR 15) maps
+# onto the same split: chunked-prefill dispatches sample into
+# "prefill" (block_until_ready-bracketed, 1/N gated), bucketed decode
+# dispatches into "step"/"sample" — one catalog for both engines, so
+# /debug/xprof reads the same under GROVE_ENGINE=paged|lanes.
 PHASES = ("prefill", "step", "sample", "host_transfer")
 
 # Recompile-storm window: more than STORM_THRESHOLD non-first compiles

@@ -68,6 +68,7 @@ SLOW_MODULES = {
     "test_e2e_jax_distributed", "test_e2e_process", "test_e2e_disagg",
     "test_e2e_secure_multihost", "test_e2e_chaos", "test_bench_supervisor",
     "test_diagnostics",  # spawns a sub-pytest with a live cluster
+    "test_paged_engine",  # compiles per-bucket paged executables
 }
 
 

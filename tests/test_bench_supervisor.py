@@ -59,21 +59,30 @@ def test_cpu_fallback_reports_nonzero_stamped_row():
     assert row["value"] > 0
     assert row["backend_mode"] == "cpu-fallback"
     assert row["compile_seconds"] > 0
-    assert row["compiles"].get("prefill") == 1
+    # Default engine is the paged continuous-batching one: its compile
+    # table carries per-bucket lowerings (paged_prefill[cC,wW], ...).
+    assert row["engine"] == "paged"
+    assert any(k.startswith("paged_prefill") for k in row["compiles"]), \
+        row["compiles"]
     assert "step" in row["phases"] or "sample" in row["phases"]
-    # vs_baseline measured on the SAME backend (the engine-bare loop on
-    # the CPU mesh), never CPU-served against a TPU baseline.
-    assert 0 < row["vs_baseline"] <= 1.5
+    # vs_baseline measured on the SAME backend (the contiguous bare
+    # block loop on the CPU mesh), never CPU-served against a TPU
+    # baseline. The paged engine dispatches per step (the lanes bench
+    # fused K steps per dispatch), so sub-1 ratios are expected here;
+    # the paged engine's win is the mixed-length workload
+    # (make bench-decode), not this uniform fixed batch.
+    assert 0 < row["vs_baseline"] <= 2.0
     assert row["probe_latency_s"] > 0
 
 
-def test_dead_relay_spends_one_insurance_attempt_then_reprobes():
+def test_dead_relay_spends_one_insurance_attempt_then_reserve():
     """Under a relay that HANGS every child, the supervisor spends two
-    probes, exactly ONE insurance attempt, then returns to cheap probes
-    for the remainder of the window (probe-attempt-probe) — a second
-    230s attempt would re-prove what the probes established while the
-    reclaimed budget buys probe cycles at the window's end, when a
-    flapping relay is likeliest to answer (VERDICT r4 weak #3)."""
+    probes and exactly ONE insurance attempt; with a hung attempt on
+    record the tail belongs to the CPU reserve, not to open-ended
+    re-probing (the BENCH_r05 fix — that round ran its budget to
+    "-0s left" probing a dead relay and reported 0.0). Here the total
+    budget is smaller than the reserve, so the supervisor breaks to the
+    fallback phase immediately after the attempt."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", GROVE_BENCH_HISTORY="0",
                GROVE_BENCH_FAKE_HANG="3600",
                GROVE_BENCH_PROBE_TIMEOUT="1",
@@ -90,11 +99,11 @@ def test_dead_relay_spends_one_insurance_attempt_then_reprobes():
     # Exactly one insurance attempt launched and killed by its watchdog.
     assert proc.stderr.count("probe gate bypassed") == 1
     assert proc.stderr.count("exceeded the") == 1
-    # Probing resumed AFTER the insurance attempt: probe failures appear
-    # on both sides of the attempt in the stderr timeline.
     bypass_at = proc.stderr.index("probe gate bypassed")
     assert "probe failed" in proc.stderr[:bypass_at]
-    assert "probe failed" in proc.stderr[bypass_at:]
+    # The hung attempt engaged the reserve; the budget was NOT run dry.
+    assert "engaging the CPU reserve" in proc.stderr
+    assert "-0s left" not in proc.stderr
     # Last stdout line is parseable and records the single attempt.
     parsed = json.loads(proc.stdout.strip().splitlines()[-1])
     assert parsed["value"] == 0.0
@@ -103,6 +112,37 @@ def test_dead_relay_spends_one_insurance_attempt_then_reprobes():
     # relay never answered, and the row says so instead of a blind 0.0.
     assert parsed["backend_mode"] == "unreachable"
     assert "probe" in parsed
+
+
+def test_hung_attempt_caps_tail_reprobes_then_engages_reserve():
+    """With budget beyond the reserve, the post-attempt tail re-probes
+    at most GROVE_BENCH_TAIL_REPROBES times (a late relay recovery is
+    still observed) and then breaks to the fallback phase with the
+    reserve intact — the r05 timeline can no longer exhaust a round."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GROVE_BENCH_HISTORY="0",
+               GROVE_BENCH_FAKE_HANG="3600",
+               GROVE_BENCH_PROBE_TIMEOUT="1",
+               GROVE_BENCH_PROBE_DELAY="0.1",
+               GROVE_BENCH_ATTEMPT_TIMEOUT="3",
+               GROVE_BENCH_RETRY_DELAY="0.1",
+               GROVE_BENCH_ATTEMPTS="3",
+               GROVE_BENCH_TAIL_REPROBES="2",
+               GROVE_BENCH_CPU_RESERVE="8",
+               GROVE_BENCH_TOTAL_BUDGET="30")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=90)
+    assert proc.returncode == 1
+    # One insurance attempt, at most the capped number of tail probes,
+    # then the reserve engages — never a drained budget.
+    assert proc.stderr.count("exceeded the") == 1
+    assert ("tail re-probe cap" in proc.stderr
+            or "engaging the CPU reserve" in proc.stderr)
+    assert "-0s left" not in proc.stderr
+    parsed = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert parsed["value"] == 0.0
+    assert parsed["attempts"] == 1
 
 
 def test_failed_attempt_still_prints_parseable_json():

@@ -318,6 +318,126 @@ def test_clone_rule_ignores_point_gets():
     assert lint(src, "grove_tpu/controllers/podclique.py") == []
 
 
+# ---- host-sync-in-step-loop ----------------------------------------------
+
+STEP_SYNC_BAD = """
+    import jax
+    import numpy as np
+
+    class Engine:
+        def step(self):
+            self._dispatch()
+            jax.block_until_ready(self._tokens)
+            toks = np.asarray(self._tokens)
+            n = self._count.item()
+
+        def run(self, steps):
+            for _ in range(steps):
+                np.asarray(self._tokens)
+"""
+
+# The sampling-MODE branch is taken every dispatch — a sync under it
+# is a per-step stall, and the gate heuristic must NOT exempt it.
+STEP_SYNC_MODE_BRANCH = """
+    import numpy as np
+
+    class Engine:
+        def step(self):
+            if self._sampling:
+                n = self._count.item()
+"""
+
+STEP_SYNC_GOOD = """
+    import jax
+    import numpy as np
+
+    class Engine:
+        def step(self):
+            sampled = self.xprof is not None and self.xprof.should_sample()
+            if sampled:
+                jax.block_until_ready(self._tokens)
+            self._dispatch()
+            if sampled:
+                jax.block_until_ready(self._tokens)
+            if len(self._pending) >= self.window:
+                self._drain()
+
+        def run(self, steps):
+            for _ in range(steps):
+                self.step()
+            self.sync()
+
+        def _drain(self):
+            # Once per window, in a named helper: sanctioned.
+            toks = np.asarray(jax.numpy.stack(self._pending))
+"""
+
+
+def test_host_sync_in_step_loop_fires():
+    findings = lint(STEP_SYNC_BAD, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"host-sync-in-step-loop"}
+    # block_until_ready + np.asarray + .item() in step(), plus the
+    # in-loop np.asarray in run(): all four shapes detected.
+    assert len(findings) == 4
+
+
+def test_host_sync_gated_and_helpers_pass():
+    assert lint(STEP_SYNC_GOOD, "grove_tpu/serving/engine.py") == []
+
+
+def test_host_sync_sampling_mode_branch_is_not_a_gate():
+    findings = lint(STEP_SYNC_MODE_BRANCH, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"host-sync-in-step-loop"}
+
+
+def test_host_sync_scans_per_tick_internals_and_rejects_xprof_gate():
+    """The dispatch path includes the per-tick internals step()
+    delegates to, and an always-on `if self.xprof is not None:` mode
+    branch is NOT the sampling gate (it runs every dispatch)."""
+    src = """
+        import numpy as np
+
+        class Engine:
+            def _decode_tick(self):
+                if self.xprof is not None:
+                    n = self._count.item()
+
+            def _prefill_tick(self):
+                np.asarray(self._logits)
+    """
+    findings = lint(src, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"host-sync-in-step-loop"}
+    assert len(findings) == 2
+
+
+def test_host_sync_in_control_flow_headers_fires():
+    """A sync hidden in an If/While test or For iterable runs every
+    step too — header expressions must be scanned, not just statement
+    bodies."""
+    src = """
+        import numpy as np
+
+        class Engine:
+            def step(self):
+                if self._done.item():
+                    return
+                while self._flag.item():
+                    self._spin()
+                for t in np.asarray(self._tokens):
+                    self._use(t)
+    """
+    findings = lint(src, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"host-sync-in-step-loop"}
+    assert len(findings) == 3
+
+
+def test_host_sync_rule_scoped_to_engine_module():
+    # The same source elsewhere is not this rule's business — drains
+    # and benches sync wherever they like.
+    assert lint(STEP_SYNC_BAD, "grove_tpu/serving/other.py") == []
+    assert lint(STEP_SYNC_BAD, "tools/bench_decode.py") == []
+
+
 # ---- pragmas -------------------------------------------------------------
 
 def test_inline_pragma_suppresses_with_justification():

@@ -1,0 +1,475 @@
+"""Paged continuous-batching engine: device-path parity, lifecycle,
+chunked-prefill stamps, preemption recompute, bucketed-shape compile
+hygiene (slow tier — compiles XLA programs).
+
+The companion host-only allocator/schedule coverage is
+tests/test_paged_kvcache.py; the CI smoke (tools/decode_smoke.py) pins
+the exact lowering set. Here the invariants are semantic: the paged
+gather/scatter path produces the SAME tokens as the contiguous seed
+engine, requests join and leave mid-flight, and memory pressure
+degrades through recompute — never through wrong tokens.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import llama
+from grove_tpu.ops.kvcache import KVCache
+from grove_tpu.serving.engine import (DecodeEngine, PagedDecodeEngine,
+                                      engine_mode, make_engine)
+from grove_tpu.serving.kvcache import BlockAllocator, PagedKV, SeqBlocks, \
+    pad_tables
+
+CFG = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                          max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def drive(eng, want: int, max_iters: int = 800) -> None:
+    for _ in range(max_iters):
+        eng.admit_from_queue()
+        if len(eng.completed) >= want:
+            break
+        if eng._sched.live:
+            eng.step()
+    eng.sync()
+    assert len(eng.completed) >= want, (len(eng.completed), want)
+
+
+# ---- block-table kernels vs the contiguous reference cache ----
+
+def test_paged_kernels_match_contiguous_reference(params):
+    """Same seeds, same params: chunked prefill over block tables +
+    paged decode must reproduce the contiguous cache's logits (the
+    masked-softmax padding contributes exact zeros, so the paths agree
+    to the float; greedy tokens must match exactly)."""
+    b, s, gen = 3, 10, 6
+    prompts = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (b, s), 0, CFG.vocab_size), np.int32)
+
+    cache = KVCache.create(CFG.n_layers, b, 64, CFG.n_kv_heads,
+                           CFG.head_dim, jnp.float32)
+    ref_logits, cache = llama.prefill(CFG, params, jnp.asarray(prompts),
+                                      cache)
+    ref_tok = [np.asarray(jnp.argmax(ref_logits, -1))]
+    tok = jnp.argmax(ref_logits, -1).astype(jnp.int32)
+    for _ in range(gen - 1):
+        logits, cache = llama.decode_step(CFG, params, tok, cache)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        ref_tok.append(np.asarray(tok))
+
+    bs, chunk = 4, 4
+    alloc = BlockAllocator(num_blocks=32, block_size=bs)
+    kv = PagedKV.create(CFG.n_layers, 32, bs, CFG.n_kv_heads,
+                        CFG.head_dim, jnp.float32)
+    seqs = [SeqBlocks(alloc) for _ in range(b)]
+    first = np.zeros((b,), np.int32)
+    for i in range(b):
+        pos = 0
+        while pos < s:
+            c = min(chunk, s - pos)
+            toks = np.zeros((1, chunk), np.int32)
+            toks[0, :c] = prompts[i, pos:pos + c]
+            assert seqs[i].ensure(pos + chunk)  # padded chunk width
+            table = jnp.asarray(pad_tables([seqs[i].blocks],
+                                           len(seqs[i].blocks)))
+            lg, k, v = llama.prefill_chunk_paged(
+                CFG, params, jnp.asarray(toks), kv.k, kv.v, table,
+                jnp.int32(pos), jnp.int32(c - 1))
+            kv = PagedKV(k=k, v=v)
+            pos += c
+        first[i] = int(np.argmax(np.asarray(lg)[0]))
+    assert list(first) == list(ref_tok[0])
+
+    tok = jnp.asarray(first)
+    lengths = np.full((b,), s, np.int32)
+    got = [first]
+    for step in range(gen - 1):
+        for sq in seqs:
+            assert sq.ensure(int(lengths[0]) + 1)
+        w = max(len(sq.blocks) for sq in seqs)
+        tables = jnp.asarray(pad_tables([sq.blocks for sq in seqs], w))
+        logits, k, v = llama.decode_step_paged(
+            CFG, params, tok, kv.k, kv.v, tables, jnp.asarray(lengths))
+        kv = PagedKV(k=k, v=v)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        got.append(np.asarray(tok))
+        lengths += 1
+    np.testing.assert_array_equal(np.stack(ref_tok), np.stack(got))
+    alloc.check()
+
+
+# ---- engine-level parity + lifecycle ----
+
+def test_paged_engine_matches_lanes_tokens(params):
+    """Mixed-length greedy traffic through both engines: identical
+    generated sequences (the logits-parity satellite at engine
+    altitude — admission order, chunking, and compaction must not
+    change the math)."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, CFG.vocab_size, size=n).astype(np.int32)
+               for n in (6, 13, 4, 9)]
+
+    lanes = DecodeEngine(CFG, params, batch=len(prompts), max_len=48)
+    pad = max(len(p) for p in prompts)
+    toks = np.zeros((len(prompts), pad), np.int32)
+    lens = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+        lens[i] = len(p)
+    lanes.admit_prompts(jnp.asarray(toks), max_new_tokens=8,
+                        lengths=jnp.asarray(lens))
+    for _ in range(16):
+        lanes.step()
+    lanes.sync()
+    assert len(lanes.completed) == len(prompts)
+
+    paged = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                              block_size=8, prefill_chunk=8,
+                              host_sync_interval=4)
+    for p in prompts:
+        paged.submit(p, max_new_tokens=8)
+    drive(paged, len(prompts))
+    lanes_by = {r.prompt_len: r.generated for r in lanes.completed}
+    for r in paged.completed:
+        assert r.generated == lanes_by[r.prompt_len], r.prompt_len
+
+
+def test_request_joins_mid_decode(params):
+    """Continuous batching's defining property: a request admitted
+    while another is mid-decode joins THAT batch — no window drain, no
+    full-batch barrier (the seed engine admits only into free lanes at
+    whole-prefill boundaries)."""
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4)
+    rng = np.random.default_rng(5)
+    eng.submit(rng.integers(0, 256, size=6).astype(np.int32),
+               max_new_tokens=20)
+    eng.admit_from_queue()
+    for _ in range(8):
+        eng.step()
+    assert eng._sched.running and not eng.completed
+    first = eng._sched.running[0]
+    mid_pos = first.pos
+    eng.submit(rng.integers(0, 256, size=5).astype(np.int32),
+               max_new_tokens=4)
+    eng.admit_from_queue()
+    # Drive a few ticks: the second request prefills and joins while
+    # the first keeps decoding.
+    joined_at = None
+    for _ in range(30):
+        eng.step()
+        if len(eng._sched.running) == 2 and joined_at is None:
+            joined_at = True
+            assert not eng.completed  # first is still mid-flight
+        if len(eng.completed) == 2:
+            break
+        eng.admit_from_queue()
+    assert joined_at, "second request never joined the live batch"
+    drive(eng, 2)
+    assert first.pos > mid_pos
+    # The short second request finished while the long first ran on.
+    done = {r.prompt_len: r for r in eng.completed}
+    assert done[5].done_ts <= done[6].done_ts
+
+
+def test_chunked_prefill_interleaves_with_decode(params):
+    """A long prompt must not stall TPOT for its whole prefill: each
+    engine tick advances at most ONE chunk and still runs the decode
+    dispatch, so the live batch keeps producing tokens while the
+    prompt works through its chunks."""
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=64,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=2)
+    rng = np.random.default_rng(6)
+    eng.submit(rng.integers(0, 256, size=4).astype(np.int32),
+               max_new_tokens=24)
+    eng.admit_from_queue()
+    for _ in range(4):
+        eng.step()
+    running = eng._sched.running[0]
+    pos_before = running.pos
+    # 40-token prompt = 5 chunks of 8.
+    eng.submit(rng.integers(0, 256, size=40).astype(np.int32),
+               max_new_tokens=4)
+    eng.admit_from_queue()
+    decoded_during_prefill = 0
+    prefill_ticks = 0
+    while eng._sched.prefilling and prefill_ticks < 20:
+        before = running.pos
+        eng.step()
+        prefill_ticks += 1
+        if running.pos > before:
+            decoded_during_prefill += 1
+    assert prefill_ticks >= 4, "long prompt finished in one tick?"
+    assert decoded_during_prefill >= 4, \
+        "decode stalled for the whole prefill"
+    drive(eng, 2)
+
+
+def test_ttft_stamped_at_producing_chunk_both_modes(params, monkeypatch):
+    """The chunked-prefill TTFT satellite: first_token_ts lands when
+    the chunk that PRODUCES the token completes (the sampling moment),
+    admit_ts at queue exit; GROVE_TTFT_COMPAT=1 fuses them — both
+    modes pinned, with a multi-chunk prompt so prefill takes real
+    wall time between the stamps."""
+    from grove_tpu.serving.slo import EngineTelemetry
+
+    def run_one(compat: bool):
+        monkeypatch.setenv("GROVE_TTFT_COMPAT", "1" if compat else "0")
+        tel = EngineTelemetry()
+        eng = PagedDecodeEngine(CFG, params, batch=2, max_len=64,
+                                block_size=8, prefill_chunk=8,
+                                host_sync_interval=4, telemetry=tel)
+        rng = np.random.default_rng(8)
+        eng.submit(rng.integers(0, 256, size=29).astype(np.int32),
+                   max_new_tokens=5)  # 4 chunks of 8
+        drive(eng, 1)
+        return eng.completed[0], tel
+
+    req, tel = run_one(compat=False)
+    assert req.enqueue_ts <= req.admit_ts < req.first_token_ts \
+        <= req.done_ts
+    # Queue-wait excludes the chunked prefill; TTFT includes it.
+    assert tel.quantile("ttft_seconds", 0.5) > \
+        tel.quantile("queue_wait_seconds", 0.5)
+    assert tel.hist_count("ttft_seconds") == 1
+
+    old, _ = run_one(compat=True)
+    assert old.admit_ts == old.first_token_ts  # the fused derivation
+
+
+def test_oom_preemption_recompute_preserves_tokens(params):
+    """Memory pressure degrades through RECOMPUTE, never through wrong
+    tokens: a pool small enough to force preemption must still produce
+    exactly the sequences a roomy pool does (greedy — the replayed
+    prompt+generated reconstructs the cache bit-for-bit)."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 256, size=6).astype(np.int32)
+               for _ in range(4)]
+
+    def run(num_blocks):
+        eng = PagedDecodeEngine(CFG, params, batch=4, max_len=40,
+                                block_size=4, num_blocks=num_blocks,
+                                prefill_chunk=4, host_sync_interval=2)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=12)
+        drive(eng, 4, max_iters=3000)
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    roomy = run(num_blocks=48)
+    tight = run(num_blocks=13)
+    assert tight._sched.preemptions_total > 0, \
+        "pool was not tight enough to force preemption"
+    by_rid = {r.rid: r.generated for r in roomy.completed}
+    for r in tight.completed:
+        assert r.generated == by_rid[r.rid], r.rid
+        assert len(r.generated) == 12
+
+
+def test_tight_pool_storm_preserves_tokens(params):
+    """Review regression (recompute-eviction corruption): a pool tight
+    enough to force decode preemptions AND prefill-queue evictions —
+    including recompute sequences bounced back through the preempted
+    path — must still produce exactly the roomy pool's greedy tokens
+    for every request (with greedy independent sequences, a request's
+    tokens depend only on its prompt, so any scheduling-path corruption
+    shows up as divergence)."""
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, 256, size=int(n)).astype(np.int32)
+               for n in rng.integers(6, 20, size=8)]
+
+    def run(num_blocks, slots):
+        eng = PagedDecodeEngine(CFG, params, batch=slots, max_len=40,
+                                block_size=4, num_blocks=num_blocks,
+                                prefill_chunk=4, host_sync_interval=2)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=10)
+        drive(eng, len(prompts), max_iters=6000)
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    roomy = run(num_blocks=96, slots=8)
+    tight = run(num_blocks=12, slots=6)
+    assert tight._sched.preemptions_total > 0, "pool not tight enough"
+    by_rid = {r.rid: r.generated for r in roomy.completed}
+    for r in tight.completed:
+        assert r.generated == by_rid[r.rid], r.rid
+        assert len(r.generated) == 10
+        # Stamps survived the churn in order, never re-stamped later
+        # than completion.
+        assert r.enqueue_ts <= r.admit_ts <= r.first_token_ts \
+            <= r.done_ts
+
+
+def test_zero_steady_state_compiles(params):
+    """The bucket-ladder guarantee at engine altitude: after warmup()
+    plus one traffic pass, a second identical pass adds zero
+    executables and zero recompiles (decode_smoke pins the exact set;
+    this pins the invariant inside the suite)."""
+    eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4)
+    built = eng.warmup()
+    assert built > 0
+    assert eng.warmup() == 0  # idempotent: everything already built
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, size=n).astype(np.int32)
+               for n in (4, 17, 8)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=6)
+    drive(eng, 3)
+    counts = dict(eng.xprof.compile.counts())
+    for p in prompts:
+        eng.submit(p, max_new_tokens=6)
+    drive(eng, 6)
+    assert eng.xprof.compile.counts() == counts
+    assert eng.xprof.compile.recompile_count() == 0
+    assert all(v == 1 for v in counts.values()), counts
+
+
+def test_make_engine_factory_honors_grove_engine(params, monkeypatch):
+    monkeypatch.setenv("GROVE_ENGINE", "lanes")
+    assert engine_mode() == "lanes"
+    eng = make_engine(CFG, params, batch=2, max_len=48)
+    assert isinstance(eng, DecodeEngine)
+    monkeypatch.setenv("GROVE_ENGINE", "paged")
+    eng = make_engine(CFG, params, batch=2, max_len=48, block_size=8)
+    assert isinstance(eng, PagedDecodeEngine)
+    monkeypatch.setenv("GROVE_ENGINE", "bogus")
+    with pytest.raises(ValueError):
+        engine_mode()
+    monkeypatch.delenv("GROVE_ENGINE")
+    assert engine_mode() == "paged"  # the default is the rebuild
+
+
+def test_paged_engine_gspmd_mesh_argument(params):
+    """The GSPMD path takes an explicit mesh; a 1-device mesh must be
+    byte-identical to the default (the CPU-fallback contract: same
+    engine, shardings collapse to no-ops)."""
+    from grove_tpu.parallel.mesh import single_device_mesh
+
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, 256, size=7).astype(np.int32)
+
+    eng_default = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                                    block_size=8, prefill_chunk=8)
+    eng_mesh = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                                 block_size=8, prefill_chunk=8,
+                                 mesh=single_device_mesh())
+    for eng in (eng_default, eng_mesh):
+        eng.submit(p, max_new_tokens=6)
+        drive(eng, 1)
+    assert eng_default.completed[0].generated \
+        == eng_mesh.completed[0].generated
+
+
+def test_chunk_padding_past_capacity_does_not_corrupt(params):
+    """Review regression: a final prefill chunk whose PADDED tail
+    extends past the sequence's per-seq token capacity must not let
+    the clamped scatter overwrite real prompt K/V (max_len=48,
+    chunk=32, block=16: a 40-token prompt's last chunk pads to
+    positions 32..63 while capacity tops at 48 — the overflow rows
+    must land in the null block, and the tokens must match the lanes
+    engine exactly)."""
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(0, CFG.vocab_size, size=40).astype(np.int32)
+
+    lanes = DecodeEngine(CFG, params, batch=1, max_len=48)
+    lanes.admit_prompts(jnp.asarray(prompt)[None], max_new_tokens=6)
+    for _ in range(12):
+        lanes.step()
+    lanes.sync()
+    assert len(lanes.completed) == 1
+
+    paged = PagedDecodeEngine(CFG, params, batch=1, max_len=48,
+                              block_size=16, prefill_chunk=32,
+                              host_sync_interval=4)
+    paged.submit(prompt, max_new_tokens=6)
+    drive(paged, 1)
+    assert paged.completed[0].generated == lanes.completed[0].generated
+
+
+def test_cache_full_truncates_instead_of_crashing(params):
+    """Review regression: max_new_tokens overshooting max_len must
+    complete the request at cache-full (the lanes _lane_has_room
+    analog) — before the fix the block table grew past the width
+    ladder's top bucket and pick_bucket raised out of step()."""
+    rng = np.random.default_rng(22)
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=40,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4)
+    eng.submit(rng.integers(0, 256, size=30).astype(np.int32),
+               max_new_tokens=64)  # would need 94 > max_len tokens
+    drive(eng, 1, max_iters=400)
+    req = eng.completed[0]
+    # Truncated at the cache boundary: the cache holds prompt 30 +
+    # 10 written tokens = max_len 40, plus the final sampled token
+    # which needs no write — max_len - prompt_len + 1 generated,
+    # exactly the lanes engine's _lane_has_room arithmetic.
+    assert len(req.generated) == 40 - 30 + 1
+    assert req.done_ts > 0
+    eng._alloc.check()
+    assert eng._alloc.used_blocks == 0
+
+
+def test_prefill_head_of_line_oom_does_not_deadlock(params):
+    """Review regression: with every block pinned by PREFILLING
+    sequences (nothing decoding), the FIFO head's OOM used to wait
+    forever for completions that could never come. The engine must
+    evict the newest prefilling sequence back to the queue (head
+    priority gates re-admission) and finish everything."""
+    rng = np.random.default_rng(23)
+    # Pool of 6 blocks; 4 concurrent admissions each pinning blocks
+    # while prefilling 17-token prompts (3 blocks each at bs=8 with
+    # chunk padding) guarantees head-of-line OOM before any decode.
+    eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                            block_size=8, num_blocks=7,
+                            prefill_chunk=8, host_sync_interval=4)
+    for _ in range(4):
+        eng.submit(rng.integers(0, 256, size=17).astype(np.int32),
+                   max_new_tokens=4)
+    drive(eng, 4, max_iters=3000)
+    assert len(eng.completed) == 4
+    for r in eng.completed:
+        assert len(r.generated) == 4
+    eng._alloc.check()
+    assert eng._alloc.used_blocks == 0
+
+
+def test_telemetry_gauges_and_memory_surface(params):
+    """EngineTelemetry + xprof memory accounting ride the paged engine
+    unchanged: queue/utilization gauges sample, the memory snapshot
+    reads the block pool through the .cache property."""
+    from grove_tpu.serving.slo import EngineTelemetry
+    from grove_tpu.serving.xprof import memory_snapshot
+
+    tel = EngineTelemetry()
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8, prefill_chunk=8, telemetry=tel)
+    rng = np.random.default_rng(13)
+    for _ in range(3):
+        eng.submit(rng.integers(0, 256, size=5).astype(np.int32),
+                   max_new_tokens=4)
+    assert tel.queue_depth == 3
+    drive(eng, 3)
+    assert tel.requests_completed == 3
+    assert tel.tokens_total == sum(len(r.generated)
+                                   for r in eng.completed)
+    mem = memory_snapshot(eng)
+    assert mem["kv_cache_bytes"] == eng.kv.k.nbytes + eng.kv.v.nbytes
+    assert mem["source"] in ("device", "model-estimate")
+    assert eng.kv_lane_utilization == 0.0  # drained pool

@@ -1,0 +1,210 @@
+"""Paged KV allocator + schedule policy: pure host-side coverage
+(core tier — no XLA). The device-path parity and engine lifecycle
+tests live in tests/test_paged_engine.py (slow tier).
+
+The allocator is the paged engine's ledger: every block the model
+scatters into was granted here, and a bookkeeping slip turns into
+silent cross-sequence KV corruption. Hence the posture: invariants
+checked aggressively (the soak sweeps ``check()`` after every op),
+violations raise instead of degrading.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.serving.kvcache import (NULL_BLOCK, BlockAllocator,
+                                       PagedKV, SeqBlocks, pad_tables)
+from grove_tpu.serving.schedule import bucket_ladder, pick_bucket
+
+
+# ---- allocator invariants ----
+
+def test_alloc_free_reuse_invariants():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    assert a.capacity == 8  # null block is not allocatable
+    g1 = a.alloc(3)
+    assert g1 is not None and len(g1) == 3
+    assert NULL_BLOCK not in g1
+    assert a.used_blocks == 3 and a.free_blocks == 5
+    g2 = a.alloc(5)
+    assert g2 is not None and not (set(g1) & set(g2))
+    assert a.free_blocks == 0 and a.utilization == 1.0
+    a.check()
+    a.free(g1)
+    # LIFO reuse: the blocks just freed come back first.
+    g3 = a.alloc(3)
+    assert set(g3) == set(g1)
+    a.check()
+    a.free(g2)
+    a.free(g3)
+    assert a.used_blocks == 0 and a.free_blocks == 8
+    assert a.allocs_total == 11 and a.frees_total == 11
+    a.check()
+
+
+def test_alloc_is_all_or_nothing_backpressure():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    got = a.alloc(3)
+    assert got is not None
+    # 1 free, ask 2: None, NOTHING granted, oom counted.
+    assert a.alloc(2) is None
+    assert a.oom_events == 1
+    assert a.free_blocks == 1
+    a.check()
+    # The remaining single block is still grantable.
+    assert a.alloc(1) is not None
+    assert a.alloc(0) == []
+
+
+def test_double_free_and_foreign_free_raise():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got)  # double free
+    with pytest.raises(ValueError):
+        a.free([NULL_BLOCK])  # the null block is never grantable
+    b = BlockAllocator(num_blocks=5, block_size=4)
+    with pytest.raises(ValueError):
+        b.free([3])  # never granted by THIS allocator state
+
+
+def test_seq_blocks_growth_and_release():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    s = SeqBlocks(a)
+    assert s.capacity == 0
+    assert s.ensure(1) and s.capacity == 4
+    assert s.ensure(4) and s.capacity == 4      # no growth needed
+    assert s.ensure(9) and s.capacity == 12     # two more blocks
+    assert a.used_blocks == 3
+    # OOM growth: table unchanged (all-or-nothing).
+    other = SeqBlocks(a)
+    assert other.ensure(20) and a.free_blocks == 0
+    assert not s.ensure(100)
+    assert s.capacity == 12
+    s.release()
+    assert s.capacity == 0 and a.used_blocks == 5
+    s.release()  # idempotent
+    a.check()
+
+
+def test_fragmentation_any_free_block_serves_any_sequence():
+    """The paged design's fragmentation story: after arbitrary
+    interleaved releases the free set is discontiguous block IDS, and
+    that must not matter — a new sequence assembles its table from
+    whatever is free (contiguity lives in the table, not the pool)."""
+    a = BlockAllocator(num_blocks=17, block_size=4)
+    seqs = [SeqBlocks(a) for _ in range(4)]
+    for i, s in enumerate(seqs):
+        assert s.ensure((i + 1) * 4)
+    # Free the middle two: the free list is now a discontiguous mix.
+    seqs[1].release()
+    seqs[2].release()
+    free_before = a.free_blocks
+    big = SeqBlocks(a)
+    assert big.ensure(free_before * 4)   # consumes every free block
+    assert a.free_blocks == 0
+    assert len(set(big.blocks)) == len(big.blocks)
+    a.check()
+
+
+def test_randomized_alloc_free_soak():
+    """Hypothesis-style randomized soak (seeded PRNG, no dependency):
+    thousands of random grow/release ops with the structural check
+    swept after EVERY op, plus a shadow model of the free count."""
+    rng = np.random.default_rng(7)
+    a = BlockAllocator(num_blocks=33, block_size=8)
+    live: list[SeqBlocks] = []
+    for _ in range(3000):
+        op = rng.integers(0, 3)
+        if op == 0 or not live:                      # admit
+            s = SeqBlocks(a)
+            want = int(rng.integers(1, 60))
+            ok = s.ensure(want)
+            if ok:
+                live.append(s)
+            else:
+                assert -(-want // 8) > a.free_blocks  # honest OOM
+        elif op == 1:                                # grow a random seq
+            s = live[int(rng.integers(0, len(live)))]
+            want = s.capacity + int(rng.integers(1, 24))
+            before = list(s.blocks)
+            if not s.ensure(want):
+                assert s.blocks == before            # all-or-nothing
+        else:                                        # release a random seq
+            s = live.pop(int(rng.integers(0, len(live))))
+            s.release()
+        a.check()
+        assert a.used_blocks == sum(len(s.blocks) for s in live)
+    for s in live:
+        s.release()
+    a.check()
+    assert a.used_blocks == 0
+    assert a.allocs_total == a.frees_total
+
+
+# ---- table padding + bucket ladders ----
+
+def test_pad_tables_pads_with_null_block():
+    out = pad_tables([[3, 5], [7], []], width=4)
+    assert out.shape == (3, 4)
+    assert out.dtype == np.int32
+    assert list(out[0]) == [3, 5, NULL_BLOCK, NULL_BLOCK]
+    assert list(out[1]) == [7, NULL_BLOCK, NULL_BLOCK, NULL_BLOCK]
+    assert list(out[2]) == [NULL_BLOCK] * 4
+    with pytest.raises(AssertionError):
+        pad_tables([[1, 2, 3]], width=2)
+
+
+def test_bucket_ladder_and_pick():
+    assert bucket_ladder(1) == [1]
+    assert bucket_ladder(8) == [1, 2, 4, 8]
+    assert bucket_ladder(12) == [1, 2, 4, 8, 12]
+    assert bucket_ladder(6) == [1, 2, 4, 6]
+    ladder = bucket_ladder(12)
+    assert pick_bucket(1, ladder) == 1
+    assert pick_bucket(3, ladder) == 4
+    assert pick_bucket(9, ladder) == 12
+    assert pick_bucket(12, ladder) == 12
+    with pytest.raises(ValueError):
+        pick_bucket(13, ladder)
+
+
+def test_paged_kv_geometry():
+    kv = PagedKV.create(n_layers=2, num_blocks=9, block_size=4,
+                        n_kv=2, head_dim=8)
+    assert kv.num_blocks == 9
+    assert kv.block_size == 4
+    assert kv.tokens_capacity == 32  # null block excluded
+    assert kv.k.shape == (2, 9, 4, 2, 8)
+
+
+# ---- GSPMD sharding specs (host-only: specs, not devices) ----
+
+def test_paged_sharding_specs_build():
+    from jax.sharding import PartitionSpec as P
+
+    from grove_tpu.parallel.mesh import AXIS_TP
+    from grove_tpu.parallel.sharding import paged_kv_pspec
+
+    spec = paged_kv_pspec()
+    # [layers, num_blocks, block_size, n_kv, head_dim]: kv heads over
+    # tp, everything else replicated.
+    assert spec == P(None, None, None, AXIS_TP, None)
+
+
+def test_param_pspecs_handle_quantized_leaves():
+    """QTensor trees (serving/quant.py) shard like their parent weight:
+    q takes the weight's spec (same shape), scale replicates (size-1
+    contracted axes cannot shard)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from grove_tpu.serving.quant import quantize_tensor
+    from grove_tpu.parallel.sharding import param_pspec, param_pspecs
+
+    w = jnp.ones((2, 8, 4, 8), jnp.bfloat16)  # wq-shaped [L, d, h, hd]
+    tree = {"layers": {"wq": quantize_tensor(w, (1,))}}
+    specs = param_pspecs(tree)
+    assert specs["layers"]["wq"].q == param_pspec("wq")
+    assert specs["layers"]["wq"].scale == P()

@@ -48,6 +48,8 @@ def render(rows: list[dict]) -> str:
                if r.get("metric") == "serving_ttft_p99_ms"]
     serving_tok = [r for r in rows
                    if r.get("metric") == "serving_tokens_per_sec"]
+    decode_cmp = [r for r in rows if r.get("metric")
+                  == "decode_tokens_per_sec_paged_vs_lanes"]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
     reclaim = [r for r in rows
@@ -268,6 +270,29 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('overhead_min_ratio', 0):.3f} "
                 f"| {r.get('overhead_median_ratio', 0):.3f} "
                 f"| {'yes' if r.get('within_bound') else 'NO'} |")
+        out.append("")
+    if decode_cmp:
+        out += ["## Paged vs lanes decode engine (mixed-length "
+                "open-loop, CPU)", "",
+                "_same KV token budget, same seeded Poisson schedules "
+                "with bounded-Pareto prompt lengths; value = median "
+                "paged/lanes tokens-per-second ratio, steady-state "
+                "compiles must be 0 (docs/design/"
+                "continuous-batching.md)_", "",
+                "| when | git | ratio | paged tok/s | lanes tok/s | "
+                "KV budget | slots vs lanes | preempts | steady "
+                "compiles |", "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(decode_cmp, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('value', 0):.2f}x "
+                f"| {r.get('paged_tok_s', 0):.0f} "
+                f"| {r.get('lanes_tok_s', 0):.0f} "
+                f"| {r.get('kv_budget_tokens', '?')} tok "
+                f"| {r.get('paged_slots', '?')} vs "
+                f"{r.get('lanes_batch', '?')} "
+                f"| {r.get('preemptions', 0)} "
+                f"| {r.get('steady_compiles', 0)} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

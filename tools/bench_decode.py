@@ -1,0 +1,199 @@
+"""Paged vs lanes decode throughput under the mixed-length open-loop
+workload — the continuous-batching rebuild's proof
+(docs/design/continuous-batching.md; ``make bench-decode``).
+
+Both engines get the SAME KV token budget and the SAME seeded
+open-loop Poisson schedules (tools/loadgen.py: arrivals on the wall
+clock, bounded-Pareto prompt lengths — the traffic shape that punishes
+worst-case pre-allocation). The seed lanes engine spends the budget on
+``budget / max_len`` fixed lanes, each pre-allocated to the worst case
+and prefilled through a max-prompt-padded PrefillWorker; the paged
+engine spends it on blocks, so concurrency is bounded by tokens in
+flight, prefill costs only the chunks a prompt actually has, and
+decode attention reads the bucketed live width.
+
+Measurement discipline (the bench_serving precedent — this box's CPU
+share swings between runs): the engines alternate inside each rep and
+the headline is the MEDIAN paged/lanes ratio across reps. The paged
+engine is bucket-warmed before measuring and its CompileTracker must
+show ZERO compiles across the measured window.
+
+Appends one ``decode_tokens_per_sec_paged_vs_lanes`` row (value = the
+median ratio). Exits 1 unless the ratio clears
+``GROVE_BENCH_DECODE_MIN`` (default 2.0 — the PR's acceptance bar) and
+steady-state compiles stayed at zero.
+
+    python tools/bench_decode.py                 # append history rows
+    python tools/bench_decode.py --no-history    # dev run
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_sched import append_history  # noqa: E402
+from tools.loadgen import ArrivalSchedule, LoadProfile, run_load  # noqa: E402
+
+MIN_RATIO = float(os.environ.get("GROVE_BENCH_DECODE_MIN", 2.0))
+
+# One KV token budget, two spending policies. max_len is the per-seq
+# worst case both engines must honor (prompt tail up to 48 + 16 new);
+# the lanes engine turns the budget into 4 worst-case lanes, the paged
+# engine into 32 blocks (~10 typical sequences in flight).
+MAX_LEN = 64
+KV_BUDGET_TOKENS = 4 * MAX_LEN
+BLOCK_SIZE = 8
+PAGED_SLOTS = 10
+MAX_PROMPT = 48
+MAX_NEW = 16
+
+
+def build_engines():
+    import jax
+    import jax.numpy as jnp
+
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import (DecodeEngine, PagedDecodeEngine,
+                                          PrefillWorker)
+
+    cfg = dataclasses.replace(llama.CONFIGS["test-tiny"],
+                              dtype=jnp.float32, max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    lanes = DecodeEngine(cfg, params, batch=KV_BUDGET_TOKENS // MAX_LEN,
+                         max_len=MAX_LEN, host_sync_interval=4)
+    prefiller = PrefillWorker(cfg, params, batch=2, max_prompt=MAX_PROMPT)
+    paged = PagedDecodeEngine(cfg, params, batch=PAGED_SLOTS,
+                              max_len=MAX_LEN, block_size=BLOCK_SIZE,
+                              num_blocks=KV_BUDGET_TOKENS // BLOCK_SIZE + 1,
+                              prefill_chunk=8, host_sync_interval=4)
+    return lanes, prefiller, paged
+
+
+def bench(duration: float, rate: float, seed: int, reps: int) -> dict:
+    lanes, prefiller, paged = build_engines()
+    profile = LoadProfile(duration_s=duration, base_rate=rate,
+                          ramp_factor=1.0, min_prompt=4,
+                          max_prompt=MAX_PROMPT, max_new_tokens=MAX_NEW)
+
+    # Warmup: every paged bucket compiled up front (null-block
+    # dispatches), then a small real schedule through each engine so
+    # the lanes jits and the host paths are warm too.
+    paged.warmup()
+    warm_prof = dataclasses.replace(profile, duration_s=0.5, base_rate=40)
+    run_load(lanes, prefiller,
+             ArrivalSchedule.build(warm_prof, seed=seed + 100),
+             drain_s=30.0)
+    run_load(paged, prefiller,
+             ArrivalSchedule.build(warm_prof, seed=seed + 100),
+             drain_s=30.0)
+    compiles_before = (sum(paged.xprof.compile.counts().values())
+                       if paged.xprof else 0)
+
+    ratios, lanes_tps, paged_tps = [], [], []
+    offered = lanes_done = paged_done = 0
+    for rep in range(reps):
+        sched_l = ArrivalSchedule.build(profile, seed=seed + rep)
+        ls = run_load(lanes, prefiller, sched_l, drain_s=60.0)
+        sched_p = ArrivalSchedule.build(profile, seed=seed + rep)
+        ps = run_load(paged, prefiller, sched_p, drain_s=60.0)
+        ratios.append(ps.tokens_per_sec / ls.tokens_per_sec
+                      if ls.tokens_per_sec > 0 else 0.0)
+        lanes_tps.append(ls.tokens_per_sec)
+        paged_tps.append(ps.tokens_per_sec)
+        offered += ls.offered
+        lanes_done += ls.completed
+        paged_done += ps.completed
+
+    compiles_after = (sum(paged.xprof.compile.counts().values())
+                      if paged.xprof else 0)
+    recompiles = (paged.xprof.compile.recompile_count()
+                  if paged.xprof else 0)
+
+    import jax
+    return {
+        "metric": "decode_tokens_per_sec_paged_vs_lanes",
+        "value": round(statistics.median(ratios), 3),
+        "unit": "x",
+        "mode": "serving-cpu",
+        "backend_mode": jax.devices()[0].platform,
+        "ratios": [round(r, 3) for r in ratios],
+        "paged_tok_s": round(statistics.median(paged_tps), 1),
+        "lanes_tok_s": round(statistics.median(lanes_tps), 1),
+        "offered": offered,
+        "lanes_completed": lanes_done,
+        "paged_completed": paged_done,
+        "rate": rate,
+        "duration_s": duration,
+        "reps": reps,
+        "kv_budget_tokens": KV_BUDGET_TOKENS,
+        "lanes_batch": KV_BUDGET_TOKENS // MAX_LEN,
+        "paged_slots": PAGED_SLOTS,
+        "block_size": BLOCK_SIZE,
+        "max_prompt": MAX_PROMPT,
+        "max_new_tokens": MAX_NEW,
+        "preemptions": paged._sched.preemptions_total,
+        "oom_events": paged._alloc.oom_events,
+        "steady_compiles": compiles_after - compiles_before,
+        "recompiles": recompiles,
+        "min_ratio": MIN_RATIO,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="measured open-loop window per rep (seconds)")
+    ap.add_argument("--rate", type=float, default=900.0,
+                    help="offered req/s (saturating: the bench measures "
+                    "service rate, not arrival echo)")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="interleaved measurement reps (median wins; "
+                    "this box's CPU share swings between runs)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-history", action="store_true")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The CompileTracker is this bench's acceptance witness: force the
+    # observatory ON so an ambient GROVE_XPROF=0 can't make the
+    # zero-steady-state-compiles gate silently vacuous.
+    os.environ["GROVE_XPROF"] = "1"
+    if args.no_history:
+        os.environ["GROVE_BENCH_HISTORY"] = "0"
+
+    row = bench(args.duration, args.rate, args.seed, args.reps)
+    print(f"lanes:  {row['lanes_tok_s']:8.1f} tok/s median "
+          f"({row['lanes_completed']}/{row['offered']} completed, "
+          f"{row['lanes_batch']} worst-case lanes)")
+    print(f"paged:  {row['paged_tok_s']:8.1f} tok/s median "
+          f"({row['paged_completed']}/{row['offered']} completed, "
+          f"{row['paged_slots']} slots over "
+          f"{row['kv_budget_tokens'] // row['block_size']} blocks, "
+          f"{row['preemptions']} preemptions)")
+    print(f"ratio:  {row['value']:.2f}x median of {row['ratios']} on the "
+          f"same {row['kv_budget_tokens']}-token KV budget "
+          f"(backend={row['backend_mode']}, "
+          f"{row['steady_compiles']} steady-state compiles, "
+          f"{row['recompiles']} recompiles)")
+    append_history(row)
+    if row["steady_compiles"] or row["recompiles"]:
+        print("FAIL: the paged engine compiled during the measured "
+              "window — shapes leaked past the bucket ladder",
+              file=sys.stderr)
+        return 1
+    if row["value"] < MIN_RATIO:
+        print(f"FAIL: paged/lanes ratio {row['value']:.2f}x is under the "
+              f"{MIN_RATIO:.1f}x bar", file=sys.stderr)
+        return 1
+    print("bench-decode OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

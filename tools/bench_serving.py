@@ -154,8 +154,13 @@ def bench_ramp(duration: float, base_rate: float | None,
     from grove_tpu.serving.slo import EngineTelemetry, samples_for_push
     from grove_tpu.topology.fleet import FleetSpec, SliceSpec
 
+    # Lanes engine pinned explicitly: this bench's calibrated targets
+    # (service-rate fraction, TTFT floors) encode the lanes engine's
+    # admission behavior, and its subject is the SLO telemetry plane,
+    # not engine throughput — the paged-vs-lanes comparison lives in
+    # tools/bench_decode.py.
     tel = EngineTelemetry()
-    eng, pw = build_tiny_engine(batch=2, telemetry=tel)
+    eng, pw = build_tiny_engine(batch=2, telemetry=tel, engine="lanes")
 
     # Calibrate offered load to THIS machine: measure the engine's
     # service rate under full load, set the base rate at ~35% of it —

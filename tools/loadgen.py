@@ -183,23 +183,37 @@ def run_load(engine, prefiller, schedule: ArrivalSchedule, *,
     return stats
 
 
-def build_tiny_engine(batch: int = 2, telemetry=None):
+def build_tiny_engine(batch: int = 2, telemetry=None,
+                      engine: str = "lanes"):
     """The CPU test-config engine + prefiller pair every serving tool
-    drives (one place to keep the shape honest across smoke/bench)."""
+    drives (one place to keep the shape honest across smoke/bench).
+
+    ``engine``: "lanes" (the seed fixed-lane engine — the default here
+    because the SLO smokes/benches pin its calibrated behavior) or
+    "paged" (the PR 15 continuous-batching engine; the returned
+    prefiller is then only a call-site convenience — chunked prefill
+    runs in-engine and run_load's prefiller argument is ignored).
+    """
     import dataclasses as dc
 
     import jax
     import jax.numpy as jnp
 
     from grove_tpu.models import llama
-    from grove_tpu.serving.engine import DecodeEngine, PrefillWorker
+    from grove_tpu.serving.engine import (DecodeEngine, PagedDecodeEngine,
+                                          PrefillWorker)
 
     cfg = dc.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
                      max_seq_len=64)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     pw = PrefillWorker(cfg, params, batch=batch, max_prompt=32)
-    eng = DecodeEngine(cfg, params, batch=batch, host_sync_interval=4,
-                       telemetry=telemetry)
+    if engine == "paged":
+        eng = PagedDecodeEngine(cfg, params, batch=batch,
+                                block_size=8, prefill_chunk=8,
+                                host_sync_interval=4, telemetry=telemetry)
+    else:
+        eng = DecodeEngine(cfg, params, batch=batch, host_sync_interval=4,
+                           telemetry=telemetry)
     return eng, pw
 
 
@@ -211,13 +225,23 @@ def main(argv=None) -> int:
                         help="peak rate as a multiple of --base-rate")
     parser.add_argument("--batch", type=int, default=2)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--engine", choices=("lanes", "paged"),
+                        default="lanes",
+                        help="decode engine flavor (paged = the "
+                        "continuous-batching rebuild)")
     args = parser.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from grove_tpu.serving.slo import EngineTelemetry
 
     tel = EngineTelemetry()
-    eng, pw = build_tiny_engine(batch=args.batch, telemetry=tel)
+    eng, pw = build_tiny_engine(batch=args.batch, telemetry=tel,
+                                engine=args.engine)
+    if args.engine == "paged":
+        # Pay every bucket's XLA build before offering load, as a
+        # deployment would — otherwise a short run's TTFT digest is a
+        # compile-stall story, not a serving one.
+        eng.warmup()
     profile = LoadProfile(duration_s=args.duration,
                           base_rate=args.base_rate,
                           ramp_factor=args.ramp)
