@@ -634,6 +634,70 @@ class HostSyncInStepLoop(Rule):
         return None
 
 
+class WriteToSharedBlock(Rule):
+    """The prefix cache's write-safety contract (PR 16,
+    docs/design/prefix-cache.md): with refcounted block sharing, a KV
+    scatter into a block another sequence also references silently
+    corrupts THAT sequence's attention — the worst failure mode in the
+    serving stack because nothing raises; tokens just go subtly wrong
+    for an unrelated user. The engine's discipline is that every
+    function that fetches a scatter-bearing executable
+    (``self._get_prefill`` / ``self._get_step``) must first route
+    through a copy-on-write helper: ``_resolve_cow`` (copies a pending
+    shared source into the sequence's private block BEFORE its next
+    chunk lands) or ``_cow_guard`` (raises if any imminent decode write
+    targets a refcount>1 block — defense-in-depth; decode writes are
+    provably past the shared region). Fetch-before-guard is flagged at
+    the fetch site: ordering is the contract, not mere presence."""
+
+    name = "write-to-shared-block"
+    description = ("KV scatter dispatch (_get_prefill/_get_step) without "
+                   "a prior _resolve_cow/_cow_guard call in the same "
+                   "function — writes into refcount>1 blocks must "
+                   "copy-on-write first")
+
+    SCATTER_GETTERS = {"_get_prefill", "_get_step"}
+    COW_HELPERS = {"_resolve_cow", "_cow_guard"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel == "grove_tpu/serving/engine.py"
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in mod.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.extend(self._check_fn(mod, fn))
+        return out
+
+    def _check_fn(self, mod: ModuleFile, fn: ast.AST) -> list[Finding]:
+        getters: list[ast.Call] = []
+        first_cow: int | None = None
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = self.attr_chain(node.func)
+            if not chain:
+                continue
+            if chain[-1] in self.SCATTER_GETTERS:
+                getters.append(node)
+            elif chain[-1] in self.COW_HELPERS:
+                if first_cow is None or node.lineno < first_cow:
+                    first_cow = node.lineno
+        return [
+            self.finding(
+                mod, g,
+                f"{self.attr_chain(g.func)[-1]} fetched without a prior "
+                "copy-on-write gate — call self._resolve_cow(seq) or "
+                "self._cow_guard(...) earlier in this function so no "
+                "scatter can land in a refcount>1 shared block")
+            for g in getters
+            if first_cow is None or g.lineno < first_cow
+        ]
+
+
 ALL_RULES = [
     HubUnderStoreLock,
     LeaderClientWrite,
@@ -642,4 +706,5 @@ ALL_RULES = [
     ThreadJoinInStop,
     CloneBeforeMutate,
     HostSyncInStepLoop,
+    WriteToSharedBlock,
 ]

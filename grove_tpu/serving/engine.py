@@ -30,7 +30,8 @@ import numpy as np
 from grove_tpu.models import llama
 from grove_tpu.models.llama import LlamaConfig
 from grove_tpu.ops.kvcache import KVCache
-from grove_tpu.serving.kvcache import PagedKV, BlockAllocator, pad_tables
+from grove_tpu.serving.kvcache import (NULL_BLOCK, PagedKV, BlockAllocator,
+                                       PrefixTree, pad_tables)
 from grove_tpu.serving.schedule import PagedScheduler, pick_bucket
 
 
@@ -75,6 +76,9 @@ class Request:
     admit_ts: float = 0.0
     first_token_ts: float = 0.0
     done_ts: float = 0.0
+    # Prompt tokens served from the prefix cache at first admission
+    # (0 = cold). The bench surfaces segment warm/cold TTFT on this.
+    cached_tokens: int = 0
 
     def __post_init__(self):
         if self.prompt_len < 0:
@@ -757,7 +761,8 @@ class PagedDecodeEngine:
                  quant: str | None = None,
                  telemetry=None,
                  xprof=None,
-                 mesh=None):
+                 mesh=None,
+                 prefix_cache: bool | None = None):
         self.cfg = cfg
         self._sampler = sampler or SamplerConfig()
         if isinstance(key_or_params, jax.Array) \
@@ -801,9 +806,25 @@ class PagedDecodeEngine:
         if prefill_chunk is None:
             prefill_chunk = int(os.environ.get("GROVE_PAGED_CHUNK", 32))
         self.prefill_chunk = max(1, min(prefill_chunk, self.max_len))
+        # Global prefix cache (GROVE_PREFIX_CACHE=0 is the off switch:
+        # no tree, no refcount sharing, the PR 15 allocator behavior
+        # byte-for-byte). Token output is bitwise-identical either way
+        # — cached KV is exactly what a cold prefill would have written
+        # — so the switch trades memory/lookup work, never correctness.
+        if prefix_cache is None:
+            prefix_cache = os.environ.get("GROVE_PREFIX_CACHE", "1") != "0"
+        self._prefix = PrefixTree(self._alloc) if prefix_cache else None
+        # Bytes one block pins across both pools (K and V) — the
+        # reclaimed/cached byte gauges ride this.
+        self._block_bytes = 2 * int(np.prod(
+            (cfg.n_layers, block_size, cfg.n_kv_heads, cfg.head_dim))) \
+            * jnp.dtype(cfg.dtype).itemsize
+        self.cow_copies = 0
+        self._cow_jit = None
         self._sched = PagedScheduler(self._alloc, batch,
                                      self.max_blocks_per_seq,
-                                     self.prefill_chunk)
+                                     self.prefill_chunk,
+                                     prefix_tree=self._prefix)
 
         # ---- GSPMD: mesh + shardings (1-chip CPU degrades to no-ops) --
         from grove_tpu.parallel import sharding as shardlib
@@ -863,6 +884,14 @@ class PagedDecodeEngine:
             elif xprof_mod.enabled():
                 self.xprof = xprof_mod.Observatory(
                     cfg=cfg, batch=batch, max_len=self.max_len)
+
+        # With sharing on, pay the ONE copy-on-write executable at
+        # bring-up (a null→null block copy): it is workload-independent
+        # and shape-static, so building it here keeps the steady-state
+        # lowering set identical to the cache-off engine's — the
+        # decode_smoke pin counts it at construction, never mid-traffic.
+        if self._prefix is not None:
+            self._resolve_cow(None)
 
     # ---- jit construction (one executable per shape bucket) ----
 
@@ -940,6 +969,10 @@ class PagedDecodeEngine:
         DIFFERENT width ranges for the same run, and an unused
         executable is a real XLA build wasted."""
         built = 0
+        # Warmup scatters land in the null block only — nothing live
+        # exists to collide with, witnessed through the same tripwire
+        # every real dispatch routes through.
+        self._cow_guard(())
         for B in batches or self._sched.batch_buckets:
             for W in widths or self._sched.width_buckets:
                 if (B, W, self._sampling) not in self._step_jits:
@@ -1038,8 +1071,25 @@ class PagedDecodeEngine:
         if self.telemetry is not None:
             self.telemetry.sample_gauges(self.queue_depth,
                                          self.kv_lane_utilization)
+            if self._prefix is not None:
+                self.telemetry.sample_prefix(self.prefix_stats())
         if self.xprof is not None:
             self.xprof.observe_memory(self, self.telemetry)
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache gauges for the slo digest (hit-rate,
+        cached-blocks, reclaimed-bytes — the PR 16 telemetry riders).
+        Empty dict with the cache off."""
+        if self._prefix is None:
+            return {}
+        p = self._prefix.payload()
+        return {"hit_rate": p["hit_rate"],
+                "cached_blocks": p["cached_blocks"],
+                "cached_bytes": p["cached_blocks"] * self._block_bytes,
+                "reclaimed_bytes":
+                    p["reclaimed_total"] * self._block_bytes,
+                "tokens_matched_total": p["tokens_matched_total"],
+                "cow_copies": self.cow_copies}
 
     def _stamp_admit(self, req: Request, now: float,
                      admit: float | None = None) -> None:
@@ -1154,6 +1204,71 @@ class PagedDecodeEngine:
         if self._tokens is not None:
             np.asarray(self._tokens)
 
+    # ---- copy-on-write (the write-to-shared-block lint contract) ----
+
+    def _get_cow(self):
+        """The one CoW executable: copy a block's K/V across the pool.
+        Traced src/dst scalars → ONE shape-static program for every
+        copy, built at engine construction (never mid-traffic), tracked
+        as ``paged_cow_copy`` so the decode_smoke pin counts it."""
+        if self._cow_jit is None:
+            from grove_tpu.parallel import sharding as shardlib
+            kv_sh = shardlib.paged_kv_sharding(self.mesh)
+            rep = shardlib.replicated(self.mesh)
+
+            def cow(k, v, src, dst):
+                return (k.at[:, dst].set(k[:, src]),
+                        v.at[:, dst].set(v[:, src]))
+
+            jitted = jax.jit(cow, donate_argnums=(0, 1),
+                             in_shardings=(kv_sh, kv_sh, rep, rep),
+                             out_shardings=(kv_sh, kv_sh))
+            self._cow_jit = self._wrap("paged_cow_copy", jitted)
+        return self._cow_jit
+
+    def _resolve_cow(self, seq) -> None:
+        """Copy-on-write barrier — THE helper every prefill scatter
+        dispatch routes through first (write-to-shared-block lint
+        rule). A sequence that matched a prefix MID-BLOCK shares the
+        divergence block read-only; before its first chunk writes into
+        that table slot, the shared contents are device-copied into the
+        fresh block the scheduler granted (the table already points at
+        the copy) and the source reference drops. ``seq=None`` is the
+        construction-time prebuild: a null→null copy that pays the
+        executable before any traffic."""
+        if seq is None:
+            k, v = self._get_cow()(self.kv.k, self.kv.v,
+                                   np.int32(NULL_BLOCK),
+                                   np.int32(NULL_BLOCK))
+            self.kv = PagedKV(k=k, v=v)
+            return
+        if seq.cow_src < 0:
+            return
+        src, dst = seq.cow_src, seq.cow_dst
+        seq.cow_src = seq.cow_dst = -1
+        k, v = self._get_cow()(self.kv.k, self.kv.v,
+                               np.int32(src), np.int32(dst))
+        self.kv = PagedKV(k=k, v=v)
+        self._alloc.free([src])
+        self.cow_copies += 1
+
+    def _cow_guard(self, seqs) -> None:
+        """Exclusive-write tripwire ahead of the decode scatter (the
+        lint rule's decode half): the block each sequence's next token
+        lands in must be refcount-1. By construction decode always
+        writes a fresh suffix/CoW block — a trip here means the sharing
+        bookkeeping is corrupt, and raising now beats the silent KV
+        corruption a shared-block write would smear over every other
+        holder."""
+        bs = self.block_size
+        for seq in seqs:
+            b = seq.blocks.blocks[seq.pos // bs]
+            if self._alloc.refcount(b) > 1:
+                raise RuntimeError(
+                    f"decode write into shared block {b} (refcount "
+                    f"{self._alloc.refcount(b)}) — copy-on-write was "
+                    "bypassed")
+
     # ---- chunked prefill ----
 
     def _prefill_tick(self) -> None:
@@ -1181,6 +1296,10 @@ class PagedDecodeEngine:
                     self._requeue_prefill_victim(victim)
                     self._report_metric()
             return
+        # Shared-block write safety: a pending mid-block prefix hit is
+        # copied into its fresh block BEFORE this chunk's scatter can
+        # land there (the write-to-shared-block lint contract).
+        self._resolve_cow(seq)
         c = self.prefill_chunk
         pos, total = seq.pos, seq.prompt_len
         valid = min(c, total - pos)
@@ -1305,6 +1424,7 @@ class PagedDecodeEngine:
         if not sched.running:
             return
         B, W = self._cur_shape
+        self._cow_guard(self._run_order)
         fn = self._get_step(B, W)
         x = self.xprof
         sampled = x is not None and x.should_sample()
@@ -1441,6 +1561,8 @@ class PagedDecodeEngine:
                 "queue_depth": self.queue_depth,
                 "steps": self.steps, "ticks": self.ticks,
                 "completed": len(self.completed),
+                "prefix_cache": self._prefix is not None,
+                "cow_copies": self.cow_copies,
                 "schedule": self._sched.payload()}
 
 

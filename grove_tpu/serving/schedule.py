@@ -29,6 +29,21 @@ arrays and the jitted dispatch; this module owns the decisions:
   re-enters at the head of the admission queue (vLLM's recompute
   policy). The victim's stamps and token counts survive — recompute
   regenerates cache state, not history.
+- **Prefix-aware admission** (PR 16, ``prefix_tree`` attached).
+  Admission first matches the prompt against the block-granular prefix
+  tree: whole-block hits join the table SHARED (ref'd, never copied),
+  a mid-block divergence grants one fresh block as a copy-on-write
+  target (the engine device-copies before the first scatter), and the
+  allocator grant covers only the COLD SUFFIX. ``seq.pos`` starts at
+  the matched token count, so prefill chunks skip matched tokens
+  entirely — warm-prefix TTFT collapses to the suffix's chunks.
+  Eviction ordering on shortfall is cached-then-preempt: ``alloc``
+  reclaims unreferenced cached blocks (LRU) before it ever reports the
+  OOM that defers admission or preempts running work, so a warm cache
+  never steals capacity from live traffic. Every release path
+  (retire / preempt / prefill-evict) registers the sequence's full
+  blocks into the tree first — a preempted victim usually re-admits
+  straight out of the cache it just parked.
 """
 
 from __future__ import annotations
@@ -78,6 +93,9 @@ class PagedSeq:
     recompute: bool = False         # re-prefill after preemption
     last_token: int = -1            # host view of the newest token
     preemptions: int = 0
+    prefix_matched: int = 0         # tokens served from the prefix tree
+    cow_src: int = -1               # shared block awaiting copy-on-write
+    cow_dst: int = -1               # fresh block the copy lands in
 
     @property
     def prompt_len(self) -> int:
@@ -102,11 +120,13 @@ class PagedScheduler:
     """
 
     def __init__(self, allocator: BlockAllocator, max_slots: int,
-                 max_blocks_per_seq: int, chunk: int) -> None:
+                 max_blocks_per_seq: int, chunk: int,
+                 prefix_tree=None) -> None:
         self.allocator = allocator
         self.max_slots = max_slots
         self.chunk = chunk
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.prefix_tree = prefix_tree  # kvcache.PrefixTree | None
         self.batch_buckets = bucket_ladder(max_slots)
         self.width_buckets = bucket_ladder(max_blocks_per_seq)
         self.prefilling: deque[PagedSeq] = deque()
@@ -117,6 +137,7 @@ class PagedScheduler:
         self.admitted_total = 0
         self.deferred_total = 0
         self.preemptions_total = 0
+        self.prefix_tokens_skipped_total = 0
 
     # ---- occupancy ----
 
@@ -158,16 +179,59 @@ class PagedScheduler:
               recompute: bool = False) -> PagedSeq | None:
         """Admit one request if a slot is free, the prefill head is not
         starved, and the allocator grants the first chunk. None =
-        backpressure (nothing allocated)."""
+        backpressure (nothing allocated — a failed admission also
+        unrefs any prefix-tree hits it took, so matched blocks fall
+        back to the cached pool untouched).
+
+        With a prefix tree, matching runs FIRST: whole-block hits join
+        the table shared, a mid-block hit adds one fresh copy-on-write
+        target block, and the grant covers only the cold suffix.
+        ``seq.pos`` starts past every matched token, so prefill skips
+        them entirely."""
         if self.slots_free <= 0 or self._head_starved():
             self.deferred_total += 1
             return None
-        seq = PagedSeq(req=req, tokens=np.asarray(tokens, np.int32),
+        tokens = np.asarray(tokens, np.int32)
+        seq = PagedSeq(req=req, tokens=tokens,
                        blocks=SeqBlocks(self.allocator), order=self._order,
                        recompute=recompute)
+        if self.prefix_tree is not None:
+            shared, matched, partial = self.prefix_tree.match(tokens)
+            seq.blocks.blocks = shared
+            seq.pos = seq.prefix_matched = matched
+            if partial is not None:
+                src, _ = partial
+                got = self.allocator.alloc(1)
+                if got is None:
+                    self._release_seq(seq)
+                    self.allocator.free([src])
+                    self.deferred_total += 1
+                    return None
+                seq.blocks.blocks.append(got[0])
+                seq.cow_src, seq.cow_dst = src, got[0]
         if not seq.blocks.ensure(self._chunk_capacity(seq)):
+            self._release_seq(seq)
             self.deferred_total += 1
             return None
+        if self._head_starved():
+            # The grant just taken starved the prefill head's next
+            # chunk. The pre-grant gate above cannot see this: a warm
+            # admission's cold need can be tiny (prefix hits cover the
+            # rest), so it passes, grabs exactly the head's shortfall,
+            # gets evicted as newest, and re-admits forever — a
+            # livelock the cold path never hits (its own first-chunk
+            # grant fails first). Roll back: matched refs fall to the
+            # cached pool, exclusive blocks to the free list, and the
+            # head's ensure succeeds again this tick.
+            self._release_seq(seq)
+            self.deferred_total += 1
+            return None
+        self.prefix_tokens_skipped_total += seq.prefix_matched
+        if not recompute:
+            # TTFT segmentation for the bench surfaces (warm vs cold):
+            # first admission only — a later recompute hit is recovery,
+            # not a warm arrival.
+            req.cached_tokens = seq.prefix_matched
         self._order += 1
         self.admitted_total += 1
         self.prefilling.append(seq)
@@ -197,17 +261,61 @@ class PagedScheduler:
 
     def promote(self, seq: PagedSeq) -> None:
         """Prefill finished → join the decode batch (continuous: this
-        happens at ANY step, between any two decode dispatches)."""
+        happens at ANY step, between any two decode dispatches). The
+        prompt's full blocks register into the prefix tree NOW — they
+        are immutable from here (decode writes start past the prompt),
+        so a concurrent identical prompt shares them while this one is
+        still decoding."""
         assert self.prefilling and self.prefilling[0] is seq
         self.prefilling.popleft()
+        self._register_prefix(seq)
         self.running.append(seq)
+
+    # ---- release / registration (every block-freeing path) ----
+
+    def _release_seq(self, seq: PagedSeq) -> None:
+        """Drop every reference the sequence holds: its table, plus a
+        pending copy-on-write source if the engine never resolved it
+        (admission bail-out, prefill eviction). Shared blocks fall to
+        their other holders or the cached pool; exclusive unregistered
+        ones return to the free list."""
+        if seq.cow_src >= 0:
+            self.allocator.free([seq.cow_src])
+            seq.cow_src = seq.cow_dst = -1
+        seq.blocks.release()
+
+    def _register_prefix(self, seq: PagedSeq) -> None:
+        """Register the sequence's FULL blocks of known content into
+        the prefix tree — called at every release site BEFORE the
+        blocks are unref'd, so the last unref parks them cached instead
+        of freeing them. Content is prompt + drained generated tokens
+        (position ``p`` holds ``prompt[p]`` or
+        ``generated[p - prompt_len]`` — the recompute replay identity),
+        capped at ``seq.pos``: undrained window tokens just shorten
+        what this release can cache."""
+        if self.prefix_tree is None:
+            return
+        req = seq.req
+        gen = list(getattr(req, "generated", []))
+        content = np.asarray(req.prompt[:req.prompt_len], np.int32)
+        if gen:
+            content = np.concatenate([content,
+                                      np.asarray(gen, np.int32)])
+        n_known = min(seq.pos, len(content))
+        n_full = n_known // self.allocator.block_size
+        if n_full:
+            self.prefix_tree.insert(content[:n_known],
+                                    seq.blocks.blocks[:n_full])
 
     # ---- decode-set maintenance ----
 
     def retire(self, seq: PagedSeq) -> None:
-        """Remove a finished sequence and free its blocks."""
+        """Remove a finished sequence and free its blocks (registering
+        its prefix first, so an identical prompt arriving next admits
+        straight out of the cached pool)."""
         self.running.remove(seq)
-        seq.blocks.release()
+        self._register_prefix(seq)
+        self._release_seq(seq)
 
     def evict_newest_prefilling(self, protect: PagedSeq | None = None
                                 ) -> PagedSeq | None:
@@ -223,8 +331,10 @@ class PagedScheduler:
             return None
         victim = max(candidates, key=lambda s: s.order)
         self.prefilling.remove(victim)
-        victim.blocks.release()
+        self._register_prefix(victim)
+        self._release_seq(victim)
         victim.pos = 0
+        victim.prefix_matched = 0
         self.preemptions_total += 1
         return victim
 
@@ -238,7 +348,8 @@ class PagedScheduler:
             return None
         victim = max(candidates, key=lambda s: s.order)
         self.running.remove(victim)
-        victim.blocks.release()
+        self._register_prefix(victim)
+        self._release_seq(victim)
         # Recompute input: everything decoded so far rides the new
         # prompt, so prefill reconstructs the exact cache state (greedy
         # or seeded sampling — history is replayed, not re-drawn).
@@ -256,6 +367,7 @@ class PagedScheduler:
              np.asarray(gen, np.int32)]) if gen else \
             np.asarray(victim.req.prompt[:victim.req.prompt_len], np.int32)
         victim.pos = 0
+        victim.prefix_matched = 0
         victim.preemptions += 1
         self.preemptions_total += 1
         self.preempted.appendleft(victim)
@@ -298,4 +410,8 @@ class PagedScheduler:
                 "admitted_total": self.admitted_total,
                 "deferred_total": self.deferred_total,
                 "preemptions_total": self.preemptions_total,
+                "prefix_tokens_skipped_total":
+                    self.prefix_tokens_skipped_total,
+                "prefix": (self.prefix_tree.payload()
+                           if self.prefix_tree is not None else None),
                 "allocator": self.allocator.payload()}

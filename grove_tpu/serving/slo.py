@@ -95,6 +95,11 @@ class EngineTelemetry:
         # TTFT/TPOT so the autoscaler and /debug/serving see memory
         # pressure, not just latency.
         self.memory: dict | None = None
+        # Latest prefix-cache accounting (engine.prefix_stats shape:
+        # hit_rate/cached_blocks/cached_bytes/reclaimed_bytes/...;
+        # None until the engine samples once, or forever when
+        # GROVE_PREFIX_CACHE=0).
+        self.prefix: dict | None = None
 
     # ---- engine-side hooks ----
 
@@ -108,6 +113,13 @@ class EngineTelemetry:
         payload: kv_cache/weight/workspace/total bytes, kv_headroom,
         source) — point-sampled like the gauges."""
         self.memory = mem
+
+    def sample_prefix(self, stats: dict) -> None:
+        """Latest prefix-cache accounting (engine.prefix_stats payload:
+        hit_rate, cached_blocks, cached/reclaimed bytes, cow_copies) —
+        point-sampled like the gauges; rides the same digest so the
+        autoscaler sees reuse alongside latency."""
+        self.prefix = stats
 
     def add_tokens(self, n: int) -> None:
         """Decoded-token counter, bumped once per drained window (NOT
@@ -178,6 +190,7 @@ class EngineTelemetry:
             "queue_depth": self.queue_depth,
             "kv_utilization": self.kv_utilization,
             "memory": self.memory,
+            "prefix": self.prefix,
             "requests_completed": completed,
             "tokens_total": tokens,
             "ttft_p50_s": self.quantile("ttft_seconds", 0.5),
@@ -214,6 +227,18 @@ def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
              "value": float(mem.get("kv_cache_bytes", 0)), "agg": "sum"},
             {"metric": "hbm_total_bytes",
              "value": float(mem.get("total_bytes", 0)), "agg": "sum"},
+        ]
+    if s.get("prefix"):
+        pfx = s["prefix"]
+        # Prefix-cache reuse: hit-rate averages (a scope-level reuse
+        # ratio), block/byte totals sum across replicas.
+        samples += [
+            {"metric": "prefix_hit_rate",
+             "value": float(pfx.get("hit_rate", 0.0)), "agg": "avg"},
+            {"metric": "prefix_cached_blocks",
+             "value": float(pfx.get("cached_blocks", 0)), "agg": "sum"},
+            {"metric": "prefix_reclaimed_bytes",
+             "value": float(pfx.get("reclaimed_bytes", 0)), "agg": "sum"},
         ]
     return samples + [
         {"metric": "queue_depth", "value": float(s["queue_depth"]),

@@ -438,6 +438,61 @@ def test_host_sync_rule_scoped_to_engine_module():
     assert lint(STEP_SYNC_BAD, "tools/bench_decode.py") == []
 
 
+# ---- write-to-shared-block -----------------------------------------------
+
+COW_BAD = """
+    class Engine:
+        def _decode_tick(self):
+            fn = self._get_step(4, 2)
+            self.kv = fn(self.kv)
+
+        def _prefill_tick(self):
+            seq = self._sched.next_prefill()
+            fn = self._get_prefill(2)
+            self.kv = fn(self.kv)
+            self._resolve_cow(seq)   # AFTER the fetch: ordering violated
+"""
+
+COW_GOOD = """
+    class Engine:
+        def _decode_tick(self):
+            self._cow_guard(self._run_order)
+            fn = self._get_step(4, 2)
+            self.kv = fn(self.kv)
+
+        def _prefill_tick(self):
+            seq = self._sched.next_prefill()
+            self._resolve_cow(seq)
+            fn = self._get_prefill(2)
+            self.kv = fn(self.kv)
+
+        def warmup(self):
+            self._cow_guard(())
+            for b in (1, 2, 4):
+                fn = self._get_step(b, 2)
+                fn(self.kv)
+"""
+
+
+def test_write_to_shared_block_fires_on_unguarded_scatter():
+    findings = lint(COW_BAD, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"write-to-shared-block"}
+    # The bare _get_step fetch AND the fetch-before-_resolve_cow
+    # ordering violation: both shapes detected.
+    assert len(findings) == 2
+
+
+def test_write_to_shared_block_passes_guarded_dispatch():
+    assert lint(COW_GOOD, "grove_tpu/serving/engine.py") == []
+
+
+def test_write_to_shared_block_scoped_to_engine_module():
+    # Scatter helpers elsewhere (benches, model code) are not this
+    # rule's business — only the serving engine shares blocks.
+    assert lint(COW_BAD, "grove_tpu/serving/other.py") == []
+    assert lint(COW_BAD, "tools/decode_smoke.py") == []
+
+
 # ---- pragmas -------------------------------------------------------------
 
 def test_inline_pragma_suppresses_with_justification():

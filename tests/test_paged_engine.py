@@ -473,3 +473,149 @@ def test_telemetry_gauges_and_memory_surface(params):
     assert mem["kv_cache_bytes"] == eng.kv.k.nbytes + eng.kv.v.nbytes
     assert mem["source"] in ("device", "model-estimate")
     assert eng.kv_lane_utilization == 0.0  # drained pool
+
+
+# ---- global prefix cache: sharing semantics at the engine level ----
+#
+# The host-only tree/allocator mechanics live in
+# tests/test_paged_kvcache.py; here the invariants are end-to-end:
+# cache hits (including mid-block copy-on-write divergence and
+# preemption recompute under pressure) must be invisible in the token
+# stream, and the off switch must restore PR 15 behavior exactly.
+
+def test_prefix_cache_bitwise_parity_on_off(params):
+    """Warm full-block hits, a mid-block CoW divergence, and a cold
+    miss all produce the SAME tokens as a cache-off engine. The warm
+    tree persists after drain as reclaimable (not live) blocks."""
+    rng = np.random.default_rng(41)
+    base = rng.integers(0, 256, size=19).astype(np.int32)
+    later = [
+        base.copy(),                      # warm: 2 full blocks + CoW tail
+        np.concatenate([base[:12],        # diverges inside block 1 → CoW
+                        rng.integers(0, 256, size=7).astype(np.int32)]),
+        rng.integers(0, 256, size=7).astype(np.int32),   # cold miss
+        base.copy(),                      # warm again
+    ]
+
+    def run(prefix_cache):
+        eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                                block_size=8, num_blocks=24,
+                                prefill_chunk=8, host_sync_interval=4,
+                                prefix_cache=prefix_cache)
+        # Two-phase submission: the seed prompt retires (registering
+        # its blocks) before the warm wave arrives, so hits are
+        # deterministic rather than racing the first prefill.
+        eng.submit(base, max_new_tokens=6)
+        drive(eng, 1, max_iters=3000)
+        for p in later:
+            eng.submit(p, max_new_tokens=6)
+        drive(eng, 1 + len(later), max_iters=3000)
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    on, off = run(True), run(False)
+    by_rid = {r.rid: r.generated for r in off.completed}
+    assert len(by_rid) == 5
+    for r in on.completed:
+        assert r.generated == by_rid[r.rid], r.rid
+    assert on._sched.prefix_tokens_skipped_total > 0
+    # Both identical resubmissions and the mid-block divergence share
+    # a partial block copy-on-write.
+    assert on.cow_copies >= 2
+    assert on._alloc.cached_blocks > 0
+    assert off._alloc.cached_blocks == 0
+    assert off._sched.prefix_tokens_skipped_total == 0
+    assert off.cow_copies == 0
+
+
+def test_prefix_cache_parity_under_preemption_recompute(params):
+    """Tight pool + sharing: preemption recompute re-admits through
+    the tree (its own retired blocks can serve the replay) and cached
+    blocks are evicted under pressure before any OOM — tokens still
+    match a roomy cache-off run bitwise."""
+    rng = np.random.default_rng(42)
+    shared = rng.integers(0, 256, size=10).astype(np.int32)
+    prompts = [np.concatenate(
+        [shared, rng.integers(0, 256, size=int(n)).astype(np.int32)])
+        for n in rng.integers(2, 8, size=6)]
+
+    def run(num_blocks, prefix_cache):
+        eng = PagedDecodeEngine(CFG, params, batch=4, max_len=40,
+                                block_size=4, num_blocks=num_blocks,
+                                prefill_chunk=4, host_sync_interval=2,
+                                prefix_cache=prefix_cache)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=10)
+        drive(eng, len(prompts), max_iters=6000)
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    roomy_off = run(64, False)
+    tight_on = run(13, True)
+    assert tight_on._sched.preemptions_total > 0
+    assert tight_on._alloc.reclaimed_total > 0
+    by_rid = {r.rid: r.generated for r in roomy_off.completed}
+    for r in tight_on.completed:
+        assert r.generated == by_rid[r.rid], r.rid
+
+
+def test_prefix_cache_warm_admission_skips_matched_tokens(params):
+    """A resubmitted prompt is stamped cached_tokens and skips its
+    matched prefill work: 27 tokens match 3 full blocks + a 2-token
+    partial (capped at len-1 so the final token still prefills for
+    first-token logits)."""
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4, prefix_cache=True)
+    rng = np.random.default_rng(43)
+    p = rng.integers(0, 256, size=27).astype(np.int32)
+    eng.submit(p, max_new_tokens=6)
+    drive(eng, 1, max_iters=3000)
+    assert eng._sched.prefix_tokens_skipped_total == 0
+    assert eng._alloc.cached_blocks > 0      # retired prompt registered
+
+    warm_rid = eng.submit(p.copy(), max_new_tokens=6)
+    drive(eng, 2, max_iters=3000)
+    warm = next(r for r in eng.completed if r.rid == warm_rid)
+    cold = next(r for r in eng.completed if r.rid != warm_rid)
+    assert warm.cached_tokens == 26          # 3 full blocks + 2 partial
+    assert cold.cached_tokens == 0
+    assert eng._sched.prefix_tokens_skipped_total == 26
+    assert warm.generated == cold.generated  # hit is token-invisible
+    stats = eng.prefix_stats()
+    assert stats["hit_rate"] > 0
+    assert stats["cached_blocks"] > 0
+    eng._alloc.check()
+    assert eng._alloc.used_blocks == 0
+
+
+def test_prefix_cache_env_off_switch(params, monkeypatch):
+    """GROVE_PREFIX_CACHE=0 with no constructor override: no tree, no
+    stamps, no cached residue — the PR 15 allocator behavior."""
+    monkeypatch.setenv("GROVE_PREFIX_CACHE", "0")
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4)
+    assert eng._prefix is None
+    assert eng.payload()["prefix_cache"] is False
+    rng = np.random.default_rng(44)
+    p = rng.integers(0, 256, size=17).astype(np.int32)
+    eng.submit(p, max_new_tokens=4)
+    drive(eng, 1, max_iters=3000)
+    eng.submit(p.copy(), max_new_tokens=4)
+    drive(eng, 2, max_iters=3000)
+    assert eng._alloc.cached_blocks == 0
+    assert eng._sched.prefix_tokens_skipped_total == 0
+    assert all(r.cached_tokens == 0 for r in eng.completed)
+    assert eng.cow_copies == 0
+    eng._alloc.check()
+    assert eng._alloc.used_blocks == 0
+
+    monkeypatch.setenv("GROVE_PREFIX_CACHE", "1")
+    eng_on = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                               block_size=8, prefill_chunk=8,
+                               host_sync_interval=4)
+    assert eng_on._prefix is not None
+    assert eng_on.payload()["prefix_cache"] is True

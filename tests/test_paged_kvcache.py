@@ -9,11 +9,14 @@ checked aggressively (the soak sweeps ``check()`` after every op),
 violations raise instead of degrading.
 """
 
+import collections
+
 import numpy as np
 import pytest
 
 from grove_tpu.serving.kvcache import (NULL_BLOCK, BlockAllocator,
-                                       PagedKV, SeqBlocks, pad_tables)
+                                       PagedKV, PrefixTree, SeqBlocks,
+                                       pad_tables)
 from grove_tpu.serving.schedule import bucket_ladder, pick_bucket
 
 
@@ -141,6 +144,193 @@ def test_randomized_alloc_free_soak():
     a.check()
     assert a.used_blocks == 0
     assert a.allocs_total == a.frees_total
+
+
+# ---- refcounted sharing + prefix tree (PR 16) ----
+
+def test_refcount_share_resurrect_and_double_unref_raises():
+    a = BlockAllocator(num_blocks=6, block_size=4)
+    tree = PrefixTree(a)
+    g = a.alloc(2)
+    a.ref(g[0])
+    assert a.refcount(g[0]) == 2 and a.refcount(g[1]) == 1
+    a.free([g[0]])
+    assert a.refcount(g[0]) == 1     # still live: one holder left
+    tree.insert(tuple(range(8)), g)
+    a.free(g)                        # last unref: registered → CACHED
+    assert a.used_blocks == 0 and a.cached_blocks == 2
+    with pytest.raises(ValueError):
+        a.free([g[0]])               # double unref of a cached block
+    a.ref(g[0])                      # tree hit resurrects cached → live
+    assert a.refcount(g[0]) == 1 and a.cached_blocks == 1
+    a.free([g[0]])
+    with pytest.raises(ValueError):
+        a.ref(4)                     # never-granted block
+    a.check()
+
+
+def test_cached_blocks_are_headroom_not_pressure():
+    """Eviction-before-backpressure: a full cached pool serves grants
+    (LRU leaf-first eviction inside alloc), and OOM fires only when
+    free + cached together cannot cover — used_blocks never counts
+    cached blocks."""
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    tree = PrefixTree(a)
+    g = a.alloc(8)
+    tree.insert(tuple(range(32)), g)
+    a.free(g)
+    assert a.free_blocks == 0 and a.cached_blocks == 8
+    assert a.used_blocks == 0 and a.utilization == 0.0
+    assert a.can_alloc(8) and not a.can_alloc(9)
+    got = a.alloc(5)                 # must evict 5 LRU leaves to serve
+    assert got is not None
+    assert a.cached_blocks == 3 and a.reclaimed_total == 5
+    assert a.oom_events == 0
+    # Deepest-first eviction: the surviving chain is the SHALLOW prefix,
+    # so a re-match still hits the first 3 blocks (12 tokens).
+    full, n, _ = tree.match(tuple(range(32)))
+    assert full == g[:3] and n >= 12
+    a.free(full)
+    assert a.alloc(4) is None        # 4 > 0 free + 3 cached: honest OOM
+    assert a.oom_events == 1
+    a.free(got)
+    a.check()
+
+
+def test_prefix_tree_match_caps_at_len_minus_one_and_partial_cow():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    tree = PrefixTree(a)
+    toks = tuple(range(8))
+    g = a.alloc(2)
+    tree.insert(toks, g)
+    # Identical prompt: the FINAL token must prefill (first-token
+    # logits), so at most 7 match — one full block + a 3-token partial
+    # copy-on-write share of the second.
+    full, n, part = tree.match(toks)
+    assert full == [g[0]] and n == 7 and part == (g[1], 3)
+    assert a.refcount(g[0]) == 2 and a.refcount(g[1]) == 2
+    a.free(full + [part[0]])
+    # Mid-block divergence: 2 shared tokens into block 1, then split.
+    full2, n2, part2 = tree.match(np.array((0, 1, 2, 3, 4, 5, 9, 9, 9)))
+    assert full2 == [g[0]] and n2 == 6 and part2 == (g[1], 2)
+    a.free(full2 + [part2[0]])
+    # Sub-block prompt: limit len-1 keeps even its only block partial.
+    full3, n3, part3 = tree.match((0, 1, 2, 3))
+    assert full3 == [] and n3 == 3 and part3 == (g[0], 3)
+    a.free([part3[0]])
+    assert tree.cow_shares == 3
+    a.free(g)
+    a.check()
+
+
+def test_insert_first_writer_wins_and_grafts_through():
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    tree = PrefixTree(a)
+    toks = tuple(range(12))
+    g1 = a.alloc(2)
+    assert tree.insert(toks[:8], g1) == 2
+    # A second sequence re-registering the same prefix keeps the
+    # EXISTING blocks but still grafts its deeper suffix under them.
+    g2 = a.alloc(3)
+    assert tree.insert(toks, g2) == 1          # only block 3 is new
+    full, n, _ = tree.match(toks + (99,))
+    assert full == [g1[0], g1[1], g2[2]] and n == 12
+    a.free(full)
+    a.free(g1)
+    a.free(g2)
+    # g2[0]/g2[1] lost the registration race: straight to free list.
+    assert a.cached_blocks == 3
+    a.check()
+
+
+def test_randomized_shared_block_soak():
+    """The 3000-op soak, extended to SHARED blocks: randomized warm
+    admission (match → cold-suffix grant → CoW resolve → register),
+    release, transient pressure (forces LRU eviction), and ref/unref
+    churn, with ``check()`` swept after EVERY op plus a shadow refcount
+    model — each block's refcount must equal (hence ≥, the invariant
+    the tree relies on) the number of live sequences holding it."""
+    rng = np.random.default_rng(16)
+    a = BlockAllocator(num_blocks=33, block_size=8)
+    tree = PrefixTree(a)
+    bs = 8
+    pool = [tuple(int(x) for x in rng.integers(0, 50,
+                                               size=int(rng.integers(17, 80))))
+            for _ in range(6)]
+    live: list[list[int]] = []       # per-sequence held block lists
+    for _ in range(3000):
+        op = int(rng.integers(0, 4))
+        if op == 0 or not live:
+            # Warm admit: shared-prefix prompt + divergent cold tail.
+            base = pool[int(rng.integers(0, len(pool)))]
+            tail = tuple(int(x) for x in
+                         rng.integers(50, 99, size=int(rng.integers(1, 17))))
+            tokens = base[:int(rng.integers(1, len(base) + 1))] + tail
+            full, matched, partial = tree.match(tokens)
+            # Cold grant covers every non-shared block, INCLUDING the
+            # CoW destination that replaces the partial source.
+            n_blocks = -(-len(tokens) // bs)
+            need = n_blocks - len(full)
+            got = a.alloc(need)
+            if got is None:
+                assert not a.can_alloc(need)     # honest OOM
+                bail = full + ([partial[0]] if partial else [])
+                if bail:
+                    a.free(bail)
+            else:
+                blocks = list(full)
+                if partial:
+                    blocks.append(got[0])        # CoW dst replaces src
+                    a.free([partial[0]])         # resolve: drop src hold
+                    blocks.extend(got[1:])
+                else:
+                    blocks.extend(got)
+                assert len(blocks) == n_blocks
+                tree.insert(tokens, blocks[:len(tokens) // bs])
+                live.append(blocks)
+        elif op == 1:
+            # Release: registered blocks park cached, rest free; then
+            # prove the double-unref contract on a now-unheld block.
+            blocks = live.pop(int(rng.integers(0, len(live))))
+            a.free(blocks)
+            dead = [b for b in blocks if a.refcount(b) == 0]
+            if dead:
+                with pytest.raises(ValueError):
+                    a.free([dead[0]])
+        elif op == 2:
+            # Transient pressure: bulk grant (evicts LRU cached blocks
+            # as needed), immediately returned — unregistered blocks
+            # land back on the free list, never in the cached pool.
+            want = int(rng.integers(1, a.capacity + 1))
+            got = a.alloc(want)
+            if got is None:
+                assert want > a.free_blocks + a.cached_blocks
+            else:
+                cached_before = a.cached_blocks
+                a.free(got)
+                assert a.cached_blocks == cached_before
+        else:
+            # Share/unshare churn on a random held block.
+            blocks = live[int(rng.integers(0, len(live)))]
+            b = blocks[int(rng.integers(0, len(blocks)))]
+            a.ref(b)
+            a.free([b])
+        a.check()
+        shadow = collections.Counter()
+        for blocks in live:
+            shadow.update(blocks)
+        assert a.used_blocks == len(shadow)
+        for b, n in shadow.items():
+            assert a.refcount(b) == n, (b, a.refcount(b), n)
+    for blocks in live:
+        a.free(blocks)
+    a.check()
+    assert a.used_blocks == 0
+    # Every grant and every share is matched by exactly one unref once
+    # all sequences are gone (cached parks already counted theirs).
+    assert a.allocs_total + a.refs_total == a.frees_total
+    assert tree.hits > 0 and tree.cow_shares > 0
+    assert a.reclaimed_total > 0
 
 
 # ---- table padding + bucket ladders ----

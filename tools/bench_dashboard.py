@@ -50,6 +50,9 @@ def render(rows: list[dict]) -> str:
                    if r.get("metric") == "serving_tokens_per_sec"]
     decode_cmp = [r for r in rows if r.get("metric")
                   == "decode_tokens_per_sec_paged_vs_lanes"]
+    prefix_rows = [r for r in rows
+                   if r.get("metric") in ("prefix_cache_warm_ttft_vs_cold",
+                                          "decode_tokens_per_sec_prefix_vs_off")]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
     reclaim = [r for r in rows
@@ -293,6 +296,34 @@ def render(rows: list[dict]) -> str:
                 f"{r.get('lanes_batch', '?')} "
                 f"| {r.get('preemptions', 0)} "
                 f"| {r.get('steady_compiles', 0)} |")
+        out.append("")
+    if prefix_rows:
+        out += ["## Prefix cache (radix tree over paged KV blocks)", "",
+                "_warm_ttft_vs_cold: median warm-prefix TTFT over cold "
+                "on the 90/10 shared-prefix workload (lower is better, "
+                "bar ≤ 0.25x); prefix_vs_off: cache-on over cache-off "
+                "tokens/sec on the ALL-COLD workload (bar ≥ the "
+                "no-regression floor) — docs/design/prefix-cache.md_",
+                "",
+                "| when | git | row | ratio | warm ms / on tok/s | "
+                "cold ms / off tok/s | hit rate | CoW | steady "
+                "compiles |", "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(prefix_rows, key=lambda r: (r.get("ts", ""),
+                                                    r.get("metric", ""))):
+            is_ttft = r.get("metric") == "prefix_cache_warm_ttft_vs_cold"
+            a = (f"{r.get('warm_ttft_p50_ms', 0):.1f}" if is_ttft
+                 else f"{r.get('on_tok_s', 0):.0f}")
+            b = (f"{r.get('cold_ttft_p50_ms', 0):.1f}" if is_ttft
+                 else f"{r.get('off_tok_s', 0):.0f}")
+            hr = r.get("hit_rate")
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {'warm TTFT' if is_ttft else 'all-cold tok/s'} "
+                f"| {r.get('value', 0):.2f}x "
+                f"| {a} | {b} "
+                f"| {f'{hr:.2f}' if hr is not None else '-'} "
+                f"| {r.get('cow_copies', '-')} "
+                f"| {r.get('steady_compiles', '-')} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

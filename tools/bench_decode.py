@@ -23,6 +23,20 @@ median ratio). Exits 1 unless the ratio clears
 ``GROVE_BENCH_DECODE_MIN`` (default 2.0 — the PR's acceptance bar) and
 steady-state compiles stayed at zero.
 
+Two prefix-cache rows ride along (PR 16; docs/design/prefix-cache.md,
+the dashboard's "Prefix cache" section):
+
+- ``prefix_cache_warm_ttft_vs_cold`` — median warm-prefix TTFT over
+  median cold TTFT on the 90/10 shared-prefix workload
+  (tools/loadgen.py --shared-prefix shape), the pool pre-warmed on a
+  separate arrival schedule so measured warm hits are steady-state.
+  Gate: ≤ ``GROVE_BENCH_PREFIX_TTFT_MAX`` (default 0.25).
+- ``decode_tokens_per_sec_prefix_vs_off`` — cache-on over cache-off
+  paged throughput on the ALL-COLD workload (nothing shares; the
+  cache must not cost). Gate: ≥ ``GROVE_BENCH_PREFIX_MIN``
+  (default 0.9 — the honest no-regression bar under this box's CPU
+  noise; the expected value is ~1.0).
+
     python tools/bench_decode.py                 # append history rows
     python tools/bench_decode.py --no-history    # dev run
 """
@@ -41,6 +55,8 @@ from tools.bench_sched import append_history  # noqa: E402
 from tools.loadgen import ArrivalSchedule, LoadProfile, run_load  # noqa: E402
 
 MIN_RATIO = float(os.environ.get("GROVE_BENCH_DECODE_MIN", 2.0))
+PREFIX_TTFT_MAX = float(os.environ.get("GROVE_BENCH_PREFIX_TTFT_MAX", 0.25))
+PREFIX_MIN = float(os.environ.get("GROVE_BENCH_PREFIX_MIN", 0.9))
 
 # One KV token budget, two spending policies. max_len is the per-seq
 # worst case both engines must honor (prompt tail up to 48 + 16 new);
@@ -74,6 +90,27 @@ def build_engines():
                               num_blocks=KV_BUDGET_TOKENS // BLOCK_SIZE + 1,
                               prefill_chunk=8, host_sync_interval=4)
     return lanes, prefiller, paged
+
+
+def build_paged(prefix_cache: bool, num_blocks: int | None = None,
+                prefill_chunk: int = 8):
+    """One paged engine with the cache explicitly on or off (the
+    prefix rows compare paged-vs-paged, not paged-vs-lanes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import PagedDecodeEngine
+
+    cfg = dataclasses.replace(llama.CONFIGS["test-tiny"],
+                              dtype=jnp.float32, max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return PagedDecodeEngine(
+        cfg, params, batch=PAGED_SLOTS, max_len=MAX_LEN,
+        block_size=BLOCK_SIZE,
+        num_blocks=num_blocks or KV_BUDGET_TOKENS // BLOCK_SIZE + 1,
+        prefill_chunk=prefill_chunk, host_sync_interval=4,
+        prefix_cache=prefix_cache)
 
 
 def bench(duration: float, rate: float, seed: int, reps: int) -> dict:
@@ -146,6 +183,133 @@ def bench(duration: float, rate: float, seed: int, reps: int) -> dict:
     }
 
 
+def bench_prefix_ttft(duration: float, seed: int, reps: int) -> dict:
+    """Warm-prefix vs cold TTFT on the 90/10 shared-prefix workload.
+
+    The system-prompt pool is pinned (shared_prefix_pool_seed) and
+    pre-warmed on a DIFFERENT arrival schedule, so in the measured
+    passes the 90% shared requests hit a steady-state cache while the
+    10% unique-prefix requests pay full prefill — equal prompt lengths,
+    same pass, same CPU conditions. Segmentation is by the engine's own
+    ``cached_tokens`` stamp. This row isolates REUSE (the budget story
+    is the paged_vs_lanes row), so the engine gets its own geometry: a
+    128-token max_len, a 96-token shared prefix, and a 4-token prefill
+    chunk — cold TTFT is then ~25 chunk dispatches of real prefill
+    against ~1-2 warm, well clear of the per-step dispatch floor the
+    tiny CPU model otherwise hides the reuse under."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from grove_tpu.models import llama
+    from grove_tpu.serving.engine import PagedDecodeEngine
+
+    prefix_len = 96
+    cfg = dc.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                     max_seq_len=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedDecodeEngine(cfg, params, batch=PAGED_SLOTS, max_len=128,
+                            block_size=BLOCK_SIZE, num_blocks=257,
+                            prefill_chunk=4, host_sync_interval=4,
+                            prefix_cache=True)
+    profile = LoadProfile(duration_s=duration, base_rate=25.0,
+                          ramp_factor=1.0, min_prompt=4, max_prompt=8,
+                          max_new_tokens=4, shared_prefix=True,
+                          shared_prefix_len=prefix_len,
+                          shared_prefix_pool_seed=seed + 7777)
+    eng.warmup()
+    # Pool warm-up: different arrivals, same pool (and compile/host
+    # paths warm before anything is measured).
+    warm_prof = dataclasses.replace(profile, duration_s=1.0)
+    run_load(eng, None, ArrivalSchedule.build(warm_prof, seed=seed + 1000),
+             drain_s=30.0)
+    warm_ttft, cold_ttft = [], []
+    for rep in range(reps):
+        n0 = len(eng.completed)
+        sched = ArrivalSchedule.build(profile, seed=seed + rep)
+        run_load(eng, None, sched, drain_s=60.0)
+        for r in eng.completed[n0:]:
+            ttft = r.first_token_ts - r.enqueue_ts
+            (warm_ttft if r.cached_tokens > 0 else cold_ttft).append(ttft)
+    warm_ms = statistics.median(warm_ttft) * 1e3 if warm_ttft else 0.0
+    cold_ms = statistics.median(cold_ttft) * 1e3 if cold_ttft else 0.0
+    stats = eng.prefix_stats()
+    import jax
+    return {
+        "metric": "prefix_cache_warm_ttft_vs_cold",
+        "value": round(warm_ms / cold_ms, 3) if cold_ms else 0.0,
+        "unit": "x",
+        "mode": "serving-cpu",
+        "backend_mode": jax.devices()[0].platform,
+        "warm_ttft_p50_ms": round(warm_ms, 2),
+        "cold_ttft_p50_ms": round(cold_ms, 2),
+        "warm_n": len(warm_ttft),
+        "cold_n": len(cold_ttft),
+        "shared_prefix_len": prefix_len,
+        "shared_frac": profile.shared_frac,
+        "hit_rate": stats["hit_rate"],
+        "tokens_matched_total": stats["tokens_matched_total"],
+        "cow_copies": stats["cow_copies"],
+        "reps": reps,
+        "duration_s": duration,
+        "max_ratio": PREFIX_TTFT_MAX,
+    }
+
+
+def bench_prefix_off(duration: float, rate: float, seed: int,
+                     reps: int) -> dict:
+    """Cache-on vs cache-off paged throughput on the ALL-COLD workload
+    (no prompt shares a prefix): the host-side matching/registration
+    overhead must not tax the no-sharing case. Engines alternate inside
+    each rep, median ratio wins — the same discipline as the headline
+    row."""
+    on = build_paged(True)
+    off = build_paged(False)
+    profile = LoadProfile(duration_s=duration, base_rate=rate,
+                          ramp_factor=1.0, min_prompt=4,
+                          max_prompt=MAX_PROMPT, max_new_tokens=MAX_NEW)
+    on.warmup()
+    off.warmup()
+    warm_prof = dataclasses.replace(profile, duration_s=0.5, base_rate=40)
+    for eng in (off, on):
+        run_load(eng, None, ArrivalSchedule.build(warm_prof, seed=seed + 100),
+                 drain_s=30.0)
+    compiles_before = sum(on.xprof.compile.counts().values()) \
+        if on.xprof else 0
+    ratios, on_tps, off_tps = [], [], []
+    for rep in range(reps):
+        os_ = run_load(off, None,
+                       ArrivalSchedule.build(profile, seed=seed + rep),
+                       drain_s=60.0)
+        ns = run_load(on, None,
+                      ArrivalSchedule.build(profile, seed=seed + rep),
+                      drain_s=60.0)
+        ratios.append(ns.tokens_per_sec / os_.tokens_per_sec
+                      if os_.tokens_per_sec > 0 else 0.0)
+        on_tps.append(ns.tokens_per_sec)
+        off_tps.append(os_.tokens_per_sec)
+    compiles_after = sum(on.xprof.compile.counts().values()) \
+        if on.xprof else 0
+    import jax
+    return {
+        "metric": "decode_tokens_per_sec_prefix_vs_off",
+        "value": round(statistics.median(ratios), 3),
+        "unit": "x",
+        "mode": "serving-cpu",
+        "backend_mode": jax.devices()[0].platform,
+        "ratios": [round(r, 3) for r in ratios],
+        "on_tok_s": round(statistics.median(on_tps), 1),
+        "off_tok_s": round(statistics.median(off_tps), 1),
+        "rate": rate,
+        "duration_s": duration,
+        "reps": reps,
+        "steady_compiles": compiles_after - compiles_before,
+        "recompiles": on.xprof.compile.recompile_count() if on.xprof else 0,
+        "min_ratio": PREFIX_MIN,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--duration", type=float, default=3.0,
@@ -182,7 +346,25 @@ def main(argv=None) -> int:
           f"{row['steady_compiles']} steady-state compiles, "
           f"{row['recompiles']} recompiles)")
     append_history(row)
-    if row["steady_compiles"] or row["recompiles"]:
+
+    ttft_row = bench_prefix_ttft(args.duration, args.seed,
+                                 max(1, args.reps - 2))
+    print(f"prefix: warm TTFT {ttft_row['warm_ttft_p50_ms']:.1f} ms vs "
+          f"cold {ttft_row['cold_ttft_p50_ms']:.1f} ms = "
+          f"{ttft_row['value']:.2f}x "
+          f"({ttft_row['warm_n']} warm / {ttft_row['cold_n']} cold, "
+          f"hit-rate {ttft_row['hit_rate']:.2f}, "
+          f"{ttft_row['cow_copies']} CoW copies)")
+    append_history(ttft_row)
+    off_row = bench_prefix_off(args.duration, args.rate, args.seed,
+                               max(1, args.reps - 2))
+    print(f"prefix: cache-on {off_row['on_tok_s']:.1f} tok/s vs "
+          f"cache-off {off_row['off_tok_s']:.1f} tok/s all-cold = "
+          f"{off_row['value']:.2f}x of {off_row['ratios']}")
+    append_history(off_row)
+
+    if row["steady_compiles"] or row["recompiles"] \
+            or off_row["steady_compiles"] or off_row["recompiles"]:
         print("FAIL: the paged engine compiled during the measured "
               "window — shapes leaked past the bucket ladder",
               file=sys.stderr)
@@ -190,6 +372,14 @@ def main(argv=None) -> int:
     if row["value"] < MIN_RATIO:
         print(f"FAIL: paged/lanes ratio {row['value']:.2f}x is under the "
               f"{MIN_RATIO:.1f}x bar", file=sys.stderr)
+        return 1
+    if not ttft_row["value"] or ttft_row["value"] > PREFIX_TTFT_MAX:
+        print(f"FAIL: warm-prefix TTFT {ttft_row['value']:.2f}x cold is "
+              f"over the {PREFIX_TTFT_MAX:.2f}x bar", file=sys.stderr)
+        return 1
+    if off_row["value"] < PREFIX_MIN:
+        print(f"FAIL: cache-on/off all-cold ratio {off_row['value']:.2f}x "
+              f"is under the {PREFIX_MIN:.2f}x bar", file=sys.stderr)
         return 1
     print("bench-decode OK")
     return 0

@@ -20,6 +20,16 @@ the rebuild makes:
    and continuous admission actually interleaved (requests joined
    while others were mid-decode).
 
+The smoke runs the paged engine TWICE — GROVE_PREFIX_CACHE off and on
+(docs/design/prefix-cache.md). Prefix matching is host-side, so the
+cache may not change the executable set: cache-off pins exactly
+EXPECTED_LOWERINGS; cache-on pins exactly EXPECTED_LOWERINGS plus the
+single ``paged_cow_copy`` executable, which is built eagerly at engine
+CONSTRUCTION (asserted before any traffic) — never mid-request. Both
+modes must show zero steady-state growth, and the cache-on engine's
+tokens must match cache-off and lanes bitwise even on the second pass,
+where every re-submitted prompt admits through warm tree hits.
+
     python tools/decode_smoke.py
 """
 
@@ -54,6 +64,11 @@ EXPECTED_LOWERINGS = {
     "paged_step[b4,w4]": 1,
 }
 
+# With the prefix cache on, the ONE addition is the copy-on-write
+# block copy, compiled once at engine construction (before traffic).
+# Prefix matching itself is host-side: no other executable may appear.
+EXPECTED_WITH_PREFIX = dict(EXPECTED_LOWERINGS, **{"paged_cow_copy": 1})
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="decode-smoke")
@@ -75,9 +90,6 @@ def main(argv=None) -> int:
     prompts = [rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
                for n in PROMPT_LENS]
 
-    eng = PagedDecodeEngine(cfg, params, batch=4, max_len=48, block_size=8,
-                            prefill_chunk=8, host_sync_interval=4)
-
     def drive(engine, want: int) -> None:
         for _ in range(600):
             engine.admit_from_queue()
@@ -89,36 +101,69 @@ def main(argv=None) -> int:
         assert len(engine.completed) >= want, \
             (len(engine.completed), want)
 
-    # ---- warm pass: mixed lengths through admission/prefill/decode ----
-    for p in prompts:
-        eng.submit(p, max_new_tokens=MAX_NEW)
-    drive(eng, len(prompts))
-    counts = eng.xprof.compile.counts()
-    assert counts == EXPECTED_LOWERINGS, (
-        "lowering set drifted:\n"
-        f"  got      {counts}\n  expected {EXPECTED_LOWERINGS}")
-    assert eng.xprof.compile.recompile_count() == 0, \
-        eng.xprof.compile.payload()
+    def exercise(eng, expected: dict) -> None:
+        """Warm + steady pass against one pinned executable set."""
+        # ---- warm pass: mixed lengths through admit/prefill/decode --
+        for p in prompts:
+            eng.submit(p, max_new_tokens=MAX_NEW)
+        drive(eng, len(prompts))
+        counts = eng.xprof.compile.counts()
+        assert counts == expected, (
+            "lowering set drifted:\n"
+            f"  got      {counts}\n  expected {expected}")
+        assert eng.xprof.compile.recompile_count() == 0, \
+            eng.xprof.compile.payload()
 
-    # ---- steady state: the SAME workload again must compile NOTHING --
-    before = dict(counts)
-    for p in prompts:
-        eng.submit(p, max_new_tokens=MAX_NEW)
-    drive(eng, 2 * len(prompts))
-    after = eng.xprof.compile.counts()
-    assert after == before, \
-        f"steady state compiled: {set(after) - set(before)} / counts moved"
-    assert eng.xprof.compile.recompile_count() == 0
-    assert eng.xprof.compile.storms == 0
+        # ---- steady state: SAME workload must compile NOTHING ------
+        before = dict(counts)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=MAX_NEW)
+        drive(eng, 2 * len(prompts))
+        after = eng.xprof.compile.counts()
+        assert after == before, (
+            f"steady state compiled: {set(after) - set(before)} "
+            "/ counts moved")
+        assert eng.xprof.compile.recompile_count() == 0
+        assert eng.xprof.compile.storms == 0
 
-    # ---- lifecycle + allocator hygiene ----
-    for req in eng.completed:
-        assert len(req.generated) == MAX_NEW, req.rid
-        assert req.enqueue_ts <= req.admit_ts <= req.first_token_ts \
-            <= req.done_ts, req.rid
-    eng._alloc.check()
-    assert eng._alloc.used_blocks == 0, eng._alloc.payload()
-    assert eng._sched.admitted_total >= 2 * len(prompts)
+        # ---- lifecycle + allocator hygiene --------------------------
+        for req in eng.completed:
+            assert len(req.generated) == MAX_NEW, req.rid
+            assert req.enqueue_ts <= req.admit_ts <= req.first_token_ts \
+                <= req.done_ts, req.rid
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0, eng._alloc.payload()
+        assert eng._sched.admitted_total >= 2 * len(prompts)
+
+    # ---- cache OFF: byte-for-byte the PR-15 engine ------------------
+    eng = PagedDecodeEngine(cfg, params, batch=4, max_len=48, block_size=8,
+                            prefill_chunk=8, host_sync_interval=4,
+                            prefix_cache=False)
+    exercise(eng, EXPECTED_LOWERINGS)
+
+    # ---- cache ON: one eager CoW executable, nothing mid-traffic ----
+    eng_on = PagedDecodeEngine(cfg, params, batch=4, max_len=48,
+                               block_size=8, prefill_chunk=8,
+                               host_sync_interval=4, prefix_cache=True)
+    at_construction = eng_on.xprof.compile.counts()
+    assert at_construction == {"paged_cow_copy": 1}, (
+        "CoW copy must be built at construction, before traffic: "
+        f"{at_construction}")
+    exercise(eng_on, EXPECTED_WITH_PREFIX)
+    pfx = eng_on.prefix_stats()
+    assert pfx["tokens_matched_total"] > 0, pfx
+    # Second pass resubmits identical prompts: every full-block prefix
+    # must hit (len-3/5/7 prompts are sub-block — limit len-1 forbids
+    # matching their only block; the 11/19-token prompts must).
+    skipped = eng_on._sched.prefix_tokens_skipped_total
+    assert skipped >= 8 + 16, skipped
+
+    # ---- bitwise token parity: cache on vs off, both passes ---------
+    off_by_rid = {r.rid: r.generated for r in eng.completed}
+    for r in eng_on.completed:
+        assert r.generated == off_by_rid[r.rid], (
+            f"prefix-cache token divergence rid={r.rid}: "
+            f"{r.generated} vs {off_by_rid[r.rid]}")
 
     # ---- parity vs the seed lanes engine (greedy, same params) ----
     lanes = DecodeEngine(cfg, params, batch=len(prompts), max_len=48)
@@ -144,8 +189,10 @@ def main(argv=None) -> int:
 
     print(f"decode smoke OK: {len(eng.completed)} mixed-length requests "
           f"({sorted(PROMPT_LENS)} prompt lens) through the paged "
-          f"engine; {sum(counts.values())} pinned lowerings, 0 "
-          "steady-state recompiles, token parity vs lanes, allocator "
+          f"engine twice (prefix cache off+on); "
+          f"{len(EXPECTED_LOWERINGS)}+1 pinned lowerings, 0 "
+          "steady-state recompiles, token parity vs lanes and vs "
+          f"cache-off, {skipped} prefix tokens skipped, allocator "
           f"clean ({eng._alloc.payload()['allocs_total']} allocs, "
           f"{eng._sched.preemptions_total} preemptions)")
     return 0

@@ -60,6 +60,22 @@ class LoadProfile:
     max_prompt: int = 24
     tail_alpha: float = 1.2
     max_new_tokens: int = 16
+    # Shared-prefix workload (--shared-prefix; docs/design/
+    # prefix-cache.md proof traffic): every prompt is a fixed-length
+    # system prefix + the Pareto-length cold suffix above. A
+    # ``shared_frac`` fraction draws its prefix from a FIXED pool of
+    # ``shared_prefix_pool`` seeded system prompts (the 90% that should
+    # hit the prefix cache); the rest get a unique random prefix of the
+    # SAME length, so warm-vs-cold TTFT compares equal-length prompts.
+    shared_prefix: bool = False
+    shared_frac: float = 0.9
+    shared_prefix_pool: int = 4
+    shared_prefix_len: int = 32
+    # When set, the pool is drawn from its OWN seeded rng, so schedules
+    # with different arrival seeds still share one system-prompt pool
+    # (system prompts are deploy-time constants; the prefix bench warms
+    # the pool on one schedule and measures on another).
+    shared_prefix_pool_seed: int | None = None
 
     def rate_at(self, t: float) -> float:
         frac = t / self.duration_s if self.duration_s > 0 else 1.0
@@ -97,8 +113,29 @@ class ArrivalSchedule:
                 break
             offsets.append(t)
         lengths = cls._pareto_lengths(rng, len(offsets), profile)
-        prompts = [rng.integers(0, vocab_size, size=int(n)).astype(np.int32)
-                   for n in lengths]
+        if profile.shared_prefix:
+            pool_rng = (np.random.default_rng(profile.shared_prefix_pool_seed)
+                        if profile.shared_prefix_pool_seed is not None
+                        else rng)
+            pool = [pool_rng.integers(0, vocab_size,
+                                      size=profile.shared_prefix_len
+                                      ).astype(np.int32)
+                    for _ in range(profile.shared_prefix_pool)]
+            prompts = []
+            for n in lengths:
+                suffix = rng.integers(0, vocab_size,
+                                      size=int(n)).astype(np.int32)
+                if rng.random() < profile.shared_frac:
+                    head = pool[int(rng.integers(0, len(pool)))]
+                else:
+                    head = rng.integers(0, vocab_size,
+                                        size=profile.shared_prefix_len
+                                        ).astype(np.int32)
+                prompts.append(np.concatenate([head, suffix]))
+        else:
+            prompts = [rng.integers(0, vocab_size,
+                                    size=int(n)).astype(np.int32)
+                       for n in lengths]
         return cls(profile=profile, offsets=offsets, prompts=prompts)
 
     @staticmethod
@@ -229,7 +266,14 @@ def main(argv=None) -> int:
                         default="lanes",
                         help="decode engine flavor (paged = the "
                         "continuous-batching rebuild)")
+    parser.add_argument("--shared-prefix", action="store_true",
+                        help="90/10 shared/cold prompts over a fixed "
+                        "system-prompt pool (prefix-cache proof "
+                        "traffic; implies --engine paged)")
+    parser.add_argument("--shared-frac", type=float, default=0.9)
     args = parser.parse_args(argv)
+    if args.shared_prefix:
+        args.engine = "paged"   # only the paged engine has the cache
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from grove_tpu.serving.slo import EngineTelemetry
@@ -244,11 +288,20 @@ def main(argv=None) -> int:
         eng.warmup()
     profile = LoadProfile(duration_s=args.duration,
                           base_rate=args.base_rate,
-                          ramp_factor=args.ramp)
+                          ramp_factor=args.ramp,
+                          shared_prefix=args.shared_prefix,
+                          shared_frac=args.shared_frac)
+    if args.shared_prefix:
+        # Keep prefix + suffix + new tokens inside the tiny engine's
+        # 64-token max_seq_len.
+        profile = dataclasses.replace(profile, max_prompt=12)
     schedule = ArrivalSchedule.build(profile, seed=args.seed)
     print(f"offering {len(schedule.offsets)} requests over "
           f"{args.duration:.0f}s ({args.base_rate:.1f} -> "
-          f"{args.base_rate * args.ramp:.1f} req/s)")
+          f"{args.base_rate * args.ramp:.1f} req/s)"
+          + (f", shared-prefix {profile.shared_frac:.0%} over "
+             f"{profile.shared_prefix_pool} system prompts"
+             if args.shared_prefix else ""))
     stats = run_load(eng, pw, schedule, telemetry=tel)
     s = tel.snapshot()
     print(f"completed {stats.completed}/{stats.offered} "
@@ -258,6 +311,12 @@ def main(argv=None) -> int:
           f"TPOT p50/p99: {s['tpot_p50_s'] * 1e3:.2f}/"
           f"{s['tpot_p99_s'] * 1e3:.2f} ms   "
           f"queue-wait p99: {s['queue_wait_p99_s'] * 1e3:.1f} ms")
+    if getattr(eng, "_prefix", None) is not None:
+        p = eng.prefix_stats()
+        print(f"prefix cache: hit-rate {p['hit_rate']:.2f}, "
+              f"{p['cached_blocks']} cached blocks, "
+              f"{p['tokens_matched_total']} tokens matched, "
+              f"{p['cow_copies']} CoW copies")
     return 0
 
 
