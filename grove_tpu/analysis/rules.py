@@ -534,7 +534,8 @@ class HostSyncInStepLoop(Rule):
     # The per-step dispatch path: the public tick entrypoints AND the
     # per-tick internals they delegate to — scoping only to step/run
     # would leave the paged engine's actual dispatch bodies unchecked.
-    STEP_FUNCS = {"step", "run", "_decode_tick", "_prefill_tick"}
+    STEP_FUNCS = {"step", "run", "_decode_tick", "_prefill_tick",
+                  "_spec_tick"}
     # What marks an If-test as THE sampling gate: the bound gate flag
     # (``sampled = x is not None and x.should_sample()``) or the gate
     # method itself. Deliberately NOT substrings like "sample" or
@@ -656,7 +657,8 @@ class WriteToSharedBlock(Rule):
                    "function — writes into refcount>1 blocks must "
                    "copy-on-write first")
 
-    SCATTER_GETTERS = {"_get_prefill", "_get_step"}
+    SCATTER_GETTERS = {"_get_prefill", "_get_step", "_get_spec",
+                       "_get_draft_prefill"}
     COW_HELPERS = {"_resolve_cow", "_cow_guard"}
 
     def applies(self, mod: ModuleFile) -> bool:

@@ -87,6 +87,29 @@ CONFIGS: dict[str, LlamaConfig] = {
 }
 
 
+def draft_config(cfg: LlamaConfig) -> LlamaConfig:
+    """Shrink a target config into its speculative-decoding draft.
+
+    The draft shares the tokenizer (vocab), rope geometry, and context
+    budget with the target — acceptance math compares token ids, so the
+    vocab MUST match — but runs ~1/4 of the width/depth. head_dim is
+    kept so the draft reuses the target's paged block geometry (same
+    block tables address both pools; only n_kv/layers differ).
+    """
+    n_heads = max(2, cfg.n_heads // 4)
+    n_kv = max(1, cfg.n_kv_heads // 4)
+    while n_heads % n_kv:  # GQA grouping needs an even split
+        n_kv -= 1
+    return dataclasses.replace(
+        cfg,
+        d_model=max(32, cfg.d_model // 4),
+        n_layers=max(1, cfg.n_layers // 4),
+        n_heads=n_heads,
+        n_kv_heads=n_kv,
+        d_ff=max(64, cfg.d_ff // 4),
+    )
+
+
 def norm_init(cfg: LlamaConfig, shape) -> jnp.ndarray:
     return jnp.ones(shape, cfg.dtype)
 
@@ -486,49 +509,120 @@ def _paged_scatter(cache_blocks: jnp.ndarray, kv: jnp.ndarray,
     return flat.reshape(cache_blocks.shape)
 
 
+# int8 paged KV (GROVE_KV_QUANT=int8): K/V blocks store int8 payloads
+# with a per-slot-per-head symmetric scale alongside the pool —
+# [num_blocks, bs, n_kv] f32 per layer. Per-SLOT (not per-block) scales
+# are forced by incremental writes: a whole-block amax would need the
+# other slots' values at write time, which a decode step doesn't have.
+# Quantization happens in the scatter, dequantization in the gather, so
+# int8 is what crosses HBM; XLA fuses the upcast*scale into the
+# attention matmul's operand read (same trade as weight QTensors,
+# serving/quant.py).
+
+KV_SCALE_EPS = 1e-8
+
+
+def _paged_scatter_q(cache_blocks: jnp.ndarray, scales: jnp.ndarray,
+                     kv: jnp.ndarray, tables: jnp.ndarray,
+                     positions: jnp.ndarray,
+                     valid: jnp.ndarray | None = None
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantizing variant of ``_paged_scatter``: write ``kv``
+    [b, s, n_kv, d] as int8 rows plus per-(slot, head) scales.
+    cache_blocks: int8 [nb, bs, n_kv, d]; scales: [nb, bs, n_kv].
+    The scale of a row depends only on that row's values, so a k-wide
+    verify chunk quantizes each row exactly as a sequential decode step
+    would — speculative/int8 composition stays bitwise."""
+    nb, bs = cache_blocks.shape[0], cache_blocks.shape[1]
+    f = kv.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-1)  # [b, s, n_kv]
+    scale = jnp.maximum(amax, KV_SCALE_EPS) / 127.0
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127).astype(jnp.int8)
+    block = jnp.take_along_axis(tables, positions // bs, axis=1)  # [b, s]
+    flat_idx = block * bs + positions % bs
+    if valid is not None:
+        flat_idx = jnp.where(valid, flat_idx, positions % bs)
+    flat = cache_blocks.reshape((nb * bs,) + cache_blocks.shape[2:])
+    flat = flat.at[flat_idx.reshape(-1)].set(q.reshape((-1,) + q.shape[2:]))
+    sflat = scales.reshape(nb * bs, scales.shape[2])
+    sflat = sflat.at[flat_idx.reshape(-1)].set(
+        scale.reshape(-1, scale.shape[2]).astype(scales.dtype))
+    return flat.reshape(cache_blocks.shape), sflat.reshape(scales.shape)
+
+
+def _paged_gather_q(cache_blocks: jnp.ndarray, scales: jnp.ndarray,
+                    tables: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Dequantizing variant of ``_paged_gather``: int8 rows × scales →
+    ``dtype`` [b, w*bs, n_kv, d]."""
+    b, w = tables.shape
+    nb, bs, n_kv, d = cache_blocks.shape
+    vals = cache_blocks[tables].reshape(b, w * bs, n_kv, d)
+    s = scales[tables].reshape(b, w * bs, n_kv)
+    return (vals.astype(jnp.float32) * s[..., None]).astype(dtype)
+
+
 def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       kv_k: jnp.ndarray, kv_v: jnp.ndarray,
-                      tables: jnp.ndarray, lengths: jnp.ndarray
-                      ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                      tables: jnp.ndarray, lengths: jnp.ndarray,
+                      k_scale: jnp.ndarray | None = None,
+                      v_scale: jnp.ndarray | None = None
+                      ) -> tuple[jnp.ndarray, ...]:
     """One decode step over block tables. tokens: [b]; kv pools:
     [layers, num_blocks, bs, n_kv, d]; tables: [b, w]; lengths: [b] =
     tokens already in cache (the new token writes at that position).
 
-    Returns (logits [b, vocab], new kv_k, new kv_v). Attention reads
-    only the gathered w*bs window — the whole point: w is the BUCKETED
-    width of the live sequences, not the engine-wide worst case, so a
-    20-token conversation stops paying a max_len-wide HBM read.
+    Returns (logits [b, vocab], new kv_k, new kv_v) — plus the updated
+    scale pools when ``k_scale``/``v_scale`` are given (int8 KV).
+    Attention reads only the gathered w*bs window — the whole point: w
+    is the BUCKETED width of the live sequences, not the engine-wide
+    worst case, so a 20-token conversation stops paying a max_len-wide
+    HBM read.
     """
     b = tokens.shape[0]
     positions = lengths[:, None]  # [b, 1]
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = embed(cfg, params, tokens[:, None])  # [b, 1, d]
     new_lengths = lengths + 1
+    quant = k_scale is not None
 
     def body(x, xs):
-        lp, kc, vc = xs
+        if quant:
+            lp, kc, vc, ks, vs = xs
+        else:
+            lp, kc, vc = xs
         q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
-        kc = _paged_scatter(kc, k, tables, positions)
-        vc = _paged_scatter(vc, v, tables, positions)
-        attn = decode_attention(q, _paged_gather(kc, tables),
-                                _paged_gather(vc, tables), new_lengths)
+        if quant:
+            kc, ks = _paged_scatter_q(kc, ks, k, tables, positions)
+            vc, vs = _paged_scatter_q(vc, vs, v, tables, positions)
+            kg = _paged_gather_q(kc, ks, tables, cfg.dtype)
+            vg = _paged_gather_q(vc, vs, tables, cfg.dtype)
+        else:
+            kc = _paged_scatter(kc, k, tables, positions)
+            vc = _paged_scatter(vc, v, tables, positions)
+            kg, vg = _paged_gather(kc, tables), _paged_gather(vc, tables)
+        attn = decode_attention(q, kg, vg, new_lengths)
         x = _attn_out(x, attn, lp)
         x = _mlp_block(cfg, x, lp)
-        return x, (kc, vc)
+        return x, ((kc, vc, ks, vs) if quant else (kc, vc))
 
-    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], kv_k, kv_v))
+    xs = (params["layers"], kv_k, kv_v)
+    if quant:
+        xs = xs + (k_scale, v_scale)
+    x, outs = lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("bd,dv->bv", x[:, 0], _w(params["lm_head"]),
                         preferred_element_type=jnp.float32)
-    return logits, k_all, v_all
+    return (logits,) + tuple(outs)
 
 
 def prefill_chunk_paged(cfg: LlamaConfig, params: Params,
                         tokens: jnp.ndarray, kv_k: jnp.ndarray,
                         kv_v: jnp.ndarray, tables: jnp.ndarray,
                         offset: jnp.ndarray, logit_idx: jnp.ndarray,
-                        n_valid: jnp.ndarray | None = None
-                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                        n_valid: jnp.ndarray | None = None,
+                        k_scale: jnp.ndarray | None = None,
+                        v_scale: jnp.ndarray | None = None
+                        ) -> tuple[jnp.ndarray, ...]:
     """One chunked-prefill window over block tables.
 
     tokens: [b, c] at absolute positions [offset, offset+c); ``offset``
@@ -560,25 +654,261 @@ def prefill_chunk_paged(cfg: LlamaConfig, params: Params,
     valid = jnp.broadcast_to(jnp.arange(c)[None, :] < n_valid, (b, c))
     cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     x = embed(cfg, params, tokens)
+    quant = k_scale is not None
 
     def body(x, xs):
-        lp, kc, vc = xs
+        if quant:
+            lp, kc, vc, ks, vs = xs
+        else:
+            lp, kc, vc = xs
         q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
-        kc = _paged_scatter(kc, k, tables, positions, valid=valid)
-        vc = _paged_scatter(vc, v, tables, positions, valid=valid)
-        attn = causal_attention(q, _paged_gather(kc, tables),
-                                _paged_gather(vc, tables),
-                                q_offset=offset)
+        if quant:
+            kc, ks = _paged_scatter_q(kc, ks, k, tables, positions,
+                                      valid=valid)
+            vc, vs = _paged_scatter_q(vc, vs, v, tables, positions,
+                                      valid=valid)
+            kg = _paged_gather_q(kc, ks, tables, cfg.dtype)
+            vg = _paged_gather_q(vc, vs, tables, cfg.dtype)
+        else:
+            kc = _paged_scatter(kc, k, tables, positions, valid=valid)
+            vc = _paged_scatter(vc, v, tables, positions, valid=valid)
+            kg, vg = _paged_gather(kc, tables), _paged_gather(vc, tables)
+        attn = causal_attention(q, kg, vg, q_offset=offset)
         x = _attn_out(x, attn, lp)
         x = _mlp_block(cfg, x, lp)
-        return x, (kc, vc)
+        return x, ((kc, vc, ks, vs) if quant else (kc, vc))
 
-    x, (k_all, v_all) = lax.scan(body, x, (params["layers"], kv_k, kv_v))
+    xs = (params["layers"], kv_k, kv_v)
+    if quant:
+        xs = xs + (k_scale, v_scale)
+    x, outs = lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     row = jnp.take(x, logit_idx, axis=1)  # [b, d] (clipped gather)
     logits = jnp.einsum("bd,dv->bv", row, _w(params["lm_head"]),
                         preferred_element_type=jnp.float32)
-    return logits, k_all, v_all
+    return (logits,) + tuple(outs)
+
+
+# ---- speculative decoding kernels ------------------------------------
+# Verification of k drafted tokens is a (k+1)-wide chunked prefill with
+# PER-POSITION logits and a PER-SEQUENCE causal offset (each sequence
+# sits at its own length — the scalar-offset prefill chunk can't express
+# that). Position i's logits depend only on cache rows < lengths+i plus
+# chunk rows ≤ i, all of which hold exactly what a sequential greedy
+# decode would have written — so argmax per position reproduces
+# sequential greedy bitwise, which is what makes accept/reject exact
+# rather than approximate.
+
+
+def verify_chunk_paged(cfg: LlamaConfig, params: Params,
+                       tokens: jnp.ndarray, kv_k: jnp.ndarray,
+                       kv_v: jnp.ndarray, tables: jnp.ndarray,
+                       lengths: jnp.ndarray,
+                       limit: jnp.ndarray | None = None,
+                       k_scale: jnp.ndarray | None = None,
+                       v_scale: jnp.ndarray | None = None
+                       ) -> tuple[jnp.ndarray, ...]:
+    """Target-model verification chunk. tokens: [b, c] — row 0 is the
+    last committed token, rows 1..c-1 the draft; row i writes its K/V at
+    position lengths+i and its logits predict position lengths+i+1.
+
+    ``limit`` [b] caps writes per sequence (min of max_len and the block
+    table's backed capacity): rows at positions ≥ limit scatter to the
+    null block. The engine clamps acceptance so committed tokens never
+    depend on capped rows.
+
+    Returns (all_logits [b, c, vocab], pools... [+ scale pools when
+    quantized]).
+    """
+    b, c = tokens.shape
+    positions = lengths[:, None] + jnp.arange(c)[None, :]  # [b, c]
+    valid = None if limit is None else positions < limit[:, None]
+    cos, sin = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    x = embed(cfg, params, tokens)
+    quant = k_scale is not None
+
+    def body(x, xs):
+        if quant:
+            lp, kc, vc, ks, vs = xs
+        else:
+            lp, kc, vc = xs
+        q, k, v = _qkv(cfg, x, lp, cos, sin, positions)
+        if quant:
+            kc, ks = _paged_scatter_q(kc, ks, k, tables, positions,
+                                      valid=valid)
+            vc, vs = _paged_scatter_q(vc, vs, v, tables, positions,
+                                      valid=valid)
+            kg = _paged_gather_q(kc, ks, tables, cfg.dtype)
+            vg = _paged_gather_q(vc, vs, tables, cfg.dtype)
+        else:
+            kc = _paged_scatter(kc, k, tables, positions, valid=valid)
+            vc = _paged_scatter(vc, v, tables, positions, valid=valid)
+            kg, vg = _paged_gather(kc, tables), _paged_gather(vc, tables)
+        attn = causal_attention(q, kg, vg, q_offset=lengths)
+        x = _attn_out(x, attn, lp)
+        x = _mlp_block(cfg, x, lp)
+        return x, ((kc, vc, ks, vs) if quant else (kc, vc))
+
+    xs = (params["layers"], kv_k, kv_v)
+    if quant:
+        xs = xs + (k_scale, v_scale)
+    x, outs = lax.scan(body, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    all_logits = jnp.einsum("bcd,dv->bcv", x, _w(params["lm_head"]),
+                            preferred_element_type=jnp.float32)
+    return (all_logits,) + tuple(outs)
+
+
+def spec_step_paged(cfg: LlamaConfig, dcfg: LlamaConfig, params: Params,
+                    dparams: Params, tokens: jnp.ndarray,
+                    kv_k: jnp.ndarray, kv_v: jnp.ndarray,
+                    draft_k: jnp.ndarray | None, draft_v: jnp.ndarray | None,
+                    tables: jnp.ndarray, lengths: jnp.ndarray,
+                    limit: jnp.ndarray, spec_k: int,
+                    k_scale: jnp.ndarray | None = None,
+                    v_scale: jnp.ndarray | None = None,
+                    self_draft: bool = False) -> tuple[jnp.ndarray, ...]:
+    """One fused speculative decode step: draft spec_k tokens with the
+    draft model (greedy, on its own paged pool addressed by the SAME
+    block tables), verify all of them plus the input token in one
+    (spec_k+1)-wide target chunk, and accept the longest agreeing
+    prefix + one bonus token — all inside a single dispatch, so the
+    whole thing is one executable per (batch, width) bucket.
+
+    Greedy acceptance: row i of the verify chunk emits the target's
+    argmax after consuming [..., tokens, d_1..d_i]; a draft token d_i+1
+    is accepted iff it equals that argmax. m = longest agreeing prefix;
+    the committed tokens are d_1..d_m plus the target's argmax at row m
+    (the "bonus"), which is exactly the token sequential greedy would
+    emit — rejection costs nothing because rows past m sit ABOVE the
+    new length (causally invisible) and are overwritten by the next
+    dispatch's writes at those positions: rollback is pure bookkeeping,
+    no block copies.
+
+    ``limit`` [b] = per-sequence write cap (min(max_len, backed block
+    capacity)); acceptance is clamped so new_lengths ≤ limit and every
+    committed token's K/V row is real. Padded batch rows carry limit 0:
+    their writes land in the null block and their lengths don't move.
+
+    ``self_draft``: the drafter IS the target model (dcfg/dparams are
+    cfg/params). A separate draft pool would then be a bitwise mirror
+    of the target pool, so the scan drafts directly against the TARGET
+    pool: its writes at positions lengths..lengths+k-1 are exactly what
+    the verify chunk rewrites (chunked and sequential scatters agree
+    bitwise), the verify chunk additionally covers the bonus position,
+    and both the duplicate pool and the draft replay pass disappear —
+    draft_k/draft_v must be None and are not returned.
+
+    Returns (out_tokens [b, spec_k+1] int32, committed prefix padded
+    with -1; next_tokens [b]; new_lengths [b]; target pools [+ scale
+    pools when quantized]; draft pools unless self_draft).
+    """
+    b = tokens.shape[0]
+    dcos, dsin = rope_table(dcfg.max_seq_len, dcfg.head_dim, dcfg.rope_theta)
+    quant = k_scale is not None
+    # Self-draft against a quantized target pool drafts THROUGH the
+    # int8 path — the same dequantized history sequential greedy reads,
+    # so draft/target agreement stays exact.
+    dquant = quant and self_draft
+
+    def draft_step(carry, _):
+        if dquant:
+            tok, dk, dv, dks, dvs, ln = carry
+        else:
+            tok, dk, dv, ln = carry
+        positions = ln[:, None]  # [b, 1]
+        dvalid = positions < limit[:, None]
+        x = embed(dcfg, dparams, tok[:, None])
+
+        def body(x, xs):
+            if dquant:
+                lp, kc, vc, ks, vs = xs
+                q, k, v = _qkv(dcfg, x, lp, dcos, dsin, positions)
+                kc, ks = _paged_scatter_q(kc, ks, k, tables, positions,
+                                          valid=dvalid)
+                vc, vs = _paged_scatter_q(vc, vs, v, tables, positions,
+                                          valid=dvalid)
+                kg = _paged_gather_q(kc, ks, tables, dcfg.dtype)
+                vg = _paged_gather_q(vc, vs, tables, dcfg.dtype)
+            else:
+                lp, kc, vc = xs
+                q, k, v = _qkv(dcfg, x, lp, dcos, dsin, positions)
+                kc = _paged_scatter(kc, k, tables, positions, valid=dvalid)
+                vc = _paged_scatter(vc, v, tables, positions, valid=dvalid)
+                kg, vg = _paged_gather(kc, tables), _paged_gather(vc, tables)
+            attn = decode_attention(q, kg, vg, ln + 1)
+            x = _attn_out(x, attn, lp)
+            x = _mlp_block(dcfg, x, lp)
+            return x, ((kc, vc, ks, vs) if dquant else (kc, vc))
+
+        xs = (dparams["layers"], dk, dv)
+        if dquant:
+            xs = xs + (dks, dvs)
+        x, pools = lax.scan(body, x, xs)
+        x = rms_norm(x, dparams["final_norm"], dcfg.norm_eps)
+        lg = jnp.einsum("bd,dv->bv", x[:, 0], _w(dparams["lm_head"]),
+                        preferred_element_type=jnp.float32)
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return (nxt,) + tuple(pools) + (ln + 1,), nxt
+
+    if self_draft:
+        scan_pools = (kv_k, kv_v) + ((k_scale, v_scale) if quant else ())
+    else:
+        scan_pools = (draft_k, draft_v)
+    carry, drafts = lax.scan(
+        draft_step, (tokens.astype(jnp.int32),) + scan_pools + (lengths,),
+        None, length=spec_k)
+    drafts = jnp.transpose(drafts)  # [b, spec_k]
+    if self_draft:
+        # Thread the drafted-over target pool into verification: the
+        # verify chunk rewrites those slots with identical values, so
+        # this only preserves donation-friendly single ownership.
+        if quant:
+            kv_k, kv_v, k_scale, v_scale = carry[1:5]
+        else:
+            kv_k, kv_v = carry[1:3]
+    else:
+        draft_k, draft_v = carry[1:3]
+
+    chunk = jnp.concatenate([tokens[:, None].astype(jnp.int32), drafts],
+                            axis=1)  # [b, spec_k+1]
+    if not self_draft:
+        # Replay the whole chunk through the DRAFT model too: the
+        # sequential scan above wrote draft K/V only for its own inputs
+        # (positions lengths..lengths+k-1), but a full acceptance
+        # commits through lengths+k — without this pass the draft pool
+        # would hold a permanent hole at every last-draft position and
+        # acceptance would degrade (verification never reads the draft
+        # pool, so this is a draft-accuracy repair, not a correctness
+        # one). Chunked and sequential writes are bitwise-identical for
+        # the overlapping positions, so the replay only fills the hole.
+        # The replayed logits are unused and XLA dead-code-eliminates
+        # that lm_head.
+        d_outs = verify_chunk_paged(dcfg, dparams, chunk, draft_k,
+                                    draft_v, tables, lengths, limit=limit)
+        draft_k, draft_v = d_outs[1], d_outs[2]
+    outs = verify_chunk_paged(cfg, params, chunk, kv_k, kv_v, tables,
+                              lengths, limit=limit,
+                              k_scale=k_scale, v_scale=v_scale)
+    all_logits = outs[0]
+    tgt = jnp.argmax(all_logits, axis=-1).astype(jnp.int32)  # [b, k+1]
+    agree = (drafts == tgt[:, :-1]).astype(jnp.int32)        # [b, k]
+    m = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)          # [b]
+    # Clamp: committed token i's K/V lives at lengths+i, which must be
+    # < limit; the bonus token needs no K/V row yet (it is next tick's
+    # input). limit ≤ lengths means a full/padded row: commit nothing.
+    m = jnp.minimum(m, jnp.maximum(limit - lengths - 1, 0))
+    idx = jnp.arange(spec_k + 1)[None, :]
+    drafts_p = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    bonus = jnp.take_along_axis(tgt, m[:, None], axis=1)     # [b, 1]
+    out_tokens = jnp.where(idx == m[:, None], bonus, drafts_p)
+    out_tokens = jnp.where(idx <= m[:, None], out_tokens, -1)
+    new_lengths = jnp.minimum(lengths + m + 1,
+                              jnp.maximum(limit, lengths))
+    next_tokens = bonus[:, 0]
+    ret = (out_tokens, next_tokens, new_lengths) + tuple(outs[1:])
+    return ret if self_draft else ret + (draft_k, draft_v)
 
 
 def next_token_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:

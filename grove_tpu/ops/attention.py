@@ -85,7 +85,11 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Causal GQA attention for prefill.
 
     q: [b, sq, h, d]; k, v: [b, skv, n_kv, d]. ``q_offset`` is the absolute
-    position of q[0] (for chunked prefill against a longer KV prefix).
+    position of q[0] (for chunked prefill against a longer KV prefix) —
+    either a scalar shared by the whole batch or a per-sequence ``[b]``
+    vector (speculative verify chunks, where each sequence sits at its
+    own length). The scalar path's lowering is unchanged by the vector
+    extension: the branch resolves at trace time.
     """
     b, sq, h, d = q.shape
     n_kv = k.shape[2]
@@ -93,10 +97,16 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = d ** -0.5
     logits = jnp.einsum("bqkgd,bskd->bkgqs", qg * scale, k,
                         preferred_element_type=jnp.float32)
-    q_pos = jnp.arange(sq)[:, None] + q_offset
-    kv_pos = jnp.arange(k.shape[1])[None, :]
-    mask = q_pos >= kv_pos  # causal
-    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    if jnp.ndim(q_offset) >= 1:
+        q_pos = q_offset[:, None, None] + jnp.arange(sq)[None, :, None]
+        kv_pos = jnp.arange(k.shape[1])[None, None, :]
+        mask = q_pos >= kv_pos  # [b, sq, skv]
+        logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    else:
+        q_pos = jnp.arange(sq)[:, None] + q_offset
+        kv_pos = jnp.arange(k.shape[1])[None, :]
+        mask = q_pos >= kv_pos  # causal
+        logits = jnp.where(mask[None, None, None], logits, NEG_INF)
     probs = jnp.exp(logits - lax.stop_gradient(
         jnp.max(logits, axis=-1, keepdims=True)))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)

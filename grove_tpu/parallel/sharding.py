@@ -155,30 +155,74 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def paged_scale_pspec() -> P:
+    """int8-KV dequant scales, [layers, num_blocks, block_size, n_kv] —
+    co-sharded with the pools they scale (kv_heads over tp)."""
+    return logical_pspec("layers", None, None, "kv_heads")
+
+
+def paged_scale_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, paged_scale_pspec())
+
+
 def paged_step_shardings(mesh: Mesh, params: Any,
-                         sampled: bool = False) -> tuple:
+                         sampled: bool = False,
+                         quant: bool = False) -> tuple:
     """(in_shardings, out_shardings) for the paged decode step:
-    (params, tokens[b], kv_k, kv_v, tables[b,w], lengths[b][, key]) →
-    (next[b], kv_k, kv_v, lengths[b][, key])."""
+    (params, tokens[b], kv_k, kv_v[, k_scale, v_scale], tables[b,w],
+    lengths[b][, key]) → (next[b], kv pools..., lengths[b][, key]).
+    ``quant`` inserts the int8 scale pools right after the payload
+    pools, matching ``decode_step_paged``'s quantized signature."""
     ps = param_shardings(mesh, params)
     kv = paged_kv_sharding(mesh)
     rep = replicated(mesh)
-    ins = (ps, rep, kv, kv, rep, rep)
-    outs = (rep, kv, kv, rep)
+    pool = (kv, kv, paged_scale_sharding(mesh),
+            paged_scale_sharding(mesh)) if quant else (kv, kv)
+    ins = (ps, rep) + pool + (rep, rep)
+    outs = (rep,) + pool + (rep,)
     if sampled:
         ins += (rep,)
         outs += (rep,)
     return ins, outs
 
 
-def paged_prefill_shardings(mesh: Mesh, params: Any) -> tuple:
+def paged_prefill_shardings(mesh: Mesh, params: Any,
+                            quant: bool = False) -> tuple:
     """(in_shardings, out_shardings) for one chunked-prefill window:
-    (params, tokens[1,c], kv_k, kv_v, table[1,w], offset, logit_idx,
-    n_valid) → (logits[1,vocab], kv_k, kv_v). The spec list mirrors
+    (params, tokens[1,c], kv pools..., table[1,w], offset, logit_idx,
+    n_valid) → (logits[1,vocab], kv pools...). The spec list mirrors
     ``models/llama.prefill_chunk_paged``'s full signature — an arity
     drift here surfaces only as a jit error at engine construction,
     so keep them together."""
     ps = param_shardings(mesh, params)
     kv = paged_kv_sharding(mesh)
     rep = replicated(mesh)
-    return (ps, rep, kv, kv, rep, rep, rep, rep), (rep, kv, kv)
+    pool = (kv, kv, paged_scale_sharding(mesh),
+            paged_scale_sharding(mesh)) if quant else (kv, kv)
+    return (ps, rep) + pool + (rep, rep, rep, rep), (rep,) + pool
+
+
+def paged_spec_shardings(mesh: Mesh, params: Any, dparams: Any,
+                         quant: bool = False,
+                         self_draft: bool = False) -> tuple:
+    """(in_shardings, out_shardings) for the fused speculative step
+    (``models/llama.spec_step_paged``): (params, dparams, tokens[b],
+    target pools..., draft pools..., tables[b,w], lengths[b],
+    limit[b]) → (out_tokens[b,k+1], next[b], lengths[b], target
+    pools..., draft pools...). The draft pool shards exactly like the
+    target pool — same kv_heads-over-tp rule, its own (smaller)
+    arrays. With ``self_draft`` the drafter runs against the target
+    pool, so dparams and the draft pools drop out of the signature on
+    both sides."""
+    ps = param_shardings(mesh, params)
+    kv = paged_kv_sharding(mesh)
+    rep = replicated(mesh)
+    pool = (kv, kv, paged_scale_sharding(mesh),
+            paged_scale_sharding(mesh)) if quant else (kv, kv)
+    if self_draft:
+        return ((ps, rep) + pool + (rep, rep, rep),
+                (rep, rep, rep) + pool)
+    dps = param_shardings(mesh, dparams)
+    ins = (ps, dps, rep) + pool + (kv, kv) + (rep, rep, rep)
+    outs = (rep, rep, rep) + pool + (kv, kv)
+    return ins, outs

@@ -762,7 +762,11 @@ class PagedDecodeEngine:
                  telemetry=None,
                  xprof=None,
                  mesh=None,
-                 prefix_cache: bool | None = None):
+                 prefix_cache: bool | None = None,
+                 spec_decode: bool | None = None,
+                 spec_k: int | None = None,
+                 draft_params=None,
+                 kv_quant: str | None = None):
         self.cfg = cfg
         self._sampler = sampler or SamplerConfig()
         if isinstance(key_or_params, jax.Array) \
@@ -800,8 +804,18 @@ class PagedDecodeEngine:
         # this cannot).
         assert num_blocks - 1 >= self.max_blocks_per_seq, \
             (num_blocks, self.max_blocks_per_seq)
+        # int8 paged KV (GROVE_KV_QUANT=int8): blocks store int8 payload
+        # plus per-slot-per-head f32 scales — roughly half the bytes a
+        # bf16 block moves, dequant fused into the gather. "off" is the
+        # untouched bf16 path byte-for-byte.
+        if kv_quant is None:
+            kv_quant = os.environ.get("GROVE_KV_QUANT", "off")
+        assert kv_quant in ("off", "int8"), \
+            f"unknown KV quant mode {kv_quant!r}"
+        self.kv_quant = kv_quant
         self.kv = PagedKV.create(cfg.n_layers, num_blocks, block_size,
-                                 cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+                                 cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                                 quant=kv_quant)
         self._alloc = BlockAllocator(num_blocks, block_size)
         if prefill_chunk is None:
             prefill_chunk = int(os.environ.get("GROVE_PAGED_CHUNK", 32))
@@ -814,17 +828,60 @@ class PagedDecodeEngine:
         if prefix_cache is None:
             prefix_cache = os.environ.get("GROVE_PREFIX_CACHE", "1") != "0"
         self._prefix = PrefixTree(self._alloc) if prefix_cache else None
-        # Bytes one block pins across both pools (K and V) — the
-        # reclaimed/cached byte gauges ride this.
-        self._block_bytes = 2 * int(np.prod(
-            (cfg.n_layers, block_size, cfg.n_kv_heads, cfg.head_dim))) \
-            * jnp.dtype(cfg.dtype).itemsize
+        # Bytes one block pins across both pools (K and V, plus scales
+        # when quantized) — the reclaimed/cached byte gauges ride this.
+        # Derived from the ONE shared helper so bench rows, xprof's
+        # roofline and these gauges can never disagree.
+        from grove_tpu.serving.quant import kv_block_bytes
+        self._block_bytes = kv_block_bytes(cfg, block_size, kv_quant)
         self.cow_copies = 0
         self._cow_jit = None
         self._sched = PagedScheduler(self._alloc, batch,
                                      self.max_blocks_per_seq,
                                      self.prefill_chunk,
                                      prefix_tree=self._prefix)
+
+        # Speculative decoding (GROVE_SPEC_DECODE=1, default off): a
+        # draft model shares the tokenizer, block tables and allocator
+        # but owns its own (smaller, never-quantized) KV pool; each
+        # decode dispatch drafts k tokens and verifies ALL of them in
+        # one fused k+1-wide step. Greedy acceptance commits the
+        # longest agreeing prefix plus one bonus token — BITWISE the
+        # greedy non-speculative output, so the switch trades compute
+        # for dispatches, never correctness. Rejected drafts roll back
+        # as bookkeeping only: their rows sit above the committed
+        # length (causally invisible) and are overwritten next
+        # dispatch — no block copies.
+        if spec_decode is None:
+            spec_decode = os.environ.get("GROVE_SPEC_DECODE", "0") == "1"
+        self.spec_decode = bool(spec_decode)
+        if spec_k is None:
+            spec_k = int(os.environ.get("GROVE_SPEC_K", "3"))
+        self.spec_k = max(1, int(spec_k))
+        self._draft_cfg = None
+        self._draft_params = None
+        self.draft_kv = None
+        self._spec_stats = {"draft_tokens": 0, "accepted_tokens": 0,
+                            "committed_tokens": 0, "dispatches": 0,
+                            "rows": 0, "per_bucket": {}}
+        if self.spec_decode:
+            if draft_params is None:
+                # Derived tiny draft: ~1/4 width/depth of the target,
+                # same vocab/head_dim/max_seq_len so tables and rope
+                # are shared (models/llama.draft_config).
+                self._draft_cfg = llama.draft_config(cfg)
+                self._draft_params = llama.init_params(
+                    self._draft_cfg,
+                    jax.random.PRNGKey(self._sampler.seed + 1))
+            elif isinstance(draft_params, str) and draft_params == "self":
+                # Self-draft: the target drafts for itself. Every draft
+                # agrees, acceptance is k/k deterministically — the
+                # bench/smoke configuration that isolates the
+                # dispatch-amortization win from draft quality.
+                self._draft_cfg = cfg
+                self._draft_params = self.params
+            else:
+                self._draft_cfg, self._draft_params = draft_params
 
         # ---- GSPMD: mesh + shardings (1-chip CPU degrades to no-ops) --
         from grove_tpu.parallel import sharding as shardlib
@@ -835,10 +892,37 @@ class PagedDecodeEngine:
         assert cfg.n_kv_heads % tp == 0, \
             f"n_kv_heads {cfg.n_kv_heads} must divide over tp={tp}"
         self.mesh = mesh
+        self._self_draft = (self.spec_decode
+                            and self._draft_params is self.params)
         self.params = shardlib.shard_params(mesh, self.params)
         kv_sh = shardlib.paged_kv_sharding(mesh)
-        self.kv = PagedKV(k=jax.device_put(self.kv.k, kv_sh),
-                          v=jax.device_put(self.kv.v, kv_sh))
+        if self.kv.quantized:
+            sc_sh = shardlib.paged_scale_sharding(mesh)
+            self.kv = PagedKV(
+                k=jax.device_put(self.kv.k, kv_sh),
+                v=jax.device_put(self.kv.v, kv_sh),
+                k_scale=jax.device_put(self.kv.k_scale, sc_sh),
+                v_scale=jax.device_put(self.kv.v_scale, sc_sh))
+        else:
+            self.kv = PagedKV(k=jax.device_put(self.kv.k, kv_sh),
+                              v=jax.device_put(self.kv.v, kv_sh))
+        if self.spec_decode and self._self_draft:
+            # Self-draft needs NO draft pool: the fused step drafts
+            # directly against the target pool (whose drafted-over
+            # slots the verify chunk rewrites bitwise-identically), so
+            # the KV footprint is the plain engine's.
+            self._draft_params = self.params
+        elif self.spec_decode:
+            dcfg = self._draft_cfg
+            assert dcfg.n_kv_heads % tp == 0, \
+                f"draft n_kv_heads {dcfg.n_kv_heads} must divide tp={tp}"
+            self._draft_params = shardlib.shard_params(
+                mesh, self._draft_params)
+            draft = PagedKV.create(dcfg.n_layers, num_blocks, block_size,
+                                   dcfg.n_kv_heads, dcfg.head_dim,
+                                   cfg.dtype)
+            self.draft_kv = PagedKV(k=jax.device_put(draft.k, kv_sh),
+                                    v=jax.device_put(draft.v, kv_sh))
         # Host-fed buffers (tokens at recompose, tables, prefill chunks)
         # are COMMITTED to the replicated sharding before dispatch:
         # an uncommitted host array and a device-chained committed one
@@ -847,6 +931,11 @@ class PagedDecodeEngine:
 
         self._rng = jax.random.PRNGKey(self._sampler.seed)
         self._sampling = self._sampler.temperature > 0.0
+        # Speculative acceptance is an argmax-agreement test: under
+        # sampling there is no "the" token to agree with, so the combo
+        # is rejected outright rather than silently degrading.
+        assert not (self.spec_decode and self._sampling), \
+            "speculative decoding is greedy-only (temperature must be 0)"
 
         # Per-bucket jitted executables (lazy): each (shape-bucket) key
         # owns its own jit object, so its cache holds exactly one entry
@@ -854,6 +943,8 @@ class PagedDecodeEngine:
         # bucket ladder is the zero-steady-state-recompiles guarantee.
         self._step_jits: dict[tuple, Callable] = {}
         self._prefill_jits: dict[int, Callable] = {}
+        self._spec_jits: dict[tuple, Callable] = {}
+        self._draft_prefill_jits: dict[int, Callable] = {}
 
         # Request flow state.
         self._queue: deque[Request] = deque()
@@ -884,6 +975,10 @@ class PagedDecodeEngine:
             elif xprof_mod.enabled():
                 self.xprof = xprof_mod.Observatory(
                     cfg=cfg, batch=batch, max_len=self.max_len)
+        if self.xprof is not None:
+            # Roofline byte basis: the observatory's KV terms must use
+            # what this engine actually moves.
+            self.xprof.kv_quant = self.kv_quant
 
         # With sharing on, pay the ONE copy-on-write executable at
         # bring-up (a null→null block copy): it is workload-independent
@@ -900,6 +995,28 @@ class PagedDecodeEngine:
             return self.xprof.compile.wrap(name, jitted)
         return jitted
 
+    def _pools(self) -> tuple:
+        """The KV pool arrays a dispatch threads through, in signature
+        order: (k, v) bf16 or (k, v, k_scale, v_scale) int8."""
+        if self.kv.quantized:
+            return (self.kv.k, self.kv.v, self.kv.k_scale,
+                    self.kv.v_scale)
+        return (self.kv.k, self.kv.v)
+
+    def _set_pools(self, outs) -> None:
+        """Rebind self.kv from a dispatch's returned pool arrays (the
+        inverse of ``_pools``)."""
+        if self.kv.quantized:
+            k, v, ks, vs = outs
+            self.kv = PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+        else:
+            k, v = outs
+            self.kv = PagedKV(k=k, v=v)
+
+    @property
+    def _n_pools(self) -> int:
+        return 4 if self.kv.quantized else 2
+
     def _get_step(self, B: int, W: int):
         key = (B, W, self._sampling)
         fn = self._step_jits.get(key)
@@ -908,26 +1025,51 @@ class PagedDecodeEngine:
         from grove_tpu.parallel import sharding as shardlib
         cfg = self.cfg
         sampler_cfg = self._sampler
+        quant = self.kv_quant == "int8"
 
-        def step_greedy(params, tokens, kv_k, kv_v, tables, lengths):
-            logits, kv_k, kv_v = llama.decode_step_paged(
-                cfg, params, tokens, kv_k, kv_v, tables, lengths)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return nxt, kv_k, kv_v, lengths + 1
+        if quant:
+            def step_greedy(params, tokens, kv_k, kv_v, ks, vs, tables,
+                            lengths):
+                logits, kv_k, kv_v, ks, vs = llama.decode_step_paged(
+                    cfg, params, tokens, kv_k, kv_v, tables, lengths,
+                    k_scale=ks, v_scale=vs)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, kv_k, kv_v, ks, vs, lengths + 1
 
-        def step_sampled(params, tokens, kv_k, kv_v, tables, lengths, key):
-            logits, kv_k, kv_v = llama.decode_step_paged(
-                cfg, params, tokens, kv_k, kv_v, tables, lengths)
-            key, sub = jax.random.split(key)
-            nxt = sample_tokens(logits, sub, sampler_cfg)
-            return nxt, kv_k, kv_v, lengths + 1, key
+            def step_sampled(params, tokens, kv_k, kv_v, ks, vs, tables,
+                             lengths, key):
+                logits, kv_k, kv_v, ks, vs = llama.decode_step_paged(
+                    cfg, params, tokens, kv_k, kv_v, tables, lengths,
+                    k_scale=ks, v_scale=vs)
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens(logits, sub, sampler_cfg)
+                return nxt, kv_k, kv_v, ks, vs, lengths + 1, key
+        else:
+            def step_greedy(params, tokens, kv_k, kv_v, tables, lengths):
+                logits, kv_k, kv_v = llama.decode_step_paged(
+                    cfg, params, tokens, kv_k, kv_v, tables, lengths)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, kv_k, kv_v, lengths + 1
+
+            def step_sampled(params, tokens, kv_k, kv_v, tables, lengths,
+                             key):
+                logits, kv_k, kv_v = llama.decode_step_paged(
+                    cfg, params, tokens, kv_k, kv_v, tables, lengths)
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens(logits, sub, sampler_cfg)
+                return nxt, kv_k, kv_v, lengths + 1, key
 
         ins, outs = shardlib.paged_step_shardings(
-            self.mesh, self.params, sampled=self._sampling)
+            self.mesh, self.params, sampled=self._sampling, quant=quant)
+        donate = (2, 3, 4, 5) if quant else (2, 3)
         fn = jax.jit(step_sampled if self._sampling else step_greedy,
-                     donate_argnums=(2, 3), in_shardings=ins,
+                     donate_argnums=donate, in_shardings=ins,
                      out_shardings=outs)
-        suffix = "_sampled" if self._sampling else ""
+        # Quantized executables carry a distinct name so decode_smoke's
+        # lowering pin distinguishes the modes — GROVE_KV_QUANT=off must
+        # reproduce the exact prior lowering set.
+        suffix = ("_sampled" if self._sampling else "") \
+            + ("_q8" if quant else "")
         fn = self._wrap(f"paged_step{suffix}[b{B},w{W}]", fn)
         self._step_jits[key] = fn
         return fn
@@ -938,18 +1080,118 @@ class PagedDecodeEngine:
             return fn
         from grove_tpu.parallel import sharding as shardlib
         cfg = self.cfg
+        quant = self.kv_quant == "int8"
 
-        def chunk_fn(params, tokens, kv_k, kv_v, table, offset, logit_idx,
+        if quant:
+            def chunk_fn(params, tokens, kv_k, kv_v, ks, vs, table,
+                         offset, logit_idx, n_valid):
+                return llama.prefill_chunk_paged(
+                    cfg, params, tokens, kv_k, kv_v, table, offset,
+                    logit_idx, n_valid, k_scale=ks, v_scale=vs)
+        else:
+            def chunk_fn(params, tokens, kv_k, kv_v, table, offset,
+                         logit_idx, n_valid):
+                return llama.prefill_chunk_paged(cfg, params, tokens,
+                                                 kv_k, kv_v, table,
+                                                 offset, logit_idx,
+                                                 n_valid)
+
+        ins, outs = shardlib.paged_prefill_shardings(
+            self.mesh, self.params, quant=quant)
+        donate = (2, 3, 4, 5) if quant else (2, 3)
+        fn = jax.jit(chunk_fn, donate_argnums=donate, in_shardings=ins,
+                     out_shardings=outs)
+        suffix = "_q8" if quant else ""
+        fn = self._wrap(
+            f"paged_prefill{suffix}[c{self.prefill_chunk},w{W}]", fn)
+        self._prefill_jits[W] = fn
+        return fn
+
+    def _get_spec(self, B: int, W: int):
+        """The fused speculative executable for one shape bucket:
+        draft k tokens (sequential small-model steps inside the jit),
+        verify all of them in ONE k+1-wide paged-attention pass, commit
+        the longest agreeing prefix + bonus (models/llama.
+        spec_step_paged). One program per (batch, width) bucket —
+        the ladder keeps the executable set finite exactly like the
+        plain step's."""
+        key = (B, W)
+        fn = self._spec_jits.get(key)
+        if fn is not None:
+            return fn
+        from grove_tpu.parallel import sharding as shardlib
+        cfg, dcfg = self.cfg, self._draft_cfg
+        K = self.spec_k
+        quant = self.kv_quant == "int8"
+
+        if self._self_draft and quant:
+            def spec_fn(params, tokens, kv_k, kv_v, ks, vs,
+                        tables, lengths, limit):
+                return llama.spec_step_paged(
+                    cfg, cfg, params, params, tokens, kv_k, kv_v,
+                    None, None, tables, lengths, limit, K,
+                    k_scale=ks, v_scale=vs, self_draft=True)
+        elif self._self_draft:
+            def spec_fn(params, tokens, kv_k, kv_v,
+                        tables, lengths, limit):
+                return llama.spec_step_paged(
+                    cfg, cfg, params, params, tokens, kv_k, kv_v,
+                    None, None, tables, lengths, limit, K,
+                    self_draft=True)
+        elif quant:
+            def spec_fn(params, dparams, tokens, kv_k, kv_v, ks, vs,
+                        dk, dv, tables, lengths, limit):
+                return llama.spec_step_paged(
+                    cfg, dcfg, params, dparams, tokens, kv_k, kv_v,
+                    dk, dv, tables, lengths, limit, K,
+                    k_scale=ks, v_scale=vs)
+        else:
+            def spec_fn(params, dparams, tokens, kv_k, kv_v, dk, dv,
+                        tables, lengths, limit):
+                return llama.spec_step_paged(
+                    cfg, dcfg, params, dparams, tokens, kv_k, kv_v,
+                    dk, dv, tables, lengths, limit, K)
+
+        ins, outs = shardlib.paged_spec_shardings(
+            self.mesh, self.params, self._draft_params, quant=quant,
+            self_draft=self._self_draft)
+        if self._self_draft:
+            donate = (2, 3, 4, 5) if quant else (2, 3)
+        else:
+            donate = (3, 4, 5, 6, 7, 8) if quant else (3, 4, 5, 6)
+        fn = jax.jit(spec_fn, donate_argnums=donate, in_shardings=ins,
+                     out_shardings=outs)
+        suffix = "_q8" if quant else ""
+        fn = self._wrap(f"paged_spec{suffix}[b{B},w{W},k{K}]", fn)
+        self._spec_jits[key] = fn
+        return fn
+
+    def _get_draft_prefill(self, W: int):
+        """Chunked prefill through the DRAFT model: same tokens, same
+        block table, writing the draft pool so the drafter has its own
+        KV history to decode from. Logits are discarded — the target's
+        chunk produces the first token. The draft pool is never
+        quantized (it is already small; quantizing it would buy bytes
+        nobody is short of and cost draft accuracy)."""
+        fn = self._draft_prefill_jits.get(W)
+        if fn is not None:
+            return fn
+        from grove_tpu.parallel import sharding as shardlib
+        dcfg = self._draft_cfg
+
+        def chunk_fn(dparams, tokens, dk, dv, table, offset, logit_idx,
                      n_valid):
-            return llama.prefill_chunk_paged(cfg, params, tokens, kv_k,
-                                             kv_v, table, offset,
+            return llama.prefill_chunk_paged(dcfg, dparams, tokens, dk,
+                                             dv, table, offset,
                                              logit_idx, n_valid)
 
-        ins, outs = shardlib.paged_prefill_shardings(self.mesh, self.params)
+        ins, outs = shardlib.paged_prefill_shardings(
+            self.mesh, self._draft_params)
         fn = jax.jit(chunk_fn, donate_argnums=(2, 3), in_shardings=ins,
                      out_shardings=outs)
-        fn = self._wrap(f"paged_prefill[c{self.prefill_chunk},w{W}]", fn)
-        self._prefill_jits[W] = fn
+        fn = self._wrap(
+            f"draft_prefill[c{self.prefill_chunk},w{W}]", fn)
+        self._draft_prefill_jits[W] = fn
         return fn
 
     def warmup(self, batches: list[int] | None = None,
@@ -973,25 +1215,44 @@ class PagedDecodeEngine:
         # exists to collide with, witnessed through the same tripwire
         # every real dispatch routes through.
         self._cow_guard(())
+        n_pool = self._n_pools
         for B in batches or self._sched.batch_buckets:
             for W in widths or self._sched.width_buckets:
-                if (B, W, self._sampling) not in self._step_jits:
-                    built += 1
-                fn = self._get_step(B, W)
                 # Commit-ness mirrors the steady state exactly (or the
                 # warm entry would not be THE entry): tokens/lengths
                 # committed, tables host-fed.
                 toks = jax.device_put(np.zeros((B,), np.int32), self._rep)
                 tables = np.zeros((B, W), np.int32)
                 lens = jax.device_put(np.zeros((B,), np.int32), self._rep)
+                if self.spec_decode:
+                    # Spec engines decode ONLY through the fused spec
+                    # executable — building plain steps here would add
+                    # dead programs to the lowering pin.
+                    if (B, W) not in self._spec_jits:
+                        built += 1
+                    fn = self._get_spec(B, W)
+                    limit = np.zeros((B,), np.int32)
+                    if self._self_draft:
+                        outs = fn(self.params, toks, *self._pools(),
+                                  tables, lens, limit)
+                    else:
+                        outs = fn(self.params, self._draft_params, toks,
+                                  *self._pools(), self.draft_kv.k,
+                                  self.draft_kv.v, tables, lens, limit)
+                        self.draft_kv = PagedKV(k=outs[-2], v=outs[-1])
+                    self._set_pools(outs[3:3 + n_pool])
+                    continue
+                if (B, W, self._sampling) not in self._step_jits:
+                    built += 1
+                fn = self._get_step(B, W)
                 if self._sampling:
-                    _, k, v, _, self._rng = fn(self.params, toks, self.kv.k,
-                                               self.kv.v, tables, lens,
-                                               self._rng)
+                    res = fn(self.params, toks, *self._pools(), tables,
+                             lens, self._rng)
+                    self._rng = res[-1]
                 else:
-                    _, k, v, _ = fn(self.params, toks, self.kv.k,
-                                    self.kv.v, tables, lens)
-                self.kv = PagedKV(k=k, v=v)
+                    res = fn(self.params, toks, *self._pools(), tables,
+                             lens)
+                self._set_pools(res[1:1 + n_pool])
         if prefill_widths is None:
             prefill_widths = widths or self._sched.width_buckets
         for W in prefill_widths:
@@ -1000,9 +1261,17 @@ class PagedDecodeEngine:
             fn = self._get_prefill(W)
             toks = np.zeros((1, self.prefill_chunk), np.int32)
             table = np.zeros((1, W), np.int32)
-            _, k, v = fn(self.params, toks, self.kv.k, self.kv.v, table,
-                         np.int32(0), np.int32(0), np.int32(0))
-            self.kv = PagedKV(k=k, v=v)
+            res = fn(self.params, toks, *self._pools(), table,
+                     np.int32(0), np.int32(0), np.int32(0))
+            self._set_pools(res[1:])
+            if self.spec_decode and not self._self_draft:
+                if W not in self._draft_prefill_jits:
+                    built += 1
+                dfn = self._get_draft_prefill(W)
+                _, dk, dv = dfn(self._draft_params, toks,
+                                self.draft_kv.k, self.draft_kv.v, table,
+                                np.int32(0), np.int32(0), np.int32(0))
+                self.draft_kv = PagedKV(k=dk, v=dv)
         jax.block_until_ready(self.kv.k)
         return built
 
@@ -1073,8 +1342,12 @@ class PagedDecodeEngine:
                                          self.kv_lane_utilization)
             if self._prefix is not None:
                 self.telemetry.sample_prefix(self.prefix_stats())
+            if self.spec_decode:
+                self.telemetry.sample_spec(self.spec_stats())
         if self.xprof is not None:
             self.xprof.observe_memory(self, self.telemetry)
+            if self.spec_decode:
+                self.xprof.spec = self.spec_stats()
 
     def prefix_stats(self) -> dict:
         """Prefix-cache gauges for the slo digest (hit-rate,
@@ -1090,6 +1363,36 @@ class PagedDecodeEngine:
                     p["reclaimed_total"] * self._block_bytes,
                 "tokens_matched_total": p["tokens_matched_total"],
                 "cow_copies": self.cow_copies}
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding acceptance gauges (the slo digest and
+        /debug/xprof riders). Empty dict with spec off. Counters
+        accumulate at drain time only — between drains they lag the
+        device by at most one window, the same staleness every other
+        token counter here carries.
+
+        ``accepted_per_dispatch`` is the headline multiplier: mean
+        tokens COMMITTED per sequence per fused dispatch (bonus
+        included) — 1.0 is non-speculative parity, spec_k+1 the
+        ceiling."""
+        if not self.spec_decode:
+            return {}
+        st = self._spec_stats
+        drafted, accepted = st["draft_tokens"], st["accepted_tokens"]
+        rows = st["rows"]
+        return {"spec_k": self.spec_k,
+                "draft_tokens": drafted,
+                "accepted_tokens": accepted,
+                "committed_tokens": st["committed_tokens"],
+                "dispatches": st["dispatches"],
+                "rows": rows,
+                "acceptance_rate":
+                    accepted / drafted if drafted else 0.0,
+                "accepted_per_dispatch":
+                    st["committed_tokens"] / rows if rows else 0.0,
+                "per_bucket": {
+                    f"b{B},w{W}": dict(v)
+                    for (B, W), v in st["per_bucket"].items()}}
 
     def _stamp_admit(self, req: Request, now: float,
                      admit: float | None = None) -> None:
@@ -1157,7 +1460,10 @@ class PagedDecodeEngine:
                 # than the engine's slot count): decode the live set so
                 # completions free slots — without this the loop would
                 # spin forever waiting on admissions that can't happen.
-                self._decode_tick()
+                if self.spec_decode:
+                    self._spec_tick()
+                else:
+                    self._decode_tick()
             self.admit_from_queue()
             stalled = stalled + 1 if self._admit_progress() == before \
                 else 0
@@ -1184,7 +1490,10 @@ class PagedDecodeEngine:
         if self._sched.has_prefill_work():
             self._prefill_tick()
         if self._sched.running:
-            self._decode_tick()
+            if self.spec_decode:
+                self._spec_tick()
+            else:
+                self._decode_tick()
         elif self._pending or self._finishing:
             # The decode set emptied with a window in flight: fold it
             # in now — nothing else will (the last completion must not
@@ -1215,14 +1524,29 @@ class PagedDecodeEngine:
             from grove_tpu.parallel import sharding as shardlib
             kv_sh = shardlib.paged_kv_sharding(self.mesh)
             rep = shardlib.replicated(self.mesh)
+            if self.kv.quantized:
+                # Scales ride the copy: an int8 payload without its
+                # per-slot scale row dequantizes to garbage.
+                sc_sh = shardlib.paged_scale_sharding(self.mesh)
 
-            def cow(k, v, src, dst):
-                return (k.at[:, dst].set(k[:, src]),
-                        v.at[:, dst].set(v[:, src]))
+                def cow(k, v, ks, vs, src, dst):
+                    return (k.at[:, dst].set(k[:, src]),
+                            v.at[:, dst].set(v[:, src]),
+                            ks.at[:, dst].set(ks[:, src]),
+                            vs.at[:, dst].set(vs[:, src]))
 
-            jitted = jax.jit(cow, donate_argnums=(0, 1),
-                             in_shardings=(kv_sh, kv_sh, rep, rep),
-                             out_shardings=(kv_sh, kv_sh))
+                jitted = jax.jit(
+                    cow, donate_argnums=(0, 1, 2, 3),
+                    in_shardings=(kv_sh, kv_sh, sc_sh, sc_sh, rep, rep),
+                    out_shardings=(kv_sh, kv_sh, sc_sh, sc_sh))
+            else:
+                def cow(k, v, src, dst):
+                    return (k.at[:, dst].set(k[:, src]),
+                            v.at[:, dst].set(v[:, src]))
+
+                jitted = jax.jit(cow, donate_argnums=(0, 1),
+                                 in_shardings=(kv_sh, kv_sh, rep, rep),
+                                 out_shardings=(kv_sh, kv_sh))
             self._cow_jit = self._wrap("paged_cow_copy", jitted)
         return self._cow_jit
 
@@ -1237,37 +1561,40 @@ class PagedDecodeEngine:
         construction-time prebuild: a null→null copy that pays the
         executable before any traffic."""
         if seq is None:
-            k, v = self._get_cow()(self.kv.k, self.kv.v,
-                                   np.int32(NULL_BLOCK),
-                                   np.int32(NULL_BLOCK))
-            self.kv = PagedKV(k=k, v=v)
+            self._set_pools(self._get_cow()(*self._pools(),
+                                            np.int32(NULL_BLOCK),
+                                            np.int32(NULL_BLOCK)))
             return
         if seq.cow_src < 0:
             return
         src, dst = seq.cow_src, seq.cow_dst
         seq.cow_src = seq.cow_dst = -1
-        k, v = self._get_cow()(self.kv.k, self.kv.v,
-                               np.int32(src), np.int32(dst))
-        self.kv = PagedKV(k=k, v=v)
+        self._set_pools(self._get_cow()(*self._pools(),
+                                        np.int32(src), np.int32(dst)))
         self._alloc.free([src])
         self.cow_copies += 1
 
-    def _cow_guard(self, seqs) -> None:
+    def _cow_guard(self, seqs, span: int = 1) -> None:
         """Exclusive-write tripwire ahead of the decode scatter (the
-        lint rule's decode half): the block each sequence's next token
-        lands in must be refcount-1. By construction decode always
-        writes a fresh suffix/CoW block — a trip here means the sharing
-        bookkeeping is corrupt, and raising now beats the silent KV
-        corruption a shared-block write would smear over every other
-        holder."""
+        lint rule's decode half): every block the next dispatch can
+        write — positions [pos + inflight, pos + inflight + span) —
+        must be refcount-1. Non-speculative decode has inflight 0 and
+        span 1: exactly the single next-token block. By construction
+        decode always writes a fresh suffix/CoW block — a trip here
+        means the sharing bookkeeping is corrupt, and raising now
+        beats the silent KV corruption a shared-block write would
+        smear over every other holder."""
         bs = self.block_size
         for seq in seqs:
-            b = seq.blocks.blocks[seq.pos // bs]
-            if self._alloc.refcount(b) > 1:
-                raise RuntimeError(
-                    f"decode write into shared block {b} (refcount "
-                    f"{self._alloc.refcount(b)}) — copy-on-write was "
-                    "bypassed")
+            start = seq.pos + seq.inflight
+            end = min(start + span, len(seq.blocks.blocks) * bs)
+            for p in range(start, end):
+                b = seq.blocks.blocks[p // bs]
+                if self._alloc.refcount(b) > 1:
+                    raise RuntimeError(
+                        f"decode write into shared block {b} (refcount "
+                        f"{self._alloc.refcount(b)}) — copy-on-write "
+                        "was bypassed")
 
     # ---- chunked prefill ----
 
@@ -1313,10 +1640,25 @@ class PagedDecodeEngine:
         if sampled:
             jax.block_until_ready(self.kv.k)
             t0 = time.perf_counter()
-        logits, k, v = fn(self.params, toks, self.kv.k, self.kv.v, table,
-                          np.int32(pos), np.int32(max(0, valid - 1)),
-                          np.int32(valid))
-        self.kv = PagedKV(k=k, v=v)
+        res = fn(self.params, toks, *self._pools(), table,
+                 np.int32(pos), np.int32(max(0, valid - 1)),
+                 np.int32(valid))
+        logits = res[0]
+        self._set_pools(res[1:])
+        if self.spec_decode and not self._self_draft:
+            # The draft model replays the SAME chunk into its own pool
+            # (same tokens, same table — block IDs are shared) so it
+            # has KV history to draft from. Runs for recompute replays
+            # too; prefix-cache hits skip straight past matched blocks,
+            # leaving stale draft KV there — an acceptance-rate cost
+            # only, never a correctness one (verification is always
+            # the target's). Self-draft skips this entirely: the
+            # drafter reads the target pool the chunk above just wrote.
+            dfn = self._get_draft_prefill(W)
+            _, dk, dv = dfn(self._draft_params, toks, self.draft_kv.k,
+                            self.draft_kv.v, table, np.int32(pos),
+                            np.int32(max(0, valid - 1)), np.int32(valid))
+            self.draft_kv = PagedKV(k=dk, v=dv)
         if sampled:
             jax.block_until_ready(logits)
             x.record("prefill", time.perf_counter() - t0, tokens=valid)
@@ -1431,20 +1773,21 @@ class PagedDecodeEngine:
         if sampled:
             jax.block_until_ready(self._tokens)
             t0 = time.perf_counter()
+        n_pool = self._n_pools
         if self._sampling:
-            tokens, k, v, lengths, self._rng = fn(
-                self.params, self._tokens, self.kv.k, self.kv.v,
-                self._tables_dev, self._lengths_dev, self._rng)
+            res = fn(self.params, self._tokens, *self._pools(),
+                     self._tables_dev, self._lengths_dev, self._rng)
+            self._rng = res[-1]
         else:
-            tokens, k, v, lengths = fn(
-                self.params, self._tokens, self.kv.k, self.kv.v,
-                self._tables_dev, self._lengths_dev)
+            res = fn(self.params, self._tokens, *self._pools(),
+                     self._tables_dev, self._lengths_dev)
+        tokens, lengths = res[0], res[1 + n_pool]
         if sampled:
             jax.block_until_ready(tokens)
             x.record("sample" if self._sampling else "step",
                      time.perf_counter() - t0,
                      tokens=len(self._run_order))
-        self.kv = PagedKV(k=k, v=v)
+        self._set_pools(res[1:1 + n_pool])
         self._tokens, self._lengths_dev = tokens, lengths
         # Each pending window remembers ITS composition: joins/leaves
         # between windows then need no drain — the fold-in maps each
@@ -1463,6 +1806,121 @@ class PagedDecodeEngine:
                 sched.retire(seq)
                 self._finishing.append(seq)
                 self._composition_dirty = True
+        if len(self._pending) >= self.host_sync_interval:
+            self._drain()
+
+    def _spec_tick(self) -> None:
+        """The speculative decode tick: one fused dispatch advances
+        every running sequence by 1..spec_k+1 tokens. The committed
+        count is DATA-DEPENDENT and lives on device until the window
+        drains, so all host bookkeeping here is conservative:
+        ``seq.inflight`` grows by the full span per dispatch (the upper
+        bound on device length), capacity/full checks use
+        ``pos + inflight``, and the true counts fold into ``pos`` at
+        ``_drain``. No device syncs on this path (the
+        host-sync-in-step-loop lint rule covers it by name)."""
+        sched = self._sched
+        span = self.spec_k + 1
+        # Cache-full: if the NEXT dispatch could write past max_len for
+        # any sequence (conservatively: its device length may already
+        # be pos + inflight), drain to learn the real positions, then
+        # retire the truly-full. Surviving sequences re-enter with
+        # inflight 0 and exact pos — the dispatched limit vector then
+        # clamps their commits at max_len, which is precisely the
+        # sequential engine's one-token-at-the-edge behavior.
+        if any(s.pos + s.inflight + span > self.max_len
+               for s in sched.running):
+            self._drain()
+            full = [s for s in sched.running if s.pos + 1 > self.max_len]
+            for s in full:
+                sched.retire(s)
+                self._complete(s.req)
+            if full:
+                self._composition_dirty = True
+                self._report_metric()
+            if not sched.running:
+                return
+        # Capacity: every row needs room for a full span past its
+        # conservative device length. ensure_decode_capacity degrades
+        # to a single-token grant under pressure before preempting —
+        # the limit vector turns the shortfall into fewer committed
+        # tokens, not an eviction.
+        # The ensure target caps at max_len: a near-the-edge sequence
+        # (pos + span past max_len but not yet full) must not grow its
+        # table past the width ladder — the limit vector truncates its
+        # commit instead, and the full-check above retires it next tick.
+        needy = [s for s in sched.running
+                 if not s.blocks.ensure(min(s.pos + s.inflight + span,
+                                            self.max_len))]
+        if needy:
+            self._drain()
+            if sched.ensure_decode_capacity(tokens_per_tick=span):
+                self._composition_dirty = True
+                self._report_metric()
+            stuck = [s for s in sched.running
+                     if s.blocks.capacity < s.pos + 1]
+            for s in stuck:
+                while s.blocks.capacity < s.pos + 1:
+                    victim = sched.evict_newest_prefilling()
+                    if victim is None:
+                        break
+                    self._requeue_prefill_victim(victim)
+                    s.blocks.ensure(s.pos + 1)
+                if s.blocks.capacity >= s.pos + 1:
+                    continue
+                sched.retire(s)
+                self._complete(s.req)
+                self._composition_dirty = True
+            if not sched.running:
+                return
+        sig = tuple(len(s.blocks.blocks) for s in self._run_order)
+        if self._composition_dirty:
+            self._recompose()
+        elif sig != self._tables_sig:
+            self._refresh_tables()
+        if not sched.running:
+            return
+        B, W = self._cur_shape
+        self._cow_guard(self._run_order, span=span)
+        # Per-row commit ceiling: what the granted blocks (and max_len)
+        # can hold. Live rows always satisfy limit >= device length + 1
+        # (the capacity pass above guarantees at least one more slot),
+        # so row 0 of the verify chunk — the sequence's own next token
+        # — is never rerouted to the null block. Padded rows get 0:
+        # every write nulls out and their lengths stay frozen.
+        limit = np.zeros((B,), np.int32)
+        for i, s in enumerate(self._run_order):
+            limit[i] = min(self.max_len,
+                           len(s.blocks.blocks) * self.block_size)
+        fn = self._get_spec(B, W)
+        x = self.xprof
+        sampled = x is not None and x.should_sample()
+        if sampled:
+            jax.block_until_ready(self._tokens)
+            t0 = time.perf_counter()
+        n_pool = self._n_pools
+        if self._self_draft:
+            res = fn(self.params, self._tokens, *self._pools(),
+                     self._tables_dev, self._lengths_dev, limit)
+        else:
+            res = fn(self.params, self._draft_params, self._tokens,
+                     *self._pools(), self.draft_kv.k, self.draft_kv.v,
+                     self._tables_dev, self._lengths_dev, limit)
+        out_tokens, tokens, lengths = res[0], res[1], res[2]
+        if sampled:
+            jax.block_until_ready(tokens)
+            x.record("step", time.perf_counter() - t0,
+                     tokens=len(self._run_order))
+        self._set_pools(res[3:3 + n_pool])
+        if not self._self_draft:
+            self.draft_kv = PagedKV(k=res[-2], v=res[-1])
+        self._tokens, self._lengths_dev = tokens, lengths
+        self._pending.append((out_tokens, self._run_order, (B, W)))
+        self.steps += 1
+        for seq in self._run_order:
+            if seq.req.done:
+                continue
+            seq.inflight += span
         if len(self._pending) >= self.host_sync_interval:
             self._drain()
 
@@ -1529,20 +1987,92 @@ class PagedDecodeEngine:
         x = self.xprof
         if x is not None:
             t0 = time.perf_counter()
-        entries = [(np.asarray(t), order) for t, order in self._pending]
+        entries = [(np.asarray(e[0]),) + tuple(e[1:])
+                   for e in self._pending]
         if x is not None:
             x.record("host_transfer", time.perf_counter() - t0)
         self._pending.clear()
         appended = 0
-        for arr, order in entries:
+        spec_seqs: dict = {}   # insertion-ordered dedupe
+        spec_accepted = spec_drafted = 0
+        st = self._spec_stats
+        for entry in entries:
+            if len(entry) == 2:
+                arr, order = entry
+                for i, seq in enumerate(order):
+                    req = seq.req
+                    if req.done or \
+                            len(req.generated) >= req.max_new_tokens:
+                        continue
+                    tok = int(arr[i])
+                    req.generated.append(tok)
+                    seq.last_token = tok
+                    appended += 1
+                continue
+            # Speculative window: [B, k+1] rows, committed tokens
+            # left-packed, −1 past the commit point. Row length IS the
+            # device's data-dependent commit count — fold it into pos
+            # (host truth catches up to device truth here).
+            arr, order, bucket = entry
+            pb = st["per_bucket"].setdefault(
+                bucket, {"accepted_tokens": 0, "draft_tokens": 0,
+                         "committed_tokens": 0, "dispatches": 0,
+                         "rows": 0})
+            pb["dispatches"] += 1
+            st["dispatches"] += 1
             for i, seq in enumerate(order):
                 req = seq.req
-                if req.done or len(req.generated) >= req.max_new_tokens:
+                if req.done:
                     continue
-                tok = int(arr[i])
-                req.generated.append(tok)
-                seq.last_token = tok
-                appended += 1
+                row = arr[i]
+                toks = row[row >= 0]
+                n = int(toks.shape[0])
+                seq.pos += n
+                spec_seqs[id(seq)] = seq
+                spec_accepted += max(0, n - 1)
+                spec_drafted += self.spec_k
+                pb["accepted_tokens"] += max(0, n - 1)
+                pb["draft_tokens"] += self.spec_k
+                pb["committed_tokens"] += n
+                pb["rows"] += 1
+                st["accepted_tokens"] += max(0, n - 1)
+                st["draft_tokens"] += self.spec_k
+                st["committed_tokens"] += n
+                st["rows"] += 1
+                for t in toks:
+                    if len(req.generated) >= req.max_new_tokens:
+                        # Overshoot past max_new: pos already advanced
+                        # (the KV for these tokens is real and
+                        # consistent) but the request is done — the
+                        # sequence retires below, blocks free, excess
+                        # tokens drop.
+                        break
+                    req.generated.append(int(t))
+                    appended += 1
+                seq.n_generated = len(req.generated)
+                if n:
+                    seq.last_token = int(toks[-1])
+        if spec_seqs:
+            if spec_drafted:
+                from grove_tpu.runtime.metrics import GLOBAL_METRICS
+                GLOBAL_METRICS.inc("grove_spec_accepted_tokens",
+                                   float(spec_accepted))
+                GLOBAL_METRICS.inc("grove_spec_draft_tokens",
+                                   float(spec_drafted))
+            retired = False
+            for seq in spec_seqs.values():
+                # Every inflight window for this sequence just folded
+                # (a drain consumes ALL pending entries) — pos is
+                # device-exact again.
+                seq.inflight = 0
+                if not seq.req.done and seq.finished() \
+                        and seq in self._sched.running:
+                    self._sched.retire(seq)
+                    self._complete(seq.req)
+                    self._composition_dirty = True
+                    retired = True
+            if retired:
+                self._report_metric()
         if self.telemetry is not None:
             self.telemetry.add_tokens(appended)
         if self._finishing:
@@ -1563,6 +2093,9 @@ class PagedDecodeEngine:
                 "completed": len(self.completed),
                 "prefix_cache": self._prefix is not None,
                 "cow_copies": self.cow_copies,
+                "kv_quant": self.kv_quant,
+                "spec_decode": self.spec_decode,
+                "spec": self.spec_stats(),
                 "schedule": self._sched.payload()}
 
 

@@ -65,16 +65,46 @@ class PagedKV(NamedTuple):
     Shapes: ``[layers, num_blocks, block_size, n_kv, head_dim]``. The
     pool rides jit boundaries as a plain pytree and is DONATED through
     every decode/prefill dispatch (the engine threads the returned pool
-    forward, exactly like the contiguous cache)."""
+    forward, exactly like the contiguous cache).
+
+    With int8 KV (``GROVE_KV_QUANT=int8``) the payload pools hold int8
+    rows and ``k_scale``/``v_scale`` carry the per-(slot, head)
+    symmetric dequant scales, ``[layers, num_blocks, block_size,
+    n_kv]`` f32 — per-slot because rows are written incrementally (a
+    whole-block scale would need slots the writer hasn't seen). Scales
+    default to None so the bf16 path's pytree — and every executable
+    compiled over it — is untouched when quantization is off."""
 
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
 
     @classmethod
     def create(cls, n_layers: int, num_blocks: int, block_size: int,
-               n_kv: int, head_dim: int, dtype=jnp.bfloat16) -> "PagedKV":
+               n_kv: int, head_dim: int, dtype=jnp.bfloat16,
+               quant: str = "off") -> "PagedKV":
         shape = (n_layers, num_blocks, block_size, n_kv, head_dim)
+        if quant == "int8":
+            sshape = (n_layers, num_blocks, block_size, n_kv)
+            return cls(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+        assert quant == "off", f"unknown KV quant mode {quant!r}"
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def pool_bytes(self) -> int:
+        """Device bytes of the whole pool, scales included."""
+        total = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return int(total)
 
     @property
     def num_blocks(self) -> int:

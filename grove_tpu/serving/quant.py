@@ -11,8 +11,13 @@ operand read so HBM sees only int8).
 ``QTensor`` is a registered pytree: quantized leaves ride ``device_put``,
 ``lax.scan`` over stacked layers (the leading L axis slices q and scale
 together), and jit boundaries like plain arrays. The model consumes them
-through ``llama._w`` (materialize-on-read); norms, rope tables, and the
-KV cache stay bf16 (int8 KV is a separate trade).
+through ``llama._w`` (materialize-on-read); norms and rope tables stay
+bf16. The KV cache has its own int8 mode (``GROVE_KV_QUANT=int8``,
+per-slot-per-head scales — serving/kvcache.py); every byte estimate that
+mentions KV — the bench roofline note above, xprof's
+``decode_hbm_bytes_per_token``, ``grove_hbm_bytes`` — derives from
+``kv_bytes_per_token_per_layer`` below so the numbers cannot drift apart
+when quantization flips on.
 """
 
 from __future__ import annotations
@@ -69,6 +74,27 @@ def quantize_params(params: Any) -> Any:
             return w
         return quantize_tensor(w, axes)
     return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def kv_bytes_per_token_per_layer(cfg: Any, kv_quant: str = "off") -> int:
+    """Bytes one token's K+V occupy in one layer of the serving cache.
+
+    THE shared derivation for every KV byte estimate (bench rows,
+    xprof's HBM roofline, ``grove_hbm_bytes``): int8 KV stores one
+    int8 per element plus one f32 scale per (slot, head) for each of
+    K and V (serving/kvcache.py's scale layout)."""
+    if kv_quant == "int8":
+        return 2 * cfg.n_kv_heads * (cfg.head_dim + 4)
+    assert kv_quant == "off", f"unknown KV quant mode {kv_quant!r}"
+    return 2 * cfg.n_kv_heads * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
+
+
+def kv_block_bytes(cfg: Any, block_size: int, kv_quant: str = "off") -> int:
+    """Device bytes of one K+V block pair across all layers — what one
+    allocator grant costs in HBM (the unit behind kv_headroom and the
+    bench's blocks-per-byte-budget sizing)."""
+    return cfg.n_layers * block_size * kv_bytes_per_token_per_layer(
+        cfg, kv_quant)
 
 
 def params_bytes(params: Any) -> int:

@@ -96,6 +96,13 @@ class PagedSeq:
     prefix_matched: int = 0         # tokens served from the prefix tree
     cow_src: int = -1               # shared block awaiting copy-on-write
     cow_dst: int = -1               # fresh block the copy lands in
+    # Speculative decode: upper bound on tokens dispatched but not yet
+    # drained. The device commits a DATA-DEPENDENT count per spec step
+    # (accepted + bonus ≤ k+1); the host can't know it until the window
+    # drains, so capacity grants use pos + inflight as the conservative
+    # device-length bound. Drains fold the real counts into ``pos`` and
+    # zero this. Always 0 in non-speculative mode.
+    inflight: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -368,22 +375,39 @@ class PagedScheduler:
             np.asarray(victim.req.prompt[:victim.req.prompt_len], np.int32)
         victim.pos = 0
         victim.prefix_matched = 0
+        victim.inflight = 0
         victim.preemptions += 1
         self.preemptions_total += 1
         self.preempted.appendleft(victim)
         return victim
 
-    def ensure_decode_capacity(self) -> list[PagedSeq]:
-        """Grant every running sequence room for one more token,
-        preempting newest-first on shortfall. Returns the victims (the
-        engine re-queues them). A lone un-growable sequence is left to
-        the engine to force-finish — preempting the only occupant
-        would livelock."""
+    def ensure_decode_capacity(self, tokens_per_tick: int = 1
+                               ) -> list[PagedSeq]:
+        """Grant every running sequence room for ``tokens_per_tick``
+        more tokens past its in-flight bound, preempting newest-first
+        on shortfall. Returns the victims (the engine re-queues them).
+        A lone un-growable sequence is left to the engine to
+        force-finish — preempting the only occupant would livelock.
+
+        Speculative ticks pass the full k+1-token span, but a sequence
+        can run degraded on any prefix of it (the spec kernel's per-seq
+        ``limit`` clamps acceptance to backed capacity), so a span
+        shortfall falls back to the +1 grant before it ever preempts —
+        identical eviction pressure to the non-speculative policy.
+
+        Ensure targets cap at the per-sequence block capacity so a
+        near-the-limit sequence never grows its table past the width
+        ladder (the engine's length limit truncates its commit)."""
+        cap = self.max_blocks_per_seq * self.allocator.block_size
         victims: list[PagedSeq] = []
         for seq in list(self.running):
             if seq not in self.running:
                 continue  # already evicted this sweep
-            while not seq.blocks.ensure(seq.pos + 1):
+            while not seq.blocks.ensure(min(seq.pos + seq.inflight
+                                            + tokens_per_tick, cap)):
+                if tokens_per_tick > 1 and seq.blocks.ensure(
+                        min(seq.pos + seq.inflight + 1, cap)):
+                    break  # degraded span: clamp, don't evict
                 v = self.preempt_newest(protect=seq)
                 if v is None:
                     return victims  # engine handles the stuck lone seq

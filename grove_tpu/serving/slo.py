@@ -100,6 +100,11 @@ class EngineTelemetry:
         # None until the engine samples once, or forever when
         # GROVE_PREFIX_CACHE=0).
         self.prefix: dict | None = None
+        # Latest speculative-decoding accounting (engine.spec_stats
+        # shape: acceptance_rate/accepted_per_dispatch/counters; None
+        # until the engine samples once, or forever when
+        # GROVE_SPEC_DECODE=0).
+        self.spec: dict | None = None
 
     # ---- engine-side hooks ----
 
@@ -120,6 +125,14 @@ class EngineTelemetry:
         point-sampled like the gauges; rides the same digest so the
         autoscaler sees reuse alongside latency."""
         self.prefix = stats
+
+    def sample_spec(self, stats: dict) -> None:
+        """Latest speculative-decoding accounting (engine.spec_stats
+        payload: acceptance_rate, accepted_per_dispatch, per-bucket
+        counters) — point-sampled like the gauges; a low acceptance
+        rate in the digest is the signal to shrink spec_k or swap the
+        draft."""
+        self.spec = stats
 
     def add_tokens(self, n: int) -> None:
         """Decoded-token counter, bumped once per drained window (NOT
@@ -191,6 +204,7 @@ class EngineTelemetry:
             "kv_utilization": self.kv_utilization,
             "memory": self.memory,
             "prefix": self.prefix,
+            "spec": self.spec,
             "requests_completed": completed,
             "tokens_total": tokens,
             "ttft_p50_s": self.quantile("ttft_seconds", 0.5),
@@ -239,6 +253,21 @@ def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
              "value": float(pfx.get("cached_blocks", 0)), "agg": "sum"},
             {"metric": "prefix_reclaimed_bytes",
              "value": float(pfx.get("reclaimed_bytes", 0)), "agg": "sum"},
+        ]
+    if s.get("spec"):
+        sp = s["spec"]
+        # Speculation efficiency: rates average across replicas (a
+        # scope-level acceptance ratio), the accepted-token counter
+        # sums.
+        samples += [
+            {"metric": "spec_acceptance_rate",
+             "value": float(sp.get("acceptance_rate", 0.0)),
+             "agg": "avg"},
+            {"metric": "spec_accepted_per_dispatch",
+             "value": float(sp.get("accepted_per_dispatch", 0.0)),
+             "agg": "avg"},
+            {"metric": "spec_accepted_tokens",
+             "value": float(sp.get("accepted_tokens", 0)), "agg": "sum"},
         ]
     return samples + [
         {"metric": "queue_depth", "value": float(s["queue_depth"]),

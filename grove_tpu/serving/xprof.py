@@ -118,19 +118,22 @@ def prefill_flops_per_token(cfg, prompt_len: int) -> float:
 
 
 def decode_hbm_bytes_per_token(cfg, cache_len: int, batch: int,
-                               weight_bytes: float | None = None) -> float:
+                               weight_bytes: float | None = None,
+                               kv_quant: str = "off") -> float:
     """HBM bytes moved per decoded token: full weight read amortized
     over the batch, plus this lane's KV cache read and one-entry write.
     ``cache_len`` is the ALLOCATED cache length — the padded read is
     what the implementation actually moves, regardless of live context.
     ``weight_bytes`` overrides the bf16 weight size (int8 quantization
     halves the read; the roofline must use what actually crosses HBM).
-    """
-    import jax.numpy as jnp
-    itemsize = jnp.dtype(cfg.dtype).itemsize
-    kv_read = (2 * cfg.n_layers * cache_len * cfg.n_kv_heads
-               * cfg.head_dim * itemsize)
-    kv_write = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * itemsize
+    ``kv_quant`` does the same for the cache side: int8 paged KV moves
+    int8 payload plus f32 scales. Both KV terms derive from serving/
+    quant.kv_bytes_per_token_per_layer — the ONE shared derivation, so
+    this roofline and the engine's block-byte gauges cannot drift."""
+    from grove_tpu.serving.quant import kv_bytes_per_token_per_layer
+    per_tok_layer = kv_bytes_per_token_per_layer(cfg, kv_quant)
+    kv_read = cfg.n_layers * cache_len * per_tok_layer
+    kv_write = cfg.n_layers * per_tok_layer
     weights = cfg.params_bytes if weight_bytes is None else weight_bytes
     return weights / batch + kv_read + kv_write
 
@@ -382,7 +385,16 @@ def memory_snapshot(engine) -> dict:
     derived, not measured)."""
     from grove_tpu.serving.quant import params_bytes as live_params_bytes
 
-    kv_bytes = int(engine.cache.k.nbytes + engine.cache.v.nbytes)
+    cache = engine.cache
+    # PagedKV.pool_bytes includes the int8 dequant-scale pools; the
+    # lanes engine's contiguous cache has no such property and falls
+    # back to the raw payload arrays. A speculative engine's draft
+    # pool is real HBM too.
+    kv_bytes = int(getattr(cache, "pool_bytes", None)
+                   or (cache.k.nbytes + cache.v.nbytes))
+    draft = getattr(engine, "draft_kv", None)
+    if draft is not None:
+        kv_bytes += int(draft.k.nbytes + draft.v.nbytes)
     weight_bytes = int(live_params_bytes(engine.params))
     stats, limit, in_use = None, 0, 0
     try:
@@ -443,6 +455,10 @@ class Observatory:
         self._last_memory: dict | None = None
         self._last_memory_ts = 0.0
         self._weight_bytes: int | None = None
+        # Engine-pushed riders (set by the paged engine when the
+        # corresponding feature is on, None otherwise).
+        self.spec: dict | None = None   # engine.spec_stats() shape
+        self.kv_quant: str = "off"      # KV byte basis for the roofline
         register(self)
 
     # -- hooks the engine calls --
@@ -514,7 +530,8 @@ class Observatory:
         flops_tok = decode_flops_per_token(self.cfg, ctx)
         bytes_tok = decode_hbm_bytes_per_token(
             self.cfg, self.max_len or self.cfg.max_seq_len,
-            max(1, self.batch), weight_bytes=self._weight_bytes)
+            max(1, self.batch), weight_bytes=self._weight_bytes,
+            kv_quant=self.kv_quant)
         backend = self.backend()
         return {
             "tokens_per_sec_est": round(tps, 1),
@@ -544,6 +561,8 @@ class Observatory:
             "hottest_phase": hottest,
             "compile": self.compile.payload(),
             "memory": self._last_memory,
+            "kv_quant": self.kv_quant,
+            "spec": self.spec,
             "throughput": self.throughput_estimate(phases),
         }
 
@@ -683,6 +702,33 @@ def render_engine_profile(payload: dict) -> list[str]:
                  if mem.get("limit_bytes") else "")
         out.append(f"  {'total':<11}{_fmt_bytes(mem['total_bytes']):>12}"
                    f"{limit}  kv_headroom {mem['kv_headroom']:.2f}")
+    spec = payload.get("spec")
+    if spec:
+        out.append("")
+        rate = spec.get("acceptance_rate", 0.0)
+        # <50% acceptance means more than half the draft compute is
+        # thrown away — the speculation config IS the bottleneck
+        # (shrink spec_k or improve the draft), so star it the way
+        # the hottest phase is starred.
+        star = "  * LOW ACCEPTANCE — speculation is the bottleneck" \
+            if spec.get("draft_tokens", 0) and rate < 0.5 else ""
+        out.append(f"speculation (k={spec.get('spec_k', '?')}): "
+                   f"acceptance {rate * 100:.1f}%, "
+                   f"{spec.get('accepted_per_dispatch', 0.0):.2f} "
+                   f"tokens/dispatch "
+                   f"({spec.get('accepted_tokens', 0)}/"
+                   f"{spec.get('draft_tokens', 0)} drafts accepted)"
+                   f"{star}")
+        buckets = spec.get("per_bucket") or {}
+        for key in sorted(buckets):
+            b = buckets[key]
+            acc = (b["accepted_tokens"] / b["draft_tokens"]
+                   if b.get("draft_tokens") else 0.0)
+            per = (b["committed_tokens"] / b["rows"]
+                   if b.get("rows") else 0.0)
+            out.append(f"  [{key}] acceptance {acc * 100:.1f}%, "
+                       f"{per:.2f} tok/dispatch over "
+                       f"{b.get('dispatches', 0)} dispatches")
     thr = payload.get("throughput")
     if thr:
         out.append("")

@@ -1,0 +1,408 @@
+"""Speculative decoding + int8 paged KV (PR 17): exactness and byte
+contracts on the paged engine.
+
+Speculative greedy decoding is EXACT by construction — the verify
+chunk's per-position argmax reproduces sequential greedy bitwise, so
+every test here asserts token EQUALITY against the plain engine, not
+similarity: under preemption recompute, with the prefix cache warm,
+truncating at max_len, and stacked on int8 KV. int8 KV is approximate
+by construction, so its contracts are a pinned logit-error bound, a
+byte-halving floor, and token agreement — plus one shared derivation
+(serving/quant.kv_bytes_per_token_per_layer) that the engine, the
+xprof roofline, and the bench all consume.
+
+The lowering-set pins for both switches live in tools/decode_smoke.py;
+throughput bars in tools/bench_decode.py.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import llama
+from grove_tpu.serving.engine import PagedDecodeEngine
+from grove_tpu.serving.kvcache import PagedKV, pad_tables
+
+CFG = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                          max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def drive(eng, want: int, max_iters: int = 3000) -> None:
+    for _ in range(max_iters):
+        eng.admit_from_queue()
+        if len(eng.completed) >= want:
+            break
+        if eng._sched.live:
+            eng.step()
+    eng.sync()
+    assert len(eng.completed) >= want, (len(eng.completed), want)
+
+
+def _prompts(seed: int, n: int = 5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, size=int(k)).astype(np.int32)
+            for k in rng.integers(3, 20, size=n)]
+
+
+def _run(params, prompts, max_new=6, **kw):
+    kw.setdefault("batch", 4)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("host_sync_interval", 4)
+    eng = PagedDecodeEngine(CFG, params, **kw)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=max_new)
+    drive(eng, len(prompts))
+    eng._alloc.check()
+    assert eng._alloc.used_blocks == 0
+    return eng
+
+
+def _tokens_by_rid(eng):
+    return {r.rid: r.generated for r in eng.completed}
+
+
+# ---- speculative decoding: bitwise exactness --------------------------
+
+def test_spec_parity_tiny_draft(params):
+    """A derived tiny draft (random-init: most drafts REJECT) still
+    yields bitwise greedy parity — acceptance only changes how many
+    tokens commit per dispatch, never which tokens."""
+    prompts = _prompts(50)
+    base = _run(params, prompts)
+    spec = _run(params, prompts, spec_decode=True, spec_k=3)
+    assert _tokens_by_rid(spec) == _tokens_by_rid(base)
+    st = spec.spec_stats()
+    assert st["dispatches"] > 0
+    # Unrelated random-init models: near-flat logits still agree
+    # sometimes, but full acceptance every dispatch would mean the
+    # draft isn't actually being consulted.
+    assert st["acceptance_rate"] < 1.0
+
+
+def test_spec_parity_self_draft_full_acceptance(params):
+    """Self-draft (drafter IS the target) must accept every draft:
+    acceptance 1.0, k+1 committed per dispatch, bitwise parity — and
+    no separate draft pool exists (the scan reads the target pool)."""
+    prompts = _prompts(51)
+    base = _run(params, prompts)
+    spec = _run(params, prompts, spec_decode=True, spec_k=3,
+                draft_params="self")
+    assert _tokens_by_rid(spec) == _tokens_by_rid(base)
+    st = spec.spec_stats()
+    assert st["acceptance_rate"] == 1.0, st
+    assert st["accepted_per_dispatch"] == 4.0, st
+    assert spec.draft_kv is None
+    assert spec.steps < base.steps  # the tokens-per-dispatch multiplier
+
+
+def test_spec_parity_under_preemption(params):
+    """A pool tight enough to preempt speculative sequences mid-flight
+    (block-table-only rollback + recompute) still produces the roomy
+    plain engine's tokens for every request."""
+    prompts = _prompts(52, n=8)
+    base = _run(params, prompts, max_new=10, num_blocks=96, batch=8,
+                max_len=40, block_size=4, prefill_chunk=4,
+                host_sync_interval=2)
+    tight = _run(params, prompts, max_new=10, num_blocks=11, batch=6,
+                 max_len=40, block_size=4, prefill_chunk=4,
+                 host_sync_interval=2, spec_decode=True, spec_k=3,
+                 draft_params="self")
+    assert tight._sched.preemptions_total > 0, "pool not tight enough"
+    assert _tokens_by_rid(tight) == _tokens_by_rid(base)
+    for r in tight.completed:
+        assert len(r.generated) == 10
+
+
+def test_spec_parity_with_prefix_cache(params):
+    """Spec + prefix cache: warm full-block hits, a mid-block CoW
+    divergence, and a cold miss — drafts never scatter into shared
+    blocks (the CoW guard runs with the speculative span), tokens
+    stay bitwise."""
+    rng = np.random.default_rng(53)
+    base_p = rng.integers(0, 256, size=19).astype(np.int32)
+    wave = [base_p.copy(),
+            np.concatenate([base_p[:12],
+                            rng.integers(0, 256, size=7).astype(np.int32)]),
+            rng.integers(0, 256, size=7).astype(np.int32),
+            base_p.copy()]
+
+    def run(**kw):
+        eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                                block_size=8, num_blocks=24,
+                                prefill_chunk=8, host_sync_interval=4,
+                                **kw)
+        eng.submit(base_p, max_new_tokens=6)
+        drive(eng, 1)
+        for p in wave:
+            eng.submit(p, max_new_tokens=6)
+        drive(eng, 1 + len(wave))
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    off = run(prefix_cache=False)
+    on = run(prefix_cache=True, spec_decode=True, spec_k=3,
+             draft_params="self")
+    assert _tokens_by_rid(on) == _tokens_by_rid(off)
+    assert on._sched.prefix_tokens_skipped_total > 0
+    assert on.cow_copies >= 2
+
+
+def test_spec_truncation_parity_at_max_len(params):
+    """max_new overshooting max_len: the speculative engine truncates
+    at the cache boundary to exactly the plain engine's token count
+    and tokens (acceptance is clamped so no committed token ever
+    depends on an unbacked KV row)."""
+    rng = np.random.default_rng(54)
+    prompt = rng.integers(0, 256, size=30).astype(np.int32)
+    base = _run(params, [prompt], max_new=64, batch=2, max_len=40)
+    spec = _run(params, [prompt], max_new=64, batch=2, max_len=40,
+                spec_decode=True, spec_k=3, draft_params="self")
+    b, s = base.completed[0], spec.completed[0]
+    assert len(b.generated) == 40 - 30 + 1  # the lanes-room arithmetic
+    assert s.generated == b.generated
+
+
+def test_spec_off_switch_and_env(params, monkeypatch):
+    """GROVE_SPEC_DECODE=0 (or unset, or spec_decode=False) is exactly
+    the prior engine: no spec state, no draft model, empty stats."""
+    monkeypatch.delenv("GROVE_SPEC_DECODE", raising=False)
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert not eng.spec_decode and eng.spec_stats() == {}
+    assert eng._draft_params is None and eng.draft_kv is None
+    monkeypatch.setenv("GROVE_SPEC_DECODE", "1")
+    monkeypatch.setenv("GROVE_SPEC_K", "2")
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert eng.spec_decode and eng.spec_k == 2
+    monkeypatch.setenv("GROVE_SPEC_DECODE", "0")
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert not eng.spec_decode
+
+
+def test_spec_sampling_rejected(params):
+    from grove_tpu.serving.engine import SamplerConfig
+    with pytest.raises(AssertionError, match="greedy-only"):
+        PagedDecodeEngine(CFG, params, batch=2, max_len=48, block_size=8,
+                          spec_decode=True,
+                          sampler=SamplerConfig(temperature=0.8))
+
+
+def test_spec_telemetry_counters_and_profile(params):
+    """Acceptance counters flow to GLOBAL_METRICS, spec_stats,
+    the telemetry digest, the xprof payload, and the engine-profile
+    rendering (which stars <50% acceptance as the bottleneck)."""
+    from grove_tpu.runtime.metrics import GLOBAL_METRICS
+    from grove_tpu.serving.slo import EngineTelemetry
+    from grove_tpu.serving.xprof import render_engine_profile
+
+    c0 = GLOBAL_METRICS.counter_total("grove_spec_accepted_tokens")
+    d0 = GLOBAL_METRICS.counter_total("grove_spec_draft_tokens")
+    eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                            block_size=8, prefill_chunk=8,
+                            host_sync_interval=4, spec_decode=True,
+                            spec_k=3, draft_params="self")
+    tel = EngineTelemetry()
+    eng.telemetry = tel
+    for p in _prompts(55):
+        eng.submit(p, max_new_tokens=6)
+    drive(eng, 5)
+    st = eng.spec_stats()
+    assert st["draft_tokens"] > 0 and st["accepted_tokens"] > 0
+    assert st["per_bucket"], st
+    for bucket, bs in st["per_bucket"].items():
+        assert bs["dispatches"] > 0, bucket
+    assert GLOBAL_METRICS.counter_total("grove_spec_accepted_tokens") \
+        == c0 + st["accepted_tokens"]
+    assert GLOBAL_METRICS.counter_total("grove_spec_draft_tokens") \
+        == d0 + st["draft_tokens"]
+    assert tel.snapshot()["spec"]["acceptance_rate"] == 1.0
+    assert eng.xprof.payload()["spec"]["spec_k"] == 3
+
+    text = "\n".join(render_engine_profile(eng.xprof.payload()))
+    assert "speculation (k=" in text and "acceptance" in text
+    assert "LOW ACCEPTANCE" not in text  # self-draft accepts all
+    low = eng.xprof.payload()
+    low["spec"] = dict(low["spec"], acceptance_rate=0.2,
+                       draft_tokens=100, accepted_tokens=20)
+    text = "\n".join(render_engine_profile(low))
+    assert "LOW ACCEPTANCE" in text
+
+
+# ---- int8 paged KV ----------------------------------------------------
+
+def test_int8_kv_logit_error_bound(params):
+    """Per-slot-per-head int8 K/V with dequant fused into the gather:
+    decode logits off a quantized pool stay within a pinned max-error
+    of the f32 pool's on the same prefilled context (~3x the observed
+    margin — a regression that widens the bound is a real numerics
+    break, not noise)."""
+    from grove_tpu.serving.kvcache import BlockAllocator, SeqBlocks
+    b, s = 2, 12
+    prompts = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(7), (b, s), 0, CFG.vocab_size), np.int32)
+
+    def rollout(quant):
+        n_blocks, bs = 16, 8
+        kv = PagedKV.create(CFG.n_layers, n_blocks, bs, CFG.n_kv_heads,
+                            CFG.head_dim, jnp.float32, quant=quant)
+        alloc = BlockAllocator(num_blocks=n_blocks, block_size=bs)
+        seqs = [SeqBlocks(alloc) for _ in range(b)]
+        for sb in seqs:
+            assert sb.ensure(s + 1)
+        tables = pad_tables([sb.blocks for sb in seqs], 4)
+        sc = dict(k_scale=kv.k_scale, v_scale=kv.v_scale) if kv.quantized \
+            else {}
+        outs = llama.prefill_chunk_paged(
+            CFG, params, jnp.asarray(prompts), kv.k, kv.v, tables,
+            jnp.int32(0), jnp.int32(s - 1), jnp.int32(s), **sc)
+        logits, pools = outs[0], outs[1:]  # logits [b, vocab] at s-1
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        sc = dict(zip(("k_scale", "v_scale"), pools[2:4])) \
+            if kv.quantized else {}
+        outs = llama.decode_step_paged(
+            CFG, params, tok, pools[0], pools[1], tables,
+            jnp.full((b,), s, jnp.int32), **sc)
+        return np.asarray(outs[0], np.float64)
+
+    full, quant = rollout("off"), rollout("int8")
+    err = np.abs(full - quant).max()
+    spread = np.abs(full).max()
+    assert err <= 0.02 * spread + 0.05, (err, spread)
+
+
+def test_int8_kv_pool_bytes_halve():
+    """int8 halves (better) the f32 pool: values drop 4x, the
+    per-slot-per-head f32 scales add back head_dim/4 worth."""
+    f32 = PagedKV.create(2, 32, 8, 4, 64, jnp.float32)
+    q8 = PagedKV.create(2, 32, 8, 4, 64, jnp.float32, quant="int8")
+    assert q8.quantized and q8.k.dtype == jnp.int8
+    assert q8.pool_bytes < 0.5 * f32.pool_bytes, \
+        (q8.pool_bytes, f32.pool_bytes)
+    assert not f32.quantized and f32.k_scale is None
+
+
+def test_int8_engine_token_agreement(params):
+    """GROVE_KV_QUANT=int8 through the full engine: tokens
+    overwhelmingly agree with the f32 engine (random-init logits are
+    nearly flat; real checkpoints agree far higher)."""
+    prompts = _prompts(56)
+    full = _run(params, prompts, max_new=8)
+    q8 = _run(params, prompts, max_new=8, kv_quant="int8")
+    assert q8.kv.quantized
+    a = _tokens_by_rid(full)
+    b = _tokens_by_rid(q8)
+    flat = [int(x == y) for rid in a
+            for x, y in zip(a[rid], b[rid])]
+    assert sum(flat) / len(flat) >= 0.75, sum(flat) / len(flat)
+
+
+def test_int8_env_switch_off(params, monkeypatch):
+    monkeypatch.delenv("GROVE_KV_QUANT", raising=False)
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert not eng.kv.quantized and eng.kv.k_scale is None
+    monkeypatch.setenv("GROVE_KV_QUANT", "int8")
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert eng.kv.quantized
+    monkeypatch.setenv("GROVE_KV_QUANT", "off")
+    eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                            block_size=8)
+    assert not eng.kv.quantized
+
+
+# ---- stacked: spec × int8 × prefix ------------------------------------
+
+def test_spec_int8_bitwise_vs_plain_int8(params):
+    """Speculative exactness is relative to whatever numerics the
+    engine runs: spec+int8 must reproduce plain int8 decoding bitwise
+    (the self-drafter reads the SAME quantized history sequential
+    greedy reads)."""
+    prompts = _prompts(57)
+    q8 = _run(params, prompts, kv_quant="int8")
+    both = _run(params, prompts, kv_quant="int8", spec_decode=True,
+                spec_k=3, draft_params="self")
+    assert _tokens_by_rid(both) == _tokens_by_rid(q8)
+    assert both.spec_stats()["acceptance_rate"] == 1.0
+
+
+def test_spec_int8_prefix_combined_90_10(params):
+    """The full PR-17 stack — spec + int8 KV + prefix cache — on a
+    90/10 shared-prefix workload matches plain int8 decoding bitwise,
+    with real cache hits and real multi-token dispatches."""
+    rng = np.random.default_rng(58)
+    shared = rng.integers(0, 256, size=16).astype(np.int32)
+    prompts = []
+    for i in range(10):
+        if i % 10 == 9:  # the 10% unique-prefix tail
+            prompts.append(rng.integers(0, 256, size=11).astype(np.int32))
+        else:
+            tail = rng.integers(0, 256, size=3 + (i % 4)).astype(np.int32)
+            prompts.append(np.concatenate([shared, tail]))
+
+    def run(**kw):
+        eng = PagedDecodeEngine(CFG, params, batch=4, max_len=48,
+                                block_size=8, num_blocks=40,
+                                prefill_chunk=8, host_sync_interval=4,
+                                kv_quant="int8", **kw)
+        eng.submit(prompts[0], max_new_tokens=6)
+        drive(eng, 1)
+        for p in prompts[1:]:
+            eng.submit(p, max_new_tokens=6)
+        drive(eng, len(prompts))
+        eng._alloc.check()
+        assert eng._alloc.used_blocks == 0
+        return eng
+
+    plain = run()
+    stack = run(prefix_cache=True, spec_decode=True, spec_k=3,
+                draft_params="self")
+    assert _tokens_by_rid(stack) == _tokens_by_rid(plain)
+    assert stack._sched.prefix_tokens_skipped_total > 0
+    assert stack.spec_stats()["committed_tokens"] > 0
+
+
+# ---- the one shared KV-bytes derivation -------------------------------
+
+def test_kv_bytes_single_derivation(params):
+    """Engine block accounting, the xprof roofline, and the bench all
+    read quant.kv_bytes_per_token_per_layer — assert the helper against
+    first principles AND against the pools the engine actually
+    allocated, in both modes."""
+    from grove_tpu.serving.quant import (kv_block_bytes,
+                                         kv_bytes_per_token_per_layer)
+    from grove_tpu.serving.xprof import decode_hbm_bytes_per_token
+
+    per_off = kv_bytes_per_token_per_layer(CFG, "off")
+    per_q8 = kv_bytes_per_token_per_layer(CFG, "int8")
+    assert per_off == 2 * CFG.n_kv_heads * CFG.head_dim * 4  # f32
+    assert per_q8 == 2 * CFG.n_kv_heads * (CFG.head_dim + 4)
+    assert kv_block_bytes(CFG, 8, "int8") == 8 * CFG.n_layers * per_q8
+
+    for quant in ("off", "int8"):
+        eng = PagedDecodeEngine(CFG, params, batch=2, max_len=48,
+                                block_size=8, num_blocks=16,
+                                kv_quant=quant)
+        assert eng._block_bytes == kv_block_bytes(CFG, 8, quant)
+        assert eng.kv.pool_bytes == eng._block_bytes * 16
+    # The roofline reads the same helper: the off/int8 estimate gap is
+    # exactly (cache_len reads + 1 write) of the per-token-layer delta.
+    est_off = decode_hbm_bytes_per_token(CFG, cache_len=32, batch=2)
+    est_q8 = decode_hbm_bytes_per_token(CFG, cache_len=32, batch=2,
+                                        kv_quant="int8")
+    assert est_off - est_q8 == \
+        (32 + 1) * CFG.n_layers * (per_off - per_q8)

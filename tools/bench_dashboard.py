@@ -53,6 +53,12 @@ def render(rows: list[dict]) -> str:
     prefix_rows = [r for r in rows
                    if r.get("metric") in ("prefix_cache_warm_ttft_vs_cold",
                                           "decode_tokens_per_sec_prefix_vs_off")]
+    spec_rows = [r for r in rows
+                 if r.get("metric") in ("decode_tokens_per_sec_spec_vs_off",
+                                        "decode_tokens_per_sec_specoff_vs_base",
+                                        "decode_accepted_tokens_per_dispatch")]
+    kv_rows = [r for r in rows
+               if r.get("metric") == "decode_kv_bytes_per_token"]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
     reclaim = [r for r in rows
@@ -324,6 +330,50 @@ def render(rows: list[dict]) -> str:
                 f"| {f'{hr:.2f}' if hr is not None else '-'} "
                 f"| {r.get('cow_copies', '-')} "
                 f"| {r.get('steady_compiles', '-')} |")
+        out.append("")
+    if spec_rows:
+        out += ["## Speculative decoding (fused draft+verify dispatch)",
+                "",
+                "_spec_vs_off: spec-on over spec-off paged tokens/sec "
+                "(bar ≥ 1.5x; self-draft, so acceptance is 1.0 and the "
+                "row is the dispatch-amortization ceiling); "
+                "specoff_vs_base: the plumbing must cost nothing when "
+                "off (bar ≥ the no-regression floor); accepted/dispatch "
+                "comes from the engine's own acceptance counters — "
+                "docs/design/speculative-decoding.md_", "",
+                "| when | git | row | value | k | acceptance | "
+                "on tok/s | off tok/s | steady compiles |",
+                "|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(spec_rows, key=lambda r: (r.get("ts", ""),
+                                                  r.get("metric", ""))):
+            name = r.get("metric", "?").replace(
+                "decode_tokens_per_sec_", "").replace("decode_", "")
+            acc = r.get("acceptance_rate")
+            unit = "x" if r.get("unit") == "x" else " tok"
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {name} | {r.get('value', 0):.2f}{unit} "
+                f"| {r.get('spec_k', '?')} "
+                f"| {f'{acc:.2f}' if acc is not None else '-'} "
+                f"| {r.get('on_tok_s', '-')} | {r.get('off_tok_s', '-')} "
+                f"| {r.get('steady_compiles', '-')} |")
+        out.append("")
+    if kv_rows:
+        out += ["## KV bytes per token (int8 paged KV)", "",
+                "_one token's K+V across layers from the shared "
+                "``quant.kv_bytes_per_token_per_layer`` derivation, "
+                "cross-checked against the live engine's allocated "
+                "pools — int8 stores the values in one byte plus a "
+                "per-slot-per-head f32 scale_", "",
+                "| when | git | quant | B/token | B/token off | ratio | "
+                "layers |", "|---|---|---|---|---|---|---|"]
+        for r in sorted(kv_rows, key=lambda r: r.get("ts", "")):
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('kv_quant', '?')} | {r.get('value', 0):.0f} "
+                f"| {r.get('bytes_per_token_off', 0):.0f} "
+                f"| {r.get('ratio_vs_off', 0):.2f}x "
+                f"| {r.get('layers', '?')} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

@@ -37,6 +37,24 @@ the dashboard's "Prefix cache" section):
   (default 0.9 — the honest no-regression bar under this box's CPU
   noise; the expected value is ~1.0).
 
+Four speculative-decoding / int8-KV rows ride along (PR 17;
+docs/design/speculative-decoding.md, the dashboard's "Speculative
+decoding" section):
+
+- ``decode_tokens_per_sec_spec_vs_off`` — spec-on (self-draft, so
+  acceptance is 1.0 and the row measures the dispatch-amortization
+  ceiling) over spec-off paged throughput on the decode-heavy
+  workload. Gate: ≥ ``GROVE_BENCH_SPEC_MIN`` (default 1.5).
+- ``decode_tokens_per_sec_specoff_vs_base`` — spec_decode=False over
+  the default engine: the speculation plumbing must cost NOTHING when
+  off. Gate: ≥ ``GROVE_BENCH_SPEC_OFF_MIN`` (default 0.9).
+- ``decode_accepted_tokens_per_dispatch`` — committed tokens per
+  fused dispatch from the engine's own acceptance counters.
+- ``decode_kv_bytes_per_token`` — bytes one token's K+V costs across
+  layers under GROVE_KV_QUANT=int8, from the shared
+  ``quant.kv_bytes_per_token_per_layer`` derivation and
+  cross-checked against the live engine's pool bytes.
+
     python tools/bench_decode.py                 # append history rows
     python tools/bench_decode.py --no-history    # dev run
 """
@@ -57,6 +75,8 @@ from tools.loadgen import ArrivalSchedule, LoadProfile, run_load  # noqa: E402
 MIN_RATIO = float(os.environ.get("GROVE_BENCH_DECODE_MIN", 2.0))
 PREFIX_TTFT_MAX = float(os.environ.get("GROVE_BENCH_PREFIX_TTFT_MAX", 0.25))
 PREFIX_MIN = float(os.environ.get("GROVE_BENCH_PREFIX_MIN", 0.9))
+SPEC_MIN = float(os.environ.get("GROVE_BENCH_SPEC_MIN", 1.5))
+SPEC_OFF_MIN = float(os.environ.get("GROVE_BENCH_SPEC_OFF_MIN", 0.9))
 
 # One KV token budget, two spending policies. max_len is the per-seq
 # worst case both engines must honor (prompt tail up to 48 + 16 new);
@@ -93,9 +113,11 @@ def build_engines():
 
 
 def build_paged(prefix_cache: bool, num_blocks: int | None = None,
-                prefill_chunk: int = 8):
+                prefill_chunk: int = 8, **kw):
     """One paged engine with the cache explicitly on or off (the
-    prefix rows compare paged-vs-paged, not paged-vs-lanes)."""
+    prefix rows compare paged-vs-paged, not paged-vs-lanes); extra
+    kwargs (spec_decode, kv_quant, ...) pass through so the PR-17 rows
+    can flip ONE switch against an otherwise identical geometry."""
     import jax
     import jax.numpy as jnp
 
@@ -110,7 +132,7 @@ def build_paged(prefix_cache: bool, num_blocks: int | None = None,
         block_size=BLOCK_SIZE,
         num_blocks=num_blocks or KV_BUDGET_TOKENS // BLOCK_SIZE + 1,
         prefill_chunk=prefill_chunk, host_sync_interval=4,
-        prefix_cache=prefix_cache)
+        prefix_cache=prefix_cache, **kw)
 
 
 def bench(duration: float, rate: float, seed: int, reps: int) -> dict:
@@ -310,6 +332,135 @@ def bench_prefix_off(duration: float, rate: float, seed: int,
     }
 
 
+def bench_spec(duration: float, rate: float, seed: int,
+               reps: int) -> list[dict]:
+    """Speculative decoding vs plain decode, paged-vs-paged.
+
+    Self-draft (the drafter IS the target) pins acceptance at 1.0, so
+    the spec_vs_off ratio isolates what speculation actually buys on
+    this engine: k+1 committed tokens per fused dispatch instead of
+    one, with the host tick/dispatch overhead amortized k+1-fold. A
+    real small drafter scales the win by its acceptance rate — the
+    telemetry row carries the counters that predict it. Three engines
+    alternate inside each rep (base, spec-off, spec-on) so all three
+    see the same CPU weather; medians win as everywhere else."""
+    base = build_paged(False)
+    off = build_paged(False, spec_decode=False)
+    # k=3: 4 committed tokens per fused dispatch and max_new=32 drains
+    # in exactly 8 — deeper k misaligns with max_new (overshoot tokens
+    # are clipped at drain) and measured no better here.
+    on = build_paged(False, spec_decode=True, spec_k=3,
+                     draft_params="self")
+    # Decode-heavy shape: short prompts, long generations — the regime
+    # speculation targets (prefill-bound traffic wouldn't move).
+    profile = LoadProfile(duration_s=duration, base_rate=rate,
+                          ramp_factor=1.0, min_prompt=4, max_prompt=8,
+                          max_new_tokens=32)
+    warm_prof = dataclasses.replace(profile, duration_s=0.5, base_rate=40)
+    for eng in (base, off, on):
+        eng.warmup()
+        run_load(eng, None, ArrivalSchedule.build(warm_prof, seed=seed + 100),
+                 drain_s=30.0)
+    compiles_before = sum(on.xprof.compile.counts().values()) \
+        if on.xprof else 0
+    ratios, off_ratios, on_tps, off_tps = [], [], [], []
+    for rep in range(reps):
+        bs = run_load(base, None,
+                      ArrivalSchedule.build(profile, seed=seed + rep),
+                      drain_s=60.0)
+        os_ = run_load(off, None,
+                       ArrivalSchedule.build(profile, seed=seed + rep),
+                       drain_s=60.0)
+        ns = run_load(on, None,
+                      ArrivalSchedule.build(profile, seed=seed + rep),
+                      drain_s=60.0)
+        ratios.append(ns.tokens_per_sec / os_.tokens_per_sec
+                      if os_.tokens_per_sec > 0 else 0.0)
+        off_ratios.append(os_.tokens_per_sec / bs.tokens_per_sec
+                          if bs.tokens_per_sec > 0 else 0.0)
+        on_tps.append(ns.tokens_per_sec)
+        off_tps.append(os_.tokens_per_sec)
+    compiles_after = sum(on.xprof.compile.counts().values()) \
+        if on.xprof else 0
+    sp = on.spec_stats()
+    import jax
+    common = {
+        "unit": "x",
+        "mode": "serving-cpu",
+        "backend_mode": jax.devices()[0].platform,
+        "rate": rate,
+        "duration_s": duration,
+        "reps": reps,
+        "spec_k": sp["spec_k"],
+    }
+    spec_row = dict(common, **{
+        "metric": "decode_tokens_per_sec_spec_vs_off",
+        "value": round(statistics.median(ratios), 3),
+        "ratios": [round(r, 3) for r in ratios],
+        "on_tok_s": round(statistics.median(on_tps), 1),
+        "off_tok_s": round(statistics.median(off_tps), 1),
+        "acceptance_rate": round(sp["acceptance_rate"], 3),
+        "accepted_per_dispatch": round(sp["accepted_per_dispatch"], 3),
+        "steady_compiles": compiles_after - compiles_before,
+        "recompiles": on.xprof.compile.recompile_count() if on.xprof else 0,
+        "min_ratio": SPEC_MIN,
+    })
+    off_row = dict(common, **{
+        "metric": "decode_tokens_per_sec_specoff_vs_base",
+        "value": round(statistics.median(off_ratios), 3),
+        "ratios": [round(r, 3) for r in off_ratios],
+        "min_ratio": SPEC_OFF_MIN,
+    })
+    accept_row = dict(common, **{
+        "metric": "decode_accepted_tokens_per_dispatch",
+        "value": round(sp["accepted_per_dispatch"], 3),
+        "unit": "tok/dispatch",
+        "acceptance_rate": round(sp["acceptance_rate"], 3),
+        "draft_tokens": sp["draft_tokens"],
+        "accepted_tokens": sp["accepted_tokens"],
+        "dispatches": sp["dispatches"],
+    })
+    return [spec_row, off_row, accept_row]
+
+
+def bench_kv_bytes(seed: int) -> dict:
+    """The int8-KV bytes row: what one token's K+V costs across layers
+    under GROVE_KV_QUANT=int8, from the ONE shared derivation
+    (grove_tpu.serving.quant) every consumer uses — and cross-checked
+    against the live pools a quantized engine actually allocated, so
+    the row can't drift from the engine."""
+    from grove_tpu.serving.quant import (kv_block_bytes,
+                                         kv_bytes_per_token_per_layer)
+
+    q8 = build_paged(False, kv_quant="int8")
+    f32 = build_paged(False)
+    cfg = q8.cfg
+    bytes_q8 = kv_bytes_per_token_per_layer(cfg, "int8") * cfg.n_layers
+    bytes_off = kv_bytes_per_token_per_layer(cfg, "off") * cfg.n_layers
+    # The derivation must match the allocator's reality block-for-block.
+    n_blocks = q8.kv.k.shape[1]
+    assert q8.kv.pool_bytes == \
+        kv_block_bytes(cfg, BLOCK_SIZE, "int8") * n_blocks, \
+        (q8.kv.pool_bytes, kv_block_bytes(cfg, BLOCK_SIZE, "int8"))
+    assert f32.kv.pool_bytes == \
+        kv_block_bytes(cfg, BLOCK_SIZE, "off") * n_blocks
+    import jax
+    return {
+        "metric": "decode_kv_bytes_per_token",
+        "value": bytes_q8,
+        "unit": "B",
+        "mode": "serving-cpu",
+        "backend_mode": jax.devices()[0].platform,
+        "kv_quant": "int8",
+        "bytes_per_token_off": bytes_off,
+        "ratio_vs_off": round(bytes_q8 / bytes_off, 3),
+        "pool_bytes_int8": q8.kv.pool_bytes,
+        "pool_bytes_off": f32.kv.pool_bytes,
+        "layers": cfg.n_layers,
+        "seed": seed,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--duration", type=float, default=3.0,
@@ -363,6 +514,26 @@ def main(argv=None) -> int:
           f"{off_row['value']:.2f}x of {off_row['ratios']}")
     append_history(off_row)
 
+    spec_row, specoff_row, accept_row = bench_spec(
+        args.duration, args.rate, args.seed, max(1, args.reps - 2))
+    print(f"spec:   on {spec_row['on_tok_s']:.1f} tok/s vs "
+          f"off {spec_row['off_tok_s']:.1f} tok/s = "
+          f"{spec_row['value']:.2f}x of {spec_row['ratios']} "
+          f"(k={spec_row['spec_k']}, acceptance "
+          f"{spec_row['acceptance_rate']:.2f}, "
+          f"{spec_row['accepted_per_dispatch']:.2f} tok/dispatch, "
+          f"{spec_row['steady_compiles']} steady-state compiles); "
+          f"spec-off vs base {specoff_row['value']:.2f}x")
+    append_history(spec_row)
+    append_history(specoff_row)
+    append_history(accept_row)
+    kv_row = bench_kv_bytes(args.seed)
+    print(f"kv:     {kv_row['value']} B/token int8 vs "
+          f"{kv_row['bytes_per_token_off']} B/token off = "
+          f"{kv_row['ratio_vs_off']:.2f}x across {kv_row['layers']} "
+          "layers (pool bytes cross-checked)")
+    append_history(kv_row)
+
     if row["steady_compiles"] or row["recompiles"] \
             or off_row["steady_compiles"] or off_row["recompiles"]:
         print("FAIL: the paged engine compiled during the measured "
@@ -380,6 +551,20 @@ def main(argv=None) -> int:
     if off_row["value"] < PREFIX_MIN:
         print(f"FAIL: cache-on/off all-cold ratio {off_row['value']:.2f}x "
               f"is under the {PREFIX_MIN:.2f}x bar", file=sys.stderr)
+        return 1
+    if spec_row["steady_compiles"] or spec_row["recompiles"]:
+        print("FAIL: the speculative engine compiled during the "
+              "measured window — the spec ladder leaked a shape",
+              file=sys.stderr)
+        return 1
+    if spec_row["value"] < SPEC_MIN:
+        print(f"FAIL: spec-on/off ratio {spec_row['value']:.2f}x is "
+              f"under the {SPEC_MIN:.1f}x bar", file=sys.stderr)
+        return 1
+    if specoff_row["value"] < SPEC_OFF_MIN:
+        print(f"FAIL: spec-off/base ratio {specoff_row['value']:.2f}x "
+              f"is under the {SPEC_OFF_MIN:.2f}x no-regression bar",
+              file=sys.stderr)
         return 1
     print("bench-decode OK")
     return 0

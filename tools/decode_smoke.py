@@ -30,6 +30,23 @@ modes must show zero steady-state growth, and the cache-on engine's
 tokens must match cache-off and lanes bitwise even on the second pass,
 where every re-submitted prompt admits through warm tree hits.
 
+Two more pinned runs cover the PR 17 multipliers
+(docs/design/speculative-decoding.md):
+
+- **Speculative decoding** (GROVE_SPEC_DECODE, self-draft): decode
+  dispatches come ONLY from the fused ``paged_spec[b,w,k]``
+  executables — no plain ``paged_step`` may appear, no draft programs
+  either (self-draft shares the target pool) — and tokens must match
+  the non-speculative run bitwise (greedy acceptance is exact, not
+  approximate).
+- **int8 paged KV** (GROVE_KV_QUANT=int8): the SAME bucket set with
+  ``_q8``-suffixed names — quantization swaps every executable's body,
+  never its shape discipline.
+
+Both ride the same zero-steady-state-recompile assertion, and the
+default engine's pins above stay untouched: either switch off restores
+the exact prior lowering set.
+
     python tools/decode_smoke.py
 """
 
@@ -68,6 +85,26 @@ EXPECTED_LOWERINGS = {
 # block copy, compiled once at engine construction (before traffic).
 # Prefix matching itself is host-side: no other executable may appear.
 EXPECTED_WITH_PREFIX = dict(EXPECTED_LOWERINGS, **{"paged_cow_copy": 1})
+
+# Speculative decoding (spec_k=3, self-draft): every decode dispatch is
+# the fused draft+verify executable — plain paged_step MUST NOT appear,
+# and self-draft builds NO draft_prefill/draft-pool programs (the
+# drafter reads the target pool). The bucket set differs from the plain
+# engine's because spec commits up to k+1 tokens per dispatch: the
+# composition crosses fewer decode shapes.
+EXPECTED_SPEC = {
+    "paged_prefill[c8,w1]": 1,
+    "paged_prefill[c8,w2]": 1,
+    "paged_prefill[c8,w4]": 1,
+    "paged_spec[b1,w2,k3]": 1,
+    "paged_spec[b1,w4,k3]": 1,
+    "paged_spec[b2,w4,k3]": 1,
+}
+
+# int8 KV: the IDENTICAL bucket set with _q8-suffixed names —
+# quantization changes executable bodies, never the shape ladder.
+EXPECTED_QUANT = {name.replace("[", "_q8["): 1
+                  for name in EXPECTED_LOWERINGS}
 
 
 def main(argv=None) -> int:
@@ -165,6 +202,35 @@ def main(argv=None) -> int:
             f"prefix-cache token divergence rid={r.rid}: "
             f"{r.generated} vs {off_by_rid[r.rid]}")
 
+    # ---- speculative decoding: fused-dispatch pin + bitwise parity --
+    eng_spec = PagedDecodeEngine(cfg, params, batch=4, max_len=48,
+                                 block_size=8, prefill_chunk=8,
+                                 host_sync_interval=4,
+                                 prefix_cache=False, spec_decode=True,
+                                 spec_k=3, draft_params="self")
+    exercise(eng_spec, EXPECTED_SPEC)
+    assert not any(n.startswith("paged_step") for n in
+                   eng_spec.xprof.compile.counts()), \
+        "spec engine dispatched a plain decode step"
+    sp = eng_spec.spec_stats()
+    # Self-draft: the drafter IS the target, so every draft must agree
+    # — acceptance below 1.0 here means the draft pool's KV history
+    # diverged from the target's (the bug class this pin exists for).
+    assert sp["acceptance_rate"] == 1.0, sp
+    assert sp["accepted_per_dispatch"] == 4.0, sp
+    for r in eng_spec.completed:
+        assert r.generated == off_by_rid[r.rid], (
+            f"speculative token divergence rid={r.rid}: "
+            f"{r.generated} vs {off_by_rid[r.rid]}")
+
+    # ---- int8 paged KV: same ladder, _q8 bodies ---------------------
+    eng_q8 = PagedDecodeEngine(cfg, params, batch=4, max_len=48,
+                               block_size=8, prefill_chunk=8,
+                               host_sync_interval=4,
+                               prefix_cache=False, kv_quant="int8")
+    exercise(eng_q8, EXPECTED_QUANT)
+    assert eng_q8.kv.quantized and eng_q8.kv.k.dtype == jnp.int8
+
     # ---- parity vs the seed lanes engine (greedy, same params) ----
     lanes = DecodeEngine(cfg, params, batch=len(prompts), max_len=48)
     pad = max(PROMPT_LENS)
@@ -189,11 +255,16 @@ def main(argv=None) -> int:
 
     print(f"decode smoke OK: {len(eng.completed)} mixed-length requests "
           f"({sorted(PROMPT_LENS)} prompt lens) through the paged "
-          f"engine twice (prefix cache off+on); "
-          f"{len(EXPECTED_LOWERINGS)}+1 pinned lowerings, 0 "
-          "steady-state recompiles, token parity vs lanes and vs "
-          f"cache-off, {skipped} prefix tokens skipped, allocator "
-          f"clean ({eng._alloc.payload()['allocs_total']} allocs, "
+          f"engine four ways (prefix cache off+on, speculative "
+          f"self-draft, int8 KV); "
+          f"{len(EXPECTED_LOWERINGS)}+1+{len(EXPECTED_SPEC)}"
+          f"+{len(EXPECTED_QUANT)} pinned lowerings, 0 "
+          "steady-state recompiles, token parity vs lanes / cache-off "
+          f"/ spec, {skipped} prefix tokens skipped, spec acceptance "
+          f"{sp['acceptance_rate']:.2f} "
+          f"({sp['accepted_per_dispatch']:.1f} tok/dispatch), "
+          f"allocator clean "
+          f"({eng._alloc.payload()['allocs_total']} allocs, "
           f"{eng._sched.preemptions_total} preemptions)")
     return 0
 
