@@ -175,6 +175,12 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# steady-state recompiles, token parity vs the lanes engine,
 	@# allocator hygiene (docs/design/continuous-batching.md).
 	$(PY) tools/decode_smoke.py
+	@# disagg smoke: the same workload through the GROVE_DISAGG
+	@# prefill->decode pair — split pinned lowering sets (prefill-only
+	@# tier + steps-and-handoff tier), ZERO steady-state recompiles on
+	@# both, bitwise token parity vs the mono engine
+	@# (docs/design/disaggregated-serving.md).
+	$(PY) tools/decode_smoke.py --disagg
 	@# defrag smoke: one fragmented 2-slice fleet -> migration plan ->
 	@# hold/drain/rebind -> the stuck gang schedules, the Fragmented
 	@# gauge drops, holds release (docs/design/defrag.md).

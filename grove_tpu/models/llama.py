@@ -561,6 +561,43 @@ def _paged_gather_q(cache_blocks: jnp.ndarray, scales: jnp.ndarray,
     return (vals.astype(jnp.float32) * s[..., None]).astype(dtype)
 
 
+def paged_block_copy(dst_k: jnp.ndarray, dst_v: jnp.ndarray,
+                     src_k: jnp.ndarray, src_v: jnp.ndarray,
+                     src: jnp.ndarray, dst: jnp.ndarray
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Copy a payload's K/V block rows from a SOURCE pool into a
+    DESTINATION pool — the disaggregated handoff primitive (a block-id
+    remap plus this copy; docs/design/disaggregated-serving.md).
+    ``src``/``dst`` are traced int32[W] id vectors with W fixed at the
+    engine's max table width, padded with the NULL block: ONE
+    shape-static executable moves a whole payload in one dispatch (a
+    per-block scalar variant cost a dispatch per cold block — the
+    dominant handoff overhead on short suffixes). Pad pairs write the
+    source's null-block garbage over the destination's null block,
+    which holds garbage by design; duplicate null scatter indices all
+    carry that same row, so the scatter stays deterministic where it
+    matters. Pools may differ in block count; block geometry must
+    match."""
+    return (dst_k.at[:, dst].set(src_k[:, src]),
+            dst_v.at[:, dst].set(src_v[:, src]))
+
+
+def paged_block_copy_q(dst_k: jnp.ndarray, dst_v: jnp.ndarray,
+                       dst_ks: jnp.ndarray, dst_vs: jnp.ndarray,
+                       src_k: jnp.ndarray, src_v: jnp.ndarray,
+                       src_ks: jnp.ndarray, src_vs: jnp.ndarray,
+                       src: jnp.ndarray, dst: jnp.ndarray
+                       ) -> tuple[jnp.ndarray, ...]:
+    """int8-KV variant of ``paged_block_copy`` (same null-padded id
+    vectors): quantized payload rows AND their per-slot dequant scales
+    move together, as-is — the handoff never requantizes (an int8
+    block without its scale row dequantizes to garbage)."""
+    return (dst_k.at[:, dst].set(src_k[:, src]),
+            dst_v.at[:, dst].set(src_v[:, src]),
+            dst_ks.at[:, dst].set(src_ks[:, src]),
+            dst_vs.at[:, dst].set(src_vs[:, src]))
+
+
 def decode_step_paged(cfg: LlamaConfig, params: Params, tokens: jnp.ndarray,
                       kv_k: jnp.ndarray, kv_v: jnp.ndarray,
                       tables: jnp.ndarray, lengths: jnp.ndarray,

@@ -202,6 +202,21 @@ def paged_prefill_shardings(mesh: Mesh, params: Any,
     return (ps, rep) + pool + (rep, rep, rep, rep), (rep,) + pool
 
 
+def paged_handoff_shardings(mesh: Mesh, quant: bool = False) -> tuple:
+    """(in_shardings, out_shardings) for the disaggregated block
+    handoff copy (``models/llama.paged_block_copy``): (dst pools...,
+    src pools..., src_id, dst_id) → (dst pools...). Both pools carry
+    the kv-heads-over-tp pspec, so on a sharded mesh the copy is a
+    local per-shard move — each chip copies its own head slice, no
+    collective (the block axis is never a parallel axis). The source
+    pool is NOT donated: the producer keeps serving from it."""
+    kv = paged_kv_sharding(mesh)
+    rep = replicated(mesh)
+    pool = (kv, kv, paged_scale_sharding(mesh),
+            paged_scale_sharding(mesh)) if quant else (kv, kv)
+    return pool + pool + (rep, rep), pool
+
+
 def paged_spec_shardings(mesh: Mesh, params: Any, dparams: Any,
                          quant: bool = False,
                          self_draft: bool = False) -> tuple:

@@ -677,3 +677,24 @@ GLOBAL_METRICS.describe_histogram(
     "replica (promotion wall time: fence + state load + controller "
     "warm start), observed once per promotion",
     buckets=LIFECYCLE_BUCKETS)
+# Disaggregated prefill→decode serving (serving/handoff.py,
+# docs/design/disaggregated-serving.md): the KV block handoff seam,
+# counted on the ADOPTING (decode) side — one bump per adopted
+# request. GROVE_DISAGG=0 leaves these at zero.
+GLOBAL_METRICS.describe(
+    "grove_handoff_blocks_total",
+    "KV blocks physically transferred prefill→decode (cold blocks "
+    "only — decode-side prefix-cache hits ride shared refs and never "
+    "move; a high shared:cold ratio is the cache doing the handoff's "
+    "work)")
+GLOBAL_METRICS.describe(
+    "grove_handoff_bytes_total",
+    "Bytes the transferred blocks represent (K + V + int8 scales "
+    "when quantized — the live pool's per-block nbytes, the figure "
+    "the decode bench cross-checks)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_handoff_seconds",
+    "Per-request handoff adoption wall time (every cold block's pool "
+    "copy, synced end-to-end), observed on xprof-sampled adoptions "
+    "only — the transfer seam's latency distribution",
+    buckets=DEVICE_STEP_BUCKETS)

@@ -30,9 +30,10 @@ import numpy as np
 from grove_tpu.models import llama
 from grove_tpu.models.llama import LlamaConfig
 from grove_tpu.ops.kvcache import KVCache
+from grove_tpu.serving.handoff import HandoffPayload
 from grove_tpu.serving.kvcache import (NULL_BLOCK, PagedKV, BlockAllocator,
-                                       PrefixTree, pad_tables)
-from grove_tpu.serving.schedule import PagedScheduler, pick_bucket
+                                       PrefixTree, SeqBlocks, pad_tables)
+from grove_tpu.serving.schedule import PagedScheduler, PagedSeq, pick_bucket
 
 
 @dataclasses.dataclass(frozen=True)
@@ -836,6 +837,15 @@ class PagedDecodeEngine:
         self._block_bytes = kv_block_bytes(cfg, block_size, kv_quant)
         self.cow_copies = 0
         self._cow_jit = None
+        # Disaggregated handoff state (GROVE_DISAGG): the cross-pool
+        # copy executable builds lazily on first adoption (or at the
+        # facade's warmup), so mono engines never construct it and the
+        # mono lowering pin stays byte-identical. Stats accumulate on
+        # the CONSUMER side — the adopt() call is where bytes move.
+        self._handoff_jit = None
+        self.handoff_stats = {"requests": 0, "blocks": 0,
+                              "shared_blocks": 0, "bytes": 0,
+                              "seconds": 0.0, "deferred": 0}
         self._sched = PagedScheduler(self._alloc, batch,
                                      self.max_blocks_per_seq,
                                      self.prefill_chunk,
@@ -1344,10 +1354,14 @@ class PagedDecodeEngine:
                 self.telemetry.sample_prefix(self.prefix_stats())
             if self.spec_decode:
                 self.telemetry.sample_spec(self.spec_stats())
+            if self.handoff_stats["requests"]:
+                self.telemetry.sample_handoff(self.handoff_view())
         if self.xprof is not None:
             self.xprof.observe_memory(self, self.telemetry)
             if self.spec_decode:
                 self.xprof.spec = self.spec_stats()
+            if self.handoff_stats["requests"]:
+                self.xprof.handoff = self.handoff_view()
 
     def prefix_stats(self) -> dict:
         """Prefix-cache gauges for the slo digest (hit-rate,
@@ -1394,6 +1408,25 @@ class PagedDecodeEngine:
                     f"b{B},w{W}": dict(v)
                     for (B, W), v in st["per_bucket"].items()}}
 
+    def handoff_view(self) -> dict:
+        """Block-handoff accounting for the slo digest and /debug/xprof
+        (GROVE_DISAGG consumer-side riders). ``ms_per_request`` is the
+        mean host wall one adoption's copy dispatches cost;
+        ``bytes_per_request`` the mean bytes a request's cold suffix
+        moved (shared prefix blocks never move — they are the
+        ``shared_blocks`` count)."""
+        st = self.handoff_stats
+        n = st["requests"]
+        return {"requests": n,
+                "blocks": st["blocks"],
+                "shared_blocks": st["shared_blocks"],
+                "bytes": st["bytes"],
+                "deferred": st["deferred"],
+                "seconds": st["seconds"],
+                "ms_per_request": st["seconds"] * 1e3 / n if n else 0.0,
+                "bytes_per_request": st["bytes"] / n if n else 0.0,
+                "block_bytes": self._block_bytes}
+
     def _stamp_admit(self, req: Request, now: float,
                      admit: float | None = None) -> None:
         _stamp_admit_impl(req, now, admit, self._ttft_compat,
@@ -1401,6 +1434,132 @@ class PagedDecodeEngine:
 
     def _complete(self, req: Request) -> None:
         _complete_impl(req, self.completed, self.telemetry)
+
+    # ---- disaggregated handoff (the consumer side) ----
+
+    def _get_handoff(self):
+        """The one cross-pool block-copy executable (serving/handoff.py
+        protocol): traced null-padded src/dst id VECTORS at the fixed
+        max table width → ONE shape-static program moving a whole
+        payload per dispatch, ``paged_handoff_copy`` in the compile
+        tracker. Built lazily on first adoption (or the disagg
+        facade's warmup) so mono engines never carry it. Only the
+        DESTINATION pools are donated — the producer keeps serving
+        from the source pool."""
+        if self._handoff_jit is None:
+            from grove_tpu.parallel import sharding as shardlib
+            quant = self.kv.quantized
+            ins, outs = shardlib.paged_handoff_shardings(self.mesh,
+                                                         quant=quant)
+            if quant:
+                fn = jax.jit(llama.paged_block_copy_q,
+                             donate_argnums=(0, 1, 2, 3),
+                             in_shardings=ins, out_shardings=outs)
+            else:
+                fn = jax.jit(llama.paged_block_copy,
+                             donate_argnums=(0, 1),
+                             in_shardings=ins, out_shardings=outs)
+            self._handoff_jit = self._wrap("paged_handoff_copy", fn)
+        return self._handoff_jit
+
+    def warmup_handoff(self, source) -> int:
+        """Pre-build the handoff copy against ``source``'s pool with a
+        null→null copy (the CoW prebuild recipe): the executable is
+        paid before traffic, so decode_smoke's pin counts it at warmup,
+        never mid-stream. Returns executables built (0 or 1)."""
+        built = int(self._handoff_jit is None)
+        fn = self._get_handoff()
+        pad = np.full((self.max_blocks_per_seq,), NULL_BLOCK, np.int32)
+        outs = fn(*self._pools(), *source._pools(), pad, pad)
+        self._set_pools(outs)
+        return built
+
+    def adopt(self, payload: HandoffPayload) -> bool:
+        """Adopt one finished prefill from another engine's pool: the
+        tentpole handoff (docs/design/disaggregated-serving.md). Gate
+        on a free decode slot, match the tokens against the LOCAL
+        prefix tree (full-block hits join shared — those blocks never
+        transfer), adopt fresh blocks for the cold suffix, device-copy
+        them src-pool → dst-pool, and join the sequence straight into
+        the decode batch. False = backpressure (nothing changed hands;
+        the producer retries next pump).
+
+        Refcount contract: source block refs stay with the payload
+        until ``release()`` at the END — a mid-adoption failure leaves
+        both allocators exactly as they were. The final handed-off
+        block is never prefix-shared (match caps at len(tokens) - 1),
+        so decode's first write always lands in a refcount-1 adopted
+        block and the ``_cow_guard`` holds with no CoW at adoption."""
+        sched = self._sched
+        if sched.slots_free <= 0:
+            self.handoff_stats["deferred"] += 1
+            return False
+        tokens = np.asarray(payload.tokens, np.int32)
+        shared: list[int] = []
+        matched = 0
+        if self._prefix is not None:
+            shared, matched, partial = self._prefix.match(tokens)
+            if partial is not None:
+                # A mid-block hit would need CoW *and* a partial copy
+                # on top — the handoff only reuses FULL blocks. Drop
+                # the caller ref; the block falls back to cached.
+                src_b, k = partial
+                self._alloc.free([src_b])
+                matched -= k
+        n_shared = len(shared)
+        cold = len(payload.blocks) - n_shared
+        got = self._alloc.adopt(cold)
+        if got is None:
+            if shared:
+                self._alloc.free(shared)
+            self.handoff_stats["deferred"] += 1
+            return False
+        x = self.xprof
+        sampled = x is not None and x.should_sample()
+        if sampled:
+            jax.block_until_ready(self.kv.k)
+        t0 = time.perf_counter()
+        fn = self._get_handoff()
+        # One dispatch per payload: the cold (src, dst) pairs padded
+        # to the fixed table width with null→null no-ops.
+        srcv = np.full((self.max_blocks_per_seq,), NULL_BLOCK, np.int32)
+        dstv = np.full((self.max_blocks_per_seq,), NULL_BLOCK, np.int32)
+        srcv[:cold] = payload.blocks[n_shared:]
+        dstv[:cold] = got
+        outs = fn(*self._pools(), *payload.source._pools(), srcv, dstv)
+        self._set_pools(outs)
+        if sampled:
+            jax.block_until_ready(self.kv.k)
+        dt = time.perf_counter() - t0
+        if sampled:
+            x.record("handoff", dt)
+        seq = PagedSeq(req=payload.req, tokens=tokens,
+                       blocks=SeqBlocks(self._alloc, shared + got),
+                       order=0, pos=payload.pos,
+                       n_generated=payload.n_generated,
+                       recompute=payload.recompute,
+                       last_token=payload.first_token,
+                       prefix_matched=matched)
+        sched.adopt_running(seq)
+        self._composition_dirty = True
+        moved_bytes = cold * self._block_bytes
+        st = self.handoff_stats
+        st["requests"] += 1
+        st["blocks"] += cold
+        st["shared_blocks"] += n_shared
+        st["bytes"] += moved_bytes
+        st["seconds"] += dt
+        from grove_tpu.runtime.metrics import GLOBAL_METRICS
+        GLOBAL_METRICS.inc("grove_handoff_blocks_total", float(cold))
+        GLOBAL_METRICS.inc("grove_handoff_bytes_total",
+                           float(moved_bytes))
+        if sampled:
+            # Only synced walls enter the histogram — an unsynced dt
+            # times dispatch enqueue, not the transfer.
+            GLOBAL_METRICS.observe("grove_handoff_seconds", dt)
+        payload.release()
+        self._report_metric()
+        return True
 
     # ---- admission ----
 
@@ -1678,17 +1837,21 @@ class PagedDecodeEngine:
         else:
             self._queue.appendleft(victim.req)
 
+    def _sample_first(self, logits) -> int:
+        """Sample the prefill-produced first token (the sampler state a
+        disaggregated handoff materializes and ships)."""
+        if self._sampling:
+            self._rng, sub = jax.random.split(self._rng)
+            return int(np.asarray(
+                sample_tokens(logits, sub, self._sampler))[0])
+        return int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+
     def _finish_prefill(self, seq, logits) -> None:
         """The chunk that PRODUCES the first token just ran: sample it,
         stamp TTFT here — at token emission, not at batch-wide prefill
         completion (the chunked-prefill TTFT satellite; both
         GROVE_TTFT_COMPAT modes regression-tested)."""
-        if self._sampling:
-            self._rng, sub = jax.random.split(self._rng)
-            tok = int(np.asarray(
-                sample_tokens(logits, sub, self._sampler))[0])
-        else:
-            tok = int(np.asarray(jnp.argmax(logits, axis=-1))[0])
+        tok = self._sample_first(logits)
         req = seq.req
         if seq.recompute:
             # Recompute replays history; the sampled token is the next
@@ -2096,7 +2259,326 @@ class PagedDecodeEngine:
                 "kv_quant": self.kv_quant,
                 "spec_decode": self.spec_decode,
                 "spec": self.spec_stats(),
+                "handoff": self.handoff_view(),
                 "schedule": self._sched.payload()}
+
+
+class PrefillEngine(PagedDecodeEngine):
+    """The prefill tier of disaggregated serving (GROVE_DISAGG=1):
+    chunked prefill over its OWN block pool and bucket ladder, no
+    decode leg at all. A finished prefill detaches from the scheduler
+    with its blocks still live and lands in ``outbox`` as a
+    ``HandoffPayload`` — the facade pumps the outbox into the decode
+    engine's ``adopt``. TTFT is stamped HERE, at handoff-producing
+    prefill completion (the same token-emission moment the mono engine
+    stamps, so the stamp semantics don't move with the split).
+
+    Requests whose ``max_new_tokens`` is 1 complete on this tier — the
+    prefill-sampled token is their whole output, exactly where the
+    mono engine completes them — so the facade merges both engines'
+    ``completed`` lists."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["spec_decode"] = False   # speculation is a decode-tier
+        super().__init__(*args, **kwargs)   # concern; prefill drafts nothing
+        self.outbox: deque[HandoffPayload] = deque()
+        self.handoffs_produced = 0
+
+    def step(self) -> None:
+        """One prefill tick. No decode leg: this engine's running set
+        is empty by construction (sequences detach at promotion time),
+        which is the whole disaggregation point — the decode tier's
+        TPOT never waits on a prompt chunk."""
+        if self._sched.has_prefill_work():
+            self._prefill_tick()
+        self.ticks += 1
+
+    def warmup(self, batches: list[int] | None = None,
+               widths: list[int] | None = None,
+               prefill_widths: list[int] | None = None) -> int:
+        """Pre-build ONLY prefill executables (the base warmup's empty
+        ``batches`` list means "full decode ladder", which would bloat
+        this tier's lowering pin with dead decode programs)."""
+        built = 0
+        self._cow_guard(())
+        if prefill_widths is None:
+            prefill_widths = widths or self._sched.width_buckets
+        for W in prefill_widths:
+            if W not in self._prefill_jits:
+                built += 1
+            fn = self._get_prefill(W)
+            toks = np.zeros((1, self.prefill_chunk), np.int32)
+            table = np.zeros((1, W), np.int32)
+            res = fn(self.params, toks, *self._pools(), table,
+                     np.int32(0), np.int32(0), np.int32(0))
+            self._set_pools(res[1:])
+        jax.block_until_ready(self.kv.k)
+        return built
+
+    def _finish_prefill(self, seq, logits) -> None:
+        """Prefill completion on the disaggregated tier: sample the
+        first token and stamp exactly as the mono engine does, then
+        detach the sequence WITHOUT freeing its blocks — ownership
+        moves to the HandoffPayload until the decode side adopts (or
+        this engine dies and the payload dies with its pool)."""
+        tok = self._sample_first(logits)
+        req = seq.req
+        if seq.recompute:
+            # Recompute replay: the sampled token is the next DECODE
+            # token, not a first token — no stamp rewrite (the mono
+            # recompute branch, verbatim).
+            req.generated.append(tok)
+            if self.telemetry is not None:
+                self.telemetry.add_tokens(1)
+        else:
+            self._stamp_admit(req, time.time(), admit=req.admit_ts or None)
+            req.generated.append(tok)
+        seq.n_generated = len(req.generated)
+        seq.last_token = tok
+        if seq.finished():
+            # One-token requests never reach the decode tier: the mono
+            # engine completes them at _finish_prefill, so does this.
+            self._sched.detach_prefill_head(seq)
+            self._sched._release_seq(seq)
+            self._complete(req)
+            self._report_metric()
+            return
+        self._sched.detach_prefill_head(seq)
+        self.outbox.append(HandoffPayload(
+            rid=req.rid, req=req, tokens=seq.tokens, first_token=tok,
+            blocks=list(seq.blocks.blocks), pos=seq.pos,
+            n_generated=seq.n_generated, recompute=seq.recompute,
+            source=self, block_bytes=self._block_bytes))
+        self.handoffs_produced += 1
+        self._report_metric()
+
+    def _release_handoff(self, payload: HandoffPayload) -> None:
+        """Drop a payload's block references (HandoffPayload.release).
+        The prompt's full blocks were registered into this tier's
+        prefix tree at detach time, so the unref parks them cached —
+        the producer keeps its warm prefix across handoffs."""
+        self._alloc.free(payload.blocks)
+
+    def accept_recompute(self, seq: PagedSeq) -> None:
+        """Take a decode-tier preemption victim for re-prefill: in
+        disagg mode ALL prefill — including recompute — runs on this
+        tier, so the decode tick stays 100% decode. The victim arrives
+        block-less (the decode scheduler released its table); a carrier
+        seq re-enters through the preempted queue, whose readmit path
+        restores n_generated/preemptions from it."""
+        assert not seq.blocks.blocks, "recompute victim still holds blocks"
+        carrier = PagedSeq(req=seq.req, tokens=seq.tokens,
+                           blocks=SeqBlocks(self._alloc), order=-1,
+                           n_generated=seq.n_generated, recompute=True,
+                           preemptions=seq.preemptions)
+        self._sched.preempted.append(carrier)
+
+    @property
+    def queue_depth(self) -> int:
+        """Queued + preempted + produced-but-unadopted: an outbox
+        payload is still this tier's responsibility until the decode
+        side takes it."""
+        return super().queue_depth + len(self.outbox)
+
+
+class DisaggServing:
+    """The GROVE_DISAGG=1 serving pair behind one engine interface:
+    a ``PrefillEngine`` front door streaming finished KV blocks to a
+    ``PagedDecodeEngine`` through the ``serving/handoff.py`` protocol
+    (router-less for now — the prefill tier IS the front door, the
+    samples/disagg-tiered.yaml PCSG shape). Drivers built for one
+    engine (tools/loadgen.run_load, the benches, the smokes) work
+    unchanged: submit routes to prefill, step runs prefill tick →
+    outbox pump → decode tick, and the liveness/queue/completed
+    surfaces merge both tiers."""
+
+    def __init__(self, prefill: PrefillEngine,
+                 decode: PagedDecodeEngine) -> None:
+        assert prefill.kv_quant == decode.kv_quant, \
+            "handoff cannot cross quant modes (no requantize by design)"
+        assert prefill.block_size == decode.block_size, \
+            "handoff is a block-id remap; block geometry must match"
+        assert not decode.spec_decode, \
+            "disagg + speculative decoding is not wired yet"
+        self.prefill = prefill
+        self.decode = decode
+        self.telemetry = decode.telemetry
+        self.ticks = 0
+
+    # -- engine interface (run_load/bench/smoke drivers) --
+
+    def submit(self, prompt: np.ndarray, max_new_tokens: int = 32) -> int:
+        return self.prefill.submit(prompt,
+                                   max_new_tokens=max_new_tokens)
+
+    def admit_from_queue(self, prefiller=None) -> int:
+        # Decode-tier preemption victims re-prefill on the prefill
+        # tier (recompute is prefill work); then fresh admissions.
+        moved = 0
+        d = self.decode._sched.preempted
+        while d:
+            self.prefill.accept_recompute(d.popleft())
+            moved += 1
+        return self.prefill.admit_from_queue() + moved
+
+    def step(self) -> None:
+        self.prefill.step()
+        self._pump()
+        self.decode.step()
+        self.ticks += 1
+        if self.telemetry is not None:
+            # The per-engine gauges see only their own half; the facade
+            # is the one place the COMBINED load signal exists.
+            self.telemetry.sample_gauges(self.queue_depth,
+                                         self.kv_lane_utilization)
+
+    def _pump(self) -> None:
+        """Move finished prefills into the decode tier, in order. A
+        refused adoption (no slot / allocator backpressure) leaves the
+        payload at the outbox head for the next tick — blocks stay
+        owned by the payload, nothing leaks on either side."""
+        out = self.prefill.outbox
+        while out:
+            if not self.decode.adopt(out[0]):
+                break
+            out.popleft()
+
+    def run(self, steps: int) -> None:
+        for _ in range(steps):
+            self.step()
+        self.sync()
+
+    def sync(self) -> None:
+        self.prefill.sync()
+        self.decode.sync()
+
+    def warmup(self, batches: list[int] | None = None,
+               widths: list[int] | None = None,
+               prefill_widths: list[int] | None = None) -> int:
+        """Pre-build both tiers' ladders plus the handoff copy: the
+        prefill tier compiles only prefill programs, the decode tier
+        only decode programs — each pin is the union a mono engine
+        would split."""
+        built = self.prefill.warmup(
+            prefill_widths=(prefill_widths if prefill_widths is not None
+                            else widths))
+        built += self.decode.warmup(batches=batches, widths=widths,
+                                    prefill_widths=[])
+        built += self.decode.warmup_handoff(self.prefill)
+        return built
+
+    # -- merged surfaces --
+
+    @property
+    def completed(self) -> list:
+        """Both tiers' completions (max_new_tokens == 1 requests finish
+        on the prefill tier, everything else on decode)."""
+        return self.prefill.completed + self.decode.completed
+
+    @property
+    def queue_depth(self) -> int:
+        return self.prefill.queue_depth + self.decode.queue_depth
+
+    @property
+    def kv_lane_utilization(self) -> float:
+        """The tighter pool is the backpressure signal."""
+        return max(self.prefill.kv_lane_utilization,
+                   self.decode.kv_lane_utilization)
+
+    @property
+    def _active(self) -> np.ndarray:
+        n = (self.prefill._sched.live + len(self.prefill.outbox)
+             + self.decode._sched.live)
+        if n == 0 and (self.decode._pending or self.decode._finishing
+                       or self.prefill._queue
+                       or self.prefill._sched.preempted
+                       or self.decode._sched.preempted):
+            n = 1
+        return np.ones((n,), bool)
+
+    @property
+    def xprof(self):
+        """The decode tier's observatory (each tier keeps its own —
+        separately pinned lowering sets are the point; the prefill
+        tier's is ``self.prefill.xprof``)."""
+        return self.decode.xprof
+
+    @property
+    def cache(self) -> PagedKV:
+        return self.decode.kv
+
+    @property
+    def params(self):
+        return self.decode.params
+
+    def handoff_view(self) -> dict:
+        return self.decode.handoff_view()
+
+    def replace_prefill(self, prefill: PrefillEngine) -> int:
+        """Disaster recovery (chaos: prefill-replica-kill): swap in a
+        fresh prefill engine after the old tier died. Un-adopted work —
+        queued requests, mid-prefill sequences, outbox payloads whose
+        blocks died with the old pool — re-enters the new tier's queue
+        with rids intact; produced-but-unshipped first tokens are
+        discarded so the replay regenerates them (greedy re-prefill is
+        deterministic: bitwise-identical output, the chaos invariant).
+        Decode-tier recompute victims keep their generated history and
+        re-enter through the recompute path. Returns requests rescued.
+        The old engine's allocator state is NOT consulted — a killed
+        replica can't be."""
+        old = self.prefill
+        fresh: list[Request] = []
+        carriers: list[PagedSeq] = []
+
+        def _carrier(req, tokens, n_generated, preemptions=0):
+            carriers.append(PagedSeq(
+                req=req, tokens=np.asarray(tokens, np.int32),
+                blocks=SeqBlocks(prefill._alloc), order=-1,
+                n_generated=n_generated, recompute=True,
+                preemptions=preemptions))
+
+        for p in old.outbox:
+            if p.recompute:
+                # The replay's decode history is REAL output (including
+                # the unshipped token _finish_prefill appended) — it
+                # must survive: rebuild the replay input from it.
+                _carrier(p.req, np.concatenate([
+                    p.req.prompt[:p.req.prompt_len],
+                    np.asarray(p.req.generated, np.int32)]),
+                    len(p.req.generated))
+            else:
+                fresh.append(p.req)
+        for s in old._sched.prefilling:
+            if s.recompute:
+                _carrier(s.req, s.tokens, s.n_generated, s.preemptions)
+            else:
+                fresh.append(s.req)
+        fresh.extend(old._queue)
+        for req in fresh:
+            # Replay from scratch: stamps and produced first tokens
+            # belonged to work the dead tier never shipped. Greedy
+            # re-prefill regenerates them bitwise-identically.
+            req.generated = []
+            req.done = False
+            req.admit_ts = req.first_token_ts = req.done_ts = 0.0
+            req.cached_tokens = 0
+            prefill._queue.append(req)
+        carriers.extend(old._sched.preempted)
+        for c in carriers:
+            prefill._sched.preempted.append(c)
+        # Completions already made are history, not state — carry them.
+        prefill.completed.extend(old.completed)
+        prefill._next_rid = max(prefill._next_rid, old._next_rid)
+        self.prefill = prefill
+        self.decode.warmup_handoff(prefill)
+        return len(fresh) + len(carriers)
+
+    def payload(self) -> dict:
+        return {"engine": "disagg", "ticks": self.ticks,
+                "handoff": self.decode.handoff_view(),
+                "outbox": len(self.prefill.outbox),
+                "prefill": self.prefill.payload(),
+                "decode": self.decode.payload()}
 
 
 def engine_mode() -> str:
@@ -2109,6 +2591,49 @@ def engine_mode() -> str:
     return mode
 
 
+def disagg_mode() -> bool:
+    """GROVE_DISAGG=1 splits paged serving into a PrefillEngine →
+    PagedDecodeEngine pair over the block handoff (default 0: the mono
+    PagedDecodeEngine, byte-for-byte the prior behavior). Only the
+    paged engine disaggregates — GROVE_ENGINE=lanes ignores this."""
+    return os.environ.get("GROVE_DISAGG", "0") == "1"
+
+
+def make_disagg(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
+                mesh=None, prefill_slots: int | None = None,
+                prefill_num_blocks: int | None = None,
+                telemetry=None, xprof=None,
+                **common) -> DisaggServing:
+    """Build the disaggregated pair: params are resolved ONCE and
+    shared (both tiers serve the same model; in a real deployment each
+    tier device_puts onto its own slice), each tier gets its OWN block
+    pool and Observatory (separately pinned lowering sets are the
+    point), and the telemetry is shared — SLO stamps span the seam.
+
+    ``prefill_slots``/``prefill_num_blocks`` size the prefill tier
+    independently (the disagg premise: prompt-heavy chips want deeper
+    pools and fewer concurrent slots than token-heavy chips); both
+    default to the decode tier's geometry."""
+    if isinstance(key_or_params, jax.Array) \
+            and key_or_params.dtype == jnp.uint32:
+        params = llama.init_params(cfg, key_or_params)
+    else:
+        params = key_or_params
+    common.pop("spec_decode", None)  # decode-tier feature, not wired
+    common.pop("spec_k", None)
+    common.pop("draft_params", None)
+    pre_kwargs = dict(common)
+    if prefill_num_blocks is not None:
+        pre_kwargs["num_blocks"] = prefill_num_blocks
+    pre = PrefillEngine(cfg, params, batch=prefill_slots or batch,
+                        mesh=mesh, telemetry=telemetry,
+                        **pre_kwargs)
+    dec = PagedDecodeEngine(cfg, params, batch=batch, mesh=mesh,
+                            telemetry=telemetry, xprof=xprof,
+                            **common)
+    return DisaggServing(pre, dec)
+
+
 def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
                 max_len: int | None = None,
                 host_sync_interval: int = 8,
@@ -2117,9 +2642,10 @@ def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
                 metric_hook=None, telemetry=None, xprof=None,
                 mesh=None, mode: str | None = None,
                 **paged_kwargs):
-    """Engine factory honoring GROVE_ENGINE. Paged-only knobs
-    (block_size, num_blocks, prefill_chunk) pass through
-    ``paged_kwargs`` and are ignored by the lanes engine."""
+    """Engine factory honoring GROVE_ENGINE (and, for the paged
+    engine, GROVE_DISAGG). Paged-only knobs (block_size, num_blocks,
+    prefill_chunk) pass through ``paged_kwargs`` and are ignored by
+    the lanes engine."""
     mode = mode or engine_mode()
     common = dict(batch=batch, max_len=max_len,
                   host_sync_interval=host_sync_interval, sampler=sampler,
@@ -2127,5 +2653,9 @@ def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
                   telemetry=telemetry, xprof=xprof)
     if mode == "lanes":
         return DecodeEngine(cfg, key_or_params, **common)
+    if disagg_mode():
+        common.pop("xprof")
+        return make_disagg(cfg, key_or_params, mesh=mesh, xprof=xprof,
+                           **common, **paged_kwargs)
     return PagedDecodeEngine(cfg, key_or_params, mesh=mesh,
                              **common, **paged_kwargs)

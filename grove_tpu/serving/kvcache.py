@@ -167,6 +167,7 @@ class BlockAllocator:
         self.high_water = 0
         self.reclaimed_total = 0
         self.cached_high_water = 0
+        self.adopted_total = 0
 
     @property
     def free_blocks(self) -> int:
@@ -221,6 +222,23 @@ class BlockAllocator:
             self._refs[b] = 1
         self.allocs_total += n
         self.high_water = max(self.high_water, len(self._refs))
+        return got
+
+    def adopt(self, n: int) -> list[int] | None:
+        """Grant ``n`` blocks whose contents will be EXTERNALLY filled
+        (the disaggregated handoff: a prefill engine's pool copies in,
+        no local prefill dispatch ever writes them). Allocation
+        semantics are exactly ``alloc`` — all-or-nothing, cached-LRU
+        reclaim before backpressure, refcount 1 to the caller — the
+        separate entry point exists so the telemetry can attribute
+        handoff-adopted blocks distinctly from locally-written ones
+        (docs/design/disaggregated-serving.md). The adopted block ids
+        are LOCAL: the handoff remaps the source table onto them, it
+        never imports foreign ids (a foreign-id free raises like any
+        other unallocated free)."""
+        got = self.alloc(n)
+        if got is not None:
+            self.adopted_total += n
         return got
 
     def _reclaim_one(self) -> None:
@@ -307,7 +325,8 @@ class BlockAllocator:
                 "oom_events": self.oom_events,
                 "high_water": self.high_water,
                 "reclaimed_total": self.reclaimed_total,
-                "cached_high_water": self.cached_high_water}
+                "cached_high_water": self.cached_high_water,
+                "adopted_total": self.adopted_total}
 
 
 @dataclasses.dataclass

@@ -105,6 +105,11 @@ class EngineTelemetry:
         # until the engine samples once, or forever when
         # GROVE_SPEC_DECODE=0).
         self.spec: dict | None = None
+        # Latest disaggregated-handoff accounting (engine.handoff_view
+        # shape: requests/blocks/shared_blocks/bytes/deferred/seconds
+        # + per-request derivatives; None until a handoff lands, or
+        # forever when GROVE_DISAGG=0).
+        self.handoff: dict | None = None
 
     # ---- engine-side hooks ----
 
@@ -133,6 +138,14 @@ class EngineTelemetry:
         rate in the digest is the signal to shrink spec_k or swap the
         draft."""
         self.spec = stats
+
+    def sample_handoff(self, stats: dict) -> None:
+        """Latest prefill→decode handoff accounting (engine
+        handoff_view payload: requests, cold/shared block counts,
+        transfer bytes, deferred adoptions, per-request ms) —
+        point-sampled like the gauges; a rising ms_per_request or
+        deferred count in the digest is the transfer seam saturating."""
+        self.handoff = stats
 
     def add_tokens(self, n: int) -> None:
         """Decoded-token counter, bumped once per drained window (NOT
@@ -205,6 +218,7 @@ class EngineTelemetry:
             "memory": self.memory,
             "prefix": self.prefix,
             "spec": self.spec,
+            "handoff": self.handoff,
             "requests_completed": completed,
             "tokens_total": tokens,
             "ttft_p50_s": self.quantile("ttft_seconds", 0.5),
@@ -268,6 +282,20 @@ def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
              "agg": "avg"},
             {"metric": "spec_accepted_tokens",
              "value": float(sp.get("accepted_tokens", 0)), "agg": "sum"},
+        ]
+    if s.get("handoff"):
+        ho = s["handoff"]
+        # Disaggregation seam health: block/byte totals sum across
+        # replica pairs, the per-request transfer cost averages (a
+        # scope-level seam latency).
+        samples += [
+            {"metric": "handoff_blocks",
+             "value": float(ho.get("blocks", 0)), "agg": "sum"},
+            {"metric": "handoff_bytes",
+             "value": float(ho.get("bytes", 0)), "agg": "sum"},
+            {"metric": "handoff_ms_per_request",
+             "value": float(ho.get("ms_per_request", 0.0)),
+             "agg": "avg"},
         ]
     return samples + [
         {"metric": "queue_depth", "value": float(s["queue_depth"]),

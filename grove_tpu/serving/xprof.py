@@ -458,6 +458,7 @@ class Observatory:
         # Engine-pushed riders (set by the paged engine when the
         # corresponding feature is on, None otherwise).
         self.spec: dict | None = None   # engine.spec_stats() shape
+        self.handoff: dict | None = None  # engine.handoff_view() shape
         self.kv_quant: str = "off"      # KV byte basis for the roofline
         register(self)
 
@@ -563,6 +564,7 @@ class Observatory:
             "memory": self._last_memory,
             "kv_quant": self.kv_quant,
             "spec": self.spec,
+            "handoff": self.handoff,
             "throughput": self.throughput_estimate(phases),
         }
 

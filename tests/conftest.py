@@ -69,6 +69,7 @@ SLOW_MODULES = {
     "test_e2e_secure_multihost", "test_e2e_chaos", "test_bench_supervisor",
     "test_diagnostics",  # spawns a sub-pytest with a live cluster
     "test_paged_engine",  # compiles per-bucket paged executables
+    "test_disagg_serving",  # compiles both tiers' executables
 }
 
 

@@ -1,0 +1,212 @@
+"""Disaggregated prefill→decode serving (GROVE_DISAGG=1): bitwise
+token parity against the mono paged engine, handoff composition with
+the prefix cache and int8 KV, recompute routing across the seam, and
+the factory switch (slow tier — compiles XLA programs).
+
+The host-side ownership rules live in tests/test_paged_kvcache.py
+(adopt across two allocators); the chaos acceptance is
+``tools/chaos_soak.py --scenario prefill-replica-kill``; the lowering
+pin is ``tools/decode_smoke.py --disagg``. Here the invariant is the
+serving contract: splitting the engine across the block handoff is
+invisible in the tokens — greedy output is rid-for-rid bitwise
+identical to the mono engine, under warm prefixes, quantized blocks,
+and decode-tier preemption pressure alike.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from grove_tpu.models import llama
+from grove_tpu.serving.engine import (DisaggServing, PagedDecodeEngine,
+                                      PrefillEngine, make_disagg,
+                                      make_engine)
+
+CFG = dataclasses.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
+                          max_seq_len=64)
+GEOM = dict(batch=4, block_size=8, prefill_chunk=8, host_sync_interval=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def drive(eng, want: int, max_iters: int = 3000) -> None:
+    """Engine-agnostic drain: DisaggServing and PagedDecodeEngine share
+    the submit/admit/step/completed surface (the facade's point)."""
+    for _ in range(max_iters):
+        eng.admit_from_queue()
+        if len(eng.completed) >= want:
+            break
+        eng.step()
+    eng.sync()
+    assert len(eng.completed) >= want, (len(eng.completed), want)
+
+
+def mixed_prompts(seed: int, n: int = 5):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(3, 28, size=n)
+    return [rng.integers(1, CFG.vocab_size, size=int(k)).astype(np.int32)
+            for k in lens]
+
+
+def assert_rid_parity(dis, mono) -> None:
+    """Greedy sampling makes the comparison exact: same submit order →
+    same rids → same token stream, bitwise, request by request."""
+    expect = {r.rid: list(r.generated) for r in mono.completed}
+    got = {r.rid: list(r.generated) for r in dis.completed}
+    assert set(got) == set(expect)
+    for rid in expect:
+        assert got[rid] == expect[rid], rid
+
+
+# ---- bitwise parity: the splitting-is-invisible contract ----
+
+@pytest.mark.parametrize("prefix_cache", [True, False])
+def test_disagg_matches_mono_tokens(params, prefix_cache):
+    """Mixed prompt lengths, prefix cache on and off. With the cache
+    on, a repeated prompt exercises the shared-prefix handoff path:
+    matched blocks never cross the seam (the decode tier serves them
+    from its own tree), only the cold suffix is copied."""
+    prompts = mixed_prompts(42)
+    mono = PagedDecodeEngine(CFG, params, prefix_cache=prefix_cache,
+                             **GEOM)
+    dis = make_disagg(CFG, params, prefix_cache=prefix_cache, **GEOM)
+    # Two-phase submission (the prefix-cache test's idiom): the seed
+    # prompt retires — registering its blocks in BOTH tiers' trees —
+    # before the warm resubmission arrives, so the shared-prefix
+    # handoff path (matched blocks never cross the seam) is
+    # deterministic rather than racing the first prefill.
+    base = max(prompts, key=len)     # ≥ 2 full blocks: a hit is possible
+    rest = [t for t in prompts if t is not base]
+    for eng in (mono, dis):
+        eng.submit(base, max_new_tokens=6)
+        drive(eng, 1)
+        for t in rest + [base.copy()]:
+            eng.submit(t, max_new_tokens=6)
+    prompts.append(base)
+    drive(mono, len(prompts))
+    drive(dis, len(prompts))
+    assert_rid_parity(dis, mono)
+    hv = dis.handoff_view()
+    assert hv["requests"] == len(prompts)
+    if prefix_cache:
+        # The warm resubmission's matched blocks stayed put: fewer
+        # blocks crossed than a cold-only run would ship.
+        assert hv["shared_blocks"] > 0
+    else:
+        assert hv["shared_blocks"] == 0
+    dis.decode._alloc.check()
+    dis.prefill._alloc.check()
+    assert dis.decode._alloc.used_blocks == 0
+
+
+def test_disagg_int8_kv_blocks_transfer_quantized(params):
+    """int8 paged KV composes with the handoff: quantized blocks and
+    their scales move as-is (no requantize — the payload's block_bytes
+    is the int8 footprint), and tokens still match the int8 mono
+    engine bitwise."""
+    from grove_tpu.serving.quant import kv_block_bytes
+    prompts = mixed_prompts(43)
+    mono = PagedDecodeEngine(CFG, params, kv_quant="int8", **GEOM)
+    dis = make_disagg(CFG, params, kv_quant="int8", **GEOM)
+    for t in prompts:
+        mono.submit(t, max_new_tokens=6)
+        dis.submit(t, max_new_tokens=6)
+    drive(mono, len(prompts))
+    drive(dis, len(prompts))
+    assert_rid_parity(dis, mono)
+    hv = dis.handoff_view()
+    assert hv["block_bytes"] == kv_block_bytes(CFG, GEOM["block_size"],
+                                               "int8")
+    assert hv["bytes"] == hv["blocks"] * hv["block_bytes"]
+    dis.decode._alloc.check()
+
+
+def test_disagg_parity_under_decode_preemption(params):
+    """A tight decode pool forces preemptions AFTER adoption; the
+    victims cross back over the seam (recompute is prefill work — the
+    decode tick stays pure decode) and re-prefill on the prefill tier.
+    Tokens still match a roomy mono run bitwise: recompute replays are
+    deterministic on either side of the seam."""
+    prompts = mixed_prompts(44, n=6)
+    mono = PagedDecodeEngine(CFG, params, **GEOM)
+    dis = make_disagg(CFG, params, num_blocks=9,
+                      prefill_num_blocks=33, **GEOM)
+    for t in prompts:
+        mono.submit(t, max_new_tokens=12)
+        dis.submit(t, max_new_tokens=12)
+    drive(mono, len(prompts))
+    drive(dis, len(prompts))
+    assert dis.decode._sched.preemptions_total > 0, \
+        "pool was not tight enough to exercise the recompute seam"
+    assert_rid_parity(dis, mono)
+    dis.decode._alloc.check()
+    dis.prefill._alloc.check()
+    assert dis.decode._alloc.used_blocks == 0
+    assert dis.prefill._alloc.used_blocks == 0
+
+
+# ---- lifecycle edges ----
+
+def test_one_token_requests_complete_on_prefill_tier(params):
+    """max_new_tokens == 1 finishes at _finish_prefill in the mono
+    engine; the prefill tier must complete it locally the same way —
+    no payload ships, no decode slot burns."""
+    dis = make_disagg(CFG, params, **GEOM)
+    mono = PagedDecodeEngine(CFG, params, **GEOM)
+    t = mixed_prompts(45, n=1)[0]
+    dis.submit(t, max_new_tokens=1)
+    mono.submit(t, max_new_tokens=1)
+    drive(dis, 1)
+    drive(mono, 1)
+    assert len(dis.prefill.completed) == 1 and not dis.decode.completed
+    assert dis.prefill.handoffs_produced == 0
+    assert_rid_parity(dis, mono)
+
+
+def test_handoff_backpressure_defers_not_drops(params):
+    """More concurrent work than decode slots: refused adoptions stay
+    at the outbox head (blocks still payload-owned) and land on later
+    ticks — every request completes, nothing leaks."""
+    prompts = mixed_prompts(46, n=8)
+    dis = make_disagg(CFG, params, prefill_slots=8, **GEOM)
+    for t in prompts:
+        dis.submit(t, max_new_tokens=6)
+    drive(dis, len(prompts))
+    assert len(dis.completed) == len(prompts)
+    dis.decode._alloc.check()
+    dis.prefill._alloc.check()
+    assert not dis.decode._alloc._refs and not dis.prefill._alloc._refs
+
+
+# ---- factory switch ----
+
+def test_make_engine_honors_grove_disagg(params, monkeypatch):
+    """GROVE_DISAGG=1 routes the paged factory path to the pair;
+    GROVE_DISAGG=0 (and unset) is byte-for-byte the prior behavior —
+    the same PagedDecodeEngine construction, no disagg import cost.
+    The lanes engine ignores the flag entirely."""
+    monkeypatch.setenv("GROVE_ENGINE", "paged")
+    monkeypatch.setenv("GROVE_DISAGG", "1")
+    eng = make_engine(CFG, params, batch=2, block_size=8)
+    assert isinstance(eng, DisaggServing)
+    assert isinstance(eng.prefill, PrefillEngine)
+    assert isinstance(eng.decode, PagedDecodeEngine)
+    monkeypatch.setenv("GROVE_DISAGG", "0")
+    eng = make_engine(CFG, params, batch=2, block_size=8)
+    assert isinstance(eng, PagedDecodeEngine) \
+        and not isinstance(eng, PrefillEngine)
+    monkeypatch.delenv("GROVE_DISAGG")
+    eng = make_engine(CFG, params, batch=2, block_size=8)
+    assert isinstance(eng, PagedDecodeEngine) \
+        and not isinstance(eng, PrefillEngine)
+    monkeypatch.setenv("GROVE_DISAGG", "1")
+    monkeypatch.setenv("GROVE_ENGINE", "lanes")
+    from grove_tpu.serving.engine import DecodeEngine
+    assert isinstance(make_engine(CFG, params, batch=2, max_len=48),
+                      DecodeEngine)

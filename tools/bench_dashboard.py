@@ -59,6 +59,11 @@ def render(rows: list[dict]) -> str:
                                         "decode_accepted_tokens_per_dispatch")]
     kv_rows = [r for r in rows
                if r.get("metric") == "decode_kv_bytes_per_token"]
+    disagg_rows = [r for r in rows
+                   if r.get("metric") in
+                   ("decode_tokens_per_sec_disagg_vs_mono",
+                    "decode_tpot_p99_disagg_vs_mono",
+                    "disagg_handoff_overhead")]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
     reclaim = [r for r in rows
@@ -374,6 +379,51 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('bytes_per_token_off', 0):.0f} "
                 f"| {r.get('ratio_vs_off', 0):.2f}x "
                 f"| {r.get('layers', '?')} |")
+        out.append("")
+    if disagg_rows:
+        out += ["## Disaggregated serving (prefill tier → decode tier "
+                "block handoff)", "",
+                "_disagg_vs_mono: the GROVE_DISAGG pair over the mono "
+                "paged engine, tokens/sec on the mixed Poisson workload "
+                "(bar ≥ 0.9x); tpot_p99: long-prompt-heavy mix, disagg "
+                "TPOT p99 over mono's (bar < 1.0x — decode dispatches "
+                "are 100% decode, so the tail is no longer hostage to "
+                "prompt length); overhead: per-adopted-request handoff "
+                "cost from the engine's own counters, bytes "
+                "cross-checked against live pool nbytes — "
+                "docs/design/disaggregated-serving.md_", "",
+                "| when | git | row | value | disagg | mono | handoffs | "
+                "deferred | preempts d/m | steady compiles |",
+                "|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(disagg_rows, key=lambda r: (r.get("ts", ""),
+                                                    r.get("metric", ""))):
+            m = r.get("metric", "?")
+            if m == "decode_tokens_per_sec_disagg_vs_mono":
+                name = "tok/s disagg/mono"
+                a = f"{r.get('disagg_tok_s', 0):.0f} tok/s"
+                b = f"{r.get('mono_tok_s', 0):.0f} tok/s"
+                val = f"{r.get('value', 0):.2f}x"
+                pre = "-"
+            elif m == "decode_tpot_p99_disagg_vs_mono":
+                name = "TPOT p99 disagg/mono"
+                a = f"{r.get('disagg_tpot_p99_ms', 0):.2f} ms"
+                b = f"{r.get('mono_tpot_p99_ms', 0):.2f} ms"
+                val = f"{r.get('value', 0):.2f}x"
+                pre = (f"{r.get('disagg_preemptions', '?')}/"
+                       f"{r.get('mono_preemptions', '?')}")
+            else:
+                name = "handoff overhead"
+                a = f"{r.get('bytes_per_request', 0):.0f} B/req"
+                b = f"{r.get('blocks_moved', '?')} cold blk"
+                val = f"{r.get('value', 0):.3f} ms/req"
+                pre = "-"
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {name} | {val} | {a} | {b} "
+                f"| {r.get('handoff_requests', r.get('requests', '-'))} "
+                f"| {r.get('handoff_deferred', r.get('deferred', '-'))} "
+                f"| {pre} "
+                f"| {r.get('steady_compiles', '-')} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

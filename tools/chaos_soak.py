@@ -138,6 +138,9 @@ def main(argv=None) -> int:
               "standby takes over")
         print("  roll-wedge         the PR 8 required-pack roll wedge: "
               "converges with defrag, reproduces with GROVE_DEFRAG=0")
+        print("  prefill-replica-kill  kill the GROVE_DISAGG prefill "
+              "tier mid-handoff; decode allocator stays clean, "
+              "requests re-prefill bitwise-identical")
         print("fault types:", ", ".join(sorted(FAULT_REGISTRY)))
         return 0
 
@@ -153,6 +156,37 @@ def main(argv=None) -> int:
         print(f"roll-wedge OK: defrag-on converged in {on['roll_s']}s on "
               f"{on['wedge_slices']}; GROVE_DEFRAG=0 wedged on roll "
               f"{off['attempt']} (pre-defrag behavior intact)")
+        return 0
+
+    if args.scenario == "prefill-replica-kill":
+        from grove_tpu.chaos.scenario import run_prefill_replica_kill
+        # The disagg seam's chaos acceptance: kill the prefill tier
+        # with payloads stranded between chunk completion and decode
+        # adoption. The scenario asserts the invariants internally
+        # (allocator check() on both sides, rid-keyed bitwise token
+        # parity vs a mono run) — reaching the print means green.
+        report = run_prefill_replica_kill(seed=args.seed)
+        print(json.dumps(report, indent=2))
+        if args.history:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from bench_sched import append_history
+            append_history({
+                "metric": "chaos_prefill_replica_kill_rescued",
+                "value": float(report["rescued"]),
+                "unit": "requests",
+                "scenario": "prefill-replica-kill",
+                "seed": args.seed,
+                "outbox_at_kill": report["outbox_at_kill"],
+                "blocks_in_flight": report["blocks_in_flight"],
+                "completed": report["completed"],
+                "bitwise_identical": report["tokens_bitwise_identical"],
+                "mode": "chaos-cpu",
+            })
+        print(f"prefill-replica-kill OK: {report['rescued']} rescued "
+              f"({report['outbox_at_kill']} mid-handoff, "
+              f"{report['blocks_in_flight']} blocks in flight), "
+              f"{report['completed']}/{report['prompts']} requests "
+              f"bitwise-identical to mono, allocators clean")
         return 0
 
     if args.scenario == "leader-kill":

@@ -106,10 +106,37 @@ EXPECTED_SPEC = {
 EXPECTED_QUANT = {name.replace("[", "_q8["): 1
                   for name in EXPECTED_LOWERINGS}
 
+# ---- disagg (GROVE_DISAGG=1, --disagg leg) ---------------------------
+# The pair splits the mono set down the seam: the prefill tier compiles
+# ONLY prefill programs, the decode tier ONLY decode steps plus the one
+# handoff block copy (docs/design/disaggregated-serving.md). The decode
+# bucket set differs from mono's — adoption admits finished prefills in
+# arrival order, so the batch composition crosses different (b,w)
+# corners — but it is just as deterministic, and it must not grow.
+EXPECTED_DISAGG_PREFILL = {
+    "paged_prefill[c8,w1]": 1,
+    "paged_prefill[c8,w2]": 1,
+    "paged_prefill[c8,w4]": 1,
+}
+EXPECTED_DISAGG_DECODE = {
+    "paged_handoff_copy": 1,
+    "paged_step[b1,w1]": 1,
+    "paged_step[b1,w2]": 1,
+    "paged_step[b2,w2]": 1,
+    "paged_step[b2,w4]": 1,
+    "paged_step[b4,w2]": 1,
+    "paged_step[b4,w4]": 1,
+}
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="decode-smoke")
-    parser.parse_args(argv)
+    parser.add_argument("--disagg", action="store_true",
+                        help="smoke the GROVE_DISAGG prefill→decode "
+                             "pair instead of the mono engine (its own "
+                             "`make ci` leg — the mono pins above stay "
+                             "byte-for-byte untouched)")
+    args = parser.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ["GROVE_XPROF"] = "1"   # the CompileTracker is the witness
 
@@ -171,6 +198,83 @@ def main(argv=None) -> int:
         eng._alloc.check()
         assert eng._alloc.used_blocks == 0, eng._alloc.payload()
         assert eng._sched.admitted_total >= 2 * len(prompts)
+
+    if args.disagg:
+        # ---- the GROVE_DISAGG pair: split pins, zero steady growth --
+        from grove_tpu.serving.engine import make_disagg
+        geom = dict(batch=4, max_len=48, block_size=8, prefill_chunk=8,
+                    host_sync_interval=4, prefix_cache=False)
+        mono = PagedDecodeEngine(cfg, params, **geom)
+        dis = make_disagg(cfg, params, **geom)
+
+        def drive_pair(want: int) -> None:
+            for _ in range(600):
+                dis.admit_from_queue()
+                if len(dis.completed) >= want:
+                    break
+                dis.step()
+            dis.sync()
+            assert len(dis.completed) >= want, (len(dis.completed), want)
+
+        # Warm pass: each tier must pin EXACTLY its half of the work —
+        # no decode step may appear on the prefill tier, no prefill on
+        # the decode tier, and the handoff copy compiles exactly once.
+        for p in prompts:
+            mono.submit(p, max_new_tokens=MAX_NEW)
+            dis.submit(p, max_new_tokens=MAX_NEW)
+        drive(mono, len(prompts))
+        drive_pair(len(prompts))
+        pre = dis.prefill.xprof.compile.counts()
+        dec = dis.decode.xprof.compile.counts()
+        assert pre == EXPECTED_DISAGG_PREFILL, (
+            f"prefill-tier lowering set drifted:\n  got      {pre}\n"
+            f"  expected {EXPECTED_DISAGG_PREFILL}")
+        assert dec == EXPECTED_DISAGG_DECODE, (
+            f"decode-tier lowering set drifted:\n  got      {dec}\n"
+            f"  expected {EXPECTED_DISAGG_DECODE}")
+        assert dis.prefill.xprof.compile.recompile_count() == 0
+        assert dis.decode.xprof.compile.recompile_count() == 0
+
+        # Steady state: the SAME workload must compile NOTHING on
+        # either tier (handoff included — its jit is shape-static).
+        for p in prompts:
+            mono.submit(p, max_new_tokens=MAX_NEW)
+            dis.submit(p, max_new_tokens=MAX_NEW)
+        drive(mono, 2 * len(prompts))
+        drive_pair(2 * len(prompts))
+        assert dis.prefill.xprof.compile.counts() == pre
+        assert dis.decode.xprof.compile.counts() == dec
+        assert dis.prefill.xprof.compile.recompile_count() == 0
+        assert dis.decode.xprof.compile.recompile_count() == 0
+        assert dis.prefill.xprof.compile.storms == 0
+        assert dis.decode.xprof.compile.storms == 0
+
+        # Bitwise token parity vs the mono engine, both passes, plus
+        # lifecycle stamps and clean allocators on BOTH pools.
+        mono_by_rid = {r.rid: r.generated for r in mono.completed}
+        for r in dis.completed:
+            assert len(r.generated) == MAX_NEW, r.rid
+            assert r.enqueue_ts <= r.admit_ts <= r.first_token_ts \
+                <= r.done_ts, r.rid
+            assert r.generated == mono_by_rid[r.rid], (
+                f"disagg token divergence rid={r.rid}: "
+                f"{r.generated} vs {mono_by_rid[r.rid]}")
+        dis.prefill._alloc.check()
+        dis.decode._alloc.check()
+        assert dis.prefill._alloc.used_blocks == 0
+        assert dis.decode._alloc.used_blocks == 0
+        hv = dis.handoff_view()
+        assert hv["requests"] == 2 * len(prompts), hv
+        assert hv["deferred"] == 0, hv
+        print(f"decode smoke OK (disagg): {len(dis.completed)} requests "
+              f"through the prefill→decode pair, "
+              f"{len(pre)}+{len(dec)} pinned lowerings "
+              "(prefill tier: prefill-only; decode tier: steps + one "
+              "handoff copy), 0 steady-state recompiles on both "
+              f"tiers, bitwise token parity vs mono, "
+              f"{hv['blocks']} blocks handed off "
+              f"({hv['bytes']} B, 0 deferred), allocators clean")
+        return 0
 
     # ---- cache OFF: byte-for-byte the PR-15 engine ------------------
     eng = PagedDecodeEngine(cfg, params, batch=4, max_len=48, block_size=8,

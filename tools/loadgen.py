@@ -226,10 +226,12 @@ def build_tiny_engine(batch: int = 2, telemetry=None,
     drives (one place to keep the shape honest across smoke/bench).
 
     ``engine``: "lanes" (the seed fixed-lane engine — the default here
-    because the SLO smokes/benches pin its calibrated behavior) or
+    because the SLO smokes/benches pin its calibrated behavior),
     "paged" (the PR 15 continuous-batching engine; the returned
     prefiller is then only a call-site convenience — chunked prefill
-    runs in-engine and run_load's prefiller argument is ignored).
+    runs in-engine and run_load's prefiller argument is ignored), or
+    "disagg" (the GROVE_DISAGG prefill→decode pair behind one engine
+    interface — same paged geometry on both tiers).
     """
     import dataclasses as dc
 
@@ -238,7 +240,7 @@ def build_tiny_engine(batch: int = 2, telemetry=None,
 
     from grove_tpu.models import llama
     from grove_tpu.serving.engine import (DecodeEngine, PagedDecodeEngine,
-                                          PrefillWorker)
+                                          PrefillWorker, make_disagg)
 
     cfg = dc.replace(llama.CONFIGS["test-tiny"], dtype=jnp.float32,
                      max_seq_len=64)
@@ -248,6 +250,10 @@ def build_tiny_engine(batch: int = 2, telemetry=None,
         eng = PagedDecodeEngine(cfg, params, batch=batch,
                                 block_size=8, prefill_chunk=8,
                                 host_sync_interval=4, telemetry=telemetry)
+    elif engine == "disagg":
+        eng = make_disagg(cfg, params, batch=batch, block_size=8,
+                          prefill_chunk=8, host_sync_interval=4,
+                          telemetry=telemetry)
     else:
         eng = DecodeEngine(cfg, params, batch=batch, host_sync_interval=4,
                            telemetry=telemetry)
@@ -262,18 +268,23 @@ def main(argv=None) -> int:
                         help="peak rate as a multiple of --base-rate")
     parser.add_argument("--batch", type=int, default=2)
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--engine", choices=("lanes", "paged"),
+    parser.add_argument("--engine", choices=("lanes", "paged", "disagg"),
                         default="lanes",
                         help="decode engine flavor (paged = the "
-                        "continuous-batching rebuild)")
+                        "continuous-batching rebuild; disagg = the "
+                        "prefill/decode pair over the block handoff)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="shorthand for --engine disagg")
     parser.add_argument("--shared-prefix", action="store_true",
                         help="90/10 shared/cold prompts over a fixed "
                         "system-prompt pool (prefix-cache proof "
                         "traffic; implies --engine paged)")
     parser.add_argument("--shared-frac", type=float, default=0.9)
     args = parser.parse_args(argv)
-    if args.shared_prefix:
-        args.engine = "paged"   # only the paged engine has the cache
+    if args.disagg:
+        args.engine = "disagg"
+    if args.shared_prefix and args.engine == "lanes":
+        args.engine = "paged"   # only the paged engines have the cache
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from grove_tpu.serving.slo import EngineTelemetry
@@ -281,7 +292,7 @@ def main(argv=None) -> int:
     tel = EngineTelemetry()
     eng, pw = build_tiny_engine(batch=args.batch, telemetry=tel,
                                 engine=args.engine)
-    if args.engine == "paged":
+    if args.engine in ("paged", "disagg"):
         # Pay every bucket's XLA build before offering load, as a
         # deployment would — otherwise a short run's TTFT digest is a
         # compile-stall story, not a serving one.
@@ -317,6 +328,13 @@ def main(argv=None) -> int:
               f"{p['cached_blocks']} cached blocks, "
               f"{p['tokens_matched_total']} tokens matched, "
               f"{p['cow_copies']} CoW copies")
+    if args.engine == "disagg":
+        h = eng.handoff_view()
+        print(f"handoff: {h['requests']} requests, {h['blocks']} cold + "
+              f"{h['shared_blocks']} shared blocks, "
+              f"{h['bytes']} bytes moved, "
+              f"{h['ms_per_request']:.2f} ms/request, "
+              f"{h['deferred']} deferred")
     return 0
 
 
