@@ -170,6 +170,12 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# /debug/xprof renders -> grovectl engine-profile exits 0
 	@# (docs/design/data-plane-observability.md).
 	$(PY) tools/engine_profile_smoke.py
+	@# request-trace smoke: mixed workload through the disagg pair with
+	@# client-side tagging -> every phase stamped in causal order ->
+	@# client/engine clocks cross-checked -> /debug/requests serves ->
+	@# grovectl request-trace resolves a rid with the dominant phase
+	@# starred (docs/design/request-tracing.md).
+	$(PY) tools/reqtrace_smoke.py
 	@# decode smoke: the paged continuous-batching engine through a
 	@# mixed-length workload — pinned per-bucket lowerings, ZERO
 	@# steady-state recompiles, token parity vs the lanes engine,

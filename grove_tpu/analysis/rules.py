@@ -225,6 +225,7 @@ class JaxInTelemetry(Rule):
     TELEMETRY_MODULES = {
         "grove_tpu/serving/slo.py",
         "grove_tpu/serving/xprof.py",
+        "grove_tpu/serving/reqtrace.py",
         "grove_tpu/serving/metrics_push.py",
         "grove_tpu/runtime/metrics.py",
         "grove_tpu/runtime/servingwatch.py",
@@ -635,6 +636,45 @@ class HostSyncInStepLoop(Rule):
         return None
 
 
+class ReqtraceInStepLoop(HostSyncInStepLoop):
+    """The request observatory's hot-path discipline (PR 19,
+    docs/design/request-tracing.md): per-request seam stamps
+    (enqueue/admit/handoff/done) are unconditional but fire once per
+    REQUEST from named helpers; anything recorded per TICK from the
+    dispatch path — a prefill chunk span, a spec-window note — takes
+    the recorder's lock every engine tick and must sit behind the
+    sampling gate (``traced = rt is not None and rt.should_sample()``),
+    exactly like xprof's flight recorder. An ungated note call in
+    ``_decode_tick``/``_prefill_tick`` turns "sampled decoration" into
+    a per-tick lock acquisition — the overhead pin this rule keeps
+    honest. Reuses the host-sync rule's walk: same step-path scope,
+    same gate detection (plus the reqtrace gate's ``traced`` flag)."""
+
+    name = "reqtrace-gate"
+    description = ("reqtrace span recording on the engine dispatch "
+                   "path (step/run/_decode_tick/_prefill_tick) must "
+                   "sit behind the sampling gate (traced/sampled/"
+                   "should_sample)")
+
+    GATE_NAMES = {"sampled", "should_sample", "traced"}
+    NOTE_METHODS = {
+        "note_enqueue", "note_admit", "note_prefix", "note_chunk",
+        "note_prefill_done", "note_handoff", "note_decode_start",
+        "note_preempt", "note_resume", "note_spec_window",
+        "note_done", "adopt_trace",
+    }
+
+    def _sync_call(self, node: ast.Call) -> str | None:
+        chain = self.attr_chain(node.func)
+        if chain and chain[-1] in self.NOTE_METHODS:
+            return (f".{chain[-1]}() on the step path outside the "
+                    "sampling gate — per-tick span recording takes "
+                    "the recorder lock every dispatch; gate it with "
+                    "``traced = rt is not None and rt.should_sample()``"
+                    " or stamp once per request from a named helper")
+        return None
+
+
 class WriteToSharedBlock(Rule):
     """The prefix cache's write-safety contract (PR 16,
     docs/design/prefix-cache.md): with refcounted block sharing, a KV
@@ -708,5 +748,6 @@ ALL_RULES = [
     ThreadJoinInStop,
     CloneBeforeMutate,
     HostSyncInStepLoop,
+    ReqtraceInStepLoop,
     WriteToSharedBlock,
 ]

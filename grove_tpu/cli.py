@@ -759,6 +759,50 @@ def cmd_engine_profile(args: argparse.Namespace) -> int:
     return 1 if storms else 0
 
 
+def cmd_request_trace(args: argparse.Namespace) -> int:
+    """Render one request's span timeline from the serve daemon
+    (GET /debug/requests/<ns>/<name>): phase attribution with the
+    dominant phase starred, then the span-by-span timeline — the
+    "why was this request slow" view. Without ``rid``, list the
+    retained traces (slowest-K starred) so an exemplar id from
+    serving-status can be picked off. Exit 0 when the requested trace
+    rendered, 1 when it was never retained (ring churn or never
+    traced)."""
+    from grove_tpu.serving.reqtrace import render_request_trace
+    status, data = _http(
+        args.server, f"/debug/requests/{args.namespace}/{args.name}",
+        ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    if args.rid is None:
+        scope = data.get("scope") or {}
+        print(f"engine:    {scope.get('namespace', '?')}/"
+              f"{scope.get('name', '?')}")
+        ring = data.get("ring") or {}
+        print(f"retained:  {ring.get('len', 0)}/"
+              f"{ring.get('capacity', 0)} finished "
+              f"({ring.get('finished_total', 0)} total, "
+              f"{data.get('dropped', 0)} dropped, "
+              f"{data.get('live', 0)} live)")
+        slowest = {t.get("rid") for t in data.get("slowest") or []}
+        rows = {t.get("rid"): t for t in data.get("traces") or []}
+        for t in data.get("slowest") or []:
+            rows.setdefault(t.get("rid"), t)
+        for rid in sorted(rows):
+            t = rows[rid]
+            star = " *" if rid in slowest else ""
+            print(f"  rid {rid:<8} e2e {t.get('e2e_s', 0.0) * 1e3:>9.1f} ms"
+                  f"  dominant {t.get('dominant') or '?'}{star}")
+        return 0
+    found = any(t.get("rid") == args.rid
+                for t in (data.get("slowest") or [])
+                + (data.get("traces") or []))
+    for line in render_request_trace(data, args.rid):
+        print(line)
+    return 0 if found else 1
+
+
 def cmd_defrag_status(args: argparse.Namespace) -> int:
     """Render the serve daemon's defrag plan ledger: the in-flight
     migration (hold/drain/rebind state), recent completed/aborted
@@ -1389,6 +1433,19 @@ def main(argv: list[str] | None = None) -> int:
     ep.add_argument("--server", default=default_server)
     add_ca(ep)
     ep.set_defaults(fn=cmd_engine_profile)
+
+    rtr = sub.add_parser(
+        "request-trace",
+        help="request observatory view of a serving engine: one rid's "
+             "span timeline with the dominant phase starred (the "
+             "'why was this request slow' answer; no rid lists the "
+             "retained traces — slowest-K starred)")
+    rtr.add_argument("name")
+    rtr.add_argument("rid", nargs="?", type=int, default=None)
+    rtr.add_argument("--namespace", default="default")
+    rtr.add_argument("--server", default=default_server)
+    add_ca(rtr)
+    rtr.set_defaults(fn=cmd_request_trace)
 
     dfs = sub.add_parser(
         "defrag-status",

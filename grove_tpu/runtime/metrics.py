@@ -698,3 +698,27 @@ GLOBAL_METRICS.describe_histogram(
     "copy, synced end-to-end), observed on xprof-sampled adoptions "
     "only — the transfer seam's latency distribution",
     buckets=DEVICE_STEP_BUCKETS)
+# Request observatory (serving/reqtrace.py,
+# docs/design/request-tracing.md): per-request phase attribution.
+# Spans sub-millisecond queue waits through multi-second preemption
+# storms, so the ladder is wider than the duration defaults on both
+# ends. One observation per phase per FINISHED request (unconditional
+# seam stamps, never the sampled per-tick decoration).
+REQUEST_PHASE_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+                         0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                         60.0)
+GLOBAL_METRICS.describe_histogram(
+    "grove_request_phase_seconds",
+    "Wall seconds one finished request spent in each serving phase "
+    "(queue_wait|prefix_match|prefill|handoff|decode|"
+    "preempt_recompute), accumulated from unconditional lifecycle "
+    "stamps and observed once per phase at completion — the p99 "
+    "attribution family (argmax = the request's dominant phase)",
+    buckets=REQUEST_PHASE_BUCKETS)
+GLOBAL_METRICS.describe(
+    "grove_reqtrace_dropped_total",
+    "Request traces shed by the observatory's bounds (live-cap "
+    "overflow on a submit storm, finished-ring eviction churn) — "
+    "nonzero means /debug/requests is a sample of the traffic, not "
+    "the census; GROVE_REQTRACE_RING/GROVE_REQTRACE_LIVE raise the "
+    "bounds")

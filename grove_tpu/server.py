@@ -60,6 +60,13 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       roofline estimates; grovectl
                                       engine-profile renders it; same
                                       read gate as /debug/placement)
+  GET  /debug/requests/<ns>/<name>    request observatory payload for
+                                      one serving engine (per-request
+                                      span traces, p99 phase
+                                      attribution, slowest-K ring;
+                                      grovectl request-trace renders
+                                      it; same read gate as
+                                      /debug/xprof)
   GET  /debug/disruption              disruption-contract ledger: live
                                       notices with barrier state,
                                       in-flight/recent spot-reclaim
@@ -490,6 +497,9 @@ class ApiServer:
                     elif len(parts) == 4 and parts[0] == "debug" \
                             and parts[1] == "xprof":
                         self._debug_xprof(parts[2], parts[3])
+                    elif len(parts) == 4 and parts[0] == "debug" \
+                            and parts[1] == "requests":
+                        self._debug_requests(parts[2], parts[3])
                     elif url.path == "/debug/defrag":
                         self._debug_defrag()
                     elif url.path == "/debug/disruption":
@@ -840,6 +850,16 @@ class ApiServer:
                 the read gate, not the profiling gate. NotFoundError
                 from the twin maps to 404 in do_GET's handler."""
                 self._send(200, cluster.client.debug_xprof(
+                    name, namespace))
+
+            def _debug_requests(self, namespace: str, name: str):
+                """GET /debug/requests/<ns>/<name> — one engine's
+                request-observatory payload (``grovectl
+                request-trace`` renders it). Per-request spans and
+                phase attribution, read-gated exactly like
+                /debug/xprof. NotFoundError from the twin maps to 404
+                in do_GET's handler."""
+                self._send(200, cluster.client.debug_requests(
                     name, namespace))
 
             def _workload_owns(self, actor: str, payload: dict) -> bool:

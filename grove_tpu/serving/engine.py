@@ -205,7 +205,8 @@ class DecodeEngine:
                  sampler: SamplerConfig | None = None,
                  quant: str | None = None,
                  telemetry=None,
-                 xprof=None):
+                 xprof=None,
+                 reqtrace=None):
         self.cfg = cfg
         # Init-only: the sampled step closes over this config at compile
         # time, so later mutation cannot take effect (and is rejected).
@@ -334,6 +335,21 @@ class DecodeEngine:
             self._step_block_sampled = wrap("step_block_sampled",
                                             self._step_block_sampled)
 
+        # Request observatory (serving/reqtrace.py): bounded per-request
+        # span recorder stamping lifecycle seams the engine already
+        # crosses — nothing on the JIT path. Same contract as xprof:
+        # a RequestObservatory (caller names the scope), None
+        # (auto-create unless GROVE_REQTRACE=0), or False (explicitly
+        # off). Off means self.reqtrace is None and every stamp site
+        # short-circuits on the None check — the prior hot path exactly.
+        self.reqtrace = None
+        if reqtrace is not False:
+            from grove_tpu.serving import reqtrace as reqtrace_mod
+            if reqtrace is not None:
+                self.reqtrace = reqtrace
+            elif reqtrace_mod.enabled():
+                self.reqtrace = reqtrace_mod.RequestObservatory()
+
     @property
     def sampler(self) -> SamplerConfig:
         return self._sampler
@@ -365,6 +381,11 @@ class DecodeEngine:
                       enqueue_ts=time.time())
         self._next_rid += 1
         self._queue.append(req)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_enqueue(req.rid, ts=req.enqueue_ts,
+                            prompt_len=len(req.prompt),
+                            max_new_tokens=max_new_tokens)
         self._report_metric()
         return req.rid
 
@@ -384,6 +405,10 @@ class DecodeEngine:
         if self.telemetry is not None:
             self.telemetry.sample_gauges(len(self._queue),
                                          self.kv_lane_utilization)
+            if self.reqtrace is not None \
+                    and self.reqtrace.finished_total:
+                self.telemetry.sample_phases(
+                    self.reqtrace.phase_stats())
         if self.xprof is not None:
             self.xprof.observe_memory(self, self.telemetry)
 
@@ -402,11 +427,17 @@ class DecodeEngine:
         so it is counted here — the drain only sees decode tokens."""
         _stamp_admit_impl(req, now, admit, self._ttft_compat,
                           self.telemetry)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_admit(req.rid, ts=req.admit_ts)
 
     def _complete(self, req: Request) -> None:
         """Shared completion bookkeeping (window drain + lane retire):
         stamp done, record, and fold the request into the telemetry."""
         _complete_impl(req, self.completed, self.telemetry)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_done(req.rid, ts=req.done_ts)
 
     # ---- standalone mode (bench path) ----
 
@@ -515,6 +546,12 @@ class DecodeEngine:
             self._stamp_admit(request, time.time(),
                               admit=request.admit_ts or None)
             request.generated.append(result.next_token)
+            rt = self.reqtrace
+            if rt is not None:
+                # Lane insert IS the prefill→decode splice: the worker's
+                # prefill ran between queue-exit and here.
+                rt.note_prefill_done(request.rid)
+                rt.note_decode_start(request.rid)
 
     def admit_from_queue(self, prefiller: PrefillWorker) -> int:
         """Move queued requests through the prefiller into free lanes."""
@@ -762,6 +799,7 @@ class PagedDecodeEngine:
                  quant: str | None = None,
                  telemetry=None,
                  xprof=None,
+                 reqtrace=None,
                  mesh=None,
                  prefix_cache: bool | None = None,
                  spec_decode: bool | None = None,
@@ -989,6 +1027,20 @@ class PagedDecodeEngine:
             # Roofline byte basis: the observatory's KV terms must use
             # what this engine actually moves.
             self.xprof.kv_quant = self.kv_quant
+
+        # Request observatory (serving/reqtrace.py), same contract as
+        # the lanes engine: RequestObservatory | None (auto unless
+        # GROVE_REQTRACE=0) | False. The scheduler gets the same
+        # reference so preemption boundaries stamp from the victim
+        # path itself — unconditional, never sampled away.
+        self.reqtrace = None
+        if reqtrace is not False:
+            from grove_tpu.serving import reqtrace as reqtrace_mod
+            if reqtrace is not None:
+                self.reqtrace = reqtrace
+            elif reqtrace_mod.enabled():
+                self.reqtrace = reqtrace_mod.RequestObservatory()
+        self._sched.reqtrace = self.reqtrace
 
         # With sharing on, pay the ONE copy-on-write executable at
         # bring-up (a null→null block copy): it is workload-independent
@@ -1311,6 +1363,11 @@ class PagedDecodeEngine:
                       enqueue_ts=time.time())
         self._next_rid += 1
         self._queue.append(req)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_enqueue(req.rid, ts=req.enqueue_ts,
+                            prompt_len=len(prompt),
+                            max_new_tokens=max_new_tokens)
         self._report_metric()
         return req.rid
 
@@ -1356,6 +1413,10 @@ class PagedDecodeEngine:
                 self.telemetry.sample_spec(self.spec_stats())
             if self.handoff_stats["requests"]:
                 self.telemetry.sample_handoff(self.handoff_view())
+            if self.reqtrace is not None \
+                    and self.reqtrace.finished_total:
+                self.telemetry.sample_phases(
+                    self.reqtrace.phase_stats())
         if self.xprof is not None:
             self.xprof.observe_memory(self, self.telemetry)
             if self.spec_decode:
@@ -1431,9 +1492,15 @@ class PagedDecodeEngine:
                      admit: float | None = None) -> None:
         _stamp_admit_impl(req, now, admit, self._ttft_compat,
                           self.telemetry)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_admit(req.rid, ts=req.admit_ts)
 
     def _complete(self, req: Request) -> None:
         _complete_impl(req, self.completed, self.telemetry)
+        rt = self.reqtrace
+        if rt is not None:
+            rt.note_done(req.rid, ts=req.done_ts)
 
     # ---- disaggregated handoff (the consumer side) ----
 
@@ -1543,6 +1610,25 @@ class PagedDecodeEngine:
         sched.adopt_running(seq)
         self._composition_dirty = True
         moved_bytes = cold * self._block_bytes
+        rt = self.reqtrace
+        if rt is not None:
+            # The trace rode the payload across the seam: under the
+            # shared disagg recorder this is a no-op, with per-tier
+            # recorders it splices — either way the rid's timeline is
+            # one trace. Adoption closes the handoff span (detach →
+            # remap/copy → here) and opens this tier's decode segment;
+            # a recompute replay closes its preempt window instead.
+            rt.adopt_trace(payload.trace)
+            if matched:
+                rt.note_prefix(payload.req.rid, n_shared,
+                               len(payload.blocks), matched)
+            if payload.recompute:
+                rt.note_resume(payload.req.rid)
+            else:
+                rt.note_handoff(payload.req.rid, payload.created_ts,
+                                blocks=cold, nbytes=moved_bytes,
+                                shared=n_shared)
+                rt.note_decode_start(payload.req.rid)
         st = self.handoff_stats
         st["requests"] += 1
         st["blocks"] += cold
@@ -1586,6 +1672,12 @@ class PagedDecodeEngine:
             self._queue.popleft()
             if not req.admit_ts:
                 req.admit_ts = popped
+            rt = self.reqtrace
+            if rt is not None:
+                # Queue exit stamps here (real time, not the
+                # retroactive _stamp_admit at prefill completion) so
+                # queue_wait never absorbs chunked-prefill wall.
+                rt.note_admit(req.rid, ts=req.admit_ts)
             admitted += 1
         if admitted:
             self._report_metric()
@@ -1796,6 +1888,10 @@ class PagedDecodeEngine:
         fn = self._get_prefill(W)
         x = self.xprof
         sampled = x is not None and x.should_sample()
+        rt = self.reqtrace
+        traced = rt is not None and rt.should_sample()
+        if traced:
+            tr0 = time.perf_counter()
         if sampled:
             jax.block_until_ready(self.kv.k)
             t0 = time.perf_counter()
@@ -1821,6 +1917,12 @@ class PagedDecodeEngine:
         if sampled:
             jax.block_until_ready(logits)
             x.record("prefill", time.perf_counter() - t0, tokens=valid)
+        if traced:
+            # Decoration only (accumulate=False): an unsynced chunk
+            # wall times dispatch enqueue, and the sampled subset never
+            # feeds phase attribution — the admit→done boundaries do.
+            rt.note_chunk(seq.req.rid, W, time.perf_counter() - tr0,
+                          valid)
         seq.pos += valid
         if seq.prefill_done:
             self._finish_prefill(seq, logits)
@@ -1864,6 +1966,15 @@ class PagedDecodeEngine:
             req.generated.append(tok)
         seq.n_generated = len(req.generated)
         seq.last_token = tok
+        rt = self.reqtrace
+        if rt is not None:
+            if seq.recompute:
+                # Recompute replay finished: the preempt_recompute
+                # window closes and decode resumes.
+                rt.note_resume(req.rid)
+            else:
+                rt.note_prefill_done(req.rid)
+                rt.note_decode_start(req.rid)
         self._sched.promote(seq)
         self._composition_dirty = True
         if seq.finished():
@@ -2159,6 +2270,11 @@ class PagedDecodeEngine:
         spec_seqs: dict = {}   # insertion-ordered dedupe
         spec_accepted = spec_drafted = 0
         st = self._spec_stats
+        rt = self.reqtrace
+        # Per-window acceptance decoration, thinned by the sampling
+        # gate (per-seq aggregation over this drain's folded windows).
+        spec_traced = rt is not None and rt.should_sample()
+        spec_note: dict = {}
         for entry in entries:
             if len(entry) == 2:
                 arr, order = entry
@@ -2202,6 +2318,10 @@ class PagedDecodeEngine:
                 st["draft_tokens"] += self.spec_k
                 st["committed_tokens"] += n
                 st["rows"] += 1
+                if spec_traced:
+                    agg = spec_note.setdefault(id(seq), [seq, 0, 0])
+                    agg[1] += max(0, n - 1)
+                    agg[2] += self.spec_k
                 for t in toks:
                     if len(req.generated) >= req.max_new_tokens:
                         # Overshoot past max_new: pos already advanced
@@ -2236,6 +2356,10 @@ class PagedDecodeEngine:
                     retired = True
             if retired:
                 self._report_metric()
+        if spec_note:
+            for seq, acc, drafted in spec_note.values():
+                rt.note_spec_window(seq.req.rid, self.steps, acc,
+                                    drafted)
         if self.telemetry is not None:
             self.telemetry.add_tokens(appended)
         if self._finishing:
@@ -2344,11 +2468,19 @@ class PrefillEngine(PagedDecodeEngine):
             self._report_metric()
             return
         self._sched.detach_prefill_head(seq)
+        rt = self.reqtrace
+        if rt is not None and not seq.recompute:
+            # Prefill phase closes at detach; the handoff span runs
+            # from the payload's created_ts to adoption on the decode
+            # tier (a recompute replay closes its preempt window at
+            # adoption instead).
+            rt.note_prefill_done(req.rid)
         self.outbox.append(HandoffPayload(
             rid=req.rid, req=req, tokens=seq.tokens, first_token=tok,
             blocks=list(seq.blocks.blocks), pos=seq.pos,
             n_generated=seq.n_generated, recompute=seq.recompute,
-            source=self, block_bytes=self._block_bytes))
+            source=self, block_bytes=self._block_bytes,
+            trace=rt.live_trace(req.rid) if rt is not None else None))
         self.handoffs_produced += 1
         self._report_metric()
 
@@ -2403,6 +2535,10 @@ class DisaggServing:
         self.prefill = prefill
         self.decode = decode
         self.telemetry = decode.telemetry
+        # One recorder spans the seam (make_disagg hands both tiers
+        # the same instance, like the shared telemetry); the decode
+        # tier's is authoritative for the facade surface.
+        self.reqtrace = decode.reqtrace
         self.ticks = 0
 
     # -- engine interface (run_load/bench/smoke drivers) --
@@ -2569,6 +2705,13 @@ class DisaggServing:
         # Completions already made are history, not state — carry them.
         prefill.completed.extend(old.completed)
         prefill._next_rid = max(prefill._next_rid, old._next_rid)
+        # Trace continuity across the swap: the replacement tier joins
+        # the facade's recorder (rids persist, so rescued requests keep
+        # appending to the SAME trace — the chaos-recovery invariant
+        # tests/test_reqtrace.py pins). Off stays off uniformly.
+        if prefill.reqtrace is not self.reqtrace:
+            prefill.reqtrace = self.reqtrace
+            prefill._sched.reqtrace = self.reqtrace
         self.prefill = prefill
         self.decode.warmup_handoff(prefill)
         return len(fresh) + len(carriers)
@@ -2602,7 +2745,7 @@ def disagg_mode() -> bool:
 def make_disagg(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
                 mesh=None, prefill_slots: int | None = None,
                 prefill_num_blocks: int | None = None,
-                telemetry=None, xprof=None,
+                telemetry=None, xprof=None, reqtrace=None,
                 **common) -> DisaggServing:
     """Build the disaggregated pair: params are resolved ONCE and
     shared (both tiers serve the same model; in a real deployment each
@@ -2622,15 +2765,22 @@ def make_disagg(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
     common.pop("spec_decode", None)  # decode-tier feature, not wired
     common.pop("spec_k", None)
     common.pop("draft_params", None)
+    # ONE request recorder for both tiers (the telemetry pattern): a
+    # trace follows its rid across the handoff seam with no splice.
+    # False when tracing is off so neither tier auto-creates its own.
+    if reqtrace is None:
+        from grove_tpu.serving import reqtrace as reqtrace_mod
+        reqtrace = (reqtrace_mod.RequestObservatory()
+                    if reqtrace_mod.enabled() else False)
     pre_kwargs = dict(common)
     if prefill_num_blocks is not None:
         pre_kwargs["num_blocks"] = prefill_num_blocks
     pre = PrefillEngine(cfg, params, batch=prefill_slots or batch,
                         mesh=mesh, telemetry=telemetry,
-                        **pre_kwargs)
+                        reqtrace=reqtrace, **pre_kwargs)
     dec = PagedDecodeEngine(cfg, params, batch=batch, mesh=mesh,
                             telemetry=telemetry, xprof=xprof,
-                            **common)
+                            reqtrace=reqtrace, **common)
     return DisaggServing(pre, dec)
 
 
@@ -2640,7 +2790,7 @@ def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
                 sampler: SamplerConfig | None = None,
                 quant: str | None = None,
                 metric_hook=None, telemetry=None, xprof=None,
-                mesh=None, mode: str | None = None,
+                reqtrace=None, mesh=None, mode: str | None = None,
                 **paged_kwargs):
     """Engine factory honoring GROVE_ENGINE (and, for the paged
     engine, GROVE_DISAGG). Paged-only knobs (block_size, num_blocks,
@@ -2650,12 +2800,13 @@ def make_engine(cfg: LlamaConfig, key_or_params, *, batch: int = 8,
     common = dict(batch=batch, max_len=max_len,
                   host_sync_interval=host_sync_interval, sampler=sampler,
                   quant=quant, metric_hook=metric_hook,
-                  telemetry=telemetry, xprof=xprof)
+                  telemetry=telemetry, xprof=xprof, reqtrace=reqtrace)
     if mode == "lanes":
         return DecodeEngine(cfg, key_or_params, **common)
     if disagg_mode():
         common.pop("xprof")
+        common.pop("reqtrace")
         return make_disagg(cfg, key_or_params, mesh=mesh, xprof=xprof,
-                           **common, **paged_kwargs)
+                           reqtrace=reqtrace, **common, **paged_kwargs)
     return PagedDecodeEngine(cfg, key_or_params, mesh=mesh,
                              **common, **paged_kwargs)

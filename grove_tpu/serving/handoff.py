@@ -70,6 +70,12 @@ class HandoffPayload:
     recompute: bool
     source: object                  # producing PrefillEngine
     block_bytes: int                # bytes one block moves (quant-aware)
+    # The request's live trace (serving/reqtrace.RequestTrace | None):
+    # carried across the seam so ONE trace spans both tiers — the
+    # adopting engine splices it via ``RequestObservatory.adopt_trace``
+    # (a no-op under the shared disagg recorder, a real splice with
+    # per-tier recorders). None when tracing is off.
+    trace: object = None
     created_ts: float = dataclasses.field(default_factory=time.time)
     _released: bool = dataclasses.field(default=False, repr=False)
 

@@ -110,6 +110,18 @@ class EngineTelemetry:
         # + per-request derivatives; None until a handoff lands, or
         # forever when GROVE_DISAGG=0).
         self.handoff: dict | None = None
+        # Latest per-phase attribution stats (reqtrace.phase_stats
+        # shape: {phase: {count, total_s, dominant, p50_ms, p99_ms}};
+        # None until the engine samples once, or forever when
+        # GROVE_REQTRACE=0).
+        self.phases: dict | None = None
+        # Exemplar linkage (docs/design/request-tracing.md): the WORST
+        # observed request per latency metric, by rid — the digest's
+        # percentile rows carry these so a breached p99 resolves to a
+        # full trace via ``grovectl request-trace <rid>``. The
+        # slowest-K retained ring on the reqtrace side guarantees the
+        # exemplar's trace outlives ring churn.
+        self.exemplars: dict[str, dict] = {}
 
     # ---- engine-side hooks ----
 
@@ -147,6 +159,12 @@ class EngineTelemetry:
         deferred count in the digest is the transfer seam saturating."""
         self.handoff = stats
 
+    def sample_phases(self, stats: dict) -> None:
+        """Latest per-phase p99 attribution (reqtrace.phase_stats
+        payload) — point-sampled like the gauges; the digest's
+        "why slow" breakdown next to the "how slow" percentiles."""
+        self.phases = stats
+
     def add_tokens(self, n: int) -> None:
         """Decoded-token counter, bumped once per drained window (NOT
         per token — the drain already walks the window)."""
@@ -163,18 +181,22 @@ class EngineTelemetry:
         admit = req.admit_ts or enq
         first = req.first_token_ts or admit
         n_gen = len(req.generated)
+        rid = getattr(req, "rid", -1)
         with self._lock:
             self.requests_completed += 1
-            self._observe("queue_wait_seconds", max(0.0, admit - enq))
-            self._observe("ttft_seconds", max(0.0, first - enq))
-            self._observe("e2e_latency_seconds", max(0.0, done - enq))
+            self._observe("queue_wait_seconds", max(0.0, admit - enq),
+                          rid)
+            self._observe("ttft_seconds", max(0.0, first - enq), rid)
+            self._observe("e2e_latency_seconds", max(0.0, done - enq),
+                          rid)
             if n_gen > 1:
                 # The first token is the prefill's; the remaining
                 # n_gen-1 are decode steps — TPOT is their mean pace.
                 self._observe("tpot_seconds",
-                              max(0.0, done - first) / (n_gen - 1))
+                              max(0.0, done - first) / (n_gen - 1), rid)
 
-    def _observe(self, name: str, value: float) -> None:
+    def _observe(self, name: str, value: float,
+                 rid: int = -1) -> None:
         h = self._hists[name]
         for i, ub in enumerate(h.buckets):
             if value <= ub:
@@ -184,6 +206,11 @@ class EngineTelemetry:
             h.counts[-1] += 1
         h.sum += value
         h.count += 1
+        if rid >= 0:
+            ex = self.exemplars.get(name)
+            if ex is None or value > ex["value_s"]:
+                self.exemplars[name] = {"rid": rid,
+                                        "value_s": value}
 
     # ---- read surface ----
 
@@ -212,7 +239,11 @@ class EngineTelemetry:
                      for n, h in self._hists.items()}
             completed = self.requests_completed
             tokens = self.tokens_total
+            exemplars = {n: dict(ex)
+                         for n, ex in self.exemplars.items()}
         return {
+            "exemplars": exemplars,
+            "phases": self.phases,
             "queue_depth": self.queue_depth,
             "kv_utilization": self.kv_utilization,
             "memory": self.memory,
@@ -282,6 +313,18 @@ def samples_for_push(telemetry: EngineTelemetry) -> list[dict]:
              "agg": "avg"},
             {"metric": "spec_accepted_tokens",
              "value": float(sp.get("accepted_tokens", 0)), "agg": "sum"},
+        ]
+    if s.get("phases"):
+        # p99 attribution (serving/reqtrace.py): per-phase p99 wall
+        # rides the digest so the control plane sees WHERE the tail
+        # lives, not just how long it is. Worst replica wins (max),
+        # like the other tail latencies. These are also the
+        # ``request_phase_p99_ms`` rows the bench history/dashboard
+        # "p99 attribution" section consumes.
+        samples += [
+            {"metric": f"request_phase_p99_ms:{phase}",
+             "value": float(d.get("p99_ms", 0.0)), "agg": "max"}
+            for phase, d in sorted(s["phases"].items())
         ]
     if s.get("handoff"):
         ho = s["handoff"]

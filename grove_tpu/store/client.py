@@ -216,6 +216,27 @@ class Client:
                 f"elsewhere; registered: {known})")
         return obs.payload()
 
+    def debug_requests(self, name: str,
+                       namespace: str = "default") -> dict:
+        """One engine's request-observatory payload (finished-trace
+        ring, slowest-K, per-phase p99 attribution) — the in-process
+        twin of ``GET /debug/requests/<ns>/<name>`` (same payload
+        shape; grovectl request-trace renders either). Raises
+        NotFoundError when no recorder is registered under the scope
+        in this process (engine not running here, or
+        GROVE_REQTRACE=0)."""
+        from grove_tpu.runtime.errors import NotFoundError
+        from grove_tpu.serving import reqtrace
+        rec = reqtrace.recorder_for(name, namespace)
+        if rec is None:
+            known = ", ".join(f"{ns}/{n}"
+                              for ns, n in reqtrace.scopes()) or "none"
+            raise NotFoundError(
+                f"no request recorder registered for "
+                f"{namespace}/{name} in this process (GROVE_REQTRACE=0,"
+                f" or the engine runs elsewhere; registered: {known})")
+        return rec.payload()
+
     def debug_serving(self, name: str, namespace: str = "default") -> dict:
         """One serving scope's SLO state — the in-process twin of
         ``GET /debug/serving/<ns>/<name>`` (same payload shape;

@@ -307,6 +307,14 @@ class HttpClient:
         return self._request(
             "GET", f"/debug/xprof/{quote(namespace)}/{quote(name)}")
 
+    def debug_requests(self, name: str,
+                       namespace: str = "default") -> dict:
+        """One engine's request-observatory payload from
+        ``GET /debug/requests/<ns>/<name>`` (the wire twin of
+        ``Client.debug_requests``; 404 maps to NotFoundError)."""
+        return self._request(
+            "GET", f"/debug/requests/{quote(namespace)}/{quote(name)}")
+
     def debug_defrag(self) -> dict:
         """The defrag plan ledger from ``GET /debug/defrag`` (the wire
         twin of ``Client.debug_defrag``; 404 maps to NotFoundError)."""

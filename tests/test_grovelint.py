@@ -438,6 +438,70 @@ def test_host_sync_rule_scoped_to_engine_module():
     assert lint(STEP_SYNC_BAD, "tools/bench_decode.py") == []
 
 
+# ---- reqtrace-gate -------------------------------------------------------
+
+REQTRACE_BAD = """
+    class Engine:
+        def _prefill_tick(self):
+            seq = self._sched.next_prefill()
+            self.reqtrace.note_chunk(seq.req.rid, 8, 0.001, 8)
+
+        def _decode_tick(self):
+            rt = self.reqtrace
+            rt.note_spec_window(1, self.steps, 2, 4)
+"""
+
+REQTRACE_GOOD = """
+    class Engine:
+        def _prefill_tick(self):
+            seq = self._sched.next_prefill()
+            rt = self.reqtrace
+            traced = rt is not None and rt.should_sample()
+            if traced:
+                rt.note_chunk(seq.req.rid, 8, 0.001, 8)
+
+        def _stamp_admit(self, req):
+            # Once per request, in a named helper off the tick path:
+            # the unconditional seam stamps are sanctioned.
+            self.reqtrace.note_admit(req.rid, ts=req.admit_ts)
+"""
+
+
+def test_reqtrace_gate_fires():
+    findings = lint(REQTRACE_BAD, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"reqtrace-gate"}
+    # one ungated note per tick function
+    assert len(findings) == 2
+
+
+def test_reqtrace_gated_and_helpers_pass():
+    assert lint(REQTRACE_GOOD, "grove_tpu/serving/engine.py") == []
+
+
+def test_reqtrace_enabled_branch_is_not_a_gate():
+    # `if self.reqtrace is not None:` runs every dispatch — recorder
+    # presence is a mode, not the sampling gate.
+    src = """
+        class Engine:
+            def _decode_tick(self):
+                if self.reqtrace is not None:
+                    self.reqtrace.note_spec_window(1, self.steps, 2, 4)
+    """
+    findings = lint(src, "grove_tpu/serving/engine.py")
+    assert rules_of(findings) == {"reqtrace-gate"}
+
+
+def test_reqtrace_rule_scoped_to_engine_module():
+    assert lint(REQTRACE_BAD, "grove_tpu/serving/reqtrace.py") == []
+    assert lint(REQTRACE_BAD, "tools/loadgen.py") == []
+
+
+def test_jax_rule_covers_reqtrace_module():
+    # PR 19 extension: the observatory is telemetry — same no-jax wall.
+    findings = lint(JAX_BAD, "grove_tpu/serving/reqtrace.py")
+    assert rules_of(findings) == {"jax-in-telemetry"}
+
+
 # ---- write-to-shared-block -----------------------------------------------
 
 COW_BAD = """

@@ -64,6 +64,12 @@ def render(rows: list[dict]) -> str:
                    ("decode_tokens_per_sec_disagg_vs_mono",
                     "decode_tpot_p99_disagg_vs_mono",
                     "disagg_handoff_overhead")]
+    # request_phase_p99_ms:<phase> rows (the SLO digest's reqtrace
+    # attribution, agg=max across replicas) — one history line per
+    # phase, regrouped into one dashboard row per run.
+    phase_rows = [r for r in rows
+                  if str(r.get("metric", "")).startswith(
+                      "request_phase_p99_ms")]
     defrag = [r for r in rows
               if r.get("metric") == "defrag_placeable_per_1k_chips"]
     reclaim = [r for r in rows
@@ -85,8 +91,10 @@ def render(rows: list[dict]) -> str:
                   "chaos_leader_kill_resume_s"}
     ok_all = [r for r in rows if r.get("value", 0) > 0
               and r.get("mode") not in cp_modes
-              and r.get("metric") not in cp_metrics]
-    failed = [r for r in rows if r.get("value", 0) <= 0]
+              and r.get("metric") not in cp_metrics
+              and r not in phase_rows]
+    failed = [r for r in rows if r.get("value", 0) <= 0
+              and r not in phase_rows]
     disagg = [r for r in ok_all if r.get("mode") == "disagg"]
     ok = [r for r in ok_all if r.get("mode") != "disagg"]
     if ready:
@@ -424,6 +432,28 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('handoff_deferred', r.get('deferred', '-'))} "
                 f"| {pre} "
                 f"| {r.get('steady_compiles', '-')} |")
+        out.append("")
+    if phase_rows:
+        groups: dict[tuple, dict] = {}
+        for r in sorted(phase_rows, key=lambda r: r.get("ts", "")):
+            key = (r.get("ts", "?"), r.get("git", "?"))
+            phase = str(r.get("metric", "?")).split(":", 1)[-1]
+            groups.setdefault(key, {})[phase] = float(r.get("value", 0))
+        out += ["## p99 attribution (request observatory)", "",
+                "_per-phase p99 seconds over finished request traces "
+                "(serving/reqtrace.py via the SLO digest's push rows, "
+                "agg=max across replicas); dominant = the phase the "
+                "slow tail spends its time in — resolve an exemplar "
+                "with ``grovectl request-trace`` "
+                "(docs/design/request-tracing.md)_", "",
+                "| when | git | dominant | per-phase p99 ms |",
+                "|---|---|---|---|"]
+        for (ts, git), phases in groups.items():
+            dom = max(phases, key=phases.get) if phases else "-"
+            detail = ", ".join(
+                f"{p}={v:.1f}" for p, v in
+                sorted(phases.items(), key=lambda kv: -kv[1]))
+            out.append(f"| {ts[:16]} | {git} | {dom} | {detail} |")
         out.append("")
     if ok:
         out += ["## Successful runs", "",

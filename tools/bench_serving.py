@@ -292,6 +292,12 @@ def bench_ramp(duration: float, base_rate: float | None,
         if "breach" in marks and "scaled" in marks else -1.0,
         "scaled_from": 1,
         "scaled_to": int(scaled_to),
+        # Reqtrace p99 attribution for this run's requests (the
+        # per-completion rider fed tel_run.phases): main() fans these
+        # out as request_phase_p99_ms:<phase> history rows for the
+        # dashboard's attribution section.
+        "phase_p99_ms": {p: d.get("p99_ms", 0.0) for p, d in
+                         (digest.get("phases") or {}).items()},
     }
 
 
@@ -355,6 +361,9 @@ def main(argv=None) -> int:
               "the latency signal, but not attributable to the ramp",
               file=sys.stderr)
     append_history(row)
+    for phase, p99 in sorted((row.get("phase_p99_ms") or {}).items()):
+        append_history({"metric": f"request_phase_p99_ms:{phase}",
+                        "value": p99, "unit": "ms", "agg": "max"})
     if not over["within_bound"]:
         print("FAIL: telemetry overhead exceeds the 5% tokens/sec pin",
               file=sys.stderr)

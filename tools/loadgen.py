@@ -153,15 +153,35 @@ class LoadStats:
     completed: int = 0
     tokens: int = 0
     wall_s: float = 0.0
+    # --tag-requests rows: one dict per completed request with the
+    # CLIENT-side clocks (send stamped at submit, done stamped when
+    # this loop first OBSERVES the completion). The engine's own trace
+    # measures enqueue→done from the inside; client latency bounds it
+    # from above, and the reqtrace smoke cross-checks the two.
+    requests: list = dataclasses.field(default_factory=list)
 
     @property
     def tokens_per_sec(self) -> float:
         return self.tokens / self.wall_s if self.wall_s > 0 else 0.0
 
 
+def write_request_csv(path: str, rows: list) -> None:
+    """Per-request latency CSV (--tag-requests artifact)."""
+    import csv
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["rid", "send_ts", "done_ts", "latency_ms",
+                    "prompt_len", "new_tokens"])
+        for r in sorted(rows, key=lambda r: r["rid"]):
+            w.writerow([r["rid"], f"{r['send_ts']:.6f}",
+                        f"{r['done_ts']:.6f}",
+                        f"{r['latency_s'] * 1e3:.3f}",
+                        r["prompt_len"], r["new_tokens"]])
+
+
 def run_load(engine, prefiller, schedule: ArrivalSchedule, *,
              telemetry=None, on_tick=None, drain_s: float = 30.0,
-             ) -> LoadStats:
+             tag_requests: bool = False) -> LoadStats:
     """Replay ``schedule`` against a DecodeEngine on the wall clock.
 
     One thread runs both halves: due arrivals are submitted (open loop
@@ -179,20 +199,40 @@ def run_load(engine, prefiller, schedule: ArrivalSchedule, *,
     # run's completions/tokens (deltas, not lifetime totals).
     completed0 = len(engine.completed)
     tokens0 = sum(len(r.generated) for r in engine.completed)
+    sends: dict[int, float] = {}        # --tag-requests: rid → send ts
+    observed = completed0
     start = time.time()
     i = 0
     deadline = start + schedule.profile.duration_s + drain_s
     while True:
         now = time.time() - start
         while i < len(schedule.offsets) and schedule.offsets[i] <= now:
-            engine.submit(schedule.prompts[i],
-                          max_new_tokens=schedule.profile.max_new_tokens)
+            rid = engine.submit(
+                schedule.prompts[i],
+                max_new_tokens=schedule.profile.max_new_tokens)
+            if tag_requests:
+                sends[rid] = time.time()
             stats.submitted += 1
             i += 1
         engine.admit_from_queue(prefiller)
         active = bool(np.count_nonzero(engine._active))
         if active:
             engine.step()
+        if tag_requests:
+            # Stamp completions as the CLIENT first sees them — the
+            # outside view of latency, one row per request.
+            while observed < len(engine.completed):
+                req = engine.completed[observed]
+                observed += 1
+                send = sends.pop(req.rid, None)
+                if send is None:
+                    continue
+                done = time.time()
+                stats.requests.append({
+                    "rid": req.rid, "send_ts": send, "done_ts": done,
+                    "latency_s": done - send,
+                    "prompt_len": int(req.prompt_len),
+                    "new_tokens": len(req.generated)})
         if on_tick is not None:
             on_tick(now)
         if i >= len(schedule.offsets) and not active \
@@ -280,6 +320,14 @@ def main(argv=None) -> int:
                         "system-prompt pool (prefix-cache proof "
                         "traffic; implies --engine paged)")
     parser.add_argument("--shared-frac", type=float, default=0.9)
+    parser.add_argument("--tag-requests", action="store_true",
+                        help="stamp client-side send/done times per "
+                        "request and report the outside view of "
+                        "latency (cross-checkable against the "
+                        "engine's request traces)")
+    parser.add_argument("--tag-csv", default=None, metavar="PATH",
+                        help="with --tag-requests: write the "
+                        "per-request latency rows as CSV")
     args = parser.parse_args(argv)
     if args.disagg:
         args.engine = "disagg"
@@ -313,7 +361,8 @@ def main(argv=None) -> int:
           + (f", shared-prefix {profile.shared_frac:.0%} over "
              f"{profile.shared_prefix_pool} system prompts"
              if args.shared_prefix else ""))
-    stats = run_load(eng, pw, schedule, telemetry=tel)
+    stats = run_load(eng, pw, schedule, telemetry=tel,
+                     tag_requests=args.tag_requests)
     s = tel.snapshot()
     print(f"completed {stats.completed}/{stats.offered} "
           f"({stats.tokens} tokens, {stats.tokens_per_sec:.1f} tok/s)")
@@ -328,6 +377,30 @@ def main(argv=None) -> int:
               f"{p['cached_blocks']} cached blocks, "
               f"{p['tokens_matched_total']} tokens matched, "
               f"{p['cow_copies']} CoW copies")
+    if args.tag_requests and stats.requests:
+        lat = sorted(r["latency_s"] for r in stats.requests)
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        print(f"client-side latency ({len(lat)} tagged): "
+              f"p50 {p50 * 1e3:.1f} ms, p99 {p99 * 1e3:.1f} ms")
+        rt = getattr(eng, "reqtrace", None)
+        if rt is not None:
+            # Drift between the two clocks: the client's view includes
+            # everything the engine cannot see (its own loop's
+            # observation lag here; network in a real deployment), so
+            # it bounds the trace e2e from above.
+            drifts = []
+            for r in stats.requests:
+                t = rt.find(r["rid"])
+                if t is not None and t.get("done"):
+                    drifts.append(r["latency_s"] - t["e2e_s"])
+            if drifts:
+                print(f"client-vs-trace drift: max "
+                      f"{max(drifts) * 1e3:.2f} ms over "
+                      f"{len(drifts)} resolved traces")
+        if args.tag_csv:
+            write_request_csv(args.tag_csv, stats.requests)
+            print(f"wrote {len(stats.requests)} rows to {args.tag_csv}")
     if args.engine == "disagg":
         h = eng.handoff_view()
         print(f"handoff: {h['requests']} requests, {h['blocks']} cold + "
