@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-defrag bench-reclaim bench-failover bench-decode docs native lint clean ci render-deploy chaos-smoke chaos-soak
+.PHONY: test test-fast scale soak bench bench-sched bench-reconcile bench-reconcile-4k bench-defrag bench-reclaim bench-failover bench-decode docs native lint clean ci render-deploy chaos-smoke chaos-soak
 
 lint:            ## the semantic gate: compile check + grovelint (AST
 	@# invariant rules, docs/design/static-analysis.md) + one
@@ -76,6 +76,16 @@ bench-reconcile: ## controller reconcile p50/p99 + store-scan/write counts (CPU 
 	@# 1024-pod point pins the 1000-pod deploy budget) to
 	@# bench-history/history.jsonl.
 	$(PY) tools/bench_reconcile.py --compare
+
+bench-reconcile-4k: ## 4096-pod / 1024-gang status-batching pin (CPU only)
+	@# The control-plane observatory's proof (docs/design/
+	@# controlplane-observatory.md): the same seed fleet driven batched
+	@# (GROVE_STATUS_BATCH=1) and unbatched (=0) with a SweepObserver
+	@# attached; batched write-calls/pod must be STRICTLY below
+	@# unbatched, measured from the observatory's own ledger. Appends
+	@# reconcile_p50_ms_4k + store_writes_per_pod_4k rows to
+	@# bench-history/history.jsonl.
+	$(PY) tools/bench_reconcile.py --fourk
 
 bench-defrag:    ## defrag-on vs defrag-off churn bench (CPU only)
 	@# The defragmentation engine's proof (docs/design/defrag.md):
@@ -161,6 +171,11 @@ ci:              ## the CI gate (reference .github/workflows analog):
 	@# write-amplification assertion (store writes per pod deployed
 	@# bounded) and writer-attribution + deploy-histogram checks.
 	$(PY) tools/deploy_smoke.py
+	@# control-plane observatory smoke: 1-gang deploy -> sweep records
+	@# attributed with pinned causes, write-amp ledger finite,
+	@# /debug/controlplane serves (200 + route-miss 404), grovectl
+	@# controlplane-status exits 0 with the hottest controller starred.
+	$(PY) tools/controlplane_smoke.py
 	@# serving-SLO smoke: tiny engine -> TTFT/TPOT histograms -> one
 	@# batched /metrics/push -> ServingObserver -> /debug/serving
 	@# renders with the SLO judged against the autoscaling target.

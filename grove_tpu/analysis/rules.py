@@ -740,6 +740,101 @@ class WriteToSharedBlock(Rule):
         ]
 
 
+class UnattributedControllerWrite(Rule):
+    """PR 20's sweep-attribution contract (docs/design/
+    controlplane-observatory.md): writeobs names every store write
+    after the reconcile that issued it via a contextvar that
+    ``Controller._process`` sets — and that ``run_concurrently``
+    copies onto its pool threads. A RAW ``threading.Thread``/``Timer``
+    a controller spawns gets a fresh context, so every write from it
+    files as ``writer="direct"`` and the observatory's per-controller
+    ledger silently under-counts. The discipline: a thread entrypoint
+    in controller code that (transitively, via self-calls) issues
+    write verbs must stamp itself with ``writeobs.set_writer(...)``
+    first. Scope: grove_tpu/controllers/."""
+
+    name = "unattributed-controller-write"
+    description = ("store write reachable from a raw controller thread "
+                   "without writeobs.set_writer — it files as "
+                   "writer=\"direct\" and escapes the sweep ledger")
+
+    THREAD_CTORS = {"Thread", "Timer"}
+
+    def applies(self, mod: ModuleFile) -> bool:
+        return mod.rel.startswith("grove_tpu/controllers/")
+
+    def check(self, mod: ModuleFile) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in mod.tree.body:
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(mod, cls))
+        return out
+
+    def _check_class(self, mod: ModuleFile,
+                     cls: ast.ClassDef) -> list[Finding]:
+        methods = {fn.name: fn for fn in cls.body
+                   if isinstance(fn, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        out: list[Finding] = []
+        for entry in self._thread_targets(cls, methods):
+            if self._sets_writer(methods[entry]):
+                continue
+            # Closure over self-calls: the thread's whole call tree
+            # runs in the unattributed context.
+            seen, frontier = {entry}, [entry]
+            while frontier:
+                fn = methods[frontier.pop()]
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = self.attr_chain(node.func)
+                    if chain[:1] == ["self"] and len(chain) == 2 \
+                            and chain[1] in methods \
+                            and chain[1] not in seen:
+                        seen.add(chain[1])
+                        frontier.append(chain[1])
+                    elif len(chain) >= 2 and chain[-2] == "client" \
+                            and chain[-1] in WRITE_VERBS:
+                        out.append(self.finding(
+                            mod, node,
+                            f".{chain[-1]}() on a raw controller thread "
+                            f"(entrypoint {cls.name}.{entry}) without "
+                            "writeobs.set_writer — the write files as "
+                            "writer=\"direct\"; stamp the thread "
+                            "entrypoint with writeobs.set_writer(name)"))
+        return out
+
+    def _thread_targets(self, cls: ast.ClassDef,
+                        methods: dict) -> list[str]:
+        """Method names handed to threading.Thread(target=self.X) /
+        threading.Timer(delay, self.X) anywhere in the class."""
+        targets: list[str] = []
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = self.attr_chain(node.func)
+            if not chain or chain[-1] not in self.THREAD_CTORS:
+                continue
+            cands = [kw.value for kw in node.keywords
+                     if kw.arg in ("target", "function")]
+            if chain[-1] == "Timer" and len(node.args) >= 2:
+                cands.append(node.args[1])
+            for cand in cands:
+                cc = self.attr_chain(cand)
+                if cc[:1] == ["self"] and len(cc) == 2 \
+                        and cc[1] in methods:
+                    targets.append(cc[1])
+        return targets
+
+    def _sets_writer(self, fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = self.attr_chain(node.func)
+                if chain[-1:] == ["set_writer"]:
+                    return True
+        return False
+
+
 ALL_RULES = [
     HubUnderStoreLock,
     LeaderClientWrite,
@@ -750,4 +845,5 @@ ALL_RULES = [
     HostSyncInStepLoop,
     ReqtraceInStepLoop,
     WriteToSharedBlock,
+    UnattributedControllerWrite,
 ]

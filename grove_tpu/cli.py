@@ -871,6 +871,25 @@ def cmd_leader_status(args: argparse.Namespace) -> int:
     return 0 if role == "leader" and not fenced else 1
 
 
+def cmd_controlplane_status(args: argparse.Namespace) -> int:
+    """Render the control-plane observatory (GET /debug/controlplane):
+    per-controller sweep attribution with the hottest controller
+    starred, write-amplification ledger, hot-object top-K, watch-lag
+    SLO verdicts, queue pickup-vs-work split. Exit 0 healthy, 1 on a
+    watch-lag SLO breach or write-amp above --max-write-amp (scripted
+    'is my control plane thrashing' probe)."""
+    from grove_tpu.runtime import sweepobs
+    status, data = _http(args.server, "/debug/controlplane", ca=args.ca)
+    if status != 200:
+        print(f"error ({status}): {_err_text(data)}", file=sys.stderr)
+        return 1
+    print("\n".join(sweepobs.render_controlplane_status(
+        data, max_write_amp=args.max_write_amp)))
+    problems = sweepobs.status_problems(data,
+                                        max_write_amp=args.max_write_amp)
+    return 1 if problems else 0
+
+
 def _serve_standby(args: argparse.Namespace) -> int:
     """``serve --standby --peer <leader-url>``: run as a hot standby —
     wire mirror of the leader kept warm, reads served locally, writes
@@ -1465,6 +1484,21 @@ def main(argv: list[str] | None = None) -> int:
     dis.add_argument("--server", default=default_server)
     add_ca(dis)
     dis.set_defaults(fn=cmd_disruptions)
+
+    cps = sub.add_parser(
+        "controlplane-status",
+        help="control-plane observatory from a serve daemon: per-"
+             "controller sweep attribution (hottest starred), write-"
+             "amplification ledger with hot objects, watch-lag SLO "
+             "(exit 1 on an SLO breach or write-amp above "
+             "--max-write-amp)")
+    cps.add_argument("--max-write-amp", type=float,
+                     default=10.0,
+                     help="recent write-calls-per-changed-object above "
+                          "which a controller is flagged (default 10)")
+    cps.add_argument("--server", default=default_server)
+    add_ca(cps)
+    cps.set_defaults(fn=cmd_controlplane_status)
 
     ls = sub.add_parser(
         "leader-status",

@@ -76,15 +76,23 @@ class NodeLifecycleController:
         self._paused = False
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            if getattr(self, "_paused", False):
+        # Raw thread = fresh contextvar context: without this stamp
+        # every write below files as writer="direct" in writeobs and
+        # escapes the sweep ledger (lint: unattributed-controller-write).
+        from grove_tpu.store import writeobs
+        token = writeobs.set_writer("node-lifecycle")
+        try:
+            while not self._stop.is_set():
+                if getattr(self, "_paused", False):
+                    self._stop.wait(self.sync_period)
+                    continue
+                try:
+                    self._pass()
+                except Exception:  # noqa: BLE001 - controller survival
+                    self.log.exception("node lifecycle pass panicked")
                 self._stop.wait(self.sync_period)
-                continue
-            try:
-                self._pass()
-            except Exception:  # noqa: BLE001 - controller survival
-                self.log.exception("node lifecycle pass panicked")
-            self._stop.wait(self.sync_period)
+        finally:
+            writeobs.reset_writer(token)
 
     def _pass(self) -> None:
         now = time.time()

@@ -32,6 +32,7 @@ from grove_tpu.api.meta import (
     set_condition,
 )
 from grove_tpu.api.serde import clone
+from grove_tpu.controllers import statusbatch
 from grove_tpu.controllers.expected import podgang_name_for_pclq
 from grove_tpu.runtime.concurrent import run_with_slow_start
 from grove_tpu.runtime.controller import Request
@@ -102,6 +103,14 @@ class PodCliqueReconciler:
             "state")
 
     def reconcile(self, req: Request) -> StepResult:
+        # One status sweep per reconcile: both _update_status calls
+        # below (expectation-gated refresh and end-of-sync aggregation)
+        # queue field-diff patches that flush as one patch_status_many
+        # batch (GROVE_STATUS_BATCH=0 restores per-call update_status).
+        with statusbatch.sweep(self.client):
+            return self._reconcile(req)
+
+    def _reconcile(self, req: Request) -> StepResult:
         try:
             pclq = self.client.get(PodClique, req.name, req.namespace)
         except NotFoundError:
@@ -659,6 +668,7 @@ class PodCliqueReconciler:
     # ---- status (reference reconcilestatus.go:210-282) ----
 
     def _update_status(self, pclq: PodClique, pods: list[Pod]) -> None:
+        before = statusbatch.snapshot(pclq)
         ready = sum(1 for p in pods
                     if is_condition_true(p.status.conditions, c.COND_READY))
         scheduled = sum(1 for p in pods if p.status.node_name)
@@ -690,10 +700,8 @@ class PodCliqueReconciler:
                 type=c.COND_PCLQ_SCHEDULED,
                 status="True" if was_scheduled else "False",
                 reason=f"scheduled={scheduled}"))
-        try:
-            self.client.update_status(pclq)
-        except GroveError:
-            pass
+        statusbatch.commit_status(self.client, pclq, before,
+                                  swallow_errors=True)
 
 
 def _deletion_order(pod: Pod) -> tuple:

@@ -24,6 +24,7 @@ from grove_tpu.api.meta import Condition, is_condition_true, set_condition
 from grove_tpu.api.serde import clone as serde_clone
 from grove_tpu.controllers import expected as exp
 from grove_tpu.controllers import replica_lifecycle as lifecycle
+from grove_tpu.controllers import statusbatch
 from grove_tpu.runtime.concurrent import run_concurrently
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.errors import (
@@ -42,6 +43,14 @@ class PodCliqueSetReconciler:
         self.log = get_logger("podcliqueset")
 
     def reconcile(self, req: Request) -> StepResult:
+        # One status sweep per reconcile: the generation-hash seed and
+        # the aggregation below queue field-diff patches that flush as
+        # ONE patch_status_many batch (GROVE_STATUS_BATCH=0 restores
+        # the per-call update_status path).
+        with statusbatch.sweep(self.client):
+            return self._reconcile(req)
+
+    def _reconcile(self, req: Request) -> StepResult:
         try:
             pcs = self.client.get(PodCliqueSet, req.name, req.namespace)
         except NotFoundError:
@@ -56,9 +65,10 @@ class PodCliqueSetReconciler:
 
         template_hash = exp.generation_hash(pcs)
         if not pcs.status.generation_hash:
+            before = statusbatch.snapshot(pcs)
             pcs.status.generation_hash = template_hash
             pcs.status.structure_hash = exp.structure_hash(pcs)
-            pcs = self.client.update_status(pcs)
+            pcs = statusbatch.commit_status(self.client, pcs, before)
         elif pcs.status.generation_hash != template_hash:
             # Pod-shaping-only change (e.g. an image tweak): each PCLQ of
             # the replica being updated rolls its pods one at a time in
@@ -77,8 +87,9 @@ class PodCliqueSetReconciler:
                                             pod_level)
         elif not pcs.status.structure_hash:
             # Backfill for statuses written before structure_hash existed.
+            before = statusbatch.snapshot(pcs)
             pcs.status.structure_hash = exp.structure_hash(pcs)
-            pcs = self.client.update_status(pcs)
+            pcs = statusbatch.commit_status(self.client, pcs, before)
 
         # Availability loops first (reference sync group G1): gang
         # termination and rolling-update orchestration may delete replica
@@ -117,6 +128,9 @@ class PodCliqueSetReconciler:
         pcs.status.structure_hash = s_hash
         pcs.status.rolling_update = UpdateProgress(target_hash=target_hash,
                                                    pod_level=pod_level)
+        # Deliberately NOT batched: rolling_update_pass (direct writer,
+        # same sweep) advances this progress object — a queued init
+        # patch flushing afterwards would roll it back.
         return self.client.update_status(pcs)
 
     # ---- component sync ----
@@ -326,6 +340,7 @@ class PodCliqueSetReconciler:
             pcs = self.client.get(PodCliqueSet, pcs.meta.name, pcs.meta.namespace)
         except NotFoundError:
             return
+        before = statusbatch.snapshot(pcs)
         selector = {c.LABEL_PCS_NAME: pcs.meta.name}
         pclqs = self.client.list(PodClique, pcs.meta.namespace, selector)
         pcsgs = self.client.list(PodCliqueScalingGroup, pcs.meta.namespace,
@@ -367,7 +382,5 @@ class PodCliqueSetReconciler:
             type="Available",
             status="True" if available >= pcs.spec.replicas else "False",
             reason=f"{available}/{pcs.spec.replicas} replicas available"))
-        try:
-            self.client.update_status(pcs)
-        except GroveError:
-            pass  # next event recomputes
+        statusbatch.commit_status(self.client, pcs, before,
+                                  swallow_errors=True)

@@ -19,6 +19,7 @@ from grove_tpu.api import (
 from grove_tpu.api.meta import Condition, OwnerReference, set_condition
 from grove_tpu.api.serde import clone as serde_clone
 from grove_tpu.controllers import expected as exp
+from grove_tpu.controllers import statusbatch
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.errors import GroveError, NotFoundError
 from grove_tpu.runtime.flow import StepResult
@@ -32,6 +33,12 @@ class ScalingGroupReconciler:
         self.log = get_logger("podcliquescalinggroup")
 
     def reconcile(self, req: Request) -> StepResult:
+        # One status sweep per reconcile (see statusbatch): the roll-up
+        # below queues a field-diff patch, flushed via patch_status_many.
+        with statusbatch.sweep(self.client):
+            return self._reconcile(req)
+
+    def _reconcile(self, req: Request) -> StepResult:
         try:
             pcsg = self.client.get(PodCliqueScalingGroup, req.name,
                                    req.namespace)
@@ -131,6 +138,7 @@ class ScalingGroupReconciler:
         return errors
 
     def _update_status(self, pcsg: PodCliqueScalingGroup) -> None:
+        before = statusbatch.snapshot(pcsg)
         members = self.client.list(
             PodClique, pcsg.meta.namespace,
             selector={c.LABEL_PCSG_NAME: pcsg.meta.name})
@@ -158,7 +166,5 @@ class ScalingGroupReconciler:
                 status="True" if breached else "False",
                 reason=(f"readyReplicas={ready_replicas} "
                         f"minAvailable={pcsg.spec.min_available}")))
-        try:
-            self.client.update_status(pcsg)
-        except GroveError:
-            pass
+        statusbatch.commit_status(self.client, pcsg, before,
+                                  swallow_errors=True)

@@ -1,0 +1,192 @@
+"""Per-sweep status-write batching for reconcilers (ROADMAP item 5).
+
+The PCS/PCSG/PodClique reconcilers historically ended every sweep with
+a full-object ``update_status`` round trip — one write-verb call, one
+store-lock acquisition, and one rv-checked PUT per sweep *even when
+nothing changed* (the store suppresses the no-op, but only after the
+call paid for the lock). At 4096 pods that is thousands of no-op verb
+calls per settle round, and the PCS create path commits its status
+twice (generation-hash seed, then aggregation).
+
+This module converts those sweeps to ``patch_status_many`` batching:
+
+- Each reconcile opens a :func:`sweep` (a contextvar, so helpers any
+  depth down can queue without threading a parameter).
+- ``commit_status`` computes a **field-diff merge patch** of the
+  object's status against a pre-mutation :func:`snapshot` — only
+  changed fields and changed conditions ride; an empty diff queues
+  NOTHING (the no-op call disappears entirely, which the sweep
+  observatory's ledger can prove: write calls per sweep drop to zero
+  at convergence).
+- At sweep close the queued patches flush grouped per (kind,
+  namespace) through ONE ``patch_status_many`` call each — same-object
+  patches are merged first (the PCS seed + aggregation writes become
+  one commit), and per-item errors are swallowed exactly like the
+  prior ``except GroveError: pass`` (the next event recomputes).
+
+Merge-patch semantics are the status subresource's (store/patch.py):
+no rv precondition, per-field last-write-wins, conditions merged BY
+TYPE — a concurrent writer's Scheduled condition survives our
+MinAvailableBreached patch, which the full-object PUT could clobber
+only by losing a conflict retry.
+
+``GROVE_STATUS_BATCH=0`` restores the exact prior path (every
+``commit_status`` falls back to the full ``update_status``); the 4k
+bench runs the same seed both ways and pins the batched writes/pod
+strictly below the unbatched run from the observatory's own ledger
+(tools/bench_reconcile.py, tests/test_sweepobs.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+from typing import Any, Iterator
+
+from grove_tpu.api.serde import to_dict
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.logger import get_logger
+
+STATUS_BATCH_ENV = "GROVE_STATUS_BATCH"
+
+log = get_logger("statusbatch")
+
+# The open sweep rides a contextvar (the writeobs writer idiom): one
+# reconcile = one sweep, helpers queue from any depth, and worker
+# threads never share a sweep.
+_sweep_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "grove_status_sweep", default=None)
+
+
+def enabled() -> bool:
+    """Per-call env read (the GROVE_WRITE_OBS idiom): the bench flips
+    this between the batched and the prior path on the same process."""
+    return os.environ.get(STATUS_BATCH_ENV, "1") != "0"
+
+
+class StatusSweep:
+    """Queued status merge-patches for one reconcile sweep."""
+
+    def __init__(self, client: Any) -> None:
+        self.client = client
+        # (kind_cls, namespace) -> {name: merged patch dict}
+        self._groups: dict[tuple[type, str], dict[str, dict]] = {}
+
+    def queue(self, obj: Any, patch: dict) -> None:
+        group = self._groups.setdefault(
+            (type(obj), obj.meta.namespace), {})
+        prior = group.get(obj.meta.name)
+        group[obj.meta.name] = patch if prior is None \
+            else _merge_patches(prior, patch)
+
+    def flush(self) -> None:
+        """One ``patch_status_many`` per (kind, namespace) group.
+        Per-item errors are logged and dropped — the prior per-write
+        ``except GroveError: pass`` contract; the next event
+        recomputes from live state."""
+        for (kind_cls, namespace), items in self._groups.items():
+            try:
+                results = self.client.patch_status_many(
+                    kind_cls, list(items.items()), namespace)
+            except GroveError as e:
+                log.debug("status batch for %s/%s dropped: %s",
+                          kind_cls.KIND, namespace, e)
+                continue
+            for (name, _), err in zip(items.items(), results):
+                if err is not None:
+                    log.debug("status patch %s %s/%s dropped: %s",
+                              kind_cls.KIND, namespace, name, err)
+        self._groups.clear()
+
+
+@contextlib.contextmanager
+def sweep(client: Any) -> Iterator[StatusSweep | None]:
+    """Open a status sweep for one reconcile body. With
+    GROVE_STATUS_BATCH=0 this is a bare yield and every commit_status
+    inside takes the prior full-object path."""
+    if not enabled():
+        yield None
+        return
+    s = StatusSweep(client)
+    token = _sweep_ctx.set(s)
+    try:
+        yield s
+    finally:
+        _sweep_ctx.reset(token)
+        s.flush()
+
+
+def current_sweep() -> StatusSweep | None:
+    return _sweep_ctx.get()
+
+
+def snapshot(obj: Any) -> dict:
+    """Pre-mutation status snapshot for ``commit_status`` to diff
+    against (plain data, the same serde the patch machinery uses)."""
+    return to_dict(obj.status)
+
+
+def commit_status(client: Any, obj: Any, before: dict,
+                  swallow_errors: bool = False) -> Any:
+    """Persist ``obj``'s status mutations since ``before``.
+
+    Batched (an open sweep and GROVE_STATUS_BATCH unset/1): queue a
+    field-diff merge patch — nothing at all when the diff is empty.
+    Otherwise: the prior full-object ``update_status``, including the
+    ``swallow_errors`` contract of the status-aggregation call sites.
+    Returns the object (the store's refreshed copy on the direct path,
+    the local one when queued — callers keep reading their mutation
+    either way)."""
+    s = _sweep_ctx.get()
+    if s is not None and enabled():
+        patch = _status_diff(before, to_dict(obj.status))
+        if patch:
+            s.queue(obj, patch)
+        return obj
+    try:
+        return client.update_status(obj)
+    except GroveError:
+        if not swallow_errors:
+            raise
+        return obj  # next event recomputes
+
+
+def _status_diff(before: dict, after: dict) -> dict:
+    """Merge patch carrying only what changed. Conditions diff BY TYPE
+    (the store's merge key); other fields compare wholesale — status
+    dataclasses are flat enough that a per-field replace is exactly
+    the RFC 7386 merge the store applies."""
+    patch: dict = {}
+    for key, value in after.items():
+        if key == "conditions":
+            continue
+        if before.get(key) != value:
+            patch[key] = value
+    before_conds = {e.get("type"): e
+                    for e in before.get("conditions") or []}
+    changed = [e for e in after.get("conditions") or []
+               if before_conds.get(e.get("type")) != e]
+    if changed:
+        patch["conditions"] = changed
+    return patch
+
+
+def _merge_patches(prior: dict, patch: dict) -> dict:
+    """Client-side pre-merge of two patches against the same object
+    (the PCS generation-hash seed + aggregation pair): later fields
+    win; conditions union by type with the later entry winning."""
+    merged = dict(prior)
+    for key, value in patch.items():
+        if key == "conditions":
+            by_type = {e.get("type"): e
+                       for e in merged.get("conditions") or []}
+            for entry in value:
+                by_type[entry.get("type")] = entry
+            merged["conditions"] = list(by_type.values())
+        elif isinstance(value, dict) and \
+                isinstance(merged.get(key), dict):
+            merged[key] = {**merged[key], **value}
+        else:
+            merged[key] = value
+    return merged

@@ -27,6 +27,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+try:                       # moved to the top level in newer jax
+    from jax import shard_map as _shard_map
+except ImportError:        # jax <= 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+
+def _pcast_varying(x, axes):
+    # lax.pcast's varying-type marking exists only in newer jax; the
+    # 0.4.x shard_map has no varying types, so identity is exact there.
+    pcast = getattr(lax, "pcast", None)
+    return pcast(x, axes, to="varying") if pcast is not None else x
+
+
+def _axis_size(name):
+    # lax.axis_size is newer-jax; psum(1, axis) is the classic idiom it
+    # replaced and constant-folds to the same static size under shard_map.
+    size = getattr(lax, "axis_size", None)
+    return size(name) if size is not None else lax.psum(1, name)
 
 from grove_tpu.models import llama
 from grove_tpu.models.llama import LlamaConfig, _attn_out, _qkv
@@ -119,7 +138,7 @@ def _ep_moe_block(cfg: MoeConfig, x, lp, capacity_factor: float):
     every shape compile-time constant; overflow assignments are dropped
     (their tokens keep the residual path only).
     """
-    ep = lax.axis_size(AXIS_EP)
+    ep = _axis_size(AXIS_EP)
     E, k = cfg.n_experts, cfg.experts_per_token
     El = E // ep
     bl, s, d = x.shape
@@ -195,11 +214,21 @@ def _ep_body(cfg: MoeConfig, capacity_factor: float, params, tokens):
     sharded over ep, attention token-local."""
     # The aux accumulator must carry the device-varying type from the
     # start (layer aux varies over dp/ep) or the scan carry types differ.
-    aux0 = lax.pcast(jnp.float32(0.0), (AXIS_DP, AXIS_EP), to="varying")
-    logits, aux = _decoder_stack(
-        cfg, params, tokens,
-        lambda x, lp: _ep_moe_block(cfg, x, lp, capacity_factor), aux0)
-    return logits, lax.pmean(aux, (AXIS_DP, AXIS_EP))
+    # Shape (1,) rather than scalar: under grad, partial-eval saves it as
+    # a residual with the all-axes residual spec on axis 0, which a
+    # rank-0 value cannot carry (older shard_map rejects it outright).
+    aux0 = _pcast_varying(jnp.zeros((1,), jnp.float32), (AXIS_DP, AXIS_EP))
+
+    def moe_fn(x, lp):
+        y, layer_aux = _ep_moe_block(cfg, x, lp, capacity_factor)
+        return y, layer_aux[None]
+
+    logits, aux = _decoder_stack(cfg, params, tokens, moe_fn, aux0)
+    # Per-shard aux out (mapped over dp×ep, meaned by the caller): the
+    # math is identical to an in-body pmean → replicated scalar, but a
+    # mapped output transposes cleanly on every jax version — older
+    # shard_map cannot type the replicated-scalar cotangent under grad.
+    return logits, aux
 
 
 def _collapse_to_dp_ep(spec: P) -> P:
@@ -267,13 +296,15 @@ def ep_forward(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,
     # entry.
     specs = jax.tree.map(_collapse_to_dp_ep, param_pspecs(params),
                          is_leaf=lambda x: isinstance(x, P))
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_ep_body, cfg, capacity_factor),
         mesh=mesh,
         in_specs=(specs, batch_spec),
-        out_specs=(batch_spec, P()),
+        out_specs=(batch_spec, batch_spec),
     )
-    return fn(params, tokens)
+    logits, aux_shards = fn(params, tokens)
+    # [dp*ep] per-shard aux values → scalar (== the in-body pmean).
+    return logits, aux_shards.mean()
 
 
 def loss_fn(cfg: MoeConfig, params: Params, tokens: jnp.ndarray,

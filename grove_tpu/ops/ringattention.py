@@ -21,6 +21,32 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+try:                       # moved to the top level in newer jax
+    from jax import shard_map as _shard_map
+except ImportError:
+    # jax <= 0.4.x keeps it under experimental, where the replication
+    # checker predates varying types and rejects valid bodies (e.g. a
+    # cond over freshly-built accumulators) — disable it there; newer
+    # jax type-checks the same bodies natively.
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def _shard_map(f, **kw):
+        return _esm(f, check_rep=False, **kw)
+
+
+
+def _pcast_varying(x, axes):
+    # lax.pcast's varying-type marking exists only in newer jax; the
+    # 0.4.x shard_map has no varying types, so identity is exact there.
+    pcast = getattr(lax, "pcast", None)
+    return pcast(x, axes, to="varying") if pcast is not None else x
+
+
+def _axis_size(name):
+    # lax.axis_size is newer-jax; psum(1, axis) is the classic idiom it
+    # replaced and constant-folds to the same static size under shard_map.
+    size = getattr(lax, "axis_size", None)
+    return size(name) if size is not None else lax.psum(1, name)
 
 from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 
@@ -53,7 +79,7 @@ def _block_attention(q, k, v, q_offset, kv_offset, scale):
 
 def _ring_attention_local(q, k, v, axis_name: str):
     """Per-shard body (run under shard_map): rotate K/V around the ring."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     n_kv = k.shape[2]
@@ -65,7 +91,7 @@ def _ring_attention_local(q, k, v, axis_name: str):
     all_axes = (AXIS_DP, AXIS_SP, AXIS_TP)
 
     def _varying(x):
-        return lax.pcast(x, all_axes, to="varying")
+        return _pcast_varying(x, all_axes)
 
     acc_max = _varying(jnp.full((b, n_kv, h // n_kv, sq), NEG_INF, jnp.float32))
     acc_sum = _varying(jnp.zeros((b, n_kv, h // n_kv, sq), jnp.float32))
@@ -123,7 +149,7 @@ def ring_attention(mesh: Mesh, q, k, v, *, axis_name: str = AXIS_SP):
     over ``sp``, h/n_kv over ``tp``, b over ``dp``.
     """
     qspec = P(AXIS_DP, axis_name, AXIS_TP, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_ring_attention_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(qspec, qspec, qspec),

@@ -29,6 +29,17 @@ from functools import partial
 import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+try:                       # moved to the top level in newer jax
+    from jax import shard_map as _shard_map
+except ImportError:        # jax <= 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _axis_size(name):
+    # lax.axis_size is newer-jax; psum(1, axis) is the classic idiom it
+    # replaced and constant-folds to the same static size under shard_map.
+    size = getattr(lax, "axis_size", None)
+    return size(name) if size is not None else lax.psum(1, name)
 
 from grove_tpu.ops.attention import causal_attention
 from grove_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
@@ -41,7 +52,7 @@ def _ulysses_local(q, k, v, axis_name: str):
     the per-member head counts AFTER any tp sharding; sp further divides
     them for the attention phase.
     """
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     h_l, n_kv_l = q.shape[2], k.shape[2]
     assert h_l % sp == 0 and n_kv_l % sp == 0, (
         f"ulysses needs heads divisible by sp={sp}: have q heads {h_l}, "
@@ -68,7 +79,7 @@ def ulysses_attention(mesh: Mesh, q, k, v, *, axis_name: str = AXIS_SP):
     ``sp``, heads over ``tp``, batch over ``dp`` (same contract as
     ring_attention)."""
     spec = P(AXIS_DP, axis_name, AXIS_TP, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_ulysses_local, axis_name=axis_name),
         mesh=mesh,
         in_specs=(spec, spec, spec),

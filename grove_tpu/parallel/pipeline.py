@@ -20,10 +20,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+try:                       # moved to the top level in newer jax
+    from jax import shard_map as _shard_map
+except ImportError:        # jax <= 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 from grove_tpu.models.llama import LlamaConfig, _layer_prefill, head
 from grove_tpu.ops.rope import rope_table
 from grove_tpu.parallel.mesh import AXIS_PP, AXIS_TP
+
+
+def _axis_size(name):
+    # lax.axis_size is newer-jax; psum(1, axis) is the classic idiom it
+    # replaced and constant-folds to the same static size under shard_map.
+    size = getattr(lax, "axis_size", None)
+    return size(name) if size is not None else lax.psum(1, name)
+
+
+def _pcast_varying(x, axes):
+    # lax.pcast's varying-type marking exists only in newer jax; the
+    # 0.4.x shard_map has no varying types, so identity is exact there.
+    pcast = getattr(lax, "pcast", None)
+    return pcast(x, axes, to="varying") if pcast is not None else x
 
 
 def _stage_body(cfg: LlamaConfig, n_micro: int, tp_axis, tok_embed, lm_head,
@@ -35,7 +54,7 @@ def _stage_body(cfg: LlamaConfig, n_micro: int, tp_axis, tok_embed, lm_head,
     body psums its output projections over that axis (Megatron-style).
     tokens: full [B, s] (replicated); microbatches split on B.
     """
-    s_count = lax.axis_size(AXIS_PP)
+    s_count = _axis_size(AXIS_PP)
     stage = lax.axis_index(AXIS_PP)
     B, seq = tokens.shape
     mb = B // n_micro
@@ -54,10 +73,10 @@ def _stage_body(cfg: LlamaConfig, n_micro: int, tp_axis, tok_embed, lm_head,
     fwd_perm = [(i, (i + 1) % s_count) for i in range(s_count)]
     # pvary: fresh buffers must carry the device-varying type to match
     # the loop carry once mixed with per-stage data.
-    carry_in = lax.pcast(jnp.zeros((mb, seq, d), cfg.dtype), (AXIS_PP,),
-                         to="varying")
-    outputs = lax.pcast(jnp.zeros((n_micro, mb, seq, d), cfg.dtype),
-                        (AXIS_PP,), to="varying")
+    carry_in = _pcast_varying(jnp.zeros((mb, seq, d), cfg.dtype),
+                              (AXIS_PP,))
+    outputs = _pcast_varying(jnp.zeros((n_micro, mb, seq, d), cfg.dtype),
+                             (AXIS_PP,))
 
     def tick(t, state):
         carry_in, outputs = state
@@ -144,7 +163,7 @@ def pipeline_forward(cfg: LlamaConfig, params, tokens: jnp.ndarray,
         out_spec = P(None, None, AXIS_TP)  # logits stay vocab-sharded
     else:
         layer_spec = jax.tree.map(lambda _: P(AXIS_PP), params["layers"])
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(_stage_body, cfg, n_microbatches, tp_axis),
         mesh=mesh,
         in_specs=(P(), head_spec, P(), layer_spec, P()),

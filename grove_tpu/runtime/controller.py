@@ -27,6 +27,7 @@ from grove_tpu.runtime.flow import StepResult
 from grove_tpu.runtime.logger import get_logger
 from grove_tpu.runtime.metrics import GLOBAL_METRICS
 from grove_tpu.api.meta import trace_id_of
+from grove_tpu.runtime import sweepobs
 from grove_tpu.runtime.trace import GLOBAL_TRACER
 from grove_tpu.store import writeobs
 from grove_tpu.store.store import Event
@@ -76,15 +77,24 @@ class _DelayQueue:
         # latest hint; _process pops it to bind the reconcile span to
         # the trace that woke the request.
         self._trace: dict[Request, str] = {}
+        # Trigger-cause hint, riding exactly like the trace hint: what
+        # woke this request (watch:<Kind>, resync, requeue, backoff,
+        # panic) — the sweep observatory's cause label. Dedup keeps the
+        # latest cause; a dirty re-add inherits the cause of the event
+        # that arrived mid-processing (add() records it before the
+        # dirty check).
+        self._cause: dict[Request, str] = {}
         self._shutdown = False
 
     def add(self, req: Request, delay: float = 0.0,
-            trace_id: str = "") -> None:
+            trace_id: str = "", cause: str = "") -> None:
         with self._lock:
             if self._shutdown:
                 return
             if trace_id:
                 self._trace[req] = trace_id
+            if cause:
+                self._cause[req] = cause
             if req in self._processing:
                 self._dirty.add(req)
                 return
@@ -134,8 +144,13 @@ class _DelayQueue:
         """Take the trace hint for a request this worker just popped
         ('' when it arrived untraced). Safe without further
         coordination: dedup guarantees one worker holds ``req``."""
+        return self.pop_hints(req)[0]
+
+    def pop_hints(self, req: Request) -> tuple[str, str]:
+        """(trace_id, cause) for a just-popped request, both '' when
+        absent — one lock round trip for the pair."""
         with self._lock:
-            return self._trace.pop(req, "")
+            return self._trace.pop(req, ""), self._cause.pop(req, "")
 
     def done(self, req: Request) -> None:
         with self._lock:
@@ -162,6 +177,7 @@ class _DelayQueue:
             self._dirty.clear()
             self._ready.clear()
             self._trace.clear()
+            self._cause.clear()
             return n
 
     def shutdown(self) -> None:
@@ -206,6 +222,10 @@ class Controller:
         # stale expectations on re-promotion are the SURVEY §7
         # duplicate-pod hazard). Set by controller registration.
         self.on_park: Callable[[], Any] | None = None
+        # Sweep observatory (runtime/sweepobs.py), wired by
+        # Manager.add_controller; None for unmanaged controllers
+        # (benches construct their own observer or run unattributed).
+        self.sweep_observer: Any = None
         self.reconcile_count = 0
         self.error_count = 0
         # Per-request-key reconcile totals (under _count_lock: worker
@@ -237,10 +257,10 @@ class Controller:
         return self
 
     def enqueue(self, req: Request, delay: float = 0.0,
-                trace_id: str = "") -> None:
+                trace_id: str = "", cause: str = "") -> None:
         if self._parked:
             return
-        self.queue.add(req, delay, trace_id=trace_id)
+        self.queue.add(req, delay, trace_id=trace_id, cause=cause)
 
     # ---- leadership parking (grove_tpu/ha) ----
 
@@ -251,6 +271,15 @@ class Controller:
         the registered on_park hook (expectations clear)."""
         self._parked = True
         dropped = self.queue.drain()
+        # Gauge hygiene: the drain above empties the queue, but the
+        # depth gauge is only re-sampled by Manager.metrics_text — a
+        # standby scraped through the raw hub between demote and the
+        # next metrics_text would read the pre-demote depth as live
+        # load. Zero it (and this controller's sweep gauges) NOW.
+        GLOBAL_METRICS.set("grove_workqueue_depth", 0.0,
+                           controller=self.name)
+        if self.sweep_observer is not None:
+            self.sweep_observer.on_park(self.name)
         if self.on_park is not None:
             try:
                 self.on_park()
@@ -266,6 +295,8 @@ class Controller:
         if not self._parked:
             return
         self._parked = False
+        if self.sweep_observer is not None:
+            self.sweep_observer.on_unpark(self.name)
         for kinds, mapper, selector in self._watch_specs:
             self._resync(kinds, mapper, selector)
 
@@ -328,7 +359,7 @@ class Controller:
                 try:
                     tid = trace_id_of(obj)
                     for req in mapper(Event(EventType.ADDED, obj)):
-                        self.enqueue(req, trace_id=tid)
+                        self.enqueue(req, trace_id=tid, cause="resync")
                 except Exception:  # noqa: BLE001
                     self.log.exception("resync mapper panic")
 
@@ -340,10 +371,12 @@ class Controller:
             try:
                 # Trace propagation through the workqueue: the event
                 # object's trace id rides along as a hint so the
-                # reconcile it triggers lands in the same trace.
+                # reconcile it triggers lands in the same trace; the
+                # cause hint names the waking event's kind.
                 tid = trace_id_of(event.obj)
+                cause = f"watch:{event.obj.KIND}"
                 for req in mapper(event):
-                    self.enqueue(req, trace_id=tid)
+                    self.enqueue(req, trace_id=tid, cause=cause)
             except Exception:  # noqa: BLE001
                 self.log.exception("watch mapper panic (event dropped)")
 
@@ -380,7 +413,7 @@ class Controller:
         # (no-op for untraced requests). The span context is ambient
         # for the reconcile body, so objects it creates and nested
         # spans it opens land in the same trace.
-        trace_hint = self.queue.pop_trace(req)
+        trace_hint, cause_hint = self.queue.pop_hints(req)
         t0 = time.perf_counter()
         # Writer attribution for store write telemetry: every write the
         # reconcile body issues — however deep, including fan-out
@@ -388,7 +421,12 @@ class Controller:
         # controller's name (grove_store_writes_total{writer=...}).
         writer_token = writeobs.set_writer(self.name)
         try:
-            with GLOBAL_TRACER.span(f"reconcile.{self.name}",
+            # Sweep attribution (runtime/sweepobs.py): a bare yield
+            # when GROVE_SWEEP_OBS=0 or the controller is unmanaged —
+            # the prior path, pinned by the overhead test.
+            with sweepobs.maybe_record(self.sweep_observer, self.name,
+                                       cause_hint, req.key), \
+                 GLOBAL_TRACER.span(f"reconcile.{self.name}",
                                     trace_id=trace_hint or None,
                                     attrs={"key": req.key}) as span:
                 try:
@@ -425,7 +463,7 @@ class Controller:
                                        controller=self.name,
                                        reason="requeue_after")
                     self.enqueue(req, result.requeue_after,
-                                 trace_id=trace_hint)
+                                 trace_id=trace_hint, cause="requeue")
         finally:
             writeobs.reset_writer(writer_token)
 
@@ -440,8 +478,10 @@ class Controller:
         self._failures[req] = n
         delay = override if override is not None else min(
             self.backoff_base * (2 ** (n - 1)), self.backoff_max)
+        why = reason or ("requeue_after" if override is not None
+                         else "backoff")
         GLOBAL_METRICS.inc(
             "grove_reconcile_requeues_total", controller=self.name,
-            reason=reason or ("requeue_after" if override is not None
-                              else "backoff"))
-        self.enqueue(req, delay, trace_id=trace_id)
+            reason=why)
+        self.enqueue(req, delay, trace_id=trace_id,
+                     cause="requeue" if why == "requeue_after" else why)

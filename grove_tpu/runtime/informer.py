@@ -185,6 +185,15 @@ class Informer:
         self.rv = 0            # last seq observed (seed rv or event seq)
         self.relists = 0
         self.events_applied = 0
+        # Watch-lag SLO feed (runtime/sweepobs.py): lag of the most
+        # recently applied timestamped event (the cache's current
+        # staleness estimator), lifetime max, and a count. Updated
+        # under the informer lock alongside the lag list; replayed
+        # events a relist already superseded never reach here (the rv
+        # guard in _apply_locked returns before the lag append).
+        self.lag_events = 0
+        self.lag_last_s = 0.0
+        self.lag_max_s = 0.0
         self._seeded = False
         self._lister = Lister(self)   # one shared view; Lister is stateless
         self.log = get_logger(f"informer.{self.KIND}")
@@ -293,7 +302,12 @@ class Informer:
             self._index_locked(key, obj)
         self.events_applied += 1
         if ts > 0.0:
-            lags.append(max(0.0, time.time() - ts))
+            lag = max(0.0, time.time() - ts)
+            lags.append(lag)
+            self.lag_events += 1
+            self.lag_last_s = lag
+            if lag > self.lag_max_s:
+                self.lag_max_s = lag
 
     def _index_locked(self, key: tuple[str, str], obj: Any) -> None:
         for pair in obj.meta.labels.items():
@@ -340,6 +354,15 @@ class Informer:
         if count is not None:
             GLOBAL_METRICS.set("grove_informer_cache_objects", count,
                                kind=self.KIND)
+
+    def lag_snapshot(self) -> dict:
+        """Watch-lag stats for the control-plane observatory's SLO
+        judge (one lock round trip; zeros before any timestamped
+        event has applied)."""
+        with self._lock:
+            return {"events": self.lag_events,
+                    "last_s": self.lag_last_s,
+                    "max_s": self.lag_max_s}
 
     def lister(self) -> Lister:
         return self._lister

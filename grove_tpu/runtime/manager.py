@@ -86,9 +86,18 @@ class Manager:
         from grove_tpu.runtime.deploywatch import DeployObserver
         self.deploy_observer = DeployObserver(self.store)
         self.runnables.append(self.deploy_observer)
+        # Control-plane observatory (runtime/sweepobs.py): per-sweep
+        # reconcile attribution + write-amplification ledger, served at
+        # /debug/controlplane. A runnable for registry lifecycle only —
+        # it has no thread; controllers feed it synchronously.
+        from grove_tpu.runtime.sweepobs import SweepObserver
+        self.sweep_observer = SweepObserver(self.store)
+        self.sweep_observer.attach_informers(self.informers)
+        self.runnables.append(self.sweep_observer)
         self._started = False
 
     def add_controller(self, controller: Controller) -> None:
+        controller.sweep_observer = self.sweep_observer
         self.controllers.append(controller)
 
     def add_runnable(self, runnable: Any) -> None:
@@ -218,6 +227,10 @@ class Manager:
             except Exception:  # noqa: BLE001 - best-effort gauge
                 pass
         self._export_state_objects()
+        # Sweep observatory gauges (write-amp per controller, watch-lag
+        # SLO per kind) — re-asserted per scrape like the rest; parked
+        # controllers zero via the family setter.
+        self.sweep_observer.export_gauges()
         # Leadership gauges re-asserted per scrape (a scrape between
         # transitions must still see the current role/epoch).
         GLOBAL_METRICS.set("grove_leader",

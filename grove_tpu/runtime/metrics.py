@@ -65,6 +65,14 @@ COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 LOCK_BUCKETS = (5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3,
                 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1)
 
+# Pinned buckets for write-verb calls per reconcile sweep
+# (runtime/sweepobs.py): a converged sweep issues 0-1 calls, a
+# replica-create sweep a handful, and a 4096-pod fan-out sweep lands in
+# the hundreds — counts, not seconds, so the duration defaults would be
+# nonsense here.
+SWEEP_WRITE_BUCKETS = (1.0, 2.0, 3.0, 5.0, 8.0, 16.0, 32.0, 64.0,
+                       128.0, 256.0, 512.0)
+
 
 class _Hist:
     __slots__ = ("buckets", "counts", "sum", "count")
@@ -387,6 +395,34 @@ GLOBAL_METRICS.describe_histogram(
     # buckets would flatten everything into the first bucket.
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
              0.1, 0.25, 0.5, 1.0, 2.5))
+# Control-plane observatory (runtime/sweepobs.py,
+# docs/design/controlplane-observatory.md): per-sweep attribution
+# rolled up by trigger cause, plus the write-amplification and
+# watch-lag SLO gauges grovectl controlplane-status judges.
+GLOBAL_METRICS.describe_histogram(
+    "grove_sweep_seconds",
+    "Reconcile sweep wall time per controller and trigger cause "
+    "(watch:<Kind>|resync|requeue|backoff|panic|external — the "
+    "workqueue hint that woke the request)")
+GLOBAL_METRICS.describe_histogram(
+    "grove_sweep_writes",
+    "Store write-verb CALLS issued by one reconcile sweep, per "
+    "controller and verb (a batched patch_status_many is one call "
+    "however many items — the store-RPC-rate analog)",
+    buckets=SWEEP_WRITE_BUCKETS)
+GLOBAL_METRICS.describe(
+    "grove_sweep_write_amp",
+    "Recent writes per changed object per controller (the write-"
+    "amplification ledger's windowed estimate; zeroed on park/demote "
+    "so a standby never advertises live load)")
+GLOBAL_METRICS.describe(
+    "grove_informer_watch_lag_seconds",
+    "Staleness of the most recently applied watch event per kind (the "
+    "watch-lag SLO estimator, judged against GROVE_WATCH_LAG_SLO)")
+GLOBAL_METRICS.describe(
+    "grove_informer_watch_lag_breached",
+    "1 while a kind's watch lag exceeds the configured staleness "
+    "target, else 0 (grovectl controlplane-status exits 1 on breach)")
 # Gang lifecycle SLO surface, derived from trace milestones
 # (runtime/trace.py): one observation per gang per milestone, measured
 # from the trace's mint (the root object's create).

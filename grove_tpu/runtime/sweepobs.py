@@ -1,0 +1,540 @@
+"""Control-plane observatory — per-sweep reconcile attribution.
+
+PR 19 gave the data plane per-request attribution; this module is the
+control plane's twin (ROADMAP item 5: the write-amp gap must close in a
+way "the observatory can prove"). Every reconcile sweep a controller
+runs is recorded end-to-end:
+
+- **trigger cause** from the workqueue hint (``runtime/controller.py``
+  rides it next to the trace hint): ``watch:<Kind>`` for a watch event,
+  ``resync`` for the startup/unpark relist, ``requeue`` for an explicit
+  requeue_after, ``backoff``/``panic`` for the failure ladder,
+  ``external`` for direct enqueues (scale runners, tests);
+- **store attribution** via the existing writeobs contextvar records: a
+  sweep sink rides a *contextvar* (NOT a thread-local — fan-out through
+  ``runtime/concurrent.py`` copies the context onto pool threads, so a
+  pod-creation burst's writes land in the sweep that issued them, the
+  same reason writer attribution survives there). Each flushed
+  ``WriteRecord`` folds into the open sweep: write-verb calls, commits
+  (= changed objects), no-ops, conflicts, fenced rejections, list
+  scans, and the store-lock wait/hold split;
+- **wall split**: lock-wait (Σ record wait), store-write (Σ record
+  hold), compute (the remainder). Queue pickup-to-done is already
+  ``grove_workqueue_work_seconds``; this carves up the "being worked
+  on" half.
+
+Rolled-up series (pinned buckets, runtime/metrics.py):
+
+- ``grove_sweep_seconds{controller,cause}`` — sweep wall time;
+- ``grove_sweep_writes{controller,verb}`` — write-verb calls per sweep
+  (a batched ``patch_status_many`` is ONE call however many items — the
+  store-RPC-rate analog batching is supposed to bend);
+- ``grove_sweep_write_amp{controller}`` — recent writes per changed
+  object (gauge, re-asserted per scrape; zeroed on park/demote);
+- ``grove_informer_watch_lag_seconds{kind}`` /
+  ``grove_informer_watch_lag_breached{kind}`` — the watch-lag SLO
+  gauges, judged against ``GROVE_WATCH_LAG_SLO`` (seconds).
+
+The **write-amplification ledger** keeps per-controller totals plus a
+sweep-over-sweep recent window (writes per changed object) and a
+hot-object top-K so one flapping PodCliqueSet can be *named*, not just
+suspected from an aggregate.
+
+Surfaces (the house observatory pattern, deploywatch.py's sibling):
+``GET /debug/controlplane`` (read-gated), ``Client``/``HttpClient``
+``debug_controlplane`` twins, ``grovectl controlplane-status`` (hottest
+controller starred; exit 1 on a watch-lag breach or write-amp above
+threshold), a bench_dashboard section, and ``tools/controlplane_smoke``
+in ``make ci``.
+
+Off switch: ``GROVE_SWEEP_OBS=0`` (per-call env read, the
+GROVE_WRITE_OBS idiom) restores the exact prior reconcile path —
+tests/test_sweepobs.py pins the dual-estimator overhead under 5%. The
+sweep sink only sees what writeobs records, so ``GROVE_WRITE_OBS=0``
+also blinds the ledger's write columns (documented, not a bug).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+import weakref
+from typing import Any, Iterator
+
+from grove_tpu.runtime.metrics import GLOBAL_METRICS
+from grove_tpu.store import writeobs
+
+SWEEP_OBS_ENV = "GROVE_SWEEP_OBS"
+WATCH_LAG_SLO_ENV = "GROVE_WATCH_LAG_SLO"
+
+# Default staleness target for the watch-lag SLO (seconds). In-process
+# informers apply at micro-to-millisecond lag; a full second of
+# staleness means the watch path is drowning (or replaying a gap).
+DEFAULT_WATCH_LAG_SLO_S = 1.0
+
+# grovectl's default write-amp alarm threshold (writes per changed
+# object over the recent window). A healthy reconcile writes once per
+# object it changes (amp ~1); no-op storms and conflict retries push it
+# up. 10 is loud enough to mean "a controller is flapping".
+DEFAULT_WRITE_AMP_THRESHOLD = 10.0
+
+# Recent window for the sweep-over-sweep amplification estimate.
+RECENT_SWEEPS = 64
+
+# Hot-object table bound: trimmed to the top half when it doubles.
+HOT_CAPACITY = 4096
+
+# store (weakly) -> its observer, so the in-process Client can resolve
+# the payload the same way HTTP does (the deploywatch registry idiom).
+_OBSERVERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def observer_for(store) -> "SweepObserver | None":
+    return _OBSERVERS.get(store)
+
+
+def enabled() -> bool:
+    """Per-call env read (the GROVE_WRITE_OBS idiom): flipping
+    ``GROVE_SWEEP_OBS=0`` mid-process takes effect on the next sweep —
+    incident mitigation and the overhead benchmark's baseline."""
+    return os.environ.get(SWEEP_OBS_ENV, "1") != "0"
+
+
+def watch_lag_slo_s() -> float:
+    try:
+        return float(os.environ.get(WATCH_LAG_SLO_ENV,
+                                    str(DEFAULT_WATCH_LAG_SLO_S)))
+    except ValueError:
+        return DEFAULT_WATCH_LAG_SLO_S
+
+
+class SweepSink:
+    """Per-sweep write accumulator, fed by writeobs.flush/count_scan.
+
+    Thread-safe on purpose: the sink rides a contextvar through
+    ``run_concurrently``'s context copy, so a slow-start pod-creation
+    burst has many pool threads absorbing into ONE sink concurrently.
+    """
+
+    __slots__ = ("_lock", "verb_calls", "commits", "noops", "conflicts",
+                 "fenced", "scans", "wait_s", "hold_s")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.verb_calls: collections.Counter = collections.Counter()
+        self.commits = 0
+        self.noops = 0
+        self.conflicts = 0
+        self.fenced = 0
+        self.scans = 0
+        self.wait_s = 0.0
+        self.hold_s = 0.0
+
+    def absorb(self, rec: "writeobs.WriteRecord") -> None:
+        """Fold one flushed WriteRecord into the sweep (called by
+        writeobs.flush AFTER the store lock is released)."""
+        with self._lock:
+            self.verb_calls[rec.verb] += 1
+            self.commits += len(rec.commits)
+            self.noops += len(rec.noops)
+            self.conflicts += len(rec.conflicts)
+            self.fenced += len(rec.fenced)
+            self.scans += len(rec.scans)
+            self.wait_s += rec.wait_s
+            self.hold_s += rec.hold_s
+
+    def absorb_scan(self, kind: str) -> None:
+        """A list-shaped read outside any write verb (the common list
+        path) — counted as scanned work, no verb call."""
+        with self._lock:
+            self.scans += 1
+
+    def write_calls(self) -> int:
+        with self._lock:
+            return sum(self.verb_calls.values())
+
+
+class _Ledger:
+    """Per-controller write-amplification ledger entry."""
+
+    __slots__ = ("sweeps", "causes", "wall_s", "lock_wait_s",
+                 "store_write_s", "compute_s", "write_calls", "commits",
+                 "noops", "conflicts", "fenced", "scans", "verb_calls",
+                 "recent", "last")
+
+    def __init__(self) -> None:
+        self.sweeps = 0
+        self.causes: collections.Counter = collections.Counter()
+        self.wall_s = 0.0
+        self.lock_wait_s = 0.0
+        self.store_write_s = 0.0
+        self.compute_s = 0.0
+        self.write_calls = 0
+        self.commits = 0
+        self.noops = 0
+        self.conflicts = 0
+        self.fenced = 0
+        self.scans = 0
+        self.verb_calls: collections.Counter = collections.Counter()
+        # Sweep-over-sweep recent window: (write_calls, commits) per
+        # sweep, the basis of the windowed amplification estimate.
+        self.recent: "collections.deque[tuple[int, int]]" = \
+            collections.deque(maxlen=RECENT_SWEEPS)
+        self.last: dict[str, Any] = {}
+
+    def recent_amp(self) -> float:
+        writes = sum(w for w, _ in self.recent)
+        changed = sum(c for _, c in self.recent)
+        return writes / max(1, changed)
+
+    def total_amp(self) -> float:
+        return self.write_calls / max(1, self.commits)
+
+
+class SweepObserver:
+    """The control-plane observatory: holds the per-controller ledger
+    and emits the rolled-up sweep series. A manager runnable (started
+    and stopped with the control loops) with no thread of its own — it
+    is fed synchronously from ``Controller._process`` via ``record()``,
+    not from the event stream."""
+
+    def __init__(self, store) -> None:
+        # Weak store ref: _OBSERVERS is weakly KEYED by the store, and a
+        # strong ref from value back to key would pin the entry forever.
+        self._store_ref = weakref.ref(store)
+        from grove_tpu.analysis import lockdep
+        self._lock = lockdep.maybe_wrap(threading.Lock(), "sweep-observer")
+        self._ledgers: dict[str, _Ledger] = {}
+        # (controller, key) -> [write_calls, commits, sweeps]; bounded.
+        self._hot: dict[tuple[str, str], list[int]] = {}
+        self._parked: set[str] = set()
+        self._paused = False
+        self._informers_ref: Any = None
+
+    # ---- runnable contract (Manager.runnables) ----
+
+    def start(self) -> None:
+        store = self._store_ref()
+        if store is not None:
+            _OBSERVERS[store] = self
+
+    def request_stop(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def pause(self) -> None:
+        """Demotion (Manager.demote): a standby must not advertise live
+        control-plane load — zero every sweep gauge family now rather
+        than waiting for the next scrape to rebuild them."""
+        self._paused = True
+        GLOBAL_METRICS.set_gauge_family("grove_sweep_write_amp", [])
+        GLOBAL_METRICS.set_gauge_family("grove_informer_watch_lag_seconds",
+                                        [])
+        GLOBAL_METRICS.set_gauge_family("grove_informer_watch_lag_breached",
+                                        [])
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def attach_informers(self, informer_set) -> None:
+        """Wire the manager's shared informers for the watch-lag SLO
+        judge (weakly — the observer must not pin the manager's store
+        through InformerSet)."""
+        self._informers_ref = weakref.ref(informer_set)
+
+    # ---- park hygiene (satellite: stale gauges on a standby) ----
+
+    def on_park(self, controller: str) -> None:
+        with self._lock:
+            self._parked.add(controller)
+        GLOBAL_METRICS.set("grove_sweep_write_amp", 0.0,
+                           controller=controller)
+
+    def on_unpark(self, controller: str) -> None:
+        with self._lock:
+            self._parked.discard(controller)
+
+    # ---- recording ----
+
+    @contextlib.contextmanager
+    def record(self, controller: str, cause: str,
+               key: str) -> Iterator[SweepSink | None]:
+        """Attribute one reconcile sweep: installs the writeobs sweep
+        sink for the duration of the body, then folds the sweep into
+        the ledger and the rolled-up histograms. With GROVE_SWEEP_OBS=0
+        this is a bare yield — the exact prior path."""
+        if not enabled():
+            yield None
+            return
+        sink = SweepSink()
+        token = writeobs.set_sweep_sink(sink)
+        t0 = time.perf_counter()
+        try:
+            yield sink
+        finally:
+            writeobs.reset_sweep_sink(token)
+            self._ingest(controller, cause or "external", key,
+                         time.perf_counter() - t0, sink)
+
+    def _ingest(self, controller: str, cause: str, key: str,
+                wall_s: float, sink: SweepSink) -> None:
+        write_calls = sink.write_calls()
+        compute_s = max(0.0, wall_s - sink.wait_s - sink.hold_s)
+        with self._lock:
+            led = self._ledgers.get(controller)
+            if led is None:
+                led = self._ledgers[controller] = _Ledger()
+            led.sweeps += 1
+            led.causes[cause] += 1
+            led.wall_s += wall_s
+            led.lock_wait_s += sink.wait_s
+            led.store_write_s += sink.hold_s
+            led.compute_s += compute_s
+            led.write_calls += write_calls
+            led.commits += sink.commits
+            led.noops += sink.noops
+            led.conflicts += sink.conflicts
+            led.fenced += sink.fenced
+            led.scans += sink.scans
+            led.verb_calls.update(sink.verb_calls)
+            led.recent.append((write_calls, sink.commits))
+            led.last = {"cause": cause, "key": key,
+                        "wall_s": wall_s, "write_calls": write_calls,
+                        "changed": sink.commits, "noops": sink.noops,
+                        "conflicts": sink.conflicts}
+            if write_calls or sink.commits:
+                hot = self._hot.get((controller, key))
+                if hot is None:
+                    hot = self._hot[(controller, key)] = [0, 0, 0]
+                hot[0] += write_calls
+                hot[1] += sink.commits
+                hot[2] += 1
+                if len(self._hot) > 2 * HOT_CAPACITY:
+                    keep = sorted(self._hot.items(),
+                                  key=lambda kv: kv[1][0],
+                                  reverse=True)[:HOT_CAPACITY]
+                    self._hot = dict(keep)
+        # Hub emissions AFTER the observer lock (and writeobs already
+        # released the store lock): one bulk, pre-sorted label tuples —
+        # the hub's lock is held across every /metrics render.
+        observations = [("grove_sweep_seconds",
+                         _sweep_labels(cause, controller), wall_s)]
+        for verb, n in sink.verb_calls.items():
+            observations.append(("grove_sweep_writes",
+                                 _write_labels(controller, verb),
+                                 float(n)))
+        GLOBAL_METRICS.bulk(observations=observations)
+
+    # ---- export + payload ----
+
+    def export_gauges(self) -> None:
+        """Re-assert the sweep gauge families for one scrape
+        (Manager.metrics_text). Parked controllers are omitted — the
+        family setter zeroes their series (the satellite: a demoted
+        standby's gauges must read 0, not last-known load)."""
+        if self._paused:
+            return
+        with self._lock:
+            amp_series = [({"controller": name}, led.recent_amp())
+                          for name, led in self._ledgers.items()
+                          if name not in self._parked]
+        GLOBAL_METRICS.set_gauge_family("grove_sweep_write_amp",
+                                        amp_series)
+        target = watch_lag_slo_s()
+        lag_series: list[tuple[dict, float]] = []
+        breach_series: list[tuple[dict, float]] = []
+        for kind, stats in self._watch_lag_stats().items():
+            lag_series.append(({"kind": kind}, stats["last_s"]))
+            breach_series.append(({"kind": kind},
+                                  1.0 if stats["last_s"] > target else 0.0))
+        GLOBAL_METRICS.set_gauge_family("grove_informer_watch_lag_seconds",
+                                        lag_series)
+        GLOBAL_METRICS.set_gauge_family("grove_informer_watch_lag_breached",
+                                        breach_series)
+
+    def _watch_lag_stats(self) -> dict[str, dict]:
+        informer_set = self._informers_ref() \
+            if self._informers_ref is not None else None
+        if informer_set is None:
+            return {}
+        stats: dict[str, dict] = {}
+        for inf in informer_set.informers():
+            snap = inf.lag_snapshot()
+            if snap["events"]:
+                stats[inf.KIND] = snap
+        return stats
+
+    def payload(self) -> dict:
+        """The /debug/controlplane body (served by Client.debug_
+        controlplane and its HTTP twin). Server-side "now" so renderers
+        and assertions don't need a second clock."""
+        target = watch_lag_slo_s()
+        with self._lock:
+            controllers = {}
+            for name, led in self._ledgers.items():
+                controllers[name] = {
+                    "sweeps": led.sweeps,
+                    "causes": dict(led.causes),
+                    "wall_s": led.wall_s,
+                    "lock_wait_s": led.lock_wait_s,
+                    "store_write_s": led.store_write_s,
+                    "compute_s": led.compute_s,
+                    "write_calls": led.write_calls,
+                    "changed": led.commits,
+                    "noops": led.noops,
+                    "conflicts": led.conflicts,
+                    "fenced": led.fenced,
+                    "scans": led.scans,
+                    "verbs": dict(led.verb_calls),
+                    "write_amp": led.total_amp(),
+                    "recent_write_amp": led.recent_amp(),
+                    "parked": name in self._parked,
+                    "last": dict(led.last),
+                }
+            hot = sorted(self._hot.items(), key=lambda kv: kv[1][0],
+                         reverse=True)[:10]
+        watch_lag = {}
+        for kind, stats in self._watch_lag_stats().items():
+            watch_lag[kind] = {
+                "events": stats["events"],
+                "last_s": stats["last_s"],
+                "max_s": stats["max_s"],
+                "breached": stats["last_s"] > target,
+            }
+        wait_sum, wait_n = GLOBAL_METRICS.hist_totals(
+            "grove_workqueue_wait_seconds")
+        work_sum, work_n = GLOBAL_METRICS.hist_totals(
+            "grove_workqueue_work_seconds")
+        return {
+            "now": time.time(),
+            "enabled": enabled(),
+            "write_obs_enabled": writeobs.enabled(),
+            "slo_target_s": target,
+            "controllers": controllers,
+            "hot_objects": [
+                {"controller": ctrl, "key": key, "write_calls": h[0],
+                 "changed": h[1], "sweeps": h[2]}
+                for (ctrl, key), h in hot],
+            "watch_lag": watch_lag,
+            "queue": {"wait_s": wait_sum, "waits": wait_n,
+                      "work_s": work_sum, "works": work_n},
+        }
+
+
+# Cached pre-sorted label tuples (the writeobs idiom): cardinality is
+# controllers x causes / controllers x verbs — small and bounded.
+_SWEEP_LABELS: dict[tuple[str, str], tuple] = {}
+_WRITE_LABELS: dict[tuple[str, str], tuple] = {}
+
+
+def _sweep_labels(cause: str, controller: str) -> tuple:
+    key = (cause, controller)
+    labels = _SWEEP_LABELS.get(key)
+    if labels is None:
+        labels = _SWEEP_LABELS[key] = (("cause", cause),
+                                       ("controller", controller))
+    return labels
+
+
+def _write_labels(controller: str, verb: str) -> tuple:
+    key = (controller, verb)
+    labels = _WRITE_LABELS.get(key)
+    if labels is None:
+        labels = _WRITE_LABELS[key] = (("controller", controller),
+                                       ("verb", verb))
+    return labels
+
+
+@contextlib.contextmanager
+def maybe_record(observer: SweepObserver | None, controller: str,
+                 cause: str, key: str) -> Iterator[SweepSink | None]:
+    """record() that tolerates an unmanaged controller (no observer) —
+    the Controller._process call site stays one line either way."""
+    if observer is None or not enabled():
+        yield None
+        return
+    with observer.record(controller, cause, key) as sink:
+        yield sink
+
+
+def render_controlplane_status(payload: dict,
+                               now: float | None = None,
+                               max_write_amp: float =
+                               DEFAULT_WRITE_AMP_THRESHOLD) -> list[str]:
+    """grovectl controlplane-status lines (shared by CLI and tests —
+    the render-beside-recorder house pattern). The hottest controller
+    (largest sweep wall share) is starred."""
+    now = payload.get("now", now or time.time())
+    lines = ["control-plane observatory"
+             + ("" if payload.get("enabled", True)
+                else "  [GROVE_SWEEP_OBS=0 — ledger frozen]")]
+    controllers = payload.get("controllers", {})
+    hottest = max(controllers, key=lambda n: controllers[n]["wall_s"]) \
+        if controllers else None
+    lines.append(f"  controllers: {len(controllers)}  "
+                 f"watch-lag SLO target: "
+                 f"{payload.get('slo_target_s', 0.0):.3f}s")
+    for name in sorted(controllers,
+                       key=lambda n: -controllers[n]["wall_s"]):
+        led = controllers[name]
+        star = "*" if name == hottest else " "
+        causes = ",".join(f"{c}:{n}" for c, n in sorted(
+            led["causes"].items(), key=lambda kv: -kv[1])[:3])
+        amp = led["recent_write_amp"]
+        flag = "  AMP!" if amp > max_write_amp else ""
+        parked = "  (parked)" if led.get("parked") else ""
+        lines.append(
+            f"{star} {name:<16} sweeps {led['sweeps']:>6}  "
+            f"wall {led['wall_s']*1000.0:8.1f}ms "
+            f"(lock {led['lock_wait_s']*1000.0:.1f} / store "
+            f"{led['store_write_s']*1000.0:.1f} / compute "
+            f"{led['compute_s']*1000.0:.1f})  "
+            f"writes {led['write_calls']} calls / {led['changed']} "
+            f"changed (amp {amp:.2f}){flag}  causes {causes}"
+            f"{parked}")
+    hot = payload.get("hot_objects", [])
+    if hot:
+        lines.append("  hottest objects:")
+        for h in hot[:5]:
+            lines.append(f"    {h['controller']} {h['key']}: "
+                         f"{h['write_calls']} writes / {h['changed']} "
+                         f"changed over {h['sweeps']} sweeps")
+    for kind, wl in sorted(payload.get("watch_lag", {}).items()):
+        verdict = "BREACH" if wl["breached"] else "ok"
+        lines.append(f"  watch-lag {kind:<14} last "
+                     f"{wl['last_s']*1000.0:8.3f}ms  max "
+                     f"{wl['max_s']*1000.0:8.3f}ms  events "
+                     f"{wl['events']:>7}  [{verdict}]")
+    q = payload.get("queue", {})
+    if q.get("works"):
+        lines.append(f"  queue: wait {q['wait_s']:.3f}s over "
+                     f"{q['waits']:.0f} pickups, work "
+                     f"{q['work_s']:.3f}s over {q['works']:.0f} sweeps")
+    return lines
+
+
+def status_problems(payload: dict,
+                    max_write_amp: float = DEFAULT_WRITE_AMP_THRESHOLD
+                    ) -> list[str]:
+    """The exit-1 predicate grovectl and the smoke share: watch-lag SLO
+    breaches and write-amp above threshold, as human-readable strings
+    (empty = exit 0)."""
+    problems = []
+    for kind, wl in payload.get("watch_lag", {}).items():
+        if wl.get("breached"):
+            problems.append(
+                f"watch-lag SLO breached for {kind}: last event applied "
+                f"{wl['last_s']:.3f}s stale (target "
+                f"{payload.get('slo_target_s', 0.0):.3f}s)")
+    for name, led in payload.get("controllers", {}).items():
+        amp = led.get("recent_write_amp", 0.0)
+        if amp > max_write_amp:
+            problems.append(
+                f"write amplification on {name}: {amp:.2f} writes per "
+                f"changed object (threshold {max_write_amp:.2f})")
+    return problems

@@ -77,6 +77,12 @@ control plane exposes its own minimal HTTP API so out-of-process clients
                                       (grovectl defrag-status renders
                                       it; same read gate as
                                       /debug/placement)
+  GET  /debug/controlplane            control-plane observatory: per-
+                                      controller sweep attribution,
+                                      write-amplification ledger,
+                                      watch-lag SLO (grovectl
+                                      controlplane-status renders it;
+                                      same read gate as /debug/defrag)
   GET  /debug/leadership              this replica's leadership view:
                                       role, fencing epoch, transitions,
                                       leader hint (grovectl
@@ -506,6 +512,8 @@ class ApiServer:
                         self._debug_disruption()
                     elif url.path == "/debug/leadership":
                         self._debug_leadership()
+                    elif url.path == "/debug/controlplane":
+                        self._debug_controlplane()
                     else:
                         self._send(404, {"error": "not found"})
                 except NotFoundError as e:
@@ -831,6 +839,17 @@ class ApiServer:
                 gate."""
                 self._send(200, cluster.manager.leadership.payload(
                     cluster.manager.store))
+
+            def _debug_controlplane(self):
+                """GET /debug/controlplane — the control-plane
+                observatory's sweep ledger (``grovectl
+                controlplane-status`` renders it): per-controller
+                reconcile attribution, write-amplification,
+                hot-object top-K, watch-lag SLO. Aggregate operational
+                state like /debug/defrag, so it shares the read gate,
+                not the profiling gate. NotFoundError from the twin
+                maps to 404 in do_GET's handler."""
+                self._send(200, cluster.client.debug_controlplane())
 
             def _debug_serving(self, namespace: str, name: str):
                 """GET /debug/serving/<ns>/<name> — one serving scope's

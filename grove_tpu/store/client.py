@@ -258,6 +258,21 @@ class Client:
                 "(no engine reported inside the sample TTL)")
         return payload
 
+    def debug_controlplane(self) -> dict:
+        """The control-plane observatory's sweep ledger (per-controller
+        reconcile attribution, write-amplification, watch-lag SLO) —
+        the in-process twin of ``GET /debug/controlplane`` (same
+        payload shape; grovectl controlplane-status renders either).
+        Raises NotFoundError when no observatory runs on this store."""
+        from grove_tpu.runtime.errors import NotFoundError
+        from grove_tpu.runtime.sweepobs import observer_for
+        obs = observer_for(self._store)
+        if obs is None:
+            raise NotFoundError(
+                "control-plane observatory is not running for this "
+                "store (no started Manager owns it)")
+        return obs.payload()
+
 
 @dataclasses.dataclass
 class _InjectedError:
@@ -364,6 +379,26 @@ class FakeClient(Client):
                      namespace: str = "default") -> Any:
         self._intercept("patch_status", kind_cls.KIND, name)
         return super().patch_status(kind_cls, name, patch, namespace)
+
+    def patch_status_many(self, kind_cls: type,
+                          items: list[tuple[str, dict]],
+                          namespace: str = "default"
+                          ) -> list[Exception | None]:
+        # Decomposed like update_status_many: injected patch_status
+        # errors replay per item and every call is recorded.
+        from grove_tpu.runtime.errors import (
+            ForbiddenError,
+            NotFoundError,
+            ValidationError,
+        )
+        results: list[Exception | None] = []
+        for name, patch in items:
+            try:
+                self.patch_status(kind_cls, name, patch, namespace)
+                results.append(None)
+            except (NotFoundError, ValidationError, ForbiddenError) as e:
+                results.append(e)
+        return results
 
     def delete(self, kind_cls: type, name: str, namespace: str = "default") -> None:
         self._intercept("delete", kind_cls.KIND, name)

@@ -332,6 +332,13 @@ class HttpClient:
         leader-status renders either)."""
         return self._request("GET", "/debug/leadership")
 
+    def debug_controlplane(self) -> dict:
+        """The control-plane observatory's sweep ledger from
+        ``GET /debug/controlplane`` (the wire twin of
+        ``Client.debug_controlplane``; grovectl controlplane-status
+        renders either; 404 maps to NotFoundError)."""
+        return self._request("GET", "/debug/controlplane")
+
     def watch_events(self, kinds: list[str] | None = None,
                      namespace: str | None = None,
                      selector: dict[str, str] | None = None,

@@ -71,6 +71,15 @@ DIRECT_WRITER = "direct"
 _writer_ctx: contextvars.ContextVar = contextvars.ContextVar(
     "grove_store_writer", default=DIRECT_WRITER)
 
+# Per-sweep attribution sink (runtime/sweepobs.py): a contextvar — NOT
+# a thread-local — because reconcile fan-out through
+# runtime/concurrent.py copies the submitter's context onto pool
+# threads; a slow-start pod-creation burst's writes must land in the
+# sweep that issued them, exactly like the writer label above. The sink
+# object itself is thread-safe (many pool threads absorb into one).
+_sweep_sink_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "grove_sweep_sink", default=None)
+
 # The write record being accumulated by this thread's in-flight store
 # write verb (the store lock serializes writers, but records are
 # per-thread so concurrent verbs on different stores never mix).
@@ -98,6 +107,23 @@ def reset_writer(token) -> None:
 
 def current_writer() -> str:
     return _writer_ctx.get()
+
+
+def set_sweep_sink(sink):
+    """Install a per-sweep write sink on this context (the sweep
+    observatory calls this around each reconcile). Every WriteRecord
+    flushed while it is installed — on this thread or any pool thread
+    the context is copied onto — is absorbed into the sink. Returns a
+    token for ``reset_sweep_sink``."""
+    return _sweep_sink_ctx.set(sink)
+
+
+def reset_sweep_sink(token) -> None:
+    _sweep_sink_ctx.reset(token)
+
+
+def current_sweep_sink():
+    return _sweep_sink_ctx.get()
 
 
 class WriteRecord:
@@ -214,6 +240,11 @@ def count_scan(kind: str) -> None:
     if rec is not None:
         rec.scans.append(kind)
         return
+    sink = _sweep_sink_ctx.get()
+    if sink is not None:
+        # Scans inside an open write record reach the sweep sink at
+        # flush; this is the common standalone-list path.
+        sink.absorb_scan(kind)
     inc = _SCAN_INC.get(kind)
     if inc is None:
         inc = _SCAN_INC[kind] = (
@@ -229,6 +260,12 @@ def flush(rec: WriteRecord) -> None:
     IS the steady state: every reconcile of a converged fleet ends in
     exactly one of these."""
     _active.rec = None
+    sink = _sweep_sink_ctx.get()
+    if sink is not None:
+        # Sweep attribution (runtime/sweepobs.py) — fed on EVERY path,
+        # pure no-ops included: "how many write calls did this sweep
+        # issue" is exactly the number batching is supposed to bend.
+        sink.absorb(rec)
     w = rec.writer
     if not rec.commits and not rec.conflicts and not rec.events \
             and not rec.fenced:

@@ -690,6 +690,93 @@ def test_syntax_error_is_exit_2_not_crash(tmp_path):
     assert "syntax error" in out.stderr + out.stdout
 
 
+# ---- unattributed-controller-write --------------------------------------
+
+UNATTRIBUTED_BAD = """
+    import threading
+
+    class NodeSweeper:
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def _run(self):
+            while True:
+                self._pass()
+
+        def _pass(self):
+            for node in self.client.list(Node):
+                self.client.update_status(node)
+"""
+
+UNATTRIBUTED_GOOD = """
+    import threading
+    from grove_tpu.store import writeobs
+
+    class NodeSweeper:
+        def start(self):
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+        def _run(self):
+            token = writeobs.set_writer("node-sweeper")
+            try:
+                while True:
+                    self._pass()
+            finally:
+                writeobs.reset_writer(token)
+
+        def _pass(self):
+            for node in self.client.list(Node):
+                self.client.update_status(node)
+"""
+
+
+def test_unattributed_controller_write_fires():
+    findings = lint(UNATTRIBUTED_BAD, "grove_tpu/controllers/sweeper.py")
+    assert rules_of(findings) == {"unattributed-controller-write"}
+    # update_status two self-call hops below the thread entrypoint
+    # (list is a read — only the write fires).
+    assert len(findings) == 1
+    assert "writer=\"direct\"" in findings[0].message
+
+
+def test_unattributed_controller_write_timer_target_fires():
+    src = """
+        import threading
+
+        class Backoff:
+            def arm(self):
+                threading.Timer(5.0, self._fire).start()
+
+            def _fire(self):
+                self.client.delete(Pod, "stale")
+    """
+    findings = lint(src, "grove_tpu/controllers/backoff.py")
+    assert rules_of(findings) == {"unattributed-controller-write"}
+
+
+def test_unattributed_controller_write_compliant_quiet():
+    assert lint(UNATTRIBUTED_GOOD,
+                "grove_tpu/controllers/sweeper.py") == []
+
+
+def test_unattributed_controller_write_no_thread_quiet():
+    """Writes from plain reconcile methods are attributed by
+    Controller._process's contextvar — no thread, no finding."""
+    src = """
+        class Reconciler:
+            def reconcile(self, req):
+                obj = self.client.get(Pod, req.name)
+                self.client.update_status(obj)
+    """
+    assert lint(src, "grove_tpu/controllers/reconciler.py") == []
+
+
+def test_unattributed_controller_write_scoped_to_controllers():
+    assert lint(UNATTRIBUTED_BAD, "grove_tpu/agent/local.py") == []
+
+
 # ---- the repo itself stays clean ----------------------------------------
 
 def test_repo_lints_clean():

@@ -44,6 +44,13 @@ def render(rows: list[dict]) -> str:
                if r.get("metric") == "gang_pending_reasons"]
     deploys = [r for r in rows if r.get("metric") == "reconcile_p50_ms"
                and r.get("deploy_wall_ms", 0) > 0]
+    # The 4096-pod control-plane pin (make bench-reconcile-4k): latency
+    # and writes/pod rows land as a pair per run, joined here by (ts,
+    # git) into one row of the observatory table.
+    fourk_lat = [r for r in rows
+                 if r.get("metric") == "reconcile_p50_ms_4k"]
+    fourk_writes = {(r.get("ts"), r.get("git")): r for r in rows
+                    if r.get("metric") == "store_writes_per_pod_4k"}
     serving = [r for r in rows
                if r.get("metric") == "serving_ttft_p99_ms"]
     serving_tok = [r for r in rows
@@ -83,8 +90,9 @@ def render(rows: list[dict]) -> str:
     failovers = [r for r in rows
                  if r.get("metric") in ("failover_resume_warm_s",
                                         "failover_resume_cold_s")]
-    cp_modes = {"sched-cpu", "reconcile-cpu", "trace-cpu", "explain-cpu",
-                "serving-cpu", "chaos-cpu", "defrag-cpu", "reclaim-cpu"}
+    cp_modes = {"sched-cpu", "reconcile-cpu", "reconcile-cpu-4k",
+                "trace-cpu", "explain-cpu", "serving-cpu", "chaos-cpu",
+                "defrag-cpu", "reclaim-cpu"}
     # Control-plane rows without a mode stamp (the failover/leader-kill
     # seconds rows) must not masquerade as tok/s in the serving table.
     cp_metrics = {"failover_resume_warm_s", "failover_resume_cold_s",
@@ -132,6 +140,32 @@ def render(rows: list[dict]) -> str:
                 f"| {r.get('store_list_scans', '?')} "
                 f"| {r.get('deploy_speedup', '-')} "
                 f"| {r.get('steady_speedup', '-')} |")
+        out.append("")
+    if fourk_lat:
+        out += ["## 4096-pod control-plane pin (sweep observatory "
+                "ledger)", "",
+                "_tools/bench_reconcile.py --fourk: 4096 pods / 1024 "
+                "gangs deployed to convergence with per-sweep "
+                "attribution on; writes/pod is the observatory's own "
+                "write-amplification ledger, and the batched column "
+                "must sit strictly below unbatched "
+                "(docs/design/controlplane-observatory.md)_", "",
+                "| when | git | pods | gangs | p50 ms | p99 ms | "
+                "deploy ms | rounds | writes/pod batched | unbatched | "
+                "ratio |",
+                "|---|---|---|---|---|---|---|---|---|---|---|"]
+        for r in sorted(fourk_lat, key=lambda r: r.get("ts", "")):
+            w = fourk_writes.get((r.get("ts"), r.get("git")), {})
+            out.append(
+                f"| {r.get('ts', '?')[:16]} | {r.get('git', '?')} "
+                f"| {r.get('pods', '?')} | {r.get('gangs', '?')} "
+                f"| {r.get('value', 0):.3f} "
+                f"| {r.get('p99_ms', 0):.3f} "
+                f"| {r.get('deploy_wall_ms', 0):.0f} "
+                f"| {r.get('rounds', '?')} "
+                f"| {w.get('value', '-')} "
+                f"| {w.get('unbatched_writes_per_pod', '-')} "
+                f"| {w.get('batching_ratio', '-')}x |")
         out.append("")
     if pending:
         out += ["## Pending gangs by reason (placement explainability "

@@ -59,6 +59,8 @@ from grove_tpu.controllers.podclique import PodCliqueReconciler
 from grove_tpu.controllers.podcliqueset import PodCliqueSetReconciler
 from grove_tpu.controllers.podgang import PodGangReconciler
 from grove_tpu.controllers.scalinggroup import ScalingGroupReconciler
+from grove_tpu.controllers.statusbatch import STATUS_BATCH_ENV
+from grove_tpu.runtime import sweepobs
 from grove_tpu.runtime.controller import Request
 from grove_tpu.runtime.informer import CachedClient, InformerSet
 from grove_tpu.runtime.metrics import GLOBAL_METRICS, parse_counters
@@ -66,6 +68,16 @@ from grove_tpu.scheduler.registry import build_registry
 from grove_tpu.store.client import Client
 from grove_tpu.store.store import Store
 from tools.bench_sched import append_history
+
+# The ledger's controller names for the bench's round-robin drive —
+# the same names a Manager-run control plane reports, so 4k rows read
+# like production /debug/controlplane output.
+CONTROLLER_OF = {
+    "PodCliqueSet": "podcliqueset",
+    "PodCliqueScalingGroup": "podcliquescalinggroup",
+    "PodClique": "podclique",
+    "PodGang": "podgang",
+}
 
 
 def counter_total(name: str) -> float:
@@ -91,32 +103,40 @@ def build_workload(client: Client, pods: int, gang_size: int = 4) -> int:
     return replicas
 
 
-def sweep(store: Store, reconcilers: dict,
-          durations: list[float]) -> None:
+def sweep(store: Store, reconcilers: dict, durations: list[float],
+          observer: "sweepobs.SweepObserver | None" = None) -> None:
     """One full round: every object through its real reconciler
     (single-threaded; the workqueue's coalescing is irrelevant to
     read-path cost). Object enumeration reads the store dict directly —
     NOT through a client — so the harness's own bookkeeping never
-    pollutes the scan counts being measured."""
+    pollutes the scan counts being measured. With an ``observer``, each
+    reconcile records as a sweep (cause "bench") so the run's write
+    attribution lands in the observatory's ledger — how the 4k mode
+    proves the batching win from the same surface operators read."""
     for kind in ("PodCliqueSet", "PodCliqueScalingGroup", "PodClique",
                  "PodGang"):
         rec = reconcilers[kind]
+        controller = CONTROLLER_OF[kind]
         for ns, name in sorted(store._objects.get(kind, {})):
             t0 = time.perf_counter()
-            rec.reconcile(Request(ns, name))
+            with sweepobs.maybe_record(observer, controller, "bench",
+                                       f"{ns}/{name}"):
+                rec.reconcile(Request(ns, name))
             durations.append(time.perf_counter() - t0)
 
 
 def drive_until_settled(store: Store, reconcilers: dict,
                         durations: list[float],
-                        rounds_cap: int = 64) -> int:
+                        rounds_cap: int = 64,
+                        observer: "sweepobs.SweepObserver | None" = None
+                        ) -> int:
     """Sweep until a full round moves no resource version. Returns the
     number of rounds."""
     rounds = 0
     while rounds < rounds_cap:
         rounds += 1
         rv0 = store.current_rv()
-        sweep(store, reconcilers, durations)
+        sweep(store, reconcilers, durations, observer)
         if store.current_rv() == rv0:
             break
     return rounds
@@ -209,6 +229,102 @@ def bench_fleet(pods: int, reps: int, informer: bool = True) -> dict:
     }
 
 
+def run_4k_once(pods: int, batched: bool,
+                gang_size: int = 4) -> dict:
+    """One deploy-to-convergence at the 4k point with the control-plane
+    observatory attached: every reconcile records into a SweepObserver
+    ledger, so write calls vs changed objects come from the SAME
+    surface ``grovectl controlplane-status`` reads — the batching win
+    must be legible there, not in private bench bookkeeping."""
+    prev = os.environ.get(STATUS_BATCH_ENV)
+    os.environ[STATUS_BATCH_ENV] = "1" if batched else "0"
+    try:
+        store = Store()
+        base = Client(store)
+        client = CachedClient(base, InformerSet(store=store))
+        registry = build_registry(OperatorConfiguration(), base)
+        observer = sweepobs.SweepObserver(store)
+        observer.start()
+        gangs = build_workload(base, pods, gang_size)
+        reconcilers = {
+            "PodCliqueSet": PodCliqueSetReconciler(client),
+            "PodCliqueScalingGroup": ScalingGroupReconciler(client),
+            "PodClique": PodCliqueReconciler(client, registry),
+            "PodGang": PodGangReconciler(client, registry),
+        }
+        durations: list[float] = []
+        t0 = time.perf_counter()
+        rounds = drive_until_settled(store, reconcilers, durations,
+                                     observer=observer)
+        wall = time.perf_counter() - t0
+        payload = observer.payload()
+        ctrl = payload["controllers"]
+        write_calls = sum(c["write_calls"] for c in ctrl.values())
+        changed = sum(c["changed"] for c in ctrl.values())
+        n_pods = len(store._objects.get("Pod", {}))
+        observer.stop()
+    finally:
+        if prev is None:
+            os.environ.pop(STATUS_BATCH_ENV, None)
+        else:
+            os.environ[STATUS_BATCH_ENV] = prev
+    assert n_pods == pods, (n_pods, pods)
+    return {"wall_s": wall, "gangs": gangs, "pods": n_pods,
+            "rounds": rounds, "write_calls": write_calls,
+            "changed": changed, "durations": durations,
+            "per_controller": {name: {"write_calls": c["write_calls"],
+                                      "changed": c["changed"],
+                                      "sweeps": c["sweeps"]}
+                               for name, c in ctrl.items()}}
+
+
+def bench_4k(pods: int = 4096, gang_size: int = 4) -> list[dict]:
+    """The 4096-pod / 1024-gang pin: same seed workload driven batched
+    (GROVE_STATUS_BATCH=1) and unbatched (=0); the observatory ledger
+    must show batched write calls per pod STRICTLY below unbatched —
+    the acceptance gate for the patch_status_many conversion. Returns
+    the two history rows (reconcile_p50_ms_4k, store_writes_per_pod_4k)."""
+    batched = run_4k_once(pods, batched=True, gang_size=gang_size)
+    unbatched = run_4k_once(pods, batched=False, gang_size=gang_size)
+    b_per_pod = batched["write_calls"] / max(1, pods)
+    u_per_pod = unbatched["write_calls"] / max(1, pods)
+    assert b_per_pod < u_per_pod, (
+        f"status batching regressed: {b_per_pod:.3f} write calls/pod "
+        f"batched vs {u_per_pod:.3f} unbatched at {pods} pods — the "
+        f"observatory ledger no longer shows the patch_status_many win "
+        f"(per-controller: batched={batched['per_controller']} "
+        f"unbatched={unbatched['per_controller']})")
+    pooled = sorted(d * 1e3 for d in batched["durations"])
+    q = statistics.quantiles(pooled, n=100, method="inclusive") \
+        if len(pooled) > 1 else pooled * 2
+    lat_row = {
+        "metric": "reconcile_p50_ms_4k",
+        "value": round(statistics.median(pooled), 4),
+        "unit": "ms/reconcile",
+        "pods": pods,
+        "gangs": batched["gangs"],
+        "p99_ms": round(q[98] if len(pooled) > 1 else pooled[0], 4),
+        "deploy_wall_ms": round(batched["wall_s"] * 1e3, 3),
+        "rounds": batched["rounds"],
+        "reconciles": len(batched["durations"]),
+        "mode": "reconcile-cpu-4k",
+    }
+    writes_row = {
+        "metric": "store_writes_per_pod_4k",
+        "value": round(b_per_pod, 3),
+        "unit": "write-calls/pod",
+        "pods": pods,
+        "gangs": batched["gangs"],
+        "write_calls": batched["write_calls"],
+        "changed": batched["changed"],
+        "unbatched_write_calls": unbatched["write_calls"],
+        "unbatched_writes_per_pod": round(u_per_pod, 3),
+        "batching_ratio": round(u_per_pod / max(b_per_pod, 1e-9), 2),
+        "mode": "reconcile-cpu-4k",
+    }
+    return [lat_row, writes_row]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--pods", type=int, nargs="*",
@@ -223,9 +339,32 @@ def main() -> None:
                          "path and print speedup + scan ratio")
     ap.add_argument("--no-history", action="store_true",
                     help="do not append to bench-history/")
+    ap.add_argument("--fourk", action="store_true",
+                    help="run ONLY the 4096-pod / 1024-gang pin: "
+                         "batched vs unbatched status writes on the "
+                         "same seed, proven from the observatory "
+                         "ledger (make bench-reconcile-4k)")
+    ap.add_argument("--fourk-pods", type=int, default=4096,
+                    help="pod count for --fourk (default 4096; lower "
+                         "it for a CI smoke of the same code path)")
     args = ap.parse_args()
     if args.no_history:
         os.environ["GROVE_BENCH_HISTORY"] = "0"
+
+    if args.fourk:
+        lat_row, writes_row = bench_4k(args.fourk_pods)
+        print(f"pods={lat_row['pods']} gangs={lat_row['gangs']} "
+              f"p50={lat_row['value']:.3f} ms "
+              f"p99={lat_row['p99_ms']:.3f} ms "
+              f"deploy={lat_row['deploy_wall_ms']:.0f} ms "
+              f"rounds={lat_row['rounds']}", flush=True)
+        print(f"write-calls/pod: batched={writes_row['value']:.3f} "
+              f"unbatched={writes_row['unbatched_writes_per_pod']:.3f} "
+              f"({writes_row['batching_ratio']:.2f}x fewer calls, "
+              f"from the observatory ledger)", flush=True)
+        append_history(lat_row)
+        append_history(writes_row)
+        return
 
     for pods in args.pods:
         row = bench_fleet(pods, args.reps, informer=True)
